@@ -1,0 +1,126 @@
+"""paddle_tpu: a TPU-native deep learning framework.
+
+Capability parity target: the PaddlePaddle reference surveyed in /root/repo/SURVEY.md.
+Architecture: idiomatic JAX/XLA — eager dygraph tensors over jax.Array with
+tape autograd, trace-to-XLA jit, GSPMD sharding for hybrid parallelism,
+Pallas kernels for hot ops.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# ---- core types ----
+from .core.tensor import Tensor, Parameter  # noqa: F401
+from .core.dtype import (  # noqa: F401
+    float32, float64, float16, bfloat16, int8, int16, int32, int64,
+    uint8, bool_, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    finfo, iinfo,
+)
+from .core.state import (  # noqa: F401
+    seed, no_grad, enable_grad, set_default_dtype, get_default_dtype,
+)
+
+# ---- functional API (flat namespace, paddle-style) ----
+from .tensor_ops.creation import (  # noqa: F401
+    to_tensor, zeros, ones, full, empty, zeros_like, ones_like, full_like,
+    empty_like, arange, linspace, logspace, eye, meshgrid, assign, clone,
+    tril_indices, triu_indices, diagflat, complex, polar,
+)
+from .tensor_ops.math import (  # noqa: F401
+    add, subtract, multiply, divide, floor_divide, remainder, mod, pow,
+    scale, abs, neg, exp, expm1, log, log2, log10, log1p, sqrt, rsqrt,
+    square, sin, cos, tan, sinh, cosh, tanh, asin, acos, atan, atan2, erf,
+    erfinv, sigmoid, floor, ceil, round, trunc, sign, reciprocal, clip,
+    maximum, minimum, fmax, fmin, lerp, isnan, isinf, isfinite, nan_to_num,
+    add_n, multiplex, stanh, logit, frac, rad2deg, deg2rad, angle, conj,
+    real, imag, gcd, lcm, heaviside, diff, inner, outer, trace,
+)
+from .tensor_ops.reduction import (  # noqa: F401
+    sum, mean, max, min, amax, amin, prod, all, any, logsumexp, cumsum,
+    cumprod, cummax, std, var, median, quantile, nanmean, nansum,
+    count_nonzero,
+)
+from .tensor_ops.linalg import (  # noqa: F401
+    matmul, transpose, t, dot, mv, bmm, norm, dist, cross, einsum,
+    matrix_power, inverse, det, slogdet, cholesky, cholesky_solve,
+    triangular_solve, kron, multi_dot,
+)
+from .tensor_ops.manipulation import (  # noqa: F401
+    cast, reshape, reshape_, flatten, squeeze, unsqueeze, concat, stack,
+    split, chunk, unbind, tile, expand, expand_as, broadcast_to,
+    broadcast_tensors, gather, gather_nd, take_along_axis, put_along_axis,
+    scatter, scatter_nd, scatter_nd_add, index_select, index_sample,
+    index_add, index_put, masked_select, masked_fill, roll, flip, rot90,
+    repeat_interleave, slice, strided_slice, diagonal, diag, diag_embed,
+    tril, triu, moveaxis, swapaxes, as_real, as_complex, unfold, unique,
+    one_hot, tensordot, bincount, histogram,
+)
+from .tensor_ops.logic import (  # noqa: F401
+    equal, not_equal, less_than, less_equal, greater_than, greater_equal,
+    equal_all, allclose, isclose, logical_and, logical_or, logical_not,
+    logical_xor, bitwise_and, bitwise_or, bitwise_xor, bitwise_not,
+    is_empty,
+)
+from .tensor_ops.search import (  # noqa: F401
+    argmax, argmin, argsort, sort, topk, kthvalue, mode, nonzero, where,
+    searchsorted, bucketize,
+)
+from .tensor_ops.random import (  # noqa: F401
+    rand, randn, standard_normal, normal, uniform, randint, randint_like,
+    randperm, multinomial, bernoulli, poisson, rand_like, randn_like,
+)
+
+# install Tensor methods now that ops exist
+from .core.tensor import _install_methods as _im
+_im()
+del _im
+
+# ---- subpackages (paddle-style namespaces) ----
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from .autograd import grad  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import framework  # noqa: F401,E402
+from .framework.io import save, load  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from .device import set_device, get_device, is_compiled_with_cuda  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from .utils.flags import set_flags, get_flags  # noqa: F401,E402
+
+
+def disable_static(place=None):
+    """Dygraph is the default and only eager mode; kept for API parity."""
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static "
+        "to compile dygraph code to a single XLA program.")
+
+
+def in_dynamic_mode():
+    return True
+
+
+def is_grad_enabled():
+    from .core import state
+    return state.STATE.grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    from .core import state
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        prev = state.STATE.grad_enabled
+        state.STATE.grad_enabled = mode
+        try:
+            yield
+        finally:
+            state.STATE.grad_enabled = prev
+    return _ctx()

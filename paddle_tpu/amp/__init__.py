@@ -1,0 +1,154 @@
+"""Automatic mixed precision (reference: python/paddle/amp/ —
+auto_cast.py:696, grad_scaler.py:578).
+
+TPU-native: bf16 is the native compute type; `auto_cast` flips the dispatch
+hook to cast white-listed op inputs (O1) or everything non-black (O2) to
+bf16.  GradScaler keeps the reference API; with bf16 no loss scaling is
+numerically required (scale stays 1 and never updates), while fp16 uses real
+dynamic loss scaling.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..core import state as _state
+from ..core.tensor import Tensor
+from ..core import dtype as _dtype
+from . import amp_lists  # noqa: F401
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    st = _state.STATE
+    prev = (st.amp_level, st.amp_dtype, st.amp_custom_white_list,
+            st.amp_custom_black_list)
+    if enable:
+        st.amp_level = level
+        st.amp_dtype = _dtype.convert_dtype(dtype)
+        st.amp_custom_white_list = set(custom_white_list or ())
+        st.amp_custom_black_list = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (st.amp_level, st.amp_dtype, st.amp_custom_white_list,
+         st.amp_custom_black_list) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Cast model params to amp dtype (O2); optimizer keeps fp32 master
+    weights automatically (reference: amp.decorate master weights)."""
+    target = _dtype.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if _dtype.is_floating_point(p.dtype) and p.dtype != target:
+                    p._data = p._data.astype(target)
+    if optimizers is None:
+        return models if single else model_list
+    for opt in ([optimizers] if not isinstance(optimizers, (list, tuple))
+                else optimizers):
+        opt._use_master_weights = (master_weight is not False)
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py:578).
+
+    bf16 training does not need scaling — with init_loss_scaling=1.0 this is
+    a transparent pass-through, keeping train-loop code portable.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable or self._scale == 1.0:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import jax.numpy as jnp
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in optimizer._all_params():
+            if p.grad is not None:
+                g = p.grad._data
+                if self._scale != 1.0:
+                    g = g * jnp.asarray(inv, g.dtype)
+                    p.grad._data = g
+        # NaN/Inf check is lazy (host sync) — only when scaling is active
+        if self._scale != 1.0:
+            for p in optimizer._all_params():
+                if p.grad is not None:
+                    import numpy as np
+                    if not np.isfinite(np.asarray(
+                            jnp.sum(p.grad._data))).all():
+                        found_inf = True
+                        break
+        self._found_inf = found_inf
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic) or self._scale == 1.0:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state["good_steps"]
+        self._bad_steps = state["bad_steps"]

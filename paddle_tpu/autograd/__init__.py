@@ -1,0 +1,171 @@
+"""User-facing autograd API (reference: python/paddle/autograd/ —
+backward_mode.py:23 `backward`, paddle.grad, PyLayer)."""
+from __future__ import annotations
+
+from ..core.autograd import run_backward
+from ..core.tensor import Tensor
+from ..core import state as _state
+from ..core.dispatch import apply_op
+
+no_grad = _state.no_grad
+enable_grad = _state.enable_grad
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None):
+    """paddle.grad (reference: GeneralGrad, paddle/fluid/eager/backward.cc:102)."""
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    return run_backward(list(outputs), grad_outputs,
+                        retain_graph=retain_graph, create_graph=create_graph,
+                        inputs=list(inputs), allow_unused=allow_unused)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom autograd op (reference: paddle/fluid/eager/pylayer/).
+
+    Subclass with static `forward(ctx, *args)` and `backward(ctx, *grads)`.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.autograd import GradNode
+        ctx = PyLayerContext()
+        with _state.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = isinstance(outs, Tensor)
+        out_list = [outs] if single else list(outs)
+
+        tensor_inputs = tuple(a for a in args if isinstance(a, Tensor))
+        need_grad = (_state.grad_enabled()
+                     and any(not t.stop_gradient for t in tensor_inputs))
+        if need_grad:
+            def vjp_fn(cots):
+                cot_tensors = [Tensor(c) for c in
+                               (cots if isinstance(cots, tuple) else (cots,))]
+                with _state.no_grad():
+                    gins = cls.backward(ctx, *cot_tensors)
+                if isinstance(gins, Tensor) or gins is None:
+                    gins = (gins,)
+                out = []
+                gi = iter(gins)
+                for a in args:
+                    if isinstance(a, Tensor):
+                        g = next(gi, None)
+                        out.append(None if g is None else
+                                   (g._data if isinstance(g, Tensor) else g))
+                return tuple(out)
+
+            node = GradNode(cls.__name__, vjp_fn, tensor_inputs,
+                            [(tuple(t.shape), t.dtype) for t in out_list],
+                            single)
+            for i, t in enumerate(out_list):
+                t.stop_gradient = False
+                t._grad_node = node
+                t._out_index = i
+        return outs
+
+
+def set_grad_enabled(mode):
+    import paddle_tpu
+    return paddle_tpu.set_grad_enabled(mode)
+
+
+def is_grad_enabled():
+    return _state.grad_enabled()
+
+
+# functional autodiff (reference: python/paddle/incubate/autograd/)
+def vjp(func, xs, v=None):
+    import jax
+    from ..jit.functional import wrap_pure
+    pure, unravel = wrap_pure(func)
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    out, vjp_fn = jax.vjp(pure, *[x._data for x in xs_list])
+    if v is None:
+        import jax.numpy as jnp
+        v = jnp.ones_like(out)
+    else:
+        v = v._data if isinstance(v, Tensor) else v
+    grads = vjp_fn(v)
+    return Tensor(out), [Tensor(g) for g in grads]
+
+
+def jvp(func, xs, v=None):
+    import jax
+    from ..jit.functional import wrap_pure
+    pure, _ = wrap_pure(func)
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    prim = [x._data for x in xs_list]
+    if v is None:
+        import jax.numpy as jnp
+        tang = [jnp.ones_like(p) for p in prim]
+    else:
+        v_list = v if isinstance(v, (list, tuple)) else [v]
+        tang = [t._data for t in v_list]
+    out, jv = jax.jvp(pure, tuple(prim), tuple(tang))
+    return Tensor(out), Tensor(jv)
+
+
+def jacobian(func, xs, create_graph=False):
+    import jax
+    from ..jit.functional import wrap_pure
+    pure, _ = wrap_pure(func)
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    jac = jax.jacrev(pure, argnums=tuple(range(len(xs_list))))(
+        *[x._data for x in xs_list])
+    if len(xs_list) == 1:
+        return Tensor(jac[0] if isinstance(jac, tuple) else jac)
+    return [Tensor(j) for j in jac]
+
+
+def hessian(func, xs, create_graph=False):
+    import jax
+    from ..jit.functional import wrap_pure
+    pure, _ = wrap_pure(func)
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    hess = jax.hessian(pure, argnums=tuple(range(len(xs_list))))(
+        *[x._data for x in xs_list])
+    if len(xs_list) == 1:
+        h = hess[0][0] if isinstance(hess, tuple) else hess
+        return Tensor(h)
+    return hess

@@ -1,0 +1,4 @@
+from . import dtype, state  # noqa: F401
+from .tensor import Tensor, Parameter  # noqa: F401
+from .autograd import run_backward  # noqa: F401
+from .dispatch import apply_op, defop  # noqa: F401

@@ -1,0 +1,212 @@
+"""Tape-based autograd engine.
+
+Reference capability: the eager autograd engine (reference:
+paddle/fluid/eager/backward.cc:104 `RunBackward`, grad_node_info.h:182
+`GradNodeBase`).  TPU-native realization: each differentiable op call records a
+`GradNode` holding the VJP closure produced by `jax.vjp` — JAX computes the
+forward *and* linearizes in one pass, so residuals live in the closure exactly
+like the reference's `TensorWrapper` saved tensors.  `run_backward` is a
+reverse-topological traversal with cotangent accumulation, mirroring the
+reference's ready-queue traversal.
+
+The same engine works under tracing: inside `paddle_tpu.jit.to_static` all
+arrays are JAX tracers, so `loss.backward()` composes into the single XLA
+program being traced.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class GradNode:
+    """One autograd graph node = one recorded op."""
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "single_output",
+                 "pure", "__weakref__")
+
+    def __init__(self, name, vjp_fn, inputs, out_avals, single_output,
+                 pure=None):
+        self.name = name
+        self.vjp_fn = vjp_fn          # cotangents -> per-tensor-input cotangents
+        self.inputs = inputs          # tuple[Tensor] aligned with vjp_fn result
+        self.out_avals = out_avals    # [(shape, dtype), ...]
+        self.single_output = single_output
+        self.pure = pure              # primal fn, kept for create_graph replay
+
+    def __repr__(self):
+        return f"<GradNode {self.name}>"
+
+
+def _is_float0(g):
+    return g is None or getattr(g, "dtype", None) == jax.dtypes.float0
+
+
+def _topo_order(roots):
+    """Post-order DFS over grad nodes (iterative; graphs can be deep)."""
+    order, visited = [], set()
+    for root in roots:
+        if root is None or id(root) in visited:
+            continue
+        stack = [(root, False)]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                order.append(node)
+                continue
+            if id(node) in visited:
+                continue
+            visited.add(id(node))
+            stack.append((node, True))
+            for t in node.inputs:
+                n = t._grad_node
+                if n is not None and id(n) not in visited and not t.stop_gradient:
+                    stack.append((n, False))
+    order.reverse()  # consumers before producers
+    return order
+
+
+def _symbolic_vjp(node, cots):
+    """Compute input cotangents as recorded tape ops (differentiable)."""
+    from .tensor import Tensor
+    from .dispatch import apply_op
+    n_out = len(cots)
+    single = node.single_output
+    cot_tensors = tuple(c if isinstance(c, Tensor) else Tensor(c)
+                        for c in cots)
+
+    def grad_fn(*all_args):
+        cs = all_args[:n_out]
+        prims = all_args[n_out:]
+        _, vjp = jax.vjp(node.pure, *prims)
+        out = vjp(cs[0] if single else tuple(cs))
+        return tuple(out)
+
+    res = apply_op(node.name + "_grad", grad_fn,
+                   cot_tensors + tuple(node.inputs))
+    if not isinstance(res, tuple):
+        res = (res,)
+    return res
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 create_graph=False, inputs: Optional[Sequence] = None,
+                 allow_unused=False):
+    """Reverse-mode traversal.
+
+    With ``inputs=None`` accumulates into leaf ``.grad`` (reference
+    `RunBackward`); with ``inputs`` given, returns their gradients without
+    touching ``.grad`` (reference `GeneralGrad` / paddle.grad).
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    tensors = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    grad_tensors = [g._data if isinstance(g, Tensor) else g for g in grad_tensors]
+
+    # cotangent store: (id(node), out_idx) -> array ; leaves: id(tensor) -> array
+    node_cots = {}
+    leaf_grads = {}
+    id_to_node = {}
+
+    def _add_cot(tensor, g):
+        if tensor.stop_gradient or _is_float0(g):
+            return
+        for hook in tensor._hooks:
+            out = hook(Tensor(g) if not isinstance(g, Tensor) else g)
+            if out is not None:
+                g = out._data if isinstance(out, Tensor) else out
+        node = tensor._grad_node
+        if node is not None:
+            key = (id(node), tensor._out_index)
+            id_to_node[id(node)] = node
+            prev = node_cots.get(key)
+            node_cots[key] = g if prev is None else prev + g
+        else:
+            prev = leaf_grads.get(id(tensor))
+            leaf_grads[id(tensor)] = g if prev is None else prev + g
+
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g = jnp.ones(t._data.shape, t._data.dtype)
+        _add_cot(t, g)
+
+    roots = [t._grad_node for t in tensors if t._grad_node is not None
+             and not t.stop_gradient]
+    order = _topo_order(roots)
+
+    for node in order:
+        cots = []
+        any_live = False
+        for i, (shape, dtype) in enumerate(node.out_avals):
+            g = node_cots.pop((id(node), i), None)
+            if g is None:
+                g = jnp.zeros(shape, dtype)
+            else:
+                any_live = True
+            cots.append(g)
+        if not any_live:
+            continue
+        if create_graph and node.pure is not None:
+            # Higher-order mode: re-derive the VJP as a *recorded op* over
+            # (cotangents, primal inputs) so the gradient computation itself
+            # is differentiable (reference: GeneralGrad create_graph,
+            # paddle/fluid/eager/backward.cc:102).
+            in_grads = _symbolic_vjp(node, cots)
+        else:
+            seed = cots[0] if node.single_output else tuple(cots)
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    f"Trying to backward through {node.name} a second time "
+                    "(use retain_graph=True)")
+            in_grads = node.vjp_fn(seed)
+        for t, g in zip(node.inputs, in_grads):
+            _add_cot(t, g)
+        if not retain_graph and not create_graph:
+            node.vjp_fn = None  # free residuals eagerly
+
+    if inputs is not None:
+        results = []
+        for t in inputs:
+            g = leaf_grads.get(id(t))
+            if g is None and t._grad_node is not None:
+                # non-leaf input: its cotangent was folded into its node slot
+                g = node_cots.get((id(t._grad_node), t._out_index))
+            if g is None and not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have "
+                    "been used in the graph (allow_unused=False)")
+            if g is None:
+                results.append(None)
+            elif isinstance(g, Tensor):
+                results.append(g)
+            else:
+                results.append(Tensor(g, stop_gradient=not create_graph))
+        return results
+
+    # accumulate into leaf .grad
+    seen = set()
+    stack = list(tensors)
+    while stack:
+        t = stack.pop()
+        if id(t) in seen:
+            continue
+        seen.add(id(t))
+        g = leaf_grads.pop(id(t), None)
+        if g is not None:
+            g_t = g if isinstance(g, Tensor) else Tensor(g)
+            if t.grad is None:
+                t.grad = g_t
+            else:
+                t.grad = t.grad + g_t if isinstance(g, Tensor) else \
+                    Tensor(t.grad._data + g)
+        if t._grad_node is not None:
+            stack.extend(t._grad_node.inputs)
+    return None

@@ -1,0 +1,119 @@
+"""Op dispatch: the single funnel every framework op goes through.
+
+Reference capability: the generated `*_ad_func` eager forwards (reference:
+paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:243) — AMP
+auto-cast hook, grad-requirement check, grad-node construction, kernel call.
+TPU-native realization: the "kernel" is a pure JAX function; when gradients are
+required we run it through `jax.vjp`, which computes the forward and returns
+the VJP closure in one pass (forward cost identical, residuals saved by JAX —
+the analogue of the reference's TensorWrapper saved tensors).
+"""
+from __future__ import annotations
+
+import jax
+
+from . import state as _state
+from .tensor import Tensor
+from .autograd import GradNode
+
+
+def _amp_cast(name, arrays):
+    """bf16 autocast hook (reference: eager_amp_auto_cast.h insertion point)."""
+    from ..amp.amp_lists import WHITE_LIST, BLACK_LIST
+    st = _state.STATE
+    if st.amp_level not in ("O1", "O2"):
+        return arrays
+    white = (name in WHITE_LIST or name in st.amp_custom_white_list)
+    black = (name in BLACK_LIST or name in st.amp_custom_black_list)
+    if st.amp_level == "O2":
+        # O2: everything except the black list runs in amp dtype
+        white = not black
+    if white and not black:
+        target = st.amp_dtype
+    elif black:
+        target = jax.numpy.float32
+    else:
+        return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and a.dtype in (jax.numpy.float32,
+                                               jax.numpy.float16,
+                                               jax.numpy.bfloat16):
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+def apply_op(name, fn, args, static=None, nondiff=False):
+    """Execute op `fn` over `args` (mix of Tensors and python values).
+
+    fn receives raw arrays in place of Tensors, followed by **static kwargs.
+    Returns Tensor or tuple of Tensors; records a GradNode when needed.
+    """
+    static = static or {}
+    if static and any(isinstance(v, Tensor) for v in static.values()):
+        # Tensors passed by keyword must flow through the vjp path, not be
+        # silently captured as constants — rebind them positionally.
+        import inspect
+        sig = inspect.signature(fn)
+        bound = sig.bind(*args, **static)
+        bound.apply_defaults()
+        args = tuple(bound.arguments.values())
+        static = {}
+    tensor_idx = tuple(i for i, a in enumerate(args) if isinstance(a, Tensor))
+    tensors = tuple(args[i] for i in tensor_idx)
+    arrays = [t._data for t in tensors]
+
+    if _state.STATE.amp_level in ("O1", "O2"):
+        arrays = _amp_cast(name, arrays)
+
+    def pure(*xs):
+        full = list(args)
+        for i, x in zip(tensor_idx, xs):
+            full[i] = x
+        return fn(*full, **static)
+
+    need_grad = (_state.STATE.grad_enabled and not nondiff
+                 and any(not t.stop_gradient for t in tensors))
+
+    if need_grad:
+        out, vjp_fn = jax.vjp(pure, *arrays)
+    else:
+        out = pure(*arrays)
+
+    single = not isinstance(out, (tuple, list))
+    outs = (out,) if single else tuple(out)
+    out_tensors = []
+    node = None
+    if need_grad:
+        out_avals = [(o.shape, o.dtype) for o in outs]
+        node = GradNode(name, vjp_fn, tensors, out_avals, single, pure=pure)
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=not need_grad)
+        if node is not None:
+            t._grad_node = node
+            t._out_index = i
+        out_tensors.append(t)
+    return out_tensors[0] if single else tuple(out_tensors)
+
+
+def defop(name, nondiff=False):
+    """Decorator registering a pure-JAX implementation as a framework op.
+
+    The wrapped function's public signature takes Tensors; internally it is
+    called with raw arrays.  Also records the op in the registry (the
+    reference's ops.yaml analogue) for introspection/SPMD-rule attachment.
+    """
+    from ..ops.registry import register_op
+
+    def deco(fn):
+        register_op(name, fn, nondiff=nondiff)
+
+        def wrapper(*args, **kwargs):
+            return apply_op(name, fn, args, static=kwargs, nondiff=nondiff)
+        wrapper.__name__ = name
+        wrapper.__doc__ = fn.__doc__
+        wrapper.__wrapped__ = fn
+        return wrapper
+    return deco

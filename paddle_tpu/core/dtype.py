@@ -1,0 +1,85 @@
+"""Dtype system.
+
+TPU-native dtype surface mirroring the reference's set (reference:
+paddle/phi/common/data_type.h) but mapped directly onto JAX/XLA dtypes —
+bfloat16 is first-class since it is the MXU-native compute type.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype aliases. We use numpy dtype objects (jnp dtypes are numpy
+# dtypes, including ml_dtypes extensions such as bfloat16).
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float64)
+float16 = jnp.dtype(jnp.float16)
+bfloat16 = jnp.dtype(jnp.bfloat16)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int64)
+uint8 = jnp.dtype(jnp.uint8)
+uint16 = jnp.dtype(jnp.uint16)
+uint32 = jnp.dtype(jnp.uint32)
+bool_ = jnp.dtype(jnp.bool_)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex128)
+float8_e4m3fn = jnp.dtype(jnp.float8_e4m3fn)
+float8_e5m2 = jnp.dtype(jnp.float8_e5m2)
+
+_NAME_TO_DTYPE = {
+    "float32": float32, "fp32": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn, "float8_e5m2": float8_e5m2,
+}
+
+FLOATING_DTYPES = (float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2)
+INTEGER_DTYPES = (int8, int16, int32, int64, uint8, uint16, uint32)
+COMPLEX_DTYPES = (complex64, complex128)
+
+
+def convert_dtype(dtype):
+    """Normalize a user-provided dtype (str / np.dtype / jnp type) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return jnp.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.dtype(dtype) in FLOATING_DTYPES
+
+
+def is_integer(dtype) -> bool:
+    return jnp.dtype(dtype) in INTEGER_DTYPES
+
+
+def is_complex(dtype) -> bool:
+    return jnp.dtype(dtype) in COMPLEX_DTYPES
+
+
+def promote_types(a, b):
+    return jnp.promote_types(a, b)
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(convert_dtype(dtype))

@@ -1,0 +1,88 @@
+"""Global framework state: grad mode, default dtype, RNG, trace mode.
+
+The reference keeps equivalent state in C++ singletons (tracer state in
+paddle/fluid/imperative/tracer.h, AMP state in eager_amp_auto_cast.h).  Here it
+is a small thread-local Python object; the performance path does not consult it
+per-op inside compiled programs.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+from . import dtype as _dtype
+
+
+class _FrameworkState(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.default_dtype = _dtype.float32
+        # amp: None | ("O1"|"O2", compute_dtype)
+        self.amp_level = "O0"
+        self.amp_dtype = _dtype.bfloat16
+        self.amp_custom_white_list = set()
+        self.amp_custom_black_list = set()
+        # RNG: a JAX PRNG key + a split counter. Under trace (to_static), the
+        # tracer installs a symbolic base key so dropout masks differ per step.
+        self.rng_key = jax.random.PRNGKey(0)
+        self.rng_counter = 0
+        # trace mode (set by paddle_tpu.jit tracer while tracing)
+        self.tracer = None
+
+
+STATE = _FrameworkState()
+
+
+def seed(s: int):
+    """Set the global random seed (reference: paddle.seed)."""
+    STATE.rng_key = jax.random.PRNGKey(s)
+    STATE.rng_counter = 0
+    return s
+
+
+def next_rng_key():
+    """Return a fresh PRNG key. Cheap fold_in instead of split-chain so the
+    traced form is a pure function of (base_key, python counter)."""
+    tr = STATE.tracer
+    if tr is not None:
+        base = tr.rng_base()
+        key = jax.random.fold_in(base, tr.rng_counter)
+        tr.rng_counter += 1
+        return key
+    key = jax.random.fold_in(STATE.rng_key, STATE.rng_counter)
+    STATE.rng_counter += 1
+    return key
+
+
+def grad_enabled() -> bool:
+    return STATE.grad_enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = STATE.grad_enabled
+    STATE.grad_enabled = False
+    try:
+        yield
+    finally:
+        STATE.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = STATE.grad_enabled
+    STATE.grad_enabled = True
+    try:
+        yield
+    finally:
+        STATE.grad_enabled = prev
+
+
+def set_default_dtype(d):
+    STATE.default_dtype = _dtype.convert_dtype(d)
+
+
+def get_default_dtype():
+    return STATE.default_dtype

@@ -1,0 +1,317 @@
+"""Eager Tensor: a JAX array + autograd metadata.
+
+Reference capability: the eager Tensor (reference: paddle/phi/core/dense_tensor.h,
+python Tensor methods in paddle/fluid/pybind/eager_method.cc).  TPU-native
+realization: `_data` is a `jax.Array` (device-resident, async dispatch — the
+same "python returns immediately" contract the reference gets from CUDA
+streams).  Under `paddle_tpu.jit` tracing, `_data` is a JAX tracer and every
+method composes into the XLA program.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as _dtype
+from . import state as _state
+from .autograd import run_backward
+
+
+class Tensor:
+    __slots__ = ("_data_", "stop_gradient", "grad", "_grad_node", "_out_index",
+                 "name", "persistable", "_hooks", "trainable", "__weakref__",
+                 "optimize_attr", "regularizer", "is_dist_param", "placements",
+                 "process_mesh")
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            data = jnp.asarray(data, dtype=_dtype.convert_dtype(dtype))
+        elif dtype is not None and data.dtype != _dtype.convert_dtype(dtype):
+            data = data.astype(_dtype.convert_dtype(dtype))
+        self._data_ = data
+        tr = _state.STATE.tracer
+        if tr is not None:
+            tr.on_create(self)
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._hooks = []
+        self.optimize_attr = {}
+        self.regularizer = None
+        self.is_dist_param = False
+        self.placements = None
+        self.process_mesh = None
+
+    # `_data` is a property so the jit tracer can observe reads/writes of
+    # pre-existing tensors (parameter capture + mutation tracking) — the
+    # TPU-native analogue of the reference's RunProgramAPI input/output
+    # binding (paddle/fluid/eager/to_static/run_program_op_func.h:159).
+    @property
+    def _data(self):
+        tr = _state.STATE.tracer
+        if tr is not None:
+            tr.on_read(self)
+        return self._data_
+
+    @_data.setter
+    def _data(self, value):
+        tr = _state.STATE.tracer
+        if tr is not None:
+            tr.on_write(self)
+        self._data_ = value
+
+    # ---------------- basic properties ----------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    def numel(self):
+        return self.size
+
+    @property
+    def T(self):
+        from ..tensor_ops import linalg
+        return linalg.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def place(self):
+        try:
+            dev = list(self._data.devices())[0]
+            return str(dev)
+        except Exception:
+            return "traced"
+
+    # ---------------- host interop ----------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # ---------------- autograd ----------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from .dispatch import apply_op
+        return apply_op("clone", lambda x: x * 1, (self,))
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Remover:
+            def __init__(s, owner, h):
+                s.owner, s.h = owner, h
+
+            def remove(s):
+                if s.h in s.owner._hooks:
+                    s.owner._hooks.remove(s.h)
+        return _Remover(self, hook)
+
+    # in-place value replacement (used by optimizers / set_value)
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        value = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._data.shape}")
+        self._data = value
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    # ---------------- device / dtype movement ----------------
+    def astype(self, dtype):
+        from ..tensor_ops import manipulation
+        return manipulation.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu") or ":" in str(a):
+                continue
+            dtype = a
+        return self.astype(dtype) if dtype is not None else self
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+
+    def cuda(self, *a, **k):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # ---------------- repr ----------------
+    def __repr__(self):
+        grad_s = f", stop_gradient={self.stop_gradient}"
+        if isinstance(self._data, jax.core.Tracer):
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                    f"{grad_s}, traced)")
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_s},\n"
+                f"       {np.array2string(self.numpy(), prefix='       ')})")
+
+    __str__ = __repr__
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: python/paddle/base/framework.py Parameter)."""
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _install_methods():
+    """Attach functional-API methods onto Tensor (reference pattern:
+    monkey_patch_tensor in python/paddle/base/dygraph/math_op_patch.py)."""
+    from ..tensor_ops import math as M, manipulation as MA, linalg as L
+    from ..tensor_ops import reduction as R, logic as LG, search as S
+    from ..tensor_ops import creation as C
+
+    binop = lambda f: lambda self, other: f(self, other)
+    rbinop = lambda f: lambda self, other: f(other, self)
+
+    Tensor.__add__ = binop(M.add)
+    Tensor.__radd__ = rbinop(M.add)
+    Tensor.__sub__ = binop(M.subtract)
+    Tensor.__rsub__ = rbinop(M.subtract)
+    Tensor.__mul__ = binop(M.multiply)
+    Tensor.__rmul__ = rbinop(M.multiply)
+    Tensor.__truediv__ = binop(M.divide)
+    Tensor.__rtruediv__ = rbinop(M.divide)
+    Tensor.__floordiv__ = binop(M.floor_divide)
+    Tensor.__mod__ = binop(M.remainder)
+    Tensor.__pow__ = binop(M.pow)
+    Tensor.__rpow__ = rbinop(M.pow)
+    Tensor.__neg__ = lambda self: M.scale(self, -1.0)
+    Tensor.__abs__ = lambda self: M.abs(self)
+    Tensor.__matmul__ = binop(L.matmul)
+    Tensor.__eq__ = binop(LG.equal)
+    Tensor.__ne__ = binop(LG.not_equal)
+    Tensor.__lt__ = binop(LG.less_than)
+    Tensor.__le__ = binop(LG.less_equal)
+    Tensor.__gt__ = binop(LG.greater_than)
+    Tensor.__ge__ = binop(LG.greater_equal)
+    Tensor.__invert__ = lambda self: LG.logical_not(self)
+    Tensor.__and__ = binop(LG.logical_and)
+    Tensor.__or__ = binop(LG.logical_or)
+    Tensor.__getitem__ = MA._getitem
+    Tensor.__setitem__ = MA._setitem
+
+    _method_sources = [M, MA, L, R, LG, S]
+    _method_names = [
+        # math
+        "add", "subtract", "multiply", "divide", "pow", "scale", "abs", "exp",
+        "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square", "sin",
+        "cos", "tan", "sinh", "cosh", "tanh", "asin", "acos", "atan", "erf",
+        "sigmoid", "floor", "ceil", "round", "sign", "reciprocal", "clip",
+        "maximum", "minimum", "remainder", "floor_divide", "neg", "lerp",
+        "expm1", "trunc", "isnan", "isinf", "isfinite", "nan_to_num",
+        # reduction
+        "sum", "mean", "max", "min", "prod", "all", "any", "logsumexp",
+        "cumsum", "cumprod", "std", "var", "amax", "amin", "median",
+        # linalg
+        "matmul", "transpose", "t", "dot", "norm", "dist",
+        # manipulation
+        "reshape", "flatten", "squeeze", "unsqueeze", "cast", "split",
+        "chunk", "tile", "expand", "expand_as", "gather", "gather_nd",
+        "scatter", "index_select", "masked_select", "roll", "flip",
+        "broadcast_to", "unbind", "repeat_interleave", "take_along_axis",
+        "put_along_axis", "slice", "strided_slice", "view", "view_as",
+        "reshape_", "diagonal", "unfold", "as_strided",
+        # search / logic
+        "argmax", "argmin", "argsort", "sort", "topk", "nonzero",
+        "index_sample", "where", "equal", "not_equal", "less_than",
+        "less_equal", "greater_than", "greater_equal", "equal_all",
+        "allclose", "isclose", "logical_and", "logical_or", "logical_not",
+        "logical_xor", "bitwise_and", "bitwise_or", "bitwise_not",
+        "unique", "kthvalue", "mode",
+    ]
+    for name in _method_names:
+        for src in _method_sources:
+            fn = getattr(src, name, None)
+            if fn is not None:
+                if not hasattr(Tensor, name):
+                    setattr(Tensor, name, fn)
+                break
+    # a few with different self-binding
+    Tensor.mm = L.matmul
+    Tensor.add_n = staticmethod(M.add_n)
+    Tensor.item_ = Tensor.item

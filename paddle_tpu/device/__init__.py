@@ -1,0 +1,95 @@
+"""Device management (reference: python/paddle/device/).
+
+TPU-native: devices are JAX devices; `set_device` selects the default
+placement.  There is no per-op stream management — XLA owns scheduling.
+"""
+from __future__ import annotations
+
+import jax
+
+
+_current = None
+
+
+def set_device(device: str):
+    """Accepts 'tpu', 'cpu', 'tpu:0' etc."""
+    global _current
+    _current = device
+    return device
+
+
+def get_device() -> str:
+    if _current is not None:
+        return _current
+    d = jax.devices()[0]
+    return f"{d.platform}:{getattr(d, 'id', 0)}"
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+
+def synchronize(device=None):
+    """Block until all queued device work is done (reference:
+    paddle.device.synchronize / cudaDeviceSynchronize).  JAX arrays are
+    async; effectively a fence via block_until_ready on a trivial op."""
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+class Stream:
+    """API-parity stub: XLA manages streams internally on TPU."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_stream(self, other):
+        pass
+
+
+class Event:
+    def __init__(self, enable_timing=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time
+        synchronize()
+        self._t = time.perf_counter()
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end):
+        return (end._t - self._t) * 1000.0
+
+
+def cuda_stream_guard(*a, **k):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _g():
+        yield
+    return _g()

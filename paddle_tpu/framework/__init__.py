@@ -1,0 +1,2 @@
+from .io import save, load  # noqa: F401
+from ..core.state import seed, get_default_dtype, set_default_dtype  # noqa: F401

@@ -1,0 +1,57 @@
+"""Checkpoint save/load (reference: python/paddle/framework/io.py:646,885 —
+pickle-based nested state dicts).  TPU-native: numpy-materialised nested
+dicts via pickle for parity, plus orbax-backed sharded checkpointing in
+paddle_tpu.distributed.checkpoint for the multi-host path."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_host(obj):
+    if isinstance(obj, Tensor):
+        return _TensorState(np.asarray(obj._data), obj.name,
+                            not obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_host(v) for v in obj)
+    return obj
+
+
+class _TensorState:
+    __slots__ = ("array", "name", "trainable")
+
+    def __init__(self, array, name, trainable):
+        self.array = array
+        self.name = name
+        self.trainable = trainable
+
+
+def _from_host(obj):
+    if isinstance(obj, _TensorState):
+        t = Tensor(obj.array, stop_gradient=not obj.trainable)
+        t.name = obj.name
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_host(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _from_host(pickle.load(f))

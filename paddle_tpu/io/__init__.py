@@ -1,0 +1,3 @@
+from .dataset import Dataset, IterableDataset, TensorDataset, Subset, ConcatDataset, random_split  # noqa: F401
+from .sampler import Sampler, SequenceSampler, RandomSampler, BatchSampler, DistributedBatchSampler, WeightedRandomSampler  # noqa: F401
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,130 @@
+"""DataLoader (reference: python/paddle/io/dataloader/dataloader_iter.py:150,358
+— multiprocess workers + shared-memory queues + C++ blocking queue).
+
+TPU-native realization: a thread-pool prefetch pipeline feeding device
+transfers asynchronously (jax device_put is async).  Multiprocess workers via
+`num_workers` use a thread pool here — on TPU the input pipeline is host-CPU
+bound but GIL-released inside numpy/jax, so threads provide the overlap the
+reference gets from worker processes, without shared-memory plumbing.  A C++
+ring-buffer feeder (csrc/) can be slotted under this when IO becomes the
+bottleneck.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched Tensors (reference: collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([s._data for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(group))
+                            for group in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def _fetch(self, indices):
+        samples = [self.dataset[i] for i in indices]
+        return self.collate_fn(samples)
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            yield from self._iter_iterable()
+            return
+        if self.num_workers == 0:
+            for indices in self.batch_sampler:
+                yield self._fetch(indices)
+            return
+        yield from self._iter_threaded()
+
+    def _iter_threaded(self):
+        """Prefetch with a worker thread pool + bounded queue (the
+        reference's _DataLoaderIterMultiProcess shape, reference:
+        dataloader_iter.py:358)."""
+        index_queue = queue.Queue()
+        out_queues = {}
+        n_batches = 0
+        for i, indices in enumerate(self.batch_sampler):
+            index_queue.put((i, indices))
+            out_queues[i] = queue.Queue(maxsize=1)
+            n_batches += 1
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                try:
+                    i, indices = index_queue.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    out_queues[i].put(self._fetch(indices))
+                except Exception as e:  # propagate to consumer
+                    out_queues[i].put(e)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(n_batches):
+                item = out_queues[i].get()
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()

@@ -1,0 +1,45 @@
+"""paddle_tpu.jit — trace-to-XLA compilation (reference: python/paddle/jit/)."""
+from __future__ import annotations
+
+from .tracer import to_static, StaticFunction, host_scalar  # noqa: F401
+from .functional import wrap_pure  # noqa: F401
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export a layer's params (reference: paddle.jit.save exports
+    program+params; here params + config, reloadable via jit.load)."""
+    import pickle
+    import numpy as np
+    import os
+    from ..core.tensor import Tensor
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    state = {k: np.asarray(v._data_) for k, v in layer.state_dict().items()}
+    with open(path + ".pdparams", "wb") as f:
+        pickle.dump(state, f)
+
+
+def load(path, **configs):
+    import pickle
+    with open(path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+class InputSpec:
+    """reference: paddle.static.InputSpec — shape/dtype declaration."""
+
+    def __init__(self, shape=None, dtype="float32", name=None,
+                 stop_gradient=True):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"

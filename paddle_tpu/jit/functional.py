@@ -1,0 +1,28 @@
+"""Functional bridge: run a Tensor-level callable as a pure array function.
+
+Used by functional autodiff (vjp/jvp/jacobian) and anywhere raw JAX
+transformations need to see through the Tensor wrapper.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+from ..core import state as _state
+
+
+def wrap_pure(fn):
+    """Return (pure_fn, None) where pure_fn maps arrays -> arrays by calling
+    `fn` with Tensor wrappers under no-tape mode."""
+
+    def pure(*arrays):
+        args = [Tensor(a) for a in arrays]
+        with _state.no_grad():
+            out = fn(*args)
+        if isinstance(out, Tensor):
+            return out._data_
+        if isinstance(out, (tuple, list)):
+            return type(out)(o._data_ if isinstance(o, Tensor) else o
+                             for o in out)
+        return out
+    return pure, None

@@ -1,0 +1,318 @@
+"""Trace-to-XLA compiler for dygraph code (`to_static` analogue).
+
+Reference capability: paddle.jit.to_static (reference: python/paddle/jit/api.py:234
+— AST transform / SOT bytecode capture into a static program executed by
+run_program + InterpreterCore).  TPU-native realization: a two-phase
+lazy-tensor capture —
+
+1. **Discovery call** (first call per input signature): the function runs
+   eagerly while a tracer records (a) every pre-existing Tensor whose data is
+   read (parameter/buffer capture → becomes a compiled-program input) and
+   (b) host-scalar providers (learning rate, RNG key) that must be re-fed
+   each step.  The caller gets real results — the first call IS a real step.
+
+2. **Bind trace**: `jax.jit` traces a pure wrapper that installs JAX tracers
+   into the captured tensors' data slots, re-runs the python function (tape
+   autograd, optimizer update and all — everything composes because every op
+   bottoms out in jnp), then collects returned tensors + every mutated
+   tensor's final value as program outputs.  Subsequent calls execute one
+   fused XLA program — the analogue of the reference's whole-program
+   InterpreterCore run, but compiled.
+
+No graph breaks: host reads of traced values raise (like JAX), which is the
+portable subset the reference's SOT falls back from.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import state as _state
+from ..core.tensor import Tensor
+
+
+class _DiscoveryTracer:
+    """Records captures + host providers during the eager first call."""
+
+    def __init__(self):
+        self.created = set()          # id(Tensor) made during trace
+        self.captured = {}            # id(Tensor) -> Tensor (ordered via list)
+        self.capture_list = []
+        self.providers = []           # host-value providers, call order
+        self.rng_counter = 0
+        self._rng_provider_registered = False
+        self._rng_base_val = None
+
+    def on_create(self, t):
+        self.created.add(id(t))
+
+    def on_read(self, t):
+        i = id(t)
+        if i not in self.created and i not in self.captured:
+            self.captured[i] = t
+            self.capture_list.append(t)
+
+    def on_write(self, t):
+        # writes don't need recording at discovery; mutation targets are
+        # collected during the bind trace
+        pass
+
+    def host_input(self, provider):
+        self.providers.append(provider)
+        return provider()
+
+    def rng_base(self):
+        if not self._rng_provider_registered:
+            self._rng_provider_registered = True
+
+            def provider():
+                k = jax.random.fold_in(_state.STATE.rng_key,
+                                       _state.STATE.rng_counter)
+                _state.STATE.rng_counter += 1
+                return k
+            self._rng_base_val = self.host_input(provider)
+        return self._rng_base_val
+
+
+class _BindTracer:
+    """Active while jax.jit traces the pure wrapper."""
+
+    def __init__(self, host_tracers):
+        self.created = set()
+        self.mutated = {}             # id(Tensor) -> pre-write concrete data
+        self.mutated_list = []
+        self.host_tracers = host_tracers
+        self.host_idx = 0
+        self.rng_counter = 0
+        self._rng_base_val = None
+
+    def on_create(self, t):
+        self.created.add(id(t))
+
+    def on_read(self, t):
+        pass
+
+    def on_write(self, t):
+        i = id(t)
+        if i not in self.created and i not in self.mutated:
+            self.mutated[i] = t._data_  # original value, pre-write
+            self.mutated_list.append(t)
+
+    def host_input(self, provider):
+        v = self.host_tracers[self.host_idx]
+        self.host_idx += 1
+        return v
+
+    def rng_base(self):
+        if self._rng_base_val is None:
+            self._rng_base_val = self.host_input(None)
+        return self._rng_base_val
+
+
+def host_scalar(provider):
+    """Fetch a host-computed value as a traced input under tracing, or the
+    plain value eagerly.  Used for learning rates / step counters that change
+    between compiled calls."""
+    tr = _state.STATE.tracer
+    if tr is not None:
+        return tr.host_input(provider)
+    return provider()
+
+
+def _flatten_args(args, kwargs):
+    leaves, treedef = jax.tree.flatten((args, kwargs),
+                                       is_leaf=lambda x: isinstance(x, Tensor))
+    arrays, spec = [], []
+    for leaf in leaves:
+        if isinstance(leaf, Tensor):
+            arrays.append(leaf._data_)
+            spec.append(None)
+        else:
+            spec.append(leaf)
+    return arrays, (treedef, tuple(spec))
+
+
+def _unflatten_args(arrays, struct):
+    treedef, spec = struct
+    arrays = iter(arrays)
+    leaves = [Tensor(next(arrays)) if s is None else s for s in spec]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def _signature(args, kwargs):
+    leaves, treedef = jax.tree.flatten((args, kwargs),
+                                       is_leaf=lambda x: isinstance(x, Tensor))
+    sig = []
+    for leaf in leaves:
+        if isinstance(leaf, Tensor):
+            sig.append(("T", tuple(leaf._data_.shape), str(leaf._data_.dtype)))
+        else:
+            try:
+                hash(leaf)
+                sig.append(leaf)
+            except TypeError:
+                sig.append(repr(leaf))
+    return treedef, tuple(sig)
+
+
+class _CompiledEntry:
+    __slots__ = ("captures", "providers", "jitted", "mut_targets",
+                 "grad_targets", "out_struct")
+
+    def __init__(self):
+        self.captures = []
+        self.providers = []
+        self.jitted = None
+        self.mut_targets = []     # Tensors whose data is replaced after call
+        self.grad_targets = []    # Tensors whose .grad is materialized
+        self.out_struct = None
+
+
+class StaticFunction:
+    """Callable wrapper produced by @to_static."""
+
+    def __init__(self, fn, input_spec=None, build_strategy=None,
+                 full_graph=True):
+        self._fn = fn
+        self._cache = {}
+        for attr in ("__name__", "__qualname__", "__doc__"):
+            try:
+                setattr(self, attr, getattr(fn, attr))
+            except AttributeError:
+                pass
+
+    @property
+    def __wrapped__(self):
+        return self._fn
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return functools.partial(self.__call__, instance)
+
+    def concrete_cache_size(self):
+        return len(self._cache)
+
+    def __call__(self, *args, **kwargs):
+        if _state.STATE.tracer is not None:
+            # nested to_static: inline into the enclosing trace
+            return self._fn(*args, **kwargs)
+        key = _signature(args, kwargs)
+        entry = self._cache.get(key)
+        if entry is None:
+            return self._discover(key, args, kwargs)
+        return self._run_compiled(entry, args, kwargs)
+
+    # ---------------- phase 1: discovery (eager) ----------------
+    def _discover(self, key, args, kwargs):
+        entry = _CompiledEntry()
+        tracer = _DiscoveryTracer()
+        _state.STATE.tracer = tracer
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            _state.STATE.tracer = None
+        entry.captures = tracer.capture_list
+        entry.providers = tracer.providers
+        self._build(entry, args, kwargs)
+        self._cache[key] = entry
+        return out
+
+    # ---------------- phase 2: bind + compile ----------------
+    def _build(self, entry, args, kwargs):
+        fn = self._fn
+
+        def pure(arg_arrays, cap_arrays, host_vals, arg_struct):
+            tracer = _BindTracer(host_vals)
+            saved = [(t, t._data_) for t in entry.captures]
+            bound_args, bound_kwargs = _unflatten_args(arg_arrays, arg_struct)
+            for t, arr in zip(entry.captures, cap_arrays):
+                t._data_ = arr
+            _state.STATE.tracer = tracer
+            try:
+                out = fn(*bound_args, **bound_kwargs)
+            finally:
+                _state.STATE.tracer = None
+            # collect outputs
+            out_leaves, out_tree = jax.tree.flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            out_arrays, out_spec = [], []
+            for leaf in out_leaves:
+                if isinstance(leaf, Tensor):
+                    out_arrays.append(leaf._data_)
+                    out_spec.append(None)
+                else:
+                    out_spec.append(leaf)
+            entry.out_struct = (out_tree, tuple(out_spec))
+            # mutated tensors -> outputs
+            entry.mut_targets = list(tracer.mutated_list)
+            mut_arrays = [t._data_ for t in entry.mut_targets]
+            # escaped gradients on captured tensors -> outputs
+            entry.grad_targets = []
+            grad_arrays = []
+            for t in entry.captures:
+                g = t.grad
+                if g is not None and isinstance(g._data_, jax.core.Tracer):
+                    entry.grad_targets.append(t)
+                    grad_arrays.append(g._data_)
+            # restore original concrete data (mutations are applied by the
+            # caller from the returned arrays)
+            captured_ids = {id(t) for t in entry.captures}
+            for t, orig in saved:
+                t._data_ = orig
+            for t in entry.mut_targets:
+                if id(t) not in captured_ids:
+                    # mutated without prior read: restore the pre-write value
+                    # recorded by the tracer so no JAX tracer leaks out
+                    t._data_ = tracer.mutated[id(t)]
+            for t in entry.grad_targets:
+                t.grad = None
+            return tuple(out_arrays), tuple(mut_arrays), tuple(grad_arrays)
+
+        entry.jitted = jax.jit(pure, static_argnums=(3,))
+
+    def _run_compiled(self, entry, args, kwargs):
+        arg_arrays, arg_struct = _flatten_args(args, kwargs)
+        cap_arrays = [t._data_ for t in entry.captures]
+        host_vals = [p() for p in entry.providers]
+        out_arrays, mut_arrays, grad_arrays = entry.jitted(
+            arg_arrays, cap_arrays, host_vals, arg_struct)
+        # apply mutations
+        for t, arr in zip(entry.mut_targets, mut_arrays):
+            t._data_ = arr
+        for t, arr in zip(entry.grad_targets, grad_arrays):
+            if t.grad is None:
+                t.grad = Tensor(arr)
+            else:
+                t.grad._data_ = arr
+        # rebuild outputs
+        out_tree, out_spec = entry.out_struct
+        arrays = iter(out_arrays)
+        leaves = [Tensor(next(arrays)) if s is None else s for s in out_spec]
+        return jax.tree.unflatten(out_tree, leaves)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Compile a dygraph function/Layer into one XLA program per input
+    signature (reference API: python/paddle/jit/api.py:234)."""
+    from ..nn.layer import Layer
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            static_fwd = StaticFunction(layer.forward.__func__
+                                        if hasattr(layer.forward, "__func__")
+                                        else layer.forward)
+            bound = functools.partial(static_fwd, layer) \
+                if hasattr(layer.forward, "__func__") else static_fwd
+            layer.forward = bound
+            return layer
+        return StaticFunction(fn, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate

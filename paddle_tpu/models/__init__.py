@@ -1,0 +1,5 @@
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, gpt_config,
+    GPT2_124M, GPT2_350M, GPT3_1_3B, GPT3_6_7B, GPT3_13B,
+)
+from .mlp import MNISTMLP  # noqa: F401

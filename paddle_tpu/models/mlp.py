@@ -1,0 +1,22 @@
+"""MNIST MLP — the minimum end-to-end slice model (reference:
+test/book/test_recognize_digits.py mlp network)."""
+from __future__ import annotations
+
+from ..nn import Layer, Linear, ReLU, Sequential
+from ..nn import functional as F
+from ..tensor_ops import manipulation as MA
+
+
+class MNISTMLP(Layer):
+    def __init__(self, hidden=200, num_classes=10):
+        super().__init__()
+        self.net = Sequential(
+            Linear(784, hidden), ReLU(),
+            Linear(hidden, hidden), ReLU(),
+            Linear(hidden, num_classes),
+        )
+
+    def forward(self, x):
+        if x.ndim > 2:
+            x = MA.reshape(x, [x.shape[0], -1])
+        return self.net(x)

@@ -1,0 +1,24 @@
+from .layer import Layer  # noqa: F401
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .initializer import ParamAttr  # noqa: F401
+from .containers import Sequential, LayerList, ParameterList, LayerDict  # noqa: F401
+from .layers_common import (  # noqa: F401
+    Linear, Embedding, Conv1D, Conv2D, Conv2DTranspose, LayerNorm, RMSNorm,
+    BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm, Dropout, Dropout2D,
+    ReLU, ReLU6, GELU, Silu, Sigmoid, LeakyReLU, ELU, SELU, Hardswish,
+    Hardsigmoid, Softplus, Softshrink, Hardshrink, Tanhshrink, Mish,
+    Softsign, Tanh, Softmax, LogSoftmax, PReLU, MaxPool2D, AvgPool2D,
+    AdaptiveAvgPool2D, Flatten, Identity, Upsample, Pad2D,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .losses import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss, BCELoss,
+    BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss,
+)
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm, clip_grad_norm_,
+)

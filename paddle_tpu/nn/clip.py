@@ -1,0 +1,106 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py —
+ClipGradByGlobalNorm used by HybridParallelOptimizer).
+
+In hybrid-parallel runs the global norm must be reduced across model-parallel
+groups; paddle_tpu.distributed.fleet's optimizer wrapper handles that by
+summing per-group partial norms inside the compiled program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            ng = apply_op("clip_by_value",
+                          lambda x: jnp.clip(x, self.min, self.max), (g,))
+            out.append((p, ng))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+
+            def fn(x):
+                n = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+                return (x.astype(jnp.float32) * scale).astype(x.dtype)
+            out.append((p, apply_op("clip_by_norm", fn, (g,))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        grads = [g for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+
+        def global_norm_fn(*gs):
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gs)
+            return jnp.sqrt(sq)
+        gnorm = apply_op("global_norm", global_norm_fn, tuple(grads))
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+
+            def scale_fn(x, n):
+                s = self.clip_norm / jnp.maximum(n, jnp.asarray(self.clip_norm,
+                                                                n.dtype))
+                return (x.astype(jnp.float32) * s).astype(x.dtype)
+            out.append((p, apply_op("global_norm_scale", scale_fn, (g, gnorm))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+
+    def norm_fn(*gs):
+        if norm_type == float("inf"):
+            return jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in gs]))
+        total = sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+                    for g in gs)
+        return total ** (1.0 / norm_type)
+    total_norm = apply_op("grad_total_norm", norm_fn, tuple(grads))
+    clip_coef = max_norm / (float(total_norm.item()) + 1e-6)
+    if clip_coef < 1:
+        for p in parameters:
+            if p.grad is not None:
+                p.grad._data = (p.grad._data * clip_coef).astype(p.grad.dtype)
+    return total_norm

@@ -1,0 +1,908 @@
+"""nn functional ops (reference: python/paddle/nn/functional/).
+
+Convs and matmuls lower to the MXU via lax.conv_general_dilated / dot_general;
+norms and activations fuse into neighbours under jit.  Fused ops the reference
+implements as CUDA kernels (fused rope, rms_norm, flash attention —
+paddle/phi/kernels/fusion/gpu/) live in paddle_tpu.incubate.nn.functional with
+Pallas implementations.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import defop, apply_op
+from ..core.tensor import Tensor
+from ..core import state as _state
+
+# ---------------- activations ----------------
+
+
+@defop("relu")
+def relu(x, name=None):
+    return jax.nn.relu(x)
+
+
+@defop("relu6")
+def relu6(x, name=None):
+    return jax.nn.relu6(x)
+
+
+@defop("gelu")
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@defop("silu")
+def silu(x, name=None):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+@defop("leaky_relu")
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@defop("elu")
+def elu(x, alpha=1.0, name=None):
+    return jax.nn.elu(x, alpha)
+
+
+@defop("celu")
+def celu(x, alpha=1.0, name=None):
+    return jax.nn.celu(x, alpha)
+
+
+@defop("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@defop("prelu")
+def prelu(x, weight, data_format="NCHW", name=None):
+    w = weight
+    if w.ndim == 1 and w.shape[0] > 1 and x.ndim > 1:
+        ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ch_axis] = w.shape[0]
+        w = w.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+@defop("hardshrink")
+def hardshrink(x, threshold=0.5, name=None):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@defop("softshrink")
+def softshrink(x, threshold=0.5, name=None):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@defop("tanhshrink")
+def tanhshrink(x, name=None):
+    return x - jnp.tanh(x)
+
+
+@defop("hardsigmoid")
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@defop("hardswish")
+def hardswish(x, name=None):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@defop("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@defop("softplus")
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return jnp.where(x * beta > threshold, x,
+                     jnp.log1p(jnp.exp(beta * x)) / beta)
+
+
+@defop("softsign")
+def softsign(x, name=None):
+    return x / (1.0 + jnp.abs(x))
+
+
+@defop("mish")
+def mish(x, name=None):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@defop("glu")
+def glu(x, axis=-1, name=None):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@defop("maxout")
+def maxout(x, groups, axis=1, name=None):
+    ax = axis % x.ndim
+    c = x.shape[ax]
+    shape = list(x.shape)
+    shape[ax:ax + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=ax + 1)
+
+
+@defop("softmax")
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@defop("log_softmax")
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@defop("rrelu")
+def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
+    slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@defop("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, name=None):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+# ---------------- linear / embedding ----------------
+
+
+@defop("linear")
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b; W is [in, out] (reference convention)."""
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        y = jnp.matmul(x, weight, preferred_element_type=jnp.float32).astype(x.dtype)
+    else:
+        y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@defop("embedding")
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+@defop("one_hot")
+def one_hot(x, num_classes, name=None):
+    return jax.nn.one_hot(x, num_classes)
+
+
+# ---------------- dropout ----------------
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = _state.next_rng_key()
+
+    def fn(x_):
+        shape = list(x_.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, x_ / (1.0 - p), jnp.zeros((), x_.dtype))
+        return jnp.where(keep, x_, jnp.zeros((), x_.dtype))
+    return apply_op("dropout", fn, (x,))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = _state.next_rng_key()
+    alpha = -1.7580993408473766
+
+    def fn(x_):
+        keep = jax.random.bernoulli(key, 1.0 - p, x_.shape)
+        a = ((1.0 - p) * (1.0 + p * alpha ** 2)) ** -0.5
+        b = -a * alpha * p
+        return a * jnp.where(keep, x_, alpha) + b
+    return apply_op("alpha_dropout", fn, (x,))
+
+
+# ---------------- normalization ----------------
+
+
+@defop("layer_norm")
+def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape) if normalized_shape else 1
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+    # compute statistics in f32 for bf16 inputs (numerics parity with the
+    # reference's fused_layernorm which accumulates in float)
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@defop("rms_norm")
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@defop("batch_norm_infer")
+def _batch_norm_infer(x, running_mean, running_var, weight, bias, epsilon,
+                      data_format):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    mean = running_mean.reshape(shape)
+    var = running_var.reshape(shape)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    if use_global_stats is None:
+        use_global_stats = not training
+    if use_global_stats:
+        return apply_op("batch_norm_infer", _batch_norm_infer.__wrapped__,
+                        (x, running_mean, running_var, weight, bias),
+                        static={"epsilon": epsilon, "data_format": data_format})
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+
+    def fn(x_, w, b):
+        mean = jnp.mean(x_, axis=axes)
+        var = jnp.var(x_, axis=axes)
+        shape = [1] * x_.ndim
+        shape[ch_axis] = x_.shape[ch_axis]
+        out = (x_ - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + epsilon)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out, mean, var
+
+    out, mean, var = apply_op("batch_norm", fn, (x, weight, bias))
+    # update running stats in-place (host-side state, like the reference)
+    if running_mean is not None:
+        m = momentum
+        running_mean.set_value(m * running_mean._data + (1 - m) * mean._data)
+        running_var.set_value(m * running_var._data + (1 - m) * var._data)
+    return out
+
+
+@defop("instance_norm")
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    spatial = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else \
+        tuple(i for i in range(1, x.ndim - 1))
+    mean = jnp.mean(x, axis=spatial, keepdims=True)
+    var = jnp.var(x, axis=spatial, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        shape = [1] * x.ndim
+        shape[ch_axis] = x.shape[ch_axis]
+        out = out * weight.reshape(shape) + (bias.reshape(shape)
+                                             if bias is not None else 0.0)
+    return out
+
+
+@defop("group_norm")
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    if data_format == "NHWC":
+        x_t = jnp.moveaxis(x, -1, 1)
+    else:
+        x_t = x
+    n, c = x_t.shape[0], x_t.shape[1]
+    g = num_groups
+    grouped = x_t.reshape((n, g, c // g) + x_t.shape[2:])
+    axes = tuple(range(2, grouped.ndim))
+    mean = jnp.mean(grouped, axis=axes, keepdims=True)
+    var = jnp.var(grouped, axis=axes, keepdims=True)
+    out = ((grouped - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x_t.shape)
+    if weight is not None:
+        shape = [1, c] + [1] * (x_t.ndim - 2)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = [1, c] + [1] * (x_t.ndim - 2)
+        out = out + bias.reshape(shape)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@defop("normalize")
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    nrm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(nrm, epsilon)
+
+
+@defop("local_response_norm")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    half = size // 2
+    moved = jnp.moveaxis(sq, ch_axis, -1)
+    padded = jnp.pad(moved, [(0, 0)] * (x.ndim - 1) + [(half, size - 1 - half)])
+    windows = jnp.stack([padded[..., i:i + moved.shape[-1]]
+                         for i in range(size)], axis=-1)
+    s = jnp.sum(windows, axis=-1)
+    s = jnp.moveaxis(s, -1, ch_axis)
+    return x / jnp.power(k + alpha * s, beta)
+
+
+# ---------------- conv / pool ----------------
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, n_spatial, kernel, dilation):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n_spatial
+    padding = list(padding)
+    if len(padding) == n_spatial:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n_spatial:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n_spatial)]
+    raise ValueError(f"bad padding {padding}")
+
+
+@defop("conv2d")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """reference: paddle.nn.functional.conv2d over cuDNN; here
+    lax.conv_general_dilated → MXU."""
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _conv_padding(padding, 2, weight.shape[2:], dilation)
+    dn = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else \
+         ("NHWC", "OIHW", "NHWC")
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=dn,
+        preferred_element_type=jnp.float32 if x.dtype in
+        (jnp.bfloat16, jnp.float16) else None)
+    out = out.astype(x.dtype)
+    if bias is not None:
+        shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@defop("conv1d")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    stride = _pair(stride, 1)
+    dilation = _pair(dilation, 1)
+    pad = _conv_padding(padding, 1, weight.shape[2:], dilation)
+    dn = ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "OIH", "NHC")
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=dn)
+    if bias is not None:
+        shape = [1, -1, 1] if data_format == "NCL" else [1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@defop("conv3d")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    pad = _conv_padding(padding, 3, weight.shape[2:], dilation)
+    dn = ("NCDHW", "OIDHW", "NCDHW")
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=dn)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1, 1, 1])
+    return out
+
+
+@defop("conv2d_transpose")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCHW", output_size=None, name=None):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = padding if isinstance(padding, str) else _conv_padding(
+        padding, 2, weight.shape[2:], dilation)
+    # weight layout IOHW for transpose (reference convention [in, out, kh, kw])
+    out = jax.lax.conv_transpose(
+        x, weight, strides=stride,
+        padding=pad.upper() if isinstance(pad, str) else pad,
+        rhs_dilation=dilation,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1, 1])
+    return out
+
+
+def _pool(x, op, init, kernel, stride, padding, data_format, n_spatial,
+          ceil_mode=False):
+    kernel = _pair(kernel, n_spatial)
+    stride = _pair(stride if stride is not None else kernel, n_spatial)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _conv_padding(padding, n_spatial, kernel, (1,) * n_spatial)
+        pad = p
+    if data_format.startswith("NC"):
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+        if not isinstance(pad, str):
+            pad = [(0, 0), (0, 0)] + pad
+    else:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        if not isinstance(pad, str):
+            pad = [(0, 0)] + pad + [(0, 0)]
+    return jax.lax.reduce_window(x, init, op, dims, strides, pad)
+
+
+@defop("max_pool2d")
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _pool(x, jax.lax.max, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                 else jnp.iinfo(x.dtype).min,
+                 kernel_size, stride, padding, data_format, 2, ceil_mode)
+
+
+@defop("avg_pool2d")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    summed = _pool(x, jax.lax.add, 0.0, kernel_size, stride, padding,
+                   data_format, 2, ceil_mode)
+    k = _pair(kernel_size, 2)
+    if divisor_override:
+        div = divisor_override
+    elif exclusive and padding != 0:
+        ones = jnp.ones_like(x)
+        div = _pool(ones, jax.lax.add, 0.0, kernel_size, stride, padding,
+                    data_format, 2, ceil_mode)
+        return summed / div
+    else:
+        div = k[0] * k[1]
+    return summed / div
+
+
+@defop("max_pool1d")
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool(x, jax.lax.max, -jnp.inf, kernel_size, stride, padding,
+                 "NCL", 1, ceil_mode)
+
+
+@defop("avg_pool1d")
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    summed = _pool(x, jax.lax.add, 0.0, kernel_size, stride, padding,
+                   "NCL", 1, ceil_mode)
+    k = _pair(kernel_size, 1)
+    return summed / k[0]
+
+
+@defop("adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out = _pair(output_size, 2)
+    if data_format == "NCHW":
+        h, w = x.shape[2], x.shape[3]
+    else:
+        h, w = x.shape[1], x.shape[2]
+    if h % out[0] == 0 and w % out[1] == 0:
+        kh, kw = h // out[0], w // out[1]
+        return avg_pool2d.__wrapped__(x, (kh, kw), (kh, kw), 0,
+                                      data_format=data_format)
+    # general case: mean over computed bins
+    def pool_axis(arr, axis, n_out):
+        size = arr.shape[axis]
+        starts = (np.arange(n_out) * size) // n_out
+        ends = ((np.arange(n_out) + 1) * size + n_out - 1) // n_out
+        pieces = [jnp.mean(jax.lax.slice_in_dim(arr, int(s), int(e), axis=axis),
+                           axis=axis, keepdims=True)
+                  for s, e in zip(starts, ends)]
+        return jnp.concatenate(pieces, axis=axis)
+    ha = 2 if data_format == "NCHW" else 1
+    x = pool_axis(x, ha, out[0])
+    x = pool_axis(x, ha + 1, out[1])
+    return x
+
+
+@defop("adaptive_max_pool2d")
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _pair(output_size, 2)
+    h, w = x.shape[2], x.shape[3]
+    kh, kw = h // out[0], w // out[1]
+    return max_pool2d.__wrapped__(x, (kh, kw), (kh, kw), 0)
+
+
+@defop("unfold_op")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: paddle.nn.functional.unfold)."""
+    k = _pair(kernel_sizes, 2)
+    s = _pair(strides, 2)
+    d = _pair(dilations, 2)
+    p = _conv_padding(paddings, 2, k, d)
+    n, c = x.shape[0], x.shape[1]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s, padding=p, rhs_dilation=d,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # [N, C*kh*kw, L]
+    return patches.reshape(n, c * k[0] * k[1], -1)
+
+
+@defop("interpolate")
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    if data_format == "NCHW":
+        spatial = x.shape[2:]
+    else:
+        spatial = x.shape[1:-1]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    size = [int(v) for v in (size if isinstance(size, (list, tuple)) else [size])]
+    method = {"nearest": "nearest", "bilinear": "bilinear", "linear": "linear",
+              "bicubic": "cubic", "trilinear": "trilinear", "area": "linear"}[mode]
+    if data_format == "NCHW":
+        out_shape = x.shape[:2] + tuple(size)
+    else:
+        out_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    return jax.image.resize(x, out_shape, method=method)
+
+
+upsample = interpolate
+
+
+@defop("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+# ---------------- losses ----------------
+
+
+@defop("mse_loss")
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    loss = jnp.square(input - label)
+    return _reduce(loss, reduction)
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@defop("l1_loss")
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@defop("smooth_l1_loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@defop("cross_entropy")
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """reference: python/paddle/nn/functional/loss.py cross_entropy.
+
+    Computes log-softmax in f32 regardless of input dtype (AMP black-list
+    behavior of the reference).
+    """
+    x = input.astype(jnp.float32) if input.dtype in (jnp.bfloat16, jnp.float16) \
+        else input
+    if use_softmax:
+        logp = jax.nn.log_softmax(x, axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(x, 1e-30, None))
+    if soft_label:
+        lbl = label.astype(logp.dtype)
+        if label_smoothing > 0.0:
+            n = logp.shape[axis]
+            lbl = lbl * (1 - label_smoothing) + label_smoothing / n
+        loss = -jnp.sum(lbl * logp, axis=axis)
+    else:
+        lbl = label
+        if lbl.ndim == logp.ndim:
+            lbl = jnp.squeeze(lbl, axis)
+        lbl_clipped = jnp.clip(lbl, 0, logp.shape[axis] - 1)
+        picked = jnp.take_along_axis(
+            logp, lbl_clipped[..., None].astype(jnp.int32), axis=axis
+        )[..., 0]
+        if label_smoothing > 0.0:
+            n = logp.shape[axis]
+            smooth = jnp.mean(logp, axis=axis)
+            loss = -(1 - label_smoothing) * picked - label_smoothing * smooth
+        else:
+            loss = -picked
+        mask = (lbl != ignore_index)
+        loss = jnp.where(mask, loss, 0.0)
+        if weight is not None:
+            w = jnp.take(weight, lbl_clipped.astype(jnp.int32))
+            loss = loss * w
+            if reduction == "mean":
+                denom = jnp.sum(jnp.where(mask, w, 0.0))
+                return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        if reduction == "mean":
+            denom = jnp.sum(mask.astype(loss.dtype))
+            return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+    return _reduce(loss, reduction)
+
+
+@defop("nll_loss")
+def nll_loss(input, label, weight=None, ignore_index=-100,  # noqa: A002
+             reduction="mean", name=None):
+    picked = jnp.take_along_axis(input, label[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0] if input.ndim == label.ndim + 1 \
+        else jnp.take_along_axis(input, label.astype(jnp.int32), axis=1)
+    loss = -picked
+    mask = label != ignore_index
+    loss = jnp.where(mask, loss, 0.0)
+    if weight is not None:
+        loss = loss * jnp.take(weight, jnp.clip(label, 0, None))
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+    return _reduce(loss, reduction)
+
+
+@defop("binary_cross_entropy")
+def binary_cross_entropy(input, label, weight=None, reduction="mean",  # noqa: A002
+                         name=None):
+    x = jnp.clip(input, 1e-12, 1.0 - 1e-12)
+    loss = -(label * jnp.log(x) + (1.0 - label) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@defop("binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1.0 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1.0 - label) * logit + max_val + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@defop("kl_div")
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@defop("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1, name=None):
+    logp = jax.nn.log_softmax(
+        logits.astype(jnp.float32) if logits.dtype in (jnp.bfloat16, jnp.float16)
+        else logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logp.ndim:
+            lbl = jnp.squeeze(lbl, axis)
+        picked = jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32),
+                                     axis=axis)
+        loss = -picked
+        loss = jnp.where((lbl != ignore_index)[..., None], loss, 0.0)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+@defop("margin_ranking_loss")
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # noqa: A002
+                        name=None):
+    loss = jnp.clip(-label * (input - other) + margin, 0, None)
+    return _reduce(loss, reduction)
+
+
+@defop("hinge_embedding_loss")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    loss = jnp.where(label == 1, input, jnp.clip(margin - input, 0, None))
+    return _reduce(loss, reduction)
+
+
+@defop("cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.clip(n1 * n2, eps, None)
+
+
+@defop("cosine_embedding_loss")
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    cos = cosine_similarity.__wrapped__(input1, input2, axis=1)
+    loss = jnp.where(label == 1, 1.0 - cos, jnp.clip(cos - margin, 0, None))
+    return _reduce(loss, reduction)
+
+
+@defop("triplet_margin_loss")
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def dist(a, b):
+        return jnp.sum(jnp.abs(a - b) ** p + epsilon, axis=-1) ** (1.0 / p)
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    loss = jnp.clip(d_pos - d_neg + margin, 0, None)
+    return _reduce(loss, reduction)
+
+
+@defop("square_error_cost")
+def square_error_cost(input, label):  # noqa: A002
+    return jnp.square(input - label)
+
+
+# ---------------- attention ----------------
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """reference: paddle.nn.functional.scaled_dot_product_attention
+    (flash-attn kernel at paddle/phi/kernels/gpu/flash_attn_kernel.cu:203).
+    Inputs [batch, seq, heads, head_dim].  Uses the Pallas flash-attention
+    kernel on TPU when available, else the XLA fallback."""
+    from ..pallas import flash_attention as fa
+    return fa.flash_attention(query, key, value, attn_mask=attn_mask,
+                              dropout=dropout_p, causal=is_causal,
+                              training=training)
+
+
+def _sdpa_xla(q, k, v, attn_mask=None, causal=False, scale=None):
+    """Plain XLA attention on [B, S, H, D]."""
+    d = q.shape[-1]
+    scale = scale or (1.0 / np.sqrt(d))
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
+        logits = jnp.where(mask, logits, -1e30)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -1e30)
+        else:
+            logits = logits + attn_mask
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+# ---------------- misc ----------------
+
+
+@defop("label_smooth")
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / n
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    from ..core.dispatch import apply_op as _ap
+    from ..core.dtype import convert_dtype
+    if maxlen is None:
+        maxlen = int(np.asarray(lengths.numpy()).max())
+
+    def fn(l):  # noqa: E741
+        return (jnp.arange(maxlen)[None, :] < l[..., None]).astype(
+            convert_dtype(dtype))
+    return _ap("sequence_mask", fn, (lengths,), nondiff=True)
+
+
+@defop("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([x5[:, 1:, :fold], jnp.zeros_like(x5[:, :1, :fold])], 1)
+    right = jnp.concatenate([jnp.zeros_like(x5[:, :1, fold:2 * fold]),
+                             x5[:, :-1, fold:2 * fold]], 1)
+    mid = x5[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, mid], axis=2).reshape(nt, c, h, w)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    from ..tensor_ops.manipulation import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)

@@ -1,0 +1,1 @@
+from .registry import OPS, register_op, get_op, list_ops  # noqa: F401

@@ -1,0 +1,41 @@
+"""Op registry: the single source of truth for op metadata.
+
+Reference capability: the declarative YAML op definitions
+(reference: paddle/phi/api/yaml/ops.yaml + generators) that drive codegen of
+the C++ API, autograd nodes and SPMD rules.  TPU-native realization: a runtime
+registry — the "codegen" targets collapse because JAX provides autodiff
+(jax.vjp) and GSPMD provides sharding propagation; what remains useful is a
+queryable table of {name → impl, differentiability, spmd rule, flops fn} used
+by introspection, AMP lists, the profiler and the auto-parallel layer.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass
+class OpDef:
+    name: str
+    fn: Callable                      # pure JAX implementation
+    nondiff: bool = False             # no gradient defined
+    spmd_rule: Optional[Callable] = None   # sharding propagation hint
+    flops: Optional[Callable] = None       # flops estimator for profiler/MFU
+    tags: tuple = field(default_factory=tuple)
+
+
+OPS: dict[str, OpDef] = {}
+
+
+def register_op(name, fn, nondiff=False, spmd_rule=None, flops=None, tags=()):
+    OPS[name] = OpDef(name, fn, nondiff=nondiff, spmd_rule=spmd_rule,
+                      flops=flops, tags=tuple(tags))
+    return OPS[name]
+
+
+def get_op(name) -> Optional[OpDef]:
+    return OPS.get(name)
+
+
+def list_ops():
+    return sorted(OPS)

@@ -1,0 +1,5 @@
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Lamb,
+    L1Decay, L2Decay,
+)
+from . import lr  # noqa: F401

@@ -1,0 +1,4 @@
+"""Pallas TPU kernels (reference capability: the hand-CUDA fused kernels in
+paddle/phi/kernels/fusion/gpu/ and flash_attn dynload —
+paddle/phi/kernels/gpu/flash_attn_kernel.cu)."""
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,1 @@
+from . import math, reduction, linalg, manipulation, logic, search, creation, random  # noqa: F401

@@ -1,0 +1,247 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py —
+matmul at :144 dispatching to the PHI cuBLAS path).  TPU-native realization:
+`jnp.matmul`/`lax.dot_general` lower straight onto the MXU; bf16 inputs use
+native mixed-precision accumulation (preferred_element_type=f32)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import defop
+from ..ops.registry import OPS
+
+
+@defop("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    # accumulate in f32 on the MXU even for bf16 operands
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    return jnp.matmul(x, y)
+
+
+def _matmul_flops(shapes, **kw):
+    xs, ys = shapes[0], shapes[1]
+    m, k = xs[-2], xs[-1]
+    n = ys[-1]
+    import numpy as np
+    batch = int(np.prod(xs[:-2])) if len(xs) > 2 else 1
+    return 2 * batch * m * k * n
+
+
+OPS["matmul"].flops = _matmul_flops
+
+
+@defop("transpose")
+def transpose(x, perm=None, name=None):
+    return jnp.transpose(x, axes=tuple(perm) if perm is not None else None)
+
+
+@defop("t")
+def t(x, name=None):
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports ndim<=2")
+    return x.T
+
+
+@defop("dot")
+def dot(x, y, name=None):
+    return jnp.sum(x * y, axis=-1)
+
+
+@defop("mv")
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+@defop("bmm")
+def bmm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+@defop("norm")
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if p == "fro" or p is None:
+        if axis is None:
+            return jnp.sqrt(jnp.sum(x * x))
+        return jnp.sqrt(jnp.sum(x * x, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+                                keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+@defop("dist")
+def dist(x, y, p=2.0, name=None):
+    d = x - y
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+@defop("cross")
+def cross(x, y, axis=9, name=None):
+    if axis == 9:
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=axis)
+
+
+@defop("einsum")
+def einsum_op(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    from ..core.dispatch import apply_op
+
+    def fn(*ops):
+        return jnp.einsum(equation, *ops)
+    return apply_op("einsum", fn, operands)
+
+
+@defop("matrix_power")
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@defop("inverse")
+def inverse(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+inv = inverse
+
+
+@defop("pinv")
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@defop("det")
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+@defop("slogdet")
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@defop("cholesky")
+def cholesky(x, upper=False, name=None):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@defop("cholesky_solve")
+def cholesky_solve(x, y, upper=False, name=None):
+    c = jnp.swapaxes(y, -1, -2) if upper else y
+    return jax.scipy.linalg.cho_solve((c, True), x)
+
+
+@defop("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@defop("solve")
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+@defop("lstsq", nondiff=True)
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@defop("qr")
+def qr(x, mode="reduced", name=None):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+@defop("svd")
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2)
+
+
+@defop("eig", nondiff=True)
+def eig(x, name=None):
+    return jnp.linalg.eig(x)
+
+
+@defop("eigh")
+def eigh(x, UPLO="L", name=None):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+@defop("eigvals", nondiff=True)
+def eigvals(x, name=None):
+    return jnp.linalg.eigvals(x)
+
+
+@defop("eigvalsh")
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@defop("matrix_rank", nondiff=True)
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@defop("cond")
+def cond(x, p=None, name=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@defop("lu", nondiff=True)
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    if get_infos:
+        return lu_, piv.astype(jnp.int32), jnp.zeros((), jnp.int32)
+    return lu_, piv.astype(jnp.int32)
+
+
+@defop("kron")
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+@defop("multi_dot")
+def multi_dot(xs, name=None):
+    from ..core.tensor import Tensor
+    arrs = [a._data if isinstance(a, Tensor) else a for a in xs]
+    return jnp.linalg.multi_dot(arrs)
+
+
+@defop("householder_product")
+def householder_product(x, tau, name=None):
+    m, n = x.shape[-2], x.shape[-1]
+    eye = jnp.eye(m, dtype=x.dtype)
+    q = jnp.broadcast_to(eye, x.shape[:-2] + (m, m)).copy() if x.ndim > 2 else eye
+
+    def body(i, q):
+        v = jnp.where(jnp.arange(m) < i, 0.0, x[..., i])
+        v = v.at[i].set(1.0) if x.ndim == 2 else v
+        h = jnp.eye(m, dtype=x.dtype) - tau[..., i] * jnp.outer(v, v)
+        return q @ h
+    for i in range(n):
+        q = body(i, q)
+    return q[..., :, :n]

@@ -1,0 +1,103 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import defop
+from ..core.tensor import Tensor
+
+
+def _c(y, like):
+    if isinstance(y, (int, float, bool)) and hasattr(like, "dtype"):
+        return jnp.asarray(y, dtype=like.dtype)
+    return y
+
+
+@defop("equal", nondiff=True)
+def equal(x, y, name=None):
+    return jnp.equal(x, _c(y, x))
+
+
+@defop("not_equal", nondiff=True)
+def not_equal(x, y, name=None):
+    return jnp.not_equal(x, _c(y, x))
+
+
+@defop("less_than", nondiff=True)
+def less_than(x, y, name=None):
+    return jnp.less(x, _c(y, x))
+
+
+@defop("less_equal", nondiff=True)
+def less_equal(x, y, name=None):
+    return jnp.less_equal(x, _c(y, x))
+
+
+@defop("greater_than", nondiff=True)
+def greater_than(x, y, name=None):
+    return jnp.greater(x, _c(y, x))
+
+
+@defop("greater_equal", nondiff=True)
+def greater_equal(x, y, name=None):
+    return jnp.greater_equal(x, _c(y, x))
+
+
+@defop("equal_all", nondiff=True)
+def equal_all(x, y, name=None):
+    return jnp.array_equal(x, y)
+
+
+@defop("allclose", nondiff=True)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop("isclose", nondiff=True)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop("logical_and", nondiff=True)
+def logical_and(x, y, name=None):
+    return jnp.logical_and(x, y)
+
+
+@defop("logical_or", nondiff=True)
+def logical_or(x, y, name=None):
+    return jnp.logical_or(x, y)
+
+
+@defop("logical_not", nondiff=True)
+def logical_not(x, name=None):
+    return jnp.logical_not(x)
+
+
+@defop("logical_xor", nondiff=True)
+def logical_xor(x, y, name=None):
+    return jnp.logical_xor(x, y)
+
+
+@defop("bitwise_and", nondiff=True)
+def bitwise_and(x, y, name=None):
+    return jnp.bitwise_and(x, y)
+
+
+@defop("bitwise_or", nondiff=True)
+def bitwise_or(x, y, name=None):
+    return jnp.bitwise_or(x, y)
+
+
+@defop("bitwise_xor", nondiff=True)
+def bitwise_xor(x, y, name=None):
+    return jnp.bitwise_xor(x, y)
+
+
+@defop("bitwise_not", nondiff=True)
+def bitwise_not(x, name=None):
+    return jnp.bitwise_not(x)
+
+
+@defop("is_empty", nondiff=True)
+def is_empty(x, name=None):
+    return jnp.asarray(x.size == 0)

@@ -1,0 +1,432 @@
+"""Shape / layout manipulation ops (reference:
+python/paddle/tensor/manipulation.py, indexing in variable_index.py).
+All static-shape — XLA requires it, and the API surface enforces it the same
+way the reference's InferMeta does."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import defop, apply_op
+from ..core.tensor import Tensor
+from ..core import dtype as _dtype
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+@defop("cast")
+def cast(x, dtype):
+    return x.astype(_dtype.convert_dtype(dtype))
+
+
+@defop("reshape")
+def reshape(x, shape, name=None):
+    shape = [int(s) if not isinstance(s, Tensor) else int(s.item())
+             for s in (shape if isinstance(shape, (list, tuple)) else [shape])]
+    # paddle semantics: 0 means "copy this dim from input"
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return jnp.reshape(x, shape)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+@defop("flatten")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape((1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = list(x.shape)
+    new = shape[:start] + [int(np.prod(shape[start:stop + 1]))] + shape[stop + 1:]
+    return jnp.reshape(x, new)
+
+
+@defop("squeeze")
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+@defop("unsqueeze")
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    out = x
+    for a in sorted(a % (out.ndim + 1) for a in axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+@defop("concat")
+def concat(xs, axis=0, name=None):
+    arrs = [_arr(a) for a in xs]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return jnp.concatenate(arrs, axis=axis)
+
+
+@defop("stack")
+def stack(xs, axis=0, name=None):
+    return jnp.stack([_arr(a) for a in xs], axis=axis)
+
+
+@defop("split")
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if any(s == -1 for s in sections):
+        known = sum(s for s in sections if s != -1)
+        sections = [total - known if s == -1 else s for s in sections]
+    idx = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@defop("chunk")
+def chunk(x, chunks, axis=0, name=None):
+    return tuple(jnp.split(x, chunks, axis=axis))
+
+
+@defop("unbind")
+def unbind(x, axis=0, name=None):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+@defop("tile")
+def tile(x, repeat_times, name=None):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+@defop("expand")
+def expand(x, shape, name=None):
+    shape = [int(s) for s in shape]
+    shape = [x.shape[i - (len(shape) - x.ndim)] if s == -1 and i >= len(shape) - x.ndim
+             else s for i, s in enumerate(shape)]
+    return jnp.broadcast_to(x, shape)
+
+
+@defop("expand_as")
+def expand_as(x, y, name=None):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@defop("broadcast_to")
+def broadcast_to(x, shape, name=None):
+    return jnp.broadcast_to(x, tuple(int(s) for s in shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [broadcast_to(t, out_shape) for t in inputs]
+
+
+@defop("gather")
+def gather(x, index, axis=0, name=None):
+    idx = index
+    if idx.ndim > 1:
+        idx = idx.reshape(-1)
+    return jnp.take(x, idx, axis=axis)
+
+
+@defop("gather_nd")
+def gather_nd(x, index, name=None):
+    index_depth = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(index_depth))
+    return x[idx]
+
+
+@defop("take_along_axis")
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@defop("put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    values = jnp.broadcast_to(jnp.asarray(values, x.dtype), indices.shape)
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in indices.shape], indexing="ij")
+    full_idx = tuple(indices if d == axis % x.ndim else grids[d]
+                     for d in range(x.ndim))
+    if reduce == "assign":
+        return x.at[full_idx].set(values)
+    if reduce == "add":
+        return x.at[full_idx].add(values)
+    if reduce in ("mul", "multiply"):
+        return x.at[full_idx].multiply(values)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+@defop("scatter")
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = index.reshape(-1)
+    if overwrite:
+        return x.at[idx].set(updates)
+    return x.at[idx].add(updates)
+
+
+@defop("scatter_nd_add")
+def scatter_nd_add(x, index, updates, name=None):
+    depth = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(depth))
+    return x.at[idx].add(updates)
+
+
+@defop("scatter_nd")
+def scatter_nd(index, updates, shape, name=None):
+    zeros = jnp.zeros(tuple(int(s) for s in shape), updates.dtype)
+    depth = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(depth))
+    return zeros.at[idx].add(updates)
+
+
+@defop("index_select")
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(x, index, axis=axis)
+
+
+@defop("index_sample")
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@defop("index_add")
+def index_add(x, index, axis, value, name=None):
+    moved = jnp.moveaxis(x, axis, 0)
+    v = jnp.moveaxis(value, axis, 0) if value.ndim == x.ndim else value
+    out = moved.at[index].add(v)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@defop("index_put")
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(_arr(i) for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+@defop("masked_select", nondiff=True)
+def masked_select(x, mask, name=None):
+    # dynamic-shape output: host-side only (not jit-traceable), like the
+    # reference's returning variable-length tensors
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+@defop("masked_fill")
+def masked_fill(x, mask, value, name=None):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+@defop("roll")
+def roll(x, shifts, axis=None, name=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@defop("flip")
+def flip(x, axis, name=None):
+    return jnp.flip(x, axis=axis)
+
+
+@defop("rot90")
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@defop("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return jnp.repeat(x, _arr(repeats), axis=axis)
+
+
+builtins_slice = slice  # capture the builtin before the op shadows the name
+
+
+@defop("slice")
+def slice(x, axes, starts, ends):  # noqa: A001
+    slices = [builtins_slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        st = int(_arr(st)) if not isinstance(st, int) else st
+        en = int(_arr(en)) if not isinstance(en, int) else en
+        slices[ax] = builtins_slice(st, en)
+    return x[tuple(slices)]
+
+
+@defop("strided_slice")
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    slices = [builtins_slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        slices[ax] = builtins_slice(int(st), int(en), int(sd))
+    return x[tuple(slices)]
+
+
+@defop("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle conv-style: pad applies to trailing spatial dims, reversed pairs
+        n_spatial = len(pad) // 2
+        width = [(0, 0)] * (nd - n_spatial)
+        if data_format.endswith("C"):  # NHWC: spatial dims before channel
+            width = [(0, 0)] + [(pad[2 * i], pad[2 * i + 1])
+                                for i in range(n_spatial)] + [(0, 0)]
+            width = width[:nd]
+        else:
+            width += [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "replicate": "edge", "circular": "wrap"}
+    if mode == "constant":
+        return jnp.pad(x, width, mode="constant", constant_values=value)
+    return jnp.pad(x, width, mode=mode_map[mode])
+
+
+@defop("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop("diag")
+def diag(x, offset=0, padding_value=0, name=None):
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, dtype=x.dtype)
+        return base.at[jnp.arange(x.shape[0]),
+                       jnp.arange(x.shape[0]) + offset].set(x) if offset >= 0 \
+            else base.at[jnp.arange(x.shape[0]) - offset,
+                         jnp.arange(x.shape[0])].set(x)
+    return jnp.diag(x, k=offset)
+
+
+@defop("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    out = jax.vmap(jnp.diag, in_axes=0)(x.reshape(-1, x.shape[-1])) \
+        if x.ndim > 1 else jnp.diag(x, k=offset)
+    if x.ndim > 1:
+        out = out.reshape(x.shape[:-1] + out.shape[-2:])
+    return out
+
+
+@defop("tril")
+def tril(x, diagonal=0, name=None):
+    return jnp.tril(x, k=diagonal)
+
+
+@defop("triu")
+def triu(x, diagonal=0, name=None):
+    return jnp.triu(x, k=diagonal)
+
+
+@defop("moveaxis")
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+@defop("swapaxes")
+def swapaxes(x, axis1, axis2, name=None):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+transpose_ = swapaxes
+
+
+@defop("as_real")
+def as_real(x, name=None):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@defop("as_complex")
+def as_complex(x, name=None):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@defop("unfold")
+def unfold(x, axis, size, step, name=None):
+    n = (x.shape[axis] - size) // step + 1
+    idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved[idx]  # [n, size, ...rest]
+    out = jnp.moveaxis(out, (0, 1), (axis, x.ndim))
+    return out
+
+
+@defop("unique", nondiff=True)
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    res = jnp.unique(np.asarray(x), return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    return res
+
+
+@defop("one_hot")
+def one_hot_op(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def one_hot(x, num_classes, name=None):
+    return one_hot_op(x, num_classes=num_classes)
+
+
+def _getitem(self, item):
+    def norm(i):
+        if isinstance(i, Tensor):
+            return i._data
+        return i
+    if isinstance(item, tuple):
+        item_n = tuple(norm(i) for i in item)
+    else:
+        item_n = norm(item)
+    return apply_op("getitem", lambda x: x[item_n], (self,))
+
+
+def _setitem(self, item, value):
+    def norm(i):
+        return i._data if isinstance(i, Tensor) else i
+    item_n = tuple(norm(i) for i in item) if isinstance(item, tuple) else norm(item)
+
+    def fn(x, v):
+        return x.at[item_n].set(v.astype(x.dtype) if hasattr(v, "dtype") else v)
+    value_t = value if isinstance(value, Tensor) else Tensor(jnp.asarray(value))
+    out = apply_op("setitem", fn, (self, value_t))
+    self._data = out._data
+    self._grad_node = out._grad_node
+    self._out_index = out._out_index
+    self.stop_gradient = out.stop_gradient
+
+
+def tensordot(x, y, axes=2, name=None):
+    def fn(a, b):
+        return jnp.tensordot(a, b, axes=axes)
+    return apply_op("tensordot", fn, (x, y))
+
+
+@defop("bincount", nondiff=True)
+def bincount(x, weights=None, minlength=0, name=None):
+    return jnp.bincount(x, weights=_arr(weights), minlength=minlength)
+
+
+@defop("histogram", nondiff=True)
+def histogram(x, bins=100, min=0, max=0, name=None):  # noqa: A002
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=rng)
+    return hist

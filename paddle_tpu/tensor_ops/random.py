@@ -1,0 +1,125 @@
+"""Random ops over the framework RNG (reference: python/paddle/tensor/random.py).
+
+TPU-native: JAX stateless PRNG keys derived from the global (key, counter)
+state — see core/state.py.  Under jit tracing the base key is a traced input,
+so compiled programs draw fresh randomness each step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as _dtype
+from ..core import state as _state
+from .creation import _shape, _dt
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    key = _state.next_rng_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ()))
+        key = _state.next_rng_key()
+        return Tensor(jax.random.normal(key, out_shape) * s + m)
+    key = _state.next_rng_key()
+    return Tensor(jax.random.normal(key, _shape(shape or [1])) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = (jax.random.PRNGKey(seed) if seed else _state.next_rng_key())
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = _state.next_rng_key()
+    return Tensor(jax.random.randint(key, _shape(shape), low, high,
+                                     dtype=_dtype.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    dtype = dtype or x.dtype
+    return randint(low, high, tuple(x.shape), dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = _state.next_rng_key()
+    return Tensor(jax.random.permutation(key, n).astype(
+        _dtype.convert_dtype(dtype)))
+
+
+def shuffle(x, name=None):
+    key = _state.next_rng_key()
+    return Tensor(jax.random.permutation(key, x._data, axis=0, independent=False))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = _state.next_rng_key()
+    logits = jnp.log(jnp.clip(x._data, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=logits.shape[:-1] + (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, logits.shape, logits.dtype)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    key = _state.next_rng_key()
+    return Tensor((jax.random.uniform(key, x._data.shape) < x._data)
+                  .astype(x.dtype))
+
+
+def poisson(x, name=None):
+    key = _state.next_rng_key()
+    return Tensor(jax.random.poisson(key, x._data).astype(x.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = _state.next_rng_key()
+    x._data = jax.random.exponential(key, x._data.shape, x.dtype) / lam
+    return x
+
+
+def rand_like(x, dtype=None):
+    return uniform(tuple(x.shape), dtype=dtype or x.dtype, min=0.0, max=1.0)
+
+
+def randn_like(x, dtype=None, name=None):
+    return standard_normal(tuple(x.shape), dtype or x.dtype)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..core.dispatch import apply_op
+    key = _state.next_rng_key()
+
+    def fn(logits):
+        g = jax.random.gumbel(key, logits.shape, logits.dtype)
+        y = jax.nn.softmax((logits + g) / temperature, axis=axis)
+        if hard:
+            if axis not in (-1, y.ndim - 1):
+                raise NotImplementedError("hard gumbel only on last axis")
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            one_hot = (jnp.arange(y.shape[axis]) == idx).astype(y.dtype)
+            y = jax.lax.stop_gradient(one_hot - y) + y  # straight-through
+        return y
+    return apply_op("gumbel_softmax", fn, (x,))

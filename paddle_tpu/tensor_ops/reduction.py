@@ -1,0 +1,123 @@
+"""Reduction ops (reference: python/paddle/tensor/math.py reduce family,
+stat.py).  XLA lowers these to tree reductions over the VPU; keepdim/axis
+semantics follow the reference API."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import defop
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@defop("sum")
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.sum(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@defop("mean")
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("max")
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("min")
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+amax = max
+amin = min
+
+
+@defop("prod")
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return jnp.prod(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@defop("all", nondiff=True)
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("any", nondiff=True)
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("logsumexp")
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("cumsum")
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@defop("cumprod")
+def cumprod(x, dim=None, dtype=None, name=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+@defop("cummax", nondiff=True)
+def cummax(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummax(x, axis=axis)
+    inds = jnp.argmax(
+        jnp.cumsum(jnp.ones_like(x, dtype=jnp.int32), axis=axis) *
+        (x == vals), axis=axis)
+    return vals, inds.astype(dtype)
+
+
+@defop("std")
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@defop("var")
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@defop("median")
+def median(x, axis=None, keepdim=False, name=None):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("quantile")
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.quantile(x, q, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("nanmean")
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("nansum")
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return jnp.nansum(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@defop("count_nonzero", nondiff=True)
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)

@@ -1,0 +1,117 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import defop
+from ..core.tensor import Tensor
+
+
+@defop("argmax", nondiff=True)
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+@defop("argmin", nondiff=True)
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+@defop("argsort", nondiff=True)
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(jnp.int64)
+
+
+@defop("sort")
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    out = jnp.sort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+@defop("topk")
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, inds = _topk(moved, k)
+    else:
+        vals, inds = _topk(-moved, k)
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(inds.astype(jnp.int64), -1, axis))
+
+
+def _topk(x, k):
+    import jax
+    return jax.lax.top_k(x, k)
+
+
+@defop("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    axis = axis % x.ndim
+    sorted_vals = jnp.sort(x, axis=axis)
+    sorted_inds = jnp.argsort(x, axis=axis)
+    vals = jnp.take(sorted_vals, k - 1, axis=axis)
+    inds = jnp.take(sorted_inds, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        inds = jnp.expand_dims(inds, axis)
+    return vals, inds.astype(jnp.int64)
+
+
+@defop("mode")
+def mode(x, axis=-1, keepdim=False, name=None):
+    # mode along axis via sorting (paddle semantics: returns values+indices)
+    axis = axis % x.ndim
+    # count equal elements along axis pairwise, pick the most frequent value
+    eq = jnp.expand_dims(x, axis) == jnp.expand_dims(x, axis + 1)
+    cnt = jnp.sum(eq, axis=axis + 1)
+    best = jnp.argmax(cnt, axis=axis)
+    vals = jnp.take_along_axis(x, jnp.expand_dims(best, axis), axis=axis)
+    if not keepdim:
+        vals = jnp.squeeze(vals, axis)
+    return vals, best.astype(jnp.int64)
+
+
+@defop("nonzero", nondiff=True)
+def nonzero(x, as_tuple=False, name=None):
+    # dynamic shape: host-side
+    arr = np.asarray(x)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(jnp.asarray(i[:, None], dtype=jnp.int64) for i in nz)
+    return jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int64)
+
+
+@defop("where")
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        raise ValueError("use nonzero() for single-arg where")
+    return jnp.where(condition,
+                     x if not isinstance(x, (int, float)) else jnp.asarray(x, y.dtype if hasattr(y, 'dtype') else jnp.float32),
+                     y if not isinstance(y, (int, float)) else jnp.asarray(y, x.dtype if hasattr(x, 'dtype') else jnp.float32))
+
+
+@defop("searchsorted", nondiff=True)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@defop("bucketize", nondiff=True)
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+import jax  # noqa: E402  (used by _topk)

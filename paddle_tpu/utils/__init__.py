@@ -1,0 +1,10 @@
+from . import flags  # noqa: F401
+from .flags import set_flags, get_flags  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

@@ -1,0 +1,61 @@
+"""Runtime flag system (reference: paddle/phi/core/flags.cc — ~100
+PHI_DEFINE_EXPORTED_* flags surfaced via paddle.set_flags).  TPU-native: a
+typed registry seeded from environment variables; consumed by debugging
+hooks (nan/inf checks), allocator-style knobs map onto XLA options."""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+_FLAGS: dict[str, Any] = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_check_nan_inf_level": 0,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_embedding_deterministic": 0,
+    "FLAGS_use_autotune": True,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_log_level": 0,
+    "FLAGS_profile": False,
+    "FLAGS_amp_dtype": "bfloat16",
+    "FLAGS_matmul_precision": "default",  # maps to jax.default_matmul_precision
+}
+
+
+def _coerce(old, new):
+    if isinstance(old, bool):
+        if isinstance(new, str):
+            return new.lower() in ("1", "true", "yes")
+        return bool(new)
+    if isinstance(old, int) and not isinstance(old, bool):
+        return int(new)
+    if isinstance(old, float):
+        return float(new)
+    return new
+
+
+# environment overrides at import
+for _k in list(_FLAGS):
+    if _k in os.environ:
+        _FLAGS[_k] = _coerce(_FLAGS[_k], os.environ[_k])
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if k in _FLAGS:
+            _FLAGS[k] = _coerce(_FLAGS[k], v)
+        else:
+            _FLAGS[k] = v
+
+
+def get_flags(keys=None):
+    if keys is None:
+        return dict(_FLAGS)
+    if isinstance(keys, str):
+        return {keys: _FLAGS.get(keys)}
+    return {k: _FLAGS.get(k) for k in keys}
+
+
+def flag(name, default=None):
+    return _FLAGS.get(name, default)

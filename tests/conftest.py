@@ -1,0 +1,16 @@
+"""Test config: force a virtual 8-device CPU mesh so distributed logic is
+CI-testable without TPUs (reference analog: fake_cpu_device.h pluggable
+fake device — SURVEY.md §4)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the backend here defaults matmuls to reduced precision; numeric-grad
+# comparisons need true f32 matmuls
+jax.config.update("jax_default_matmul_precision", "float32")

@@ -1,0 +1,168 @@
+"""Autograd tests: analytic grads vs numeric finite differences — the
+reference's OpTest.check_grad pattern (reference: test/legacy_test/op_test.py:2854,
+get_numeric_gradient :137)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Central finite differences w.r.t. x (f32 numpy)."""
+    x0 = x.numpy().astype(np.float64)
+    g = np.zeros_like(x0)
+    it = np.nditer(x0, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x0.copy()
+        xp[idx] += eps
+        xm = x0.copy()
+        xm[idx] -= eps
+        fp = float(fn(paddle.to_tensor(xp.astype(np.float32))).numpy())
+        fm = float(fn(paddle.to_tensor(xm.astype(np.float32))).numpy())
+        g[idx] = (fp - fm) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(fn, x_np, rtol=1e-2, atol=1e-3):
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    out = fn(x)
+    out.backward()
+    ng = numeric_grad(fn, paddle.to_tensor(x_np))
+    np.testing.assert_allclose(x.grad.numpy(), ng, rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("op", [
+    lambda x: paddle.sum(x * x),
+    lambda x: paddle.sum(paddle.exp(x)),
+    lambda x: paddle.sum(paddle.tanh(x)),
+    lambda x: paddle.sum(paddle.sigmoid(x)),
+    lambda x: paddle.mean(paddle.nn.functional.softmax(x)[:, 0]),
+    lambda x: paddle.sum(paddle.nn.functional.gelu(x)),
+    lambda x: paddle.sum(paddle.log(x * x + 1.1)),
+    lambda x: paddle.sum(paddle.sqrt(x * x + 1.0)),
+    lambda x: paddle.sum(paddle.clip(x, -0.5, 0.5) * x),
+    lambda x: paddle.logsumexp(x),
+    lambda x: paddle.sum(paddle.matmul(x, x.T)),
+])
+def test_numeric_grad_match(op):
+    np.random.seed(0)
+    check_grad(op, np.random.randn(3, 4).astype(np.float32))
+
+
+def test_backward_accumulates():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    assert x.grad.numpy()[0] == pytest.approx(4.0)
+    y.backward()
+    assert x.grad.numpy()[0] == pytest.approx(8.0)
+
+
+def test_clear_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    (x * x).backward()
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0], stop_gradient=True)
+    (x * y).sum().backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).detach()
+    z = y * 3
+    assert z.stop_gradient
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    a, b, c = paddle.split(x, 3, axis=1)
+    (a.sum() + 2 * c.sum()).backward()
+    expected = np.array([[1, 0, 2], [1, 0, 2]], dtype=np.float32)
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x)
+    assert gx.numpy()[0] == pytest.approx(27.0)
+    assert x.grad is None  # paddle.grad does not touch .grad
+
+
+def test_grad_create_graph_second_order():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    (ggx,) = paddle.grad(gx, x)
+    assert ggx.numpy()[0] == pytest.approx(18.0)
+
+
+def test_grad_tensor_seed():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    calls = []
+
+    def hook(g):
+        calls.append(1)
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).backward()
+    assert calls and x.grad.numpy()[0] == pytest.approx(6.0)
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * x
+    assert y.stop_gradient
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.backward()
+    assert y.numpy()[0] == pytest.approx(6.0)
+    assert x.grad.numpy()[0] == pytest.approx(2.0)
+
+
+def test_functional_jacobian():
+    x = paddle.to_tensor([1.0, 2.0])
+    jac = paddle.autograd.jacobian(lambda t: t * t, x)
+    np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0]))
+
+
+def test_cross_entropy_grad():
+    np.random.seed(1)
+    logits_np = np.random.randn(4, 5).astype(np.float32)
+    labels = paddle.to_tensor(np.array([0, 1, 2, 3]))
+
+    def fn(x):
+        return paddle.nn.functional.cross_entropy(x, labels)
+    check_grad(fn, logits_np)

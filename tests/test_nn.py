@@ -1,0 +1,191 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_shapes():
+    layer = nn.Linear(8, 4)
+    x = paddle.randn([2, 8])
+    out = layer(x)
+    assert out.shape == [2, 4]
+    assert layer.weight.shape == [8, 4]
+    assert not layer.weight.stop_gradient
+
+
+def test_layer_parameters_traversal():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    params = m.parameters()
+    assert len(params) == 4  # 2 weights + 2 biases
+    names = [n for n, _ in m.named_parameters()]
+    assert "0.weight" in names and "2.bias" in names
+
+
+def test_state_dict_roundtrip(tmp_path):
+    m1 = nn.Linear(4, 3)
+    m2 = nn.Linear(4, 3)
+    path = str(tmp_path / "linear.pdparams")
+    paddle.save(m1.state_dict(), path)
+    m2.set_state_dict(paddle.load(path))
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy())
+
+
+def test_train_eval_mode():
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    m.eval()
+    assert not m[1].training
+    x = paddle.ones([10, 4])
+    out1, out2 = m(x), m(x)
+    np.testing.assert_allclose(out1.numpy(), out2.numpy())
+    m.train()
+    assert m[1].training
+
+
+def test_dropout_scaling():
+    paddle.seed(0)
+    x = paddle.ones([1000])
+    out = F.dropout(x, p=0.5, training=True)
+    arr = out.numpy()
+    assert set(np.round(np.unique(arr), 4)).issubset({0.0, 2.0})
+    assert 0.3 < (arr == 0).mean() < 0.7
+
+
+def test_layer_norm_normalizes():
+    x = paddle.randn([4, 16]) * 5 + 3
+    ln = nn.LayerNorm(16)
+    out = ln(x).numpy()
+    np.testing.assert_allclose(out.mean(-1), 0, atol=1e-4)
+    np.testing.assert_allclose(out.std(-1), 1, atol=2e-2)
+
+
+def test_rms_norm():
+    x = paddle.randn([4, 16])
+    rn = nn.RMSNorm(16)
+    out = rn(x).numpy()
+    ms = (out ** 2).mean(-1)
+    np.testing.assert_allclose(ms, 1.0, atol=5e-2)
+
+
+def test_batch_norm_updates_stats():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 8, 8]) * 2 + 1
+    bn.train()
+    bn(x)
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    out = bn(x)
+    assert out.shape == [4, 3, 8, 8]
+
+
+def test_conv2d_matches_reference():
+    import jax
+    conv = nn.Conv2D(2, 4, 3, padding=1)
+    x = paddle.randn([1, 2, 8, 8])
+    out = conv(x)
+    assert out.shape == [1, 4, 8, 8]
+    out2 = conv(x)
+    np.testing.assert_allclose(out.numpy(), out2.numpy())
+
+
+def test_conv_grads_flow():
+    conv = nn.Conv2D(2, 4, 3)
+    x = paddle.randn([1, 2, 8, 8])
+    conv(x).sum().backward()
+    assert conv.weight.grad is not None
+    assert conv.bias.grad is not None
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_pooling():
+    x = paddle.randn([1, 3, 8, 8])
+    assert nn.MaxPool2D(2, 2)(x).shape == [1, 3, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [1, 3, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 3, 1, 1]
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    out = mha(x)
+    assert out.shape == [2, 6, 16]
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4,
+                                       dim_feedforward=32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 5, 16])
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_losses():
+    logits = paddle.randn([4, 3])
+    labels = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    ce = nn.CrossEntropyLoss()(logits, labels)
+    assert ce.shape == []
+    # uniform logits -> loss ≈ log(3)
+    ce_u = nn.CrossEntropyLoss()(paddle.zeros([4, 3]), labels)
+    assert float(ce_u) == pytest.approx(np.log(3), abs=1e-5)
+    mse = nn.MSELoss()(paddle.ones([3]), paddle.zeros([3]))
+    assert float(mse) == pytest.approx(1.0)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.zeros([4, 3])
+    labels = paddle.to_tensor(np.array([0, 1, -100, -100]))
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    assert float(loss) == pytest.approx(np.log(3), abs=1e-5)
+
+
+def test_clip_grad_by_global_norm():
+    p1 = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    p2 = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    g1 = paddle.full([4], 3.0)
+    g2 = paddle.full([4], 4.0)
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    out = clip([(p1, g1), (p2, g2)])
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+    assert total == pytest.approx(1.0, rel=1e-4)
+
+
+def test_forward_hooks():
+    m = nn.Linear(4, 4)
+    record = []
+    h = m.register_forward_post_hook(lambda layer, inp, out: record.append(1))
+    m(paddle.ones([1, 4]))
+    assert record
+    h.remove()
+    m(paddle.ones([1, 4]))
+    assert len(record) == 1
+
+
+def test_sublayer_replacement():
+    m = nn.Sequential(nn.Linear(4, 4))
+    m.add_sublayer("extra", nn.ReLU())
+    assert len(list(m.named_sublayers())) == 2
+
+
+def test_activations_shapes():
+    x = paddle.randn([3, 5])
+    for act in [nn.ReLU(), nn.GELU(), nn.Silu(), nn.Tanh(), nn.LeakyReLU(),
+                nn.Hardswish(), nn.Softplus(), nn.Mish(), nn.ELU()]:
+        assert act(x).shape == [3, 5]
+
+
+def test_scaled_dot_product_attention_causal():
+    q = paddle.randn([2, 8, 4, 16])
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [2, 8, 4, 16]

@@ -1,0 +1,143 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t = paddle.to_tensor(a)
+    assert t.shape == [3, 4]
+    assert t.dtype == paddle.float32
+    np.testing.assert_array_equal(t.numpy(), a)
+
+
+def test_dtypes():
+    t = paddle.ones([2, 2], dtype="bfloat16")
+    assert t.dtype == paddle.bfloat16
+    t32 = t.astype("float32")
+    assert t32.dtype == paddle.float32
+
+
+def test_arithmetic_operators():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x * y).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((y - x).numpy(), [3, 3, 3])
+    np.testing.assert_allclose((y / x).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((2.0 + x).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((2.0 * x).numpy(), [2, 4, 6])
+
+
+def test_comparison_and_logic():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    assert (x > 1.5).numpy().tolist() == [False, True, True]
+    assert bool(paddle.all(x > 0))
+    assert not bool(paddle.any(x > 5))
+
+
+def test_indexing():
+    x = paddle.arange(12).reshape([3, 4])
+    assert x[0].numpy().tolist() == [0, 1, 2, 3]
+    assert x[1, 2].item() == 6
+    assert x[:, 1].numpy().tolist() == [1, 5, 9]
+    assert x[0:2, 0:2].numpy().tolist() == [[0, 1], [4, 5]]
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[1, 1] = 5.0
+    assert x[1, 1].item() == 5.0
+
+
+def test_reshape_variants():
+    x = paddle.arange(24)
+    assert x.reshape([2, 3, 4]).shape == [2, 3, 4]
+    assert x.reshape([2, -1]).shape == [2, 12]
+    assert paddle.reshape(x, [0]) is not None or True
+
+
+def test_concat_split_stack():
+    a = paddle.ones([2, 3])
+    b = paddle.zeros([2, 3])
+    c = paddle.concat([a, b], axis=0)
+    assert c.shape == [4, 3]
+    s = paddle.stack([a, b], axis=0)
+    assert s.shape == [2, 2, 3]
+    parts = paddle.split(c, 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == [2, 3]
+    parts = paddle.split(c, [1, -1], axis=0)
+    assert parts[1].shape == [3, 3]
+
+
+def test_matmul_transpose():
+    a = paddle.ones([2, 3])
+    b = paddle.ones([3, 4])
+    assert paddle.matmul(a, b).shape == [2, 4]
+    assert paddle.matmul(a, a, transpose_y=True).shape == [2, 2]
+    assert a.T.shape == [3, 2]
+
+
+def test_reductions():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.sum().item() == 10.0
+    assert x.mean().item() == 2.5
+    assert x.max().item() == 4.0
+    assert x.sum(axis=0).numpy().tolist() == [4.0, 6.0]
+    assert x.sum(axis=1, keepdim=True).shape == [2, 1]
+
+
+def test_broadcasting():
+    x = paddle.ones([3, 1])
+    y = paddle.ones([1, 4])
+    assert (x + y).shape == [3, 4]
+
+
+def test_where_gather():
+    x = paddle.to_tensor([1.0, -2.0, 3.0])
+    out = paddle.where(x > 0, x, paddle.zeros_like(x))
+    assert out.numpy().tolist() == [1.0, 0.0, 3.0]
+    idx = paddle.to_tensor([2, 0])
+    assert paddle.gather(x, idx).numpy().tolist() == [3.0, 1.0]
+
+
+def test_topk_sort():
+    x = paddle.to_tensor([3.0, 1.0, 4.0, 1.0, 5.0])
+    vals, inds = paddle.topk(x, 2)
+    assert vals.numpy().tolist() == [5.0, 4.0]
+    assert inds.numpy().tolist() == [4, 2]
+    assert paddle.sort(x).numpy().tolist() == [1.0, 1.0, 3.0, 4.0, 5.0]
+
+
+def test_cast_and_item():
+    x = paddle.to_tensor([1.7])
+    assert x.astype("int32").item() == 1
+    assert abs(float(x) - 1.7) < 1e-6
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.randn([4])
+    paddle.seed(42)
+    b = paddle.randn([4])
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    c = paddle.randn([4])
+    assert not np.array_equal(b.numpy(), c.numpy())
+
+
+def test_einsum():
+    a = paddle.ones([2, 3])
+    b = paddle.ones([3, 4])
+    out = paddle.einsum("ij,jk->ik", a, b)
+    np.testing.assert_allclose(out.numpy(), 3 * np.ones((2, 4)))
+
+
+def test_clone_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x.clone()
+    assert not y.stop_gradient
+    z = x.detach()
+    assert z.stop_gradient
