@@ -1,0 +1,110 @@
+"""Benchmark harness: GPT-2 124M compiled train step on one chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no in-tree numbers (BASELINE.md) — vs_baseline
+compares against the recorded best from prior rounds in BENCH_BASELINE.json
+(1.0 on the first measurement).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import gpt_config
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    # CPU fallback uses a tiny config so the harness still runs in CI
+    if on_tpu:
+        cfg = gpt_config("gpt2-124m", max_seq_len=1024,
+                         use_flash_attention=True)
+        batch, seq, steps, warmup = 8, 1024, 8, 3
+    else:
+        cfg = gpt_config("gpt2-124m", num_layers=2, max_seq_len=256,
+                         use_flash_attention=False)
+        batch, seq, steps, warmup = 2, 256, 3, 2
+
+    paddle.seed(0)
+    with paddle.amp.auto_cast(enable=on_tpu, level="O2",
+                              dtype="bfloat16"):
+        model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                 weight_decay=0.01)
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32)
+    x = paddle.to_tensor(data[:, :-1])
+    y = paddle.to_tensor(data[:, 1:])
+
+    amp_level = "O2" if on_tpu else "O0"
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        with paddle.amp.auto_cast(enable=on_tpu, level=amp_level,
+                                  dtype="bfloat16"):
+            _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    # warmup: eager + discovery + first compiled call
+    for _ in range(warmup):
+        loss = train_step(x, y)
+    jax.block_until_ready(loss._data_)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(x, y)
+    jax.block_until_ready(loss._data_)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    flops_per_token = model.flops_per_token(seq)
+    # v5e peak ~197 TFLOPs bf16; v5p ~459; default to v5e unless told
+    peak = float(os.environ.get("TPU_PEAK_TFLOPS",
+                                "197" if on_tpu else "0.5")) * 1e12
+    mfu = tokens_per_sec * flops_per_token / peak
+
+    baseline_path = os.path.join(os.path.dirname(__file__),
+                                 "BENCH_BASELINE.json")
+    vs_baseline = 1.0
+    try:
+        if os.path.exists(baseline_path):
+            base = json.load(open(baseline_path))
+            if base.get("tokens_per_sec") and base.get("on_tpu") == on_tpu:
+                vs_baseline = tokens_per_sec / base["tokens_per_sec"]
+            else:
+                raise ValueError
+        else:
+            raise FileNotFoundError
+    except Exception:
+        try:
+            json.dump({"tokens_per_sec": tokens_per_sec, "on_tpu": on_tpu,
+                       "mfu": mfu}, open(baseline_path, "w"))
+        except OSError:
+            pass
+
+    print(json.dumps({
+        "metric": "gpt2_124m_train_tokens_per_sec"
+                  if on_tpu else "gpt2_124m_cpu_smoke_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+    print(f"# loss={float(loss):.4f} mfu={mfu:.3f} "
+          f"steps={steps} batch={batch} seq={seq} platform="
+          f"{jax.devices()[0].platform}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

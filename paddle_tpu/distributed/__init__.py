@@ -1,0 +1,33 @@
+"""paddle_tpu.distributed — TPU-native distributed training.
+
+Reference capability surface: python/paddle/distributed/ (collective
+communication, fleet hybrid parallelism, auto_parallel semi-auto SPMD,
+launch).  TPU-native realization: one ProcessMesh, sharding placements, and
+XLA-compiled collectives over ICI/DCN (SURVEY.md §7 layer map).
+"""
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, device_count,
+    local_device_count, is_initialized, ParallelEnv,
+)
+from .mesh import ProcessMesh, init_mesh, get_mesh, set_mesh  # noqa: F401
+from .placement import (  # noqa: F401
+    Placement, Shard, Replicate, Partial, placements_to_spec,
+    spec_to_placements, named_sharding,
+)
+from .api import (  # noqa: F401
+    shard_tensor, dtensor_from_fn, reshard, shard_layer, shard_constraint,
+    unshard_dtensor,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    broadcast, reduce, scatter, reduce_scatter, all_to_all, send, recv,
+    barrier, P2POp, batch_isend_irecv,
+)
+from . import functional  # noqa: F401
+from .topology import (  # noqa: F401
+    HybridCommunicateGroup, set_hybrid_communicate_group,
+    get_hybrid_communicate_group,
+)
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+from . import env  # noqa: F401

@@ -1,0 +1,305 @@
+"""Collective communication API.
+
+Reference capability: python/paddle/distributed/communication/ (all_reduce.py,
+all_gather, all_to_all, reduce_scatter, broadcast, send/recv, new_group) over
+ProcessGroupNCCL (reference: paddle/fluid/distributed/collective/
+process_group.h:53, process_group_nccl.h:37).
+
+TPU-native realization (SURVEY.md §5 "Distributed communication backend"):
+collectives COMPILE INTO the XLA program over ICI/DCN — there is no NCCL
+analog to wrap.  Two surfaces:
+
+1. **Eager process-level API** (this module): rank == JAX process
+   (multi-controller).  Each call assembles the per-process local values into
+   a global array over the group's devices and runs a tiny jitted program
+   containing the XLA collective; with one process it degenerates to the
+   mathematically-equal local computation, so single-host code is unchanged
+   (the reference gets this from ProcessGroup with world_size=1).
+
+2. **In-graph primitives** (`paddle_tpu.distributed.functional`): named-axis
+   psum/all_gather/ppermute/all_to_all for use inside shard_map regions —
+   ring attention, MoE dispatch, explicit-SP layers.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import env as _env
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = an ordered subset of global ranks
+    (reference: python/paddle/distributed/communication/group.py)."""
+
+    _next_id = 0
+
+    def __init__(self, ranks):
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.id = Group._next_id
+        Group._next_id += 1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        """This process's rank within the group, or -1 if not a member."""
+        try:
+            return self.ranks.index(_env.get_rank())
+        except ValueError:
+            return -1
+
+    def get_group_rank(self, global_rank):
+        return self.ranks.index(global_rank)
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+_default_group: Group | None = None
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(list(range(_env.get_world_size())))
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    """reference: python/paddle/distributed/communication/group.py new_group"""
+    if ranks is None:
+        ranks = list(range(_env.get_world_size()))
+    return Group(sorted(ranks))
+
+
+def get_group(gid=0):
+    return _get_default_group()
+
+
+def _as_array(x):
+    if isinstance(x, Tensor):
+        return x._data_
+    return jnp.asarray(x)
+
+
+def _wrap(x, like=None):
+    t = Tensor(x)
+    if like is not None and isinstance(like, Tensor):
+        t.stop_gradient = like.stop_gradient
+    return t
+
+
+def _group_devices(group: Group):
+    """Devices backing the group — one per member process (multi-controller:
+    each process contributes its first addressable device)."""
+    devs = jax.devices()
+    per_proc = {}
+    for d in devs:
+        per_proc.setdefault(d.process_index, d)
+    missing = [r for r in group.ranks if r not in per_proc]
+    if missing:
+        raise RuntimeError(
+            f"group {group} includes ranks {missing} with no visible "
+            f"devices (visible process indices: {sorted(per_proc)})")
+    return [per_proc[r] for r in group.ranks]
+
+
+def _multiproc_collective(local, group, jitted_fn):
+    """Assemble per-process local arrays into a global stacked array over the
+    group's devices, run the collective program, return this rank's slice."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    if group.rank < 0:
+        raise ValueError(
+            f"process rank {_env.get_rank()} is not a member of {group}; "
+            "collectives must only be called by group members (reference: "
+            "ProcessGroup membership contract, process_group.h:53)")
+    devs = _group_devices(group)
+    mesh = Mesh(np.array(devs, dtype=object), axis_names=("g",))
+    stacked_shape = (group.nranks,) + tuple(local.shape)
+    sharding = NamedSharding(mesh, PartitionSpec("g"))
+    garr = jax.make_array_from_single_device_arrays(
+        stacked_shape, sharding,
+        [jax.device_put(local[None], devs[group.rank])])
+    out = jitted_fn(garr, mesh)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce of `tensor` across the group
+    (reference: communication/all_reduce.py)."""
+    group = group or _get_default_group()
+    x = _as_array(tensor)
+    if group.nranks <= 1:
+        return tensor
+    reducer = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+               ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
+               ReduceOp.AVG: jnp.mean}[op]
+
+    def prog(garr, mesh):
+        out = jax.jit(lambda a: reducer(a, axis=0),
+                      out_shardings=jax.sharding.NamedSharding(
+                          mesh, jax.sharding.PartitionSpec()))(garr)
+        return np.asarray(out.addressable_shards[0].data)
+
+    res = _multiproc_collective(x, group, prog)
+    if isinstance(tensor, Tensor):
+        tensor._data_ = jnp.asarray(res)
+        return tensor
+    return _wrap(jnp.asarray(res))
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """Gather `tensor` from every rank into `tensor_list`
+    (reference: communication/all_gather.py)."""
+    group = group or _get_default_group()
+    x = _as_array(tensor)
+    if group.nranks <= 1:
+        if tensor_list is not None:
+            tensor_list.append(_wrap(x, tensor))
+            return tensor_list
+        return [_wrap(x, tensor)]
+
+    def prog(garr, mesh):
+        out = jax.jit(lambda a: a,
+                      out_shardings=jax.sharding.NamedSharding(
+                          mesh, jax.sharding.PartitionSpec()))(garr)
+        return np.asarray(out.addressable_shards[0].data)
+
+    res = _multiproc_collective(x, group, prog)
+    parts = [_wrap(jnp.asarray(res[i])) for i in range(group.nranks)]
+    if tensor_list is not None:
+        tensor_list.extend(parts)
+        return tensor_list
+    return parts
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """reference: communication/broadcast.py"""
+    group = group or _get_default_group()
+    if group.nranks <= 1:
+        return tensor
+    if src not in group.ranks:
+        raise ValueError(
+            f"broadcast src={src} is not a member of {group}")
+    parts = all_gather(None, tensor, group=group)
+    data = parts[group.get_group_rank(src)]._data_
+    if isinstance(tensor, Tensor):
+        tensor._data_ = data
+        return tensor
+    return _wrap(data)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    group = group or _get_default_group()
+    out = all_reduce(tensor, op=op, group=group)
+    return out
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if group.nranks <= 1:
+        if tensor_list:
+            tensor._data_ = _as_array(tensor_list[0])
+        return tensor
+    # src materializes the list; everyone receives its slice via broadcast
+    stacked = None
+    if group.rank == group.get_group_rank(src) and tensor_list:
+        stacked = jnp.stack([_as_array(t) for t in tensor_list])
+    else:
+        stacked = jnp.zeros((group.nranks,) + tuple(_as_array(tensor).shape),
+                            _as_array(tensor).dtype)
+    holder = _wrap(stacked)
+    broadcast(holder, src=src, group=group)
+    tensor._data_ = holder._data_[group.rank]
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """reference: communication/reduce_scatter.py"""
+    group = group or _get_default_group()
+    if group.nranks <= 1:
+        tensor._data_ = _as_array(tensor_list[0])
+        return tensor
+    stacked = jnp.stack([_as_array(t) for t in tensor_list])
+    summed = all_reduce(_wrap(stacked), op=op, group=group)
+    tensor._data_ = summed._data_[group.rank]
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """reference: communication/all_to_all.py"""
+    group = group or _get_default_group()
+    if group.nranks <= 1:
+        out_tensor_list.extend(_wrap(_as_array(t)) for t in in_tensor_list)
+        return out_tensor_list
+    stacked = jnp.stack([_as_array(t) for t in in_tensor_list])
+    gathered = all_gather(None, _wrap(stacked), group=group)
+    me = group.rank
+    for r in range(group.nranks):
+        out_tensor_list.append(_wrap(gathered[r]._data_[me]))
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point send.  Eager p2p between processes is realized as a
+    sub-group broadcast (XLA collective-permute in-graph is the fast path —
+    see functional.ppermute)."""
+    group = group or _get_default_group()
+    if group.nranks <= 1:
+        _P2P_BUF.append(_as_array(tensor))
+        return tensor
+    pair = new_group([_env.get_rank(), dst])
+    return broadcast(tensor, src=_env.get_rank(), group=pair)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if group.nranks <= 1:
+        if _P2P_BUF:
+            tensor._data_ = _P2P_BUF.pop(0)
+        return tensor
+    pair = new_group([src, _env.get_rank()])
+    return broadcast(tensor, src=src, group=pair)
+
+
+_P2P_BUF: list = []
+
+
+def barrier(group=None):
+    """reference: communication/batch_isend_irecv.py barrier"""
+    group = group or _get_default_group()
+    if group.nranks <= 1:
+        return
+    tok = _wrap(jnp.zeros((1,), jnp.float32))
+    all_reduce(tok, group=group)
+    jax.block_until_ready(tok._data_)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op, self.tensor, self.peer, self.group = op, tensor, peer, group
+
+
+def batch_isend_irecv(p2p_op_list):
+    for op in p2p_op_list:
+        op.op(op.tensor, op.peer, group=op.group)
+    return []

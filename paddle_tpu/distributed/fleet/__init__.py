@@ -1,0 +1,28 @@
+"""Fleet: the hybrid-parallel training facade
+(reference: python/paddle/distributed/fleet/)."""
+from .base import (  # noqa: F401
+    init, DistributedStrategy, distributed_model, distributed_optimizer,
+    HybridConfig, UserDefinedRoleMaker, PaddleCloudRoleMaker,
+    worker_index, worker_num, is_first_worker, barrier_worker,
+)
+from ..topology import (  # noqa: F401
+    HybridCommunicateGroup, get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from . import mp_layers  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, ColumnSequenceParallelLinear,
+    RowSequenceParallelLinear, GatherOp, ScatterOp,
+    mark_as_sequence_parallel_parameter,
+)
+from .sharding import (  # noqa: F401
+    DygraphShardingOptimizer, group_sharded_parallel,
+    save_group_sharded_model, shard_parameters, shard_optimizer_states,
+)
+
+
+class meta_parallel:
+    """Namespace parity with fleet.meta_parallel."""
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding, ParallelCrossEntropy)

@@ -1,0 +1,83 @@
+"""In-graph named-axis collective primitives.
+
+Reference capability: the collective PHI kernels (reference:
+paddle/phi/kernels/all_reduce_kernel.h:24, all_gather_kernel.h,
+all_to_all_kernel.h, reduce_scatter_kernel.h, p_send/p_recv) — collectives as
+ordinary ops *inside* graphs, which is how static-graph/auto-parallel Paddle
+composes them.
+
+TPU-native realization: thin wrappers over `jax.lax` collectives, used inside
+`shard_map` regions where a mesh axis name is in scope.  These lower directly
+to ICI collectives; XLA overlaps them with compute.  This is the layer ring
+attention, MoE all-to-all and explicit sequence-parallel layers build on.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce(x, axis_name, op="sum"):
+    """reference: phi/kernels/all_reduce_kernel.h:24"""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    if op == "avg" or op == "mean":
+        return lax.pmean(x, axis_name)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    """Concatenate shards along `axis` (reference:
+    phi/kernels/all_gather_kernel.h)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis=0, tiled=True):
+    """reference: phi/kernels/reduce_scatter_kernel.h"""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=tiled)
+
+
+def all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=True):
+    """MoE dispatch primitive (reference:
+    paddle/fluid/operators/collective/alltoall_op.cc and
+    global_scatter/global_gather)."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def ppermute(x, axis_name, perm):
+    """Neighbor exchange on the ICI ring — the TPU p2p primitive
+    (reference analog: p_send/p_recv kernels, pp_utils/p2p_communication.py).
+    """
+    return lax.ppermute(x, axis_name, perm)
+
+
+def shift_right(x, axis_name, size):
+    """Ring shift src→src+1 (wraps); the ring-attention step."""
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def shift_left(x, axis_name, size):
+    perm = [(i, (i - 1) % size) for i in range(size)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.psum(1, axis_name)
+
+
+def broadcast_from(x, axis_name, src=0):
+    """Select rank src's value everywhere (in-graph broadcast)."""
+    idx = lax.axis_index(axis_name)
+    gathered = lax.all_gather(x, axis_name, axis=0)
+    return gathered[src]

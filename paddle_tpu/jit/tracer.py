@@ -80,7 +80,7 @@ class _DiscoveryTracer:
 class _BindTracer:
     """Active while jax.jit traces the pure wrapper."""
 
-    def __init__(self, host_tracers):
+    def __init__(self, host_tracers, capture_ids=frozenset()):
         self.created = set()
         self.mutated = {}             # id(Tensor) -> pre-write concrete data
         self.mutated_list = []
@@ -88,12 +88,25 @@ class _BindTracer:
         self.host_idx = 0
         self.rng_counter = 0
         self._rng_base_val = None
+        self.capture_ids = capture_ids
 
     def on_create(self, t):
         self.created.add(id(t))
 
     def on_read(self, t):
-        pass
+        # a concrete (non-tracer) read of a tensor that is neither a declared
+        # capture nor created inside this trace would be silently baked into
+        # the program as a constant — a stale-state bug.  Discovery should
+        # have captured it; fail loudly instead.
+        if (id(t) not in self.capture_ids and id(t) not in self.created
+                and id(t) not in self.mutated
+                and not isinstance(t._data_, jax.core.Tracer)):
+            raise RuntimeError(
+                "to_static bind trace read a concrete tensor that was not "
+                "captured at discovery (shape "
+                f"{tuple(t._data_.shape)}, name={t.name!r}). This usually "
+                "means the traced function's control flow diverged between "
+                "calls; its value would be frozen into the compiled program.")
 
     def on_write(self, t):
         i = id(t)
@@ -158,6 +171,9 @@ def _signature(args, kwargs):
     return treedef, tuple(sig)
 
 
+_WARMUP = object()
+
+
 class _CompiledEntry:
     __slots__ = ("captures", "providers", "jitted", "mut_targets",
                  "grad_targets", "out_struct")
@@ -203,6 +219,17 @@ class StaticFunction:
         key = _signature(args, kwargs)
         entry = self._cache.get(key)
         if entry is None:
+            # warm-up: run once fully eager so lazily-initialized persistent
+            # state (optimizer moments, step counters, buffers) exists BEFORE
+            # discovery — otherwise discovery marks it "created" and the bind
+            # trace would bake its current value in as a constant.  The
+            # sentinel is recorded only after a successful eager run: if the
+            # warm-up raises, the next call with this signature warms up
+            # again instead of discovering against half-initialized state.
+            result = self._fn(*args, **kwargs)
+            self._cache[key] = _WARMUP
+            return result
+        if entry is _WARMUP:
             return self._discover(key, args, kwargs)
         return self._run_compiled(entry, args, kwargs)
 
@@ -226,7 +253,8 @@ class StaticFunction:
         fn = self._fn
 
         def pure(arg_arrays, cap_arrays, host_vals, arg_struct):
-            tracer = _BindTracer(host_vals)
+            tracer = _BindTracer(host_vals,
+                                 frozenset(id(t) for t in entry.captures))
             saved = [(t, t._data_) for t in entry.captures]
             bound_args, bound_kwargs = _unflatten_args(arg_arrays, arg_struct)
             for t, arr in zip(entry.captures, cap_arrays):

@@ -3,3 +3,6 @@ from .gpt import (  # noqa: F401
     GPT2_124M, GPT2_350M, GPT3_1_3B, GPT3_6_7B, GPT3_13B,
 )
 from .mlp import MNISTMLP  # noqa: F401
+from .gpt_parallel import (  # noqa: F401
+    ParallelGPTForCausalLM, ParallelGPTModel, ParallelGPTBlock,
+)

@@ -1,0 +1,204 @@
+"""Hybrid-parallel GPT: the flagship model wired for the hybrid mesh.
+
+Reference capability: PaddleNLP GPT-3 trained with Fleet hybrid parallelism
+(TP layers from fleet/layers/mpu/mp_layers.py, sequence parallelism from
+fleet/utils/sequence_parallel_utils.py, DP/sharding from the hybrid
+topology) — the driver's benchmark configs (BASELINE.md 3-5).
+
+TPU-native design: ONE model class whose layers carry mesh placements:
+- attention QKV/out + MLP in/out projections: Column/Row parallel over "mp"
+- embeddings: vocab-parallel over "mp"
+- activations: batch over "dp", sequence over "sep" (context parallel) or
+  "mp" (Megatron-SP between blocks) via sharding constraints
+- ZeRO: params/opt-state sharded over "sharding" by group_sharded_parallel
+The whole train step compiles to one SPMD program; XLA inserts all
+collectives.
+"""
+from __future__ import annotations
+
+import math
+
+from ..nn import Layer, LayerNorm, Dropout, LayerList
+from ..nn import functional as F
+from ..nn.initializer import Normal, ParamAttr
+from ..tensor_ops import manipulation as MA
+from ..tensor_ops import creation
+from ..distributed.fleet.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, ScatterOp)
+from ..distributed.api import shard_constraint
+from ..distributed.mesh import get_mesh
+from .gpt import GPTConfig, gpt_config  # noqa: F401 (re-export)
+
+
+def _constrain_act(x, seq_axis=None):
+    """[b, s, h] activation: batch→dp, optionally seq→seq_axis."""
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    from jax.sharding import PartitionSpec as P
+    entries = [None] * len(x.shape)
+    if "dp" in mesh.dim_names:
+        entries[0] = "dp"
+    if seq_axis and seq_axis in mesh.dim_names and \
+            mesh.get_dim_size(seq_axis) > 1 and len(x.shape) >= 2:
+        entries[1] = seq_axis
+    return shard_constraint(x, mesh, spec=P(*entries))
+
+
+class ParallelGPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        w_init = ParamAttr(initializer=Normal(0.0, config.initializer_range))
+        out_init = ParamAttr(initializer=Normal(
+            0.0, config.initializer_range / math.sqrt(2 * config.num_layers)))
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, weight_attr=w_init,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, weight_attr=out_init,
+                                          input_is_parallel=True)
+
+    def forward(self, x):
+        cfg = self.config
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = MA.reshape(qkv, [b, s, 3, cfg.num_heads, cfg.head_dim])
+        q, k, v = MA.unbind(qkv, axis=2)
+        # heads sharded over mp (dim 2 of [b,s,H,d]) — GSPMD keeps attention
+        # fully local per mp shard, the Megatron layout
+        mesh = get_mesh()
+        if mesh is not None and "mp" in mesh.dim_names:
+            from jax.sharding import PartitionSpec as P
+            spec = P("dp" if "dp" in mesh.dim_names else None, None, "mp",
+                     None)
+            q = shard_constraint(q, mesh, spec=spec)
+            k = shard_constraint(k, mesh, spec=spec)
+            v = shard_constraint(v, mesh, spec=spec)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=cfg.attn_dropout,
+            training=self.training)
+        out = MA.reshape(out, [b, s, h])
+        return self.out_proj(out)
+
+
+class ParallelGPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        w_init = ParamAttr(initializer=Normal(0.0, config.initializer_range))
+        out_init = ParamAttr(initializer=Normal(
+            0.0, config.initializer_range / math.sqrt(2 * config.num_layers)))
+        self.fc_in = ColumnParallelLinear(h, m, weight_attr=w_init,
+                                          gather_output=False)
+        self.fc_out = RowParallelLinear(m, h, weight_attr=out_init,
+                                        input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+
+
+class ParallelGPTBlock(Layer):
+    def __init__(self, config: GPTConfig, sequence_parallel=False):
+        super().__init__()
+        self.sequence_parallel = sequence_parallel
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.attn = ParallelGPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.mlp = ParallelGPTMLP(config)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        # between blocks: keep activations seq-sharded (Megatron-SP over mp
+        # when sequence_parallel, else context-parallel over sep)
+        return _constrain_act(
+            x, seq_axis="mp" if self.sequence_parallel else "sep")
+
+
+class ParallelGPTModel(Layer):
+    def __init__(self, config: GPTConfig, sequence_parallel=False):
+        super().__init__()
+        self.config = config
+        emb_init = ParamAttr(initializer=Normal(0.0, config.initializer_range))
+        self.wte = VocabParallelEmbedding(config.vocab_size,
+                                          config.hidden_size,
+                                          weight_attr=emb_init)
+        self.wpe = VocabParallelEmbedding(config.max_seq_len,
+                                          config.hidden_size,
+                                          weight_attr=emb_init)
+        self.drop = Dropout(config.dropout)
+        self.h = LayerList([ParallelGPTBlock(config, sequence_parallel)
+                            for _ in range(config.num_layers)])
+        self.ln_f = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = creation.arange(s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(_constrain_act(x, seq_axis="sep"))
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class ParallelGPTForCausalLM(Layer):
+    """GPT with TP/SP/DP/ZeRO-ready layout.  Use with fleet:
+
+        fleet.init(strategy)                 # builds the hybrid mesh
+        model = ParallelGPTForCausalLM(cfg)
+        fleet.distributed_model(model)       # commits placements
+    """
+
+    def __init__(self, config: GPTConfig, sequence_parallel=False):
+        super().__init__()
+        self.config = config
+        self.gpt = ParallelGPTModel(config, sequence_parallel)
+        self.loss_fn = ParallelCrossEntropy()
+
+    def forward(self, input_ids, labels=None, position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        logits = F.linear(hidden, self.gpt.wte.weight.T)
+        mesh = get_mesh()
+        if mesh is not None and "mp" in mesh.dim_names:
+            from jax.sharding import PartitionSpec as P
+            entries = [None] * len(logits.shape)
+            if "dp" in mesh.dim_names:
+                entries[0] = "dp"
+            entries[-1] = "mp"  # class dim sharded (vocab-parallel logits)
+            logits = shard_constraint(logits, mesh, spec=P(*entries))
+        if labels is not None:
+            from ..tensor_ops import logic as LO
+            from ..tensor_ops import reduction as RE
+            from ..tensor_ops import math as MM
+            flat_labels = MA.reshape(labels, [-1])
+            # per-token loss is already zero at ignore_index positions; the
+            # mean must divide by the NON-ignored count to match the serial
+            # model's cross_entropy(reduction='mean') denominator
+            per_token = self.loss_fn(
+                MA.reshape(logits, [-1, self.config.vocab_size]),
+                flat_labels)
+            valid = MA.cast(
+                LO.not_equal(flat_labels,
+                             creation.full([], self.loss_fn.ignore_index,
+                                           flat_labels.dtype)),
+                "float32")
+            n_valid = MM.clip(RE.sum(valid), min=1.0)
+            loss = RE.sum(per_token) / n_valid
+            return logits, loss
+        return logits
+
+    def num_params(self, non_embedding=True):
+        n = sum(p.size for p in self.parameters())
+        if non_embedding:
+            n -= self.gpt.wpe.weight.size
+        return n
+
+    def flops_per_token(self, seq_len=None):
+        cfg = self.config
+        s = seq_len or cfg.max_seq_len
+        return 6 * self.num_params() + \
+            12 * cfg.num_layers * cfg.hidden_size * s

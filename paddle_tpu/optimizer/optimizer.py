@@ -73,10 +73,16 @@ class Optimizer:
             self._step_tensor = Tensor(jnp.zeros((), jnp.float32))
         if self._state:
             return
+        # ZeRO-1: fleet.sharding installs a commit hook so accumulators are
+        # born sharded over the sharding axis (reference analog:
+        # dygraph_sharding_optimizer.py:39 rank-bucketed moment ownership)
+        commit = getattr(self, "_accumulator_commit_hook", None)
         for name, init in self._state_spec():
             self._state[name] = []
             for p in self._parameter_list:
                 v = init(p)
+                if v is not None and commit is not None:
+                    v = commit(v)
                 self._state[name].append(None if v is None else Tensor(v))
 
     def _master_weight_needed(self, p):

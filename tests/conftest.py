@@ -3,13 +3,19 @@ CI-testable without TPUs (reference analog: fake_cpu_device.h pluggable
 fake device — SURVEY.md §4)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU. The session env pins JAX_PLATFORMS=axon (single tunneled TPU
+# chip) and sitecustomize imports jax + registers the axon PJRT plugin in
+# every python process BEFORE conftest runs — so env vars are too late;
+# jax.devices() on the axon platform would block claiming the one chip.
+# jax.config.update works post-import (backends aren't initialized yet),
+# and XLA_FLAGS is read at CPU client creation, so setting it here works.
+import jax  # noqa: E402 (already imported by sitecustomize under axon)
+
+jax.config.update("jax_platforms", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
 
 # the backend here defaults matmuls to reduced precision; numeric-grad
 # comparisons need true f32 matmuls
