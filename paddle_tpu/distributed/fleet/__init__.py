@@ -20,9 +20,9 @@ from .sharding import (  # noqa: F401
     DygraphShardingOptimizer, group_sharded_parallel,
     save_group_sharded_model, shard_parameters, shard_optimizer_states,
 )
-
-
-class meta_parallel:
-    """Namespace parity with fleet.meta_parallel."""
-    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
-                            VocabParallelEmbedding, ParallelCrossEntropy)
+from . import meta_parallel  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    LayerDesc, SharedLayerDesc, PipelineLayer, PipelineParallel,
+    PipelineParallelWithInterleave, TensorParallel, SegmentParallel,
+    ShardingParallel,
+)

@@ -114,13 +114,26 @@ def _commit_params(model, mesh, shard_axis=None):
 
 
 def distributed_model(model):
-    """reference: fleet/model.py:31 — wraps in
-    Sharding/Segment/Tensor/Pipeline parallel; on TPU all of those reduce to
-    committing parameter shardings over the one hybrid mesh."""
+    """reference: fleet/model.py:31 — dispatches to the
+    Sharding/Segment/Tensor/Pipeline parallel wrapper by topology (:132-154).
+    On TPU each wrapper reduces to committing parameter shardings over the
+    one hybrid mesh; PipelineLayer models get the micro-batch scheduler."""
     if not _fleet_state["initialized"]:
         init()
     mesh = get_mesh()
     strategy = _fleet_state["strategy"]
+    hcg = get_hybrid_communicate_group()
+
+    from .meta_parallel import (PipelineLayer, PipelineParallel,
+                                PipelineParallelWithInterleave)
+    if isinstance(model, PipelineLayer):
+        # PipelineLayer committed its own stage placements at build time
+        if model._num_chunks > 1:
+            return PipelineParallelWithInterleave(model, hcg=hcg,
+                                                  strategy=strategy)
+        if model.get_num_stages() > 1:
+            return PipelineParallel(model, hcg=hcg, strategy=strategy)
+
     shard_axis = None
     if strategy is not None and (strategy.sharding
                                  or strategy.sharding_configs.get(

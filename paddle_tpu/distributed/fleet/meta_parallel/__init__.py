@@ -1,0 +1,12 @@
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
+from .pipeline_parallel import (  # noqa: F401
+    PipelineParallel, PipelineParallelWithInterleave,
+)
+from .segment_parallel import SegmentParallel  # noqa: F401
+from .tensor_parallel import TensorParallel  # noqa: F401
+from .sharding_parallel import ShardingParallel  # noqa: F401
+from ..mp_layers import (  # noqa: F401 — namespace parity with the
+    # reference's fleet.meta_parallel re-exports
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)

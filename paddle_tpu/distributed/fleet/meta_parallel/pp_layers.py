@@ -1,0 +1,240 @@
+"""Pipeline-parallel model description: LayerDesc / PipelineLayer.
+
+Reference capability: `PipelineLayer`/`LayerDesc`/`SharedLayerDesc`
+(reference: fleet/meta_parallel/parallel_layers/pp_layers.py:237,56) —
+a model declared as a flat list of layer descriptors, partitioned into
+`num_stages` contiguous segments, each segment owned by one pipeline rank;
+interleaved scheduling splits a stage into virtual chunks
+(`PipelineLayerChunk` :211).
+
+TPU-native realization: single-controller SPMD means every stage is visible
+to the one program.  A "stage" is a contiguous slice of layers whose
+parameters are committed to that stage's sub-mesh (the pp-slice of the hybrid
+mesh) — XLA places each stage's compute on its own devices and turns the
+stage-boundary activation hand-off into an ICI device-to-device copy (the
+p2p_communication.py analog, but compiled).  The 1F1B/interleaved *order* is
+imposed by the host scheduler in pipeline_parallel.py.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ....nn.layer import Layer
+from ....nn.containers import LayerList
+from ...mesh import ProcessMesh, get_mesh
+from ...placement import Replicate, Shard, commit_param, named_sharding
+
+
+class LayerDesc:
+    """Deferred layer constructor (reference: pp_layers.py:56)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"{layer_cls} must be a paddle_tpu.nn.Layer")
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer whose parameters are shared between pipeline stages
+    (reference: pp_layers.py SharedLayerDesc — e.g. tied embeddings).  On
+    TPU the sharing is literal: both stages reference the same param, and
+    it is committed replicated across the pp axis."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+def _stage_submesh(mesh: ProcessMesh, stage: int) -> ProcessMesh:
+    """The pp-slice of the hybrid mesh owning `stage` (other axes kept)."""
+    if mesh is None or "pp" not in mesh.dim_names:
+        return mesh
+    idx = mesh.dim_names.index("pp")
+    devs = np.asarray(mesh.jax_mesh.devices, dtype=object)
+    sub = np.moveaxis(devs, idx, 0)[stage]
+    names = [n for n in mesh.dim_names if n != "pp"]
+    return ProcessMesh(sub, names)
+
+
+def segment_uniform(num_items, num_parts):
+    """Balanced contiguous partition: item counts differ by at most 1
+    (reference: pp_layers.py SegmentLayers uniform strategy)."""
+    base, rem = divmod(num_items, num_parts)
+    bounds = [0]
+    for i in range(num_parts):
+        bounds.append(bounds[-1] + base + (1 if i < rem else 0))
+    return bounds
+
+
+def segment_by_layer(descs, num_parts, layer_name):
+    """'layer:Pattern' strategy — split so each part gets an equal share of
+    the layers whose class name matches `layer_name`."""
+    weights = [1 if re.search(layer_name, type(d).__name__
+                              if not isinstance(d, LayerDesc)
+                              else d.layer_cls.__name__) else 0
+               for d in descs]
+    total = sum(weights)
+    if total == 0:
+        return segment_uniform(len(descs), num_parts)
+    per = segment_uniform(total, num_parts)
+    bounds, acc, part = [0], 0, 1
+    for i, w in enumerate(weights):
+        acc += w
+        while part < num_parts and acc >= per[part] + 1 \
+                and len(bounds) <= part:
+            bounds.append(i)
+            part += 1
+    while len(bounds) < num_parts:
+        bounds.append(len(descs))
+    bounds.append(len(descs))
+    return bounds[:num_parts + 1]
+
+
+class PipelineLayer(Layer):
+    """reference: pp_layers.py:237.
+
+    layers      — list of LayerDesc / Layer instances / callables
+    num_stages  — pipeline depth (defaults to the mesh pp degree)
+    seg_method  — "uniform" or "layer:ClassNamePattern"
+    num_virtual_pipeline_stages — chunks per stage for interleaved 1F1B
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 seg_method="uniform", loss_fn=None,
+                 num_virtual_pipeline_stages=1, recompute_interval=0):
+        super().__init__()
+        mesh = get_mesh()
+        if num_stages is None:
+            num_stages = (mesh.get_dim_size("pp")
+                          if mesh is not None and "pp" in mesh.dim_names
+                          else 1)
+        self._num_stages = num_stages
+        self._num_chunks = num_virtual_pipeline_stages
+        self._loss_fn = loss_fn
+        self._mesh = mesh
+        self._descs = list(layers)
+
+        built = []
+        self._shared_layers = {}
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared_layers:
+                    layer = self._shared_layers[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared_layers[d.layer_name] = layer
+                built.append((layer, d.forward_func, True))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None, False))
+            elif isinstance(d, Layer):
+                built.append((d, None, False))
+            elif callable(d):
+                built.append((d, None, False))
+            else:
+                raise TypeError(f"cannot build pipeline item {d!r}")
+
+        n_parts = num_stages * self._num_chunks
+        if seg_method.startswith("layer:"):
+            bounds = segment_by_layer(self._descs, n_parts,
+                                      seg_method.split("layer:", 1)[1])
+        else:
+            bounds = segment_uniform(len(built), n_parts)
+        self._segment_bounds = bounds
+        # chunk c of stage s is part index  c*num_stages + s  (interleave
+        # order, reference pp_layers.py:211 PipelineLayerChunk)
+        self._parts = [built[bounds[i]:bounds[i + 1]]
+                       for i in range(n_parts)]
+        # register as sublayers for parameters()/state_dict
+        self.run_function = LayerList(
+            [item for part in self._parts for item, _, _ in part
+             if isinstance(item, Layer)])
+        self._submeshes = [_stage_submesh(mesh, s)
+                           for s in range(num_stages)] \
+            if (mesh is not None and "pp" in mesh.dim_names
+                and mesh.get_dim_size("pp") > 1) else []
+        self._commit_stage_placements()
+
+    # ---- stage/partition introspection (reference parity) ----
+    def get_num_stages(self):
+        return self._num_stages
+
+    def get_stage_from_index(self, idx):
+        for part_id in range(len(self._parts)):
+            lo, hi = self._segment_bounds[part_id], \
+                self._segment_bounds[part_id + 1]
+            if lo <= idx < hi:
+                return part_id % self._num_stages
+        raise IndexError(idx)
+
+    def stage_layers(self, stage, chunk=0):
+        return self._parts[chunk * self._num_stages + stage]
+
+    def _commit_stage_placements(self):
+        """Commit each stage's parameters onto its pp sub-mesh; shared layers
+        (tied embeddings) stay replicated over pp."""
+        mesh = self._mesh
+        if mesh is None or "pp" not in mesh.dim_names \
+                or mesh.get_dim_size("pp") <= 1:
+            return
+        shared_ids = {id(p) for layer in self._shared_layers.values()
+                      for p in layer.parameters()}
+        for part_id, part in enumerate(self._parts):
+            stage = part_id % self._num_stages
+            sub = self._submeshes[stage]
+            for item, _, _ in part:
+                if not isinstance(item, Layer):
+                    continue
+                for p in item.parameters():
+                    if id(p) in shared_ids:
+                        commit_param(p, mesh)  # replicated incl. pp
+                        continue
+                    placements = [Replicate() for _ in sub.dim_names]
+                    ann = getattr(p, "mp_placement", None)
+                    if ann is not None and ann[0] in sub.dim_names:
+                        placements[sub.dim_names.index(ann[0])] = ann[1]
+                    commit_param(p, sub, placements)
+                    p.pp_stage = stage
+
+    def forward(self, x, chunk_id=None):
+        """Global-view forward: all stages in order, with the activation
+        re-committed to the next stage's sub-mesh at each boundary (the
+        compiled-away analog of p2p send/recv)."""
+        from .pipeline_parallel import _to_stage_mesh
+        mesh = self._mesh
+        pp_on = (mesh is not None and "pp" in mesh.dim_names
+                 and mesh.get_dim_size("pp") > 1)
+        parts = self._parts
+        if chunk_id is not None:
+            parts = [self._parts[chunk_id * self._num_stages + s]
+                     for s in range(self._num_stages)]
+        current = None
+        for part_id, part in enumerate(parts):
+            stage = part_id % self._num_stages
+            for item, fwd, is_shared in part:
+                if pp_on:
+                    # shared layers (tied embeddings) are replicated over the
+                    # FULL mesh incl. pp — run them there; stage-owned layers
+                    # run on the stage sub-mesh.  Re-commit only on change
+                    # of residence (device_put = the compiled p2p).
+                    target = mesh if is_shared else self._submeshes[stage]
+                    if target is not current:
+                        x = _to_stage_mesh(x, target)
+                        current = target
+                if fwd is not None:
+                    x = fwd(item, x)
+                elif isinstance(item, Layer) or callable(item):
+                    x = item(x)
+        return x

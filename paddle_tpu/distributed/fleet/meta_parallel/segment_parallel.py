@@ -1,0 +1,24 @@
+"""SegmentParallel (sep) wrapper (reference: fleet/meta_parallel/
+segment_parallel.py:26 — syncs params across the sep group at init).
+
+On TPU `sep` is a mesh axis; activations are sharded over it along the
+sequence dim inside attention (ring attention / all-to-all CP in
+paddle_tpu.distributed.context_parallel), while params stay replicated over
+sep — which this wrapper commits."""
+from __future__ import annotations
+
+from ....nn.layer import Layer
+from ...mesh import get_mesh
+
+
+class SegmentParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        from ..base import _commit_params
+        mesh = get_mesh()
+        if mesh is not None:
+            _commit_params(layers, mesh)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)

@@ -1,0 +1,28 @@
+"""ShardingParallel wrapper (reference: fleet/meta_parallel/
+sharding_parallel.py — ZeRO entry of distributed_model).
+
+On TPU this commits ZeRO placements: params sharded over the sharding axis
+(stage 3) or left replicated with sharded optimizer state (stages 1/2) —
+see fleet.sharding for the layout story."""
+from __future__ import annotations
+
+from ....nn.layer import Layer
+from ...mesh import get_mesh
+
+
+class ShardingParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        from ..base import _commit_params
+        stage = 1
+        if strategy is not None:
+            stage = int(getattr(strategy, "sharding_configs",
+                                {}).get("stage", 1))
+        mesh = get_mesh()
+        if mesh is not None:
+            _commit_params(layers, mesh,
+                           shard_axis="sharding" if stage >= 3 else None)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)

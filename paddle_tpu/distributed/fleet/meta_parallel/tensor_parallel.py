@@ -1,0 +1,23 @@
+"""TensorParallel model wrapper (reference: fleet/meta_parallel/
+tensor_parallel.py — broadcasts params/inputs within the mp group).
+
+On TPU the wrapper only commits parameter shardings: TP layers carry
+`mp_placement` annotations and the single SPMD program needs no broadcast
+(replication over mp IS the broadcast, performed once at commit)."""
+from __future__ import annotations
+
+from ....nn.layer import Layer
+from ...mesh import get_mesh
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        from ..base import _commit_params
+        mesh = get_mesh()
+        if mesh is not None:
+            _commit_params(layers, mesh)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)

@@ -129,21 +129,42 @@ class Optimizer:
             self._update_jit = jax.jit(
                 functools.partial(type(self)._fused_update, self),
                 static_argnames=("lr_scales", "wd_mask"))
-        new_params, new_states = self._update_jit(
-            lr, new_step, params, grads, states, lr_scales=lr_scales,
-            wd_mask=wd_mask)
-        for j, i in enumerate(idxs):
-            self._parameter_list[i]._data = new_params[j]
-        for name in self._state:
-            vals = self._state[name]
-            for j, i in enumerate(idxs):
-                nv = new_states[name][j]
-                if nv is None:
-                    continue
-                if vals[i] is None:
-                    vals[i] = Tensor(nv)
-                else:
-                    vals[i]._data = nv
+
+        # one jitted program cannot mix device sets — pipeline stages place
+        # params on disjoint sub-meshes, so run the fused update per
+        # device-set group (still one compiled program per stage)
+        def _devset(j):
+            arr = params[j]
+            sh = getattr(arr, "sharding", None)
+            if sh is None:
+                return ()
+            return tuple(sorted(d.id for d in sh.device_set))
+
+        groups = {}
+        for j in range(len(idxs)):
+            groups.setdefault(_devset(j), []).append(j)
+
+        for sel in groups.values():
+            g_states = {name: [vals[j] for j in sel]
+                        for name, vals in states.items()}
+            new_params, new_states = self._update_jit(
+                lr, new_step,
+                [params[j] for j in sel], [grads[j] for j in sel],
+                g_states,
+                lr_scales=tuple(lr_scales[j] for j in sel),
+                wd_mask=tuple(wd_mask[j] for j in sel))
+            for k, j in enumerate(sel):
+                i = idxs[j]
+                self._parameter_list[i]._data = new_params[k]
+                for name in self._state:
+                    vals = self._state[name]
+                    nv = new_states[name][k]
+                    if nv is None:
+                        continue
+                    if vals[i] is None:
+                        vals[i] = Tensor(nv)
+                    else:
+                        vals[i]._data = nv
 
     def _wd_applies(self, p):
         """Whether decoupled/coupled weight decay applies to this param."""

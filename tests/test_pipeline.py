@@ -1,0 +1,176 @@
+"""Pipeline-parallel tests (reference strategy: parallel vs replicated
+single-rank numerics, SURVEY.md §4 — hybrid_parallel_pp_layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import (
+    LayerDesc, SharedLayerDesc, PipelineLayer, PipelineParallel,
+)
+from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+    segment_uniform,
+)
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    dist.set_mesh(None)
+
+
+def _pp_strategy(pp=4, accumulate_steps=2):
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": -1, "mp_degree": 1, "pp_degree": pp,
+                        "sharding_degree": 1, "sep_degree": 1}
+    s.pipeline = True
+    s.pipeline_configs = {"accumulate_steps": accumulate_steps,
+                          "micro_batch_size": 2}
+    return s
+
+
+def test_segment_uniform():
+    assert segment_uniform(8, 4) == [0, 2, 4, 6, 8]
+    assert segment_uniform(10, 4) == [0, 3, 6, 8, 10]
+    assert segment_uniform(3, 4) == [0, 1, 2, 3, 3]
+
+
+def _build_serial(seed=7):
+    paddle.seed(seed)
+    return nn.Sequential(
+        nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 16), nn.Tanh(),
+        nn.Linear(16, 16), nn.Tanh(), nn.Linear(16, 8))
+
+
+def _build_pipeline(seed=7, loss_fn=None):
+    paddle.seed(seed)
+    descs = [
+        LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.Tanh),
+        LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Tanh),
+        LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Tanh),
+        LayerDesc(nn.Linear, 16, 8),
+    ]
+    return PipelineLayer(descs, num_stages=4, loss_fn=loss_fn)
+
+
+def test_pipeline_layer_partition_and_placement():
+    fleet.init(strategy=_pp_strategy(pp=4))
+    pipe = _build_pipeline()
+    assert pipe.get_num_stages() == 4
+    # 7 items over 4 stages: [2,2,2,1]
+    sizes = [len(pipe.stage_layers(s)) for s in range(4)]
+    assert sizes == [2, 2, 2, 1]
+    # stage params live on DIFFERENT device subsets
+    dev0 = {d.id for d in
+            pipe.stage_layers(0)[0][0].weight._data_.sharding.device_set}
+    dev3 = {d.id for d in
+            pipe.stage_layers(3)[0][0].weight._data_.sharding.device_set}
+    assert dev0.isdisjoint(dev3)
+
+
+def test_pipeline_forward_matches_serial():
+    serial = _build_serial()
+    fleet.init(strategy=_pp_strategy(pp=4))
+    pipe = _build_pipeline()
+    for p_p, p_s in zip(pipe.parameters(), serial.parameters()):
+        p_p.set_value(p_s.numpy())
+    pipe._commit_stage_placements()
+    x = paddle.randn([4, 8])
+    ref = serial(x)
+    out = pipe(x)
+    np.testing.assert_allclose(np.asarray(out._data_), ref.numpy(),
+                               rtol=2e-5, atol=1e-6)
+
+
+def test_pipeline_train_batch_matches_grad_accumulation():
+    """train_batch (1F1B over 4 micro-batches) == serial whole-batch step."""
+    def mse(out, y):
+        return ((out - y) ** 2).mean()
+
+    serial = _build_serial()
+    opt_s = paddle.optimizer.SGD(0.1, parameters=serial.parameters())
+
+    fleet.init(strategy=_pp_strategy(pp=4, accumulate_steps=4))
+    pipe = _build_pipeline(loss_fn=mse)
+    for p_p, p_s in zip(pipe.parameters(), serial.parameters()):
+        p_p.set_value(p_s.numpy())
+    pipe._commit_stage_placements()
+    model = fleet.distributed_model(pipe)
+    assert isinstance(model, PipelineParallel)
+    opt_p = paddle.optimizer.SGD(0.1, parameters=pipe.parameters())
+
+    x = paddle.randn([8, 8])
+    y = paddle.randn([8, 8])
+
+    loss_s = mse(serial(x), y)
+    loss_s.backward()
+    opt_s.step()
+    opt_s.clear_grad()
+
+    loss_p = model.train_batch((x, y), opt_p)
+    np.testing.assert_allclose(float(loss_p), float(loss_s), rtol=1e-5)
+    for p_p, p_s in zip(pipe.parameters(), serial.parameters()):
+        np.testing.assert_allclose(np.asarray(p_p._data_), p_s.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_shared_layer_desc_ties_parameters():
+    """SharedLayerDesc shares one layer instance across stages (tied
+    embeddings pattern) and keeps it replicated over pp."""
+    fleet.init(strategy=_pp_strategy(pp=2))
+
+    class Emb(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter((8, 8))
+
+        def forward(self, x):
+            return x @ self.weight
+
+    def head_fwd(layer, x):
+        return x @ layer.weight.T
+
+    descs = [
+        SharedLayerDesc("embed", Emb),
+        LayerDesc(nn.Tanh),
+        SharedLayerDesc("embed", Emb, forward_func=head_fwd),
+    ]
+    pipe = PipelineLayer(descs, num_stages=2)
+    embeds = [item for part in pipe._parts for item, _, _ in part
+              if isinstance(item, Emb)]
+    assert embeds[0] is embeds[1]
+    x = paddle.randn([4, 8])
+    out = pipe(x)
+    assert tuple(out.shape) == (4, 8)
+
+
+def test_interleaved_pipeline_runs():
+    fleet.init(strategy=_pp_strategy(pp=2, accumulate_steps=2))
+    paddle.seed(0)
+    descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(8)]
+    pipe = PipelineLayer(descs, num_stages=2, loss_fn=lambda o, y:
+                         ((o - y) ** 2).mean(),
+                         num_virtual_pipeline_stages=2)
+    model = fleet.distributed_model(pipe)
+    from paddle_tpu.distributed.fleet import PipelineParallelWithInterleave
+    assert isinstance(model, PipelineParallelWithInterleave)
+    opt = paddle.optimizer.SGD(0.001, parameters=pipe.parameters())
+
+    # serial reference: same 8 linear layers applied in order
+    paddle.seed(0)
+    serial = nn.Sequential(*[nn.Linear(8, 8) for _ in range(8)])
+    for p_p, p_s in zip(pipe.parameters(), serial.parameters()):
+        p_s.set_value(np.asarray(p_p._data_))
+    opt_s = paddle.optimizer.SGD(0.001, parameters=serial.parameters())
+
+    x = paddle.randn([4, 8])
+    y = paddle.randn([4, 8])
+    l_p = model.train_batch((x, y), opt)
+    l_s = ((serial(x) - y) ** 2).mean()
+    l_s.backward(); opt_s.step(); opt_s.clear_grad()
+    np.testing.assert_allclose(float(l_p), float(l_s), rtol=1e-5)
+    for p_p, p_s in zip(pipe.parameters(), serial.parameters()):
+        np.testing.assert_allclose(np.asarray(p_p._data_), p_s.numpy(),
+                                   rtol=1e-4, atol=1e-5)
