@@ -1,0 +1,3 @@
+"""Incubating APIs (reference capability: python/paddle/incubate/)."""
+from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

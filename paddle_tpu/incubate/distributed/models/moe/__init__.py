@@ -1,0 +1,3 @@
+from .moe_layer import MoELayer, ExpertFFN  # noqa: F401
+from .gate import BaseGate, NaiveGate, GShardGate, SwitchGate  # noqa: F401
+from .grad_clip import ClipGradForMOEByGlobalNorm  # noqa: F401

@@ -1,0 +1,22 @@
+"""Top-k linear gate (reference capability: moe/gate/naive_gate.py —
+linear scoring + topk, no capacity logic)."""
+from __future__ import annotations
+
+from ......nn import Linear
+from ......tensor_ops import search as SE
+from .base_gate import BaseGate
+
+
+class NaiveGate(BaseGate):
+    def __init__(self, d_model, num_expert, world_size, topk=2):
+        super().__init__(num_expert, world_size)
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp, return_all_scores=False):
+        gate = self.gate(inp)
+        gate_top_k_val, gate_top_k_idx = SE.topk(
+            gate, k=self.top_k, axis=-1, largest=True, sorted=False)
+        if return_all_scores:
+            return gate_top_k_val, gate_top_k_idx, gate
+        return gate_top_k_val, gate_top_k_idx

