@@ -31,3 +31,7 @@ from .topology import (  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from . import env  # noqa: F401
+from . import context_parallel  # noqa: F401
+from .context_parallel import (  # noqa: F401
+    ring_flash_attention, ulysses_attention, split_sequence,
+)
