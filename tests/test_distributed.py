@@ -179,9 +179,9 @@ def test_eager_collectives_world1():
 def test_in_graph_collectives_shard_map():
     """functional.* inside shard_map over the 8-device mesh."""
     try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
     import jax.numpy as jnp
     from paddle_tpu.distributed import functional as CF
