@@ -27,9 +27,9 @@ import math
 import jax
 import jax.numpy as jnp
 from jax import lax
-try:
-    from jax import shard_map
-except ImportError:  # older jax
+import warnings as _warnings
+with _warnings.catch_warnings():
+    _warnings.simplefilter("ignore", DeprecationWarning)
     from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
