@@ -35,3 +35,13 @@ from . import context_parallel  # noqa: F401
 from .context_parallel import (  # noqa: F401
     ring_flash_attention, ulysses_attention, split_sequence,
 )
+from . import checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    save_state_dict, load_state_dict, DistributedSaver,
+)
+from . import launch  # noqa: F401
+from . import spawn as spawn_mod  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv,
+)

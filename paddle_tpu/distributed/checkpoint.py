@@ -1,0 +1,116 @@
+"""Distributed (sharded) checkpointing with reshard-on-load.
+
+Reference capability: `DistributedSaver` (reference:
+auto_parallel/static/dist_saver.py:53-154 — saves rank-local programs +
+dist_attrs, re-slices on load via `Converter` for changed meshes), sharded
+fleet save/load (`GroupShardedOptimizerStage2.state_dict`, test
+dygraph_dist_save_load.py), and `paddle.save/load` parity for single-host.
+
+TPU-native realization: orbax-checkpoint writes each array shard from the
+host(s) that own it (OCDBT/zarr layout) and restores directly INTO a target
+sharding — the reference's Converter re-slicing becomes a restore-time
+`jax.sharding` annotation, so mesh changes between save and load need no
+extra machinery.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+    return ocp
+
+
+def _flatten_state(obj, prefix=""):
+    """Nested dict/list of Tensors → flat {key: jax.Array}."""
+    flat = {}
+    if isinstance(obj, Tensor):
+        flat[prefix or "value"] = obj
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            flat.update(_flatten_state(v, f"{prefix}.{k}" if prefix else
+                                       str(k)))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            flat.update(_flatten_state(v, f"{prefix}.{i}" if prefix else
+                                       str(i)))
+    elif obj is not None and prefix:
+        flat[prefix] = obj
+    return flat
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, async_save=False):
+    """Sharded save: every host writes only the shards it owns
+    (reference analog: DistributedSaver.save, dist_saver.py:53)."""
+    ocp = _ocp()
+    flat = _flatten_state(state_dict)
+    arrays = {k: (v._data_ if isinstance(v, Tensor) else np.asarray(v))
+              for k, v in flat.items()}
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, arrays, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, offload=False):
+    """In-place sharded load WITH resharding: each array is restored
+    directly into the sharding currently committed on the passed
+    state_dict's tensors (reference analog: Converter re-slice on load,
+    static/converter.py)."""
+    ocp = _ocp()
+    flat = _flatten_state(state_dict)
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+
+    targets = {}
+    for k, v in flat.items():
+        if isinstance(v, Tensor):
+            arr = v._data_
+            targets[k] = jax.ShapeDtypeStruct(arr.shape, arr.dtype,
+                                              sharding=arr.sharding)
+        else:
+            a = np.asarray(v)
+            targets[k] = jax.ShapeDtypeStruct(a.shape, a.dtype)
+    restored = ckptr.restore(path, targets)
+    for k, v in flat.items():
+        if isinstance(v, Tensor):
+            v._data_ = restored[k]
+    return state_dict
+
+
+class DistributedSaver:
+    """reference: auto_parallel/static/dist_saver.py:53."""
+
+    def save(self, path, state_dict=None, program=None, **kwargs):
+        return save_state_dict(state_dict or {}, path)
+
+    def load(self, path, state_dict=None, load_optimizer=True, **kwargs):
+        return load_state_dict(state_dict or {}, path)
+
+
+def save_model_and_optimizer(model, optimizer, path, async_save=False):
+    """Convenience: one sharded checkpoint holding model + optimizer state
+    (the reference's fleet save_for_auto_infer / pp_parallel_adaptor
+    use-cases collapse to this on TPU — placements travel with arrays)."""
+    state = {"model": model.state_dict(),
+             "optimizer": optimizer.state_dict() if optimizer else {}}
+    return save_state_dict(state, path, async_save=async_save)
+
+
+def load_model_and_optimizer(model, optimizer, path):
+    state = {"model": model.state_dict(),
+             "optimizer": optimizer.state_dict() if optimizer else {}}
+    load_state_dict(state, path)
+    model.set_state_dict(state["model"])
+    if optimizer:
+        optimizer.set_state_dict(state["optimizer"])
+    return model, optimizer

@@ -1,0 +1,124 @@
+"""Elastic training: node liveness, scale events, relaunch protocol.
+
+Reference capability: `ElasticManager` (reference:
+fleet/elastic/manager.py:126) — etcd-backed node registration with TTL
+keepalive (:39), watch on the node prefix (:237-242), fault-tolerance
+levels via PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL (:178), and relaunch with
+ELASTIC_EXIT_CODE=101 (:32) when membership changes.
+
+TPU-native realization: the store is pluggable — a filesystem directory
+(every TPU pod host shares NFS/GCS or local disk in tests; heartbeat files
+with mtime TTL) stands in for etcd, which is not in this image.  The
+watch loop + exit-code relaunch protocol match the reference so the
+launcher's restart loop (launch/controller.py ELASTIC_EXIT_CODE) composes.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+ELASTIC_EXIT_CODE = 101
+ELASTIC_TIMEOUT = 60
+
+
+class FileStore:
+    """Heartbeat store over a shared directory (the etcd stand-in)."""
+
+    def __init__(self, root, ttl=10):
+        self.root = root
+        self.ttl = ttl
+        os.makedirs(root, exist_ok=True)
+
+    def register(self, node_id):
+        self.heartbeat(node_id)
+
+    def heartbeat(self, node_id):
+        path = os.path.join(self.root, f"node.{node_id}")
+        with open(path, "w") as f:
+            f.write(str(time.time()))
+
+    def deregister(self, node_id):
+        try:
+            os.remove(os.path.join(self.root, f"node.{node_id}"))
+        except FileNotFoundError:
+            pass
+
+    def alive_nodes(self):
+        now = time.time()
+        out = []
+        for name in os.listdir(self.root):
+            if not name.startswith("node."):
+                continue
+            p = os.path.join(self.root, name)
+            try:
+                with open(p) as f:
+                    ts = float(f.read().strip() or 0)
+            except (OSError, ValueError):
+                continue
+            if now - ts <= self.ttl:
+                out.append(name[len("node."):])
+        return sorted(out)
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """reference: fleet/elastic/manager.py:126."""
+
+    def __init__(self, node_id=None, np=1, store=None, store_root=None,
+                 ttl=10, heartbeat_interval=2.0):
+        self.node_id = str(node_id if node_id is not None
+                           else os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.np = np
+        self.store = store or FileStore(
+            store_root or os.environ.get("PADDLE_ELASTIC_STORE",
+                                         "/tmp/pt_elastic"), ttl=ttl)
+        self.interval = heartbeat_interval
+        self.level = int(os.environ.get(
+            "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1"))
+        self._stop = threading.Event()
+        self._thread = None
+        self._baseline = None
+
+    # ---- liveness ----
+    def start(self):
+        self.store.register(self.node_id)
+        self._baseline = self.store.alive_nodes()
+        self._thread = threading.Thread(target=self._beat_loop, daemon=True)
+        self._thread.start()
+
+    def _beat_loop(self):
+        while not self._stop.is_set():
+            self.store.heartbeat(self.node_id)
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.store.deregister(self.node_id)
+
+    # ---- membership watch (reference watch :237-242) ----
+    def watch(self):
+        """One poll: returns an ElasticStatus."""
+        alive = self.store.alive_nodes()
+        if self._baseline is None:
+            self._baseline = alive
+            return ElasticStatus.HOLD
+        if alive == self._baseline:
+            return ElasticStatus.HOLD
+        if len(alive) < self.np and self.level <= 1:
+            return ElasticStatus.ERROR
+        # scale up/down → rebuild rendezvous and relaunch
+        self._baseline = alive
+        return ElasticStatus.RESTART
+
+    def exit_code(self, status):
+        return ELASTIC_EXIT_CODE if status == ElasticStatus.RESTART else 1

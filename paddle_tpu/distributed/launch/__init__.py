@@ -1,0 +1,4 @@
+"""Multi-process / multi-host launcher
+(reference: python/paddle/distributed/launch/)."""
+from .context import Context  # noqa: F401
+from .controller import CollectiveController, launch  # noqa: F401

@@ -1,0 +1,68 @@
+"""Launch context: argument parsing + node/cluster description.
+
+Reference capability: launch/context (reference:
+python/paddle/distributed/launch/context/__init__.py — args, node info,
+event loop) and the env-var contract PADDLE_TRAINER_* consumed by
+fleet.init / init_parallel_env.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+
+
+def free_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch distributed training "
+                    "(reference: paddle.distributed.launch)")
+    p.add_argument("--master", default=None,
+                   help="coordinator ip:port (defaults to local free port)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", default=None,
+                   help="visible device ids for each local process")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_timeout", type=int, default=30)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class Context:
+    def __init__(self, args=None, argv=None):
+        self.args = args or parse_args(argv)
+        self.node_ip = os.environ.get("POD_IP", "127.0.0.1")
+
+    def world_size(self):
+        return self.args.nnodes * self.args.nproc_per_node
+
+    def global_rank(self, local_rank):
+        return self.args.node_rank * self.args.nproc_per_node + local_rank
+
+    def proc_env(self, local_rank, master):
+        """The PADDLE_TRAINER_* contract + JAX multi-controller vars."""
+        rank = self.global_rank(local_rank)
+        world = self.world_size()
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_MASTER": master,
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_JOB_ID": self.args.job_id,
+            "RANK": str(rank),
+            "WORLD_SIZE": str(world),
+            "COORDINATOR_ADDRESS": master,
+        })
+        return env

@@ -1,0 +1,98 @@
+"""Collective controller: spawn, watch, restart local worker processes.
+
+Reference capability: launch controllers (reference:
+launch/controllers/collective.py — builds pod of N procs with the env
+contract; controllers/watcher.py monitors; master.py KV rendezvous) and the
+relaunch-on-failure loop (fleet/elastic ELASTIC_EXIT_CODE protocol).
+
+TPU-native notes: one process per host is the JAX multi-controller model
+(all local chips belong to that process), so nproc_per_node>1 is for CPU
+testing; rendezvous is jax.distributed.initialize against the coordinator
+address instead of a bespoke TCPStore.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from .context import Context, free_port
+
+ELASTIC_EXIT_CODE = 101  # reference: fleet/elastic/manager.py:32
+
+
+class CollectiveController:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.procs = []
+        master = ctx.args.master
+        if master is None:
+            master = f"127.0.0.1:{free_port()}"
+        self.master = master
+
+    def _spawn_one(self, local_rank):
+        args = self.ctx.args
+        env = self.ctx.proc_env(local_rank, self.master)
+        cmd = [sys.executable, args.training_script,
+               *args.training_script_args]
+        stdout = stderr = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            rank = self.ctx.global_rank(local_rank)
+            log = open(os.path.join(args.log_dir,
+                                    f"worker.{rank}.log"), "ab")
+            stdout = stderr = log
+        return subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr)
+
+    def run(self):
+        args = self.ctx.args
+        restarts = 0
+        while True:
+            self.procs = [self._spawn_one(i)
+                          for i in range(args.nproc_per_node)]
+            codes = self._watch()
+            if all(c == 0 for c in codes):
+                return 0
+            if any(c == ELASTIC_EXIT_CODE for c in codes) \
+                    and restarts < args.max_restart:
+                restarts += 1
+                continue
+            return max(codes)
+
+    def _watch(self):
+        """Wait for all procs; if one fails, terminate the rest (the
+        watcher/pod-failure policy of controllers/watcher.py)."""
+        codes = [None] * len(self.procs)
+        try:
+            while any(c is None for c in codes):
+                for i, p in enumerate(self.procs):
+                    if codes[i] is None:
+                        c = p.poll()
+                        if c is not None:
+                            codes[i] = c
+                            if c != 0:
+                                self._terminate(exclude=i)
+                                for j, q in enumerate(self.procs):
+                                    if codes[j] is None:
+                                        codes[j] = q.wait()
+                                return codes
+                time.sleep(0.2)
+        except KeyboardInterrupt:
+            self._terminate()
+            raise
+        return codes
+
+    def _terminate(self, exclude=None):
+        for i, p in enumerate(self.procs):
+            if i != exclude and p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+
+
+def launch(argv=None):
+    ctx = Context(argv=argv)
+    return CollectiveController(ctx).run()

@@ -1,0 +1,46 @@
+"""paddle.distributed.spawn analog (reference:
+python/paddle/distributed/spawn.py — fork N workers running `func(rank)`
+with the parallel-env contract set up)."""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+from .launch.context import free_port
+
+
+def _worker(func, rank, nprocs, master, args, backend):
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_MASTER": master,
+        "RANK": str(rank),
+        "WORLD_SIZE": str(nprocs),
+        "COORDINATOR_ADDRESS": master,
+    })
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, backend=None,
+          **options):
+    """Spawn `nprocs` processes running func; returns the context
+    (reference parity: paddle.distributed.spawn)."""
+    if nprocs == 1:
+        _worker(func, 0, 1, "", args, backend)
+        return None
+    ctx = mp.get_context("spawn")
+    master = f"127.0.0.1:{free_port()}"
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, master, args, backend),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode != 0]
+        if bad:
+            raise RuntimeError(f"spawned workers failed: exit codes {bad}")
+    return procs

@@ -1,0 +1,275 @@
+"""Profiler: host spans + device (XLA/TPU) tracing.
+
+Reference capability: `paddle.profiler.Profiler` (reference:
+python/paddle/profiler/profiler.py:346 — `start` :558, scheduler states
+:79, chrome-trace export via profiler/utils.py:215 and C++
+chrometracing_logger.cc; host tracer host_tracer.cc records RecordEvent
+spans; cuda_tracer.cc records CUPTI GPU activity).
+
+TPU-native realization: two planes, mirroring the reference's host/device
+split —
+- host plane: `RecordEvent` spans recorded in-process (this module) and
+  exported as Chrome trace JSON (chrome://tracing / Perfetto-loadable);
+- device plane: `jax.profiler` xplane capture (TensorBoard/xprof-loadable),
+  started/stopped with the same scheduler — XLA's profiler is the CUPTI
+  analog on TPU.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+
+
+class ProfilerState(Enum):
+    """reference: profiler.py:79 scheduler states."""
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1      # accepted for parity; maps to the device plane
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """reference: profiler.py make_scheduler — step-phase state machine."""
+    total = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+class _HostEventBuffer:
+    """The host_tracer analog: thread-safe span buffer."""
+
+    def __init__(self):
+        self._events = []
+        self._lock = threading.Lock()
+
+    def add(self, name, ts_us, dur_us, tid, event_type):
+        with self._lock:
+            self._events.append(
+                {"name": name, "ph": "X", "ts": ts_us, "dur": dur_us,
+                 "pid": os.getpid(), "tid": tid,
+                 "cat": event_type})
+
+    def drain(self):
+        with self._lock:
+            ev, self._events = self._events, []
+        return ev
+
+
+_HOST_BUFFER = _HostEventBuffer()
+_ACTIVE = []
+
+
+class RecordEvent:
+    """User-scope span (reference: profiler/utils.py RecordEvent over C++
+    event_tracing.h).  Usable as context manager or begin()/end()."""
+
+    def __init__(self, name, event_type="UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def end(self):
+        if self._t0 is None:
+            return
+        t1 = time.perf_counter_ns()
+        if _ACTIVE:
+            _HOST_BUFFER.add(self.name, self._t0 / 1e3,
+                             (t1 - self._t0) / 1e3,
+                             threading.get_ident() % 2 ** 31,
+                             self.event_type)
+        self._t0 = None
+
+    __enter__ = begin
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+class Profiler:
+    """reference: profiler.py:346.
+
+    targets    — [ProfilerTarget.CPU, ProfilerTarget.TPU]
+    scheduler  — (start, end) tuple or a make_scheduler callable
+    on_trace_ready — callback(prof) at RECORD_AND_RETURN steps
+    """
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None):
+        self.targets = targets or [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            self.scheduler = make_scheduler(
+                closed=max(start, 0), ready=0, record=end - start, repeat=1)
+        elif scheduler is None:
+            self.scheduler = lambda step: ProfilerState.RECORD
+        else:
+            self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.state = ProfilerState.CLOSED
+        self._events = []
+        self._device_dir = None
+        self._device_active = False
+        self._step_spans = []
+        self._step_t0 = None
+
+    # ---- lifecycle (reference: start :558 / stop / step) ----
+    def start(self):
+        self.state = self.scheduler(self.step_num)
+        self._transition(ProfilerState.CLOSED, self.state)
+        self._step_t0 = time.perf_counter_ns()
+        return self
+
+    def stop(self):
+        self._transition(self.state, ProfilerState.CLOSED)
+        self.state = ProfilerState.CLOSED
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        if self._step_t0 is not None:
+            t1 = time.perf_counter_ns()
+            self._step_spans.append(
+                {"name": f"ProfileStep#{self.step_num}", "ph": "X",
+                 "ts": self._step_t0 / 1e3,
+                 "dur": (t1 - self._step_t0) / 1e3,
+                 "pid": os.getpid(), "tid": 0, "cat": "ProfileStep",
+                 "args": ({"num_samples": num_samples}
+                          if num_samples else {})})
+        old = self.state
+        self.step_num += 1
+        self.state = self.scheduler(self.step_num)
+        self._transition(old, self.state)
+        if old == ProfilerState.RECORD_AND_RETURN and self.on_trace_ready:
+            self.on_trace_ready(self)
+        self._step_t0 = time.perf_counter_ns()
+
+    def _transition(self, old, new):
+        recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if old not in recording and new in recording:
+            _ACTIVE.append(self)
+            if not self.timer_only:
+                self._start_device_trace()
+        elif old in recording and new not in recording:
+            if self in _ACTIVE:
+                _ACTIVE.remove(self)
+            self._events.extend(_HOST_BUFFER.drain())
+            self._stop_device_trace()
+
+    # ---- device plane (xplane via jax.profiler) ----
+    def _start_device_trace(self):
+        if ProfilerTarget.TPU not in self.targets and \
+                ProfilerTarget.GPU not in self.targets:
+            return
+        import tempfile
+        import jax
+        self._device_dir = tempfile.mkdtemp(prefix="pt_xplane_")
+        try:
+            jax.profiler.start_trace(self._device_dir)
+            self._device_active = True
+        except Exception:
+            self._device_active = False
+
+    def _stop_device_trace(self):
+        if self._device_active:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_active = False
+
+    # ---- export ----
+    def export(self, path, format="json"):  # noqa: A002
+        if format in ("json", "chrometracing"):
+            export_chrome_tracing_data(self, path)
+        else:
+            export_protobuf(self, path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        from .profiler_statistic import summary as _summary
+        return _summary(self, time_unit=time_unit)
+
+    @property
+    def events(self):
+        return self._events + self._step_spans
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def export_chrome_tracing_data(prof: Profiler, path):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    trace = {"traceEvents": prof.events,
+             "displayTimeUnit": "ms",
+             "metadata": {"xplane_dir": prof._device_dir}}
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready factory (reference: profiler/utils.py:215)."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof):
+        name = worker_name or f"host_{os.getpid()}"
+        export_chrome_tracing_data(
+            prof, os.path.join(dir_name,
+                               f"{name}_{int(time.time() * 1000)}.json"))
+
+    return handler
+
+
+def export_protobuf(prof_or_dir, path=None):
+    """Parity entry point: the device plane is already a protobuf xplane
+    dump under prof._device_dir (jax.profiler); link it."""
+    if path is None:
+        return prof_or_dir
+    prof = prof_or_dir
+    with open(path, "w") as f:
+        json.dump({"xplane_dir": prof._device_dir,
+                   "host_events": prof.events}, f)
+    return path
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,42 @@
+"""Profiler summary tables (reference capability:
+python/paddle/profiler/profiler_statistic.py — aggregated per-name tables
+sorted by total/avg time)."""
+from __future__ import annotations
+
+from enum import Enum
+
+
+class SortedKeys(Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+
+
+def summary(prof, time_unit="ms", sorted_by=SortedKeys.CPUTotal):
+    """Aggregate host spans per event name into a text table."""
+    scale = {"s": 1e-6, "ms": 1e-3, "us": 1.0}[time_unit]
+    agg = {}
+    for ev in prof.events:
+        a = agg.setdefault(ev["name"], {"total": 0.0, "count": 0,
+                                        "max": 0.0,
+                                        "min": float("inf")})
+        dur = ev.get("dur", 0.0)
+        a["total"] += dur
+        a["count"] += 1
+        a["max"] = max(a["max"], dur)
+        a["min"] = min(a["min"], dur)
+
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]["total"])
+    header = (f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+              f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}")
+    lines = [header, "-" * len(header)]
+    for name, a in rows:
+        lines.append(
+            f"{name[:39]:<40}{a['count']:>8}"
+            f"{a['total'] * scale:>14.3f}"
+            f"{a['total'] / max(a['count'], 1) * scale:>12.3f}"
+            f"{a['max'] * scale:>12.3f}")
+    return "\n".join(lines)

@@ -1,0 +1,127 @@
+"""Throughput / step-time benchmarking + MFU.
+
+Reference capability: profiler/timer.py (`benchmark()` hub with
+reader/batch cost and ips) and fleet's step timers
+(fleet/utils/timer_helper.py:48); the MFU calculator is the TPU-side
+"north star" metric (SURVEY §6).
+"""
+from __future__ import annotations
+
+import time
+
+
+class _Event:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None:
+            self.total += time.perf_counter() - self._t0
+            self.count += 1
+            self._t0 = None
+
+    @property
+    def avg(self):
+        return self.total / max(self.count, 1)
+
+
+class TimerHub:
+    """reference: timer_helper.py get_timers() pattern."""
+
+    def __init__(self):
+        self._timers = {}
+
+    def __call__(self, name):
+        if name not in self._timers:
+            self._timers[name] = _Event()
+        return self._timers[name]
+
+    def log(self, names=None, normalizer=1.0, reset=True):
+        names = names or list(self._timers)
+        parts = []
+        for n in names:
+            t = self._timers.get(n)
+            if t is None:
+                continue
+            parts.append(f"{n}: {t.total * 1000 / normalizer:.2f}ms")
+            if reset:
+                t.reset()
+        return " | ".join(parts)
+
+
+class Benchmark:
+    """reference: profiler/timer.py benchmark() — reader/batch cost + ips."""
+
+    def __init__(self):
+        self.reader = _Event()
+        self.batch = _Event()
+        self._samples = 0
+        self._t_start = None
+
+    def begin(self):
+        self._t_start = time.perf_counter()
+        self.reader.reset()
+        self.batch.reset()
+        self._samples = 0
+
+    def before_reader(self):
+        self.reader.start()
+
+    def after_reader(self):
+        self.reader.stop()
+        self.batch.start()
+
+    def after_step(self, num_samples=1):
+        self.batch.stop()
+        self._samples += num_samples
+
+    def step_info(self, unit="samples"):
+        ips = self._samples / max(self.batch.total, 1e-12)
+        return (f"reader_cost: {self.reader.avg * 1000:.3f} ms "
+                f"batch_cost: {self.batch.avg * 1000:.3f} ms "
+                f"ips: {ips:.2f} {unit}/s")
+
+    @property
+    def ips(self):
+        return self._samples / max(self.batch.total, 1e-12)
+
+
+_BENCH = Benchmark()
+
+
+def benchmark():
+    return _BENCH
+
+
+# peak bf16 FLOP/s per chip by TPU generation (public spec sheet numbers)
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "cpu": 1e12,      # nominal, keeps MFU finite in CI
+}
+
+
+def device_peak_flops(device=None):
+    import jax
+    d = device or jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    for key, val in _PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return _PEAK_FLOPS["v5e" if d.platform in ("tpu", "axon") else "cpu"]
+
+
+def mfu(model_flops_per_step, step_time_s, n_devices=1, device=None):
+    """Model FLOPs utilization: achieved / peak."""
+    peak = device_peak_flops(device) * n_devices
+    return model_flops_per_step / max(step_time_s, 1e-12) / peak

@@ -1,0 +1,78 @@
+"""Launcher / spawn / elastic tests (reference: test_run.py, elastic
+manager unit tests with fake etcd — here the FileStore stand-in)."""
+import os
+import sys
+import time
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.launch.context import Context, parse_args, \
+    free_port
+from paddle_tpu.distributed.launch.controller import (
+    CollectiveController, ELASTIC_EXIT_CODE,
+)
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus, FileStore,
+)
+
+
+def test_parse_args_and_env_contract():
+    args = parse_args(["--nproc_per_node", "2", "--nnodes", "2",
+                       "--node_rank", "1", "train.py", "--lr", "0.1"])
+    ctx = Context(args=args)
+    assert ctx.world_size() == 4
+    env = ctx.proc_env(1, "127.0.0.1:1234")
+    assert env["PADDLE_TRAINER_ID"] == "3"
+    assert env["WORLD_SIZE"] == "4"
+    assert env["PADDLE_MASTER"] == "127.0.0.1:1234"
+    assert args.training_script == "train.py"
+    assert args.training_script_args == ["--lr", "0.1"]
+
+
+def test_launch_runs_workers(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "open(os.path.join(os.path.dirname(__file__),\n"
+        "     f'out.{rank}'), 'w').write('ok')\n")
+    args = parse_args(["--nproc_per_node", "2", str(script)])
+    ctx = Context(args=args)
+    code = CollectiveController(ctx).run()
+    assert code == 0
+    assert (tmp_path / "out.0").exists()
+    assert (tmp_path / "out.1").exists()
+
+
+def test_launch_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    args = parse_args(["--nproc_per_node", "2", str(script)])
+    code = CollectiveController(Context(args=args)).run()
+    assert code == 3
+
+
+def test_elastic_manager_watch(tmp_path):
+    store = FileStore(str(tmp_path / "store"), ttl=5)
+    m1 = ElasticManager(node_id="0", np=2, store=store,
+                        heartbeat_interval=0.1)
+    m1.start()
+    assert m1.watch() == ElasticStatus.HOLD
+    # a second node joins → membership change → RESTART (scale event)
+    store.register("1")
+    status = m1.watch()
+    assert status == ElasticStatus.RESTART
+    assert m1.exit_code(status) == ELASTIC_EXIT_CODE
+    # stable again
+    assert m1.watch() == ElasticStatus.HOLD
+    m1.stop()
+    assert "0" not in store.alive_nodes()
+
+
+def test_spawn_single_process():
+    result = {}
+
+    def fn(val):
+        result["got"] = val
+
+    dist.spawn_mod.spawn(fn, args=(42,), nprocs=1)
+    assert result["got"] == 42
