@@ -50,6 +50,9 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.shm_slot_size = 16 << 20  # 16 MiB per batch slot
         self.prefetch_factor = max(prefetch_factor, 2)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -90,7 +93,77 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
             return
+        if self.use_shared_memory:
+            try:
+                yield from self._iter_multiprocess()
+                return
+            except ImportError:
+                pass
+            except OSError:
+                pass  # g++/shm unavailable → threaded fallback
         yield from self._iter_threaded()
+
+    def _iter_multiprocess(self):
+        """Worker PROCESSES + the native shared-memory ring queue
+        (reference: dataloader_iter.py:358 _DataLoaderIterMultiProcess with
+        use_shared_memory=True over the C++ blocking queue)."""
+        import multiprocessing as mp
+        from .shm_queue import ShmQueue, QueueClosed
+        from ..utils.cpp_extension import BuildError
+
+        all_batches = list(enumerate(self.batch_sampler))
+        n_batches = len(all_batches)
+        if n_batches == 0:
+            return
+        nw = self.num_workers
+        try:
+            out_q = ShmQueue(capacity=max(2 * nw, 4),
+                             slot_size=self.shm_slot_size)
+        except BuildError as e:
+            raise OSError(str(e))
+
+        ctx = mp.get_context("fork")
+
+        def worker(worker_id):
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(worker_id)
+            try:
+                for i, indices in all_batches[worker_id::nw]:
+                    batch = self._fetch_numpy(indices)
+                    out_q.put((i, batch), timeout=0)
+            except (QueueClosed, KeyboardInterrupt):
+                pass
+
+        procs = [ctx.Process(target=worker, args=(w,), daemon=True)
+                 for w in range(nw)]
+        for p in procs:
+            p.start()
+        pending = {}
+        try:
+            for want in range(n_batches):
+                while want not in pending:
+                    if all(p.exitcode not in (None, 0) for p in procs):
+                        raise RuntimeError(
+                            "all DataLoader workers died; see stderr")
+                    try:
+                        i, batch = out_q.get(timeout=5.0)
+                    except TimeoutError:
+                        continue
+                    pending[i] = batch
+                yield self.collate_fn(pending.pop(want))
+        finally:
+            out_q.close()
+            for p in procs:
+                p.join(timeout=2)
+                if p.exitcode is None:
+                    p.terminate()
+            out_q.release()
+
+    def _fetch_numpy(self, indices):
+        """Worker-side fetch: keep samples as numpy/python (picklable,
+        device-free) — collation to device Tensors happens in the trainer
+        process (matches the reference's worker → trainer split)."""
+        return [self.dataset[i] for i in indices]
 
     def _iter_threaded(self):
         """Prefetch with a worker thread pool + bounded queue (the

@@ -1,0 +1,96 @@
+"""JIT C++ extension builder (ctypes-based).
+
+Reference capability: `paddle.utils.cpp_extension` (reference:
+python/paddle/utils/cpp_extension/ — setuptools + JIT `load()` builds of
+`PD_BUILD_OP` custom ops).  pybind11 is not available in this image, so the
+TPU build exposes a C ABI contract instead: sources export plain C
+functions, `load()` compiles them with g++ into a cached .so and returns a
+`ctypes.CDLL`.  This is the build path for the framework's own native
+components (csrc/) and for user custom ops.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sysconfig
+
+
+DEFAULT_CACHE = os.path.join(
+    os.path.expanduser(os.environ.get("PADDLE_EXTENSION_DIR",
+                                      "~/.cache/paddle_tpu_extensions")))
+
+
+class BuildError(RuntimeError):
+    pass
+
+
+def _hash_key(sources, cflags, ldflags):
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(cflags).encode())
+    h.update(" ".join(ldflags).encode())
+    return h.hexdigest()[:16]
+
+
+def load(name, sources, extra_cflags=None, extra_ldflags=None,
+         extra_include_paths=None, build_directory=None, verbose=False,
+         with_python=False):
+    """Compile `sources` into <cache>/<name>-<hash>.so and dlopen it.
+
+    Returns a ctypes.CDLL.  Rebuilds only when sources/flags change
+    (reference: cpp_extension.load JIT semantics)."""
+    sources = [os.path.abspath(s) for s in sources]
+    cflags = ["-O3", "-fPIC", "-std=c++17", "-shared", "-pthread"]
+    cflags += extra_cflags or []
+    inc = list(extra_include_paths or [])
+    if with_python:
+        inc.append(sysconfig.get_paths()["include"])
+    ldflags = ["-lpthread", "-lrt"] + (extra_ldflags or [])
+
+    cache = build_directory or DEFAULT_CACHE
+    os.makedirs(cache, exist_ok=True)
+    so_path = os.path.join(
+        cache, f"{name}-{_hash_key(sources, cflags, ldflags)}.so")
+    if not os.path.exists(so_path):
+        cmd = (["g++"] + cflags + [f"-I{p}" for p in inc]
+               + sources + ["-o", so_path] + ldflags)
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=300)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise BuildError(f"g++ invocation failed: {e}") from e
+        if r.returncode != 0:
+            raise BuildError(
+                f"build of {name} failed:\n{r.stderr[-4000:]}")
+    return ctypes.CDLL(so_path)
+
+
+# ---- setuptools-style parity surface (reference: cpp_extension/setup) ----
+class CppExtension:
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+CUDAExtension = CppExtension  # accepted, builds CPU-side (no CUDA on TPU)
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Build every extension eagerly into the cache (JIT-style stand-in for
+    the reference's setuptools command)."""
+    built = {}
+    for ext in ext_modules or []:
+        built[name or "ext"] = load(name or "ext", ext.sources,
+                                    **ext.kwargs)
+    return built
+
+
+def get_include():
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "csrc")
