@@ -91,6 +91,10 @@ from . import utils  # noqa: F401,E402
 from .utils.flags import set_flags, get_flags  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from .hapi import Model  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
 
 
 def disable_static(place=None):

@@ -1,0 +1,217 @@
+"""Keras-like high-level Model API.
+
+Reference capability: `hapi.Model` (reference: python/paddle/hapi/
+model.py:1052 — prepare/fit/evaluate/predict/save/load over a dygraph or
+static network, with callbacks and metrics).
+
+TPU-native realization: the train step is the eager framework step (jit
+compilation comes from `paddle.jit.to_static` on the step when
+`prepare(..., jit=True)`), input pipeline is io.DataLoader.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+
+class Model:
+    """reference: hapi/model.py:1052."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # ---- configuration ----
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit=False):
+        self._optimizer = optimizer
+        self._loss = loss
+        metrics = metrics or []
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        self._metrics = metrics
+        self._jit = jit
+        self._train_fn = self._train_step
+        if jit:
+            from ..jit import to_static
+            self._train_fn = to_static(self._train_step)
+        return self
+
+    # ---- steps ----
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            raise RuntimeError("prepare(loss=...) before fit/evaluate")
+        return self._loss(outputs, labels)
+
+    def _train_step(self, x, y):
+        out = self.network(x)
+        loss = self._compute_loss(out, y)
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return loss, out
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        loss, out = self._train_fn(x, y)
+        return [float(np.asarray(loss._data_))]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core.state import no_grad
+        self.network.eval()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        with no_grad():
+            out = self.network(x)
+            loss = self._compute_loss(out, y)
+        return [float(np.asarray(loss._data_))], out
+
+    def predict_batch(self, inputs):
+        from ..core.state import no_grad
+        self.network.eval()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        with no_grad():
+            return self.network(x)
+
+    # ---- loops ----
+    def _as_loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        loader = self._as_loader(train_data, batch_size, shuffle)
+        eval_loader = self._as_loader(eval_data, batch_size, False)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbs = config_callbacks(callbacks, self, epochs=epochs, steps=steps,
+                               verbose=verbose, save_freq=save_freq,
+                               save_dir=save_dir,
+                               metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        cbs.call("on_train_begin")
+        history = {"loss": []}
+        it = 0
+        for epoch in range(epochs):
+            cbs.call("on_epoch_begin", epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                x, y = self._split_batch(batch)
+                cbs.call("on_train_batch_begin", step)
+                loss = self.train_batch(x, y)
+                logs = {"loss": loss[0]}
+                for m in self._metrics:
+                    out = self.predict_batch(x)
+                    m.update(*m.compute(out, y))
+                    logs[m.name()] = m.accumulate()
+                cbs.call("on_train_batch_end", step, logs)
+                it += 1
+                if num_iters and it >= num_iters:
+                    break
+            history["loss"].append(logs.get("loss"))
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _callbacks=cbs)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbs.call("on_epoch_end", epoch, logs)
+            if self.stop_training or (num_iters and it >= num_iters):
+                break
+        cbs.call("on_train_end", logs)
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None,
+                 _callbacks=None):
+        loader = self._as_loader(eval_data, batch_size, False)
+        cbs = _callbacks or config_callbacks(callbacks, self,
+                                             verbose=verbose)
+        cbs.call("on_eval_begin")
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            x, y = self._split_batch(batch)
+            loss, out = self.eval_batch(x, y)
+            losses.append(loss[0])
+            for m in self._metrics:
+                m.update(*m.compute(out, y))
+            cbs.call("on_eval_batch_end", step, {"loss": loss[0]})
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        cbs.call("on_eval_end", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False)
+        outs = []
+        for batch in loader:
+            x, _ = self._split_batch(batch, allow_no_label=True)
+            outs.append(self.predict_batch(x))
+        if stack_outputs:
+            import jax.numpy as jnp
+            return Tensor(jnp.concatenate([o._data_ for o in outs]))
+        return outs
+
+    @staticmethod
+    def _split_batch(batch, allow_no_label=False):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return batch[0], batch[1]
+            if allow_no_label:
+                return batch[0], None
+        return batch, None
+
+    # ---- persistence ----
+    def save(self, path, training=True):
+        from ..framework.io import save
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load
+        self.network.set_state_dict(load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(int(np.prod(p.shape))
+                       for p in self.network.parameters())
+        lines = [f"{type(self.network).__name__}: "
+                 f"{n_params:,} parameters"]
+        for name, sub in self.network.named_sublayers():
+            sub_n = sum(int(np.prod(p.shape)) for p in sub.parameters(
+                include_sublayers=False))
+            if sub_n:
+                lines.append(f"  {name} ({type(sub).__name__}): {sub_n:,}")
+        text = "\n".join(lines)
+        print(text)
+        return {"total_params": n_params}

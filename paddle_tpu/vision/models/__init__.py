@@ -1,0 +1,6 @@
+from .lenet import LeNet  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    BasicBlock, BottleneckBlock,
+)
+from .mobilenet import MobileNetV1, mobilenet_v1  # noqa: F401

@@ -1,0 +1,24 @@
+"""LeNet (reference capability: python/paddle/vision/models/lenet.py —
+the book-test MNIST CNN)."""
+from __future__ import annotations
+
+from ...nn import (Layer, Sequential, Conv2D, ReLU, MaxPool2D, Linear,
+                   Flatten)
+
+
+class LeNet(Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1), ReLU(),
+            MaxPool2D(2, 2),
+            Conv2D(6, 16, 5, stride=1, padding=0), ReLU(),
+            MaxPool2D(2, 2))
+        self.fc = Sequential(
+            Flatten(),
+            Linear(400, 120), ReLU(),
+            Linear(120, 84), ReLU(),
+            Linear(84, num_classes))
+
+    def forward(self, x):
+        return self.fc(self.features(x))

@@ -1,0 +1,57 @@
+"""MobileNetV1 (reference capability: python/paddle/vision/models/
+mobilenetv1.py — depthwise-separable conv stack)."""
+from __future__ import annotations
+
+from ...nn import (Layer, Sequential, Conv2D, BatchNorm2D, ReLU,
+                   AdaptiveAvgPool2D, Flatten, Linear)
+
+
+def _conv_bn_relu(cin, cout, k, stride=1, padding=0, groups=1):
+    return Sequential(
+        Conv2D(cin, cout, k, stride=stride, padding=padding, groups=groups,
+               bias_attr=False),
+        BatchNorm2D(cout), ReLU())
+
+
+class _DepthwiseSeparable(Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.dw = _conv_bn_relu(cin, cin, 3, stride, 1, groups=cin)
+        self.pw = _conv_bn_relu(cin, cout, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(n):
+            return max(int(n * scale), 8)
+
+        cfg = [(c(32), c(64), 1), (c(64), c(128), 2), (c(128), c(128), 1),
+               (c(128), c(256), 2), (c(256), c(256), 1),
+               (c(256), c(512), 2)] + [(c(512), c(512), 1)] * 5 + \
+              [(c(512), c(1024), 2), (c(1024), c(1024), 1)]
+        blocks = [_conv_bn_relu(3, c(32), 3, 2, 1)]
+        blocks += [_DepthwiseSeparable(a, b, s) for a, b, s in cfg]
+        self.features = Sequential(*blocks)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.head = Sequential(Flatten(), Linear(c(1024), num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.head(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,120 @@
+"""ResNet family (reference capability: python/paddle/vision/models/
+resnet.py — ResNet18/34/50/101/152 over BasicBlock/BottleneckBlock).
+
+TPU notes: NCHW layout at the API surface (reference parity); convs lower
+through lax.conv_general_dilated and XLA picks the TPU-preferred internal
+layout — no manual NHWC plumbing needed.
+"""
+from __future__ import annotations
+
+from ...nn import (Layer, Sequential, Conv2D, BatchNorm2D, ReLU, MaxPool2D,
+                   AdaptiveAvgPool2D, Linear, Flatten, Identity)
+
+
+def _conv_bn(cin, cout, k, stride=1, padding=0):
+    return Sequential(
+        Conv2D(cin, cout, k, stride=stride, padding=padding,
+               bias_attr=False),
+        BatchNorm2D(cout))
+
+
+class BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = _conv_bn(inplanes, planes, 3, stride, 1)
+        self.relu = ReLU()
+        self.conv2 = _conv_bn(planes, planes, 3, 1, 1)
+        self.downsample = downsample or Identity()
+
+    def forward(self, x):
+        out = self.relu(self.conv1(x))
+        out = self.conv2(out)
+        return self.relu(out + self.downsample(x))
+
+
+class BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = _conv_bn(inplanes, planes, 1)
+        self.conv2 = _conv_bn(planes, planes, 3, stride, 1)
+        self.conv3 = _conv_bn(planes, planes * 4, 1)
+        self.relu = ReLU()
+        self.downsample = downsample or Identity()
+
+    def forward(self, x):
+        out = self.relu(self.conv1(x))
+        out = self.relu(self.conv2(out))
+        out = self.conv3(out)
+        return self.relu(out + self.downsample(x))
+
+
+class ResNet(Layer):
+    """reference: vision/models/resnet.py ResNet(Block, depth)."""
+
+    def __init__(self, block, depth=50, width=64, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3],
+                     50: [3, 4, 6, 3], 101: [3, 4, 23, 3],
+                     152: [3, 8, 36, 3]}
+        layers = layer_cfg[depth]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.inplanes = 64
+
+        self.conv1 = _conv_bn(3, self.inplanes, 7, 2, 3)
+        self.relu = ReLU()
+        self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, layers[0])
+        self.layer2 = self._make_layer(block, 128, layers[1], 2)
+        self.layer3 = self._make_layer(block, 256, layers[2], 2)
+        self.layer4 = self._make_layer(block, 512, layers[3], 2)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.flatten = Flatten()
+            self.fc = Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = _conv_bn(self.inplanes, planes * block.expansion,
+                                  1, stride)
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, planes))
+        return Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.conv1(x)))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.flatten(x))
+        return x
+
+
+def resnet18(pretrained=False, **kwargs):
+    return ResNet(BasicBlock, 18, **kwargs)
+
+
+def resnet34(pretrained=False, **kwargs):
+    return ResNet(BasicBlock, 34, **kwargs)
+
+
+def resnet50(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, **kwargs)
+
+
+def resnet101(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, **kwargs)
+
+
+def resnet152(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 152, **kwargs)

@@ -1,0 +1,108 @@
+"""Vision transforms (reference capability: python/paddle/vision/
+transforms/ — Compose + numpy/Tensor image ops; PIL-free subset since the
+input pipeline is host-numpy feeding device transfers)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.dtype == np.uint8:
+            arr = arr.astype(np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return arr.astype(np.float32)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW",
+                 to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class Resize:
+    """Nearest-neighbor resize (PIL-free)."""
+
+    def __init__(self, size, interpolation="nearest"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        hw_first = arr.ndim == 2 or arr.shape[-1] <= 4
+        h, w = (arr.shape[0], arr.shape[1]) if hw_first else arr.shape[-2:]
+        th, tw = self.size
+        yi = (np.arange(th) * h / th).astype(np.int64).clip(0, h - 1)
+        xi = (np.arange(tw) * w / tw).astype(np.int64).clip(0, w - 1)
+        if hw_first:
+            return arr[yi][:, xi]
+        return arr[..., yi, :][..., xi]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[0], arr.shape[1]
+        th, tw = self.size
+        y = max((h - th) // 2, 0)
+        x = max((w - tw) // 2, 0)
+        return arr[y:y + th, x:x + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.padding:
+            pad = [(self.padding, self.padding),
+                   (self.padding, self.padding)] + \
+                  [(0, 0)] * (arr.ndim - 2)
+            arr = np.pad(arr, pad)
+        h, w = arr.shape[0], arr.shape[1]
+        th, tw = self.size
+        y = np.random.randint(0, h - th + 1)
+        x = np.random.randint(0, w - tw + 1)
+        return arr[y:y + th, x:x + tw]

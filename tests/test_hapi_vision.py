@@ -1,0 +1,112 @@
+"""hapi.Model + vision tests (reference: test/book/ MNIST book tests —
+tiny model trained to a loss threshold, save/load round-trip)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, Model
+from paddle_tpu.hapi.callbacks import EarlyStopping
+from paddle_tpu.metric import Accuracy, Precision, Recall, Auc
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import LeNet, resnet18, mobilenet_v1
+from paddle_tpu.vision import transforms as T
+
+
+def _ce(out, y):
+    return nn.functional.cross_entropy(out, y.reshape([-1]))
+
+
+def test_model_fit_decreases_loss(tmp_path):
+    paddle.seed(0)
+    data = FakeData(num_samples=64, image_shape=(1, 28, 28))
+    net = LeNet(num_classes=10)
+    model = Model(net)
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=_ce, metrics=Accuracy())
+    loss0 = model.evaluate(data, batch_size=16, verbose=0)["loss"]
+    model.fit(data, batch_size=16, epochs=3, verbose=0,
+              save_dir=str(tmp_path / "ckpt"))
+    loss1 = model.evaluate(data, batch_size=16, verbose=0)["loss"]
+    assert loss1 < loss0  # memorizes the 64 fixed samples
+    assert os.path.exists(str(tmp_path / "ckpt" / "final.pdparams"))
+
+    logs = model.evaluate(data, batch_size=16, verbose=0)
+    assert "acc" in logs and 0.0 <= float(np.asarray(logs["acc"])) <= 1.0
+
+    preds = model.predict(data, batch_size=16, stack_outputs=True)
+    assert tuple(preds.shape) == (64, 10)
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    paddle.seed(0)
+    net = LeNet()
+    model = Model(net)
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=_ce)
+    model.save(str(tmp_path / "m"))
+    ref = net.fc[1].weight.numpy().copy()
+
+    paddle.seed(7)
+    net2 = LeNet()
+    model2 = Model(net2)
+    model2.prepare(optimizer=paddle.optimizer.Adam(
+        1e-3, parameters=net2.parameters()), loss=_ce)
+    model2.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(net2.fc[1].weight.numpy(), ref)
+
+
+def test_early_stopping():
+    paddle.seed(0)
+    data = FakeData(num_samples=32, image_shape=(1, 28, 28))
+    net = LeNet()
+    model = Model(net)
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        0.0, parameters=net.parameters()), loss=_ce)
+    es = EarlyStopping(monitor="loss", patience=0, mode="min")
+    model.fit(data, eval_data=data, batch_size=16, epochs=5, verbose=0,
+              callbacks=[es])
+    assert es.stopped  # lr=0 → no improvement → stops early
+
+
+def test_resnet_and_mobilenet_forward():
+    paddle.seed(0)
+    x = paddle.randn([2, 3, 32, 32])
+    net = resnet18(num_classes=10)
+    out = net(x)
+    assert tuple(out.shape) == (2, 10)
+    net2 = mobilenet_v1(scale=0.25, num_classes=5)
+    out2 = net2(x)
+    assert tuple(out2.shape) == (2, 5)
+
+
+def test_transforms_pipeline():
+    tf = T.Compose([T.Resize(32), T.CenterCrop(28), T.ToTensor(),
+                    T.Normalize(mean=0.5, std=0.5)])
+    img = (np.random.rand(40, 44) * 255).astype(np.uint8)
+    out = tf(img)
+    assert out.shape == (1, 28, 28)
+    assert out.dtype == np.float32
+    assert out.min() >= -1.0 - 1e-6 and out.max() <= 1.0 + 1e-6
+
+
+def test_metrics():
+    acc = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]])
+    label = np.array([[1], [2]])
+    acc.update(*acc.compute(pred, label))
+    top1, top2 = acc.accumulate()
+    assert top1 == 0.5 and top2 == 0.5
+
+    p = Precision()
+    p.update(np.array([0.9, 0.8, 0.2]), np.array([1, 0, 1]))
+    assert p.accumulate() == 0.5
+
+    r = Recall()
+    r.update(np.array([0.9, 0.8, 0.2]), np.array([1, 0, 1]))
+    assert r.accumulate() == 0.5
+
+    a = Auc()
+    a.update(np.array([0.9, 0.8, 0.3, 0.1]), np.array([1, 1, 0, 0]))
+    assert a.accumulate() > 0.9
