@@ -199,7 +199,12 @@ class PipelineLayer(Layer):
                     continue
                 for p in item.parameters():
                     if id(p) in shared_ids:
-                        commit_param(p, mesh)  # replicated incl. pp
+                        # replicated over pp, but TP annotations still apply
+                        placements = [Replicate() for _ in mesh.dim_names]
+                        ann = getattr(p, "mp_placement", None)
+                        if ann is not None and ann[0] in mesh.dim_names:
+                            placements[mesh.dim_names.index(ann[0])] = ann[1]
+                        commit_param(p, mesh, placements)
                         continue
                     placements = [Replicate() for _ in sub.dim_names]
                     ann = getattr(p, "mp_placement", None)
@@ -228,13 +233,15 @@ class PipelineLayer(Layer):
                     # shared layers (tied embeddings) are replicated over the
                     # FULL mesh incl. pp — run them there; stage-owned layers
                     # run on the stage sub-mesh.  Re-commit only on change
-                    # of residence (device_put = the compiled p2p).
+                    # of residence (device_put = the compiled p2p).  The
+                    # target mesh is pushed as the ambient mesh so sharding
+                    # constraints inside TP layers resolve stage-locally.
                     target = mesh if is_shared else self._submeshes[stage]
                     if target is not current:
                         x = _to_stage_mesh(x, target)
                         current = target
-                if fwd is not None:
-                    x = fwd(item, x)
-                elif isinstance(item, Layer) or callable(item):
-                    x = item(x)
+                    with target:
+                        x = fwd(item, x) if fwd is not None else item(x)
+                else:
+                    x = fwd(item, x) if fwd is not None else item(x)
         return x

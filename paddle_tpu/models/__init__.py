@@ -6,3 +6,4 @@ from .mlp import MNISTMLP  # noqa: F401
 from .gpt_parallel import (  # noqa: F401
     ParallelGPTForCausalLM, ParallelGPTModel, ParallelGPTBlock,
 )
+from .gpt_pipeline import GPTForCausalLMPipe  # noqa: F401

@@ -47,9 +47,10 @@ def _constrain_act(x, seq_axis=None):
 
 
 class ParallelGPTAttention(Layer):
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, use_ring_attention=False):
         super().__init__()
         self.config = config
+        self.use_ring_attention = use_ring_attention
         h = config.hidden_size
         w_init = ParamAttr(initializer=Normal(0.0, config.initializer_range))
         out_init = ParamAttr(initializer=Normal(
@@ -75,9 +76,17 @@ class ParallelGPTAttention(Layer):
             q = shard_constraint(q, mesh, spec=spec)
             k = shard_constraint(k, mesh, spec=spec)
             v = shard_constraint(v, mesh, spec=spec)
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=cfg.attn_dropout,
-            training=self.training)
+        if self.use_ring_attention and mesh is not None \
+                and "sep" in mesh.dim_names \
+                and mesh.get_dim_size("sep") > 1:
+            # context parallelism: seq stays sharded over sep, K/V blocks
+            # rotate on the ICI ring (distributed.context_parallel)
+            from ..distributed.context_parallel import ring_flash_attention
+            out = ring_flash_attention(q, k, v, axis="sep", causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=cfg.attn_dropout,
+                training=self.training)
         out = MA.reshape(out, [b, s, h])
         return self.out_proj(out)
 
@@ -99,13 +108,22 @@ class ParallelGPTMLP(Layer):
 
 
 class ParallelGPTBlock(Layer):
-    def __init__(self, config: GPTConfig, sequence_parallel=False):
+    def __init__(self, config: GPTConfig, sequence_parallel=False,
+                 use_ring_attention=False, use_moe=False, num_experts=8):
         super().__init__()
         self.sequence_parallel = sequence_parallel
         self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
-        self.attn = ParallelGPTAttention(config)
+        self.attn = ParallelGPTAttention(config, use_ring_attention)
         self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
-        self.mlp = ParallelGPTMLP(config)
+        if use_moe:
+            # expert-parallel FFN (incubate MoE): experts sharded over mp
+            from ..incubate.distributed.models.moe import MoELayer
+            self.mlp = MoELayer(d_model=config.hidden_size,
+                                num_expert=num_experts,
+                                d_hidden=config.intermediate_size,
+                                gate={"type": "gshard", "top_k": 2})
+        else:
+            self.mlp = ParallelGPTMLP(config)
         self.dropout = Dropout(config.dropout)
 
     def forward(self, x):
@@ -118,7 +136,8 @@ class ParallelGPTBlock(Layer):
 
 
 class ParallelGPTModel(Layer):
-    def __init__(self, config: GPTConfig, sequence_parallel=False):
+    def __init__(self, config: GPTConfig, sequence_parallel=False,
+                 use_ring_attention=False, moe_every=0, num_experts=8):
         super().__init__()
         self.config = config
         emb_init = ParamAttr(initializer=Normal(0.0, config.initializer_range))
@@ -129,8 +148,12 @@ class ParallelGPTModel(Layer):
                                           config.hidden_size,
                                           weight_attr=emb_init)
         self.drop = Dropout(config.dropout)
-        self.h = LayerList([ParallelGPTBlock(config, sequence_parallel)
-                            for _ in range(config.num_layers)])
+        self.h = LayerList([
+            ParallelGPTBlock(
+                config, sequence_parallel, use_ring_attention,
+                use_moe=(moe_every > 0 and (i + 1) % moe_every == 0),
+                num_experts=num_experts)
+            for i in range(config.num_layers)])
         self.ln_f = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_eps)
 
@@ -153,10 +176,13 @@ class ParallelGPTForCausalLM(Layer):
         fleet.distributed_model(model)       # commits placements
     """
 
-    def __init__(self, config: GPTConfig, sequence_parallel=False):
+    def __init__(self, config: GPTConfig, sequence_parallel=False,
+                 use_ring_attention=False, moe_every=0, num_experts=8):
         super().__init__()
         self.config = config
-        self.gpt = ParallelGPTModel(config, sequence_parallel)
+        self.gpt = ParallelGPTModel(config, sequence_parallel,
+                                    use_ring_attention, moe_every,
+                                    num_experts)
         self.loss_fn = ParallelCrossEntropy()
 
     def forward(self, input_ids, labels=None, position_ids=None):

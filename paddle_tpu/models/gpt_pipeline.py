@@ -1,0 +1,94 @@
+"""GPT as a PipelineLayer — pp×mp hybrid for deep configs.
+
+Reference capability: PaddleNLP's GPTForPretrainingPipe pattern
+(PipelineLayer + LayerDesc/SharedLayerDesc over embedding/blocks/head,
+scheduled by fleet/meta_parallel/pipeline_parallel.py).
+
+TPU-native: the same TP layers as gpt_parallel inside each stage; stage
+params are committed to pp sub-meshes by PipelineLayer; tied embeddings via
+SharedLayerDesc stay replicated across pp.
+"""
+from __future__ import annotations
+
+from ..nn import Layer, LayerNorm
+from ..nn import functional as F
+from ..nn.initializer import Normal, ParamAttr
+from ..tensor_ops import manipulation as MA
+from ..tensor_ops import creation
+from ..distributed.fleet import LayerDesc, SharedLayerDesc, PipelineLayer
+from ..distributed.fleet.mp_layers import VocabParallelEmbedding
+from .gpt import GPTConfig
+from .gpt_parallel import ParallelGPTBlock
+
+
+class EmbeddingPipe(Layer):
+    """wte+wpe; reused as the LM head through SharedLayerDesc."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        emb_init = ParamAttr(initializer=Normal(0.0,
+                                                config.initializer_range))
+        self.wte = VocabParallelEmbedding(config.vocab_size,
+                                          config.hidden_size,
+                                          weight_attr=emb_init)
+        self.wpe = VocabParallelEmbedding(config.max_seq_len,
+                                          config.hidden_size,
+                                          weight_attr=emb_init)
+
+    @property
+    def weight(self):
+        return self.wte.weight
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = creation.arange(s, dtype="int32")
+        return self.wte(input_ids) + self.wpe(pos)
+
+
+def _lm_head_fwd(embed: EmbeddingPipe, hidden):
+    """Tied head: hidden @ wte.T (SharedLayerDesc forward_func)."""
+    return F.linear(hidden, embed.wte.weight.T)
+
+
+class LayerNormPipe(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln = LayerNorm(config.hidden_size,
+                            epsilon=config.layer_norm_eps)
+
+    def forward(self, x):
+        return self.ln(x)
+
+
+class GPTForCausalLMPipe(PipelineLayer):
+    """Construct under an active hybrid mesh (fleet.init first):
+
+        fleet.init(strategy)          # pp degree from strategy
+        model = GPTForCausalLMPipe(cfg)
+        model = fleet.distributed_model(model)   # → PipelineParallel
+        model.train_batch((x, y), opt)
+    """
+
+    def __init__(self, config: GPTConfig, num_stages=None, loss_fn=None,
+                 num_virtual_pipeline_stages=1, **block_kwargs):
+        self.config = config
+        descs = [SharedLayerDesc("embed", EmbeddingPipe, config)]
+        for _ in range(config.num_layers):
+            descs.append(LayerDesc(ParallelGPTBlock, config,
+                                   **block_kwargs))
+        descs.append(LayerDesc(LayerNormPipe, config))
+        descs.append(SharedLayerDesc("embed", EmbeddingPipe, config,
+                                     forward_func=_lm_head_fwd))
+        if loss_fn is None:
+            loss_fn = self._default_loss
+        super().__init__(
+            descs, num_stages=num_stages,
+            seg_method="layer:ParallelGPTBlock", loss_fn=loss_fn,
+            num_virtual_pipeline_stages=num_virtual_pipeline_stages)
+
+    def _default_loss(self, logits, labels):
+        n = logits.shape[-1]
+        return F.cross_entropy(
+            MA.reshape(logits, [-1, n]),
+            MA.reshape(labels, [-1])).mean()
