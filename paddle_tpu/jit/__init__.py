@@ -11,24 +11,47 @@ def not_to_static(fn):
 
 
 def save(layer, path, input_spec=None, **configs):
-    """Export a layer's params (reference: paddle.jit.save exports
-    program+params; here params + config, reloadable via jit.load)."""
-    import pickle
-    import numpy as np
-    import os
-    from ..core.tensor import Tensor
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    state = {k: np.asarray(v._data_) for k, v in layer.state_dict().items()}
-    with open(path + ".pdparams", "wb") as f:
-        pickle.dump(state, f)
+    """Export program + params (reference: paddle.jit.save → pdmodel +
+    pdiparams).  The program is portable serialized StableHLO
+    (static.save_inference_model); reload with jit.load → TranslatedLayer."""
+    if input_spec is None:
+        raise ValueError("jit.save needs input_spec=[InputSpec(...)] to "
+                         "trace the export (reference requires the same "
+                         "for non-traced layers)")
+    from ..static import save_inference_model
+    return save_inference_model(path, input_spec, None, layer=layer)
+
+
+class TranslatedLayer:
+    """reference: paddle.jit.TranslatedLayer — a loaded inference program
+    callable like a Layer."""
+
+    def __init__(self, program):
+        self._program = program
+        self._params = [program._params[k]
+                        for k in sorted(program._params)]
+
+    def __call__(self, *xs):
+        import numpy as np
+        from ..core.tensor import Tensor
+        args = [np.asarray(x._data_) if isinstance(x, Tensor)
+                else np.asarray(x) for x in xs]
+        outs = self._program._exported.call(self._params, *args)
+        outs = [Tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only "
+                           "(reference parity)")
 
 
 def load(path, **configs):
-    import pickle
-    with open(path + ".pdparams", "rb") as f:
-        return pickle.load(f)
+    from ..static import load_inference_model
+    prog, _, _ = load_inference_model(path)
+    return TranslatedLayer(prog)
 
 
 class InputSpec:

@@ -1,0 +1,247 @@
+"""Static-graph compatibility API.
+
+Reference capability: `paddle.static` (reference: python/paddle/static/ —
+Program/Executor wrappers over ProgramDesc + StandaloneExecutor,
+save/load_inference_model via static/io.py).
+
+TPU-native realization: a "Program" is a traced XLA computation, not a
+protobuf op list — the role the reference's ProgramDesc+InterpreterCore
+pipeline plays is played by jax.jit tracing + the XLA executable cache
+(SURVEY §7: StandaloneExecutor → PJRT executable launcher).  The API here
+keeps the reference's shape: build a Program from a callable (or a
+to_static-decorated layer), run it through an Executor, and
+save/load_inference_model serializes the program as portable StableHLO
+(jax.export) + a params file — the pdmodel/pdiparams split.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import state as _state
+from ..jit import InputSpec  # noqa: F401 (re-export, reference parity)
+
+_static_mode = [False]
+
+
+def enable_static():
+    """reference: paddle.enable_static — here a mode flag: under static
+    mode, Program.build traces immediately instead of lazily."""
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_static_mode():
+    return _static_mode[0]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Placeholder declaration (reference: static.data)."""
+    return InputSpec(shape=shape, dtype=dtype, name=name)
+
+
+class Program:
+    """A traced computation (reference: static.Program over ProgramDesc).
+
+    Wraps `fn(*inputs) -> outputs`; tracing/compilation happen on first
+    run per input signature (the _ExecutorCache analog is jax.jit's own
+    executable cache)."""
+
+    def __init__(self, fn=None, input_specs=None):
+        self._fn = fn
+        self._input_specs = input_specs or []
+        self._exported = None   # jax.export.Exported for deserialized progs
+        self._params = {}
+
+    def clone(self, for_test=False):
+        p = Program(self._fn, list(self._input_specs))
+        p._exported = self._exported
+        p._params = dict(self._params)
+        return p
+
+    @property
+    def num_blocks(self):
+        return 1
+
+    def __repr__(self):
+        src = "exported-stablehlo" if self._exported is not None else \
+            getattr(self._fn, "__name__", None)
+        return f"Program({src})"
+
+
+_default_program = Program()
+
+
+def default_main_program():
+    return _default_program
+
+
+def default_startup_program():
+    return _default_program
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+
+    def __enter__(self):
+        global _default_program
+        self._old = _default_program
+        _default_program = self.main
+        return self.main
+
+    def __exit__(self, *exc):
+        global _default_program
+        _default_program = self._old
+
+
+class CompiledProgram:
+    """reference: static.CompiledProgram — compilation is implicit (XLA),
+    kept for API parity."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+class Executor:
+    """reference: static.Executor (base/executor.py:1030) — run a Program
+    with a feed dict, fetch outputs."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        program = program or _default_program
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        feed = feed or {}
+        if program._exported is not None:
+            args = [np.asarray(feed[s.name]) for s in
+                    program._input_specs]
+            params = [program._params[k] for k in
+                      sorted(program._params)]
+            outs = program._exported.call(params, *args)
+        else:
+            if program._fn is None:
+                raise ValueError("Program has no function bound; build it "
+                                 "from a callable or load_inference_model")
+            args = [Tensor(np.asarray(feed[s.name]))
+                    for s in program._input_specs] if \
+                program._input_specs else \
+                [Tensor(np.asarray(v)) for v in feed.values()]
+            with _state.no_grad():
+                outs = program._fn(*args)
+        if isinstance(outs, Tensor):
+            outs = [outs]
+        elif not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        if return_numpy:
+            return [np.asarray(o._data_) if isinstance(o, Tensor)
+                    else np.asarray(o) for o in outs]
+        return list(outs)
+
+
+# ---------------------------------------------------------------------------
+# inference model save/load (reference: static/io.py)
+# ---------------------------------------------------------------------------
+
+def _export_layer(layer_or_fn, input_specs):
+    """Trace to a params-separated StableHLO export."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    if hasattr(layer_or_fn, "state_dict"):
+        layer = layer_or_fn
+        layer.eval()
+        named = sorted(layer.state_dict().items())
+        param_tensors = [t for _, t in named]
+        param_arrays = [t._data_ for t in param_tensors]
+
+        def pure(params, *xs):
+            saved = [t._data_ for t in param_tensors]
+            for t, a in zip(param_tensors, params):
+                t._data_ = a
+            try:
+                with _state.no_grad():
+                    out = layer(*[Tensor(x) for x in xs])
+            finally:
+                for t, a in zip(param_tensors, saved):
+                    t._data_ = a
+            return tuple(o._data_ for o in
+                         (out if isinstance(out, (tuple, list)) else
+                          (out,)))
+
+        params_np = {k: np.asarray(t._data_) for k, t in named}
+    else:
+        def pure(params, *xs):
+            with _state.no_grad():
+                out = layer_or_fn(*[Tensor(x) for x in xs])
+            return tuple(o._data_ for o in
+                         (out if isinstance(out, (tuple, list)) else
+                          (out,)))
+
+        param_arrays = []
+        params_np = {}
+
+    x_structs = [jax.ShapeDtypeStruct(tuple(s.shape),
+                                      jnp.dtype(s.dtype))
+                 for s in input_specs]
+    p_structs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for a in param_arrays]
+    exp = jexport.export(jax.jit(pure))(p_structs, *x_structs)
+    return exp, params_np
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, layer=None, **kwargs):
+    """Serialize <prefix>.pdmodel (StableHLO) + <prefix>.pdiparams
+    (reference: static/io.py save_inference_model)."""
+    target = layer or program
+    if target is None:
+        raise ValueError("pass layer= (a Layer/callable) to export")
+    specs = [v if isinstance(v, InputSpec) else
+             InputSpec(shape=v.shape, dtype=str(v.dtype), name=f"x{i}")
+             for i, v in enumerate(feed_vars)]
+    exp, params_np = _export_layer(target, specs)
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"params": params_np,
+                     "input_specs": [(s.name, list(s.shape or []),
+                                      str(s.dtype)) for s in specs]}, f)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program, feed_names, fetch_names)
+    (reference: static/io.py load_inference_model)."""
+    from jax import export as jexport
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exp = jexport.deserialize(f.read())
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    prog = Program()
+    prog._exported = exp
+    prog._params = {k: v for k, v in sorted(meta["params"].items())}
+    prog._input_specs = [InputSpec(shape=shape, dtype=dt, name=name)
+                         for name, shape, dt in meta["input_specs"]]
+    feed_names = [s.name for s in prog._input_specs]
+    n_out = len(exp.out_avals)
+    fetch_names = [f"fetch_{i}" for i in range(n_out)]
+    return prog, feed_names, fetch_names
+
+
+# reference-parity aliases
+save = save_inference_model
+load = load_inference_model

@@ -1,0 +1,87 @@
+"""static API + inference engine tests (reference: test/legacy_test static
+save/load + inference predictor tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static, inference
+from paddle_tpu.jit import InputSpec
+
+
+def _small_net(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_program_executor_callable():
+    net = _small_net()
+
+    def fn(x):
+        return net(x)
+
+    prog = static.Program(fn, [static.data("x", [2, 8])])
+    exe = static.Executor()
+    x = np.random.default_rng(0).standard_normal((2, 8)).astype(np.float32)
+    (out,) = exe.run(prog, feed={"x": x})
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_save_load_inference_model(tmp_path):
+    net = _small_net()
+    x = np.random.default_rng(1).standard_normal((2, 8)).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+
+    prefix = str(tmp_path / "model")
+    static.save_inference_model(
+        prefix, [InputSpec([2, 8], "float32", "x")], None, layer=net)
+
+    prog, feeds, fetches = static.load_inference_model(prefix)
+    exe = static.Executor()
+    (out,) = exe.run(prog, feed={"x": x})
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_jit_save_load_translated_layer(tmp_path):
+    net = _small_net(3)
+    x = paddle.randn([4, 8])
+    ref = net(x).numpy()
+    prefix = str(tmp_path / "jit_model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([4, 8], "float32", "x")])
+    loaded = paddle.jit.load(prefix)
+    out = loaded(x)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+    with pytest.raises(RuntimeError):
+        loaded.train()
+
+
+def test_predictor_end_to_end(tmp_path):
+    net = _small_net(5)
+    x = np.random.default_rng(2).standard_normal((2, 8)).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "served")
+    static.save_inference_model(
+        prefix, [InputSpec([2, 8], "float32", "x")], None, layer=net)
+
+    config = inference.Config(prefix + ".pdmodel")
+    predictor = inference.create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(x)
+    predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_exported_program_is_portable_stablehlo(tmp_path):
+    """The .pdmodel artifact is serialized StableHLO, loadable without the
+    original python (the reference's program portability guarantee)."""
+    net = _small_net(7)
+    prefix = str(tmp_path / "port")
+    static.save_inference_model(
+        prefix, [InputSpec([1, 8], "float32", "x")], None, layer=net)
+    from jax import export as jexport
+    exp = jexport.deserialize(open(prefix + ".pdmodel", "rb").read())
+    assert "stablehlo" in exp.mlir_module() or exp.mlir_module_serialized
