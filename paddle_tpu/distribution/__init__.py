@@ -1,0 +1,204 @@
+"""Probability distributions.
+
+Reference capability: `paddle.distribution` (reference:
+python/paddle/distribution/ — Distribution base with
+sample/log_prob/entropy/kl_divergence, Normal/Uniform/Categorical/
+Bernoulli/Beta/Dirichlet/...).
+
+TPU-native: samplers draw from the framework RNG key stream (functional
+splitting, not a mutable generator) and log-probs are plain jnp ops that
+fuse into surrounding programs.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import state as _state
+
+
+def _arr(x):
+    return x._data_ if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_arr(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale
+                      * jax.random.normal(key, shp, jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale)
+                      + jnp.zeros(self._batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low).astype(jnp.float32)
+        self.high = _arr(high).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(key, shp, jnp.float32)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v <= self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low)
+                      + jnp.zeros(self._batch_shape))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is None:
+            logits = jnp.log(jnp.clip(_arr(probs), 1e-30, None))
+        self.logits = _arr(logits).astype(jnp.float32)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jax.nn.softmax(self.logits, axis=-1))
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        return Tensor(jax.random.categorical(
+            key, self.logits, shape=tuple(shape) + self._batch_shape))
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(jnp.take_along_axis(
+            logp, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _arr(probs).astype(jnp.float32)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.bernoulli(
+            key, self.probs_, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha).astype(jnp.float32)
+        self.beta = _arr(beta).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.beta(key, self.alpha, self.beta, shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        v = _arr(value)
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v)
+                      - betaln(self.alpha, self.beta))
+
+
+def kl_divergence(p, q):
+    """reference: paddle.distribution.kl_divergence — registered pairs."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_p, var_q = p.scale ** 2, q.scale ** 2
+        return Tensor(jnp.log(q.scale / p.scale)
+                      + (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        logp = jax.nn.log_softmax(p.logits, axis=-1)
+        logq = jax.nn.log_softmax(q.logits, axis=-1)
+        return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        pp = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
+                      + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

@@ -1,0 +1,63 @@
+"""FFT family (reference capability: python/paddle/fft.py — fft/ifft/
+rfft/irfft and 2d/nd variants over phi FFT kernels; on TPU jnp.fft lowers
+to XLA's FFT HLO)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op
+from .core.tensor import Tensor
+
+
+def _wrap1(name, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return apply_op(name, lambda a: jfn(a, n=n, axis=axis, norm=norm),
+                        (x if isinstance(x, Tensor) else Tensor(x),))
+    op.__name__ = name
+    return op
+
+
+def _wrapn(name, jfn):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        kw = {"s": s, "norm": norm}
+        if axes is not None:
+            kw["axes"] = axes
+        return apply_op(name, lambda a: jfn(a, **kw),
+                        (x if isinstance(x, Tensor) else Tensor(x),))
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+
+fft2 = _wrapn("fft2", jnp.fft.fft2)
+ifft2 = _wrapn("ifft2", jnp.fft.ifft2)
+rfft2 = _wrapn("rfft2", jnp.fft.rfft2)
+irfft2 = _wrapn("irfft2", jnp.fft.irfft2)
+fftn = _wrapn("fftn", jnp.fft.fftn)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes),
+                    (x if isinstance(x, Tensor) else Tensor(x),))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes),
+                    (x if isinstance(x, Tensor) else Tensor(x),))

@@ -1,0 +1,104 @@
+"""Signal ops (reference capability: python/paddle/signal.py — stft/istft
+over frame + FFT kernels)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op
+from .core.tensor import Tensor
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames (reference: signal.frame)."""
+    def fn(a):
+        n = a.shape[axis]
+        n_frames = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[None, :]
+               + hop_length * jnp.arange(n_frames)[:, None])
+        moved = jnp.moveaxis(a, axis, -1)
+        framed = moved[..., idx]                 # [..., n_frames, flen]
+        return jnp.moveaxis(framed, (-2, -1), (0, 1)) if False else framed
+    return apply_op("frame", fn,
+                    (x if isinstance(x, Tensor) else Tensor(x),))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """reference: signal.stft — returns [..., n_fft//2+1, n_frames]
+    complex (onesided default)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(a, w=None):
+        pad = n_fft // 2
+        if center:
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        n = a.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[None, :]
+               + hop_length * jnp.arange(n_frames)[:, None])
+        frames = a[..., idx]                     # [..., n_frames, n_fft]
+        if w is None:
+            win = jnp.ones((n_fft,), a.dtype)
+        else:
+            win = w
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        frames = frames * win
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(n_fft)
+        return jnp.swapaxes(spec, -1, -2)        # [..., freq, time]
+
+    args = [x if isinstance(x, Tensor) else Tensor(x)]
+    if window is not None:
+        args.append(window if isinstance(window, Tensor)
+                    else Tensor(window))
+    return apply_op("stft", fn, tuple(args))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """reference: signal.istft — overlap-add inverse."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(spec, w=None):
+        s = jnp.swapaxes(spec, -1, -2)          # [..., time, freq]
+        if normalized:
+            s = s * jnp.sqrt(n_fft)
+        frames = (jnp.fft.irfft(s, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(s, axis=-1).real)
+        if w is None:
+            win = jnp.ones((n_fft,), frames.dtype)
+        else:
+            win = w
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        frames = frames * win
+        n_frames = frames.shape[-2]
+        out_len = n_fft + hop_length * (n_frames - 1)
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        norm = jnp.zeros((out_len,), frames.dtype)
+        for t in range(n_frames):
+            sl = slice(t * hop_length, t * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., t, :])
+            norm = norm.at[sl].add(win ** 2)
+        out = out / jnp.maximum(norm, 1e-10)
+        if center:
+            out = out[..., n_fft // 2:-(n_fft // 2)]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    args = [x if isinstance(x, Tensor) else Tensor(x)]
+    if window is not None:
+        args.append(window if isinstance(window, Tensor)
+                    else Tensor(window))
+    return apply_op("istft", fn, tuple(args))

@@ -1,0 +1,151 @@
+"""Sparse tensor API.
+
+Reference capability: `paddle.sparse` (reference: python/paddle/sparse/ —
+COO/CSR creation, elementwise/matmul/nn ops backed by
+paddle/phi/kernels/sparse/).
+
+TPU-native realization: BCOO from jax.experimental.sparse — XLA lowers
+sparse ops to gather/scatter/segment-sum which map onto the TPU's
+vector/scatter units; CSR is stored but computed via BCOO (the TPU has no
+native CSR unit, and BCOO batches better on the MXU).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+
+
+class SparseCooTensor(Tensor):
+    """COO sparse tensor; `_data_` holds the BCOO (bypasses the dense
+    asarray in Tensor.__init__)."""
+
+    def __init__(self, bcoo, stop_gradient=True):
+        self._data_ = bcoo
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = None
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._hooks = []
+        self.optimize_attr = {}
+        self.regularizer = None
+        self.is_dist_param = False
+        self.placements = None
+        self.process_mesh = None
+
+    # reference surface
+    def indices(self):
+        return Tensor(self._data_.indices.T)
+
+    def values(self):
+        return Tensor(self._data_.data)
+
+    def to_dense(self):
+        return Tensor(self._data_.todense())
+
+    def nnz(self):
+        return int(self._data_.nse)
+
+    @property
+    def shape(self):
+        return list(self._data_.shape)
+
+    def is_sparse_coo(self):
+        return True
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR view: stores crows/cols/values, computes as BCOO."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = np.asarray(crows)
+        self._cols = np.asarray(cols)
+        rows = np.repeat(np.arange(len(self._crows) - 1),
+                         np.diff(self._crows))
+        idx = jnp.stack([jnp.asarray(rows), jnp.asarray(self._cols)],
+                        axis=1)
+        bcoo = jsparse.BCOO((jnp.asarray(values), idx), shape=tuple(shape))
+        super().__init__(bcoo)
+
+    def crows(self):
+        return Tensor(jnp.asarray(self._crows))
+
+    def cols(self):
+        return Tensor(jnp.asarray(self._cols))
+
+    def is_sparse_csr(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """reference: paddle.sparse.sparse_coo_tensor(indices [ndim, nnz])."""
+    idx = np.asarray(indices if not isinstance(indices, Tensor)
+                     else indices.numpy())
+    vals = jnp.asarray(values if not isinstance(values, Tensor)
+                       else values._data_)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if shape is None:
+        shape = tuple(int(i.max()) + 1 for i in idx)
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def _dense_data(x):
+    if isinstance(x, SparseCooTensor):
+        return x._data_
+    if isinstance(x, Tensor):
+        return x._data_
+    return jnp.asarray(x)
+
+
+def matmul(x, y, name=None):
+    """Sparse @ dense (reference: paddle.sparse.matmul)."""
+    out = apply_op("sparse_matmul",
+                   lambda a, b: a @ b if not isinstance(a, jsparse.BCOO)
+                   else jsparse.bcoo_dot_general(
+                       a, b, dimension_numbers=(((a.ndim - 1,), (0,)),
+                                                ((), ()))),
+                   (x, y))
+    return out
+
+
+def add(x, y, name=None):
+    xb, yb = x._data_, y._data_
+    if isinstance(xb, jsparse.BCOO) and isinstance(yb, jsparse.BCOO):
+        s = jsparse.bcoo_add_indices_compatible \
+            if hasattr(jsparse, "bcoo_add_indices_compatible") else None
+        out = (xb.todense() + yb.todense())
+        return sparse_coo_tensor(
+            np.nonzero(np.asarray(out)), out[out != 0], out.shape)
+    return Tensor(_dense_data(x) + _dense_data(y))
+
+
+def relu(x, name=None):
+    b = x._data_
+    new = jsparse.BCOO((jax.nn.relu(b.data), b.indices), shape=b.shape)
+    return SparseCooTensor(new)
+
+
+class nn:
+    """paddle.sparse.nn parity namespace (ReLU as the canonical member)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

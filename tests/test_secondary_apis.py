@@ -1,0 +1,120 @@
+"""Sparse / distribution / fft / signal tests (reference: test/legacy_test
+sparse_*, distribution_*, fft/stft op tests vs numpy/scipy references)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse, distribution, fft, signal
+
+
+# ---------------- sparse ----------------
+def test_sparse_coo_roundtrip():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    t = sparse.sparse_coo_tensor(indices, values, [3, 3])
+    assert t.is_sparse_coo()
+    assert t.nnz() == 3
+    dense = t.to_dense().numpy()
+    ref = np.zeros((3, 3), np.float32)
+    ref[0, 1], ref[1, 2], ref[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense, ref)
+
+
+def test_sparse_csr_and_relu():
+    t = sparse.sparse_csr_tensor([0, 2, 3], [0, 2, 1], [-1.0, 2.0, -3.0],
+                                 [2, 3])
+    assert t.is_sparse_csr()
+    r = sparse.relu(t)
+    ref = np.maximum(t.to_dense().numpy(), 0)
+    np.testing.assert_allclose(r.to_dense().numpy(), ref)
+
+
+def test_sparse_matmul_dense():
+    indices = [[0, 1], [1, 0]]
+    t = sparse.sparse_coo_tensor(indices, [2.0, 3.0], [2, 2])
+    d = paddle.to_tensor(np.eye(2, dtype=np.float32) * 4)
+    out = sparse.matmul(t, d)
+    np.testing.assert_allclose(np.asarray(out._data_),
+                               t.to_dense().numpy() @ (np.eye(2) * 4))
+
+
+# ---------------- distribution ----------------
+def test_normal_sample_logprob_kl():
+    paddle.seed(0)
+    n = distribution.Normal(0.0, 1.0)
+    s = n.sample([10000])
+    arr = s.numpy()
+    assert abs(arr.mean()) < 0.05 and abs(arr.std() - 1) < 0.05
+    lp = n.log_prob(paddle.to_tensor(0.0))
+    np.testing.assert_allclose(float(lp), -0.5 * np.log(2 * np.pi),
+                               rtol=1e-5)
+    m = distribution.Normal(1.0, 2.0)
+    kl = distribution.kl_divergence(n, m)
+    ref = np.log(2.0) + (1 + 1) / 8 - 0.5
+    np.testing.assert_allclose(float(kl), ref, rtol=1e-5)
+
+
+def test_categorical_and_bernoulli():
+    paddle.seed(0)
+    c = distribution.Categorical(logits=np.log([[0.2, 0.8]]))
+    s = c.sample([2000])
+    frac = (s.numpy() == 1).mean()
+    assert 0.74 < frac < 0.86
+    lp = c.log_prob(paddle.to_tensor([1]))
+    np.testing.assert_allclose(float(lp), np.log(0.8), rtol=1e-5)
+    ent = c.entropy()
+    ref = -(0.2 * np.log(0.2) + 0.8 * np.log(0.8))
+    np.testing.assert_allclose(float(ent), ref, rtol=1e-5)
+
+    b = distribution.Bernoulli(0.3)
+    np.testing.assert_allclose(float(b.log_prob(paddle.to_tensor(1.0))),
+                               np.log(0.3), rtol=1e-4)
+
+
+def test_uniform_beta():
+    paddle.seed(0)
+    u = distribution.Uniform(0.0, 2.0)
+    s = u.sample([1000]).numpy()
+    assert s.min() >= 0 and s.max() <= 2
+    np.testing.assert_allclose(float(u.entropy()), np.log(2), rtol=1e-5)
+    bt = distribution.Beta(2.0, 2.0)
+    sb = bt.sample([1000]).numpy()
+    assert 0 <= sb.min() and sb.max() <= 1
+    assert abs(sb.mean() - 0.5) < 0.05
+
+
+# ---------------- fft ----------------
+def test_fft_matches_numpy():
+    x = np.random.default_rng(0).standard_normal(32).astype(np.float32)
+    out = fft.fft(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out._data_), np.fft.fft(x),
+                               rtol=1e-4, atol=1e-4)
+    r = fft.rfft(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(r._data_), np.fft.rfft(x),
+                               rtol=1e-4, atol=1e-4)
+    back = fft.irfft(r, n=32)
+    np.testing.assert_allclose(np.asarray(back._data_), x, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_fft2_and_shift():
+    x = np.random.default_rng(1).standard_normal((8, 8)).astype(np.float32)
+    out = fft.fft2(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out._data_), np.fft.fft2(x),
+                               rtol=1e-4, atol=1e-4)
+    sh = fft.fftshift(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(sh._data_), np.fft.fftshift(x))
+
+
+# ---------------- signal ----------------
+def test_stft_istft_roundtrip():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 512)).astype(np.float32)
+    win = np.hanning(128).astype(np.float32)
+    spec = signal.stft(paddle.to_tensor(x), n_fft=128, hop_length=32,
+                       window=paddle.to_tensor(win))
+    assert spec.shape[-2] == 65  # onesided bins
+    back = signal.istft(spec, n_fft=128, hop_length=32,
+                        window=paddle.to_tensor(win), length=512)
+    np.testing.assert_allclose(np.asarray(back._data_), x, rtol=1e-3,
+                               atol=1e-3)
