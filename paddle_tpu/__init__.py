@@ -99,6 +99,7 @@ from . import sparse  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from . import vision  # noqa: F401,E402

@@ -84,6 +84,13 @@ def apply_op(name, fn, args, static=None, nondiff=False):
 
     single = not isinstance(out, (tuple, list))
     outs = (out,) if single else tuple(out)
+
+    # NaN/Inf scanning of every op output when FLAGS_check_nan_inf is set
+    # (reference: eager nan_inf_utils.h:38 + FLAGS_check_nan_inf,
+    # phi/core/flags.cc:74).  Only active eagerly — tracers are symbolic.
+    from ..utils.flags import flag as _flag
+    if _flag("FLAGS_check_nan_inf"):
+        _check_nan_inf(name, outs)
     out_tensors = []
     node = None
     if need_grad:
@@ -96,6 +103,27 @@ def apply_op(name, fn, args, static=None, nondiff=False):
             t._out_index = i
         out_tensors.append(t)
     return out_tensors[0] if single else tuple(out_tensors)
+
+
+def _check_nan_inf(name, outs):
+    import numpy as np
+    from ..utils.flags import flag as _flag
+    for i, o in enumerate(outs):
+        if isinstance(o, jax.core.Tracer) or not hasattr(o, "dtype"):
+            continue
+        if not jax.numpy.issubdtype(o.dtype, jax.numpy.floating):
+            continue
+        bad = ~jax.numpy.isfinite(o)
+        if bool(bad.any()):
+            n_nan = int(jax.numpy.isnan(o).sum())
+            n_inf = int(jax.numpy.isinf(o).sum())
+            msg = (f"op '{name}' output {i} contains {n_nan} NaN / "
+                   f"{n_inf} Inf values (shape {tuple(o.shape)})")
+            level = int(_flag("FLAGS_check_nan_inf_level", 0))
+            if level >= 3:
+                print(f"[check_nan_inf] WARNING: {msg}")
+            else:
+                raise FloatingPointError(msg)
 
 
 def defop(name, nondiff=False):

@@ -1,0 +1,213 @@
+"""Quantization framework: QAT (fake-quant with STE) and PTQ (observe →
+quantize).
+
+Reference capability: `paddle.quantization` (reference:
+python/paddle/quantization/ — QuantConfig, QAT/PTQ pipelines, observers and
+quanters wrapping layers).
+
+TPU-native realization: fake-quant is expressed as
+`x + stop_gradient(q(x) - x)` so the straight-through estimator falls out
+of autodiff, and XLA fuses the quant/dequant pair into neighboring ops;
+int8 deployment on TPU maps to XLA int8 matmul paths at conversion time.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+from ..nn.layer import Layer
+from ..nn import Linear, Conv2D
+
+
+def _fake_quant(x, scale, bit_length=8):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    # straight-through estimator
+    return x + lax.stop_gradient(q - x)
+
+
+class BaseObserver(Layer):
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def scales(self):
+        return self._scale
+
+    def observe(self, x):
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseObserver):
+    """reference: quantization/observers/abs_max.py."""
+
+    def observe(self, x):
+        m = float(np.abs(np.asarray(x._data_)).max())
+        self._scale = m if self._scale is None else max(self._scale, m)
+        return self._scale
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def observe(self, x):
+        m = float(np.abs(np.asarray(x._data_)).max())
+        self._scale = m if self._scale is None else \
+            self.moving_rate * self._scale + (1 - self.moving_rate) * m
+        return self._scale
+
+
+class FakeQuanterWithAbsMaxObserver(BaseObserver):
+    """reference: quantization/quanters/abs_max.py — QAT quanter: observes
+    and fake-quantizes in one forward."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9, name=None):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def forward(self, x):
+        import jax
+        m = None if isinstance(x._data_, jax.core.Tracer) else \
+            float(np.abs(np.asarray(x._data_)).max())
+        if m is not None:
+            self._scale = m if self._scale is None else \
+                self.moving_rate * self._scale + (1 - self.moving_rate) * m
+        scale = self._scale or 1.0
+        bits = self.quant_bits
+        return apply_op("fake_quant",
+                        lambda a: _fake_quant(a, jnp.float32(scale), bits),
+                        (x,))
+
+
+class QuantConfig:
+    """reference: quantization/config.py QuantConfig(activation, weight)."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs = {}
+
+    def add_layer_config(self, layer=None, activation=None, weight=None):
+        for l in (layer if isinstance(layer, (list, tuple)) else [layer]):
+            self._layer_configs[id(l)] = (activation, weight)
+
+    def _for(self, layer):
+        return self._layer_configs.get(id(layer),
+                                       (self.activation, self.weight))
+
+
+class QuantedLayer(Layer):
+    """Wrapper installing weight/activation quanters around a layer."""
+
+    def __init__(self, inner, act_quanter, w_quanter):
+        super().__init__()
+        self.inner = inner
+        self.act_quanter = act_quanter() if isinstance(act_quanter, type) \
+            else act_quanter
+        self.w_quanter = w_quanter() if isinstance(w_quanter, type) \
+            else w_quanter
+
+    def forward(self, x):
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        w = self.inner.weight
+        if self.w_quanter is not None:
+            wq = self.w_quanter(w)
+            saved = w._data_
+            w._data_ = wq._data_
+            try:
+                out = self.inner(x)
+            finally:
+                w._data_ = saved
+            return out
+        return self.inner(x)
+
+
+_QUANTABLE = (Linear, Conv2D)
+
+
+def _wrap_model(model, config, quanter_cls):
+    for name, child in list(model._sub_layers.items()) \
+            if hasattr(model, "_sub_layers") else []:
+        if isinstance(child, _QUANTABLE):
+            act, w = config._for(child)
+            model._sub_layers[name] = QuantedLayer(
+                child, act or quanter_cls(), w or quanter_cls())
+        else:
+            _wrap_model(child, config, quanter_cls)
+    return model
+
+
+class QAT:
+    """reference: quantization/qat.py — quantization-aware training."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        return _wrap_model(model, self.config,
+                           FakeQuanterWithAbsMaxObserver)
+
+    def convert(self, model, inplace=False):
+        """Strip quanters, bake observed scales as layer attrs."""
+        for name, child in list(model._sub_layers.items()):
+            if isinstance(child, QuantedLayer):
+                inner = child.inner
+                inner.weight_scale = (child.w_quanter.scales()
+                                      if child.w_quanter else None)
+                inner.activation_scale = (child.act_quanter.scales()
+                                          if child.act_quanter else None)
+                model._sub_layers[name] = inner
+            else:
+                self.convert(child)
+        return model
+
+
+class PTQ:
+    """reference: quantization/ptq.py — post-training quantization:
+    observe with calibration data, then quantize weights."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        self._observers = []
+
+        def install(m):
+            for name, child in list(m._sub_layers.items()):
+                if isinstance(child, _QUANTABLE):
+                    obs = AbsmaxObserver()
+                    self._observers.append((child, obs))
+                    child._ptq_observer = obs
+                    orig = child.forward
+
+                    def observed_forward(x, _c=child, _o=obs, _f=orig):
+                        _o.observe(x)
+                        return _f(x)
+
+                    child.forward = observed_forward
+                else:
+                    install(child)
+        install(model)
+        return model
+
+    def convert(self, model, inplace=False):
+        for child, obs in getattr(self, "_observers", []):
+            w = child.weight
+            scale = float(np.abs(np.asarray(w._data_)).max())
+            qmax = 127.0
+            q = np.clip(np.round(np.asarray(w._data_) / max(scale, 1e-9)
+                                 * qmax), -qmax, qmax)
+            child.weight._data_ = jnp.asarray(q * scale / qmax)
+            child.weight_scale = scale
+            child.activation_scale = obs.scales()
+            if hasattr(child, "_ptq_observer"):
+                del child.forward  # restore class forward
+        return model

@@ -1,0 +1,78 @@
+"""Quantization + nan/inf debug tests (reference: test/quantization/,
+FLAGS_check_nan_inf tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (
+    QAT, PTQ, QuantConfig, QuantedLayer, FakeQuanterWithAbsMaxObserver,
+    AbsmaxObserver,
+)
+
+
+def test_qat_quantize_and_train():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    qat = QAT(QuantConfig())
+    model = qat.quantize(model)
+    assert isinstance(model[0], QuantedLayer)
+    x = paddle.randn([4, 8])
+    out = model(x)
+    loss = (out ** 2).mean()
+    loss.backward()
+    # STE: gradient flows through fake-quant to the weight
+    assert model[0].inner.weight.grad is not None
+    assert np.isfinite(model[0].inner.weight.grad.numpy()).all()
+
+    converted = qat.convert(model)
+    assert isinstance(converted[0], nn.Linear)
+    assert converted[0].weight_scale is not None
+
+
+def test_fake_quant_close_to_identity():
+    q = FakeQuanterWithAbsMaxObserver(quant_bits=8)
+    x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+    out = q(x)
+    np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1.0 / 127 + 1e-6)
+
+
+def test_ptq_observe_convert():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 8))
+    ptq = PTQ(QuantConfig())
+    model = ptq.quantize(model)
+    for _ in range(3):
+        model(paddle.randn([4, 8]))
+    model = ptq.convert(model)
+    lin = model[0]
+    assert lin.activation_scale is not None and lin.activation_scale > 0
+    # weights are now on the int8 grid
+    w = lin.weight.numpy()
+    grid = np.round(w / lin.weight_scale * 127)
+    np.testing.assert_allclose(w, grid * lin.weight_scale / 127, atol=1e-6)
+
+
+def test_check_nan_inf_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError, match="NaN|Inf"):
+            _ = x / paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        # healthy ops pass
+        _ = x + x
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_check_nan_inf_warn_level():
+    paddle.set_flags({"FLAGS_check_nan_inf": True,
+                      "FLAGS_check_nan_inf_level": 3})
+    try:
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        zero = paddle.to_tensor(np.array([0.0], np.float32))
+        out = x / zero  # warns, does not raise
+        assert np.isinf(out.numpy()).any()
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False,
+                          "FLAGS_check_nan_inf_level": 0})
