@@ -105,17 +105,6 @@ from .hapi import Model  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 
 
-def disable_static(place=None):
-    """Dygraph is the default and only eager mode; kept for API parity."""
-    return None
-
-
-def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static "
-        "to compile dygraph code to a single XLA program.")
-
-
 def in_dynamic_mode():
     return True
 

@@ -1,0 +1,1 @@
+from .tuner import AutoTuner, TunerConfig  # noqa: F401

@@ -1,0 +1,114 @@
+"""Launch-level distributed-config auto-tuner.
+
+Reference capability: python/paddle/distributed/auto_tuner/ (tuner.py:19,
+prune.py, search.py) — grid search over dp/mp/pp/sharding/micro-batch
+degrees, pruning infeasible points, launching trial runs, recording the
+best throughput.
+
+TPU-native realization: candidates are pruned with the roofline cost model
+(paddle_tpu.cost_model) — HBM-capacity and divisibility pruning mirror the
+reference's prune rules — then measured by calling a user trial function
+(or ranked purely by the model with mode="predict", which a single
+controller can do without burning TPU hours).
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+from ...cost_model import transformer_step_cost, DEVICE_SPECS
+
+
+@dataclass
+class TunerConfig:
+    n_devices: int = 8
+    device: str = "v5e"
+    # model description for pruning
+    n_params: float = 1.3e9
+    n_layers: int = 24
+    hidden: int = 2048
+    global_batch: int = 512
+    seq_len: int = 2048
+    # search space (None → all divisors of n_devices)
+    dp_candidates: list = field(default_factory=list)
+    mp_candidates: list = field(default_factory=list)
+    pp_candidates: list = field(default_factory=list)
+    sharding_candidates: list = field(default_factory=list)
+    micro_batch_candidates: list = field(default_factory=list)
+    max_mp: int = 8          # mp beyond one host rides DCN — prune
+    hbm_headroom: float = 0.9
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class AutoTuner:
+    """reference: auto_tuner/tuner.py:19."""
+
+    def __init__(self, config: TunerConfig):
+        self.cfg = config
+        self.history = []
+
+    def candidates(self):
+        n = self.cfg.n_devices
+        dps = self.cfg.dp_candidates or _divisors(n)
+        mps = self.cfg.mp_candidates or [d for d in _divisors(n)
+                                         if d <= self.cfg.max_mp]
+        pps = self.cfg.pp_candidates or _divisors(n)
+        shs = self.cfg.sharding_candidates or _divisors(n)
+        mbs = self.cfg.micro_batch_candidates or [1, 2, 4, 8]
+        for dp, mp, pp, sh, mb in itertools.product(dps, mps, pps, shs,
+                                                    mbs):
+            if dp * mp * pp * sh != n:
+                continue
+            cand = {"dp": dp, "mp": mp, "pp": pp, "sharding": sh,
+                    "micro_batch": mb}
+            if self.prune(cand):
+                continue
+            yield cand
+
+    def prune(self, cand):
+        """reference: prune.py rules — divisibility + memory feasibility."""
+        c = self.cfg
+        dp_world = cand["dp"] * cand["sharding"]
+        if c.global_batch % dp_world != 0:
+            return True
+        per_dp = c.global_batch // dp_world
+        if per_dp % cand["micro_batch"] != 0:
+            return True
+        if c.n_layers % cand["pp"] != 0:
+            return True
+        if c.hidden % cand["mp"] != 0:
+            return True
+        est = transformer_step_cost(
+            c.n_params, c.n_layers, c.hidden, c.global_batch, c.seq_len,
+            dp=cand["dp"], mp=cand["mp"], pp=cand["pp"],
+            sharding=cand["sharding"], device=c.device,
+            grad_accum=per_dp // cand["micro_batch"])
+        cand["_est"] = est
+        hbm = DEVICE_SPECS[c.device].hbm_bytes * c.hbm_headroom
+        return est.hbm_per_device > hbm
+
+    def tune(self, trial_fn=None, max_trials=None, mode="measure"):
+        """Returns the best candidate.  trial_fn(cand) -> tokens/sec, or
+        mode='predict' ranks by the cost model alone."""
+        cands = list(self.candidates())
+        # rank by predicted step time so measured trials start from the
+        # most promising region (reference: search.py ordered search)
+        cands.sort(key=lambda c: c["_est"].step_time_s)
+        if mode == "predict" or trial_fn is None:
+            best = cands[0] if cands else None
+            self.history = [(c, 1.0 / c["_est"].step_time_s)
+                            for c in cands]
+            return best
+        best, best_tput = None, -1.0
+        for cand in cands[:max_trials]:
+            try:
+                tput = trial_fn(cand)
+            except Exception:
+                tput = -1.0
+            self.history.append((cand, tput))
+            if tput > best_tput:
+                best, best_tput = cand, tput
+        return best

@@ -1,0 +1,186 @@
+"""User-facing RPC.
+
+Reference capability: `paddle.distributed.rpc` (reference:
+paddle/fluid/distributed/rpc/rpc_agent.{h,cc} over brpc +
+python/paddle/distributed/rpc/rpc.py — init_rpc/rpc_sync/rpc_async/
+shutdown with a master-coordinated worker registry).
+
+TPU-native realization: brpc is replaced by multiprocessing.connection
+listeners (authenticated TCP with pickle transport — stdlib, no extra
+deps).  Each worker runs a daemon serving python callables; the master
+address coordinates the name→endpoint registry, exactly the reference's
+WorkerInfo exchange.  Host-side only: device data moves through the
+collective/checkpoint paths, not RPC (same division as the reference).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from concurrent.futures import Future
+from multiprocessing.connection import Listener, Client
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip, port):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+_state = {"workers": {}, "me": None, "listener": None, "thread": None,
+          "authkey": b"paddle_tpu_rpc", "running": False}
+
+
+def _serve_loop():
+    while _state["running"]:
+        try:
+            conn = _state["listener"].accept()
+        except OSError:
+            break
+        threading.Thread(target=_handle, args=(conn,), daemon=True).start()
+
+
+def _handle(conn):
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                return
+            kind = msg[0]
+            if kind == "call":
+                _, fn, args, kwargs = msg
+                try:
+                    result = fn(*args, **(kwargs or {}))
+                    conn.send(("ok", result))
+                except Exception as e:  # serialize the failure
+                    conn.send(("err", e))
+            elif kind == "register":
+                _, info = msg
+                _state["workers"][info.name] = info
+                conn.send(("ok", list(_state["workers"].values())))
+            elif kind == "workers":
+                conn.send(("ok", list(_state["workers"].values())))
+            elif kind == "bye":
+                conn.send(("ok", None))
+                return
+    finally:
+        conn.close()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """reference: rpc.py init_rpc — start the agent + register with master."""
+    rank = rank if rank is not None else int(os.environ.get(
+        "PADDLE_TRAINER_ID", "0"))
+    master = master_endpoint or os.environ.get("PADDLE_MASTER_ENDPOINT",
+                                               "127.0.0.1:29590")
+    ip = "127.0.0.1"
+    listener = Listener((ip, 0), authkey=_state["authkey"])
+    port = listener.address[1]
+    me = WorkerInfo(name, rank, ip, port)
+    _state.update(me=me, listener=listener, running=True)
+    _state["workers"][name] = me
+    t = threading.Thread(target=_serve_loop, daemon=True)
+    t.start()
+    _state["thread"] = t
+
+    mhost, mport = master.rsplit(":", 1)
+    if rank == 0:
+        # rank0 IS the master registry; rebind listener already done — also
+        # listen on the master port for registrations
+        reg = Listener((mhost, int(mport)), authkey=_state["authkey"])
+        _state["master_listener"] = reg
+
+        def master_loop():
+            while _state["running"]:
+                try:
+                    conn = reg.accept()
+                except OSError:
+                    return
+                threading.Thread(target=_handle, args=(conn,),
+                                 daemon=True).start()
+
+        threading.Thread(target=master_loop, daemon=True).start()
+    else:
+        for _ in range(50):  # wait for master
+            try:
+                c = Client((mhost, int(mport)), authkey=_state["authkey"])
+                c.send(("register", me))
+                status, workers = c.recv()
+                c.close()
+                for w in workers:
+                    _state["workers"][w.name] = w
+                break
+            except (ConnectionRefusedError, OSError):
+                time.sleep(0.2)
+        else:
+            raise TimeoutError(f"cannot reach rpc master at {master}")
+    return me
+
+
+def _connect(to):
+    info = _state["workers"].get(to)
+    if info is None:
+        raise ValueError(f"unknown worker {to!r}; known: "
+                         f"{sorted(_state['workers'])}")
+    return Client((info.ip, info.port), authkey=_state["authkey"])
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
+    """reference: rpc.py rpc_sync — blocking remote call."""
+    c = _connect(to)
+    try:
+        c.send(("call", fn, tuple(args or ()), kwargs))
+        status, payload = c.recv()
+    finally:
+        c.close()
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
+    """reference: rpc.py rpc_async — returns a Future."""
+    fut: Future = Future()
+
+    def run():
+        try:
+            fut.set_result(rpc_sync(to, fn, args=args, kwargs=kwargs))
+        except Exception as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=run, daemon=True).start()
+    fut.wait = fut.result  # reference API parity
+    return fut
+
+
+def get_worker_info(name):
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return list(_state["workers"].values())
+
+
+def get_current_worker_info():
+    return _state["me"]
+
+
+def shutdown():
+    _state["running"] = False
+    for key in ("listener", "master_listener"):
+        lst = _state.get(key)
+        if lst is not None:
+            try:
+                lst.close()
+            except OSError:
+                pass
+    _state["workers"].clear()
+    _state["me"] = None
