@@ -181,7 +181,11 @@ int ptq_push(void* qp, const void* buf, uint64_t len, double timeout_s) {
     int rc = timeout_s > 0
                  ? pthread_cond_timedwait(&h->not_full, &h->mu, &ts)
                  : pthread_cond_wait(&h->not_full, &h->mu);
-    if (rc == ETIMEDOUT) {
+    if (rc == EOWNERDEAD) {
+      // waiter reacquired the mutex after its owner died — same recovery
+      // as lock(): the ring state is always structurally consistent
+      pthread_mutex_consistent(&h->mu);
+    } else if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -1;
     }
@@ -211,7 +215,9 @@ int64_t ptq_pop(void* qp, void* buf, uint64_t buflen, double timeout_s) {
     int rc = timeout_s > 0
                  ? pthread_cond_timedwait(&h->not_empty, &h->mu, &ts)
                  : pthread_cond_wait(&h->not_empty, &h->mu);
-    if (rc == ETIMEDOUT) {
+    if (rc == EOWNERDEAD) {
+      pthread_mutex_consistent(&h->mu);
+    } else if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -1;
     }

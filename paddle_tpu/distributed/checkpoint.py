@@ -45,6 +45,39 @@ def _flatten_state(obj, prefix=""):
     return flat
 
 
+def _restore_into(obj, restored, prefix=""):
+    """Mirror-walk of _flatten_state that writes restored values BACK into
+    the nested structure: Tensor leaves get their arrays swapped in-place;
+    non-Tensor leaves (optimizer step counts, LR-scheduler scalars) are
+    replaced with the restored value coerced to the original python type."""
+    if isinstance(obj, Tensor):
+        obj._data_ = restored[prefix or "value"]
+        return obj
+    if isinstance(obj, dict):
+        for k in obj:
+            key = f"{prefix}.{k}" if prefix else str(k)
+            obj[k] = _restore_into(obj[k], restored, key)
+        return obj
+    if isinstance(obj, list):
+        for i in range(len(obj)):  # in place: callers may hold aliases
+            obj[i] = _restore_into(obj[i], restored,
+                                   f"{prefix}.{i}" if prefix else str(i))
+        return obj
+    if isinstance(obj, tuple):
+        items = [_restore_into(v, restored,
+                               f"{prefix}.{i}" if prefix else str(i))
+                 for i, v in enumerate(obj)]
+        if hasattr(obj, "_fields"):  # namedtuple takes positional fields
+            return type(obj)(*items)
+        return type(obj)(items)
+    if obj is not None and prefix and prefix in restored:
+        val = restored[prefix]
+        if isinstance(obj, (bool, int, float)):
+            return type(obj)(np.asarray(val).item())
+        return val
+    return obj
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, async_save=False):
     """Sharded save: every host writes only the shards it owns
@@ -81,10 +114,7 @@ def load_state_dict(state_dict, path, process_group=None,
             a = np.asarray(v)
             targets[k] = jax.ShapeDtypeStruct(a.shape, a.dtype)
     restored = ckptr.restore(path, targets)
-    for k, v in flat.items():
-        if isinstance(v, Tensor):
-            v._data_ = restored[k]
-    return state_dict
+    return _restore_into(state_dict, restored)
 
 
 class DistributedSaver:

@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ......core.dispatch import apply_op
+from ......core.state import next_rng_key
 from .naive_gate import NaiveGate
 
 
@@ -79,10 +80,15 @@ class GShardGate(NaiveGate):
         n = logits.shape[0]
         factor = self.capacity_factor[0 if train else 1]
         cap = int(max(1, factor * n / self.tot_expert * self.top_k))
+        # reference GShard randomly drops the 2nd expert in training,
+        # proportional to its weight — thread a key from the framework
+        # key stream so it actually happens (and stays reproducible)
+        use_rr = self.random_routing and train
+        key = next_rng_key() if use_rr else None
 
         def fn(lg):
-            return _gshard_dispatch(lg, cap, key=None,
-                                    random_routing=False)
+            return _gshard_dispatch(lg, cap, key=key,
+                                    random_routing=use_rr)
 
         combine, dispatch, aux = apply_op("gshard_gate", fn, (logits,))
         self.set_loss(aux)

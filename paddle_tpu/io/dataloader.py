@@ -142,12 +142,20 @@ class DataLoader:
         try:
             for want in range(n_batches):
                 while want not in pending:
-                    if all(p.exitcode not in (None, 0) for p in procs):
-                        raise RuntimeError(
-                            "all DataLoader workers died; see stderr")
                     try:
                         i, batch = out_q.get(timeout=5.0)
                     except TimeoutError:
+                        # fail fast only when the batch we are waiting on
+                        # belongs to a crashed worker (batch i is produced
+                        # by worker i % nw) — a worker that died AFTER
+                        # delivering, or a slow-but-live worker, is fine
+                        owner = procs[want % nw]
+                        if owner.exitcode not in (None, 0):
+                            raise RuntimeError(
+                                f"DataLoader worker {want % nw} exited "
+                                f"unexpectedly (code {owner.exitcode}) "
+                                f"before delivering batch {want}; "
+                                f"see stderr")
                         continue
                     pending[i] = batch
                 yield self.collate_fn(pending.pop(want))

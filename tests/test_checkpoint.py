@@ -87,3 +87,20 @@ def test_save_model_and_optimizer(tmp_path):
                                np.asarray(model.weight._data_))
     np.testing.assert_allclose(
         np.asarray(opt2._state["moment1"][0]._data_), m1_ref)
+
+
+def test_non_tensor_leaves_restored(tmp_path):
+    """Scalar leaves (optimizer step counts, LR scheduler state) must
+    round-trip, not silently keep the in-memory values (ADVICE r1)."""
+    state = {"model": {"w": paddle.to_tensor(np.ones((2, 2), np.float32))},
+             "step_count": 7, "lr": 0.125, "flag": True}
+    p = str(tmp_path / "scalars")
+    dist.save_state_dict(state, p)
+
+    fresh = {"model": {"w": paddle.to_tensor(np.zeros((2, 2), np.float32))},
+             "step_count": 0, "lr": 1.0, "flag": False}
+    dist.load_state_dict(fresh, p)
+    assert fresh["step_count"] == 7 and isinstance(fresh["step_count"], int)
+    assert fresh["lr"] == 0.125
+    assert fresh["flag"] is True
+    np.testing.assert_allclose(fresh["model"]["w"].numpy(), 1.0)
