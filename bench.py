@@ -18,22 +18,72 @@ import numpy as np
 # Seconds to wait for the TPU claim before falling back to CPU.  The axon
 # tunnel claims the one chip per process and a stale lease can wedge
 # jax.devices() indefinitely — probe in a subprocess first so the bench
-# never hangs the driver.
+# never hangs the driver.  Retries with backoff: a claim blocked by a
+# dying straggler process frees up when that process exits.
 _PROBE_TIMEOUT = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "240"))
+_PROBE_RETRIES = int(os.environ.get("BENCH_TPU_PROBE_RETRIES", "3"))
+
+
+def _log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _other_jax_processes():
+    """Other live python processes that may hold the single TPU claim."""
+    me = os.getpid()
+    procs = []
+    try:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == me:
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmd = f.read().replace(b"\0", b" ").decode(
+                        errors="replace").strip()
+                if "python" in cmd and "bench.py" not in cmd:
+                    procs.append((int(pid), cmd[:120]))
+            except OSError:
+                continue
+    except OSError:
+        pass
+    return procs
 
 
 def _tpu_reachable():
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        _log("JAX_PLATFORMS=cpu set — skipping TPU probe")
         return False
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d=jax.devices(); "
-             "import sys; sys.exit(0 if d else 1)"],
-            timeout=_PROBE_TIMEOUT, capture_output=True)
-        return r.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+    for attempt in range(1, _PROBE_RETRIES + 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d=jax.devices(); "
+                 "import sys; sys.exit(0 if d else 1)"],
+                timeout=_PROBE_TIMEOUT, capture_output=True)
+            if r.returncode == 0:
+                _log(f"TPU probe succeeded (attempt {attempt})")
+                return True
+            tail = r.stderr.decode(errors="replace").strip()[-500:]
+            _log(f"TPU probe attempt {attempt}/{_PROBE_RETRIES} exited "
+                 f"rc={r.returncode}; stderr tail: {tail!r}")
+        except subprocess.TimeoutExpired as e:
+            tail = (e.stderr or b"").decode(errors="replace").strip()[-500:]
+            _log(f"TPU probe attempt {attempt}/{_PROBE_RETRIES} timed out "
+                 f"after {_PROBE_TIMEOUT:.0f}s (claim never granted); "
+                 f"stderr tail: {tail!r}")
+            others = _other_jax_processes()
+            if others:
+                _log(f"possible claim holders (other python procs): "
+                     f"{others}")
+        except OSError as e:
+            _log(f"TPU probe attempt {attempt} failed to launch: {e}")
+        if attempt < _PROBE_RETRIES:
+            backoff = 30 * attempt
+            _log(f"backing off {backoff}s before retry")
+            time.sleep(backoff)
+    _log("TPU unreachable after all probe attempts — falling back to CPU "
+         "smoke (metric will say cpu_smoke; NOT a TPU measurement)")
+    return False
 
 
 def _ensure_backend():
@@ -103,7 +153,12 @@ def main():
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * steps / dt
-    flops_per_token = model.flops_per_token(seq)
+    # analytic FLOPs from registry metadata: one counted eager forward
+    # (profiler-computed, not a per-model hand formula)
+    from paddle_tpu.profiler import count_flops
+    with paddle.no_grad():
+        _, fc = count_flops(model, x, labels=y)
+    flops_per_token = fc.train_step_flops / (batch * seq)
     # v5e peak ~197 TFLOPs bf16; v5p ~459; default to v5e unless told
     peak = float(os.environ.get("TPU_PEAK_TFLOPS",
                                 "197" if on_tpu else "0.5")) * 1e12

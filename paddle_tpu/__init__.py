@@ -101,6 +101,10 @@ from . import fft  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
+
+# populate registry flops metadata once every op module has registered
+from .ops.flops import attach_all as _attach_flops  # noqa: E402
+_attach_flops()
 from .hapi import Model  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 

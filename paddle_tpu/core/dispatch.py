@@ -85,6 +85,12 @@ def apply_op(name, fn, args, static=None, nondiff=False):
     single = not isinstance(out, (tuple, list))
     outs = (out,) if single else tuple(out)
 
+    fc = _state.STATE.flops_counter
+    if fc is not None:
+        fc.add(name,
+               tuple(tuple(getattr(a, "shape", ())) for a in arrays),
+               static)
+
     # NaN/Inf scanning of every op output when FLAGS_check_nan_inf is set
     # (reference: eager nan_inf_utils.h:38 + FLAGS_check_nan_inf,
     # phi/core/flags.cc:74).  Only active eagerly — tracers are symbolic.

@@ -30,6 +30,8 @@ class _FrameworkState(threading.local):
         self.rng_counter = 0
         # trace mode (set by paddle_tpu.jit tracer while tracing)
         self.tracer = None
+        # active ops/flops.FlopsCounter (profiler MFU accounting)
+        self.flops_counter = None
 
 
 STATE = _FrameworkState()

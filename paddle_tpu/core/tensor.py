@@ -107,7 +107,13 @@ class Tensor:
             return "traced"
 
     # ---------------- host interop ----------------
-    def numpy(self):
+    def numpy(self, _bool_read=False):
+        tr = _state.STATE.tracer
+        if tr is not None and hasattr(tr, "host_read"):
+            # to_static guard machinery (jit/tracer.py): discovery records
+            # the value; bind replays it (guarding bool branch conditions
+            # in-graph, graph-breaking on other traced host reads)
+            return tr.host_read(self, bool_read=_bool_read)
         return np.asarray(self._data)
 
     def item(self, *args):
@@ -129,7 +135,9 @@ class Tensor:
         return int(self.item())
 
     def __bool__(self):
-        return bool(self.numpy())
+        # branch conditions: under to_static these become guarded program
+        # outputs, so data-dependent python `if`s compile (SOT analog)
+        return bool(self.numpy(_bool_read=True))
 
     def __len__(self):
         if self.ndim == 0:

@@ -53,14 +53,11 @@ def _split_micro(tensor, n):
 
 
 class _ScheduleMixin:
-    """Shared 1F1B bookkeeping: the schedule is the canonical warmup /
-    steady 1F1B / cooldown sequence (reference pipeline_parallel.py:397);
-    single-controller execution issues them in that order."""
-
-    def _steps(self, n_micro):
-        num_warmup = min(self._num_stages - 1, n_micro)
-        steady = n_micro - num_warmup
-        return num_warmup, steady
+    """Host-scheduled fallback: sequential grad accumulation over
+    micro-batches (numerically identical to any pipeline schedule).  The
+    REAL pipelining lives in pipeline_spmd.SPMDPipeline — a single
+    compiled shard_map/ppermute program; this path exists for stage
+    structures that cannot be stacked (heterogeneous parts)."""
 
     def _forward_step(self, micro, labels=None):
         out = self._layers(micro) if labels is None else \
@@ -110,14 +107,65 @@ class PipelineParallel(Layer, _ScheduleMixin):
         self._n_micro = int(cfg.get("accumulate_steps", 1))
         self._loss_fn = layers._loss_fn
         self.total_loss = None
+        # schedule selection: "spmd" = single-program collective-permute
+        # pipelining (requires stackable stages), "host" = sequential
+        # accumulation, "auto" = spmd when possible
+        schedule = cfg.get("schedule", "auto")
+        self._spmd = None
+        if schedule in ("auto", "spmd") and self._num_stages > 1:
+            from .pipeline_spmd import SPMDPipeline, NotHomogeneous
+            try:
+                self._spmd = SPMDPipeline(
+                    layers, n_micro=self._n_micro,
+                    remat=bool(cfg.get("remat", True)))
+            except NotHomogeneous as e:
+                if schedule == "spmd":
+                    raise
+                import warnings
+                warnings.warn(
+                    f"pipeline schedule falling back to host-sequential "
+                    f"accumulation (stages not stackable: {e})")
+
+    def parameters(self, include_sublayers=True):
+        """Optimizer-visible params: under the SPMD schedule the stacked
+        [S, C, *shape] tensors are authoritative."""
+        if self._spmd is not None:
+            return self._spmd.parameters()
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        if self._spmd is not None:
+            self._spmd.write_back()
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        out = self._layers.set_state_dict(state_dict, *args, **kwargs)
+        if self._spmd is not None:
+            self._spmd.read_from_layers()
+        return out
 
     def forward(self, x):
+        if self._spmd is not None:
+            self._spmd.write_back()  # global-view fwd reads per-part params
         return self._layers(x)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """One pipeline-scheduled optimizer step over `data`
         (reference: pipeline_parallel.py:600)."""
-        self.total_loss = self._run_accumulated(data, scaler=scaler)
+        if self._spmd is not None:
+            inputs, labels = data if isinstance(data, tuple) \
+                and len(data) == 2 else (data, None)
+            loss = self._spmd.run(inputs, labels)
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            self.total_loss = loss.detach()
+            # optimizer.step below mutates the stacked params → per-part
+            # layer params go stale until the next write_back()
+            self._spmd._dirty = True
+        else:
+            self.total_loss = self._run_accumulated(data, scaler=scaler)
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()
@@ -132,6 +180,8 @@ class PipelineParallel(Layer, _ScheduleMixin):
         inputs, labels = data if isinstance(data, tuple) and len(data) == 2 \
             else (data, None)
         from ....core.state import no_grad
+        if self._spmd is not None:
+            self._spmd.write_back()
         with no_grad():
             out = self._layers(inputs)
             if compute_loss and self._loss_fn is not None \

@@ -19,12 +19,20 @@ lazy-tensor capture —
    fused XLA program — the analogue of the reference's whole-program
    InterpreterCore run, but compiled.
 
-No graph breaks: host reads of traced values raise (like JAX), which is the
-portable subset the reference's SOT falls back from.
+Data-dependent control flow (SOT analog, reference python/paddle/jit/sot/):
+`bool(tensor)` branch conditions compile into GUARDED programs — the bool
+is evaluated in-graph, returned as a guard output, and checked against the
+recorded branch on every compiled call; a mismatch re-specializes (one
+compiled entry per guard tuple, like SOT's guard-keyed compile cache).
+Other host reads of traced values (float()/item()/numpy() — values that
+escape into python effects the program can't replay) trigger a GRAPH BREAK:
+the function falls back to eager for that signature with a warning, the
+analog of SOT's piecewise fallback.
 """
 from __future__ import annotations
 
 import functools
+import warnings
 
 import numpy as np
 import jax
@@ -32,6 +40,11 @@ import jax.numpy as jnp
 
 from ..core import state as _state
 from ..core.tensor import Tensor
+
+
+class GraphBreak(Exception):
+    """Raised during a bind trace when the program cannot represent a host
+    interaction; the signature falls back to eager execution."""
 
 
 class _DiscoveryTracer:
@@ -42,6 +55,7 @@ class _DiscoveryTracer:
         self.captured = {}            # id(Tensor) -> Tensor (ordered via list)
         self.capture_list = []
         self.providers = []           # host-value providers, call order
+        self.host_reads = []          # (is_bool_read, recorded value)
         self.rng_counter = 0
         self._rng_provider_registered = False
         self._rng_base_val = None
@@ -59,6 +73,13 @@ class _DiscoveryTracer:
         # writes don't need recording at discovery; mutation targets are
         # collected during the bind trace
         pass
+
+    def host_read(self, t, bool_read=False):
+        """A host read during discovery: record the value so the bind trace
+        can replay the same control-flow path (and guard it)."""
+        val = np.asarray(t._data)     # property read → capture bookkeeping
+        self.host_reads.append((bool_read, val.copy()))
+        return val
 
     def host_input(self, provider):
         self.providers.append(provider)
@@ -80,7 +101,8 @@ class _DiscoveryTracer:
 class _BindTracer:
     """Active while jax.jit traces the pure wrapper."""
 
-    def __init__(self, host_tracers, capture_ids=frozenset()):
+    def __init__(self, host_tracers, capture_ids=frozenset(),
+                 host_reads=()):
         self.created = set()
         self.mutated = {}             # id(Tensor) -> pre-write concrete data
         self.mutated_list = []
@@ -89,6 +111,9 @@ class _BindTracer:
         self.rng_counter = 0
         self._rng_base_val = None
         self.capture_ids = capture_ids
+        self.host_reads = list(host_reads)
+        self.read_idx = 0
+        self.guard_arrays = []        # traced bool-read values → outputs
 
     def on_create(self, t):
         self.created.add(id(t))
@@ -97,22 +122,38 @@ class _BindTracer:
         # a concrete (non-tracer) read of a tensor that is neither a declared
         # capture nor created inside this trace would be silently baked into
         # the program as a constant — a stale-state bug.  Discovery should
-        # have captured it; fail loudly instead.
+        # have captured it; graph-break to eager instead of erroring.
         if (id(t) not in self.capture_ids and id(t) not in self.created
                 and id(t) not in self.mutated
                 and not isinstance(t._data_, jax.core.Tracer)):
-            raise RuntimeError(
-                "to_static bind trace read a concrete tensor that was not "
-                "captured at discovery (shape "
-                f"{tuple(t._data_.shape)}, name={t.name!r}). This usually "
-                "means the traced function's control flow diverged between "
-                "calls; its value would be frozen into the compiled program.")
+            raise GraphBreak(
+                "bind trace read a concrete tensor that was not captured "
+                f"at discovery (shape {tuple(t._data_.shape)}, "
+                f"name={t.name!r}): control flow diverged between calls")
 
     def on_write(self, t):
         i = id(t)
         if i not in self.created and i not in self.mutated:
             self.mutated[i] = t._data_  # original value, pre-write
             self.mutated_list.append(t)
+
+    def host_read(self, t, bool_read=False):
+        """Replay a discovery-recorded host read.  bool reads become guard
+        outputs of the compiled program; other traced reads graph-break."""
+        arr = t._data_
+        if self.read_idx >= len(self.host_reads):
+            raise GraphBreak("host-read sequence diverged from discovery")
+        rec_bool, rec_val = self.host_reads[self.read_idx]
+        self.read_idx += 1
+        if not isinstance(arr, jax.core.Tracer):
+            return np.asarray(arr)
+        if bool_read:
+            self.guard_arrays.append(arr)
+            return rec_val
+        raise GraphBreak(
+            "host read of a traced value (float()/item()/numpy()) — the "
+            "value escapes into python, which a compiled program cannot "
+            "replay; falling back to eager for this signature")
 
     def host_input(self, provider):
         v = self.host_tracers[self.host_idx]
@@ -176,7 +217,7 @@ _WARMUP = object()
 
 class _CompiledEntry:
     __slots__ = ("captures", "providers", "jitted", "mut_targets",
-                 "grad_targets", "out_struct")
+                 "grad_targets", "out_struct", "host_reads", "guard_bools")
 
     def __init__(self):
         self.captures = []
@@ -185,6 +226,21 @@ class _CompiledEntry:
         self.mut_targets = []     # Tensors whose data is replaced after call
         self.grad_targets = []    # Tensors whose .grad is materialized
         self.out_struct = None
+        self.host_reads = []      # discovery-recorded (is_bool, value)
+        self.guard_bools = ()     # the branch bits this entry specializes on
+
+
+class _SigState:
+    """Per-input-signature compile state: guard-keyed entries (SOT's
+    guard-keyed compile cache analog) + eager fallback bookkeeping."""
+
+    __slots__ = ("entries", "last", "eager_only", "rediscoveries")
+
+    def __init__(self):
+        self.entries = {}         # guard tuple -> _CompiledEntry
+        self.last = None
+        self.eager_only = False
+        self.rediscoveries = 0
 
 
 class StaticFunction:
@@ -217,8 +273,8 @@ class StaticFunction:
             # nested to_static: inline into the enclosing trace
             return self._fn(*args, **kwargs)
         key = _signature(args, kwargs)
-        entry = self._cache.get(key)
-        if entry is None:
+        state = self._cache.get(key)
+        if state is None:
             # warm-up: run once fully eager so lazily-initialized persistent
             # state (optimizer moments, step counters, buffers) exists BEFORE
             # discovery — otherwise discovery marks it "created" and the bind
@@ -229,9 +285,11 @@ class StaticFunction:
             result = self._fn(*args, **kwargs)
             self._cache[key] = _WARMUP
             return result
-        if entry is _WARMUP:
+        if state is _WARMUP:
             return self._discover(key, args, kwargs)
-        return self._run_compiled(entry, args, kwargs)
+        if state.eager_only:
+            return self._fn(*args, **kwargs)
+        return self._run_compiled(key, state, args, kwargs)
 
     # ---------------- phase 1: discovery (eager) ----------------
     def _discover(self, key, args, kwargs):
@@ -244,8 +302,16 @@ class StaticFunction:
             _state.STATE.tracer = None
         entry.captures = tracer.capture_list
         entry.providers = tracer.providers
+        entry.host_reads = tracer.host_reads
+        entry.guard_bools = tuple(bool(v) for b, v in tracer.host_reads
+                                  if b)
         self._build(entry, args, kwargs)
-        self._cache[key] = entry
+        state = self._cache.get(key)
+        if not isinstance(state, _SigState):
+            state = _SigState()
+            self._cache[key] = state
+        state.entries[entry.guard_bools] = entry
+        state.last = entry
         return out
 
     # ---------------- phase 2: bind + compile ----------------
@@ -254,60 +320,117 @@ class StaticFunction:
 
         def pure(arg_arrays, cap_arrays, host_vals, arg_struct):
             tracer = _BindTracer(host_vals,
-                                 frozenset(id(t) for t in entry.captures))
+                                 frozenset(id(t) for t in entry.captures),
+                                 host_reads=entry.host_reads)
             saved = [(t, t._data_) for t in entry.captures]
             bound_args, bound_kwargs = _unflatten_args(arg_arrays, arg_struct)
             for t, arr in zip(entry.captures, cap_arrays):
                 t._data_ = arr
             _state.STATE.tracer = tracer
+            captured_ids = {id(t) for t in entry.captures}
             try:
                 out = fn(*bound_args, **bound_kwargs)
+                # collect outputs
+                out_leaves, out_tree = jax.tree.flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                out_arrays, out_spec = [], []
+                for leaf in out_leaves:
+                    if isinstance(leaf, Tensor):
+                        out_arrays.append(leaf._data_)
+                        out_spec.append(None)
+                    else:
+                        out_spec.append(leaf)
+                entry.out_struct = (out_tree, tuple(out_spec))
+                # mutated tensors -> outputs
+                entry.mut_targets = list(tracer.mutated_list)
+                mut_arrays = [t._data_ for t in entry.mut_targets]
+                # escaped gradients on captured tensors -> outputs
+                entry.grad_targets = []
+                grad_arrays = []
+                for t in entry.captures:
+                    g = t.grad
+                    if g is not None and isinstance(g._data_,
+                                                    jax.core.Tracer):
+                        entry.grad_targets.append(t)
+                        grad_arrays.append(g._data_)
+                for t in entry.grad_targets:
+                    t.grad = None
+                return (tuple(out_arrays), tuple(mut_arrays),
+                        tuple(grad_arrays), tuple(tracer.guard_arrays))
             finally:
+                # ALWAYS restore concrete state — a GraphBreak raised
+                # mid-trace must not leak JAX tracers into live tensors
+                # (mutations are applied by the caller from returned arrays)
                 _state.STATE.tracer = None
-            # collect outputs
-            out_leaves, out_tree = jax.tree.flatten(
-                out, is_leaf=lambda x: isinstance(x, Tensor))
-            out_arrays, out_spec = [], []
-            for leaf in out_leaves:
-                if isinstance(leaf, Tensor):
-                    out_arrays.append(leaf._data_)
-                    out_spec.append(None)
-                else:
-                    out_spec.append(leaf)
-            entry.out_struct = (out_tree, tuple(out_spec))
-            # mutated tensors -> outputs
-            entry.mut_targets = list(tracer.mutated_list)
-            mut_arrays = [t._data_ for t in entry.mut_targets]
-            # escaped gradients on captured tensors -> outputs
-            entry.grad_targets = []
-            grad_arrays = []
-            for t in entry.captures:
-                g = t.grad
-                if g is not None and isinstance(g._data_, jax.core.Tracer):
-                    entry.grad_targets.append(t)
-                    grad_arrays.append(g._data_)
-            # restore original concrete data (mutations are applied by the
-            # caller from the returned arrays)
-            captured_ids = {id(t) for t in entry.captures}
-            for t, orig in saved:
-                t._data_ = orig
-            for t in entry.mut_targets:
-                if id(t) not in captured_ids:
-                    # mutated without prior read: restore the pre-write value
-                    # recorded by the tracer so no JAX tracer leaks out
-                    t._data_ = tracer.mutated[id(t)]
-            for t in entry.grad_targets:
-                t.grad = None
-            return tuple(out_arrays), tuple(mut_arrays), tuple(grad_arrays)
+                for t, orig in saved:
+                    t._data_ = orig
+                for t in tracer.mutated_list:
+                    if id(t) not in captured_ids:
+                        # mutated without prior read: restore the pre-write
+                        # value recorded by the tracer
+                        t._data_ = tracer.mutated[id(t)]
+                for t in entry.captures:
+                    g = t.grad
+                    if g is not None and isinstance(g._data_,
+                                                    jax.core.Tracer):
+                        t.grad = None
 
         entry.jitted = jax.jit(pure, static_argnums=(3,))
 
-    def _run_compiled(self, entry, args, kwargs):
+    def _run_compiled(self, key, state, args, kwargs, _depth=0):
+        entry = state.last
         arg_arrays, arg_struct = _flatten_args(args, kwargs)
         cap_arrays = [t._data_ for t in entry.captures]
         host_vals = [p() for p in entry.providers]
-        out_arrays, mut_arrays, grad_arrays = entry.jitted(
-            arg_arrays, cap_arrays, host_vals, arg_struct)
+        try:
+            out_arrays, mut_arrays, grad_arrays, guard_arrays = \
+                entry.jitted(arg_arrays, cap_arrays, host_vals, arg_struct)
+        except GraphBreak as e:
+            # the program cannot represent this function — eager fallback
+            # for this signature from now on (SOT piecewise-fallback analog)
+            state.eager_only = True
+            warnings.warn(f"to_static graph break ({e}); running "
+                          f"{getattr(self._fn, '__name__', '?')} eagerly "
+                          f"for this input signature")
+            return self._fn(*args, **kwargs)
+
+        # guard check BEFORE applying mutations: a mismatch means the
+        # compiled program followed the wrong branch and its outputs are
+        # invalid for this call
+        actual = tuple(bool(np.asarray(g)) for g in guard_arrays)
+        if actual != entry.guard_bools:
+            alt = state.entries.get(actual)
+            if alt is None:
+                # nested data-dependent branches: entries can have guard
+                # tuples of different LENGTHS (each branch records its own
+                # downstream guards), so exact lookup misses — match on
+                # the longest consistent prefix; the re-dispatch below
+                # verifies the candidate with its own guards
+                best = None
+                for gb, cand in state.entries.items():
+                    if cand is entry:
+                        continue
+                    n = min(len(gb), len(actual))
+                    if gb[:n] == actual[:n] and (
+                            best is None
+                            or len(gb) > len(best.guard_bools)):
+                        best = cand
+                alt = best
+            if alt is not None and alt is not entry and _depth < 2:
+                state.last = alt
+                return self._run_compiled(key, state, args, kwargs,
+                                          _depth=_depth + 1)
+            state.rediscoveries += 1
+            if state.rediscoveries > 4:
+                state.eager_only = True
+                warnings.warn(
+                    f"to_static: branch guards keep flipping for "
+                    f"{getattr(self._fn, '__name__', '?')}; running this "
+                    f"input signature eagerly")
+                return self._fn(*args, **kwargs)
+            # re-specialize on the new branch (runs eagerly this call)
+            return self._discover(key, args, kwargs)
+
         # apply mutations
         for t, arr in zip(entry.mut_targets, mut_arrays):
             t._data_ = arr

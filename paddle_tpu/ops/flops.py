@@ -1,0 +1,198 @@
+"""FLOPs estimators for compute-relevant registry ops + a step counter.
+
+Reference capability: the `flops` op metadata the reference wires into op
+definitions and its op-benchmark table driving the profiler/auto-parallel
+cost model (reference: paddle/phi/api/yaml/legacy_ops.yaml:679-688 op
+metadata fields; tools/check_op_benchmark_result.py).  TPU-native
+realization: estimators keyed by registry name; `FlopsCounter` hooks the
+dispatch funnel (core/dispatch.apply_op) so ONE eagerly-executed step
+yields the model's analytic FLOPs — that number feeds profiler MFU
+(profiler/timer.py:mfu) for ANY model, replacing per-model hand formulas.
+
+Counting convention: estimators count FORWARD multiply-add FLOPs (2·MACs
+for matmul-family).  A train step is ~3x forward (backward ≈ 2x), the
+standard accounting used by the PaLM/Chinchilla MFU literature.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import OPS
+
+
+def _numel(shape):
+    return int(np.prod(shape)) if len(shape) else 1
+
+
+def _attach(name, fn):
+    op = OPS.get(name)
+    if op is not None:
+        op.flops = fn
+
+
+# ---- matmul family: 2 * batch * m * k * n ----
+def _matmul_like(shapes, **kw):
+    xs, ys = shapes[0], shapes[1]
+    if len(xs) < 2 or len(ys) < 1:
+        return 2 * _numel(xs)
+    m, k = xs[-2], xs[-1]
+    n = ys[-1] if len(ys) >= 2 else 1
+    batch = _numel(xs[:-2])
+    return 2 * batch * m * k * n
+
+
+def _linear_flops(shapes, **kw):
+    xs, ws = shapes[0], shapes[1]
+    return 2 * _numel(xs[:-1]) * xs[-1] * ws[-1]
+
+
+def _conv_flops(shapes, **kw):
+    """2 * out_numel * (Cin/groups) * prod(kernel).  Output spatial size
+    is not in `shapes`; approximate with input spatial size (stride 1,
+    same padding) — an upper bound adequate for MFU accounting."""
+    xs, ws = shapes[0], shapes[1]
+    cout = ws[0]
+    kernel = _numel(ws[2:])
+    cin_per_group = ws[1]
+    spatial = _numel(xs[2:])
+    batch = xs[0]
+    return 2 * batch * cout * spatial * cin_per_group * kernel
+
+
+def _attention_flops(shapes, causal=True, **kw):
+    """QK^T + PV: 2 * 2 * B*H*S^2*D (halved when causal)."""
+    qs = shapes[0]
+    if len(qs) == 4:            # [B, S, H, D]
+        b, s, h, d = qs
+    else:
+        b, s, h, d = 1, qs[0], qs[1], qs[2]
+    full = 4 * b * h * s * s * d
+    return full // 2 if causal else full
+
+
+def _norm_flops(shapes, **kw):
+    return 8 * _numel(shapes[0])     # mean/var/normalize/affine passes
+
+
+def _softmax_flops(shapes, **kw):
+    return 5 * _numel(shapes[0])     # max, sub, exp, sum, div
+
+
+def _xent_flops(shapes, **kw):
+    return 6 * _numel(shapes[0])
+
+
+def _embedding_flops(shapes, **kw):
+    return 0                          # gather: no multiply-adds
+
+
+def _elementwise(k):
+    def fn(shapes, **kw):
+        return k * _numel(shapes[0])
+    return fn
+
+
+_ESTIMATORS = {
+    "matmul": _matmul_like,
+    "bmm": _matmul_like,
+    "mv": _matmul_like,
+    "dot": _elementwise(2),
+    "linear": _linear_flops,
+    "conv1d": _conv_flops,
+    "conv2d": _conv_flops,
+    "conv3d": _conv_flops,
+    "conv2d_transpose": _conv_flops,
+    "flash_attention": _attention_flops,
+    "ring_flash_attention": _attention_flops,
+    "ulysses_attention": _attention_flops,
+    "scaled_dot_product_attention": _attention_flops,
+    "layer_norm": _norm_flops,
+    "rms_norm": _norm_flops,
+    "fused_rms_norm": _norm_flops,
+    "group_norm": _norm_flops,
+    "instance_norm": _norm_flops,
+    "batch_norm_infer": _norm_flops,
+    "batch_norm": _norm_flops,
+    "softmax": _softmax_flops,
+    "log_softmax": _softmax_flops,
+    "cross_entropy": _xent_flops,
+    "softmax_with_cross_entropy": _xent_flops,
+    "binary_cross_entropy": _xent_flops,
+    "binary_cross_entropy_with_logits": _xent_flops,
+    "embedding": _embedding_flops,
+    "gelu": _elementwise(10),
+    "silu": _elementwise(5),
+    "relu": _elementwise(1),
+    "tanh": _elementwise(5),
+    "sigmoid": _elementwise(4),
+    "add": _elementwise(1),
+    "multiply": _elementwise(1),
+    "mean": _elementwise(1),
+    "sum": _elementwise(1),
+    "dropout": _elementwise(2),
+    "fused_rope": _elementwise(6),
+    "fused_rotary_position_embedding": _elementwise(6),
+    "fused_bias_act": _elementwise(11),
+}
+
+
+def attach_all():
+    """Populate registry `flops` metadata (idempotent)."""
+    for name, fn in _ESTIMATORS.items():
+        _attach(name, fn)
+
+
+class FlopsCounter:
+    """Accumulates per-op forward FLOPs through the dispatch funnel.
+
+    Usage:
+        with FlopsCounter() as fc:
+            loss = model(x, labels=y)     # one EAGER forward
+        fc.forward_flops     # analytic fwd FLOPs
+        fc.train_step_flops  # 3x (fwd + ~2x bwd)
+        fc.by_op             # {op name: flops}
+        fc.uncounted         # op names seen with no estimator
+    """
+
+    def __init__(self):
+        self.by_op = {}
+        self.uncounted = set()
+
+    def add(self, name, shapes, static):
+        op = OPS.get(name)
+        est = op.flops if op is not None else None
+        if est is None:
+            self.uncounted.add(name)
+            return
+        try:
+            f = int(est(shapes, **static))
+        except Exception:
+            self.uncounted.add(name)
+            return
+        self.by_op[name] = self.by_op.get(name, 0) + f
+
+    @property
+    def forward_flops(self):
+        return sum(self.by_op.values())
+
+    @property
+    def train_step_flops(self):
+        return 3 * self.forward_flops
+
+    def __enter__(self):
+        from ..core import state as _state
+        self._prev = getattr(_state.STATE, "flops_counter", None)
+        _state.STATE.flops_counter = self
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import state as _state
+        _state.STATE.flops_counter = self._prev
+        return False
+
+
+def count_flops(fn, *args, **kwargs):
+    """Run `fn` eagerly under a FlopsCounter; return (result, counter)."""
+    with FlopsCounter() as fc:
+        out = fn(*args, **kwargs)
+    return out, fc

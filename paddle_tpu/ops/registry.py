@@ -5,8 +5,16 @@ Reference capability: the declarative YAML op definitions
 the C++ API, autograd nodes and SPMD rules.  TPU-native realization: a runtime
 registry — the "codegen" targets collapse because JAX provides autodiff
 (jax.vjp) and GSPMD provides sharding propagation; what remains useful is a
-queryable table of {name → impl, differentiability, spmd rule, flops fn} used
-by introspection, AMP lists, the profiler and the auto-parallel layer.
+queryable table of {name → impl, differentiability, flops fn} used by
+introspection, AMP lists and the profiler's MFU accounting (ops/flops.py).
+
+The reference's per-op SPMD rules (reference:
+paddle/phi/infermeta/spmd_rules/, 28 rule files) have NO per-op analog here
+by design: GSPMD propagates shardings through every op, and the cases that
+genuinely need manual placement (vocab-parallel embedding/cross-entropy,
+sequence-parallel boundaries) are expressed as explicit sharding
+constraints in the layer library (fleet/mp_layers.py) and the reshard API
+(distributed/placement.py) instead of per-op metadata.
 """
 from __future__ import annotations
 
@@ -19,17 +27,16 @@ class OpDef:
     name: str
     fn: Callable                      # pure JAX implementation
     nondiff: bool = False             # no gradient defined
-    spmd_rule: Optional[Callable] = None   # sharding propagation hint
-    flops: Optional[Callable] = None       # flops estimator for profiler/MFU
+    flops: Optional[Callable] = None  # flops estimator for profiler/MFU
     tags: tuple = field(default_factory=tuple)
 
 
 OPS: dict[str, OpDef] = {}
 
 
-def register_op(name, fn, nondiff=False, spmd_rule=None, flops=None, tags=()):
-    OPS[name] = OpDef(name, fn, nondiff=nondiff, spmd_rule=spmd_rule,
-                      flops=flops, tags=tuple(tags))
+def register_op(name, fn, nondiff=False, flops=None, tags=()):
+    OPS[name] = OpDef(name, fn, nondiff=nondiff, flops=flops,
+                      tags=tuple(tags))
     return OPS[name]
 
 

@@ -3,5 +3,6 @@ from .profiler import (  # noqa: F401
     export_chrome_tracing, export_protobuf, load_profiler_result,
 )
 from .timer import benchmark, TimerHub, mfu  # noqa: F401
+from ..ops.flops import FlopsCounter, count_flops  # noqa: F401
 from . import profiler_statistic  # noqa: F401
 from .profiler_statistic import SortedKeys, summary  # noqa: F401

@@ -8,7 +8,6 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import defop
-from ..ops.registry import OPS
 
 
 @defop("matmul")
@@ -21,18 +20,6 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     if x.dtype in (jnp.bfloat16, jnp.float16):
         return jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
     return jnp.matmul(x, y)
-
-
-def _matmul_flops(shapes, **kw):
-    xs, ys = shapes[0], shapes[1]
-    m, k = xs[-2], xs[-1]
-    n = ys[-1]
-    import numpy as np
-    batch = int(np.prod(xs[:-2])) if len(xs) > 2 else 1
-    return 2 * batch * m * k * n
-
-
-OPS["matmul"].flops = _matmul_flops
 
 
 @defop("transpose")
