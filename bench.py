@@ -49,9 +49,34 @@ def _other_jax_processes():
     return procs
 
 
+def _relay_up():
+    """Fast preflight: the axon claim rides a local TCP relay to the pool
+    (PALLAS_AXON_POOL_IPS).  If nothing accepts on the relay ports the
+    claim can never be granted — fail fast with a diagnosis instead of
+    burning probe timeouts."""
+    import socket
+    pool = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+    if not pool:
+        return True  # no relay configured; let the probe decide
+    host = pool.split(",")[0]
+    ports = (8082, 8083, 8087, 8092)
+    for port in ports:
+        try:
+            with socket.create_connection((host, port), timeout=3):
+                return True
+        except OSError:
+            continue
+    _log(f"axon relay tunnel is DOWN: no listener on {host} ports {ports} "
+         f"— the TPU claim cannot be granted (relay process dead or not "
+         f"started).  Falling back to CPU smoke immediately.")
+    return False
+
+
 def _tpu_reachable():
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         _log("JAX_PLATFORMS=cpu set — skipping TPU probe")
+        return False
+    if not _relay_up():
         return False
     for attempt in range(1, _PROBE_RETRIES + 1):
         try:

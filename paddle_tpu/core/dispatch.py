@@ -61,17 +61,33 @@ def apply_op(name, fn, args, static=None, nondiff=False):
         bound.apply_defaults()
         args = tuple(bound.arguments.values())
         static = {}
-    tensor_idx = tuple(i for i, a in enumerate(args) if isinstance(a, Tensor))
-    tensors = tuple(args[i] for i in tensor_idx)
+    # Tensors may sit at a top-level position or inside a list/tuple arg
+    # (concat/stack-style ops) — both must flow through the vjp path, not
+    # be captured as constants.  Only promote a sequence when EVERY element
+    # is a Tensor: shape-like lists mixing Tensors with ints (reshape's
+    # [n, -1]) must stay concrete so the op impl can call int() on them.
+    tensor_paths = []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            tensor_paths.append((i, None))
+        elif isinstance(a, (list, tuple)) and a and \
+                all(isinstance(b, Tensor) for b in a):
+            for j in range(len(a)):
+                tensor_paths.append((i, j))
+    tensors = tuple(args[i] if j is None else args[i][j]
+                    for i, j in tensor_paths)
     arrays = [t._data for t in tensors]
 
     if _state.STATE.amp_level in ("O1", "O2"):
         arrays = _amp_cast(name, arrays)
 
     def pure(*xs):
-        full = list(args)
-        for i, x in zip(tensor_idx, xs):
-            full[i] = x
+        full = [list(a) if isinstance(a, (list, tuple)) else a for a in args]
+        for (i, j), x in zip(tensor_paths, xs):
+            if j is None:
+                full[i] = x
+            else:
+                full[i][j] = x
         return fn(*full, **static)
 
     need_grad = (_state.STATE.grad_enabled and not nondiff

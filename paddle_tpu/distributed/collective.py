@@ -142,6 +142,11 @@ def _multiproc_collective(local, group, jitted_fn):
 # collectives
 # ---------------------------------------------------------------------------
 
+_REDUCERS = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+             ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
+             ReduceOp.AVG: jnp.mean}
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce of `tensor` across the group
     (reference: communication/all_reduce.py)."""
@@ -149,9 +154,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     x = _as_array(tensor)
     if group.nranks <= 1:
         return tensor
-    reducer = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
-               ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
-               ReduceOp.AVG: jnp.mean}[op]
+    reducer = _REDUCERS[op]
 
     def prog(garr, mesh):
         out = jax.jit(lambda a: reducer(a, axis=0),
@@ -208,8 +211,19 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce to `dst`: every rank participates, only dst's buffer is
+    updated (reference semantics: process_group.h:172 — non-dst outputs
+    are unspecified, the reference leaves them untouched)."""
     group = group or _get_default_group()
+    if group.nranks <= 1:
+        return tensor
+    before = _as_array(tensor)
     out = all_reduce(tensor, op=op, group=group)
+    if _env.get_rank() != dst:
+        if isinstance(tensor, Tensor):
+            tensor._data_ = before
+            return tensor
+        return _wrap(before)
     return out
 
 
@@ -234,41 +248,76 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
-    """reference: communication/reduce_scatter.py"""
+    """Real reduce-scatter: the compiled program's output is SHARDED over
+    the group axis, so XLA lowers it to a reduce-scatter collective — each
+    rank only materializes its own slice (reference:
+    communication/reduce_scatter.py over ProcessGroup::ReduceScatter)."""
     group = group or _get_default_group()
     if group.nranks <= 1:
         tensor._data_ = _as_array(tensor_list[0])
         return tensor
     stacked = jnp.stack([_as_array(t) for t in tensor_list])
-    summed = all_reduce(_wrap(stacked), op=op, group=group)
-    tensor._data_ = summed._data_[group.rank]
+    reducer = _REDUCERS[op]
+
+    def prog(garr, mesh):
+        # garr: [g(sharded), nranks, ...] → sum over g, shard result rows
+        out = jax.jit(lambda a: reducer(a, axis=0),
+                      out_shardings=jax.sharding.NamedSharding(
+                          mesh, jax.sharding.PartitionSpec("g")))(garr)
+        return np.asarray(out.addressable_shards[0].data)[0]
+
+    res = _multiproc_collective(stacked, group, prog)
+    tensor._data_ = jnp.asarray(res)
     return tensor
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
-    """reference: communication/all_to_all.py"""
+    """Real all-to-all: transpose the (source, destination) axes of the
+    global array with a sharded output — XLA lowers it to an all-to-all
+    collective, not an all-gather (reference: communication/all_to_all.py)."""
     group = group or _get_default_group()
     if group.nranks <= 1:
         out_tensor_list.extend(_wrap(_as_array(t)) for t in in_tensor_list)
         return out_tensor_list
     stacked = jnp.stack([_as_array(t) for t in in_tensor_list])
-    gathered = all_gather(None, _wrap(stacked), group=group)
-    me = group.rank
+
+    def prog(garr, mesh):
+        # garr: [src(g), dst, ...] → [dst(g), src, ...]
+        out = jax.jit(lambda a: jnp.swapaxes(a, 0, 1),
+                      out_shardings=jax.sharding.NamedSharding(
+                          mesh, jax.sharding.PartitionSpec("g")))(garr)
+        return np.asarray(out.addressable_shards[0].data)[0]
+
+    res = _multiproc_collective(stacked, group, prog)
     for r in range(group.nranks):
-        out_tensor_list.append(_wrap(gathered[r]._data_[me]))
+        out_tensor_list.append(_wrap(jnp.asarray(res[r])))
     return out_tensor_list
+
+
+_PAIR_GROUPS: dict = {}
+
+
+def _pair_group(a, b):
+    """Cached 2-rank groups: send/recv must not build a fresh Group (and
+    Mesh) per call."""
+    key = (a, b) if a < b else (b, a)
+    g = _PAIR_GROUPS.get(key)
+    if g is None:
+        g = new_group(list(key))
+        _PAIR_GROUPS[key] = g
+    return g
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
     """Point-to-point send.  Eager p2p between processes is realized as a
-    sub-group broadcast (XLA collective-permute in-graph is the fast path —
-    see functional.ppermute)."""
+    cached sub-group broadcast (XLA collective-permute in-graph is the fast
+    path — see functional.ppermute)."""
     group = group or _get_default_group()
     if group.nranks <= 1:
         _P2P_BUF.append(_as_array(tensor))
         return tensor
-    pair = new_group([_env.get_rank(), dst])
-    return broadcast(tensor, src=_env.get_rank(), group=pair)
+    return broadcast(tensor, src=_env.get_rank(),
+                     group=_pair_group(_env.get_rank(), dst))
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
@@ -277,8 +326,8 @@ def recv(tensor, src=0, group=None, sync_op=True):
         if _P2P_BUF:
             tensor._data_ = _P2P_BUF.pop(0)
         return tensor
-    pair = new_group([src, _env.get_rank()])
-    return broadcast(tensor, src=src, group=pair)
+    return broadcast(tensor, src=src,
+                     group=_pair_group(src, _env.get_rank()))
 
 
 _P2P_BUF: list = []

@@ -27,8 +27,20 @@ def init_parallel_env(coordinator_address=None, num_processes=None,
     pid = process_id if process_id is not None else int(os.environ.get(
         "PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
     if coord and nproc > 1:
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=nproc, process_id=pid)
+        try:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=nproc, process_id=pid)
+        except RuntimeError as e:
+            # tolerate an earlier direct jax.distributed.initialize (it must
+            # run before any backend touch, so callers may do it themselves)
+            # — but ONLY when the distributed client really exists; a
+            # too-late init with no client is a genuine failure.
+            from jax._src import distributed as _jd
+            if _jd.global_state.client is None:
+                raise RuntimeError(
+                    "jax.distributed.initialize failed and no distributed "
+                    "client exists — init_parallel_env must run before any "
+                    "JAX backend use (build tensors only after it)") from e
     _initialized = True
 
 

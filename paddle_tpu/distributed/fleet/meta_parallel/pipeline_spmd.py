@@ -66,10 +66,23 @@ def _items_params(items):
 
 
 def _sig(items):
-    """Stackability signature: per-param (shape, dtype) in traversal order.
-    Parameter-free items (activations) don't affect stackability."""
-    return tuple((tuple(p._data_.shape), str(p._data_.dtype))
-                 for p in _items_params(items))
+    """Stackability signature: per-item structural identity (layer class /
+    callable name, forward-func name) plus per-param (shape, dtype).
+    Structure matters, not just parameters — stages with identical params
+    but different param-free ops (ReLU vs Tanh) must NOT stack, because
+    every stacked part executes the template part's ops."""
+    out = []
+    for item, fwd in items:
+        if isinstance(item, Layer):
+            ident = type(item).__name__
+        else:
+            ident = getattr(item, "__qualname__", type(item).__name__)
+        fident = (getattr(fwd, "__qualname__", repr(fwd))
+                  if fwd is not None else None)
+        psig = tuple((tuple(p._data_.shape), str(p._data_.dtype))
+                     for p in _item_params(item))
+        out.append((ident, fident, psig))
+    return tuple(out)
 
 
 def homogenize(parts):
@@ -231,11 +244,11 @@ class SPMDPipeline:
         if not getattr(self, "_dirty", True):
             return
         S = self._S
+        per_part = [_items_params(p) for p in self._body_parts]
         for j, t in enumerate(self.stacked):
             for p_idx, part in enumerate(self._body_parts):
                 s, c = p_idx % S, p_idx // S
-                params = _items_params(part)
-                target = params[j]
+                target = per_part[p_idx][j]
                 sl = t._data_[s, c]
                 if getattr(target, "process_mesh", None) is not None:
                     from ...placement import named_sharding

@@ -134,6 +134,7 @@ class Categorical(Distribution):
     def log_prob(self, value):
         v = _arr(value).astype(jnp.int32)
         logp = jax.nn.log_softmax(self.logits, axis=-1)
+        logp = jnp.broadcast_to(logp, v.shape + logp.shape[-1:])
         return Tensor(jnp.take_along_axis(
             logp, v[..., None], axis=-1)[..., 0])
 
@@ -183,22 +184,92 @@ class Beta(Distribution):
                       - betaln(self.alpha, self.beta))
 
 
-def kl_divergence(p, q):
-    """reference: paddle.distribution.kl_divergence — registered pairs."""
-    if isinstance(p, Normal) and isinstance(q, Normal):
-        var_p, var_q = p.scale ** 2, q.scale ** 2
-        return Tensor(jnp.log(q.scale / p.scale)
-                      + (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
-    if isinstance(p, Categorical) and isinstance(q, Categorical):
-        logp = jax.nn.log_softmax(p.logits, axis=-1)
-        logq = jax.nn.log_softmax(q.logits, axis=-1)
-        return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1))
-    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
-        pp = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
-        qq = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
-        return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
-                      + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
-    if isinstance(p, Uniform) and isinstance(q, Uniform):
-        return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
-    raise NotImplementedError(
-        f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+from .kl import kl_divergence, register_kl  # noqa: E402
+from . import transform  # noqa: E402
+from .transform import (  # noqa: E402, F401
+    Transform, AffineTransform, ExpTransform, PowerTransform,
+    SigmoidTransform, TanhTransform, AbsTransform, SoftmaxTransform,
+    StickBreakingTransform, ChainTransform, IndependentTransform,
+    ReshapeTransform,
+)
+from .families import (  # noqa: E402, F401
+    Exponential, Gamma, Chi2, Dirichlet, Laplace, LogNormal, Geometric,
+    Poisson, Gumbel, Cauchy, StudentT, Binomial, Multinomial,
+    MultivariateNormal, Independent, TransformedDistribution,
+)
+from jax.scipy.special import gammaln as _gammaln, digamma as _digamma  # noqa: E402
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    return (jnp.log(q.scale / p.scale)
+            + (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, axis=-1)
+    logq = jax.nn.log_softmax(q.logits, axis=-1)
+    return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+    return (pp * (jnp.log(pp) - jnp.log(qq))
+            + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return jnp.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from jax.scipy.special import betaln
+    sum_p = p.alpha + p.beta
+    return ((betaln(q.alpha, q.beta) - betaln(p.alpha, p.beta))
+            + (p.alpha - q.alpha) * _digamma(p.alpha)
+            + (p.beta - q.beta) * _digamma(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta) * _digamma(sum_p))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return jnp.log(p.rate) - jnp.log(q.rate) + r - 1.0
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    ap, bp, aq, bq = p.concentration, p.rate, q.concentration, q.rate
+    return ((ap - aq) * _digamma(ap) - _gammaln(ap) + _gammaln(aq)
+            + aq * (jnp.log(bp) - jnp.log(bq)) + ap * (bq / bp - 1.0))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    ap, aq = p.concentration, q.concentration
+    a0 = jnp.sum(ap, -1)
+    return (_gammaln(a0) - jnp.sum(_gammaln(ap), -1)
+            - _gammaln(jnp.sum(aq, -1)) + jnp.sum(_gammaln(aq), -1)
+            + jnp.sum((ap - aq) * (_digamma(ap)
+                                   - _digamma(a0[..., None])), -1))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    d = jnp.abs(p.loc - q.loc)
+    return (jnp.log(q.scale / p.scale)
+            + (p.scale * jnp.exp(-d / p.scale) + d) / q.scale - 1.0)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    pp = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+    return ((1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qq))
+            + jnp.log(pp) - jnp.log(qq))

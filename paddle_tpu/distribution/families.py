@@ -1,0 +1,477 @@
+"""Distribution families beyond the core five (reference:
+python/paddle/distribution/{gamma,dirichlet,exponential,laplace,lognormal,
+geometric,poisson,gumbel,cauchy,student_t,multinomial,binomial,chi2,
+multivariate_normal,independent,transformed_distribution}.py).
+
+Samplers draw from the framework RNG key stream; every log_prob/entropy is
+plain jnp, so downstream losses fuse under jit.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln, digamma, betaln
+
+from ..core.tensor import Tensor
+from ..core import state as _state
+
+
+def _arr(x):
+    return x._data_ if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _f32(x):
+    return _arr(x).astype(jnp.float32)
+
+
+from . import Distribution  # noqa: E402  (base lives in __init__)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _f32(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate ** -2)
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.exponential(key, shp) / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _f32(concentration)
+        self.rate = _f32(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.gamma(key, self.concentration, shp)
+                      / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return Tensor(a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a))
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        self.df = _f32(df)
+        super().__init__(self.df / 2.0, jnp.full_like(self.df, 0.5))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _f32(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        a = self.concentration
+        return Tensor(a / jnp.sum(a, -1, keepdims=True))
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.dirichlet(key, self.concentration, shp))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a = self.concentration
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1)
+                      + gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+
+    def entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        return Tensor(jnp.sum(gammaln(a), -1) - gammaln(a0)
+                      + (a0 - k) * digamma(a0)
+                      - jnp.sum((a - 1) * digamma(a), -1))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * self.scale ** 2,
+                                       self._batch_shape))
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.laplace(key, shp))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale)
+                      + jnp.zeros(self._batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jnp.exp(self.loc + self.scale
+                              * jax.random.normal(key, shp)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lv = jnp.log(v)
+        return Tensor(-((lv - self.loc) ** 2) / (2 * self.scale ** 2)
+                      - lv - jnp.log(self.scale)
+                      - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale) + self.loc
+                      + jnp.zeros(self._batch_shape))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_ = _f32(probs)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs_) / self.probs_)
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(key, shp, jnp.float32, 1e-7, 1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log1p(-p) + jnp.log(p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _f32(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.poisson(key, self.rate,
+                                         shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate - gammaln(v + 1))
+
+    def entropy(self):
+        # second-order Stirling approximation (exact for the common small
+        # rates only via summation; reference uses the same approximation)
+        r = self.rate
+        return Tensor(0.5 * jnp.log(2 * math.pi * math.e * r)
+                      - 1 / (12 * r) - 1 / (24 * r ** 2))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * 0.5772156649015329)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi ** 2 / 6) * self.scale ** 2
+                      + jnp.zeros(self._batch_shape))
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.gumbel(key, shp))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + 0.5772156649015329
+                      + jnp.zeros(self._batch_shape))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.cauchy(key, shp))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z ** 2)))
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale)
+                      + jnp.zeros(self._batch_shape))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _f32(df)
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale
+                      * jax.random.t(key, self.df, shp))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        df = self.df
+        return Tensor(gammaln((df + 1) / 2) - gammaln(df / 2)
+                      - 0.5 * jnp.log(df * math.pi) - jnp.log(self.scale)
+                      - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+
+    def entropy(self):
+        df = self.df
+        return Tensor((df + 1) / 2 * (digamma((df + 1) / 2)
+                                      - digamma(df / 2))
+                      + 0.5 * jnp.log(df) + betaln(df / 2, 0.5)
+                      + jnp.log(self.scale))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _f32(total_count)
+        self.probs_ = _f32(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs_.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.binomial(key, self.total_count,
+                                          self.probs_, shp))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        n, p = self.total_count, jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
+                      + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _f32(probs)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.multinomial(
+            key, self.total_count, self.probs_,
+            shape=shp + self.probs_.shape[-1:]).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs_, 1e-30, None)
+        p = p / jnp.sum(p, -1, keepdims=True)
+        return Tensor(gammaln(jnp.sum(v, -1) + 1)
+                      - jnp.sum(gammaln(v + 1), -1)
+                      + jnp.sum(v * jnp.log(p), -1))
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _f32(loc)
+        if scale_tril is not None:
+            self.scale_tril = _f32(scale_tril)
+        elif covariance_matrix is not None:
+            self.scale_tril = jnp.linalg.cholesky(_f32(covariance_matrix))
+        else:
+            raise ValueError("need covariance_matrix or scale_tril")
+        super().__init__(jnp.broadcast_shapes(
+            self.loc.shape[:-1], self.scale_tril.shape[:-2]),
+            self.loc.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        L = self.scale_tril
+        return Tensor(L @ jnp.swapaxes(L, -1, -2))
+
+    def sample(self, shape=()):
+        key = _state.next_rng_key()
+        shp = tuple(shape) + self._batch_shape + self.loc.shape[-1:]
+        z = jax.random.normal(key, shp)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
+                                            self.scale_tril, z))
+
+    def log_prob(self, value):
+        d = self.loc.shape[-1]
+        diff = _arr(value) - self.loc
+        L = jnp.broadcast_to(self.scale_tril,
+                             diff.shape[:-1] + self.scale_tril.shape[-2:])
+        y = jax.scipy.linalg.solve_triangular(
+            L, diff[..., None], lower=True)[..., 0]
+        half_logdet = jnp.sum(jnp.log(jnp.abs(
+            jnp.diagonal(self.scale_tril, axis1=-2, axis2=-1))), -1)
+        return Tensor(-0.5 * jnp.sum(y ** 2, -1) - half_logdet
+                      - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        half_logdet = jnp.sum(jnp.log(jnp.abs(
+            jnp.diagonal(self.scale_tril, axis1=-2, axis2=-1))), -1)
+        return Tensor(0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet)
+
+
+class Independent(Distribution):
+    """Reinterpret rightmost batch dims as event dims (reference:
+    distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        shape = base.batch_shape
+        super().__init__(shape[:len(shape) - self.rank],
+                         shape[len(shape) - self.rank:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = _arr(self.base.log_prob(value))
+        return Tensor(jnp.sum(lp, axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        e = _arr(self.base.entropy())
+        return Tensor(jnp.sum(e, axis=tuple(range(-self.rank, 0))))
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through a chain of transforms (reference:
+    distribution/transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        from .transform import ChainTransform
+        self.base = base
+        self.transform = (transforms if not isinstance(transforms, list)
+                          else ChainTransform(transforms))
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.transform.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self.transform.forward(x)
+
+    def log_prob(self, value):
+        y = _arr(value)
+        x = self.transform._inverse(y)
+        base_lp = _arr(self.base.log_prob(Tensor(x)))
+        ldj = self.transform._forward_log_det_jacobian(x)
+        return Tensor(base_lp - ldj)

@@ -1,0 +1,42 @@
+"""KL divergence registry (reference: python/paddle/distribution/kl.py —
+register_kl decorator + dispatch with subclass resolution)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+_REGISTRY: dict[tuple[type, type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator: register fn(p, q) as the KL implementation for the pair."""
+    def deco(fn):
+        _REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def _resolve(p_cls, q_cls):
+    exact = _REGISTRY.get((p_cls, q_cls))
+    if exact is not None:
+        return exact
+    # most-derived match over the MRO product (the reference's total_order
+    # dispatch simplified: first match in MRO order is the closest)
+    for pc in p_cls.__mro__:
+        for qc in q_cls.__mro__:
+            fn = _REGISTRY.get((pc, qc))
+            if fn is not None:
+                return fn
+    return None
+
+
+def kl_divergence(p, q):
+    """KL(p || q) via the registered pair table."""
+    fn = _resolve(type(p), type(q))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__}) — "
+            f"register with @register_kl")
+    out = fn(p, q)
+    return out if isinstance(out, Tensor) else Tensor(jnp.asarray(out))

@@ -1,0 +1,293 @@
+"""Bijective transforms (reference: python/paddle/distribution/transform.py
+— Transform base with forward/inverse/log-det-jacobian, Affine/Exp/
+Sigmoid/Tanh/Power/Abs/Softmax/StickBreaking/Chain/Independent/Reshape).
+
+TPU-native: transforms are pure jnp maps; TransformedDistribution composes
+them with a base distribution's sampler/log_prob so the whole chain traces
+into one XLA program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Type", "Transform", "AffineTransform", "ExpTransform",
+    "PowerTransform", "SigmoidTransform", "TanhTransform", "AbsTransform",
+    "SoftmaxTransform", "StickBreakingTransform", "ChainTransform",
+    "IndependentTransform", "ReshapeTransform",
+]
+
+
+def _arr(x):
+    return x._data_ if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    @property
+    def type(self):
+        return self._type
+
+    # event dims consumed/produced (0 = elementwise)
+    _domain_event_dim = 0
+    _codomain_event_dim = 0
+
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._forward_log_det_jacobian(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self._forward_log_det_jacobian(
+            self._inverse(_arr(y))))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # array-level hooks subclasses implement
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _arr(power).astype(jnp.float32)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2 (log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # right inverse
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    """R^{n} → simplex^{n+1} (reference transform.py:StickBreakingTransform)."""
+    _type = Type.BIJECTION
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zcum = jnp.cumprod(1 - z, axis=-1)
+        pad = jnp.ones_like(z[..., :1])
+        return jnp.concatenate([z, pad], -1) * \
+            jnp.concatenate([pad, zcum], -1)
+
+    def _inverse(self, y):
+        n = y.shape[-1] - 1
+        ycum = jnp.cumsum(y[..., :-1], axis=-1)
+        rem = 1 - ycum + y[..., :-1]          # remaining stick incl. current
+        z = y[..., :-1] / rem
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=x.dtype))
+        xo = x - offset
+        z = jax.nn.sigmoid(xo)
+        zcum1 = jnp.cumprod(1 - z, axis=-1)
+        pad = jnp.ones_like(z[..., :1])
+        rem = jnp.concatenate([pad, zcum1[..., :-1]], -1)
+        # dy_i/dx_i = sigma(xo)sigma(-xo) * prod_{j<i}(1-z_j), triangular
+        return jnp.sum(-jax.nn.softplus(xo) - jax.nn.softplus(-xo)
+                       + jnp.log(rem), axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._type = (Type.BIJECTION if all(
+            t.type == Type.BIJECTION for t in self.transforms)
+            else Type.OTHER)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t._forward_log_det_jacobian(x)
+            total = j if total is None else total + j
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Reinterprets the rightmost batch dims of a base transform as event
+    dims (sums the log-det over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._type = base.type
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        j = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(j, axis=tuple(range(-self.rank, 0)))
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)

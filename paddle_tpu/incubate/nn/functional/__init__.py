@@ -75,6 +75,7 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     layout; sin/cos default to the standard rope table."""
     qa = q._data if isinstance(q, Tensor) else jnp.asarray(q)
     b, s, h, d = qa.shape
+    cos2d = sin2d = None     # [s, d] tables usable by the Pallas kernel
     if sin is None or cos is None:
         inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2,
                                                     dtype=jnp.float32) / d))
@@ -82,24 +83,37 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                else jnp.arange(s, dtype=jnp.float32))
         freqs = jnp.outer(pos, inv)                       # [s, d/2]
         emb = jnp.concatenate([freqs, freqs], axis=-1)    # [s, d]
+        if pos.ndim == 1 and emb.shape[0] == s:
+            cos2d, sin2d = jnp.cos(emb), jnp.sin(emb)
         cos_a = jnp.cos(emb)[None, :, None, :]
         sin_a = jnp.sin(emb)[None, :, None, :]
     else:
         cos_a = cos._data if isinstance(cos, Tensor) else jnp.asarray(cos)
         sin_a = sin._data if isinstance(sin, Tensor) else jnp.asarray(sin)
         if cos_a.ndim == 2:
+            if cos_a.shape == (s, d):
+                cos2d, sin2d = cos_a, sin_a
             cos_a = cos_a[None, :, None, :]
             sin_a = sin_a[None, :, None, :]
 
     args = [t for t in (q, k, v) if t is not None]
+
+    from ....pallas import fused as _pf
 
     def fn(*ts):
         qq = ts[0]
         kk = ts[1] if k is not None else None
         vv = ts[2] if (v is not None and k is not None) else \
             (ts[1] if v is not None and k is None else None)
-        outs = _apply_rope(qq, kk, vv, cos_a.astype(qq.dtype),
-                           sin_a.astype(qq.dtype), use_neox_rotary_style)
+        if cos2d is not None and _pf.rope_supported(qq.shape, d):
+            c32 = cos2d.astype(jnp.float32)
+            s32 = sin2d.astype(jnp.float32)
+            outs = tuple(
+                _pf.rope_pallas(t, c32, s32, use_neox_rotary_style)
+                for t in (qq, kk, vv) if t is not None)
+        else:
+            outs = _apply_rope(qq, kk, vv, cos_a.astype(qq.dtype),
+                               sin_a.astype(qq.dtype), use_neox_rotary_style)
         return outs if len(outs) > 1 else outs[0]
 
     out = apply_op("fused_rope", fn, tuple(args))

@@ -145,11 +145,17 @@ class _BindTracer:
             raise GraphBreak("host-read sequence diverged from discovery")
         rec_bool, rec_val = self.host_reads[self.read_idx]
         self.read_idx += 1
+        if bool_read:
+            # every discovery bool read must yield exactly one guard output
+            # (guard_bools and guard_arrays are compared positionally); a
+            # read that binds concrete becomes a constant guard output
+            self.guard_arrays.append(
+                arr if isinstance(arr, jax.core.Tracer)
+                else jax.numpy.asarray(arr))
+            return (rec_val if isinstance(arr, jax.core.Tracer)
+                    else np.asarray(arr))
         if not isinstance(arr, jax.core.Tracer):
             return np.asarray(arr)
-        if bool_read:
-            self.guard_arrays.append(arr)
-            return rec_val
         raise GraphBreak(
             "host read of a traced value (float()/item()/numpy()) — the "
             "value escapes into python, which a compiled program cannot "

@@ -260,6 +260,9 @@ def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5,
 
 @defop("rms_norm")
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    from ..pallas import fused as _pf
+    if weight is not None and _pf.rms_norm_supported(x, weight):
+        return _pf.rms_norm_pallas(x, weight, epsilon)
     xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
     ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
     out = (xf * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)

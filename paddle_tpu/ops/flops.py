@@ -162,6 +162,11 @@ class FlopsCounter:
         op = OPS.get(name)
         est = op.flops if op is not None else None
         if est is None:
+            # ops invoked through bare apply_op (flash_attention, the
+            # fused pack) have no registry entry — fall back to the
+            # estimator table directly so their FLOPs still count
+            est = _ESTIMATORS.get(name)
+        if est is None:
             self.uncounted.add(name)
             return
         try:

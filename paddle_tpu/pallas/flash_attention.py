@@ -1,21 +1,31 @@
 """Flash attention for TPU.
 
 Reference capability: FlashAttention-2 via dynloaded CUDA lib (reference:
-paddle/phi/kernels/gpu/flash_attn_kernel.cu:203 → phi::dynload::flash_attn_fwd).
-TPU-native realization: a Pallas kernel tiling Q into VMEM blocks and
-streaming K/V blocks with online softmax (the classic flash algorithm maps
-1:1 onto the TPU memory hierarchy: HBM→VMEM double buffering, MXU for the
-two matmuls, VPU for the softmax update).  Falls back to a fused XLA
-attention when shapes don't tile or on CPU.
+paddle/phi/kernels/gpu/flash_attn_kernel.cu:203 → phi::dynload::flash_attn_fwd,
+backward at paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu).  TPU-native
+realization: Pallas kernels that tile Q into VMEM blocks and stream K/V
+blocks **via the grid** (one K/V block resident at a time, double-buffered
+by the Mosaic pipeline), with online softmax in fp32 scratch accumulators.
+Backward is the flash-attention backward: probabilities are recomputed per
+block from the saved logsumexp — never an O(S^2) materialization — with a
+dK/dV kernel (streaming Q innermost) and a dQ kernel (streaming K/V
+innermost).
 
 Layout: [batch, seq, heads, head_dim] (the reference's flash-attn layout).
+BlockSpecs index the 4-D arrays directly (squeezed batch/head dims), so
+there is no host-side transpose/reshape relayout.
+
+Falls back to a fused XLA attention for masks, dropout, or shapes that
+don't tile.  On CPU the Pallas path can be exercised in interpreter mode
+(set ``PADDLE_TPU_PALLAS_INTERPRET=1``) — that is how CI tests the kernels
+without a TPU.
 """
 from __future__ import annotations
 
 import functools
 import math
+import os
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -23,7 +33,11 @@ from ..core.dispatch import apply_op
 from ..core.tensor import Tensor
 from ..core import state as _state
 
-_INTERPRET = False  # set True to run pallas kernels in interpreter mode
+NEG_INF = -1e30
+
+
+def _interpret():
+    return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "") == "1"
 
 
 def _on_tpu():
@@ -47,10 +61,10 @@ def _xla_attention(q, k, v, attn_mask=None, causal=False, scale=None,
     if causal:
         s_q, s_k = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
-        logits = jnp.where(mask, logits, -1e30)
+        logits = jnp.where(mask, logits, NEG_INF)
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:
-            logits = jnp.where(attn_mask, logits, -1e30)
+            logits = jnp.where(attn_mask, logits, NEG_INF)
         else:
             logits = logits + attn_mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits, axis=-1)
@@ -61,118 +75,305 @@ def _xla_attention(q, k, v, attn_mask=None, causal=False, scale=None,
 
 
 # ------------------------------------------------------------------
-# Pallas kernel
+# Pallas forward: grid (B, H, num_q, num_kv), K/V streamed by the grid
 # ------------------------------------------------------------------
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
-               block_k, seq_len):
-    """One (batch*head, q_block) program: stream K/V blocks, online softmax.
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k):
+    """One (b, h, q_block, kv_block) step of the online softmax.
 
-    Refs are [block_q, d] for q/o and [seq_len, d] for k/v (VMEM).
+    The kv grid axis is innermost: scratch (m, l, acc) carries the running
+    max / normalizer / weighted sum across kv steps for a fixed q block.
     """
     from jax.experimental import pallas as pl
 
-    q_idx = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
-    d = q.shape[-1]
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    num_kv = pl.num_programs(3)
 
-    m = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
-    l = jnp.zeros((block_q, 1), jnp.float32)  # noqa: E741
-    acc = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q_offset = q_idx * block_q
-    num_k_blocks = seq_len // block_k
-    if causal:
-        # only iterate K blocks up to the diagonal
-        num_k_blocks = (q_offset + block_q + block_k - 1) // block_k
+    q_start = i * block_q
+    k_start = j * block_k
+    # Entire block above the causal diagonal contributes nothing: skip the
+    # matmuls (the DMA already happened; autotune trades block_k against
+    # the wasted fetches).
+    live = (q_start + block_q - 1 >= k_start) if causal else True
 
-    def body(i, carry):
-        m, l, acc = carry  # noqa: E741
-        k_blk = jax.lax.dynamic_slice_in_dim(
-            k_ref[:], i * block_k, block_k, axis=0).astype(jnp.float32)
-        v_blk = jax.lax.dynamic_slice_in_dim(
-            v_ref[:], i * block_k, block_k, axis=0).astype(jnp.float32)
-        s = q @ k_blk.T  # [block_q, block_k] on the MXU
+    @pl.when(live)
+    def _compute():
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = q_offset + jax.lax.broadcasted_iota(
+            q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            k_pos = i * block_k + jax.lax.broadcasted_iota(
+            k_pos = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = alpha * acc + p @ v_blk
-        return m_new, l_new, acc_new
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = alpha * acc_scr[:] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
 
-    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m, l, acc))  # noqa: E741
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)  # noqa: E741
+        o_ref[:] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[:] = (m_scr[:] + jnp.log(l)).astype(lse_ref.dtype)
 
 
-def _pallas_flash_fwd(q, k, v, *, causal, scale, block_q=256, block_k=256):
-    """q,k,v: [B, S, H, D] → out [B, S, H, D]."""
+def _pallas_flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
+    """q,k,v: [B, S, H, D] → (out [B, S, H, D], lse [B, H, S, 1] fp32)."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
-    # fold batch and heads; put seq in the tiled dimension
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-
-    kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k, seq_len=s)
-    out = pl.pallas_call(
+    grid = (b, h, s // block_q, s // block_k)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    qo_spec = pl.BlockSpec((None, block_q, None, d),
+                           lambda b_, h_, i, j: (b_, i, h_, 0))
+    kv_spec = pl.BlockSpec((None, block_k, None, d),
+                           lambda b_, h_, i, j: (b_, j, h_, 0))
+    lse_spec = pl.BlockSpec((None, None, block_q, 1),
+                            lambda b_, h_, i, j: (b_, h_, i, 0))
+    out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, s // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-        interpret=_INTERPRET,
-    )(qf, kf, vf)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+        grid=grid,
+        in_specs=[qo_spec, kv_spec, kv_spec],
+        out_specs=[qo_spec, lse_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, s, h, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_core(q, k, v, causal, scale):
-    return _pallas_flash_fwd(q, k, v, causal=causal, scale=scale)
+# ------------------------------------------------------------------
+# Pallas backward: dK/dV kernel (Q innermost) + dQ kernel (K/V innermost)
+# ------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, block_q, block_k):
+    """grid (B, H, num_kv, num_q): accumulate dK/dV for one kv block while
+    streaming q blocks.  p is recomputed per block from the saved lse."""
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(2)   # kv block
+    i = pl.program_id(3)   # q block (innermost)
+    num_q = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    live = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:]          # [block_q, 1]
+        delta = delta_ref[:]      # [block_q, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [block_q, block_k]
+        # dv += p^T do
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # ds = p * (do v^T - delta) * scale;  dk += ds^T q
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == num_q - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_fwd_rule(q, k, v, causal, scale):
-    out = _pallas_flash_fwd(q, k, v, causal=causal, scale=scale)
-    return out, (q, k, v)
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, scale, causal, block_q, block_k):
+    """grid (B, H, num_q, num_kv): accumulate dQ for one q block while
+    streaming kv blocks."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)   # q block
+    j = pl.program_id(3)   # kv block (innermost)
+    num_kv = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    live = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:]
+        delta = delta_ref[:]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_rule(causal, scale, res, dout):
-    """Backward via recompute with XLA attention (memory-safe lengths use the
-    pallas fwd for the big win; a fused pallas bwd kernel is the next
-    optimization step)."""
-    q, k, v = res
+def _pallas_flash_bwd(q, k, v, out, lse, dout, *, causal, scale,
+                      block_q, block_k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-    def f(q_, k_, v_):
-        return _xla_attention(q_, k_, v_, causal=causal, scale=scale)
-    _, vjp_fn = jax.vjp(f, q, k, v)
-    return vjp_fn(dout)
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    # delta_i = rowsum(dO_i * O_i): cheap elementwise+reduce, XLA fuses it
+    delta = jnp.einsum("bshd,bshd->bhs", dout.astype(jnp.float32),
+                       out.astype(jnp.float32))[..., None]  # [B, H, S, 1]
+
+    qo_spec_q = pl.BlockSpec((None, block_q, None, d),
+                             lambda b_, h_, j, i: (b_, i, h_, 0))
+    kv_spec_q = pl.BlockSpec((None, block_k, None, d),
+                             lambda b_, h_, j, i: (b_, j, h_, 0))
+    lse_spec_q = pl.BlockSpec((None, None, block_q, 1),
+                              lambda b_, h_, j, i: (b_, h_, i, 0))
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                   causal=causal, block_q=block_q,
+                                   block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, s // block_k, s // block_q),
+        in_specs=[qo_spec_q, kv_spec_q, kv_spec_q, qo_spec_q,
+                  lse_spec_q, lse_spec_q],
+        out_specs=[kv_spec_q, kv_spec_q],
+        out_shape=[jax.ShapeDtypeStruct((b, s, h, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, s, h, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, dout, lse, delta)
+
+    qo_spec = pl.BlockSpec((None, block_q, None, d),
+                           lambda b_, h_, i, j: (b_, i, h_, 0))
+    kv_spec = pl.BlockSpec((None, block_k, None, d),
+                           lambda b_, h_, i, j: (b_, j, h_, 0))
+    lse_spec = pl.BlockSpec((None, None, block_q, 1),
+                            lambda b_, h_, i, j: (b_, h_, i, 0))
+    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                                  block_q=block_q, block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, s // block_q, s // block_k),
+        in_specs=[qo_spec, kv_spec, kv_spec, qo_spec, lse_spec, lse_spec],
+        out_specs=qo_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s, h, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, dout, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------
+# custom VJP wiring
+# ------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _pallas_flash_fwd(q, k, v, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _pallas_flash_fwd(q, k, v, causal=causal, scale=scale,
+                                 block_q=block_q, block_k=block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, res, dout):
+    q, k, v, out, lse = res
+    return _pallas_flash_bwd(q, k, v, out, lse, dout, causal=causal,
+                             scale=scale, block_q=block_q, block_k=block_k)
 
 
 _flash_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def _pick_blocks(s, d):
+    """Block sizes: autotune cache first, then shape heuristics."""
+    from .autotune import lookup
+    cached = lookup("flash_attention", (s, d))
+    if cached is not None:
+        return cached
+    block_q = 256 if s % 256 == 0 else 128
+    block_k = 512 if s % 512 == 0 else block_q
+    return min(block_q, s), min(block_k, s)
+
+
 def _supports_pallas(q, k, v, attn_mask, dropout):
     if attn_mask is not None or dropout > 0.0:
         return False
-    if not _on_tpu():
+    if not (_on_tpu() or _interpret()):
         return False
     b, s, h, d = q.shape
-    if s < 256 or s % 256 != 0:
+    if s < 128 or s % 128 != 0:
         return False
-    if d % 128 != 0 and d not in (64,):
+    if d > 256:
         return False
     return k.shape == q.shape and v.shape == q.shape
 
@@ -186,7 +387,8 @@ def flash_attention(query, key, value, attn_mask=None, dropout=0.0,
     def fn(q, k, v, m):
         sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
         if _supports_pallas(q, k, v, m, dropout):
-            return _flash_core(q, k, v, causal, sc)
+            block_q, block_k = _pick_blocks(q.shape[1], q.shape[-1])
+            return _flash_core(q, k, v, causal, sc, block_q, block_k)
         return _xla_attention(q, k, v, attn_mask=m, causal=causal, scale=sc,
                               dropout=dropout, dropout_key=dropout_key)
 

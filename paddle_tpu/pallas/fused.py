@@ -1,0 +1,229 @@
+"""Fused Pallas kernels: RMS norm and rotary embedding (rope).
+
+Reference capability: the CUDA fusion pack —
+paddle/phi/kernels/gpu/rms_norm_kernel.cu (+ its grad in
+rms_norm_grad_kernel) and paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu.
+TPU-native realization: row-blocked Pallas kernels with fp32 accumulation.
+RMS norm saves the per-row reciprocal-RMS as a residual so backward never
+re-reduces x², and accumulates the weight gradient across the sequential
+TPU grid in VMEM scratch (one kernel, no second pass).  Rope's backward is
+the forward kernel with negated sin (the rotation adjoint), so one kernel
+serves both directions.
+
+Both kernels run in interpreter mode on CPU for CI (see
+flash_attention._interpret).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import _interpret, _on_tpu
+
+
+def _pick_block_rows(n_rows, n_cols, budget=1 << 21):
+    """Rows per grid step: keep x/g/out blocks within ~2MB of VMEM each."""
+    rows = max(8, budget // max(n_cols * 4, 1))
+    rows = min(rows, n_rows, 1024)
+    while n_rows % rows:
+        rows //= 2
+    return max(rows, 1)
+
+
+# ------------------------------------------------------------------
+# RMS norm
+# ------------------------------------------------------------------
+
+def _rms_fwd_kernel(x_ref, w_ref, y_ref, r_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    y_ref[:] = (x * r * w_ref[:].astype(jnp.float32)).astype(y_ref.dtype)
+    r_ref[:] = r
+
+
+def _rms_bwd_kernel(x_ref, w_ref, r_ref, g_ref, dx_ref, dw_ref, dw_scr,
+                    *, n_cols):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    num = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_scr[:] = jnp.zeros_like(dw_scr)
+
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    r = r_ref[:]
+    g = g_ref[:].astype(jnp.float32)
+    xhat = x * r
+    gw = g * w
+    # dx = r * (gw - xhat * mean(gw * xhat))
+    m = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx_ref[:] = (r * (gw - xhat * m)).astype(dx_ref.dtype)
+    dw_scr[:] += jnp.sum(g * xhat, axis=0, keepdims=True)
+
+    @pl.when(i == num - 1)
+    def _finalize():
+        dw_ref[:] = dw_scr[:].astype(dw_ref.dtype)
+
+
+def _rms_pallas_fwd(x2d, w, eps, block_rows):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows, n = x2d.shape
+    grid = (rows // block_rows,)
+    y, r = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((block_rows, n), lambda i: (i, 0)),
+                  pl.BlockSpec((1, n), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((block_rows, n), lambda i: (i, 0)),
+                   pl.BlockSpec((block_rows, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows, n), x2d.dtype),
+                   jax.ShapeDtypeStruct((rows, 1), jnp.float32)],
+        interpret=_interpret(),
+    )(x2d, w.reshape(1, n))
+    return y, r
+
+
+def _rms_pallas_bwd(x2d, w, r, g2d, block_rows):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows, n = x2d.shape
+    grid = (rows // block_rows,)
+    dx, dw = pl.pallas_call(
+        functools.partial(_rms_bwd_kernel, n_cols=n),
+        grid=grid,
+        in_specs=[pl.BlockSpec((block_rows, n), lambda i: (i, 0)),
+                  pl.BlockSpec((1, n), lambda i: (0, 0)),
+                  pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((block_rows, n), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block_rows, n), lambda i: (i, 0)),
+                   pl.BlockSpec((1, n), lambda i: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows, n), x2d.dtype),
+                   jax.ShapeDtypeStruct((1, n), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((1, n), jnp.float32)],
+        interpret=_interpret(),
+    )(x2d, w.reshape(1, n), r, g2d)
+    return dx, dw.reshape(w.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rms_norm_pallas(x, w, eps):
+    """x: [..., N], w: [N] → x / rms(x) * w (fp32 accumulation)."""
+    y, _ = _rms_fwd_core(x, w, eps)
+    return y
+
+
+def _rms_fwd_core(x, w, eps):
+    n = x.shape[-1]
+    x2d = x.reshape(-1, n)
+    block = _pick_block_rows(x2d.shape[0], n)
+    y, r = _rms_pallas_fwd(x2d, w, eps, block)
+    return y.reshape(x.shape), (x2d, r, block)
+
+
+def _rms_vjp_fwd(x, w, eps):
+    y, (x2d, r, block) = _rms_fwd_core(x, w, eps)
+    return y, (x2d, w, r, block, x.shape)
+
+
+def _rms_vjp_bwd(eps, res, g):
+    x2d, w, r, block, shape = res
+    dx, dw = _rms_pallas_bwd(x2d, w, r, g.reshape(x2d.shape), block)
+    return dx.reshape(shape), dw.astype(w.dtype)
+
+
+rms_norm_pallas.defvjp(_rms_vjp_fwd, _rms_vjp_bwd)
+
+
+def rms_norm_supported(x, w):
+    if not (_on_tpu() or _interpret()):
+        return False
+    if w is None or x.shape[-1] != w.shape[-1] or w.ndim != 1:
+        return False
+    n = x.shape[-1]
+    rows = 1
+    for dim in x.shape[:-1]:
+        rows *= dim
+    return n % 128 == 0 and rows % 8 == 0
+
+
+# ------------------------------------------------------------------
+# Rope (rotary position embedding)
+# ------------------------------------------------------------------
+
+def _rope_kernel(t_ref, cos_ref, sin_ref, o_ref, *, neox):
+    t = t_ref[:].astype(jnp.float32)         # [block_s, H, D]
+    cos = cos_ref[:].astype(jnp.float32)[:, None, :]   # [block_s, 1, D]
+    sin = sin_ref[:].astype(jnp.float32)[:, None, :]
+    d = t.shape[-1]
+    if neox:
+        t1 = t[..., :d // 2]
+        t2 = t[..., d // 2:]
+        rot = jnp.concatenate([-t2, t1], axis=-1)
+        o = t * cos + rot * sin
+    else:
+        # interleaved (GPT-J): pairs (0,1), (2,3), ...
+        tp = t.reshape(t.shape[:-1] + (d // 2, 2))
+        c = cos[..., 0::2]
+        s = sin[..., 0::2]
+        t1, t2 = tp[..., 0], tp[..., 1]
+        o = jnp.stack([t1 * c - t2 * s, t2 * c + t1 * s], axis=-1)
+        o = o.reshape(t.shape)
+    o_ref[:] = o.astype(o_ref.dtype)
+
+
+def _rope_call(t, cos, sin, neox):
+    """t: [B, S, H, D]; cos/sin: [S, D]."""
+    from jax.experimental import pallas as pl
+
+    b, s, h, d = t.shape
+    block_s = s
+    while block_s * h * d * 4 > (1 << 21) and block_s % 2 == 0:
+        block_s //= 2
+    grid = (b, s // block_s)
+    return pl.pallas_call(
+        functools.partial(_rope_kernel, neox=neox),
+        grid=grid,
+        in_specs=[pl.BlockSpec((None, block_s, h, d),
+                               lambda i, j: (i, j, 0, 0)),
+                  pl.BlockSpec((block_s, d), lambda i, j: (j, 0)),
+                  pl.BlockSpec((block_s, d), lambda i, j: (j, 0))],
+        out_specs=pl.BlockSpec((None, block_s, h, d),
+                               lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(t.shape, t.dtype),
+        interpret=_interpret(),
+    )(t, cos, sin)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def rope_pallas(t, cos, sin, neox):
+    """Rotary embedding, [B, S, H, D] with [S, D] tables."""
+    return _rope_call(t, cos, sin, neox)
+
+
+def _rope_vjp_fwd(t, cos, sin, neox):
+    return _rope_call(t, cos, sin, neox), (cos, sin)
+
+
+def _rope_vjp_bwd(neox, res, g):
+    cos, sin = res
+    # adjoint of the rotation = forward with sin negated; the sin/cos
+    # tables are position constants, not parameters — zero cotangent
+    return (_rope_call(g, cos, -sin, neox),
+            jnp.zeros_like(cos), jnp.zeros_like(sin))
+
+
+rope_pallas.defvjp(_rope_vjp_fwd, _rope_vjp_bwd)
+
+
+def rope_supported(t_shape, d):
+    if not (_on_tpu() or _interpret()):
+        return False
+    return d % 2 == 0 and d <= 512 and t_shape[1] % 8 == 0

@@ -1,0 +1,116 @@
+"""2-process collective worker (launched by test_multiproc.py via the
+launch controller; reference analog: test/legacy_test/test_dist_base.py:962
+_run_cluster spawning trainer subprocesses with PADDLE_* env)."""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+# rendezvous must precede ANY backend touch (paddle_tpu import probes
+# devices for dtype defaults)
+jax.distributed.initialize(
+    coordinator_address=os.environ["PADDLE_MASTER"],
+    num_processes=int(os.environ["WORLD_SIZE"]),
+    process_id=int(os.environ["PADDLE_TRAINER_ID"]))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == 2, f"expected 2 processes, got {world}"
+
+    # --- all_reduce ---
+    t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    np.testing.assert_allclose(np.asarray(t._data_), 3.0)
+
+    # --- all_gather ---
+    parts = dist.all_gather(None, paddle.to_tensor(
+        np.full((2,), float(rank), np.float32)))
+    np.testing.assert_allclose(np.asarray(parts[0]._data_), 0.0)
+    np.testing.assert_allclose(np.asarray(parts[1]._data_), 1.0)
+
+    # --- broadcast ---
+    b = paddle.to_tensor(np.full((3,), float(rank * 7), np.float32))
+    dist.broadcast(b, src=0)
+    np.testing.assert_allclose(np.asarray(b._data_), 0.0)
+
+    # --- reduce to dst=1: rank 0's buffer must be untouched ---
+    r = paddle.to_tensor(np.full((2,), float(rank + 1), np.float32))
+    dist.reduce(r, dst=1)
+    expect = 3.0 if rank == 1 else float(rank + 1)
+    np.testing.assert_allclose(np.asarray(r._data_), expect)
+
+    # --- reduce_scatter ---
+    ins = [paddle.to_tensor(np.full((2,), float(rank * 10 + i), np.float32))
+           for i in range(2)]
+    out = paddle.to_tensor(np.zeros((2,), np.float32))
+    dist.reduce_scatter(out, ins)
+    # row `rank` of sum over sources: (0*10+i) + (1*10+i) = 10 + 2i
+    np.testing.assert_allclose(np.asarray(out._data_), 10.0 + 2 * rank)
+
+    # --- all_to_all ---
+    ins = [paddle.to_tensor(np.full((2,), float(rank * 2 + i), np.float32))
+           for i in range(2)]
+    outs = []
+    dist.all_to_all(outs, ins)
+    # outs[r] = ins[rank] of source r = r*2 + rank
+    for r in range(2):
+        np.testing.assert_allclose(np.asarray(outs[r]._data_),
+                                   float(r * 2 + rank))
+
+    # --- send/recv over cached pair groups ---
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.full((2,), 5.0, np.float32)), dst=1)
+    else:
+        buf = paddle.to_tensor(np.zeros((2,), np.float32))
+        dist.recv(buf, src=0)
+        np.testing.assert_allclose(np.asarray(buf._data_), 5.0)
+    dist.barrier()
+
+    # --- 2-rank DP step matches single-process numerics ---
+    # Global batch of 4 rows split 2/2; grads all-reduced (AVG) must equal
+    # the single-process grad over the full batch.
+    from paddle_tpu import nn
+    paddle.seed(42)  # same init on both ranks
+    model = nn.Linear(8, 4)
+    full_x = np.random.default_rng(7).standard_normal((4, 8)).astype(
+        "float32")
+    full_y = np.random.default_rng(8).standard_normal((4, 4)).astype(
+        "float32")
+    local_x = full_x[rank * 2:(rank + 1) * 2]
+    local_y = full_y[rank * 2:(rank + 1) * 2]
+    out = model(paddle.to_tensor(local_x))
+    loss = ((out - paddle.to_tensor(local_y)) ** 2).mean()
+    loss.backward()
+    for p in model.parameters():
+        g = p.grad
+        dist.all_reduce(g, op=dist.ReduceOp.AVG)
+        p._dp_grad = np.asarray(g._data_)
+
+    # single-process reference (same everywhere)
+    paddle.seed(42)
+    ref = nn.Linear(8, 4)
+    rout = ref(paddle.to_tensor(full_x))
+    rloss = ((rout - paddle.to_tensor(full_y)) ** 2).mean()
+    rloss.backward()
+    for p, rp in zip(model.parameters(), ref.parameters()):
+        np.testing.assert_allclose(p._dp_grad, np.asarray(rp.grad._data_),
+                                   atol=1e-5)
+
+    with open(os.path.join(out_dir, f"ok.{rank}"), "w") as f:
+        f.write("ok")
+    print(f"[rank {rank}] all multi-process collective checks passed")
+
+
+if __name__ == "__main__":
+    main()

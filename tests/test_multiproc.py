@@ -1,0 +1,23 @@
+"""Multi-process distributed: 2 CPU processes through the launch
+controller, jax.distributed rendezvous, real collectives + a 2-rank DP
+step (VERDICT weak #6; reference: test/legacy_test/test_dist_base.py:962)."""
+import os
+import sys
+
+import pytest
+
+from paddle_tpu.distributed.launch.context import Context, parse_args
+from paddle_tpu.distributed.launch.controller import CollectiveController
+
+WORKER = os.path.join(os.path.dirname(__file__), "_dist_worker.py")
+
+
+def test_two_process_collectives(tmp_path):
+    args = parse_args(["--nproc_per_node", "2", WORKER, str(tmp_path)])
+    ctx = Context(args=args)
+    # the workers must NOT inherit this (pytest) process's single-device
+    # CPU backend config; they self-force cpu in the worker script
+    code = CollectiveController(ctx).run()
+    assert code == 0
+    assert (tmp_path / "ok.0").exists()
+    assert (tmp_path / "ok.1").exists()

@@ -1,0 +1,163 @@
+"""Pallas kernel numerics in interpreter mode (CPU CI; reference analog:
+OpTest numpy-reference checks, test/legacy_test/op_test.py:381)."""
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+
+from paddle_tpu.pallas import flash_attention as fa  # noqa: E402
+from paddle_tpu.pallas import fused as pf  # noqa: E402
+from paddle_tpu.pallas import autotune  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+    yield
+    os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+
+
+def _qkv(b=2, s=256, h=2, d=64, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)  # noqa: E731
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_matches_xla(causal):
+    q, k, v = _qkv()
+    sc = 1.0 / np.sqrt(q.shape[-1])
+    out, lse = fa._pallas_flash_fwd(q, k, v, causal=causal, scale=sc,
+                                    block_q=128, block_k=128)
+    ref = fa._xla_attention(q, k, v, causal=causal, scale=sc)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # lse sanity: logsumexp of the scaled logits
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sc
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1],) * 2, bool))
+        logits = jnp.where(mask, logits, -1e30)
+    ref_lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse[..., 0]), np.asarray(ref_lse),
+                               atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_xla(causal):
+    q, k, v = _qkv(seed=1)
+    sc = 1.0 / np.sqrt(q.shape[-1])
+
+    def f_pallas(q_, k_, v_):
+        return (fa._flash_core(q_, k_, v_, causal, sc, 128, 128) ** 2).sum()
+
+    def f_ref(q_, k_, v_):
+        return (fa._xla_attention(q_, k_, v_, causal=causal,
+                                  scale=sc) ** 2).sum()
+
+    g_p = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gp, gr in zip(g_p, g_r):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_flash_mixed_blocks_bf16():
+    q, k, v = _qkv(b=1, s=384, h=2, d=128, dtype=jnp.bfloat16, seed=2)
+    sc = 1.0 / np.sqrt(q.shape[-1])
+    out, _ = fa._pallas_flash_fwd(q, k, v, causal=True, scale=sc,
+                                  block_q=128, block_k=64)
+    ref = fa._xla_attention(q, k, v, causal=True, scale=sc)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=2e-2)
+
+
+def test_rms_norm_kernel():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 32, 256)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((256,)), jnp.float32)
+
+    def ref(x_, w_):
+        ms = jnp.mean(x_ * x_, -1, keepdims=True)
+        return x_ * jax.lax.rsqrt(ms + 1e-6) * w_
+
+    y = pf.rms_norm_pallas(x, w, 1e-6)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref(x, w)),
+                               atol=1e-5)
+    g_p = jax.grad(lambda a, b: (pf.rms_norm_pallas(a, b, 1e-6) ** 2).sum(),
+                   argnums=(0, 1))(x, w)
+    g_r = jax.grad(lambda a, b: (ref(a, b) ** 2).sum(),
+                   argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(g_p[0]), np.asarray(g_r[0]),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g_p[1]), np.asarray(g_r[1]),
+                               atol=1e-3)
+
+
+@pytest.mark.parametrize("neox", [True, False])
+def test_rope_kernel(neox):
+    rng = np.random.default_rng(0)
+    b, s, h, d = 2, 64, 4, 64
+    t = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = jnp.outer(jnp.arange(s, dtype=jnp.float32), inv)
+    emb = jnp.concatenate([freqs, freqs], -1)
+    cos, sin = jnp.cos(emb), jnp.sin(emb)
+
+    def ref(t_):
+        c = cos[None, :, None, :]
+        s_ = sin[None, :, None, :]
+        if neox:
+            t1, t2 = jnp.split(t_, 2, -1)
+            return t_ * c + jnp.concatenate([-t2, t1], -1) * s_
+        t1, t2 = t_[..., 0::2], t_[..., 1::2]
+        cc, ss = c[..., 0::2], s_[..., 0::2]
+        return jnp.stack([t1 * cc - t2 * ss, t2 * cc + t1 * ss],
+                         -1).reshape(t_.shape)
+
+    o = pf.rope_pallas(t, cos, sin, neox)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref(t)), atol=1e-5)
+    gp = jax.grad(lambda a: (pf.rope_pallas(a, cos, sin, neox) ** 2).sum())(t)
+    gr = jax.grad(lambda a: (ref(a) ** 2).sum())(t)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gr), atol=1e-5)
+
+
+def test_rope_wired_through_incubate():
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+    rng = np.random.default_rng(3)
+    q = paddle.to_tensor(rng.standard_normal((2, 64, 4, 64)).astype("float32"))
+    k = paddle.to_tensor(rng.standard_normal((2, 64, 4, 64)).astype("float32"))
+    q.stop_gradient = False
+    qo, ko, vo = IF.fused_rotary_position_embedding(q, k)
+    assert vo is None and tuple(qo.shape) == tuple(q.shape)
+    qo.sum().backward()
+    assert q.grad is not None
+
+
+def test_autotune_cache(tmp_path):
+    os.environ["PADDLE_TPU_AUTOTUNE_CACHE"] = str(tmp_path / "cache.json")
+    autotune._LOADED = False
+    autotune._CACHE.clear()
+    calls = []
+
+    def run(cfg):
+        calls.append(cfg)
+
+    best = autotune.sweep("op", (128, 64), [(1,), (2,)], run)
+    assert best in [(1,), (2,)]
+    assert autotune.lookup("op", (128, 64)) == best
+    # second sweep is served from cache — run() not called again
+    n = len(calls)
+    assert autotune.sweep("op", (128, 64), [(1,), (2,)], run) == best
+    assert len(calls) == n
+    # persisted across a fresh load
+    autotune._LOADED = False
+    autotune._CACHE.clear()
+    assert autotune.lookup("op", (128, 64)) == best
+    del os.environ["PADDLE_TPU_AUTOTUNE_CACHE"]
+    autotune._LOADED = False
+    autotune._CACHE.clear()

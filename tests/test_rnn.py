@@ -1,0 +1,150 @@
+"""RNN layer family (reference: python/paddle/nn/layer/rnn.py; tests
+mirror test/legacy_test/test_rnn_op.py's numpy-reference pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def _np_lstm_ref(x, h0, c0, w_ih, w_hh, b_ih, b_hh):
+    """numpy LSTM over time, gates [i, f, g, o]."""
+    B, T, _ = x.shape
+    H = h0.shape[-1]
+    h, c = h0.copy(), c0.copy()
+    ys = []
+    sig = lambda a: 1.0 / (1.0 + np.exp(-a))  # noqa: E731
+    for t in range(T):
+        z = x[:, t] @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+        i, f, g, o = np.split(z, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        ys.append(h)
+    return np.stack(ys, 1), h, c
+
+
+def test_lstm_matches_numpy():
+    paddle.seed(0)
+    B, T, I, H = 3, 5, 8, 16
+    cell = nn.LSTMCell(I, H)
+    rnn = nn.RNN(cell)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((B, T, I)).astype("float32"))
+    ys, (h, c) = rnn(x)
+    ref_y, ref_h, ref_c = _np_lstm_ref(
+        _np(x), np.zeros((B, H), np.float32), np.zeros((B, H), np.float32),
+        _np(cell.weight_ih), _np(cell.weight_hh),
+        _np(cell.bias_ih), _np(cell.bias_hh))
+    np.testing.assert_allclose(_np(ys), ref_y, atol=1e-5)
+    np.testing.assert_allclose(_np(h), ref_h, atol=1e-5)
+    np.testing.assert_allclose(_np(c), ref_c, atol=1e-5)
+
+
+def test_cells_single_step():
+    paddle.seed(1)
+    B, I, H = 2, 4, 6
+    x = paddle.randn([B, I])
+    for cell_cls in (nn.SimpleRNNCell, nn.GRUCell):
+        cell = cell_cls(I, H)
+        y, h = cell(x)
+        assert tuple(y.shape) == (B, H)
+    lstm = nn.LSTMCell(I, H)
+    y, (h, c) = lstm(x)
+    assert tuple(y.shape) == (B, H) and tuple(c.shape) == (B, H)
+
+
+@pytest.mark.parametrize("mode", ["SimpleRNN", "GRU", "LSTM"])
+def test_network_shapes_and_grad(mode):
+    paddle.seed(2)
+    B, T, I, H, L = 2, 6, 8, 12, 2
+    net = getattr(nn, mode)(I, H, num_layers=L, direction="bidirectional")
+    x = paddle.randn([B, T, I])
+    x.stop_gradient = False
+    out, final = net(x)
+    assert tuple(out.shape) == (B, T, 2 * H)
+    if mode == "LSTM":
+        h, c = final
+        assert tuple(h.shape) == (L * 2, B, H) and tuple(c.shape) == (L * 2, B, H)
+    else:
+        assert tuple(final.shape) == (L * 2, B, H)
+    out.mean().backward()
+    assert x.grad is not None
+    for p in net.parameters():
+        assert p.grad is not None
+
+
+def test_sequence_length_masking():
+    paddle.seed(3)
+    B, T, I, H = 2, 5, 4, 8
+    net = nn.GRU(I, H)
+    x_np = np.random.default_rng(1).standard_normal((B, T, I)).astype(
+        "float32")
+    x = paddle.to_tensor(x_np)
+    seq_len = paddle.to_tensor(np.array([3, 5], np.int32))
+    out, final = net(x, sequence_length=seq_len)
+    # outputs past a sequence's end are zero
+    np.testing.assert_allclose(_np(out)[0, 3:], 0.0, atol=0)
+    # final state for row 0 equals running only the first 3 steps
+    out3, final3 = net(paddle.to_tensor(x_np[:, :3]))
+    np.testing.assert_allclose(_np(final)[0, 0], _np(final3)[0, 0],
+                               atol=1e-6)
+
+
+def test_reverse_direction_with_lengths():
+    paddle.seed(4)
+    B, T, I, H = 2, 6, 4, 8
+    cell = nn.SimpleRNNCell(I, H)
+    rnn_rev = nn.RNN(cell, is_reverse=True)
+    x_np = np.random.default_rng(2).standard_normal((B, T, I)).astype(
+        "float32")
+    x = paddle.to_tensor(x_np)
+    seq_len = paddle.to_tensor(np.array([4, 6], np.int32))
+    out, final = rnn_rev(x, sequence_length=seq_len)
+    # row 0: reversed over its first 4 steps only; final == output at t=0
+    np.testing.assert_allclose(_np(out)[0, 4:], 0.0, atol=0)
+    np.testing.assert_allclose(_np(final)[0], _np(out)[0, 0], atol=1e-6)
+
+
+def test_time_major():
+    paddle.seed(5)
+    T, B, I, H = 5, 3, 4, 8
+    net = nn.LSTM(I, H, time_major=True)
+    x = paddle.randn([T, B, I])
+    out, (h, c) = net(x)
+    assert tuple(out.shape) == (T, B, H)
+    assert tuple(h.shape) == (1, B, H)
+
+
+def test_custom_cell_python_loop():
+    class Counter(nn.RNNCellBase):
+        def __init__(self):
+            super().__init__()
+            self.hidden_size = 4
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x, states=None):
+            if states is None:
+                states = self.get_initial_states(x)
+            h = self.lin(x) + states
+            return h, h
+
+    rnn = nn.RNN(Counter())
+    x = paddle.randn([2, 3, 4])
+    out, final = rnn(x)
+    assert tuple(out.shape) == (2, 3, 4)
+
+
+def test_state_dict_roundtrip():
+    net = nn.LSTM(4, 8, num_layers=2)
+    sd = net.state_dict()
+    assert any("cell_0_0" in k for k in sd)
+    net2 = nn.LSTM(4, 8, num_layers=2)
+    net2.set_state_dict(sd)
+    x = paddle.randn([2, 3, 4])
+    o1, _ = net(x)
+    o2, _ = net2(x)
+    np.testing.assert_allclose(_np(o1), _np(o2), atol=0)
