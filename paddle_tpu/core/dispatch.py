@@ -63,15 +63,21 @@ def apply_op(name, fn, args, static=None, nondiff=False):
         static = {}
     # Tensors may sit at a top-level position or inside a list/tuple arg
     # (concat/stack-style ops) — both must flow through the vjp path, not
-    # be captured as constants.  Only promote a sequence when EVERY element
-    # is a Tensor: shape-like lists mixing Tensors with ints (reshape's
-    # [n, -1]) must stay concrete so the op impl can call int() on them.
+    # be captured as constants.  Only promote a sequence when every
+    # element is a Tensor AND at least one is floating/complex: shape-like
+    # lists (reshape's [n, -1], all-int scalars) must stay concrete so the
+    # op impl can call int() on them, and int tensors carry no gradient.
+    def _floaty(t):
+        return jax.numpy.issubdtype(t._data.dtype, jax.numpy.floating) or \
+            jax.numpy.issubdtype(t._data.dtype, jax.numpy.complexfloating)
+
     tensor_paths = []
     for i, a in enumerate(args):
         if isinstance(a, Tensor):
             tensor_paths.append((i, None))
         elif isinstance(a, (list, tuple)) and a and \
-                all(isinstance(b, Tensor) for b in a):
+                all(isinstance(b, Tensor) for b in a) and \
+                any(_floaty(b) for b in a):
             for j in range(len(a)):
                 tensor_paths.append((i, j))
     tensors = tuple(args[i] if j is None else args[i][j]

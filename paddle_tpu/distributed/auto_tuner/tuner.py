@@ -112,3 +112,42 @@ class AutoTuner:
             if tput > best_tput:
                 best, best_tput = cand, tput
         return best
+
+    def tune_by_launch(self, script, script_args=(), max_trials=3,
+                       nproc_per_node=1, timeout=600):
+        """End-to-end trial loop (reference: auto_tuner/tuner.py:19 main
+        loop): launch `script` through paddle_tpu.distributed.launch once
+        per candidate, passing the candidate via PADDLE_AUTO_TUNER_CONFIG
+        (json env); the trial reports its metric by printing
+        ``AUTO_TUNER_METRIC: <tokens_per_sec>``.  Failed/silent trials
+        score -1 and never win."""
+        import json
+        import os
+        import re
+        import subprocess
+        import sys
+
+        def trial_fn(cand):
+            env = dict(os.environ)
+            env["PADDLE_AUTO_TUNER_CONFIG"] = json.dumps(
+                {k: v for k, v in cand.items() if not k.startswith("_")})
+            p = subprocess.run(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nproc_per_node", str(nproc_per_node),
+                 script, *script_args],
+                env=env, capture_output=True, timeout=timeout)
+            m = re.search(rb"AUTO_TUNER_METRIC:\s*([0-9.eE+-]+)",
+                          p.stdout + p.stderr)
+            return (float(m.group(1))
+                    if m and p.returncode == 0 else -1.0)
+
+        return self.tune(trial_fn=trial_fn, max_trials=max_trials)
+
+
+def current_trial_config(default=None):
+    """Inside a trial: the candidate this run should apply (dp/mp/pp/
+    sharding/micro_batch), or `default` when not under the tuner."""
+    import json
+    import os
+    raw = os.environ.get("PADDLE_AUTO_TUNER_CONFIG")
+    return json.loads(raw) if raw else default

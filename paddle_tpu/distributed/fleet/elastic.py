@@ -77,6 +77,18 @@ class ElasticManager:
         self.node_id = str(node_id if node_id is not None
                            else os.environ.get("PADDLE_TRAINER_ID", "0"))
         self.np = np
+        if store is None:
+            server = os.environ.get("PADDLE_ELASTIC_SERVER")
+            if server:
+                # etcd-grade TCP liveness store — no shared filesystem
+                # needed (reference: etcd keys, manager.py:221-242)
+                from ..store import TCPStore, TCPElasticStore
+                host, port = server.rsplit(":", 1)
+                store = TCPElasticStore(
+                    TCPStore(host, int(port),
+                             is_master=os.environ.get(
+                                 "PADDLE_ELASTIC_SERVER_HOST", "0") == "1"),
+                    ttl=ttl)
         self.store = store or FileStore(
             store_root or os.environ.get("PADDLE_ELASTIC_STORE",
                                          "/tmp/pt_elastic"), ttl=ttl)

@@ -1,0 +1,216 @@
+"""TCPStore: native TCP key-value store for rendezvous + elastic liveness.
+
+Reference capability: `TCPStore` (reference:
+paddle/phi/core/distributed/store/tcp_store.h:120 — blocking get + add
+counters bootstrapping NCCL) and `ETCDMaster`
+(launch/controllers/master.py:186 — node registration without a shared
+filesystem).  TPU-native realization: the C++ server/client in
+csrc/tcp_store.cpp (JIT-built through utils/cpp_extension.load), plus a
+`Master` rendezvous helper and an elastic-store adapter so
+`ElasticManager` can ride TCP instead of the FileStore stand-in.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import time
+
+_LIB = None
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        from ..utils.cpp_extension import load
+        src = os.path.join(os.path.dirname(__file__), "..", "csrc",
+                           "tcp_store.cpp")
+        lib = load("paddle_tpu_tcp_store", [src])
+        lib.ts_server_start.restype = ctypes.c_void_p
+        lib.ts_server_start.argtypes = [ctypes.c_uint16]
+        lib.ts_server_port.restype = ctypes.c_uint16
+        lib.ts_server_port.argtypes = [ctypes.c_void_p]
+        lib.ts_server_stop.argtypes = [ctypes.c_void_p]
+        lib.ts_connect.restype = ctypes.c_int
+        lib.ts_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
+                                   ctypes.c_int]
+        for name, extra in (("ts_set", [ctypes.c_char_p, ctypes.c_uint32]),
+                            ("ts_get", [ctypes.c_char_p, ctypes.c_int64]),
+                            ("ts_wait", [ctypes.c_uint32, ctypes.c_char_p,
+                                         ctypes.c_int64]),
+                            ("ts_del", []),
+                            ("ts_list", [ctypes.c_char_p,
+                                         ctypes.c_int64])):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                           ctypes.c_uint32] + extra
+        lib.ts_add.restype = ctypes.c_int64
+        lib.ts_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                               ctypes.c_uint32, ctypes.c_int64]
+        lib.ts_close.argtypes = [ctypes.c_int]
+        _LIB = lib
+    return _LIB
+
+
+class TCPStore:
+    """Key-value store client; optionally hosts the server in-process.
+
+    TCPStore(host, port, is_master=True) starts the native server (port 0
+    picks a free port — read it back from `.port`) and connects to it.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 timeout=60.0):
+        lib = _lib()
+        self._server = None
+        self.host = host
+        if is_master:
+            self._server = lib.ts_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = lib.ts_server_port(self._server)
+        self.port = port
+        self._fd = lib.ts_connect(host.encode(), port,
+                                  int(timeout * 1000))
+        if self._fd < 0:
+            raise RuntimeError(
+                f"TCPStore: cannot connect to {host}:{port} "
+                f"within {timeout}s")
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        r = _lib().ts_set(self._fd, key.encode(), len(key.encode()),
+                          value, len(value))
+        if r < 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed")
+
+    def get(self, key, default=None):
+        buf = ctypes.create_string_buffer(1 << 16)
+        r = _lib().ts_get(self._fd, key.encode(), len(key.encode()),
+                          buf, len(buf))
+        if r == -1:
+            return default
+        if r == -2:
+            raise RuntimeError("TCPStore: connection lost")
+        if r > len(buf):
+            buf = ctypes.create_string_buffer(int(r))
+            r = _lib().ts_get(self._fd, key.encode(), len(key.encode()),
+                              buf, len(buf))
+        return buf.raw[:r]
+
+    def wait(self, key, timeout=60.0):
+        buf = ctypes.create_string_buffer(1 << 16)
+        r = _lib().ts_wait(self._fd, key.encode(), len(key.encode()),
+                           int(timeout * 1000), buf, len(buf))
+        if r == -1:
+            raise TimeoutError(f"TCPStore.wait({key!r}): not set within "
+                               f"{timeout}s")
+        if r < 0:
+            raise RuntimeError("TCPStore: connection lost")
+        return buf.raw[:r]
+
+    def add(self, key, delta=1):
+        v = _lib().ts_add(self._fd, key.encode(), len(key.encode()),
+                          int(delta))
+        if v == -(2 ** 63):
+            raise RuntimeError(f"TCPStore.add({key!r}) failed")
+        return v
+
+    def delete_key(self, key):
+        _lib().ts_del(self._fd, key.encode(), len(key.encode()))
+
+    def list_prefix(self, prefix):
+        """{key: value} for all keys with the prefix."""
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            r = _lib().ts_list(self._fd, prefix.encode(),
+                               len(prefix.encode()), buf, cap)
+            if r < 0:
+                raise RuntimeError("TCPStore: connection lost")
+            if r <= cap:
+                raw, out, off = buf.raw[:r], {}, 0
+                while off < len(raw):
+                    kl = int.from_bytes(raw[off:off + 4], "little")
+                    key = raw[off + 4:off + 4 + kl].decode()
+                    off += 4 + kl
+                    vl = int.from_bytes(raw[off:off + 4], "little")
+                    out[key] = raw[off + 4:off + 4 + vl]
+                    off += 4 + vl
+                return out
+            cap = int(r)
+
+    def close(self):
+        if self._fd >= 0:
+            _lib().ts_close(self._fd)
+            self._fd = -1
+        if self._server:
+            _lib().ts_server_stop(self._server)
+            self._server = None
+
+
+class TCPElasticStore:
+    """ElasticManager store interface (register/heartbeat/alive_nodes)
+    over TCPStore — the etcd-grade replacement for FileStore when hosts
+    share no filesystem."""
+
+    def __init__(self, store: TCPStore, ttl=10):
+        self.store = store
+        self.ttl = ttl
+
+    def register(self, node_id):
+        self.heartbeat(node_id)
+
+    def heartbeat(self, node_id):
+        self.store.set(f"node.{node_id}", str(time.time()))
+
+    def deregister(self, node_id):
+        self.store.delete_key(f"node.{node_id}")
+
+    def alive_nodes(self):
+        now = time.time()
+        out = []
+        for key, val in self.store.list_prefix("node.").items():
+            try:
+                ts = float(val.decode() or 0)
+            except ValueError:
+                continue
+            if now - ts <= self.ttl:
+                out.append(key[len("node."):])
+        return sorted(out)
+
+
+class Master:
+    """Multi-node endpoint rendezvous (reference: HTTPMaster/ETCDMaster,
+    launch/controllers/master.py:73,186).
+
+    Node 0 hosts the store; every node publishes its endpoint and blocks
+    until all `nnodes` endpoints are present, then receives the full
+    ordered list — no shared filesystem required.
+    """
+
+    def __init__(self, endpoint, rank, nnodes, timeout=300.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.rank, self.nnodes = rank, nnodes
+        self.timeout = timeout
+        self.store = TCPStore(host, int(port), is_master=(rank == 0),
+                              timeout=timeout)
+
+    def sync_endpoints(self, my_endpoint):
+        self.store.set(f"ep/{self.rank}", my_endpoint)
+        self.store.add("ep_joined", 1)
+        deadline = time.time() + self.timeout
+        while True:
+            eps = self.store.list_prefix("ep/")
+            if len(eps) >= self.nnodes:
+                return [eps[f"ep/{r}"].decode()
+                        for r in range(self.nnodes)]
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"rendezvous: {len(eps)}/{self.nnodes} nodes after "
+                    f"{self.timeout}s")
+            time.sleep(0.2)
+
+    def close(self):
+        self.store.close()

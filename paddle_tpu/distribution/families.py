@@ -241,11 +241,17 @@ class Poisson(Distribution):
         return Tensor(v * jnp.log(self.rate) - self.rate - gammaln(v + 1))
 
     def entropy(self):
-        # second-order Stirling approximation (exact for the common small
-        # rates only via summation; reference uses the same approximation)
+        # exact truncated summation for small rates (the Stirling form is
+        # wrong — negative — below rate ~1); Stirling only when the k≤64
+        # truncation would itself bite (rate ≳ 10)
         r = self.rate
-        return Tensor(0.5 * jnp.log(2 * math.pi * math.e * r)
-                      - 1 / (12 * r) - 1 / (24 * r ** 2))
+        ks = jnp.arange(0, 65, dtype=jnp.float32)
+        logp = (ks * jnp.log(r)[..., None] - r[..., None]
+                - gammaln(ks + 1))
+        exact = -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        stirling = (0.5 * jnp.log(2 * math.pi * math.e * r)
+                    - 1 / (12 * r) - 1 / (24 * r ** 2))
+        return Tensor(jnp.where(r < 10.0, exact, stirling))
 
 
 class Gumbel(Distribution):

@@ -41,10 +41,26 @@ def _load():
 
 
 def _save():
+    """Merge-and-replace atomically: concurrent launched processes share
+    the cache file, so re-read before writing and os.replace the temp —
+    torn writes would silently drop every recorded config."""
     try:
-        with open(_cache_path(), "w") as f:
-            json.dump({op: {k: list(v) for k, v in e.items()}
-                       for op, e in _CACHE.items()}, f)
+        merged = {}
+        try:
+            with open(_cache_path()) as f:
+                disk = json.load(f)
+            if isinstance(disk, dict):
+                for op, entries in disk.items():
+                    merged.setdefault(op, {}).update(entries)
+        except (OSError, ValueError):
+            pass
+        for op, e in _CACHE.items():
+            merged.setdefault(op, {}).update(
+                {k: list(v) for k, v in e.items()})
+        tmp = _cache_path() + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(merged, f)
+        os.replace(tmp, _cache_path())
     except OSError:
         pass
 

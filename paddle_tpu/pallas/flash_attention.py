@@ -355,14 +355,39 @@ _flash_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def _pick_blocks(s, d):
-    """Block sizes: autotune cache first, then shape heuristics."""
+    """Block sizes: autotune cache first (validated — a stale non-dividing
+    entry would truncate the grid and leave rows unwritten), then shape
+    heuristics."""
     from .autotune import lookup
     cached = lookup("flash_attention", (s, d))
-    if cached is not None:
-        return cached
+    if cached is not None and len(cached) == 2:
+        bq, bk = int(cached[0]), int(cached[1])
+        if 0 < bq <= s and 0 < bk <= s and s % bq == 0 and s % bk == 0:
+            return bq, bk
     block_q = 256 if s % 256 == 0 else 128
     block_k = 512 if s % 512 == 0 else block_q
     return min(block_q, s), min(block_k, s)
+
+
+def autotune_blocks(s, d, dtype=jnp.bfloat16, batch=1, heads=1):
+    """Timed sweep over divisor block sizes for (seq, head_dim); caches
+    the winner (reference: phi/kernels/autotune switch_autotune.h)."""
+    from . import autotune as at
+
+    cands = [(bq, bk)
+             for bq in (128, 256, 512) for bk in (128, 256, 512)
+             if bq <= s and bk <= s and s % bq == 0 and s % bk == 0]
+    if not cands:
+        return _pick_blocks(s, d)
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (batch, s, heads, d), dtype)
+
+    def run(cfg):
+        out, _ = _pallas_flash_fwd(q, q, q, causal=True, scale=1.0,
+                                   block_q=cfg[0], block_k=cfg[1])
+        jax.block_until_ready(out)
+
+    return at.sweep("flash_attention", (s, d), cands, run)
 
 
 def _supports_pallas(q, k, v, attn_mask, dropout):

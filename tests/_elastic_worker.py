@@ -1,0 +1,74 @@
+"""Elastic kill-and-resume worker (reference pattern: the elastic tests
+under test/collective/fleet/ that kill trainer subprocesses mid-step).
+
+Trains a small model with a per-step checkpoint; on its first incarnation
+rank 0 dies mid-training with ELASTIC_EXIT_CODE (taking rank 1 down via
+the controller's failure policy), the controller relaunches everyone, and
+the relaunched workers resume from the last checkpoint.  The recorded
+loss trajectory must equal an uninterrupted run's.
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.distributed.fleet.elastic import ELASTIC_EXIT_CODE  # noqa: E402
+
+TOTAL_STEPS = 8
+KILL_AT_STEP = 3      # die after completing (and checkpointing) step 3
+
+
+def main():
+    state_dir = sys.argv[1]
+    kill_enabled = os.environ.get("ELASTIC_TEST_KILL", "0") == "1"
+    rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+    ck_path = os.path.join(state_dir, f"ck.{rank}.pdparams")
+    marker = os.path.join(state_dir, "died.once")
+
+    paddle.seed(1234)
+    model = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(5e-3, parameters=model.parameters())
+
+    start_step, losses = 0, []
+    if os.path.exists(ck_path):
+        state = paddle.load(ck_path)
+        model.set_state_dict(state["model"])
+        opt.set_state_dict(state["opt"])
+        start_step = int(state["step"]) + 1
+        losses = list(state["losses"])
+
+    for step in range(start_step, TOTAL_STEPS):
+        rng = np.random.default_rng(step)     # data keyed by step only
+        x = paddle.to_tensor(rng.standard_normal((8, 16)).astype("float32"))
+        y = paddle.to_tensor(rng.integers(0, 4, (8,)).astype("int64"))
+        loss = paddle.nn.functional.cross_entropy(model(x), y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(round(float(loss.numpy()), 6))
+
+        # atomic per-step checkpoint: a SIGTERM mid-save must not corrupt
+        tmp = ck_path + ".tmp"
+        paddle.save({"model": model.state_dict(), "opt": opt.state_dict(),
+                     "step": step, "losses": losses}, tmp)
+        os.replace(tmp, ck_path)
+
+        if (kill_enabled and rank == "0" and step == KILL_AT_STEP
+                and not os.path.exists(marker)):
+            with open(marker, "w") as f:
+                f.write("x")
+            os._exit(ELASTIC_EXIT_CODE)
+
+    with open(os.path.join(state_dir, f"losses.{rank}.json"), "w") as f:
+        json.dump(losses, f)
+
+
+if __name__ == "__main__":
+    main()

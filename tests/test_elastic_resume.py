@@ -1,0 +1,43 @@
+"""Elastic kill-and-resume integration (VERDICT #9; reference pattern:
+test/collective/fleet/ elastic tests killing trainer subprocesses)."""
+import json
+import os
+
+from paddle_tpu.distributed.launch.context import Context, parse_args
+from paddle_tpu.distributed.launch.controller import CollectiveController
+
+WORKER = os.path.join(os.path.dirname(__file__), "_elastic_worker.py")
+
+
+def _run(tmp_path, kill):
+    d = tmp_path / ("killed" if kill else "clean")
+    d.mkdir()
+    args = parse_args(["--nproc_per_node", "2", "--max_restart", "3",
+                       WORKER, str(d)])
+    env_key = "ELASTIC_TEST_KILL"
+    old = os.environ.get(env_key)
+    os.environ[env_key] = "1" if kill else "0"
+    try:
+        code = CollectiveController(Context(args=args)).run()
+    finally:
+        if old is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = old
+    assert code == 0
+    out = {}
+    for rank in ("0", "1"):
+        with open(d / f"losses.{rank}.json") as f:
+            out[rank] = json.load(f)
+    return out, d
+
+
+def test_kill_and_resume_matches_uninterrupted(tmp_path):
+    clean, _ = _run(tmp_path, kill=False)
+    killed, d = _run(tmp_path, kill=True)
+    # the victim actually died once and the controller relaunched
+    assert (d / "died.once").exists()
+    # resumed trajectory identical to the uninterrupted one, both ranks
+    assert killed["0"] == clean["0"]
+    assert killed["1"] == clean["1"]
+    assert len(killed["0"]) == 8

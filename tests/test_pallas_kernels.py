@@ -7,8 +7,6 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
-
 from paddle_tpu.pallas import flash_attention as fa  # noqa: E402
 from paddle_tpu.pallas import fused as pf  # noqa: E402
 from paddle_tpu.pallas import autotune  # noqa: E402
@@ -16,9 +14,13 @@ from paddle_tpu.pallas import autotune  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _interpret_mode():
+    prev = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")
     os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
     yield
-    os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+    if prev is None:
+        os.environ.pop("PADDLE_TPU_PALLAS_INTERPRET", None)
+    else:
+        os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = prev
 
 
 def _qkv(b=2, s=256, h=2, d=64, dtype=jnp.float32, seed=0):
