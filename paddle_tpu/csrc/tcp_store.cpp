@@ -13,6 +13,8 @@
 //   response: u8 status(0 ok, 1 missing/timeout) | u32 vlen | val
 // Ops: 1=SET 2=GET 3=WAIT(val=u32 timeout_ms) 4=ADD(val=i64 delta,
 //      returns i64) 5=DEL 6=LIST(key=prefix, returns u32-prefixed keys)
+//      7=STAMP(server-clock timestamp write; cross-host clock skew must
+//      not poison liveness TTLs) 8=NOW(returns server clock, f64 seconds)
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -183,6 +185,29 @@ void handle_conn(Server* srv, int fd) {
         ok = send_resp(fd, 0, out);
         break;
       }
+      case 7: {  // STAMP: server-clock timestamp under key
+        double now = std::chrono::duration<double>(
+                         std::chrono::system_clock::now().time_since_epoch())
+                         .count();
+        std::string v(8, '\0');
+        std::memcpy(v.data(), &now, 8);
+        {
+          std::lock_guard<std::mutex> g(st.mu);
+          st.kv[key] = v;
+        }
+        st.cv.notify_all();
+        ok = send_resp(fd, 0, "");
+        break;
+      }
+      case 8: {  // NOW: server clock (f64 seconds)
+        double now = std::chrono::duration<double>(
+                         std::chrono::system_clock::now().time_since_epoch())
+                         .count();
+        std::string v(8, '\0');
+        std::memcpy(v.data(), &now, 8);
+        ok = send_resp(fd, 0, v);
+        break;
+      }
       default:
         ok = send_resp(fd, 1, "");
     }
@@ -334,6 +359,18 @@ int64_t ts_add(int fd, const char* key, uint32_t klen, int64_t delta) {
 
 int64_t ts_del(int fd, const char* key, uint32_t klen) {
   return request(fd, 5, key, klen, nullptr, 0, nullptr, 0);
+}
+
+int64_t ts_stamp(int fd, const char* key, uint32_t klen) {
+  return request(fd, 7, key, klen, nullptr, 0, nullptr, 0);
+}
+
+double ts_now(int fd) {
+  char out[8] = {0};
+  if (request(fd, 8, nullptr, 0, nullptr, 0, out, 8) < 0) return -1.0;
+  double v;
+  std::memcpy(&v, out, 8);
+  return v;
 }
 
 int64_t ts_list(int fd, const char* prefix, uint32_t plen, char* out,

@@ -1,0 +1,336 @@
+"""Parameter-server stack (TPU-native analog).
+
+Reference capability: the PS training mode —
+paddle/fluid/distributed/ps/service/brpc_ps_server.{h,cc} (brpc servers
+hosting sparse/dense tables), brpc_ps_client, table storage
+(ps/table/memory_sparse_table), and the python runtime
+`TheOnePSRuntime` (python/paddle/distributed/ps/the_one_ps.py:1027 —
+build tables from the strategy, server/worker lifecycle).
+
+TPU-native realization: the dense compute path belongs on the TPU via
+SPMD — a PS is only warranted for host-resident *sparse* state too large
+for HBM (recommender embeddings).  So the tables live in host memory on
+server processes; transport is the stdlib authenticated-TCP channel the
+RPC module already uses (brpc is not in this image); workers pull rows
+before the device step and push gradients after it.  `PSEmbedding` wires
+that into the eager layer API: pull on forward, push via a gradient hook
+on backward — the DistributedLookupTable analog.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+from multiprocessing.connection import Listener, Client
+
+_AUTHKEY = b"paddle_tpu_ps"
+
+
+# ------------------------------------------------------------------
+# tables (reference: ps/table/ memory_dense_table / memory_sparse_table)
+# ------------------------------------------------------------------
+
+class DenseTable:
+    def __init__(self, shape, lr=0.1, optimizer="sgd", init=None):
+        self.value = (np.zeros(shape, np.float32) if init is None
+                      else np.array(init, np.float32))
+        self.lr = lr
+        self.optimizer = optimizer
+        self._accum = np.zeros_like(self.value)  # adagrad accumulator
+
+    def pull(self):
+        return self.value
+
+    def push(self, grad):
+        grad = np.asarray(grad, np.float32)
+        if self.optimizer == "adagrad":
+            self._accum += grad * grad
+            self.value -= self.lr * grad / (np.sqrt(self._accum) + 1e-8)
+        else:
+            self.value -= self.lr * grad
+
+
+class SparseTable:
+    """id → row; rows are created on first pull (reference:
+    memory_sparse_table lazy init)."""
+
+    def __init__(self, dim, lr=0.1, optimizer="sgd", initializer=None,
+                 seed=0):
+        self.dim = dim
+        self.lr = lr
+        self.optimizer = optimizer
+        self.rows: dict[int, np.ndarray] = {}
+        self._accum: dict[int, np.ndarray] = {}
+        self._rng = np.random.default_rng(seed)
+        self._init = initializer or (
+            lambda: (self._rng.standard_normal(dim) * 0.01)
+            .astype(np.float32))
+
+    def pull(self, ids):
+        out = np.empty((len(ids), self.dim), np.float32)
+        for i, key in enumerate(ids):
+            key = int(key)
+            row = self.rows.get(key)
+            if row is None:
+                row = self._init()
+                self.rows[key] = row
+            out[i] = row
+        return out
+
+    def push(self, ids, grads):
+        grads = np.asarray(grads, np.float32)
+        for key, g in zip(ids, grads):
+            key = int(key)
+            row = self.rows.setdefault(key, self._init())
+            if self.optimizer == "adagrad":
+                acc = self._accum.setdefault(
+                    key, np.zeros(self.dim, np.float32))
+                acc += g * g
+                row -= self.lr * g / (np.sqrt(acc) + 1e-8)
+            else:
+                row -= self.lr * g
+
+
+# ------------------------------------------------------------------
+# server / client (reference: brpc_ps_server / brpc_ps_client)
+# ------------------------------------------------------------------
+
+class PSServer:
+    """Hosts tables, serves pull/push over authenticated TCP."""
+
+    def __init__(self, address=("127.0.0.1", 0)):
+        self.tables: dict[int, object] = {}
+        self._listener = Listener(address, authkey=_AUTHKEY)
+        self.address = self._listener.address
+        self._stop = threading.Event()
+        self._threads = []
+        self._lock = threading.Lock()
+        self._accept_thread = None
+
+    def add_dense_table(self, table_id, shape, **kw):
+        self.tables[table_id] = DenseTable(shape, **kw)
+
+    def add_sparse_table(self, table_id, dim, **kw):
+        self.tables[table_id] = SparseTable(dim, **kw)
+
+    def start(self):
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    req = conn.recv()
+                except EOFError:
+                    return
+                op = req["op"]
+                if op == "stop":
+                    conn.send({"ok": True})
+                    self._stop.set()
+                    try:
+                        self._listener.close()
+                    except OSError:
+                        pass
+                    return
+                table = self.tables.get(req.get("table_id"))
+                # every request gets a response — a table-op error must
+                # come back as {"ok": False}, never kill the handler and
+                # leave the client blocked in recv()
+                try:
+                    with self._lock:
+                        if op in ("pull_dense", "push_dense",
+                                  "pull_sparse", "push_sparse") and \
+                                table is None:
+                            resp = {"ok": False,
+                                    "error": f"no table "
+                                             f"{req.get('table_id')!r}"}
+                        elif op == "pull_dense":
+                            resp = {"ok": True, "value": table.pull()}
+                        elif op == "push_dense":
+                            table.push(req["grad"])
+                            resp = {"ok": True}
+                        elif op == "pull_sparse":
+                            resp = {"ok": True,
+                                    "value": table.pull(req["ids"])}
+                        elif op == "push_sparse":
+                            table.push(req["ids"], req["grad"])
+                            resp = {"ok": True}
+                        elif op == "save":
+                            resp = {"ok": True, "state": {
+                                tid: (t.rows if isinstance(t, SparseTable)
+                                      else t.value)
+                                for tid, t in self.tables.items()}}
+                        else:
+                            resp = {"ok": False,
+                                    "error": f"unknown op {op!r}"}
+                except Exception as e:   # table-op failure → error resp
+                    resp = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"}
+                conn.send(resp)
+        except (OSError, EOFError):
+            return
+
+    def run(self):
+        """Block until a client sends stop (reference: run_server)."""
+        if self._accept_thread is None:
+            self.start()
+        while not self._stop.is_set():
+            self._stop.wait(0.2)
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class PSClient:
+    def __init__(self, address):
+        self._conn = Client(tuple(address), authkey=_AUTHKEY)
+        self._lock = threading.Lock()
+
+    def _call(self, **req):
+        with self._lock:
+            self._conn.send(req)
+            resp = self._conn.recv()
+        if not resp.get("ok"):
+            raise RuntimeError(resp.get("error", "ps request failed"))
+        return resp
+
+    def pull_dense(self, table_id):
+        return self._call(op="pull_dense", table_id=table_id)["value"]
+
+    def push_dense(self, table_id, grad):
+        self._call(op="push_dense", table_id=table_id,
+                   grad=np.asarray(grad, np.float32))
+
+    def pull_sparse(self, table_id, ids):
+        return self._call(op="pull_sparse", table_id=table_id,
+                          ids=[int(i) for i in ids])["value"]
+
+    def push_sparse(self, table_id, ids, grad):
+        self._call(op="push_sparse", table_id=table_id,
+                   ids=[int(i) for i in ids],
+                   grad=np.asarray(grad, np.float32))
+
+    def save(self):
+        return self._call(op="save")["state"]
+
+    def stop_server(self):
+        try:
+            self._call(op="stop")
+        except (OSError, EOFError):
+            pass
+
+    def close(self):
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------------
+# runtime facade (reference: the_one_ps.py:1027 TheOnePSRuntime)
+# ------------------------------------------------------------------
+
+class TheOnePSRuntime:
+    """Build tables from a config dict; drive server/worker lifecycle.
+
+    config = {"tables": {0: {"type": "sparse", "dim": 8, "lr": 0.1},
+                         1: {"type": "dense", "shape": [4], "lr": 0.1}}}
+    """
+
+    def __init__(self, role, config, server_address=None):
+        if role not in ("server", "worker"):
+            raise ValueError("role must be 'server' or 'worker'")
+        self.role = role
+        self.config = config
+        self.server_address = server_address
+        self._server = None
+        self._client = None
+
+    def init_server(self, address=("127.0.0.1", 0)):
+        self._server = PSServer(address)
+        for tid, spec in self.config.get("tables", {}).items():
+            spec = dict(spec)
+            kind = spec.pop("type")
+            if kind == "sparse":
+                self._server.add_sparse_table(int(tid), **spec)
+            else:
+                self._server.add_dense_table(int(tid),
+                                             tuple(spec.pop("shape")),
+                                             **spec)
+        self._server.start()
+        self.server_address = self._server.address
+        return self._server
+
+    def run_server(self):
+        self._server.run()
+
+    def init_worker(self):
+        self._client = PSClient(self.server_address)
+        return self._client
+
+    def stop_worker(self):
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def stop(self):
+        if self._client is not None:
+            self._client.stop_server()
+            self._client.close()
+        if self._server is not None:
+            self._server.stop()
+
+
+# ------------------------------------------------------------------
+# PSEmbedding: DistributedLookupTable analog for the eager layer API
+# ------------------------------------------------------------------
+
+class PSEmbedding:
+    """Embedding whose rows live on the PS: pull on forward, push grads
+    via a backward hook (reference: distributed lookup_table +
+    fleet.utils ps embedding passes)."""
+
+    def __init__(self, client, table_id, dim):
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+
+    def __call__(self, ids):
+        from ...core.tensor import Tensor
+        ids_np = np.asarray(
+            ids._data_ if isinstance(ids, Tensor) else ids).reshape(-1)
+        rows = self.client.pull_sparse(self.table_id, ids_np.tolist())
+        emb = Tensor(jnp.asarray(rows), stop_gradient=False)
+
+        client, table_id = self.client, self.table_id
+        id_list = ids_np.tolist()
+
+        def push_hook(grad):
+            client.push_sparse(table_id, id_list, np.asarray(grad._data_))
+            return grad
+
+        emb.register_hook(push_hook)
+        shape = tuple(np.shape(
+            ids._data_ if isinstance(ids, Tensor) else ids)) + (self.dim,)
+        from ...tensor_ops import manipulation
+        return manipulation.reshape(emb, shape), emb

@@ -47,6 +47,11 @@ def _lib():
         lib.ts_add.restype = ctypes.c_int64
         lib.ts_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                ctypes.c_uint32, ctypes.c_int64]
+        lib.ts_stamp.restype = ctypes.c_int64
+        lib.ts_stamp.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_uint32]
+        lib.ts_now.restype = ctypes.c_double
+        lib.ts_now.argtypes = [ctypes.c_int]
         lib.ts_close.argtypes = [ctypes.c_int]
         _LIB = lib
     return _LIB
@@ -120,6 +125,20 @@ class TCPStore:
     def delete_key(self, key):
         _lib().ts_del(self._fd, key.encode(), len(key.encode()))
 
+    def stamp(self, key):
+        """Write the SERVER's clock under key (liveness heartbeats must
+        not mix per-host wall clocks)."""
+        r = _lib().ts_stamp(self._fd, key.encode(), len(key.encode()))
+        if r < 0:
+            raise RuntimeError(f"TCPStore.stamp({key!r}) failed")
+
+    def server_now(self):
+        """The server's clock (f64 seconds since epoch)."""
+        v = _lib().ts_now(self._fd)
+        if v < 0:
+            raise RuntimeError("TCPStore.server_now failed")
+        return v
+
     def list_prefix(self, prefix):
         """{key: value} for all keys with the prefix."""
         cap = 1 << 16
@@ -153,7 +172,9 @@ class TCPStore:
 class TCPElasticStore:
     """ElasticManager store interface (register/heartbeat/alive_nodes)
     over TCPStore — the etcd-grade replacement for FileStore when hosts
-    share no filesystem."""
+    share no filesystem.  Heartbeats are stamped with the SERVER's clock
+    and compared against the server's clock (etcd leases pattern): a
+    worker whose wall clock is skewed must not look dead."""
 
     def __init__(self, store: TCPStore, ttl=10):
         self.store = store
@@ -163,19 +184,19 @@ class TCPElasticStore:
         self.heartbeat(node_id)
 
     def heartbeat(self, node_id):
-        self.store.set(f"node.{node_id}", str(time.time()))
+        self.store.stamp(f"node.{node_id}")
 
     def deregister(self, node_id):
         self.store.delete_key(f"node.{node_id}")
 
     def alive_nodes(self):
-        now = time.time()
+        import struct
+        now = self.store.server_now()
         out = []
         for key, val in self.store.list_prefix("node.").items():
-            try:
-                ts = float(val.decode() or 0)
-            except ValueError:
+            if len(val) != 8:
                 continue
+            ts = struct.unpack("<d", val)[0]
             if now - ts <= self.ttl:
                 out.append(key[len("node."):])
         return sorted(out)
@@ -199,17 +220,18 @@ class Master:
 
     def sync_endpoints(self, my_endpoint):
         self.store.set(f"ep/{self.rank}", my_endpoint)
-        self.store.add("ep_joined", 1)
         deadline = time.time() + self.timeout
         while True:
+            # check ranks 0..n-1 directly: a stale key from a previous
+            # incarnation must not satisfy the count while a rank is absent
             eps = self.store.list_prefix("ep/")
-            if len(eps) >= self.nnodes:
-                return [eps[f"ep/{r}"].decode()
-                        for r in range(self.nnodes)]
+            wanted = [f"ep/{r}" for r in range(self.nnodes)]
+            if all(k in eps for k in wanted):
+                return [eps[k].decode() for k in wanted]
             if time.time() > deadline:
+                missing = [k for k in wanted if k not in eps]
                 raise TimeoutError(
-                    f"rendezvous: {len(eps)}/{self.nnodes} nodes after "
-                    f"{self.timeout}s")
+                    f"rendezvous: missing {missing} after {self.timeout}s")
             time.sleep(0.2)
 
     def close(self):

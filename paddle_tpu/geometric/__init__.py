@@ -1,0 +1,221 @@
+"""Graph-learning ops (reference capability: python/paddle/geometric/ —
+segment math, send/recv message passing, graph reindex/sampling).
+
+TPU-native realization: everything lowers to `jax.ops.segment_*` /
+gather-scatter, which XLA compiles to efficient sorted-segment kernels;
+the whole message-passing step stays in one fused program (the reference
+ships dedicated CUDA kernels under paddle/phi/kernels/gpu/graph_*).
+Sampling (`sample_neighbors`) is host-side by nature — it runs on CPU with
+numpy, mirroring the reference's CPU sampling path.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+    "sample_neighbors",
+]
+
+
+def _arr(x):
+    return x._data_ if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _num_segments(segment_ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    ids = _arr(segment_ids)
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            "segment count is data-dependent under tracing/jit — pass "
+            "out_size= (static) so the op compiles to a fixed shape")
+    return int(jax.device_get(ids.max())) + 1 if ids.size else 0
+
+
+def _finite_or_zero(v):
+    # empty segments come back +/-inf from segment_max/min; the reference
+    # returns 0 for nodes with no incoming messages
+    return jnp.where(jnp.isfinite(v), v, jnp.zeros_like(v))
+
+
+def _segment(op_name, reducer, data, segment_ids, out_size=None, name=None):
+    n = _num_segments(segment_ids, out_size)
+
+    def fn(x, ids):
+        return _finite_or_zero(
+            reducer(x, ids.astype(jnp.int32), num_segments=n))
+    return apply_op(op_name, fn, (data, segment_ids))
+
+
+def segment_sum(data, segment_ids, out_size=None, name=None):
+    """reference: geometric/math.py segment_sum (kernel:
+    phi/kernels/gpu/segment_pool_kernel.cu).  Pass out_size under jit."""
+    return _segment("segment_sum", jax.ops.segment_sum, data, segment_ids,
+                    out_size)
+
+
+def segment_mean(data, segment_ids, out_size=None, name=None):
+    n = _num_segments(segment_ids, out_size)
+
+    def fn(x, ids):
+        ids = ids.astype(jnp.int32)
+        s = jax.ops.segment_sum(x, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), ids,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (x.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1)
+    return apply_op("segment_mean", fn, (data, segment_ids))
+
+
+def segment_max(data, segment_ids, out_size=None, name=None):
+    return _segment("segment_max", jax.ops.segment_max, data, segment_ids,
+                    out_size)
+
+
+def segment_min(data, segment_ids, out_size=None, name=None):
+    return _segment("segment_min", jax.ops.segment_min, data, segment_ids,
+                    out_size)
+
+
+_REDUCE = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,   # handled explicitly
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], scatter-reduce onto dst (reference:
+    geometric/message_passing/send_recv.py:send_u_recv)."""
+    if reduce_op not in _REDUCE:
+        raise ValueError(f"unknown reduce_op {reduce_op!r}")
+    n = out_size if out_size is not None else \
+        _num_segments(dst_index, None)
+    n = max(int(n), _arr(x).shape[0]) if out_size is None else int(n)
+
+    def fn(xv, src, dst):
+        src = src.astype(jnp.int32)
+        dst = dst.astype(jnp.int32)
+        msg = xv[src]
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msg, dst, num_segments=n)
+            cnt = jax.ops.segment_sum(
+                jnp.ones((msg.shape[0],), xv.dtype), dst, num_segments=n)
+            shape = (n,) + (1,) * (xv.ndim - 1)
+            return s / jnp.maximum(cnt.reshape(shape), 1)
+        return _finite_or_zero(
+            _REDUCE[reduce_op](msg, dst, num_segments=n))
+    return apply_op("send_u_recv", fn, (x, src_index, dst_index))
+
+
+_MSG_OPS = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine node features x[src] with edge features y, then
+    scatter-reduce (reference: send_recv.py:send_ue_recv)."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"unknown message_op {message_op!r}")
+    if reduce_op not in _REDUCE:
+        raise ValueError(f"unknown reduce_op {reduce_op!r}")
+    n = out_size if out_size is not None else \
+        max(_num_segments(dst_index, None), _arr(x).shape[0])
+    n = int(n)
+
+    def fn(xv, yv, src, dst):
+        src = src.astype(jnp.int32)
+        dst = dst.astype(jnp.int32)
+        msg = _MSG_OPS[message_op](xv[src], yv)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msg, dst, num_segments=n)
+            cnt = jax.ops.segment_sum(
+                jnp.ones((msg.shape[0],), msg.dtype), dst, num_segments=n)
+            shape = (n,) + (1,) * (msg.ndim - 1)
+            return s / jnp.maximum(cnt.reshape(shape), 1)
+        return _finite_or_zero(
+            _REDUCE[reduce_op](msg, dst, num_segments=n))
+    return apply_op("send_ue_recv", fn, (x, y, src_index, dst_index))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints (reference:
+    send_recv.py:send_uv)."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"unknown message_op {message_op!r}")
+
+    def fn(xv, yv, src, dst):
+        return _MSG_OPS[message_op](xv[src.astype(jnp.int32)],
+                                    yv[dst.astype(jnp.int32)])
+    return apply_op("send_uv", fn, (x, y, src_index, dst_index))
+
+
+def reindex_graph(x, neighbors, count, name=None):
+    """Compact global node ids to local ids (reference:
+    geometric/reindex.py:reindex_graph).  Host-side (shapes are
+    data-dependent)."""
+    xs = np.asarray(jax.device_get(_arr(x)))
+    nb = np.asarray(jax.device_get(_arr(neighbors)))
+    cnt = np.asarray(jax.device_get(_arr(count)))
+    # order: x's nodes first, then newly-seen neighbors (reference order)
+    order = {}
+    for v in xs.tolist():
+        order.setdefault(int(v), len(order))
+    for v in nb.tolist():
+        order.setdefault(int(v), len(order))
+    remap = np.array([order[int(v)] for v in np.concatenate([xs, nb])],
+                     dtype=np.int64)
+    reindex_src = remap[len(xs):]
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    nodes = np.array(sorted(order, key=order.get), dtype=np.int64)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(nodes)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling over CSC (reference:
+    geometric/sampling/neighbors.py:sample_neighbors).  Host-side numpy,
+    like the reference CPU path.  With return_eids=True the sampled
+    edges' ids are returned as a third output (from `eids` when given,
+    else CSC edge positions)."""
+    rows = np.asarray(jax.device_get(_arr(row)))
+    ptr = np.asarray(jax.device_get(_arr(colptr)))
+    nodes = np.asarray(jax.device_get(_arr(input_nodes)))
+    eid_arr = (np.asarray(jax.device_get(_arr(eids)))
+               if eids is not None else None)
+    rng = np.random.default_rng()
+    out_nb, out_cnt, out_eid = [], [], []
+    for nid in nodes.tolist():
+        beg, end = int(ptr[nid]), int(ptr[nid + 1])
+        pos = np.arange(beg, end)
+        if sample_size >= 0 and len(pos) > sample_size:
+            pos = rng.choice(pos, size=sample_size, replace=False)
+        out_nb.append(rows[pos])
+        out_cnt.append(len(pos))
+        if return_eids:
+            out_eid.append(eid_arr[pos] if eid_arr is not None else pos)
+    neighbors = np.concatenate(out_nb) if out_nb else np.zeros(0, np.int64)
+    result = (Tensor(jnp.asarray(neighbors.astype(np.int64))),
+              Tensor(jnp.asarray(np.array(out_cnt, np.int64))))
+    if return_eids:
+        sampled = (np.concatenate(out_eid) if out_eid
+                   else np.zeros(0, np.int64))
+        result = result + (Tensor(jnp.asarray(sampled.astype(np.int64))),)
+    return result

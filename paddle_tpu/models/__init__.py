@@ -7,3 +7,9 @@ from .gpt_parallel import (  # noqa: F401
     ParallelGPTForCausalLM, ParallelGPTModel, ParallelGPTBlock,
 )
 from .gpt_pipeline import GPTForCausalLMPipe  # noqa: F401
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaModel, LlamaForCausalLM, llama_config,
+)
+from .llama_parallel import (  # noqa: F401
+    ParallelLlamaForCausalLM, ParallelLlamaModel,
+)

@@ -46,6 +46,23 @@ def _constrain_act(x, seq_axis=None):
     return shard_constraint(x, mesh, spec=P(*entries))
 
 
+def _masked_parallel_ce(loss_fn, logits, labels, vocab_size):
+    """Masked-mean over ParallelCrossEntropy per-token losses: divide by
+    the NON-ignored count to match serial cross_entropy(reduction='mean')."""
+    from ..tensor_ops import logic as LO
+    from ..tensor_ops import reduction as RE
+    from ..tensor_ops import math as MM
+    flat_labels = MA.reshape(labels, [-1])
+    per_token = loss_fn(MA.reshape(logits, [-1, vocab_size]), flat_labels)
+    valid = MA.cast(
+        LO.not_equal(flat_labels,
+                     creation.full([], loss_fn.ignore_index,
+                                   flat_labels.dtype)),
+        "float32")
+    n_valid = MM.clip(RE.sum(valid), min=1.0)
+    return RE.sum(per_token) / n_valid
+
+
 class ParallelGPTAttention(Layer):
     def __init__(self, config: GPTConfig, use_ring_attention=False):
         super().__init__()
@@ -197,23 +214,8 @@ class ParallelGPTForCausalLM(Layer):
             entries[-1] = "mp"  # class dim sharded (vocab-parallel logits)
             logits = shard_constraint(logits, mesh, spec=P(*entries))
         if labels is not None:
-            from ..tensor_ops import logic as LO
-            from ..tensor_ops import reduction as RE
-            from ..tensor_ops import math as MM
-            flat_labels = MA.reshape(labels, [-1])
-            # per-token loss is already zero at ignore_index positions; the
-            # mean must divide by the NON-ignored count to match the serial
-            # model's cross_entropy(reduction='mean') denominator
-            per_token = self.loss_fn(
-                MA.reshape(logits, [-1, self.config.vocab_size]),
-                flat_labels)
-            valid = MA.cast(
-                LO.not_equal(flat_labels,
-                             creation.full([], self.loss_fn.ignore_index,
-                                           flat_labels.dtype)),
-                "float32")
-            n_valid = MM.clip(RE.sum(valid), min=1.0)
-            loss = RE.sum(per_token) / n_valid
+            loss = _masked_parallel_ce(self.loss_fn, logits, labels,
+                                       self.config.vocab_size)
             return logits, loss
         return logits
 

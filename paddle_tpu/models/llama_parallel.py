@@ -1,0 +1,187 @@
+"""Hybrid-parallel Llama: TP/SP/DP/ZeRO-ready layout over the fleet mesh.
+
+Reference capability: PaddleNLP Llama trained with Fleet hybrid
+parallelism — BASELINE.md config 4 (Llama-2 7B, TP×PP, v5p-32).
+TPU-native design mirrors models/gpt_parallel.py: Column/Row parallel
+projections over "mp" (heads sharded so attention is local per shard),
+vocab-parallel embedding + cross entropy, activations batch-sharded over
+"dp" with optional sequence sharding ("mp" for Megatron-SP, "sep" for
+ring-attention context parallelism).  GQA composes with TP because
+num_kv_heads is divisible by the mp degree in all standard configs.
+"""
+from __future__ import annotations
+
+import math
+
+from ..nn import Layer, RMSNorm, LayerList
+from ..nn import functional as F
+from ..nn.initializer import Normal, ParamAttr
+from ..tensor_ops import manipulation as MA
+from ..distributed.fleet.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy)
+from ..distributed.api import shard_constraint
+from ..distributed.mesh import get_mesh
+from ..incubate.nn import functional as IF
+from .gpt_parallel import _constrain_act, _masked_parallel_ce
+from .llama import LlamaConfig, llama_config, _repeat_kv  # noqa: F401
+
+
+class ParallelLlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig, use_ring_attention=False):
+        super().__init__()
+        self.config = config
+        self.use_ring_attention = use_ring_attention
+        h, d = config.hidden_size, config.head_dim
+        kv = config.num_kv_heads * d
+        w_init = ParamAttr(initializer=Normal(0.0, config.initializer_range))
+        out_init = ParamAttr(initializer=Normal(
+            0.0, config.initializer_range / math.sqrt(2 * config.num_layers)))
+        self.q_proj = ColumnParallelLinear(h, h, weight_attr=w_init,
+                                           has_bias=False,
+                                           gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, kv, weight_attr=w_init,
+                                           has_bias=False,
+                                           gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, kv, weight_attr=w_init,
+                                           has_bias=False,
+                                           gather_output=False)
+        self.o_proj = RowParallelLinear(h, h, weight_attr=out_init,
+                                        has_bias=False,
+                                        input_is_parallel=True)
+
+    def forward(self, x):
+        cfg = self.config
+        b, s, h = x.shape
+        d = cfg.head_dim
+        q = MA.reshape(self.q_proj(x), [b, s, cfg.num_heads, d])
+        k = MA.reshape(self.k_proj(x), [b, s, cfg.num_kv_heads, d])
+        v = MA.reshape(self.v_proj(x), [b, s, cfg.num_kv_heads, d])
+        q, k, _ = IF.fused_rotary_position_embedding(
+            q, k, rotary_emb_base=cfg.rope_theta)
+        rep = cfg.num_heads // cfg.num_kv_heads
+        k = _repeat_kv(k, rep)
+        v = _repeat_kv(v, rep)
+        mesh = get_mesh()
+        if mesh is not None and "mp" in mesh.dim_names:
+            from jax.sharding import PartitionSpec as P
+            spec = P("dp" if "dp" in mesh.dim_names else None, None, "mp",
+                     None)
+            q = shard_constraint(q, mesh, spec=spec)
+            k = shard_constraint(k, mesh, spec=spec)
+            v = shard_constraint(v, mesh, spec=spec)
+        if self.use_ring_attention and mesh is not None \
+                and "sep" in mesh.dim_names \
+                and mesh.get_dim_size("sep") > 1:
+            from ..distributed.context_parallel import ring_flash_attention
+            out = ring_flash_attention(q, k, v, axis="sep", causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                 training=self.training)
+        return self.o_proj(MA.reshape(out, [b, s, h]))
+
+
+class ParallelLlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        w_init = ParamAttr(initializer=Normal(0.0, config.initializer_range))
+        out_init = ParamAttr(initializer=Normal(
+            0.0, config.initializer_range / math.sqrt(2 * config.num_layers)))
+        self.gate_proj = ColumnParallelLinear(h, m, weight_attr=w_init,
+                                              has_bias=False,
+                                              gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, m, weight_attr=w_init,
+                                            has_bias=False,
+                                            gather_output=False)
+        self.down_proj = RowParallelLinear(m, h, weight_attr=out_init,
+                                           has_bias=False,
+                                           input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class ParallelLlamaBlock(Layer):
+    def __init__(self, config: LlamaConfig, sequence_parallel=False,
+                 use_ring_attention=False):
+        super().__init__()
+        self.sequence_parallel = sequence_parallel
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       epsilon=config.rms_norm_eps)
+        self.self_attn = ParallelLlamaAttention(config, use_ring_attention)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+        self.mlp = ParallelLlamaMLP(config)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return _constrain_act(
+            x, seq_axis="mp" if self.sequence_parallel else "sep")
+
+
+class ParallelLlamaModel(Layer):
+    def __init__(self, config: LlamaConfig, sequence_parallel=False,
+                 use_ring_attention=False):
+        super().__init__()
+        self.config = config
+        emb_init = ParamAttr(initializer=Normal(0.0,
+                                                config.initializer_range))
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=emb_init)
+        self.layers = LayerList([
+            ParallelLlamaBlock(config, sequence_parallel,
+                               use_ring_attention)
+            for _ in range(config.num_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        x = _constrain_act(x, seq_axis="sep")
+        for blk in self.layers:
+            x = blk(x)
+        return self.norm(x)
+
+
+class ParallelLlamaForCausalLM(Layer):
+    """Llama wired for the hybrid mesh.  Use with fleet:
+
+        fleet.init(strategy)
+        model = ParallelLlamaForCausalLM(cfg)
+        fleet.distributed_model(model)
+    """
+
+    def __init__(self, config: LlamaConfig, sequence_parallel=False,
+                 use_ring_attention=False):
+        super().__init__()
+        self.config = config
+        self.llama = ParallelLlamaModel(config, sequence_parallel,
+                                        use_ring_attention)
+        self.loss_fn = ParallelCrossEntropy()
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.llama(input_ids)
+        logits = F.linear(hidden, self.llama.embed_tokens.weight.T)
+        mesh = get_mesh()
+        if mesh is not None and "mp" in mesh.dim_names:
+            from jax.sharding import PartitionSpec as P
+            entries = [None] * len(logits.shape)
+            if "dp" in mesh.dim_names:
+                entries[0] = "dp"
+            entries[-1] = "mp"
+            logits = shard_constraint(logits, mesh, spec=P(*entries))
+        if labels is not None:
+            loss = _masked_parallel_ce(self.loss_fn, logits, labels,
+                                       self.config.vocab_size)
+            return logits, loss
+        return logits
+
+    def num_params(self):
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len=None):
+        cfg = self.config
+        s = seq_len or cfg.max_seq_len
+        return 6 * self.num_params() + \
+            12 * cfg.num_layers * cfg.hidden_size * s

@@ -1,0 +1,96 @@
+"""Geometric package (reference: python/paddle/geometric/ +
+test/legacy_test/test_graph_send_recv_op.py numpy-reference pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+def _np(t):
+    return np.asarray(t._data_)
+
+
+def test_segment_ops():
+    data = paddle.to_tensor(np.array(
+        [[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 2], np.int32))
+    np.testing.assert_allclose(_np(G.segment_sum(data, ids)),
+                               [[4., 6.], [5., 6.], [7., 8.]])
+    np.testing.assert_allclose(_np(G.segment_mean(data, ids)),
+                               [[2., 3.], [5., 6.], [7., 8.]])
+    np.testing.assert_allclose(_np(G.segment_max(data, ids)),
+                               [[3., 4.], [5., 6.], [7., 8.]])
+    np.testing.assert_allclose(_np(G.segment_min(data, ids)),
+                               [[1., 2.], [5., 6.], [7., 8.]])
+
+
+def test_segment_sum_grad():
+    data = paddle.to_tensor(np.ones((4, 2), np.float32))
+    data.stop_gradient = False
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1], np.int32))
+    out = G.segment_sum(data, ids)
+    (out * paddle.to_tensor(np.array([[1.], [10.]], np.float32))).sum() \
+        .backward()
+    np.testing.assert_allclose(_np(data.grad),
+                               [[1., 1.], [1., 1.], [10., 10.], [10., 10.]])
+
+
+@pytest.mark.parametrize("reduce_op,expect", [
+    ("sum", [[4., 6.], [1., 2.], [0., 0.]]),
+    ("mean", [[2., 3.], [1., 2.], [0., 0.]]),
+    ("max", [[3., 4.], [1., 2.], [0., 0.]]),
+])
+def test_send_u_recv(reduce_op, expect):
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                  np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 0], np.int32))
+    dst = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+    out = G.send_u_recv(x, src, dst, reduce_op=reduce_op)
+    np.testing.assert_allclose(_np(out), expect)
+
+
+def test_send_ue_recv_and_send_uv():
+    x = paddle.to_tensor(np.array([[1., 1.], [2., 2.]], np.float32))
+    e = paddle.to_tensor(np.array([[0.5, 0.5], [1., 1.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1], np.int32))
+    dst = paddle.to_tensor(np.array([1, 0], np.int32))
+    out = G.send_ue_recv(x, e, src, dst, message_op="mul", reduce_op="sum")
+    np.testing.assert_allclose(_np(out), [[2., 2.], [0.5, 0.5]])
+    uv = G.send_uv(x, x, src, dst, message_op="add")
+    np.testing.assert_allclose(_np(uv), [[3., 3.], [3., 3.]])
+
+
+def test_send_u_recv_grad_flows():
+    x = paddle.to_tensor(np.ones((3, 2), np.float32))
+    x.stop_gradient = False
+    src = paddle.to_tensor(np.array([0, 1, 2], np.int32))
+    dst = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+    G.send_u_recv(x, src, dst).sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(_np(x.grad), np.ones((3, 2)))
+
+
+def test_reindex_graph():
+    x = paddle.to_tensor(np.array([10, 5], np.int64))
+    neighbors = paddle.to_tensor(np.array([3, 10, 5, 7], np.int64))
+    count = paddle.to_tensor(np.array([2, 2], np.int64))
+    src, dst, nodes = G.reindex_graph(x, neighbors, count)
+    # nodes: x first (10→0, 5→1), then new neighbors (3→2, 7→3)
+    np.testing.assert_array_equal(_np(nodes), [10, 5, 3, 7])
+    np.testing.assert_array_equal(_np(src), [2, 0, 1, 3])
+    np.testing.assert_array_equal(_np(dst), [0, 0, 1, 1])
+
+
+def test_sample_neighbors():
+    # CSC graph: node0 ← {1,2,3}, node1 ← {0}
+    row = paddle.to_tensor(np.array([1, 2, 3, 0], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 3, 4], np.int64))
+    nodes = paddle.to_tensor(np.array([0, 1], np.int64))
+    nb, cnt = G.sample_neighbors(row, colptr, nodes, sample_size=2)
+    assert _np(cnt).tolist() == [2, 1]
+    assert set(_np(nb)[:2].tolist()) <= {1, 2, 3}
+    assert _np(nb)[2] == 0
+    # full sampling
+    nb2, cnt2 = G.sample_neighbors(row, colptr, nodes, sample_size=-1)
+    assert _np(cnt2).tolist() == [3, 1]

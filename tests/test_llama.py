@@ -1,0 +1,91 @@
+"""Llama model family (reference capability: PaddleNLP Llama over Fleet;
+BASELINE.md config 4).  Pattern: parallel-vs-serial numerics like
+test/collective/fleet/ hybrid tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_config
+from paddle_tpu.models.llama import _repeat_kv
+
+
+def _ids(b=2, s=64, vocab=512, seed=0):
+    return paddle.to_tensor(
+        np.random.default_rng(seed).integers(0, vocab, (b, s))
+        .astype("int32"))
+
+
+def test_eager_trains():
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_config("tiny"))
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    ids = _ids()
+    losses = []
+    for _ in range(4):
+        _, loss = m(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_gqa_repeat_kv():
+    x = paddle.to_tensor(
+        np.arange(2 * 3 * 2 * 4, dtype=np.float32).reshape(2, 3, 2, 4))
+    y = _repeat_kv(x, 3)
+    assert tuple(y.shape) == (2, 3, 6, 4)
+    xn = np.asarray(x._data_)
+    yn = np.asarray(y._data_)
+    for rep in range(3):
+        np.testing.assert_allclose(yn[:, :, rep], xn[:, :, 0])
+        np.testing.assert_allclose(yn[:, :, 3 + rep], xn[:, :, 1])
+
+
+def test_gqa_matches_mha_when_equal_heads():
+    """num_kv_heads == num_heads must reduce to plain MHA paths."""
+    paddle.seed(1)
+    cfg = llama_config("tiny", num_kv_heads=4)   # == num_heads
+    m = LlamaForCausalLM(cfg)
+    out = m(_ids())
+    assert tuple(out.shape) == (2, 64, 512)
+
+
+def test_to_static_parity():
+    paddle.seed(2)
+    m = LlamaForCausalLM(llama_config("tiny"))
+    ids = _ids(seed=3)
+    eager = m(ids)
+
+    @paddle.jit.to_static
+    def fwd(x):
+        return m(x)
+
+    compiled = fwd(ids)
+    np.testing.assert_allclose(np.asarray(eager._data_),
+                               np.asarray(compiled._data_), atol=1e-4)
+
+
+def test_parallel_llama_matches_serial():
+    """dp4×mp2 hybrid llama numerics vs the serial model (same params)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import ParallelLlamaForCausalLM
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    fleet.init(is_collective=True, strategy=s)
+
+    # tied embeddings on both sides so the parameter lists align 1:1
+    cfg = llama_config("tiny", tie_word_embeddings=True)
+    paddle.seed(7)
+    sm = LlamaForCausalLM(cfg)
+    paddle.seed(7)
+    pm = ParallelLlamaForCausalLM(cfg)
+    for p_t, p_s in zip(pm.parameters(), sm.parameters()):
+        p_t.set_value(p_s.numpy())
+    fleet.distributed_model(pm)
+    ids = _ids(b=4, seed=5)
+    _, ploss = pm(ids, labels=ids)
+    _, sloss = sm(ids, labels=ids)
+    np.testing.assert_allclose(float(ploss.numpy()), float(sloss.numpy()),
+                               rtol=2e-3)

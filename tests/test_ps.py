@@ -1,0 +1,105 @@
+"""Parameter-server stack + ONNX export surface (reference:
+paddle/fluid/distributed/ps/ + python/paddle/distributed/ps/the_one_ps.py
++ python/paddle/onnx/export.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import (
+    DenseTable, SparseTable, PSServer, PSClient, TheOnePSRuntime,
+    PSEmbedding,
+)
+
+
+def test_tables_local():
+    d = DenseTable((4,), lr=0.5)
+    np.testing.assert_allclose(d.pull(), 0.0)
+    d.push(np.ones(4, np.float32))
+    np.testing.assert_allclose(d.pull(), -0.5)
+    s = SparseTable(3, lr=1.0)
+    rows = s.pull([7, 9])
+    assert rows.shape == (2, 3)
+    s.push([7], np.ones((1, 3), np.float32))
+    np.testing.assert_allclose(s.pull([7]), rows[0:1] - 1.0)
+    # untouched row unchanged
+    np.testing.assert_allclose(s.pull([9]), rows[1:2])
+
+
+@pytest.fixture()
+def runtime():
+    cfg = {"tables": {0: {"type": "sparse", "dim": 4, "lr": 0.1},
+                      1: {"type": "dense", "shape": [3], "lr": 0.1}}}
+    server_rt = TheOnePSRuntime("server", cfg)
+    server_rt.init_server()
+    worker_rt = TheOnePSRuntime("worker", cfg,
+                                server_address=server_rt.server_address)
+    client = worker_rt.init_worker()
+    yield server_rt, worker_rt, client
+    worker_rt.stop()
+
+
+def test_server_client_pull_push(runtime):
+    _, _, client = runtime
+    v = client.pull_dense(1)
+    np.testing.assert_allclose(v, 0.0)
+    client.push_dense(1, np.ones(3, np.float32))
+    np.testing.assert_allclose(client.pull_dense(1), -0.1, atol=1e-6)
+
+    rows = client.pull_sparse(0, [1, 2, 3])
+    assert rows.shape == (3, 4)
+    client.push_sparse(0, [2], np.ones((1, 4), np.float32))
+    after = client.pull_sparse(0, [2])
+    np.testing.assert_allclose(after, rows[1:2] - 0.1, atol=1e-6)
+    # state save round-trips through the wire
+    state = client.save()
+    assert 0 in state and 2 in state[0]
+
+
+def test_two_clients_share_state(runtime):
+    srv, _, c1 = runtime
+    c2 = PSClient(srv.server_address)
+    c1.push_dense(1, np.full(3, 10.0, np.float32))
+    np.testing.assert_allclose(c2.pull_dense(1), -1.0, atol=1e-6)
+    c2.close()
+
+
+def test_ps_embedding_trains(runtime):
+    """Sparse-embedding regression: pull on forward, push on backward —
+    loss must drop (the DistributedLookupTable flow)."""
+    _, _, client = runtime
+    emb = PSEmbedding(client, table_id=0, dim=4)
+    w = paddle.to_tensor(np.ones(4, np.float32))
+    target = 3.0
+    ids = np.array([5, 6], np.int64)
+    losses = []
+    for _ in range(30):
+        e, leaf = emb(paddle.to_tensor(ids))
+        pred = (e * w).sum(-1)
+        loss = ((pred - target) ** 2).mean()
+        loss.backward()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.05 * losses[0]
+
+
+def test_onnx_export_stablehlo(tmp_path):
+    from paddle_tpu import nn
+    from paddle_tpu.static import InputSpec
+    layer = nn.Linear(4, 2)
+    prefix = str(tmp_path / "model")
+    paddle.onnx.export(layer, prefix,
+                       input_spec=[InputSpec([1, 4], "float32", "x")])
+    import os
+    assert os.path.exists(prefix + ".pdmodel")
+    from paddle_tpu.inference import Predictor, Config
+    pred = Predictor(Config(prefix))
+    x = np.ones((1, 4), np.float32)
+    out = pred.run([x])[0]
+    ref = layer(paddle.to_tensor(x))
+    np.testing.assert_allclose(out, np.asarray(ref._data_), atol=1e-5)
+
+
+def test_onnx_strict_suffix_raises(tmp_path):
+    from paddle_tpu import nn
+    with pytest.raises(NotImplementedError):
+        paddle.onnx.export(nn.Linear(2, 2), str(tmp_path / "m.onnx"),
+                           input_spec=[None])
