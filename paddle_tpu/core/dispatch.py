@@ -52,6 +52,12 @@ def apply_op(name, fn, args, static=None, nondiff=False):
     Returns Tensor or tuple of Tensors; records a GradNode when needed.
     """
     static = static or {}
+    # prim mode: substitute the registered primitive decomposition
+    # (reference: decomposition/decomp.py applied via _set_prim_all_enabled)
+    # — guarded by the module flag so the off path costs one attr check
+    from .. import decomposition as _decomp
+    if _decomp._ENABLED:
+        fn = _decomp.maybe_decompose(name, fn)
     if static and any(isinstance(v, Tensor) for v in static.values()):
         # Tensors passed by keyword must flow through the vjp path, not be
         # silently captured as constants — rebind them positionally.

@@ -1,0 +1,148 @@
+"""Composite-op decomposition registry ("prim" mode).
+
+Reference capability: python/paddle/decomposition/{decomp.py,rules.py} +
+paddle/fluid/primitive/ — rewrite composite ops (softmax, gelu,
+layer_norm, ...) into primitive compositions so compiler passes and
+higher-order AD see only simple ops, toggled by
+`core._set_prim_all_enabled`.
+
+TPU-native realization: XLA already receives primitives (jaxprs), so the
+registry's role here is the *semantic* one — a switchable table of
+composite → primitive implementations that the dispatch funnel
+substitutes when prim mode is on.  Uses: numerically-transparent op
+definitions for transforms (quantization observers see the internals),
+reference implementations for kernel testing, and double-backward through
+ops whose fused forms lack higher-order rules.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_RULES: dict[str, callable] = {}
+_ENABLED = False
+
+
+def register_decomp(name):
+    """Register fn(*arrays, **static) as the primitive form of op `name`."""
+    def deco(fn):
+        _RULES[name] = fn
+        return fn
+    return deco
+
+
+def enable_prim():
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable_prim():
+    global _ENABLED
+    _ENABLED = False
+
+
+def prim_enabled():
+    return _ENABLED
+
+
+def has_decomp(name):
+    return name in _RULES
+
+
+def maybe_decompose(name, fn):
+    """Dispatch hook: the rule replaces the op impl while prim is on."""
+    if _ENABLED:
+        rule = _RULES.get(name)
+        if rule is not None:
+            from ..utils import monitor
+            monitor.incr("prim.decomposed")
+            return rule
+    return fn
+
+
+# ---------------- rules (reference: decomposition/rules.py) ----------------
+
+@register_decomp("softmax")
+def _softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        x = x.astype(convert_dtype(dtype))
+    m = jnp.max(x, axis=axis, keepdims=True)
+    e = jnp.exp(x - m)
+    return e / jnp.sum(e, axis=axis, keepdims=True)
+
+
+@register_decomp("log_softmax")
+def _log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        x = x.astype(convert_dtype(dtype))
+    m = jnp.max(x, axis=axis, keepdims=True)
+    shifted = x - m
+    return shifted - jnp.log(jnp.sum(jnp.exp(shifted), axis=axis,
+                                     keepdims=True))
+
+
+@register_decomp("gelu")
+def _gelu(x, approximate=False, name=None):
+    if approximate:
+        c = 0.7978845608028654  # sqrt(2/pi)
+        return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x ** 3)))
+    return 0.5 * x * (1.0 + jax.lax.erf(x / 1.4142135623730951))
+
+
+@register_decomp("silu")
+def _silu(x, name=None):
+    return x * (1.0 / (1.0 + jnp.exp(-x)))
+
+
+@register_decomp("sigmoid")
+def _sigmoid(x, name=None):
+    return 1.0 / (1.0 + jnp.exp(-x))
+
+
+@register_decomp("layer_norm")
+def _layer_norm(x, normalized_shape=None, weight=None, bias=None,
+                epsilon=1e-5, name=None):
+    # signature MUST mirror nn.functional.layer_norm — the rule is called
+    # with the original op's positional args
+    ndim = 1 if normalized_shape is None else (
+        1 if isinstance(normalized_shape, int) else len(normalized_shape))
+    axes = tuple(range(-ndim, 0))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_decomp("rms_norm")
+def _rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    out = x / jnp.sqrt(ms + epsilon)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@register_decomp("mean")
+def _mean(x, axis=None, keepdim=False, name=None):
+    if axis is None:
+        n = x.size
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        n = 1
+        for a in axes:
+            n *= x.shape[a]
+        axis = axes
+    return jnp.sum(x, axis=axis, keepdims=keepdim) / n
+
+
+@register_decomp("softplus")
+def _softplus(x, beta=1.0, threshold=20.0, name=None):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x,
+                     jnp.log1p(jnp.exp(scaled)) / beta)

@@ -26,3 +26,4 @@ from .meta_parallel import (  # noqa: F401
     PipelineParallelWithInterleave, TensorParallel, SegmentParallel,
     ShardingParallel,
 )
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401

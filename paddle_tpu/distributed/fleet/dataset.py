@@ -1,0 +1,129 @@
+"""Fleet datasets: file-list driven PS data pipeline.
+
+Reference capability: `InMemoryDataset`/`QueueDataset`
+(python/paddle/distributed/fleet/dataset/dataset.py over the C++
+`data_feed`/`MultiTrainer` pipeline, paddle/fluid/framework/data_feed.cc)
+— file-list ingestion, in-memory global/local shuffle, streaming queue
+mode, and the user `data_generator` line-parsing protocol.
+
+TPU-native realization: host-side ingestion feeding device steps (the
+device never parses text).  `set_parse_func` is the data_generator
+protocol analog (line → sample); batches come out as numpy arrays ready
+for `paddle.to_tensor`, sharded across workers by file (the reference's
+file-split contract).
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+
+def _default_parse(line):
+    """Default protocol: whitespace-separated floats."""
+    return np.array([float(t) for t in line.split()], np.float32)
+
+
+class DatasetBase:
+    def __init__(self):
+        self.filelist = []
+        self.batch_size = 1
+        self.thread_num = 1
+        self.parse_fn = _default_parse
+        self.drop_last = False
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, **kwargs):
+        """reference: DatasetBase.init (dataset.py) — pipe_command is the
+        external-process protocol; here parsing is in-process via
+        set_parse_func."""
+        self.batch_size = batch_size
+        self.thread_num = thread_num
+        return self
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = batch_size
+
+    def set_parse_func(self, fn):
+        """The data_generator analog: fn(line) -> sample (numpy/tuple)."""
+        self.parse_fn = fn
+
+    def _worker_files(self, worker_id=0, worker_num=1):
+        """File-split contract: worker i takes files i, i+n, i+2n ..."""
+        return self.filelist[worker_id::worker_num]
+
+    def _batches(self, samples):
+        batch = []
+        for s in samples:
+            batch.append(s)
+            if len(batch) == self.batch_size:
+                yield self._collate(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self._collate(batch)
+
+    @staticmethod
+    def _collate(batch):
+        if isinstance(batch[0], tuple):
+            return tuple(np.stack([b[i] for b in batch])
+                         for i in range(len(batch[0])))
+        return np.stack(batch)
+
+
+class InMemoryDataset(DatasetBase):
+    """Load → shuffle → iterate (reference: InMemoryDataset —
+    load_into_memory :  local_shuffle : global_shuffle : release_memory)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = None
+        self._rng = random.Random(0)
+
+    def load_into_memory(self, worker_id=0, worker_num=1):
+        self._samples = []
+        for path in self._worker_files(worker_id, worker_num):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        self._samples.append(self.parse_fn(line))
+        return len(self._samples)
+
+    def local_shuffle(self):
+        if self._samples is None:
+            raise RuntimeError("call load_into_memory first")
+        self._rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        """Single-host realization == local shuffle; multi-host exchange
+        would ride the collective layer (reference shuffles via PS)."""
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = None
+
+    def set_shuffle_seed(self, seed):
+        self._rng = random.Random(seed)
+
+    def __iter__(self):
+        if self._samples is None:
+            raise RuntimeError("call load_into_memory first")
+        return self._batches(iter(self._samples))
+
+
+class QueueDataset(DatasetBase):
+    """Streaming mode: never holds the full dataset (reference:
+    QueueDataset — files stream through the feed queue)."""
+
+    def __iter__(self, worker_id=0, worker_num=1):
+        def gen():
+            for path in self._worker_files(worker_id, worker_num):
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            yield self.parse_fn(line)
+        return self._batches(gen())

@@ -45,12 +45,15 @@ def _finite_or_zero(v):
     return jnp.where(jnp.isfinite(v), v, jnp.zeros_like(v))
 
 
-def _segment(op_name, reducer, data, segment_ids, out_size=None, name=None):
+def _segment(op_name, reducer, data, segment_ids, out_size=None, name=None,
+             fix_empty=False):
     n = _num_segments(segment_ids, out_size)
 
     def fn(x, ids):
-        return _finite_or_zero(
-            reducer(x, ids.astype(jnp.int32), num_segments=n))
+        out = reducer(x, ids.astype(jnp.int32), num_segments=n)
+        # only max/min produce +/-inf for EMPTY segments; sum must keep
+        # propagating NaN/Inf from the data itself
+        return _finite_or_zero(out) if fix_empty else out
     return apply_op(op_name, fn, (data, segment_ids))
 
 
@@ -76,12 +79,12 @@ def segment_mean(data, segment_ids, out_size=None, name=None):
 
 def segment_max(data, segment_ids, out_size=None, name=None):
     return _segment("segment_max", jax.ops.segment_max, data, segment_ids,
-                    out_size)
+                    out_size, fix_empty=True)
 
 
 def segment_min(data, segment_ids, out_size=None, name=None):
     return _segment("segment_min", jax.ops.segment_min, data, segment_ids,
-                    out_size)
+                    out_size, fix_empty=True)
 
 
 _REDUCE = {
@@ -112,8 +115,8 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
                 jnp.ones((msg.shape[0],), xv.dtype), dst, num_segments=n)
             shape = (n,) + (1,) * (xv.ndim - 1)
             return s / jnp.maximum(cnt.reshape(shape), 1)
-        return _finite_or_zero(
-            _REDUCE[reduce_op](msg, dst, num_segments=n))
+        out = _REDUCE[reduce_op](msg, dst, num_segments=n)
+        return _finite_or_zero(out) if reduce_op in ("max", "min") else out
     return apply_op("send_u_recv", fn, (x, src_index, dst_index))
 
 
@@ -147,8 +150,8 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
                 jnp.ones((msg.shape[0],), msg.dtype), dst, num_segments=n)
             shape = (n,) + (1,) * (msg.ndim - 1)
             return s / jnp.maximum(cnt.reshape(shape), 1)
-        return _finite_or_zero(
-            _REDUCE[reduce_op](msg, dst, num_segments=n))
+        out = _REDUCE[reduce_op](msg, dst, num_segments=n)
+        return _finite_or_zero(out) if reduce_op in ("max", "min") else out
     return apply_op("send_ue_recv", fn, (x, y, src_index, dst_index))
 
 

@@ -72,6 +72,8 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _fetch(self, indices):
+        from ..utils import monitor as _monitor
+        _monitor.incr("io.batches_fetched")
         samples = [self.dataset[i] for i in indices]
         return self.collate_fn(samples)
 

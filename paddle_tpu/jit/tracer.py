@@ -291,10 +291,14 @@ class StaticFunction:
             result = self._fn(*args, **kwargs)
             self._cache[key] = _WARMUP
             return result
+        from ..utils import monitor as _monitor
         if state is _WARMUP:
+            _monitor.incr("jit.cache_miss")
             return self._discover(key, args, kwargs)
         if state.eager_only:
+            _monitor.incr("jit.eager_fallback")
             return self._fn(*args, **kwargs)
+        _monitor.incr("jit.cache_hit")
         return self._run_compiled(key, state, args, kwargs)
 
     # ---------------- phase 1: discovery (eager) ----------------

@@ -158,11 +158,22 @@ class ParallelLlamaForCausalLM(Layer):
         self.config = config
         self.llama = ParallelLlamaModel(config, sequence_parallel,
                                         use_ring_attention)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            # untied head (the Llama-2 default), vocab-sharded over mp to
+            # feed ParallelCrossEntropy without gathering logits
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=False)
         self.loss_fn = ParallelCrossEntropy()
 
     def forward(self, input_ids, labels=None):
         hidden = self.llama(input_ids)
-        logits = F.linear(hidden, self.llama.embed_tokens.weight.T)
+        if self.lm_head is not None:
+            logits = self.lm_head(hidden)
+        else:
+            logits = F.linear(hidden, self.llama.embed_tokens.weight.T)
         mesh = get_mesh()
         if mesh is not None and "mp" in mesh.dim_names:
             from jax.sharding import PartitionSpec as P

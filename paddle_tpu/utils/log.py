@@ -1,0 +1,63 @@
+"""Structured logging: rank-aware framework logger.
+
+Reference capability: `fleet/utils/log_util.py` logger +
+`base/log_helper.py` (per-rank prefixes, level from env) and glog VLOG
+levels on the C++ side.
+
+TPU-native realization: one `logging.Logger` ("paddle_tpu") with a
+rank-stamped formatter (rank read lazily — before jax.distributed init it
+shows rank -).  `set_log_level` maps the reference's VLOG-style levels;
+`log_every_n` mirrors the common glog idiom used in training loops.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LOGGER = None
+_COUNTS: dict[str, int] = {}
+
+
+class _RankFormatter(logging.Formatter):
+    def format(self, record):
+        rank = os.environ.get("PADDLE_TRAINER_ID")
+        if rank is None:
+            try:
+                import jax
+                rank = str(jax.process_index())
+            except Exception:
+                rank = "-"
+        record.rank = rank
+        return super().format(record)
+
+
+def get_logger(name="paddle_tpu"):
+    global _LOGGER
+    if _LOGGER is None:
+        logger = logging.getLogger(name)
+        if not logger.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(_RankFormatter(
+                "%(asctime)s [rank %(rank)s] %(levelname)s "
+                "%(name)s: %(message)s"))
+            logger.addHandler(h)
+        logger.setLevel(os.environ.get("PADDLE_LOG_LEVEL", "INFO").upper())
+        logger.propagate = False
+        _LOGGER = logger
+    return _LOGGER
+
+
+def set_log_level(level):
+    get_logger().setLevel(
+        level.upper() if isinstance(level, str) else level)
+
+
+def log_every_n(level, msg, n=100, *args):
+    """Emit every n-th occurrence of this message site (glog idiom)."""
+    key = f"{level}:{msg}"
+    c = _COUNTS.get(key, 0)
+    _COUNTS[key] = c + 1
+    if c % n == 0:
+        get_logger().log(getattr(logging, level.upper(), logging.INFO),
+                         msg, *args)

@@ -1,0 +1,45 @@
+"""Monitor counters: named int/float stats registry.
+
+Reference capability: `paddle/fluid/platform/monitor.{h,cc}` —
+`STAT_INT`/`DEFINE_INT_STATUS` global counters readable from python via
+core monitor getters; used for allocator/executor observability.
+
+TPU-native realization: a process-local thread-safe registry.  The
+framework increments counters at its seams (jit cache hits/misses,
+dataloader batches, collective calls); `get_monitor_value`/`all_stats`
+expose them to user dashboards and tests.
+"""
+from __future__ import annotations
+
+import threading
+
+_LOCK = threading.Lock()
+_STATS: dict[str, float] = {}
+
+
+def incr(name, value=1):
+    with _LOCK:
+        _STATS[name] = _STATS.get(name, 0) + value
+
+
+def set_value(name, value):
+    with _LOCK:
+        _STATS[name] = value
+
+
+def get_monitor_value(name, default=0):
+    with _LOCK:
+        return _STATS.get(name, default)
+
+
+def all_stats():
+    with _LOCK:
+        return dict(_STATS)
+
+
+def reset(name=None):
+    with _LOCK:
+        if name is None:
+            _STATS.clear()
+        else:
+            _STATS.pop(name, None)

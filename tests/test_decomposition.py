@@ -1,0 +1,107 @@
+"""Prim/decomposition registry (reference:
+python/paddle/decomposition/rules.py + _set_prim_all_enabled)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import decomposition as D
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.utils import monitor
+
+
+@pytest.fixture(autouse=True)
+def _prim_off():
+    yield
+    D.disable_prim()
+
+
+@pytest.mark.parametrize("op,args,kwargs", [
+    ("softmax", lambda x: F.softmax(x, axis=-1), {}),
+    ("log_softmax", lambda x: F.log_softmax(x, axis=-1), {}),
+    ("gelu", lambda x: F.gelu(x), {}),
+    ("gelu_tanh", lambda x: F.gelu(x, approximate=True), {}),
+    ("silu", lambda x: F.silu(x), {}),
+    ("layer_norm", lambda x: F.layer_norm(x), {}),
+    ("rms_norm", lambda x: F.rms_norm(x), {}),
+    ("softplus", lambda x: F.softplus(x), {}),
+], ids=lambda v: v if isinstance(v, str) else "")
+def test_decomposed_matches_fused(op, args, kwargs):
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((4, 16)).astype("float32"))
+    D.disable_prim()
+    fused = args(x)
+    D.enable_prim()
+    decomposed = args(x)
+    np.testing.assert_allclose(np.asarray(fused._data_),
+                               np.asarray(decomposed._data_), atol=1e-5)
+
+
+def test_prim_rule_actually_taken():
+    monitor.reset("prim.decomposed")
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    D.enable_prim()
+    F.softmax(x)
+    assert monitor.get_monitor_value("prim.decomposed") >= 1
+    D.disable_prim()
+    monitor.reset("prim.decomposed")
+    F.softmax(x)
+    assert monitor.get_monitor_value("prim.decomposed") == 0
+
+
+def test_decomposed_grads_flow():
+    x = paddle.to_tensor(
+        np.random.default_rng(1).standard_normal((3, 8)).astype("float32"))
+    x.stop_gradient = False
+    D.enable_prim()
+    F.gelu(F.layer_norm(x)).sum().backward()
+    assert x.grad is not None
+    g_prim = np.asarray(x.grad._data_)
+    D.disable_prim()
+    x2 = paddle.to_tensor(np.asarray(x._data_))
+    x2.stop_gradient = False
+    F.gelu(F.layer_norm(x2)).sum().backward()
+    np.testing.assert_allclose(g_prim, np.asarray(x2.grad._data_),
+                               atol=1e-5)
+
+
+def test_custom_rule_registration():
+    calls = []
+
+    @D.register_decomp("relu")
+    def my_relu(x, name=None):
+        calls.append(1)
+        import jax.numpy as jnp
+        return jnp.maximum(x, 0.0)
+
+    try:
+        D.enable_prim()
+        out = F.relu(paddle.to_tensor(np.array([-1.0, 2.0], np.float32)))
+        assert calls and np.asarray(out._data_).tolist() == [0.0, 2.0]
+    finally:
+        D._RULES.pop("relu", None)
+
+
+def test_layer_norm_layer_under_prim():
+    """nn.LayerNorm passes normalized_shape positionally — the rule must
+    bind it correctly (regression: weight bound to the shape list)."""
+    x = paddle.to_tensor(
+        np.random.default_rng(2).standard_normal((2, 8)).astype("float32"))
+    ln = nn.LayerNorm(8)
+    ln.weight.set_value(np.linspace(0.5, 1.5, 8).astype("float32"))
+    ln.bias.set_value(np.linspace(-1, 1, 8).astype("float32"))
+    D.disable_prim()
+    fused = ln(x)
+    D.enable_prim()
+    decomposed = ln(x)
+    np.testing.assert_allclose(np.asarray(fused._data_),
+                               np.asarray(decomposed._data_), atol=1e-5)
+
+
+def test_softmax_dtype_and_mean_list_axis_under_prim():
+    x = paddle.to_tensor(np.ones((2, 3, 4), np.float32))
+    D.enable_prim()
+    out = F.softmax(x, dtype="float32")
+    assert np.allclose(np.asarray(out._data_).sum(-1), 1.0)
+    m = paddle.mean(x, axis=[1, 2])
+    np.testing.assert_allclose(np.asarray(m._data_), 1.0)

@@ -1,0 +1,126 @@
+"""Fleet datasets + monitor counters + structured log (reference:
+fleet/dataset/dataset.py, platform/monitor.cc, fleet/utils/log_util.py)."""
+import numpy as np
+
+from paddle_tpu.distributed.fleet.dataset import (
+    InMemoryDataset, QueueDataset,
+)
+from paddle_tpu.utils import monitor
+from paddle_tpu.utils.log import get_logger, log_every_n, set_log_level
+
+
+def _write_files(tmp_path, n_files=3, rows=5):
+    files = []
+    v = 0
+    for i in range(n_files):
+        p = tmp_path / f"part-{i}.txt"
+        lines = []
+        for _ in range(rows):
+            lines.append(f"{v} {v + 0.5}")
+            v += 1
+        p.write_text("\n".join(lines) + "\n")
+        files.append(str(p))
+    return files
+
+
+def test_in_memory_dataset(tmp_path):
+    files = _write_files(tmp_path)
+    ds = InMemoryDataset()
+    ds.init(batch_size=4)
+    ds.set_filelist(files)
+    n = ds.load_into_memory()
+    assert n == 15
+    batches = list(ds)
+    assert len(batches) == 4          # 4+4+4+3
+    assert batches[0].shape == (4, 2)
+    total = np.concatenate([b for b in batches])
+    assert total.shape == (15, 2)
+
+    ds.set_shuffle_seed(1)
+    before = np.concatenate(list(ds))
+    ds.local_shuffle()
+    after = np.concatenate(list(ds))
+    assert sorted(before[:, 0].tolist()) == sorted(after[:, 0].tolist())
+    assert not np.array_equal(before, after)
+    ds.release_memory()
+
+
+def test_file_split_across_workers(tmp_path):
+    files = _write_files(tmp_path, n_files=4)
+    ds = InMemoryDataset()
+    ds.init(batch_size=100)
+    ds.set_filelist(files)
+    n0 = ds.load_into_memory(worker_id=0, worker_num=2)
+    all0 = np.concatenate(list(ds))
+    n1 = ds.load_into_memory(worker_id=1, worker_num=2)
+    all1 = np.concatenate(list(ds))
+    assert n0 + n1 == 20
+    # disjoint coverage
+    assert not set(all0[:, 0].tolist()) & set(all1[:, 0].tolist())
+
+
+def test_queue_dataset_streams(tmp_path):
+    files = _write_files(tmp_path, n_files=2)
+    ds = QueueDataset()
+    ds.init(batch_size=3)
+    ds.set_filelist(files)
+    batches = list(iter(ds))
+    assert sum(b.shape[0] for b in batches) == 10
+
+
+def test_custom_parse_fn(tmp_path):
+    p = tmp_path / "labeled.txt"
+    p.write_text("1,2,0\n3,4,1\n")
+    ds = InMemoryDataset()
+    ds.init(batch_size=2)
+    ds.set_filelist([str(p)])
+
+    def parse(line):
+        *feat, label = line.split(",")
+        return (np.array([float(f) for f in feat], np.float32),
+                np.int64(label))
+
+    ds.set_parse_func(parse)
+    ds.load_into_memory()
+    x, y = next(iter(ds))
+    assert x.shape == (2, 2) and y.tolist() == [0, 1]
+
+
+def test_monitor_counters():
+    monitor.reset()
+    monitor.incr("test.a")
+    monitor.incr("test.a", 4)
+    monitor.set_value("test.b", 7.5)
+    assert monitor.get_monitor_value("test.a") == 5
+    assert monitor.all_stats()["test.b"] == 7.5
+    monitor.reset("test.a")
+    assert monitor.get_monitor_value("test.a") == 0
+
+
+def test_jit_counters_increment():
+    import paddle_tpu as paddle
+    monitor.reset()
+
+    @paddle.jit.to_static
+    def f(x):
+        return x * 2
+
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    f(x)   # warmup
+    f(x)   # discovery
+    f(x)   # compiled
+    f(x)   # compiled
+    assert monitor.get_monitor_value("jit.cache_miss") >= 1
+    assert monitor.get_monitor_value("jit.cache_hit") >= 2
+
+
+def test_logger_rank_stamped(capsys):
+    set_log_level("INFO")
+    log = get_logger()
+    log.info("hello from the framework")
+    err = capsys.readouterr().err
+    assert "rank" in err and "hello from the framework" in err
+    for _ in range(5):
+        log_every_n("info", "repeated message", n=100)
+    err = capsys.readouterr().err
+    assert err.count("repeated message") == 1

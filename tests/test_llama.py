@@ -89,3 +89,26 @@ def test_parallel_llama_matches_serial():
     _, sloss = sm(ids, labels=ids)
     np.testing.assert_allclose(float(ploss.numpy()), float(sloss.numpy()),
                                rtol=2e-3)
+
+
+def test_parallel_llama_untied_head():
+    """Default Llama-2 config is untied — the parallel model must carry a
+    separate (vocab-sharded) lm_head like the serial one."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import ParallelLlamaForCausalLM
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    fleet.init(is_collective=True, strategy=s)
+    cfg = llama_config("tiny")          # tie_word_embeddings=False
+    pm = ParallelLlamaForCausalLM(cfg)
+    assert pm.lm_head is not None
+    sm = LlamaForCausalLM(cfg)
+    assert len(list(pm.parameters())) == len(list(sm.parameters()))
+    for p_t, p_s in zip(pm.parameters(), sm.parameters()):
+        p_t.set_value(p_s.numpy())
+    fleet.distributed_model(pm)
+    ids = _ids(b=4, seed=9)
+    _, ploss = pm(ids, labels=ids)
+    _, sloss = sm(ids, labels=ids)
+    np.testing.assert_allclose(float(ploss.numpy()), float(sloss.numpy()),
+                               rtol=2e-3)

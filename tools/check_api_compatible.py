@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""API-compatibility gate.
+
+Reference capability: tools/check_api_compatible.py — CI compares the
+public API surface against a recorded spec and fails on silent
+removals/signature breaks.
+
+Usage:
+    python tools/check_api_compatible.py            # check vs api_spec.json
+    python tools/check_api_compatible.py --update   # re-record the spec
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import inspect
+import json
+import os
+import sys
+
+SPEC_PATH = os.path.join(os.path.dirname(__file__), "api_spec.json")
+
+# the public modules whose surfaces are contract
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.nn",
+    "paddle_tpu.nn.functional",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.distributed",
+    "paddle_tpu.distribution",
+    "paddle_tpu.geometric",
+    "paddle_tpu.sparse",
+    "paddle_tpu.amp",
+    "paddle_tpu.io",
+    "paddle_tpu.jit",
+    "paddle_tpu.static",
+    "paddle_tpu.vision",
+]
+
+
+def _sig_of(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return None
+
+
+def snapshot():
+    spec = {}
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        entries = {}
+        for name in sorted(dir(mod)):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            kind = ("class" if inspect.isclass(obj)
+                    else "function" if callable(obj)
+                    else "module" if inspect.ismodule(obj)
+                    else "value")
+            entries[name] = {"kind": kind}
+            if kind == "function":
+                entries[name]["sig"] = _sig_of(obj)
+        spec[modname] = entries
+    return spec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true")
+    args = ap.parse_args()
+
+    current = snapshot()
+    if args.update or not os.path.exists(SPEC_PATH):
+        with open(SPEC_PATH, "w") as f:
+            json.dump(current, f, indent=1, sort_keys=True)
+        print(f"recorded API spec → {SPEC_PATH}")
+        return 0
+
+    with open(SPEC_PATH) as f:
+        recorded = json.load(f)
+    problems = []
+    for modname, entries in recorded.items():
+        cur = current.get(modname, {})
+        for name, meta in entries.items():
+            if name not in cur:
+                problems.append(f"{modname}.{name}: REMOVED")
+            elif meta.get("sig") and cur[name].get("sig") and \
+                    meta["sig"] != cur[name]["sig"]:
+                problems.append(
+                    f"{modname}.{name}: signature changed "
+                    f"{meta['sig']} -> {cur[name]['sig']}")
+    if problems:
+        print("API compatibility check FAILED:")
+        for p in problems:
+            print(" ", p)
+        print("(intentional? re-record with --update)")
+        return 1
+    n = sum(len(v) for v in recorded.values())
+    print(f"API compatibility check passed ({n} symbols)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Benchmark regression gate.
+
+Reference capability: tools/check_op_benchmark_result.py — CI compares a
+run's numbers against recorded baselines and fails on regressions beyond
+a threshold.
+
+Usage: python tools/check_bench_result.py BENCH_rN.json [--threshold 0.9]
+Compares `value` against the recorded per-platform best in
+BENCH_BASELINE.json (written by bench.py)."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("bench_json")
+    ap.add_argument("--baseline", default=os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_BASELINE.json"))
+    ap.add_argument("--threshold", type=float, default=0.9,
+                    help="fail if value < threshold * recorded best")
+    args = ap.parse_args()
+
+    with open(args.bench_json) as f:
+        run = json.load(f)
+    if "parsed" in run:          # driver-recorded BENCH_rN.json wrapper
+        run = run["parsed"]
+    value = float(run["value"])
+    platform = "cpu" if "cpu" in run.get("metric", "") else "tpu"
+
+    try:
+        with open(args.baseline) as f:
+            base = json.load(f)
+    except OSError:
+        print("no baseline recorded — pass (first run)")
+        return 0
+    entry = base.get(platform) or {}
+    best = entry.get("tokens_per_sec")
+    if not best:
+        print(f"no {platform} baseline recorded — pass")
+        return 0
+    ratio = value / best
+    print(f"{run['metric']}: {value:.1f} vs best {best:.1f} "
+          f"(ratio {ratio:.3f}, threshold {args.threshold})")
+    if ratio < args.threshold:
+        print("benchmark regression gate FAILED")
+        return 1
+    print("benchmark gate passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# CI gate (reference capability: the tools/ check scripts + CTest
+# orchestration).  Runs on the virtual CPU mesh so no TPU is needed.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PALLAS_AXON_POOL_IPS=
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+echo "== byte-compile check =="
+python -m compileall -q paddle_tpu
+
+echo "== API compatibility gate =="
+python tools/check_api_compatible.py
+
+echo "== unit tests =="
+python -m pytest tests/ -q
+
+echo "== driver hooks compile =="
+python - <<'EOF'
+import jax
+from __graft_entry__ import entry, dryrun_multichip
+fn, args = entry()
+jax.jit(fn)(*args)
+dryrun_multichip(2)
+print("driver hooks OK")
+EOF
+
+echo "CI gates all green"
