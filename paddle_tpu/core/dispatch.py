@@ -17,6 +17,9 @@ from .tensor import Tensor
 from .autograd import GradNode
 
 
+_DECOMP = None
+
+
 def _amp_cast(name, arrays):
     """bf16 autocast hook (reference: eager_amp_auto_cast.h insertion point)."""
     from ..amp.amp_lists import WHITE_LIST, BLACK_LIST
@@ -54,10 +57,13 @@ def apply_op(name, fn, args, static=None, nondiff=False):
     static = static or {}
     # prim mode: substitute the registered primitive decomposition
     # (reference: decomposition/decomp.py applied via _set_prim_all_enabled)
-    # — guarded by the module flag so the off path costs one attr check
-    from .. import decomposition as _decomp
-    if _decomp._ENABLED:
-        fn = _decomp.maybe_decompose(name, fn)
+    # — module ref bound once lazily; the off path is one flag check
+    global _DECOMP
+    if _DECOMP is None:
+        from .. import decomposition as _DECOMP_mod
+        _DECOMP = _DECOMP_mod
+    if _DECOMP._ENABLED:
+        fn = _DECOMP.maybe_decompose(name, fn)
     if static and any(isinstance(v, Tensor) for v in static.values()):
         # Tensors passed by keyword must flow through the vjp path, not be
         # silently captured as constants — rebind them positionally.

@@ -116,9 +116,22 @@ class InMemoryDataset(DatasetBase):
 
 class QueueDataset(DatasetBase):
     """Streaming mode: never holds the full dataset (reference:
-    QueueDataset — files stream through the feed queue)."""
+    QueueDataset — files stream through the feed queue).  Shard with
+    set_worker(worker_id, worker_num) BEFORE iterating — __iter__ takes
+    no arguments under the iteration protocol."""
 
-    def __iter__(self, worker_id=0, worker_num=1):
+    def __init__(self):
+        super().__init__()
+        self._worker_id = 0
+        self._worker_num = 1
+
+    def set_worker(self, worker_id, worker_num):
+        self._worker_id = worker_id
+        self._worker_num = worker_num
+
+    def __iter__(self):
+        worker_id, worker_num = self._worker_id, self._worker_num
+
         def gen():
             for path in self._worker_files(worker_id, worker_num):
                 with open(path) as f:

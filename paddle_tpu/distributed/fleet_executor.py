@@ -131,10 +131,16 @@ class Carrier:
                 pass
 
     def run(self, timeout=None):
+        import time as _time
+        deadline = None if timeout is None else _time.time() + timeout
         for it in self.interceptors.values():
             it.start()
         for it in self.interceptors.values():
-            it.join(timeout=timeout)
+            # shared deadline: N sequential joins must not multiply the
+            # timeout, and the task blamed is whichever is alive at expiry
+            remaining = None if deadline is None else \
+                max(deadline - _time.time(), 0.0)
+            it.join(timeout=remaining)
             if it.is_alive():
                 self.abort()
                 raise TimeoutError(

@@ -141,11 +141,72 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
                            scale=scale)
 
 
-def masked_multihead_attention(x, cache_kv=None, *args, **kwargs):
-    """reference: incubate/nn/functional/masked_multihead_attention.py —
-    decode-time single-token attention against a KV cache.  Provided at the
-    model level by GPT's incremental decoding; this entry point is kept for
-    API parity and routes to it."""
-    raise NotImplementedError(
-        "use models.gpt generation path; kernel-level MMHA lands with the "
-        "inference engine")
+def masked_multihead_attention(q, k, v, cache_k, cache_v, offset,
+                               scale=None, name=None):
+    """Decode-time attention against a fixed-size KV cache (reference:
+    incubate/nn/functional/masked_multihead_attention.py over
+    fusion/gpu/masked_multihead_attention.cu).
+
+    q/k/v: [B, S, H, D] new tokens (S=1 in steady-state decode, larger at
+    prefill); cache_k/cache_v: [B, S_max, H, D]; offset: int32 scalar —
+    tokens already in the cache.  Writes the new K/V at offset..offset+S,
+    attends causally over positions <= offset+i, and returns
+    (out, cache_k', cache_v').  Static shapes throughout: one compiled
+    program serves every decode step (the TPU analog of the reference's
+    persistent decode kernel).
+
+    GQA is native: when K/V carry fewer heads than Q (cache holds
+    num_kv_heads — never the repeated copies), Q's heads are grouped onto
+    the KV heads inside the einsum, so cache HBM and attention FLOPs stay
+    at the kv-head count.
+    """
+    import math as _math
+
+    # eager bounds check: dynamic_update_slice CLAMPS an out-of-range
+    # start, which would silently overwrite earlier cache positions while
+    # the causal mask still used the unclamped offset
+    s_new = (q.shape[1] if hasattr(q, "shape") else 0)
+    s_cap = cache_k.shape[1]
+    off_concrete = None
+    try:
+        off_concrete = int(offset if isinstance(offset, int)
+                           else offset.item())
+    except Exception:
+        pass   # traced offset: caller owns the bound
+    if off_concrete is not None and off_concrete + s_new > s_cap:
+        raise ValueError(
+            f"KV cache overflow: offset {off_concrete} + {s_new} new "
+            f"tokens > cache capacity {s_cap}")
+
+    def fn(qa, ka, va, ck, cv, off):
+        b, s, h_q, d = qa.shape
+        s_max, h_kv = ck.shape[1], ck.shape[2]
+        sc = scale if scale is not None else 1.0 / _math.sqrt(d)
+        off = off.astype(jnp.int32) if hasattr(off, "astype") else \
+            jnp.int32(off)
+        ck = jax.lax.dynamic_update_slice(ck, ka.astype(ck.dtype),
+                                          (0, off, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, va.astype(cv.dtype),
+                                          (0, off, 0, 0))
+        q_pos = off + jnp.arange(s)[:, None]          # [s, 1]
+        k_pos = jnp.arange(s_max)[None, :]            # [1, s_max]
+        mask = k_pos <= q_pos                         # causal over cache
+        qf = qa.astype(jnp.float32)
+        kf = ck.astype(jnp.float32)
+        if h_q == h_kv:
+            logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * sc
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cv.dtype), cv)
+        else:                                         # grouped-query
+            rep = h_q // h_kv
+            qg = qf.reshape(b, s, h_kv, rep, d)
+            logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, kf) * sc
+            logits = jnp.where(mask[None, None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhrqk,bkhd->bqhrd", probs.astype(cv.dtype),
+                             cv).reshape(b, s, h_q, d)
+        return out.astype(qa.dtype), ck, cv
+
+    return apply_op("masked_multihead_attention", fn,
+                    (q, k, v, cache_k, cache_v, offset))

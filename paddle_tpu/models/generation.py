@@ -1,0 +1,108 @@
+"""Incremental decoding: KV-cache generation loop shared by the model
+families.
+
+Reference capability: the decode path the reference serves through
+fusion/gpu/masked_multihead_attention.cu + PaddleNLP's generate().
+TPU-native design: fixed-size caches + a scalar offset tensor keep every
+decode step the SAME static-shape program — XLA compiles it once and each
+subsequent token reuses the executable (the analog of the reference's
+persistent decode kernel).  Prefill writes the prompt's K/V in one pass.
+"""
+from __future__ import annotations
+
+from ..core.state import no_grad
+from ..tensor_ops import creation
+from ..tensor_ops import manipulation as MA
+
+
+def init_kv_caches(num_layers, batch, max_len, num_heads, head_dim,
+                   dtype="float32"):
+    """Per-layer {'k','v','offset'} cache dicts ([B, max_len, H, D])."""
+    caches = []
+    offset = creation.zeros([], dtype="int32")
+    for _ in range(num_layers):
+        caches.append({
+            "k": creation.zeros([batch, max_len, num_heads, head_dim],
+                                dtype=dtype),
+            "v": creation.zeros([batch, max_len, num_heads, head_dim],
+                                dtype=dtype),
+            "offset": offset,
+        })
+    return caches
+
+
+def _advance(caches, n):
+    off = caches[0]["offset"] + n
+    for c in caches:
+        c["offset"] = off
+
+
+def _sample(logits_last, temperature, top_k):
+    """[B, V] → [B] next tokens."""
+    from ..tensor_ops import random as R, search as S
+    from ..nn import functional as F
+    if temperature == 0.0:
+        return S.argmax(logits_last, axis=-1)
+    logits_last = logits_last / temperature
+    if top_k is not None:
+        vals, _ = S.topk(logits_last, top_k)
+        minv = vals[:, -1:]
+        logits_last = MA.masked_fill(logits_last, logits_last < minv,
+                                     float("-inf"))
+    probs = F.softmax(logits_last, axis=-1)
+    return MA.reshape(R.multinomial(probs, 1), [-1])
+
+
+def _all_finished(nxt, eos_token_id):
+    if eos_token_id is None:
+        return False
+    import numpy as np
+    return bool(np.all(np.asarray(nxt._data_) == eos_token_id))
+
+
+def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
+             top_k=None, use_cache=True, eos_token_id=None):
+    """Autoregressive decoding.  Returns [B, S + n_generated] token ids.
+
+    use_cache=True runs the masked-MHA KV-cache path (every step is one
+    fixed-shape compiled program); use_cache=False re-runs the full
+    forward per token (the O(S²)-per-step fallback, kept for parity
+    checks).  With eos_token_id, decoding stops early once EVERY
+    sequence in the batch has emitted it."""
+    cfg = model.config
+    b, s = input_ids.shape
+    max_len = min(cfg.max_seq_len, s + max_new_tokens)
+    n_new = max_len - s
+    if n_new <= 0:
+        return input_ids
+
+    with no_grad():
+        if not use_cache:
+            ids = input_ids
+            for _ in range(n_new):
+                logits = model(ids)
+                nxt = _sample(logits[:, -1, :], temperature, top_k)
+                ids = MA.concat([ids, MA.reshape(nxt, [b, 1])], axis=1)
+                if _all_finished(nxt, eos_token_id):
+                    break
+            return ids
+
+        # GQA caches hold num_kv_heads rows; MMHA groups Q heads natively
+        kv_heads = getattr(cfg, "num_kv_heads", cfg.num_heads)
+        caches = init_kv_caches(
+            cfg.num_layers, b, max_len, kv_heads, cfg.head_dim,
+            dtype="float32")
+        logits = model(input_ids, caches=caches)      # prefill
+        _advance(caches, s)
+        pieces = [input_ids]
+        nxt = _sample(logits[:, -1, :], temperature, top_k)
+        for _ in range(n_new - 1):
+            tok = MA.reshape(nxt, [b, 1])
+            pieces.append(tok)
+            if _all_finished(nxt, eos_token_id):
+                return MA.concat(pieces, axis=1)
+            logits = model(tok, caches=caches)
+            _advance(caches, 1)
+            nxt = _sample(logits[:, -1, :], temperature, top_k)
+        pieces.append(MA.reshape(nxt, [b, 1]))
+        return MA.concat(pieces, axis=1)

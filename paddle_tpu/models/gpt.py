@@ -75,15 +75,21 @@ class GPTAttention(Layer):
             0.0, config.initializer_range / math.sqrt(2 * config.num_layers)))
         self.out_proj = Linear(h, h, weight_attr=out_init)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         cfg = self.config
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
         qkv = MA.reshape(qkv, [b, s, 3, cfg.num_heads, cfg.head_dim])
         q, k, v = MA.unbind(qkv, axis=2)
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=cfg.attn_dropout,
-            training=self.training)
+        if cache is not None:
+            # decode path: static-shape attention against the KV cache
+            from ..incubate.nn import functional as IF
+            out, cache["k"], cache["v"] = IF.masked_multihead_attention(
+                q, k, v, cache["k"], cache["v"], cache["offset"])
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=cfg.attn_dropout,
+                training=self.training)
         out = MA.reshape(out, [b, s, h])
         return self.out_proj(out)
 
@@ -111,8 +117,8 @@ class GPTBlock(Layer):
         self.mlp = GPTMLP(config)
         self.dropout = Dropout(config.dropout)
 
-    def forward(self, x):
-        x = x + self.dropout(self.attn(self.ln_1(x)))
+    def forward(self, x, cache=None):
+        x = x + self.dropout(self.attn(self.ln_1(x), cache=cache))
         x = x + self.dropout(self.mlp(self.ln_2(x)))
         return x
 
@@ -132,14 +138,16 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None):
         b, s = input_ids.shape
         if position_ids is None:
             position_ids = creation.arange(s, dtype="int32")
+            if caches is not None:
+                position_ids = position_ids + caches[0]["offset"]
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
-        for block in self.h:
-            x = block(x)
+        for i, block in enumerate(self.h):
+            x = block(x, cache=None if caches is None else caches[i])
         return self.ln_f(x)
 
 
@@ -154,8 +162,9 @@ class GPTForCausalLM(Layer):
             self.lm_head = Linear(config.hidden_size, config.vocab_size,
                                   bias_attr=False)
 
-    def forward(self, input_ids, labels=None, position_ids=None):
-        hidden = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, labels=None, position_ids=None,
+                caches=None):
+        hidden = self.gpt(input_ids, position_ids, caches=caches)
         if self.lm_head is not None:
             logits = self.lm_head(hidden)
         else:
@@ -166,6 +175,14 @@ class GPTForCausalLM(Layer):
                 MA.reshape(labels, [-1]))
             return logits, loss
         return logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=None, use_cache=True, eos_token_id=None):
+        """KV-cache incremental decoding (models/generation.py)."""
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens=max_new_tokens,
+                        temperature=temperature, top_k=top_k,
+                        use_cache=use_cache, eos_token_id=eos_token_id)
 
     def num_params(self, non_embedding=True):
         n = sum(p.size for p in self.parameters())

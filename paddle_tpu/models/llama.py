@@ -95,20 +95,32 @@ class LlamaAttention(Layer):
         self.v_proj = Linear(h, kv, weight_attr=w_init, bias_attr=False)
         self.o_proj = Linear(h, h, weight_attr=out_init, bias_attr=False)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         cfg = self.config
         b, s, h = x.shape
         d = cfg.head_dim
         q = MA.reshape(self.q_proj(x), [b, s, cfg.num_heads, d])
         k = MA.reshape(self.k_proj(x), [b, s, cfg.num_kv_heads, d])
         v = MA.reshape(self.v_proj(x), [b, s, cfg.num_kv_heads, d])
-        q, k, _ = IF.fused_rotary_position_embedding(
-            q, k, rotary_emb_base=cfg.rope_theta)
-        rep = cfg.num_heads // cfg.num_kv_heads
-        k = _repeat_kv(k, rep)
-        v = _repeat_kv(v, rep)
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
-                                             training=self.training)
+        if cache is not None:
+            from ..tensor_ops import creation
+            pos = creation.arange(s, dtype="int32") + cache["offset"]
+            q, k, _ = IF.fused_rotary_position_embedding(
+                q, k, position_ids=pos, rotary_emb_base=cfg.rope_theta)
+        else:
+            q, k, _ = IF.fused_rotary_position_embedding(
+                q, k, rotary_emb_base=cfg.rope_theta)
+        if cache is not None:
+            # cache stores PRE-repeat K/V (num_kv_heads) — the MMHA op
+            # groups Q heads natively, so GQA keeps its memory win
+            out, cache["k"], cache["v"] = IF.masked_multihead_attention(
+                q, k, v, cache["k"], cache["v"], cache["offset"])
+        else:
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = _repeat_kv(k, rep)
+            v = _repeat_kv(v, rep)
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                 training=self.training)
         return self.o_proj(MA.reshape(out, [b, s, h]))
 
 
@@ -141,8 +153,8 @@ class LlamaBlock(Layer):
                                                 epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x):
-        x = x + self.self_attn(self.input_layernorm(x))
+    def forward(self, x, cache=None):
+        x = x + self.self_attn(self.input_layernorm(x), cache=cache)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
@@ -159,10 +171,10 @@ class LlamaModel(Layer):
                                  for _ in range(config.num_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None):
         x = self.embed_tokens(input_ids)
-        for blk in self.layers:
-            x = blk(x)
+        for i, blk in enumerate(self.layers):
+            x = blk(x, cache=None if caches is None else caches[i])
         return self.norm(x)
 
 
@@ -177,8 +189,8 @@ class LlamaForCausalLM(Layer):
             self.lm_head = Linear(config.hidden_size, config.vocab_size,
                                   bias_attr=False)
 
-    def forward(self, input_ids, labels=None):
-        hidden = self.llama(input_ids)
+    def forward(self, input_ids, labels=None, caches=None):
+        hidden = self.llama(input_ids, caches=caches)
         if self.lm_head is not None:
             logits = self.lm_head(hidden)
         else:
@@ -189,6 +201,14 @@ class LlamaForCausalLM(Layer):
                 MA.reshape(labels, [-1]))
             return logits, loss
         return logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=None, use_cache=True, eos_token_id=None):
+        """KV-cache incremental decoding (models/generation.py)."""
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens=max_new_tokens,
+                        temperature=temperature, top_k=top_k,
+                        use_cache=use_cache, eos_token_id=eos_token_id)
 
     def num_params(self):
         return sum(p.size for p in self.parameters())

@@ -15,8 +15,11 @@ import logging
 import os
 import sys
 
-_LOGGER = None
+import threading
+
+_LOGGERS: dict = {}
 _COUNTS: dict[str, int] = {}
+_COUNTS_LOCK = threading.Lock()
 
 
 class _RankFormatter(logging.Formatter):
@@ -33,8 +36,8 @@ class _RankFormatter(logging.Formatter):
 
 
 def get_logger(name="paddle_tpu"):
-    global _LOGGER
-    if _LOGGER is None:
+    logger = _LOGGERS.get(name)
+    if logger is None:
         logger = logging.getLogger(name)
         if not logger.handlers:
             h = logging.StreamHandler(sys.stderr)
@@ -44,8 +47,8 @@ def get_logger(name="paddle_tpu"):
             logger.addHandler(h)
         logger.setLevel(os.environ.get("PADDLE_LOG_LEVEL", "INFO").upper())
         logger.propagate = False
-        _LOGGER = logger
-    return _LOGGER
+        _LOGGERS[name] = logger
+    return logger
 
 
 def set_log_level(level):
@@ -56,8 +59,9 @@ def set_log_level(level):
 def log_every_n(level, msg, n=100, *args):
     """Emit every n-th occurrence of this message site (glog idiom)."""
     key = f"{level}:{msg}"
-    c = _COUNTS.get(key, 0)
-    _COUNTS[key] = c + 1
+    with _COUNTS_LOCK:
+        c = _COUNTS.get(key, 0)
+        _COUNTS[key] = c + 1
     if c % n == 0:
         get_logger().log(getattr(logging, level.upper(), logging.INFO),
                          msg, *args)

@@ -1,0 +1,143 @@
+"""KV-cache incremental decoding (reference:
+fusion/gpu/masked_multihead_attention.cu + PaddleNLP generate)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import functional as IF
+from paddle_tpu.models import (
+    GPTForCausalLM, gpt_config, LlamaForCausalLM, llama_config,
+)
+
+
+def _np(t):
+    return np.asarray(t._data_)
+
+
+def _tiny_gpt():
+    return GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=2, hidden_size=128, num_heads=4,
+        vocab_size=512, max_seq_len=128))
+
+
+def test_masked_mha_matches_full_attention():
+    rng = np.random.default_rng(0)
+    b, s_max, h, d = 2, 16, 2, 8
+    q = paddle.to_tensor(rng.standard_normal((b, 6, h, d)).astype("f4"))
+    k = paddle.to_tensor(rng.standard_normal((b, 6, h, d)).astype("f4"))
+    v = paddle.to_tensor(rng.standard_normal((b, 6, h, d)).astype("f4"))
+    ck = paddle.to_tensor(np.zeros((b, s_max, h, d), np.float32))
+    cv = paddle.to_tensor(np.zeros((b, s_max, h, d), np.float32))
+    off = paddle.to_tensor(np.int32(0))
+    out, ck, cv = IF.masked_multihead_attention(q, k, v, ck, cv, off)
+    # reference: plain causal attention over the 6 tokens
+    from paddle_tpu.pallas.flash_attention import _xla_attention
+    import jax.numpy as jnp
+    ref = _xla_attention(jnp.asarray(_np(q)), jnp.asarray(_np(k)),
+                         jnp.asarray(_np(v)), causal=True)
+    np.testing.assert_allclose(_np(out), np.asarray(ref), atol=1e-5)
+    # cache holds the written K/V
+    np.testing.assert_allclose(_np(ck)[:, :6], _np(k), atol=0)
+    np.testing.assert_allclose(_np(cv)[:, 6:], 0.0, atol=0)
+
+
+def test_masked_mha_single_step_appends():
+    rng = np.random.default_rng(1)
+    b, s_max, h, d = 1, 8, 2, 4
+    ck = paddle.to_tensor(rng.standard_normal((b, s_max, h, d))
+                          .astype("f4"))
+    cv = paddle.to_tensor(rng.standard_normal((b, s_max, h, d))
+                          .astype("f4"))
+    q = paddle.to_tensor(rng.standard_normal((b, 1, h, d)).astype("f4"))
+    k = paddle.to_tensor(rng.standard_normal((b, 1, h, d)).astype("f4"))
+    v = paddle.to_tensor(rng.standard_normal((b, 1, h, d)).astype("f4"))
+    off = paddle.to_tensor(np.int32(3))
+    out, ck2, cv2 = IF.masked_multihead_attention(q, k, v, ck, cv, off)
+    # position 3 overwritten, positions 0-2 and 4+ untouched
+    np.testing.assert_allclose(_np(ck2)[:, 3], _np(k)[:, 0], atol=0)
+    np.testing.assert_allclose(_np(ck2)[:, :3], _np(ck)[:, :3], atol=0)
+    np.testing.assert_allclose(_np(ck2)[:, 4:], _np(ck)[:, 4:], atol=0)
+    # attention only saw positions 0..3
+    kk = np.concatenate([_np(ck)[:, :3], _np(k)], axis=1)
+    vv = np.concatenate([_np(cv)[:, :3], _np(v)], axis=1)
+    logits = np.einsum("bqhd,bkhd->bhqk", _np(q), kk) / np.sqrt(d)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", probs, vv)
+    np.testing.assert_allclose(_np(out), ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_cached_generation_matches_full_forward(family):
+    paddle.seed(0)
+    model = _tiny_gpt() if family == "gpt" else \
+        LlamaForCausalLM(llama_config("tiny"))
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, 512, (2, 16)).astype("int32"))
+    cached = model.generate(ids, max_new_tokens=8, use_cache=True)
+    full = model.generate(ids, max_new_tokens=8, use_cache=False)
+    np.testing.assert_array_equal(_np(cached), _np(full))
+    assert _np(cached).shape == (2, 24)
+    # prompt preserved
+    np.testing.assert_array_equal(_np(cached)[:, :16], _np(ids))
+
+
+def test_generation_respects_max_seq_len():
+    paddle.seed(1)
+    model = LlamaForCausalLM(llama_config("tiny", max_seq_len=20))
+    ids = paddle.to_tensor(
+        np.random.default_rng(2).integers(0, 512, (1, 16)).astype("int32"))
+    out = model.generate(ids, max_new_tokens=100, use_cache=True)
+    assert _np(out).shape[1] == 20   # clamped to max_seq_len
+
+
+def test_sampled_generation_runs():
+    paddle.seed(2)
+    model = _tiny_gpt()
+    ids = paddle.to_tensor(
+        np.random.default_rng(3).integers(0, 512, (2, 8)).astype("int32"))
+    out = model.generate(ids, max_new_tokens=4, temperature=0.8, top_k=20)
+    assert _np(out).shape == (2, 12)
+    assert (_np(out)[:, 8:] >= 0).all() and (_np(out)[:, 8:] < 512).all()
+
+
+def test_gqa_cache_holds_kv_heads_only():
+    """GQA caches must store num_kv_heads rows, not the repeated heads."""
+    paddle.seed(3)
+    cfg = llama_config("tiny")          # 4 heads, 2 kv heads
+    model = LlamaForCausalLM(cfg)
+    from paddle_tpu.models.generation import init_kv_caches
+    caches = init_kv_caches(cfg.num_layers, 1, 32, cfg.num_kv_heads,
+                            cfg.head_dim)
+    assert _np(caches[0]["k"]).shape == (1, 32, 2, 32)
+    ids = paddle.to_tensor(
+        np.random.default_rng(4).integers(0, 512, (1, 8)).astype("int32"))
+    cached = model.generate(ids, max_new_tokens=6, use_cache=True)
+    full = model.generate(ids, max_new_tokens=6, use_cache=False)
+    np.testing.assert_array_equal(_np(cached), _np(full))
+
+
+def test_eos_early_stop():
+    paddle.seed(4)
+    model = _tiny_gpt()
+    ids = paddle.to_tensor(
+        np.random.default_rng(5).integers(0, 512, (1, 8)).astype("int32"))
+    # force a deterministic eos: whatever greedy emits first becomes "eos"
+    probe = model.generate(ids, max_new_tokens=1, use_cache=True)
+    eos = int(_np(probe)[0, -1])
+    out = model.generate(ids, max_new_tokens=50, use_cache=True,
+                         eos_token_id=eos)
+    # stopped right after the first emission of eos
+    assert _np(out).shape[1] < 8 + 50
+    assert int(_np(out)[0, 8]) == eos
+
+
+def test_cache_overflow_raises():
+    from paddle_tpu.incubate.nn import functional as IF
+    b, h, d = 1, 2, 4
+    ck = paddle.to_tensor(np.zeros((b, 4, h, d), np.float32))
+    cv = paddle.to_tensor(np.zeros((b, 4, h, d), np.float32))
+    q = paddle.to_tensor(np.zeros((b, 3, h, d), np.float32))
+    with pytest.raises(ValueError, match="overflow"):
+        IF.masked_multihead_attention(q, q, q, ck, cv,
+                                      paddle.to_tensor(np.int32(2)))
