@@ -152,3 +152,5 @@ class GradScaler:
         self._scale = state["scale"]
         self._good_steps = state["good_steps"]
         self._bad_steps = state["bad_steps"]
+
+from . import debugging  # noqa: F401

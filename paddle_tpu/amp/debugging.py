@@ -1,0 +1,98 @@
+"""AMP debugging tools.
+
+Reference capability: python/paddle/amp/debugging.py
+(collect_operator_stats — per-op dtype/NaN counters under a context) and
+amp/accuracy_compare.py (compare two runs' per-op statistics to localize
+where low-precision diverges).
+
+TPU-native realization: hooks the dispatch funnel's FLOPs-counter seam —
+an `OperatorStatsCollector` context records, per op name and dtype, call
+counts and NaN/Inf occurrence; `compare_accuracy` diffs two stat dumps
+and ranks ops by divergence, the workflow used to debug bf16 O2 runs.
+"""
+from __future__ import annotations
+
+import json
+
+import jax
+
+from ..core import state as _state
+
+
+class OperatorStatsCollector:
+    """Context manager: per-op call counts + output NaN/Inf occurrence
+    (reference: debugging.collect_operator_stats)."""
+
+    def __init__(self):
+        self.stats = {}
+
+    def _record(self, name, outs):
+        for o in outs:
+            if not hasattr(o, "dtype"):
+                continue
+            key = (name, str(o.dtype))
+            ent = self.stats.setdefault(
+                key, {"calls": 0, "nan": 0, "inf": 0})
+            ent["calls"] += 1
+            if isinstance(o, jax.core.Tracer):
+                continue
+            if jax.numpy.issubdtype(o.dtype, jax.numpy.floating):
+                ent["nan"] += int(jax.numpy.isnan(o).sum())
+                ent["inf"] += int(jax.numpy.isinf(o).sum())
+
+    def __enter__(self):
+        self._prev = getattr(_state.STATE, "op_stats_collector", None)
+        _state.STATE.op_stats_collector = self
+        return self
+
+    def __exit__(self, *exc):
+        _state.STATE.op_stats_collector = self._prev
+        return False
+
+    def summary(self):
+        rows = []
+        for (name, dtype), ent in sorted(self.stats.items()):
+            rows.append({"op": name, "dtype": dtype, **ent})
+        return rows
+
+    def print_summary(self):
+        print(f"{'op':30s} {'dtype':10s} {'calls':>8s} {'nan':>8s} "
+              f"{'inf':>8s}")
+        for r in self.summary():
+            print(f"{r['op']:30s} {r['dtype']:10s} {r['calls']:8d} "
+                  f"{r['nan']:8d} {r['inf']:8d}")
+
+    def dump(self, path):
+        with open(path, "w") as f:
+            json.dump(self.summary(), f, indent=1)
+
+
+def collect_operator_stats():
+    """reference: amp/debugging.py collect_operator_stats."""
+    return OperatorStatsCollector()
+
+
+def compare_accuracy(dump_path_a, dump_path_b, output_path=None,
+                     atol=0):
+    """Diff two stat dumps (e.g. fp32 vs bf16 runs): ops whose NaN/Inf
+    counts differ, ranked worst-first (reference: accuracy_compare.py)."""
+    with open(dump_path_a) as f:
+        a = {(r["op"], r["dtype"]): r for r in json.load(f)}
+    with open(dump_path_b) as f:
+        b = {(r["op"], r["dtype"]): r for r in json.load(f)}
+    diffs = []
+    for key in sorted(set(a) | set(b), key=str):
+        ra = a.get(key, {"calls": 0, "nan": 0, "inf": 0})
+        rb = b.get(key, {"calls": 0, "nan": 0, "inf": 0})
+        d_nan = abs(ra["nan"] - rb["nan"])
+        d_inf = abs(ra["inf"] - rb["inf"])
+        if d_nan + d_inf > atol:
+            diffs.append({"op": key[0], "dtype": key[1],
+                          "nan_a": ra["nan"], "nan_b": rb["nan"],
+                          "inf_a": ra["inf"], "inf_b": rb["inf"],
+                          "delta": d_nan + d_inf})
+    diffs.sort(key=lambda r: -r["delta"])
+    if output_path:
+        with open(output_path, "w") as f:
+            json.dump(diffs, f, indent=1)
+    return diffs

@@ -1,0 +1,191 @@
+"""Audio feature extraction (reference: python/paddle/audio/ —
+features/layers.py Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC,
+functional/functional.py hz_to_mel/mel_to_hz/compute_fbank_matrix/
+create_dct, functional/window.py get_window).
+
+TPU-native realization: features are Layers whose forward is one traced
+chain — frame → (Pallas-friendly) matmul-as-DFT via signal.stft → mel
+filterbank matmul → log/DCT — so the whole front-end fuses into the
+model's first program.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..nn import Layer
+from ..core.tensor import Tensor
+from .. import signal as _signal
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "compute_fbank_matrix",
+    "create_dct", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
+    "MFCC",
+]
+
+
+def hz_to_mel(freq, htk=False):
+    """reference: audio/functional/functional.py hz_to_mel."""
+    freq = np.asarray(freq, np.float64)
+    if htk:
+        return 2595.0 * np.log10(1.0 + freq / 700.0)
+    # slaney scale
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if mels.ndim:
+        log_t = freq >= min_log_hz
+        mels[log_t] = min_log_mel + \
+            np.log(freq[log_t] / min_log_hz) / logstep
+    elif freq >= min_log_hz:
+        mels = min_log_mel + math.log(freq / min_log_hz) / logstep
+    return mels
+
+
+def mel_to_hz(mel, htk=False):
+    mel = np.asarray(mel, np.float64)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if freqs.ndim:
+        log_t = mel >= min_log_mel
+        freqs[log_t] = min_log_hz * \
+            np.exp(logstep * (mel[log_t] - min_log_mel))
+    elif mel >= min_log_mel:
+        freqs = min_log_hz * math.exp(logstep * (mel - min_log_mel))
+    return freqs
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                       n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """[n_mels, n_fft//2+1] triangular mel filterbank (reference:
+    functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    fft_freqs = np.linspace(0, sr / 2.0, n_fft // 2 + 1)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_freqs[None, :]
+    weights = np.zeros((n_mels, len(fft_freqs)), np.float32)
+    for i in range(n_mels):
+        lower = -ramps[i] / fdiff[i]
+        upper = ramps[i + 2] / fdiff[i + 1]
+        weights[i] = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None].astype(np.float32)
+    return weights
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """[n_mels, n_mfcc] DCT-II basis (reference: functional.py
+    create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return dct.astype(np.float32)
+
+
+class Spectrogram(Layer):
+    """|STFT|^power (reference: audio/features/layers.py Spectrogram)."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        if window == "hann":
+            w = np.hanning(self.win_length + 1)[:-1]
+        elif window == "hamming":
+            w = np.hamming(self.win_length + 1)[:-1]
+        elif window in (None, "rect", "boxcar"):
+            w = np.ones(self.win_length)
+        else:
+            raise ValueError(f"unknown window {window!r}")
+        self.register_buffer("window",
+                             Tensor(jnp.asarray(w.astype(np.float32))))
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, hop_length=self.hop_length,
+                            win_length=self.win_length, window=self.window,
+                            center=self.center, pad_mode=self.pad_mode)
+        from ..tensor_ops import math as MM
+        mag = MM.abs(spec)
+        return mag ** self.power if self.power != 1.0 else mag
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, n_mels=64,
+                 f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 **kwargs):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power)
+        fb = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk,
+                                  norm)
+        self.register_buffer("fbank", Tensor(jnp.asarray(fb)))
+
+    def forward(self, x):
+        from ..tensor_ops import linalg as LA
+        spec = self.spectrogram(x)       # [..., freq, time]
+        return LA.matmul(self.fbank, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, ref_value=1.0, amin=1e-10, top_db=None,
+                 **mel_kwargs):
+        super().__init__()
+        self.mel = MelSpectrogram(sr=sr, **mel_kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        from ..tensor_ops import math as MM
+        m = self.mel(x)
+        log_spec = 10.0 * MM.log10(MM.clip(m, min=self.amin))
+        log_spec = log_spec - 10.0 * math.log10(
+            max(self.amin, self.ref_value))
+        if self.top_db is not None:
+            peak = float(np.asarray(log_spec.max()._data_))
+            log_spec = MM.clip(log_spec, min=peak - self.top_db)
+        return log_spec
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_mels=64, **mel_kwargs):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, n_mels=n_mels,
+                                         **mel_kwargs)
+        self.register_buffer("dct",
+                             Tensor(jnp.asarray(create_dct(n_mfcc,
+                                                           n_mels))))
+
+    def forward(self, x):
+        from ..tensor_ops import linalg as LA
+        lm = self.log_mel(x)             # [..., n_mels, time]
+        return LA.matmul(LA.transpose(self.dct, [1, 0]), lm)

@@ -124,6 +124,9 @@ def apply_op(name, fn, args, static=None, nondiff=False):
         fc.add(name,
                tuple(tuple(getattr(a, "shape", ())) for a in arrays),
                static)
+    osc = getattr(_state.STATE, "op_stats_collector", None)
+    if osc is not None:   # amp.debugging collect_operator_stats context
+        osc._record(name, outs)
 
     # NaN/Inf scanning of every op output when FLAGS_check_nan_inf is set
     # (reference: eager nan_inf_utils.h:38 + FLAGS_check_nan_inf,

@@ -1,3 +1,4 @@
 """Incubating APIs (reference capability: python/paddle/incubate/)."""
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+from . import asp  # noqa: F401

@@ -1,0 +1,101 @@
+"""ASP: automatic structured (n:m) sparsity.
+
+Reference capability: python/paddle/incubate/asp/ — 2:4 semi-structured
+sparsity workflow (`prune_model` computes per-block magnitude masks,
+`decorate` wraps the optimizer so masks are re-applied after every step,
+`calculate_density` reports achieved sparsity; the reference targets
+Ampere sparse tensor cores).
+
+TPU-native realization: the MXU has no 2:4 hardware mode, so the value is
+model compression + the pruned-training workflow: masks are plain
+framework tensors multiplied into weights, XLA folds the masking into the
+surrounding program, and the mask-reapply step after `optimizer.step`
+keeps training on the sparse support (the reference's ASPHelper flow).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+_MASKS: dict[int, tuple] = {}     # id(param) -> (param, mask ndarray)
+
+
+def calculate_density(x):
+    arr = np.asarray(x._data_ if isinstance(x, Tensor) else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def compute_nm_mask(weight, n=2, m=4):
+    """Keep the n largest-|magnitude| entries of every m-block along the
+    LAST axis (reference: asp/utils.py get_mask_2d_best / 1d)."""
+    arr = np.asarray(weight._data_ if isinstance(weight, Tensor)
+                     else weight)
+    if arr.shape[-1] % m != 0:
+        raise ValueError(f"last dim {arr.shape[-1]} not divisible by {m}")
+    blocks = np.abs(arr).reshape(-1, m)
+    order = np.argsort(blocks, axis=-1)          # ascending
+    mask = np.ones_like(blocks, dtype=arr.dtype)
+    drop = order[:, :m - n]
+    np.put_along_axis(mask, drop, 0.0, axis=-1)
+    return mask.reshape(arr.shape)
+
+
+def _supported(layer, name, param):
+    # prune matmul-facing 2-D weights only (the reference's supported set)
+    return name.endswith("weight") and param._data_.ndim == 2
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every supported weight; returns {name: mask}.
+    reference: asp/asp.py prune_model."""
+    masks = {}
+    for name, param in model.named_parameters():
+        if not _supported(model, name, param):
+            continue
+        mask = compute_nm_mask(param, n=n, m=m)
+        param.set_value(np.asarray(param._data_) * mask)
+        if with_mask:
+            _MASKS[id(param)] = (param, mask)
+        masks[name] = mask
+    return masks
+
+
+def reset_excluded_layers(model=None):
+    """Drop recorded masks — for `model`'s params only when given."""
+    if model is None:
+        _MASKS.clear()
+        return
+    for _, param in model.named_parameters():
+        _MASKS.pop(id(param), None)
+
+
+class ASPOptimizer:
+    """Optimizer wrapper re-applying masks after each step
+    (reference: asp/asp.py OptimizerWithSparsityGuarantee).
+
+    Owns the (param, mask) pairs for ITS OWN parameter list only — other
+    models' masks are untouched, and dropping the optimizer releases the
+    references."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        mine = {id(p) for p in optimizer._parameter_list}
+        self._masks = [(param, mask) for pid, (param, mask)
+                       in _MASKS.items() if pid in mine]
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def step(self):
+        self._inner.step()
+        for param, mask in self._masks:
+            param.set_value(np.asarray(param._data_) * mask)
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+
+def decorate(optimizer):
+    """reference: asp/asp.py decorate."""
+    return ASPOptimizer(optimizer)

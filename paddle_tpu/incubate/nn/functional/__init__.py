@@ -46,6 +46,33 @@ def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
     return out
 
 
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None,
+                   smooth=None, act_method="gelu", quant_scale=-1,
+                   **kwargs):
+    """reference: incubate/nn/functional/fused_bias_act (kernel:
+    fusion/gpu/fused_bias_act_kernel.cu).  bias-add + activation
+    (gelu/relu/silu/geglu/swiglu) — XLA fuses the epilogue chain into the
+    producing matmul, so this is the API surface over that fusion.  The
+    reference's int8 dequant/quant path is not implemented — passing those
+    args raises instead of silently returning un-dequantized values."""
+    if dequant_scales is not None or shift is not None or \
+            smooth is not None or quant_scale != -1:
+        raise NotImplementedError(
+            "fused_bias_act quant path (dequant_scales/shift/smooth/"
+            "quant_scale) is not implemented; use the quantization "
+            "package for QAT/PTQ")
+    def fn(xv, bv):
+        y = xv if bv is None else xv + bv
+        if act_method in ("geglu", "swiglu"):
+            a, b = jnp.split(y, 2, axis=-1)
+            act = jax.nn.gelu if act_method == "geglu" else jax.nn.silu
+            return act(a) * b
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+               "silu": jax.nn.silu, "swish": jax.nn.silu}[act_method]
+        return act(y)
+    return apply_op("fused_bias_act", fn, (x, bias))
+
+
 def _rope_rotate_half(x):
     x1, x2 = jnp.split(x, 2, axis=-1)
     return jnp.concatenate([-x2, x1], axis=-1)

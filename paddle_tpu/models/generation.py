@@ -53,11 +53,23 @@ def _sample(logits_last, temperature, top_k):
     return MA.reshape(R.multinomial(probs, 1), [-1])
 
 
-def _all_finished(nxt, eos_token_id):
-    if eos_token_id is None:
-        return False
-    import numpy as np
-    return bool(np.all(np.asarray(nxt._data_) == eos_token_id))
+class _EosTracker:
+    """Per-sequence finished flags accumulated ACROSS steps: sequence i is
+    done once it has emitted eos at ANY step, not only when the whole
+    batch emits it simultaneously."""
+
+    def __init__(self, batch, eos_token_id):
+        import numpy as np
+        self.eos = eos_token_id
+        self.done = np.zeros(batch, bool) if eos_token_id is not None \
+            else None
+
+    def update(self, nxt):
+        if self.done is None:
+            return False
+        import numpy as np
+        self.done |= np.asarray(nxt._data_) == self.eos
+        return bool(self.done.all())
 
 
 def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
@@ -78,12 +90,13 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
 
     with no_grad():
         if not use_cache:
+            tracker = _EosTracker(b, eos_token_id)
             ids = input_ids
             for _ in range(n_new):
                 logits = model(ids)
                 nxt = _sample(logits[:, -1, :], temperature, top_k)
                 ids = MA.concat([ids, MA.reshape(nxt, [b, 1])], axis=1)
-                if _all_finished(nxt, eos_token_id):
+                if tracker.update(nxt):
                     break
             return ids
 
@@ -92,6 +105,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
         caches = init_kv_caches(
             cfg.num_layers, b, max_len, kv_heads, cfg.head_dim,
             dtype="float32")
+        tracker = _EosTracker(b, eos_token_id)
         logits = model(input_ids, caches=caches)      # prefill
         _advance(caches, s)
         pieces = [input_ids]
@@ -99,7 +113,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
         for _ in range(n_new - 1):
             tok = MA.reshape(nxt, [b, 1])
             pieces.append(tok)
-            if _all_finished(nxt, eos_token_id):
+            if tracker.update(nxt):
                 return MA.concat(pieces, axis=1)
             logits = model(tok, caches=caches)
             _advance(caches, 1)

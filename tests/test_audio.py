@@ -1,0 +1,68 @@
+"""Audio feature family (reference: python/paddle/audio/ features +
+functional)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+
+
+SR, N_FFT = 16000, 512
+
+
+def _tone(freq, sr=SR, secs=1.0):
+    t = np.arange(int(sr * secs), dtype=np.float32) / sr
+    return paddle.to_tensor(np.sin(2 * np.pi * freq * t)[None])
+
+
+def test_spectrogram_tone_peak():
+    spec = audio.Spectrogram(n_fft=N_FFT)(_tone(1000.0))
+    sn = np.asarray(spec._data_)[0]
+    assert sn.shape[0] == N_FFT // 2 + 1
+    peak = int(sn.mean(-1).argmax())
+    assert abs(peak - round(1000.0 * N_FFT / SR)) <= 1
+
+
+def test_hz_mel_roundtrip():
+    f = np.array([55., 440., 1000., 4000., 8000.])
+    for htk in (False, True):
+        np.testing.assert_allclose(
+            audio.mel_to_hz(audio.hz_to_mel(f, htk=htk), htk=htk), f,
+            rtol=1e-6)
+
+
+def test_fbank_matrix_properties():
+    fb = audio.compute_fbank_matrix(SR, N_FFT, n_mels=40)
+    assert fb.shape == (40, N_FFT // 2 + 1)
+    assert (fb >= 0).all() and np.isfinite(fb).all()
+    assert (fb.sum(axis=1) > 0).all()     # every filter covers some bins
+
+
+def test_dct_orthonormal():
+    d = audio.create_dct(13, 40, norm="ortho")
+    gram = d.T @ d
+    np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+
+def test_mel_logmel_mfcc_shapes_and_grad():
+    x = _tone(440.0, secs=0.5)
+    mel = audio.MelSpectrogram(sr=SR, n_fft=N_FFT, n_mels=40)(x)
+    assert tuple(mel.shape)[1] == 40
+    lm = audio.LogMelSpectrogram(sr=SR, n_fft=N_FFT, n_mels=40)(x)
+    assert np.isfinite(np.asarray(lm._data_)).all()
+    mfcc_layer = audio.MFCC(sr=SR, n_mfcc=13, n_mels=40, n_fft=N_FFT)
+    mf = mfcc_layer(x)
+    assert tuple(mf.shape)[1] == 13
+    # the front-end is differentiable (trainable feature extraction)
+    x2 = _tone(440.0, secs=0.25)
+    x2.stop_gradient = False
+    audio.MelSpectrogram(sr=SR, n_fft=N_FFT, n_mels=40)(x2).sum().backward()
+    assert x2.grad is not None
+
+
+def test_loud_tone_louder_mel():
+    quiet = audio.MelSpectrogram(sr=SR, n_fft=N_FFT)(_tone(500.0))
+    loud = audio.MelSpectrogram(sr=SR, n_fft=N_FFT)(
+        paddle.to_tensor(np.asarray(_tone(500.0)._data_) * 10))
+    assert float(np.asarray(loud._data_).sum()) > \
+        50 * float(np.asarray(quiet._data_).sum())

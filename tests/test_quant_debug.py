@@ -76,3 +76,77 @@ def test_check_nan_inf_warn_level():
     finally:
         paddle.set_flags({"FLAGS_check_nan_inf": False,
                           "FLAGS_check_nan_inf_level": 0})
+
+
+def test_asp_nm_sparsity_workflow():
+    """2:4 pruning + mask-preserving training (reference: incubate/asp)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.incubate import asp
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+    masks = asp.prune_model(model, n=2, m=4)
+    assert masks, "no weights pruned"
+    for name, mask in masks.items():
+        blocks = mask.reshape(-1, 4)
+        np.testing.assert_array_equal(blocks.sum(-1),
+                                      2 * np.ones(len(blocks)))
+    w0 = [p for n_, p in model.named_parameters()
+          if n_.endswith("weight")][0]
+    assert abs(asp.calculate_density(w0) - 0.5) < 0.05
+
+    opt = asp.decorate(paddle.optimizer.AdamW(
+        1e-2, parameters=model.parameters()))
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((8, 16)).astype("float32"))
+    y = paddle.to_tensor(np.random.default_rng(1).integers(0, 4, (8,))
+                         .astype("int64"))
+    for _ in range(3):
+        loss = paddle.nn.functional.cross_entropy(model(x), y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity survives optimizer updates
+    assert abs(asp.calculate_density(w0) - 0.5) < 0.05
+    asp.reset_excluded_layers()
+
+
+def test_amp_operator_stats_and_compare(tmp_path):
+    """reference: amp/debugging.py collect_operator_stats +
+    accuracy_compare.py."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.amp import debugging as dbg
+
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    with dbg.collect_operator_stats() as ca:
+        paddle.nn.functional.gelu(x)
+    with dbg.collect_operator_stats() as cb:
+        y = paddle.nn.functional.gelu(x)
+        y / paddle.to_tensor(np.zeros(4, np.float32))   # infs
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    ca.dump(pa)
+    cb.dump(pb)
+    diffs = dbg.compare_accuracy(pa, pb)
+    assert diffs and diffs[0]["delta"] > 0
+    assert dbg.compare_accuracy(pa, pa) == []
+
+
+def test_fused_bias_act_variants():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((3, 8)).astype("float32"))
+    b = paddle.to_tensor(np.ones(8, np.float32))
+    for act in ("gelu", "relu", "silu"):
+        out = IF.fused_bias_act(x, b, act_method=act)
+        assert tuple(out.shape) == (3, 8)
+    glu = IF.fused_bias_act(x, None, act_method="swiglu")
+    assert tuple(glu.shape) == (3, 4)
+    x.stop_gradient = False
+    IF.fused_bias_act(x, b, act_method="gelu").sum().backward()
+    assert x.grad is not None
