@@ -27,13 +27,16 @@ class OperatorStatsCollector:
         self.stats = {}
 
     def _record(self, name, outs):
+        seen_dtypes = set()
         for o in outs:
             if not hasattr(o, "dtype"):
                 continue
             key = (name, str(o.dtype))
             ent = self.stats.setdefault(
                 key, {"calls": 0, "nan": 0, "inf": 0})
-            ent["calls"] += 1
+            if key not in seen_dtypes:   # one call per op INVOCATION
+                ent["calls"] += 1
+                seen_dtypes.add(key)
             if isinstance(o, jax.core.Tracer):
                 continue
             if jax.numpy.issubdtype(o.dtype, jax.numpy.floating):

@@ -141,10 +141,11 @@ class MelSpectrogram(Layer):
     def __init__(self, sr=22050, n_fft=512, hop_length=None,
                  win_length=None, window="hann", power=2.0, n_mels=64,
                  f_min=50.0, f_max=None, htk=False, norm="slaney",
-                 **kwargs):
+                 center=True, pad_mode="reflect", **kwargs):
         super().__init__()
         self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
-                                       window, power)
+                                       window, power, center=center,
+                                       pad_mode=pad_mode)
         fb = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk,
                                   norm)
         self.register_buffer("fbank", Tensor(jnp.asarray(fb)))
@@ -171,8 +172,9 @@ class LogMelSpectrogram(Layer):
         log_spec = log_spec - 10.0 * math.log10(
             max(self.amin, self.ref_value))
         if self.top_db is not None:
-            peak = float(np.asarray(log_spec.max()._data_))
-            log_spec = MM.clip(log_spec, min=peak - self.top_db)
+            # keep the peak traced — a host float() would break under jit
+            peak = log_spec.max()
+            log_spec = MM.maximum(log_spec, peak - self.top_db)
         return log_spec
 
 

@@ -18,8 +18,6 @@ import numpy as np
 
 from ...core.tensor import Tensor
 
-_MASKS: dict[int, tuple] = {}     # id(param) -> (param, mask ndarray)
-
 
 def calculate_density(x):
     arr = np.asarray(x._data_ if isinstance(x, Tensor) else x)
@@ -41,9 +39,11 @@ def compute_nm_mask(weight, n=2, m=4):
     return mask.reshape(arr.shape)
 
 
-def _supported(layer, name, param):
-    # prune matmul-facing 2-D weights only (the reference's supported set)
-    return name.endswith("weight") and param._data_.ndim == 2
+def _supported(name, param, m):
+    # prune matmul-facing 2-D weights whose last dim tiles into m-blocks
+    # (the reference's supported-layer set + shape check)
+    return (name.endswith("weight") and param._data_.ndim == 2
+            and param._data_.shape[-1] % m == 0)
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
@@ -51,46 +51,45 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     reference: asp/asp.py prune_model."""
     masks = {}
     for name, param in model.named_parameters():
-        if not _supported(model, name, param):
+        if not _supported(name, param, m):
             continue
         mask = compute_nm_mask(param, n=n, m=m)
         param.set_value(np.asarray(param._data_) * mask)
         if with_mask:
-            _MASKS[id(param)] = (param, mask)
+            # the mask lives ON the param: no global registry, no leaked
+            # references once the model is dropped
+            param._asp_mask = mask
         masks[name] = mask
     return masks
 
 
-def reset_excluded_layers(model=None):
-    """Drop recorded masks — for `model`'s params only when given."""
-    if model is None:
-        _MASKS.clear()
-        return
+def reset_excluded_layers(model):
+    """Drop `model`'s recorded masks (dense training resumes)."""
     for _, param in model.named_parameters():
-        _MASKS.pop(id(param), None)
+        if hasattr(param, "_asp_mask"):
+            del param._asp_mask
 
 
 class ASPOptimizer:
     """Optimizer wrapper re-applying masks after each step
     (reference: asp/asp.py OptimizerWithSparsityGuarantee).
 
-    Owns the (param, mask) pairs for ITS OWN parameter list only — other
-    models' masks are untouched, and dropping the optimizer releases the
-    references."""
+    Reads masks LAZILY from its own parameter list each step, so
+    decorate-before-prune (the reference's documented order) works, and
+    only this optimizer's params are touched."""
 
     def __init__(self, optimizer):
         self._inner = optimizer
-        mine = {id(p) for p in optimizer._parameter_list}
-        self._masks = [(param, mask) for pid, (param, mask)
-                       in _MASKS.items() if pid in mine]
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
 
     def step(self):
         self._inner.step()
-        for param, mask in self._masks:
-            param.set_value(np.asarray(param._data_) * mask)
+        for param in self._inner._parameter_list:
+            mask = getattr(param, "_asp_mask", None)
+            if mask is not None:
+                param.set_value(np.asarray(param._data_) * mask)
 
     def clear_grad(self):
         self._inner.clear_grad()

@@ -1,0 +1,179 @@
+"""Detection ops (reference: python/paddle/vision/ops.py — nms, roi_align,
+roi_pool, yolo_box, deform_conv2d over phi/kernels/gpu/{nms,roi_align,
+roi_pool}_kernel.cu).
+
+TPU-native realization: roi_align/roi_pool are pure-jnp bilinear-sample /
+max-pool gathers with static output shapes, so they trace into the
+detection model's program; nms is host-side (its output size is
+data-dependent — the reference's GPU kernel also serializes through a
+sort + suppression loop).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "box_iou", "roi_align", "roi_pool"]
+
+
+def _arr(x):
+    return x._data_ if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_iou(boxes1, boxes2):
+    """[N,4] x [M,4] → [N,M] IoU (xyxy)."""
+    def fn(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter + 1e-10)
+    return apply_op("box_iou", fn, (boxes1, boxes2))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS (reference: vision/ops.py nms).  Host-side: keeps the
+    reference semantics — suppression happens within a category, and when
+    `categories` is given only boxes of the listed categories are
+    considered at all; returns kept indices sorted by descending score."""
+    b = np.asarray(jax.device_get(_arr(boxes)))
+    n = b.shape[0]
+    sc = (np.asarray(jax.device_get(_arr(scores)))
+          if scores is not None else np.arange(n, 0, -1, dtype=np.float32))
+    cats = (np.asarray(jax.device_get(_arr(category_idxs)))
+            if category_idxs is not None else np.zeros(n, np.int64))
+
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    order = np.argsort(-sc, kind="stable")
+    if categories is not None:
+        listed = np.isin(cats, np.asarray(list(categories)))
+        order = order[listed[order]]
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for idx in order:
+        if suppressed[idx]:
+            continue
+        keep.append(idx)
+        rest = order[~suppressed[order]]
+        rest = rest[rest != idx]
+        if len(rest) == 0:
+            continue
+        same_cat = cats[rest] == cats[idx]
+        cand = rest[same_cat]
+        if len(cand) == 0:
+            continue
+        lt = np.maximum(b[cand, :2], b[idx, :2])
+        rb = np.minimum(b[cand, 2:], b[idx, 2:])
+        wh = np.clip(rb - lt, 0, None)
+        inter = wh[:, 0] * wh[:, 1]
+        iou = inter / (area[cand] + area[idx] - inter + 1e-10)
+        suppressed[cand[iou > iou_threshold]] = True
+    keep = np.array(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def _bilinear(feat, y, x):
+    """feat [C,H,W]; y/x arbitrary same-shape index grids → [C, *grid]."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly = jnp.clip(y - y0, 0.0, 1.0)
+    lx = jnp.clip(x - x0, 0.0, 1.0)
+    y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+    x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+            + v10 * ly * (1 - lx) + v11 * ly * lx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Bilinear ROI align (reference: vision/ops.py roi_align over
+    roi_align_kernel.cu).  x: [N,C,H,W]; boxes: [R,4] xyxy in input
+    coords; boxes_num: [N] rois per image.  Returns [R, C, oh, ow]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    ratio = 2 if sampling_ratio <= 0 else sampling_ratio
+    bn = np.asarray(jax.device_get(_arr(boxes_num)))
+    img_of_roi = np.repeat(np.arange(len(bn)), bn).astype(np.int32)
+
+    def fn(xa, ba):
+        off = 0.5 if aligned else 0.0
+        sb = ba * spatial_scale - off
+
+        def one_roi(img_idx, box):
+            feat = xa[img_idx]
+            x1, y1, x2, y2 = box[0], box[1], box[2], box[3]
+            rw = jnp.maximum(x2 - x1, 1e-6 if aligned else 1.0)
+            rh = jnp.maximum(y2 - y1, 1e-6 if aligned else 1.0)
+            bin_h, bin_w = rh / oh, rw / ow
+            # sampling grid: ratio x ratio points per bin, averaged
+            iy = jnp.arange(oh * ratio) + 0.5
+            ix = jnp.arange(ow * ratio) + 0.5
+            ys = y1 + iy * (bin_h / ratio)
+            xs = x1 + ix * (bin_w / ratio)
+            grid_y, grid_x = jnp.meshgrid(ys, xs, indexing="ij")
+            vals = _bilinear(feat, grid_y, grid_x)   # [C, oh*r, ow*r]
+            C = vals.shape[0]
+            vals = vals.reshape(C, oh, ratio, ow, ratio)
+            return vals.mean(axis=(2, 4))
+
+        return jax.vmap(one_roi)(jnp.asarray(img_of_roi), sb)
+
+    return apply_op("roi_align", fn, (x, boxes))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Max ROI pooling (reference: vision/ops.py roi_pool).  Approximated
+    on a dense 4x-supersampled grid per bin (static shapes for XLA; exact
+    for boxes aligned to the grid)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    ratio = 4
+    bn = np.asarray(jax.device_get(_arr(boxes_num)))
+    img_of_roi = np.repeat(np.arange(len(bn)), bn).astype(np.int32)
+
+    def fn(xa, ba):
+        sb = ba * spatial_scale
+
+        def one_roi(img_idx, box):
+            feat = xa[img_idx]
+            H, W = feat.shape[-2:]
+            x1, y1, x2, y2 = box[0], box[1], box[2], box[3]
+            rw = jnp.maximum(x2 - x1, 1.0)
+            rh = jnp.maximum(y2 - y1, 1.0)
+            # max over the PIXELS a bin covers: dense grid + floor (nearest)
+            # indexing, never interpolation — interpolation would shrink
+            # the max
+            iy = (jnp.arange(oh * ratio) + 0.5) / ratio
+            ix = (jnp.arange(ow * ratio) + 0.5) / ratio
+            ys = jnp.clip(jnp.floor(y1 + iy * (rh / oh)), 0,
+                          H - 1).astype(jnp.int32)
+            xs = jnp.clip(jnp.floor(x1 + ix * (rw / ow)), 0,
+                          W - 1).astype(jnp.int32)
+            grid_y, grid_x = jnp.meshgrid(ys, xs, indexing="ij")
+            vals = feat[:, grid_y, grid_x]
+            C = vals.shape[0]
+            vals = vals.reshape(C, oh, ratio, ow, ratio)
+            return vals.max(axis=(2, 4))
+
+        return jax.vmap(one_roi)(jnp.asarray(img_of_roi), sb)
+
+    return apply_op("roi_pool", fn, (x, boxes))

@@ -110,7 +110,7 @@ def test_asp_nm_sparsity_workflow():
         opt.clear_grad()
     # sparsity survives optimizer updates
     assert abs(asp.calculate_density(w0) - 0.5) < 0.05
-    asp.reset_excluded_layers()
+    asp.reset_excluded_layers(model)
 
 
 def test_amp_operator_stats_and_compare(tmp_path):
@@ -150,3 +150,34 @@ def test_fused_bias_act_variants():
     x.stop_gradient = False
     IF.fused_bias_act(x, b, act_method="gelu").sum().backward()
     assert x.grad is not None
+
+
+def test_asp_decorate_before_prune_and_odd_shapes():
+    """The reference's documented order (decorate THEN prune) must work,
+    and non-divisible weights are skipped, not fatal."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.incubate import asp
+
+    paddle.seed(1)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 10))
+    opt = asp.decorate(paddle.optimizer.AdamW(
+        1e-2, parameters=model.parameters()))
+    masks = asp.prune_model(model)          # after decorate
+    # [32,10] weight skipped (10 % 4 != 0); [16,32] pruned
+    assert len(masks) == 1
+    x = paddle.to_tensor(np.random.default_rng(2)
+                         .standard_normal((4, 16)).astype("float32"))
+    y = paddle.to_tensor(np.random.default_rng(3).integers(0, 10, (4,))
+                         .astype("int64"))
+    for _ in range(2):
+        loss = paddle.nn.functional.cross_entropy(model(x), y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    w0 = [p for n_, p in model.named_parameters()
+          if n_.endswith("weight")][0]
+    assert abs(asp.calculate_density(w0) - 0.5) < 0.05
+    asp.reset_excluded_layers(model)
+    assert not hasattr(w0, "_asp_mask")

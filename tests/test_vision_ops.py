@@ -1,0 +1,100 @@
+"""Detection ops (reference: python/paddle/vision/ops.py +
+test/legacy_test/test_nms_op.py / test_roi_align_op.py patterns)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _np(t):
+    return np.asarray(t._data_)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    keep = V.nms(boxes, iou_threshold=0.5, scores=scores)
+    assert _np(keep).tolist() == [0, 2]
+    # lower threshold suppresses nothing between disjoint boxes
+    keep_all = V.nms(boxes, iou_threshold=0.95, scores=scores)
+    assert sorted(_np(keep_all).tolist()) == [0, 1, 2]
+
+
+def test_nms_per_category_and_topk():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [1, 1, 11, 11]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    cats = paddle.to_tensor(np.array([0, 1, 0], np.int64))
+    keep = V.nms(boxes, 0.5, scores, cats, categories=[0, 1])
+    # box1 is category 1 → survives; box2 (same cat, IoU 0.68) suppressed
+    assert sorted(_np(keep).tolist()) == [0, 1]
+    keep_top = V.nms(boxes, 0.95, scores, top_k=2)
+    assert len(_np(keep_top)) == 2
+
+
+def test_box_iou():
+    a = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+    b = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15],
+                                   [20, 20, 30, 30]], np.float32))
+    iou = _np(V.box_iou(a, b))[0]
+    np.testing.assert_allclose(iou[0], 1.0, atol=1e-6)
+    np.testing.assert_allclose(iou[1], 25 / 175, atol=1e-4)
+    np.testing.assert_allclose(iou[2], 0.0, atol=1e-6)
+
+
+def test_roi_align_constant_and_grad():
+    x = paddle.to_tensor(np.full((1, 3, 16, 16), 7.0, np.float32))
+    x.stop_gradient = False
+    rois = paddle.to_tensor(np.array([[2, 2, 10, 10]], np.float32))
+    n = paddle.to_tensor(np.array([1], np.int32))
+    out = V.roi_align(x, rois, n, output_size=4)
+    assert tuple(out.shape) == (1, 3, 4, 4)
+    np.testing.assert_allclose(_np(out), 7.0, atol=1e-5)
+    out.sum().backward()
+    assert x.grad is not None and float(_np(x.grad).sum()) > 0
+
+
+def test_roi_align_gradient_localized():
+    """Grad mass lands inside the ROI, not outside it."""
+    x = paddle.to_tensor(np.zeros((1, 1, 16, 16), np.float32))
+    x.stop_gradient = False
+    rois = paddle.to_tensor(np.array([[0, 0, 8, 8]], np.float32))
+    n = paddle.to_tensor(np.array([1], np.int32))
+    V.roi_align(x, rois, n, output_size=2).sum().backward()
+    g = _np(x.grad)[0, 0]
+    assert g[:9, :9].sum() > 0.99 * g.sum()   # all mass in/near the ROI
+
+
+def test_roi_pool_finds_max():
+    xa = np.zeros((1, 1, 8, 8), np.float32)
+    xa[0, 0, 3, 3] = 5.0
+    out = V.roi_pool(paddle.to_tensor(xa),
+                     paddle.to_tensor(np.array([[0, 0, 8, 8]], np.float32)),
+                     paddle.to_tensor(np.array([1], np.int32)),
+                     output_size=2)
+    assert float(_np(out).max()) == 5.0
+    # the bright pixel sits in the top-left quadrant bin
+    assert float(_np(out)[0, 0, 0, 0]) == 5.0
+
+
+def test_multi_image_rois():
+    x = paddle.to_tensor(
+        np.stack([np.full((1, 8, 8), 1.0), np.full((1, 8, 8), 2.0)])
+        .astype(np.float32))
+    rois = paddle.to_tensor(np.array([[0, 0, 4, 4], [0, 0, 4, 4]],
+                                     np.float32))
+    n = paddle.to_tensor(np.array([1, 1], np.int32))
+    out = _np(V.roi_align(x, rois, n, output_size=2))
+    np.testing.assert_allclose(out[0], 1.0, atol=1e-5)
+    np.testing.assert_allclose(out[1], 2.0, atol=1e-5)
+
+
+def test_nms_categories_filter():
+    """Boxes of unlisted categories are excluded entirely."""
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [20, 20, 30, 30], [40, 40, 50, 50]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    cats = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+    keep = V.nms(boxes, 0.5, scores, cats, categories=[0, 1])
+    assert sorted(_np(keep).tolist()) == [0, 1]   # cat-2 box dropped
