@@ -137,6 +137,16 @@ def main():
         cfg = gpt_config("gpt2-124m", max_seq_len=1024,
                          use_flash_attention=True)
         batch, seq, steps, warmup = 8, 1024, 8, 3
+        # pick flash-attention block sizes by timed sweep before the
+        # measured run (cached per shape across rounds)
+        try:
+            from paddle_tpu.pallas.flash_attention import autotune_blocks
+            blocks = autotune_blocks(seq, cfg.head_dim, batch=batch,
+                                     heads=cfg.num_heads)
+            _log(f"flash-attention autotuned blocks for "
+                 f"(seq={seq}, d={cfg.head_dim}): {blocks}")
+        except Exception as e:
+            _log(f"flash autotune skipped: {type(e).__name__}: {e}")
     else:
         cfg = gpt_config("gpt2-124m", num_layers=2, max_seq_len=256,
                          use_flash_attention=False)
@@ -184,9 +194,8 @@ def main():
     with paddle.no_grad():
         _, fc = count_flops(model, x, labels=y)
     flops_per_token = fc.train_step_flops / (batch * seq)
-    # v5e peak ~197 TFLOPs bf16; v5p ~459; default to v5e unless told
-    peak = float(os.environ.get("TPU_PEAK_TFLOPS",
-                                "197" if on_tpu else "0.5")) * 1e12
+    from paddle_tpu.cost_model import device_peak_flops
+    peak = device_peak_flops(jax.devices()[0].platform)
     mfu = tokens_per_sec * flops_per_token / peak
 
     # Per-platform baseline entries: a CPU smoke run must never clobber the

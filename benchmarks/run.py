@@ -59,10 +59,9 @@ def _serialize_cpu_dispatch():
 
 
 def _mfu(model, batch, seq, tokens_per_sec):
-    peak = float(os.environ.get(
-        "TPU_PEAK_TFLOPS",
-        "197" if _platform() in ("tpu", "axon") else "0.5")) * 1e12
-    return tokens_per_sec * model.flops_per_token(seq) / peak
+    from paddle_tpu.cost_model import device_peak_flops
+    return tokens_per_sec * model.flops_per_token(seq) / \
+        device_peak_flops(_platform())
 
 
 def bench_mnist(args):
@@ -241,13 +240,19 @@ def main():
     ap.add_argument("--config", required=True,
                     choices=["1", "mnist", "2", "gpt2-124m", "3", "gpt3-dp",
                              "4", "llama-tp-pp", "5", "moe"])
-    ap.add_argument("--preset", default="tiny", choices=["tiny", "full"])
+    ap.add_argument("--preset", default="auto",
+                    choices=["auto", "tiny", "full"],
+                    help="auto: full on TPU, tiny on CPU — a default TPU "
+                         "run must never record smoke-scale numbers under "
+                         "the flagship metric names")
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--warmup", type=int, default=2)
     args = ap.parse_args()
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         _serialize_cpu_dispatch()
+    if args.preset == "auto":
+        args.preset = "full" if _platform() in ("tpu", "axon") else "tiny"
 
     c = args.config
     if c in ("1", "mnist"):

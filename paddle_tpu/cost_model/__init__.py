@@ -114,3 +114,22 @@ class CostModel:
 
     def estimate_step(self, **kwargs):
         return transformer_step_cost(device=self.device, **kwargs)
+
+
+def device_peak_flops(platform=None):
+    """Peak bf16 FLOP/s for MFU accounting: TPU_PEAK_TFLOPS env override,
+    else the generation's spec-sheet number (PALLAS_AXON_TPU_GEN), else
+    v5e on TPU / a nominal 0.5 TF on CPU.  Single source for bench.py and
+    benchmarks/run.py so the two harnesses report comparable MFU."""
+    import os
+    env = os.environ.get("TPU_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    if platform is None:
+        import jax
+        platform = jax.devices()[0].platform
+    if platform in ("tpu", "axon"):
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        spec = DEVICE_SPECS.get(gen)
+        return spec.peak_flops_bf16 if spec else DEVICE_SPECS["v5e"].peak_flops_bf16
+    return 0.5e12

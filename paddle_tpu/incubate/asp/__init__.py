@@ -16,7 +16,14 @@ from __future__ import annotations
 
 import numpy as np
 
+import weakref
+
 from ...core.tensor import Tensor
+
+# keyed by id: weakref equality would fall back to Tensor.__eq__
+# (elementwise) — a WeakSet of Tensors is unusable
+_PRUNED_PARAMS: "weakref.WeakValueDictionary" = \
+    weakref.WeakValueDictionary()
 
 
 def calculate_density(x):
@@ -56,18 +63,25 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         mask = compute_nm_mask(param, n=n, m=m)
         param.set_value(np.asarray(param._data_) * mask)
         if with_mask:
-            # the mask lives ON the param: no global registry, no leaked
-            # references once the model is dropped
+            # the mask lives ON the param (weak registry only tracks
+            # liveness): nothing leaks once the model is dropped
             param._asp_mask = mask
+            _PRUNED_PARAMS[id(param)] = param
         masks[name] = mask
     return masks
 
 
-def reset_excluded_layers(model):
-    """Drop `model`'s recorded masks (dense training resumes)."""
-    for _, param in model.named_parameters():
+def reset_excluded_layers(model=None):
+    """Drop recorded masks (dense training resumes) — `model`'s params,
+    or every live pruned param when omitted (reference signature)."""
+    if model is not None:
+        params = [p for _, p in model.named_parameters()]
+    else:
+        params = list(_PRUNED_PARAMS.values())
+    for param in params:
         if hasattr(param, "_asp_mask"):
             del param._asp_mask
+        _PRUNED_PARAMS.pop(id(param), None)
 
 
 class ASPOptimizer:

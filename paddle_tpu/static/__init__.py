@@ -109,6 +109,58 @@ class CompiledProgram:
         self.program = program
 
 
+class _Var:
+    """Scope-held value (reference: Variable/LoDTensor holder)."""
+
+    def __init__(self, value=None):
+        self._value = value
+
+    def get_tensor(self):
+        return self._value
+
+    def set(self, value):
+        self._value = value
+
+
+class Scope:
+    """reference: paddle.static.global_scope() — name → variable holder;
+    Executor.run records fetched outputs here."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, _Var())
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def set(self, name, value):
+        self.var(name).set(value)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        global _global_scope
+        prev = _global_scope
+        _global_scope = scope
+        try:
+            yield scope
+        finally:
+            _global_scope = prev
+    return _guard()
+
+
 class Executor:
     """reference: static.Executor (base/executor.py:1030) — run a Program
     with a feed dict, fetch outputs."""
@@ -121,7 +173,25 @@ class Executor:
         program = program or _default_program
         if isinstance(program, CompiledProgram):
             program = program.program
+        # reference accepts a per-device list of feed dicts whose slices
+        # CONCATENATE into the global batch (update() would silently drop
+        # every device but the last)
+        if isinstance(feed, (list, tuple)):
+            merged = {}
+            for d in feed:
+                for k, v in d.items():
+                    merged.setdefault(k, []).append(np.asarray(v))
+            feed = {k: (vs[0] if len(vs) == 1
+                        else np.concatenate(vs, axis=0))
+                    for k, vs in merged.items()}
         feed = feed or {}
+        if program._input_specs:
+            missing = [s.name for s in program._input_specs
+                       if s.name not in feed]
+            if missing:
+                raise ValueError(
+                    f"feed is missing inputs {missing}; required: "
+                    f"{[s.name for s in program._input_specs]}")
         if program._exported is not None:
             args = [np.asarray(feed[s.name]) for s in
                     program._input_specs]
@@ -142,10 +212,31 @@ class Executor:
             outs = [outs]
         elif not isinstance(outs, (list, tuple)):
             outs = [outs]
+        outs = list(outs)
+        named = getattr(program, "_output_names", None) or []
+        # scope records ALL outputs under their canonical names BEFORE any
+        # fetch selection, so names stay positionally correct
+        scope = global_scope()
+        for i, o in enumerate(outs):
+            val = np.asarray(o._data_) if isinstance(o, Tensor) \
+                else np.asarray(o)
+            scope.set(named[i] if i < len(named) else f"fetch_{i}", val)
+        # fetch selection: indices, or names recorded on the program
+        if fetch_list:
+            sel = []
+            for f in fetch_list:
+                if isinstance(f, int):
+                    sel.append(outs[f])
+                elif isinstance(f, str) and f in named:
+                    sel.append(outs[named.index(f)])
+                else:
+                    sel = outs
+                    break
+            outs = sel
         if return_numpy:
             return [np.asarray(o._data_) if isinstance(o, Tensor)
                     else np.asarray(o) for o in outs]
-        return list(outs)
+        return outs
 
 
 # ---------------------------------------------------------------------------
@@ -239,6 +330,7 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     feed_names = [s.name for s in prog._input_specs]
     n_out = len(exp.out_avals)
     fetch_names = [f"fetch_{i}" for i in range(n_out)]
+    prog._output_names = fetch_names
     return prog, feed_names, fetch_names
 
 

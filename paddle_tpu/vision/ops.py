@@ -109,10 +109,14 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         output_size = (output_size, output_size)
     oh, ow = output_size
     ratio = 2 if sampling_ratio <= 0 else sampling_ratio
-    bn = np.asarray(jax.device_get(_arr(boxes_num)))
-    img_of_roi = np.repeat(np.arange(len(bn)), bn).astype(np.int32)
+    n_rois = _arr(boxes).shape[0]
 
-    def fn(xa, ba):
+    def fn(xa, ba, bn):
+        # ROI→image routing stays traced (boxes_num may be a jit tracer);
+        # total_repeat_length pins the static output size
+        img_of_roi = jnp.repeat(jnp.arange(bn.shape[0]),
+                                bn.astype(jnp.int32),
+                                total_repeat_length=n_rois)
         off = 0.5 if aligned else 0.0
         sb = ba * spatial_scale - off
 
@@ -133,9 +137,9 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             vals = vals.reshape(C, oh, ratio, ow, ratio)
             return vals.mean(axis=(2, 4))
 
-        return jax.vmap(one_roi)(jnp.asarray(img_of_roi), sb)
+        return jax.vmap(one_roi)(img_of_roi, sb)
 
-    return apply_op("roi_align", fn, (x, boxes))
+    return apply_op("roi_align", fn, (x, boxes, boxes_num))
 
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
@@ -147,10 +151,12 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         output_size = (output_size, output_size)
     oh, ow = output_size
     ratio = 4
-    bn = np.asarray(jax.device_get(_arr(boxes_num)))
-    img_of_roi = np.repeat(np.arange(len(bn)), bn).astype(np.int32)
+    n_rois = _arr(boxes).shape[0]
 
-    def fn(xa, ba):
+    def fn(xa, ba, bn):
+        img_of_roi = jnp.repeat(jnp.arange(bn.shape[0]),
+                                bn.astype(jnp.int32),
+                                total_repeat_length=n_rois)
         sb = ba * spatial_scale
 
         def one_roi(img_idx, box):
@@ -174,6 +180,6 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             vals = vals.reshape(C, oh, ratio, ow, ratio)
             return vals.max(axis=(2, 4))
 
-        return jax.vmap(one_roi)(jnp.asarray(img_of_roi), sb)
+        return jax.vmap(one_roi)(img_of_roi, sb)
 
-    return apply_op("roi_pool", fn, (x, boxes))
+    return apply_op("roi_pool", fn, (x, boxes, boxes_num))

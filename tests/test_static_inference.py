@@ -85,3 +85,38 @@ def test_exported_program_is_portable_stablehlo(tmp_path):
     from jax import export as jexport
     exp = jexport.deserialize(open(prefix + ".pdmodel", "rb").read())
     assert "stablehlo" in exp.mlir_module() or exp.mlir_module_serialized
+
+
+def test_executor_feed_validation_and_fetch_selection(tmp_path):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    layer = paddle.nn.Linear(4, 2)
+    prefix = str(tmp_path / "m")
+    static.save_inference_model(
+        prefix, [static.InputSpec([1, 4], "float32", "x")], [],
+        layer=layer)
+    prog, feeds, fetches = static.load_inference_model(prefix)
+    exe = static.Executor()
+    x = np.ones((1, 4), np.float32)
+    # list-of-dict feed (reference's per-device form) merges
+    out = exe.run(prog, feed=[{feeds[0]: x}], fetch_list=[0])
+    assert out[0].shape == (1, 2)
+    # missing feed key raises with the required names
+    import pytest
+    with pytest.raises(ValueError, match="missing"):
+        exe.run(prog, feed={})
+    # fetched results land in the global scope
+    scope = static.global_scope()
+    assert scope.find_var("fetch_0") is not None
+    assert scope.find_var("fetch_0").get_tensor().shape == (1, 2)
+
+
+def test_scope_guard_isolates():
+    from paddle_tpu import static
+    outer = static.global_scope()
+    with static.scope_guard(static.Scope()) as s:
+        s.set("k", 1)
+        assert static.global_scope() is s
+    assert static.global_scope() is outer
