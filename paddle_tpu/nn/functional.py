@@ -271,6 +271,80 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     return out
 
 
+@defop("grid_sample")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x at normalized grid locations (reference:
+    nn/functional/vision.py grid_sample over grid_sample_kernel.cu).
+
+    x: [N, C, H, W]; grid: [N, Hg, Wg, 2] with (x, y) in [-1, 1].
+    Pure gather + lerp: traces into the surrounding program."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unknown mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unknown padding_mode {padding_mode!r}")
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1.0) * 0.5 * (w - 1)
+        fy = (gy + 1.0) * 0.5 * (h - 1)
+    else:
+        fx = ((gx + 1.0) * w - 1.0) * 0.5
+        fy = ((gy + 1.0) * h - 1.0) * 0.5
+
+    def _reflect(v, size):
+        # reflect about -0.5 / size-0.5 (align_corners=False convention)
+        # or 0 / size-1 (align_corners=True)
+        if align_corners:
+            span = max(size - 1, 1)
+            v = jnp.abs(v) % (2 * span)
+            return jnp.where(v > span, 2 * span - v, v)
+        span = size
+        v = (v + 0.5) % (2 * span)
+        v = jnp.where(v < 0, v + 2 * span, v)
+        return jnp.where(v > span, 2 * span - v, v) - 0.5
+
+    if padding_mode == "reflection":
+        fx = _reflect(fx, w)
+        fy = _reflect(fy, h)
+
+    def _gather(iy, ix):
+        """Clamped gather with a zeros mask when padding_mode='zeros'."""
+        inside = (iy >= 0) & (iy < h) & (ix >= 0) & (ix < w)
+        iyc = jnp.clip(iy, 0, h - 1)
+        ixc = jnp.clip(ix, 0, w - 1)
+        vals = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, iyc, ixc)
+        if padding_mode == "zeros":
+            vals = jnp.where(inside[:, None], vals, 0.0)
+        return vals
+
+    def sample_nearest(fy_, fx_):
+        return _gather(jnp.round(fy_).astype(jnp.int32),
+                       jnp.round(fx_).astype(jnp.int32))
+
+    def sample_bilinear(fy_, fx_):
+        y0 = jnp.floor(fy_)
+        x0 = jnp.floor(fx_)
+        wy = fy_ - y0
+        wx = fx_ - x0
+        out = 0.0
+        for dy, sy in ((0, 1.0), (1, 0.0)):
+            for dx, sx in ((0, 1.0), (1, 0.0)):
+                wgt = (jnp.abs(sy - wy)) * (jnp.abs(sx - wx))
+                vals = _gather((y0 + dy).astype(jnp.int32),
+                               (x0 + dx).astype(jnp.int32))
+                out = out + vals * wgt[:, None]
+        return out
+
+    # flatten grid, sample, restore [N, C, Hg, Wg]
+    hg, wg = grid.shape[1], grid.shape[2]
+    fyf = fy.reshape(n, -1)
+    fxf = fx.reshape(n, -1)
+    vals = (sample_nearest(fyf, fxf) if mode == "nearest"
+            else sample_bilinear(fyf, fxf))       # [N, C, Hg*Wg]
+    return vals.reshape(n, c, hg, wg)
+
+
 @defop("batch_norm_infer")
 def _batch_norm_infer(x, running_mean, running_var, weight, bias, epsilon,
                       data_format):

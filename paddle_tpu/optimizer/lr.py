@@ -261,3 +261,55 @@ class OneCycleLR(LRScheduler):
         pct = (step - up) / max(self.total_steps - up, 1)
         return self.end_lr + (self.max_lr - self.end_lr) * (
             1 + math.cos(math.pi * pct)) / 2
+
+
+class MultiplicativeDecay(LRScheduler):
+    """reference: optimizer/lr.py MultiplicativeDecay — lr multiplied by
+    lr_lambda(epoch) cumulatively."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        lr = self.base_lr
+        for e in range(1, self.last_epoch + 1):
+            lr *= self.lr_lambda(e)
+        return lr
+
+
+class CyclicLR(LRScheduler):
+    """reference: optimizer/lr.py CyclicLR — triangular cyclic schedule
+    between base_learning_rate and max_learning_rate."""
+
+    def __init__(self, base_learning_rate, max_learning_rate,
+                 step_size_up, step_size_down=None, mode="triangular",
+                 exp_gamma=1.0, scale_fn=None, scale_mode="cycle",
+                 last_epoch=-1, verbose=False):
+        self.max_lr = max_learning_rate
+        self.up = step_size_up
+        self.down = step_size_down or step_size_up
+        self.mode = mode
+        self.exp_gamma = exp_gamma
+        self.scale_fn = scale_fn
+        self.scale_mode = scale_mode
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        total = self.up + self.down
+        cycle = self.last_epoch // total
+        pos = self.last_epoch % total
+        frac = pos / self.up if pos < self.up else \
+            1.0 - (pos - self.up) / self.down
+        amp = self.max_lr - self.base_lr
+        if self.scale_fn is not None:
+            # reference passes a 1-indexed cycle number to scale_fn
+            arg = cycle + 1 if self.scale_mode == "cycle" \
+                else self.last_epoch
+            amp *= self.scale_fn(arg)
+        elif self.mode == "triangular2":
+            amp /= 2.0 ** cycle
+        elif self.mode == "exp_range":
+            amp *= self.exp_gamma ** self.last_epoch
+        return self.base_lr + amp * frac

@@ -282,8 +282,28 @@ def _export_layer(layer_or_fn, input_specs):
         param_arrays = []
         params_np = {}
 
-    x_structs = [jax.ShapeDtypeStruct(tuple(s.shape),
-                                      jnp.dtype(s.dtype))
+    # None/-1 dims become jax.export symbolic dimensions, so one exported
+    # program serves every batch size (reference: InputSpec dynamic dims).
+    # ONE scope shared by every input — per-spec scopes cannot mix.
+    dyn_names = iter(f"_d{i}" for i in range(64))
+    scope = jexport.SymbolicScope()
+
+    def _shape(spec):
+        dims = []
+        for axis, d in enumerate(tuple(spec.shape)):
+            if d is None or (isinstance(d, int) and d < 0):
+                # dynamic axis-0 dims share ONE symbol across inputs (the
+                # common "same batch for every input" contract — distinct
+                # symbols could never broadcast together); other axes get
+                # fresh symbols
+                dims.append("_b" if axis == 0 else next(dyn_names))
+            else:
+                dims.append(str(d))
+        if any(d.startswith("_") for d in dims):
+            return jexport.symbolic_shape(",".join(dims), scope=scope)
+        return tuple(int(d) for d in dims)
+
+    x_structs = [jax.ShapeDtypeStruct(_shape(s), jnp.dtype(s.dtype))
                  for s in input_specs]
     p_structs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
                  for a in param_arrays]

@@ -189,3 +189,37 @@ def test_scaled_dot_product_attention_causal():
     q = paddle.randn([2, 8, 4, 16])
     out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
     assert out.shape == [2, 8, 4, 16]
+
+
+def test_grid_sample():
+    """reference: nn/functional/vision.py grid_sample."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    n, c, h, w = 2, 3, 5, 5
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((n, c, h, w))
+        .astype("float32"))
+    ys, xs = np.meshgrid(np.linspace(-1, 1, h), np.linspace(-1, 1, w),
+                         indexing="ij")
+    grid = paddle.to_tensor(
+        np.broadcast_to(np.stack([xs, ys], -1)[None],
+                        (n, h, w, 2)).astype("float32"))
+    # identity grid reproduces the input (align_corners)
+    out = F.grid_sample(x, grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out._data_),
+                               np.asarray(x._data_), atol=1e-5)
+    # zeros padding outside the image
+    far = paddle.to_tensor(np.full((n, 1, 1, 2), 9.0, np.float32))
+    np.testing.assert_allclose(
+        np.asarray(F.grid_sample(x, far)._data_), 0.0)
+    # border padding clamps instead
+    border = np.asarray(F.grid_sample(x, far,
+                                      padding_mode="border")._data_)
+    np.testing.assert_allclose(border[:, :, 0, 0],
+                               np.asarray(x._data_)[:, :, -1, -1],
+                               atol=1e-5)
+    # differentiable
+    x.stop_gradient = False
+    F.grid_sample(x, grid).sum().backward()
+    assert x.grad is not None

@@ -120,3 +120,22 @@ def test_scope_guard_isolates():
         s.set("k", 1)
         assert static.global_scope() is s
     assert static.global_scope() is outer
+
+
+def test_dynamic_batch_export():
+    """None dims export as jax symbolic dimensions: one program, any
+    batch (reference: InputSpec dynamic dims)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    import tempfile, os
+    layer = paddle.nn.Linear(4, 3)
+    prefix = os.path.join(tempfile.mkdtemp(), "dyn")
+    static.save_inference_model(
+        prefix, [static.InputSpec([None, 4], "float32", "x")], [],
+        layer=layer)
+    prog, feeds, fetches = static.load_inference_model(prefix)
+    exe = static.Executor()
+    for bsz in (1, 3, 8):
+        out = exe.run(prog, feed={"x": np.ones((bsz, 4), np.float32)})
+        assert out[0].shape == (bsz, 3)
