@@ -270,13 +270,18 @@ class MultiplicativeDecay(LRScheduler):
     def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
                  verbose=False):
         self.lr_lambda = lr_lambda
+        self._cum_epoch = 0      # running product cache: O(1) per step
+        self._cum = 1.0
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        lr = self.base_lr
-        for e in range(1, self.last_epoch + 1):
-            lr *= self.lr_lambda(e)
-        return lr
+        target = max(self.last_epoch, 0)
+        if target < self._cum_epoch:      # epoch jumped backwards: rebuild
+            self._cum_epoch, self._cum = 0, 1.0
+        while self._cum_epoch < target:
+            self._cum_epoch += 1
+            self._cum *= self.lr_lambda(self._cum_epoch)
+        return self.base_lr * self._cum
 
 
 class CyclicLR(LRScheduler):

@@ -134,6 +134,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[:] = (m_scr[:] + jnp.log(l)).astype(lse_ref.dtype)
 
 
+def _causal_kv_spec(block_q, block_k, d, q_axis, kv_axis, causal):
+    """kv BlockSpec for a (…, q_idx, kv_idx)-style grid: on causal,
+    beyond-diagonal kv fetches clamp to the diagonal block (Mosaic dedupes
+    the repeated index, so the pl.when-skipped steps cost no HBM traffic).
+    q_axis/kv_axis give the grid positions of the q and kv indices."""
+    from jax.experimental import pallas as pl
+
+    def index(*g):
+        j = g[kv_axis]
+        if causal:
+            i = g[q_axis]
+            j = jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
+        return (g[0], j, g[1], 0)
+    return pl.BlockSpec((None, block_k, None, d), index)
+
+
+def _causal_q_specs(block_q, block_k, d, q_axis, kv_axis, causal):
+    """(q/do spec, lse/delta spec) for the dkv grid: on causal, dead
+    (above-diagonal) q fetches clamp forward to the first live block
+    (j*block_k)//block_q."""
+    from jax.experimental import pallas as pl
+
+    def qi(*g):
+        i = g[q_axis]
+        if causal:
+            i = jnp.maximum(i, (g[kv_axis] * block_k) // block_q)
+        return (g[0], i, g[1], 0)
+
+    def li(*g):
+        i = g[q_axis]
+        if causal:
+            i = jnp.maximum(i, (g[kv_axis] * block_k) // block_q)
+        return (g[0], g[1], i, 0)
+    return (pl.BlockSpec((None, block_q, None, d), qi),
+            pl.BlockSpec((None, None, block_q, 1), li))
+
+
 def _pallas_flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
     """q,k,v: [B, S, H, D] → (out [B, S, H, D], lse [B, H, S, 1] fp32)."""
     from jax.experimental import pallas as pl
@@ -147,8 +184,8 @@ def _pallas_flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
                                block_q=block_q, block_k=block_k)
     qo_spec = pl.BlockSpec((None, block_q, None, d),
                            lambda b_, h_, i, j: (b_, i, h_, 0))
-    kv_spec = pl.BlockSpec((None, block_k, None, d),
-                           lambda b_, h_, i, j: (b_, j, h_, 0))
+    kv_spec = _causal_kv_spec(block_q, block_k, d, q_axis=2, kv_axis=3,
+                              causal=causal)
     lse_spec = pl.BlockSpec((None, None, block_q, 1),
                             lambda b_, h_, i, j: (b_, h_, i, 0))
     out, lse = pl.pallas_call(
@@ -286,12 +323,11 @@ def _pallas_flash_bwd(q, k, v, out, lse, dout, *, causal, scale,
     delta = jnp.einsum("bshd,bshd->bhs", dout.astype(jnp.float32),
                        out.astype(jnp.float32))[..., None]  # [B, H, S, 1]
 
-    qo_spec_q = pl.BlockSpec((None, block_q, None, d),
-                             lambda b_, h_, j, i: (b_, i, h_, 0))
+    qo_spec_q, lse_spec_q = _causal_q_specs(block_q, block_k, d,
+                                            q_axis=3, kv_axis=2,
+                                            causal=causal)
     kv_spec_q = pl.BlockSpec((None, block_k, None, d),
                              lambda b_, h_, j, i: (b_, j, h_, 0))
-    lse_spec_q = pl.BlockSpec((None, None, block_q, 1),
-                              lambda b_, h_, j, i: (b_, h_, i, 0))
     dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
                                    causal=causal, block_q=block_q,
                                    block_k=block_k)
@@ -310,8 +346,8 @@ def _pallas_flash_bwd(q, k, v, out, lse, dout, *, causal, scale,
 
     qo_spec = pl.BlockSpec((None, block_q, None, d),
                            lambda b_, h_, i, j: (b_, i, h_, 0))
-    kv_spec = pl.BlockSpec((None, block_k, None, d),
-                           lambda b_, h_, i, j: (b_, j, h_, 0))
+    kv_spec = _causal_kv_spec(block_q, block_k, d, q_axis=2, kv_axis=3,
+                              causal=causal)
     lse_spec = pl.BlockSpec((None, None, block_q, 1),
                             lambda b_, h_, i, j: (b_, h_, i, 0))
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,

@@ -285,7 +285,8 @@ def _export_layer(layer_or_fn, input_specs):
     # None/-1 dims become jax.export symbolic dimensions, so one exported
     # program serves every batch size (reference: InputSpec dynamic dims).
     # ONE scope shared by every input — per-spec scopes cannot mix.
-    dyn_names = iter(f"_d{i}" for i in range(64))
+    import itertools
+    dyn_names = (f"_d{i}" for i in itertools.count())
     scope = jexport.SymbolicScope()
 
     def _shape(spec):

@@ -163,3 +163,24 @@ def test_autotune_cache(tmp_path):
     del os.environ["PADDLE_TPU_AUTOTUNE_CACHE"]
     autotune._LOADED = False
     autotune._CACHE.clear()
+
+
+@pytest.mark.parametrize("bq,bk", [(128, 64), (64, 128)])
+def test_flash_backward_mixed_blocks_causal(bq, bk):
+    """Causal bwd with unequal block sizes exercises the clamped
+    dead-block index maps (first-live-q and diagonal-kv math)."""
+    q, k, v = _qkv(b=1, s=256, h=2, d=64, seed=4)
+    sc = 1.0 / np.sqrt(q.shape[-1])
+
+    def f_pallas(q_, k_, v_):
+        return (fa._flash_core(q_, k_, v_, True, sc, bq, bk) ** 2).sum()
+
+    def f_ref(q_, k_, v_):
+        return (fa._xla_attention(q_, k_, v_, causal=True,
+                                  scale=sc) ** 2).sum()
+
+    g_p = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gp, gr in zip(g_p, g_r):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                   atol=5e-5, rtol=5e-5)
