@@ -163,10 +163,18 @@ def main():
     data = rng.integers(0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32)
     x = paddle.to_tensor(data[:, :-1])
     y = paddle.to_tensor(data[:, 1:])
+    # warmup/discovery run at batch 1: the two eager passes to_static needs
+    # are memory-hostile at full batch (the eager tape holds every
+    # residual); the batch-polymorphic input_spec lets jax.jit re-trace the
+    # same bound program for the full batch without another eager pass
+    x1 = paddle.to_tensor(data[:1, :-1])
+    y1 = paddle.to_tensor(data[:1, 1:])
 
     amp_level = "O2" if on_tpu else "O0"
 
-    @paddle.jit.to_static
+    @paddle.jit.to_static(input_spec=[
+        paddle.jit.InputSpec([None, seq], "int32"),
+        paddle.jit.InputSpec([None, seq], "int32")])
     def train_step(x, y):
         with paddle.amp.auto_cast(enable=on_tpu, level=amp_level,
                                   dtype="bfloat16"):
@@ -176,24 +184,32 @@ def main():
         opt.clear_grad()
         return loss
 
-    # warmup: eager + discovery + first compiled call
-    for _ in range(warmup):
+    # warmup: eager + discovery (batch 1) + first compiled calls (full)
+    for _ in range(2):
+        loss = train_step(x1, y1)
+    for _ in range(max(warmup - 2, 1)):
         loss = train_step(x, y)
     jax.block_until_ready(loss._data_)
+    _log(f"warmup done, loss={float(loss):.4f}")
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = train_step(x, y)
     jax.block_until_ready(loss._data_)
     dt = time.perf_counter() - t0
+    # force a value read BEFORE reporting: async dispatch errors (e.g.
+    # resource exhaustion) must fail the bench, not surface after the JSON
+    final_loss = float(loss)
 
     tokens_per_sec = batch * seq * steps / dt
     # analytic FLOPs from registry metadata: one counted eager forward
     # (profiler-computed, not a per-model hand formula)
     from paddle_tpu.profiler import count_flops
     with paddle.no_grad():
-        _, fc = count_flops(model, x, labels=y)
-    flops_per_token = fc.train_step_flops / (batch * seq)
+        # count on the batch-1 slice: FLOPs/token is batch-invariant and
+        # the eager counting pass at full batch is memory-hostile
+        _, fc = count_flops(model, x1, labels=y1)
+    flops_per_token = fc.train_step_flops / (1 * seq)
     from paddle_tpu.cost_model import device_peak_flops
     peak = device_peak_flops(jax.devices()[0].platform)
     mfu = tokens_per_sec * flops_per_token / peak
@@ -232,7 +248,7 @@ def main():
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 3),
     }))
-    print(f"# loss={float(loss):.4f} mfu={mfu:.3f} "
+    print(f"# loss={final_loss:.4f} mfu={mfu:.3f} "
           f"steps={steps} batch={batch} seq={seq} platform="
           f"{jax.devices()[0].platform}", file=sys.stderr)
 

@@ -151,6 +151,11 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                 g = jnp.zeros(shape, dtype)
             else:
                 any_live = True
+                # accumulated cotangents can be wider than the primal output
+                # (e.g. an fp32 loss vjp feeding bf16 logits under AMP O2);
+                # jax.vjp requires an exact dtype match
+                if g.dtype != dtype:
+                    g = g.astype(dtype)
             cots.append(g)
         if not any_live:
             continue

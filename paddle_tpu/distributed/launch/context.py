@@ -65,4 +65,18 @@ class Context:
             "WORLD_SIZE": str(world),
             "COORDINATOR_ADDRESS": master,
         })
+        # workers must import paddle_tpu even when the package is not
+        # pip-installed (scripts get only their own dir on sys.path)
+        import paddle_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(paddle_tpu.__file__)))
+        existing = env.get("PYTHONPATH")
+        if not existing:
+            # unset OR empty-string: plain pkg_root (appending os.pathsep
+            # to "" would add a trailing empty entry = cwd on sys.path)
+            env["PYTHONPATH"] = pkg_root
+        elif pkg_root not in existing.split(os.pathsep):
+            # preserve the original verbatim (empty entries mean cwd)
+            env["PYTHONPATH"] = pkg_root + os.pathsep + existing
         return env

@@ -256,6 +256,12 @@ class StaticFunction:
                  full_graph=True):
         self._fn = fn
         self._cache = {}
+        # InputSpec list with None dims = batch-polymorphic signature:
+        # warmup/discovery run once (typically on a small batch) and
+        # jax.jit re-traces the same bound program per concrete shape.
+        # Caveat: Python-level host reads of *shapes* specialize to the
+        # discovery call's values (data guards still re-dispatch).
+        self._input_spec = list(input_spec) if input_spec else None
         for attr in ("__name__", "__qualname__", "__doc__"):
             try:
                 setattr(self, attr, getattr(fn, attr))
@@ -274,11 +280,29 @@ class StaticFunction:
     def concrete_cache_size(self):
         return len(self._cache)
 
+    def _canon_key(self, args, kwargs):
+        treedef, sig = _signature(args, kwargs)
+        if not self._input_spec:
+            return treedef, sig
+        specs = self._input_spec
+        out, ti = [], 0
+        for leaf in sig:
+            if isinstance(leaf, tuple) and len(leaf) == 3 and leaf[0] == "T":
+                spec = specs[ti] if ti < len(specs) else None
+                ti += 1
+                shape = getattr(spec, "shape", None)
+                if shape is not None and len(shape) == len(leaf[1]):
+                    leaf = ("T", tuple(None if s is None else d
+                                       for d, s in zip(leaf[1], shape)),
+                            leaf[2])
+            out.append(leaf)
+        return treedef, tuple(out)
+
     def __call__(self, *args, **kwargs):
         if _state.STATE.tracer is not None:
             # nested to_static: inline into the enclosing trace
             return self._fn(*args, **kwargs)
-        key = _signature(args, kwargs)
+        key = self._canon_key(args, kwargs)
         state = self._cache.get(key)
         if state is None:
             # warm-up: run once fully eager so lazily-initialized persistent
@@ -465,11 +489,16 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     def decorate(fn):
         if isinstance(fn, Layer):
             layer = fn
-            static_fwd = StaticFunction(layer.forward.__func__
-                                        if hasattr(layer.forward, "__func__")
-                                        else layer.forward)
-            bound = functools.partial(static_fwd, layer) \
-                if hasattr(layer.forward, "__func__") else static_fwd
+            # input_spec matches Tensor leaves positionally, so the bound
+            # self (a non-Tensor leaf) needs no placeholder in the spec
+            if hasattr(layer.forward, "__func__"):
+                static_fwd = StaticFunction(layer.forward.__func__,
+                                            input_spec=input_spec)
+                bound = functools.partial(static_fwd, layer)
+            else:
+                static_fwd = StaticFunction(layer.forward,
+                                            input_spec=input_spec)
+                bound = static_fwd
             layer.forward = bound
             return layer
         return StaticFunction(fn, input_spec=input_spec)

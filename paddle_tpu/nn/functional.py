@@ -8,6 +8,8 @@ Pallas implementations.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -735,6 +737,53 @@ def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noq
     return _reduce(loss, reduction)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _softmax_xent_fused(logits, label, ignore_index):
+    """Fused softmax + cross-entropy (hard labels, last axis).
+
+    Reference capability: the fused softmax_with_cross_entropy kernel
+    (paddle/phi/kernels/fusion; c_softmax_with_cross_entropy).  Memory
+    win that matters at LM head scale ([tokens, vocab]): the VJP saves
+    only the *original-dtype* logits + the fp32 logsumexp and recomputes
+    the softmax in backward, instead of jax.vjp storing the fp32
+    log-softmax and its residuals (3× the logits bytes at bf16).
+    """
+    loss, _ = _softmax_xent_fwd_impl(logits, label, ignore_index)
+    return loss
+
+
+def _softmax_xent_fwd_impl(logits, label, ignore_index):
+    x32 = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(x32, axis=-1, keepdims=True)
+    lbl = jnp.clip(label, 0, logits.shape[-1] - 1).astype(jnp.int32)
+    picked = jnp.take_along_axis(x32, lbl[..., None], axis=-1)[..., 0]
+    mask = label != ignore_index
+    loss = jnp.where(mask, lse[..., 0] - picked, 0.0)
+    return loss, (logits, label, lse)
+
+
+def _softmax_xent_vjp_fwd(logits, label, ignore_index):
+    loss, res = _softmax_xent_fwd_impl(logits, label, ignore_index)
+    return loss, res
+
+
+def _softmax_xent_vjp_bwd(ignore_index, res, g):
+    logits, label, lse = res
+    mask = label != ignore_index
+    gm = jnp.where(mask, g, 0.0).astype(jnp.float32)
+    p = jnp.exp(logits.astype(jnp.float32) - lse)
+    d = p * gm[..., None]
+    lbl = jnp.clip(label, 0, logits.shape[-1] - 1).astype(jnp.int32)
+    d2 = d.reshape(-1, d.shape[-1])
+    d2 = d2.at[jnp.arange(d2.shape[0]), lbl.reshape(-1)].add(
+        -gm.reshape(-1))
+    return (d2.reshape(d.shape).astype(logits.dtype),
+            np.zeros(label.shape, dtype=jax.dtypes.float0))
+
+
+_softmax_xent_fused.defvjp(_softmax_xent_vjp_fwd, _softmax_xent_vjp_bwd)
+
+
 @defop("cross_entropy")
 def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
                   reduction="mean", soft_label=False, axis=-1,
@@ -742,8 +791,21 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
     """reference: python/paddle/nn/functional/loss.py cross_entropy.
 
     Computes log-softmax in f32 regardless of input dtype (AMP black-list
-    behavior of the reference).
+    behavior of the reference).  The common LM-head case (hard labels,
+    last axis, no weight/smoothing) routes through the fused
+    softmax-cross-entropy VJP above.
     """
+    if (use_softmax and not soft_label and label_smoothing == 0.0
+            and weight is None and axis in (-1, input.ndim - 1)):
+        lbl = label
+        if lbl.ndim == input.ndim:
+            lbl = jnp.squeeze(lbl, axis)
+        loss = _softmax_xent_fused(input, lbl, ignore_index)
+        if reduction == "mean":
+            mask = lbl != ignore_index
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(mask.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
     x = input.astype(jnp.float32) if input.dtype in (jnp.bfloat16, jnp.float16) \
         else input
     if use_softmax:

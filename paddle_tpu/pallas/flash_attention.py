@@ -11,9 +11,12 @@ block from the saved logsumexp — never an O(S^2) materialization — with a
 dK/dV kernel (streaming Q innermost) and a dQ kernel (streaming K/V
 innermost).
 
-Layout: [batch, seq, heads, head_dim] (the reference's flash-attn layout).
-BlockSpecs index the 4-D arrays directly (squeezed batch/head dims), so
-there is no host-side transpose/reshape relayout.
+Layout: the public op takes [batch, seq, heads, head_dim] (the reference's
+flash-attn layout); internally the kernels run on [batch*heads, seq, d] so
+the block's trailing two dims are (seq_block, d) — Mosaic requires the last
+two block dims to be (8k, 128k) or equal to the array dims, which a
+squeezed head dim in second-to-last position violates.  The relayout is one
+XLA transpose each way, negligible next to the attention itself.
 
 Falls back to a fused XLA attention for masks, dropout, or shapes that
 don't tile.  On CPU the Pallas path can be exercised in interpreter mode
@@ -75,21 +78,33 @@ def _xla_attention(q, k, v, attn_mask=None, causal=False, scale=None,
 
 
 # ------------------------------------------------------------------
-# Pallas forward: grid (B, H, num_q, num_kv), K/V streamed by the grid
+# Pallas forward: grid (B*H, num_q, num_kv), K/V streamed by the grid
 # ------------------------------------------------------------------
+
+def _to_bh(x):
+    """[B, S, H, D] → [B*H, S, D] (head-major for Mosaic-legal tiling)."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_bh(y, b, h):
+    """[B*H, S, D] → [B, S, H, D]."""
+    _, s, d = y.shape
+    return y.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale, causal, block_q, block_k):
-    """One (b, h, q_block, kv_block) step of the online softmax.
+    """One (bh, q_block, kv_block) step of the online softmax.
 
     The kv grid axis is innermost: scratch (m, l, acc) carries the running
     max / normalizer / weighted sum across kv steps for a fixed q block.
     """
     from jax.experimental import pallas as pl
 
-    i = pl.program_id(2)
-    j = pl.program_id(3)
-    num_kv = pl.num_programs(3)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    num_kv = pl.num_programs(2)
 
     @pl.when(j == 0)
     def _init():
@@ -135,9 +150,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _causal_kv_spec(block_q, block_k, d, q_axis, kv_axis, causal):
-    """kv BlockSpec for a (…, q_idx, kv_idx)-style grid: on causal,
-    beyond-diagonal kv fetches clamp to the diagonal block (Mosaic dedupes
-    the repeated index, so the pl.when-skipped steps cost no HBM traffic).
+    """kv BlockSpec for a (bh, …) grid: on causal, beyond-diagonal kv
+    fetches clamp to the diagonal block (Mosaic dedupes the repeated
+    index, so the pl.when-skipped steps cost no HBM traffic).
     q_axis/kv_axis give the grid positions of the q and kv indices."""
     from jax.experimental import pallas as pl
 
@@ -146,8 +161,8 @@ def _causal_kv_spec(block_q, block_k, d, q_axis, kv_axis, causal):
         if causal:
             i = g[q_axis]
             j = jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
-        return (g[0], j, g[1], 0)
-    return pl.BlockSpec((None, block_k, None, d), index)
+        return (g[0], j, 0)
+    return pl.BlockSpec((None, block_k, d), index)
 
 
 def _causal_q_specs(block_q, block_k, d, q_axis, kv_axis, causal):
@@ -160,15 +175,9 @@ def _causal_q_specs(block_q, block_k, d, q_axis, kv_axis, causal):
         i = g[q_axis]
         if causal:
             i = jnp.maximum(i, (g[kv_axis] * block_k) // block_q)
-        return (g[0], i, g[1], 0)
-
-    def li(*g):
-        i = g[q_axis]
-        if causal:
-            i = jnp.maximum(i, (g[kv_axis] * block_k) // block_q)
-        return (g[0], g[1], i, 0)
-    return (pl.BlockSpec((None, block_q, None, d), qi),
-            pl.BlockSpec((None, None, block_q, 1), li))
+        return (g[0], i, 0)
+    return (pl.BlockSpec((None, block_q, d), qi),
+            pl.BlockSpec((None, block_q, 1), qi))
 
 
 def _pallas_flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
@@ -179,28 +188,26 @@ def _pallas_flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
     b, s, h, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
-    grid = (b, h, s // block_q, s // block_k)
+    grid = (b * h, s // block_q, s // block_k)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k)
-    qo_spec = pl.BlockSpec((None, block_q, None, d),
-                           lambda b_, h_, i, j: (b_, i, h_, 0))
-    kv_spec = _causal_kv_spec(block_q, block_k, d, q_axis=2, kv_axis=3,
+    qo_spec = pl.BlockSpec((None, block_q, d), lambda n, i, j: (n, i, 0))
+    kv_spec = _causal_kv_spec(block_q, block_k, d, q_axis=1, kv_axis=2,
                               causal=causal)
-    lse_spec = pl.BlockSpec((None, None, block_q, 1),
-                            lambda b_, h_, i, j: (b_, h_, i, 0))
+    lse_spec = pl.BlockSpec((None, block_q, 1), lambda n, i, j: (n, i, 0))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[qo_spec, kv_spec, kv_spec],
         out_specs=[qo_spec, lse_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, s, h, d), q.dtype),
-                   jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v)
-    return out, lse
+    )(_to_bh(q), _to_bh(k), _to_bh(v))
+    return _from_bh(out, b, h), lse.reshape(b, h, s, 1)
 
 
 # ------------------------------------------------------------------
@@ -210,13 +217,13 @@ def _pallas_flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
                     *, scale, causal, block_q, block_k):
-    """grid (B, H, num_kv, num_q): accumulate dK/dV for one kv block while
+    """grid (B*H, num_kv, num_q): accumulate dK/dV for one kv block while
     streaming q blocks.  p is recomputed per block from the saved lse."""
     from jax.experimental import pallas as pl
 
-    j = pl.program_id(2)   # kv block
-    i = pl.program_id(3)   # q block (innermost)
-    num_q = pl.num_programs(3)
+    j = pl.program_id(1)   # kv block
+    i = pl.program_id(2)   # q block (innermost)
+    num_q = pl.num_programs(2)
 
     @pl.when(i == 0)
     def _init():
@@ -266,13 +273,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr, *, scale, causal, block_q, block_k):
-    """grid (B, H, num_q, num_kv): accumulate dQ for one q block while
+    """grid (B*H, num_q, num_kv): accumulate dQ for one q block while
     streaming kv blocks."""
     from jax.experimental import pallas as pl
 
-    i = pl.program_id(2)   # q block
-    j = pl.program_id(3)   # kv block (innermost)
-    num_kv = pl.num_programs(3)
+    i = pl.program_id(1)   # q block
+    j = pl.program_id(2)   # kv block (innermost)
+    num_kv = pl.num_programs(2)
 
     @pl.when(j == 0)
     def _init():
@@ -321,47 +328,46 @@ def _pallas_flash_bwd(q, k, v, out, lse, dout, *, causal, scale,
     block_k = min(block_k, s)
     # delta_i = rowsum(dO_i * O_i): cheap elementwise+reduce, XLA fuses it
     delta = jnp.einsum("bshd,bshd->bhs", dout.astype(jnp.float32),
-                       out.astype(jnp.float32))[..., None]  # [B, H, S, 1]
+                       out.astype(jnp.float32)).reshape(b * h, s, 1)
+    q3, k3, v3, do3 = _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(dout)
+    lse3 = lse.reshape(b * h, s, 1)
 
     qo_spec_q, lse_spec_q = _causal_q_specs(block_q, block_k, d,
-                                            q_axis=3, kv_axis=2,
+                                            q_axis=2, kv_axis=1,
                                             causal=causal)
-    kv_spec_q = pl.BlockSpec((None, block_k, None, d),
-                             lambda b_, h_, j, i: (b_, j, h_, 0))
+    kv_spec_q = pl.BlockSpec((None, block_k, d), lambda n, j, i: (n, j, 0))
     dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
                                    causal=causal, block_q=block_q,
                                    block_k=block_k)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b, h, s // block_k, s // block_q),
+        grid=(b * h, s // block_k, s // block_q),
         in_specs=[qo_spec_q, kv_spec_q, kv_spec_q, qo_spec_q,
                   lse_spec_q, lse_spec_q],
         out_specs=[kv_spec_q, kv_spec_q],
-        out_shape=[jax.ShapeDtypeStruct((b, s, h, d), k.dtype),
-                   jax.ShapeDtypeStruct((b, s, h, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, dout, lse, delta)
+    )(q3, k3, v3, do3, lse3, delta)
 
-    qo_spec = pl.BlockSpec((None, block_q, None, d),
-                           lambda b_, h_, i, j: (b_, i, h_, 0))
-    kv_spec = _causal_kv_spec(block_q, block_k, d, q_axis=2, kv_axis=3,
+    qo_spec = pl.BlockSpec((None, block_q, d), lambda n, i, j: (n, i, 0))
+    kv_spec = _causal_kv_spec(block_q, block_k, d, q_axis=1, kv_axis=2,
                               causal=causal)
-    lse_spec = pl.BlockSpec((None, None, block_q, 1),
-                            lambda b_, h_, i, j: (b_, h_, i, 0))
+    lse_spec = pl.BlockSpec((None, block_q, 1), lambda n, i, j: (n, i, 0))
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                                   block_q=block_q, block_k=block_k)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(b, h, s // block_q, s // block_k),
+        grid=(b * h, s // block_q, s // block_k),
         in_specs=[qo_spec, kv_spec, kv_spec, qo_spec, lse_spec, lse_spec],
         out_specs=qo_spec,
-        out_shape=jax.ShapeDtypeStruct((b, s, h, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, dout, lse, delta)
-    return dq, dk, dv
+    )(q3, k3, v3, do3, lse3, delta)
+    return _from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h)
 
 
 # ------------------------------------------------------------------

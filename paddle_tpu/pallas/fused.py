@@ -24,12 +24,16 @@ from .flash_attention import _interpret, _on_tpu
 
 
 def _pick_block_rows(n_rows, n_cols, budget=1 << 21):
-    """Rows per grid step: keep x/g/out blocks within ~2MB of VMEM each."""
-    rows = max(8, budget // max(n_cols * 4, 1))
-    rows = min(rows, n_rows, 1024)
-    while n_rows % rows:
-        rows //= 2
-    return max(rows, 1)
+    """Rows per grid step: the largest 8·2^k divisor of n_rows that keeps
+    x/g/out blocks within ~2MB of VMEM each (Mosaic needs the sublane dim
+    to be a multiple of 8; callers guarantee n_rows % 8 == 0)."""
+    cap = max(8, min(budget // max(n_cols * 4, 1), n_rows, 1024))
+    if n_rows <= cap:
+        return n_rows  # single block (callers guarantee n_rows % 8 == 0)
+    rows = 8
+    while rows * 2 <= cap and n_rows % (rows * 2) == 0:
+        rows *= 2
+    return rows
 
 
 # ------------------------------------------------------------------
