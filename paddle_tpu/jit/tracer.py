@@ -223,7 +223,8 @@ _WARMUP = object()
 
 class _CompiledEntry:
     __slots__ = ("captures", "providers", "jitted", "mut_targets",
-                 "grad_targets", "out_struct", "host_reads", "guard_bools")
+                 "grad_targets", "out_struct", "host_reads", "guard_bools",
+                 "pure", "jitted_donate", "mut_idx")
 
     def __init__(self):
         self.captures = []
@@ -234,6 +235,9 @@ class _CompiledEntry:
         self.out_struct = None
         self.host_reads = []      # discovery-recorded (is_bool, value)
         self.guard_bools = ()     # the branch bits this entry specializes on
+        self.pure = None          # the traced body (shared by both jits)
+        self.jitted_donate = None  # donating variant, built after 1st run
+        self.mut_idx = None       # capture positions donated to XLA
 
 
 class _SigState:
@@ -409,16 +413,79 @@ class StaticFunction:
                                                     jax.core.Tracer):
                         t.grad = None
 
+        entry.pure = pure
         entry.jitted = jax.jit(pure, static_argnums=(3,))
 
+    def _build_donating(self, entry):
+        """Donating variant: the mutated captures (params, optimizer
+        moments, accumulated grads) are donated to XLA, so the update
+        aliases their buffers in place instead of holding old+new copies —
+        the in-place-update behavior the reference's executors get from
+        explicit inplace ops.  Only for guard-free entries: on a guard
+        mismatch the non-donating path discards outputs and keeps the
+        inputs, which donation makes impossible."""
+        mut_ids = {id(t) for t in entry.mut_targets}
+        entry.mut_idx = [i for i, t in enumerate(entry.captures)
+                         if id(t) in mut_ids]
+        mut_pos = {ci: k for k, ci in enumerate(entry.mut_idx)}
+        n_caps = len(entry.captures)
+        pure = entry.pure
+
+        def pure_donated(arg_arrays, mut_caps, const_caps, host_vals,
+                         arg_struct):
+            caps, ci = [], 0
+            for i in range(n_caps):
+                if i in mut_pos:
+                    caps.append(mut_caps[mut_pos[i]])
+                else:
+                    caps.append(const_caps[ci])
+                    ci += 1
+            return pure(arg_arrays, caps, host_vals, arg_struct)
+
+        entry.jitted_donate = jax.jit(pure_donated, static_argnums=(4,),
+                                      donate_argnums=(1,))
+
     def _run_compiled(self, key, state, args, kwargs, _depth=0):
+        from ..utils import flags as _flags
+
         entry = state.last
         arg_arrays, arg_struct = _flatten_args(args, kwargs)
         cap_arrays = [t._data_ for t in entry.captures]
         host_vals = [p() for p in entry.providers]
+        donate_ok = (not entry.guard_bools
+                     and _flags.flag("FLAGS_jit_donate_buffers", True))
         try:
-            out_arrays, mut_arrays, grad_arrays, guard_arrays = \
-                entry.jitted(arg_arrays, cap_arrays, host_vals, arg_struct)
+            if entry.jitted_donate is not None and donate_ok:
+                mut_set = set(entry.mut_idx)
+                mut_caps = [cap_arrays[i] for i in entry.mut_idx]
+                const_caps = [a for i, a in enumerate(cap_arrays)
+                              if i not in mut_set]
+                try:
+                    out_arrays, mut_arrays, grad_arrays, guard_arrays = \
+                        entry.jitted_donate(arg_arrays, mut_caps,
+                                            const_caps, host_vals,
+                                            arg_struct)
+                except GraphBreak:
+                    raise
+                except Exception as e:
+                    # the donated buffers may already be gone — unlike the
+                    # non-donating path, inputs cannot be preserved here
+                    if any(getattr(a, "is_deleted", lambda: False)()
+                           for a in mut_caps):
+                        raise RuntimeError(
+                            "compiled step failed after buffer donation; "
+                            "parameters/optimizer state backing this step "
+                            "are invalid — reload them from a checkpoint, "
+                            "or set FLAGS_jit_donate_buffers=False to "
+                            "trade memory for failure recovery") from e
+                    raise
+            else:
+                out_arrays, mut_arrays, grad_arrays, guard_arrays = \
+                    entry.jitted(arg_arrays, cap_arrays, host_vals,
+                                 arg_struct)
+                if (donate_ok and entry.jitted_donate is None
+                        and entry.mut_targets):
+                    self._build_donating(entry)
         except GraphBreak as e:
             # the program cannot represent this function — eager fallback
             # for this signature from now on (SOT piecewise-fallback analog)

@@ -772,12 +772,13 @@ def _softmax_xent_vjp_bwd(ignore_index, res, g):
     mask = label != ignore_index
     gm = jnp.where(mask, g, 0.0).astype(jnp.float32)
     p = jnp.exp(logits.astype(jnp.float32) - lse)
-    d = p * gm[..., None]
     lbl = jnp.clip(label, 0, logits.shape[-1] - 1).astype(jnp.int32)
-    d2 = d.reshape(-1, d.shape[-1])
-    d2 = d2.at[jnp.arange(d2.shape[0]), lbl.reshape(-1)].add(
-        -gm.reshape(-1))
-    return (d2.reshape(d.shape).astype(logits.dtype),
+    # (p - onehot) * g via a broadcasted-iota compare: pure elementwise,
+    # fuses into the exp — a row scatter here lowers to a serial loop on TPU
+    onehot = jax.lax.broadcasted_iota(
+        jnp.int32, p.shape, p.ndim - 1) == lbl[..., None]
+    d = (p - onehot.astype(jnp.float32)) * gm[..., None]
+    return (d.astype(logits.dtype),
             np.zeros(label.shape, dtype=jax.dtypes.float0))
 
 

@@ -20,6 +20,10 @@ _FLAGS: dict[str, Any] = {
     "FLAGS_profile": False,
     "FLAGS_amp_dtype": "bfloat16",
     "FLAGS_matmul_precision": "default",  # maps to jax.default_matmul_precision
+    # donate mutated captures (params/opt state) in compiled train steps so
+    # XLA updates them in place; disable if user code holds raw jax arrays
+    # of parameters across steps
+    "FLAGS_jit_donate_buffers": True,
 }
 
 

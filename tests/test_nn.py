@@ -150,6 +150,44 @@ def test_cross_entropy_ignore_index():
     assert float(loss) == pytest.approx(np.log(3), abs=1e-5)
 
 
+def test_cross_entropy_fused_matches_unfused_grad():
+    """The fused softmax-xent VJP (hard labels) must match the generic
+    log-softmax path for loss AND input gradient, incl. ignored rows."""
+    rng = np.random.default_rng(3)
+    x_np = rng.normal(size=(5, 7)).astype(np.float32)
+    lbl = paddle.to_tensor(np.array([0, 6, -100, 3, 2]))
+
+    x_f = paddle.to_tensor(x_np, stop_gradient=False)
+    loss_f = F.cross_entropy(x_f, lbl, ignore_index=-100)
+    loss_f.backward()
+
+    # force the generic path via label_smoothing=0-but-weighted trick:
+    # weight of ones is mathematically identity but disables fusion
+    x_u = paddle.to_tensor(x_np, stop_gradient=False)
+    loss_u = F.cross_entropy(x_u, lbl, ignore_index=-100,
+                             weight=paddle.ones([7]))
+    loss_u.backward()
+
+    assert float(loss_f) == pytest.approx(float(loss_u), rel=1e-5)
+    np.testing.assert_allclose(x_f.grad.numpy(), x_u.grad.numpy(),
+                               atol=1e-5)
+
+
+def test_cross_entropy_fused_bf16_lm_head_shape():
+    """bf16 logits (AMP O2 LM-head case): grad dtype tracks the input."""
+    rng = np.random.default_rng(4)
+    x = paddle.to_tensor(rng.normal(size=(2, 8, 16)).astype(np.float32))
+    x = x.astype("bfloat16")
+    x.stop_gradient = False
+    lbl = paddle.to_tensor(rng.integers(0, 16, (2, 8)).astype(np.int64))
+    loss = F.cross_entropy(x, lbl)
+    loss.backward()
+    assert str(x.grad.dtype) == "bfloat16"
+    # grad rows sum to ~0 (softmax minus one-hot is zero-sum per token)
+    sums = x.grad.numpy().astype(np.float32).sum(-1)
+    np.testing.assert_allclose(sums, np.zeros_like(sums), atol=0.05)
+
+
 def test_clip_grad_by_global_norm():
     p1 = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
     p2 = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
