@@ -94,13 +94,74 @@ class Engine:
         self._prepared = False
         self.history = {"loss": []}
 
+    def plan(self, global_batch=None, seq_len=None, n_devices=None,
+             device=None):
+        """Cost-based parallel planning (the reference's
+        rule_based_tuner/parallel_tuner step, static/tuner/): enumerate
+        dp×mp×pp×sharding factorizations of the device count, prune by HBM
+        capacity, rank with the roofline cost model, and install the best
+        config as the fleet strategy.  Call before prepare()/fit().
+
+        Returns the winning config dict (also stored on the strategy)."""
+        import jax
+
+        from ..auto_tuner.tuner import AutoTuner, TunerConfig
+        from ...cost_model import DEVICE_SPECS
+
+        n_dev = n_devices or jax.device_count()
+        if device is None:
+            plat = jax.devices()[0].platform
+            import os
+            device = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e") \
+                if plat in ("tpu", "axon") else "cpu"
+        if device not in DEVICE_SPECS:
+            device = "v5e"
+        # model statistics straight from the parameters — the planner is
+        # model-agnostic (no per-model hand formula).  hidden = the mode
+        # over all weight dims (the model width recurs in every norm/proj;
+        # FFN- and vocab-sized dims appear far less often); layer count
+        # from the standard 12·L·h² transformer budget.
+        from collections import Counter
+
+        params = list(self._model.parameters())
+        n_params = float(sum(int(np.prod(p.shape)) for p in params))
+        dim_counts = Counter(int(d) for p in params for d in p.shape
+                             if int(d) > 1)
+        hidden = dim_counts.most_common(1)[0][0] if dim_counts else 1024
+        n_layers = max(int(round(n_params / (12.0 * hidden * hidden))), 1)
+        cfg = TunerConfig(
+            n_devices=n_dev, device=device, n_params=n_params,
+            n_layers=n_layers, hidden=hidden,
+            global_batch=global_batch or 8 * n_dev,
+            seq_len=seq_len or 1024,
+            pp_candidates=[1],  # engine path is single-program SPMD
+        )
+        best = AutoTuner(cfg).tune(mode="predict")
+        if best is None:
+            best = {"dp": n_dev, "mp": 1, "pp": 1, "sharding": 1}
+        # write through to the inner DistributedStrategy: Strategy only
+        # forwards attribute READS, and fleet.init consumes the inner one
+        inner = self._strategy._inner if hasattr(self._strategy, "_inner") \
+            else self._strategy
+        inner.hybrid_configs = {
+            "dp_degree": best.get("dp", 1),
+            "mp_degree": best.get("mp", 1),
+            "pp_degree": best.get("pp", 1),
+            "sharding_degree": best.get("sharding", 1),
+        }
+        self._planned = {k: v for k, v in best.items()
+                         if not k.startswith("_")}
+        return self._planned
+
     def prepare(self, *args, **kwargs):
         """Commit model placements over the current mesh (the Completer+
         Partitioner step — here a single commit, GSPMD does the rest)."""
         from ..fleet import base as fleet_base
         if get_mesh() is None:
             from .. import fleet
-            fleet.init()
+            inner = getattr(self._strategy, "_inner", self._strategy)
+            fleet.init(strategy=inner
+                       if getattr(self, "_planned", None) else None)
         mesh = get_mesh()
         fleet_base._commit_params(self._model, mesh)
         if self._optimizer is not None:

@@ -21,6 +21,8 @@ from .sharding import (  # noqa: F401
     save_group_sharded_model, shard_parameters, shard_optimizer_states,
 )
 from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import recompute  # noqa: F401
 from .meta_parallel import (  # noqa: F401
     LayerDesc, SharedLayerDesc, PipelineLayer, PipelineParallel,
     PipelineParallelWithInterleave, TensorParallel, SegmentParallel,

@@ -1,0 +1,110 @@
+"""Fleet utils: activation recompute (reference:
+python/paddle/distributed/fleet/utils/__init__.py → recompute, backed by
+fleet/recompute/recompute.py).
+
+TPU-native realization: `jax.checkpoint` (remat) over the framework's op
+funnel.  The wrapped region runs as ONE tape op whose VJP re-runs the
+region's jaxpr instead of saving its intermediates — trading FLOPs for HBM,
+which on TPU is the standard lever for long-sequence / large-batch
+training (SURVEY §7: jax.checkpoint for rematerialisation).
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core import state as _state
+from ....core.dispatch import apply_op
+from ....core.tensor import Tensor
+
+
+def _collect_params(function):
+    """Parameters the recompute region must receive as differentiable
+    inputs: a Layer's own, plus Layers reachable through a bound method's
+    self, a functools.partial, or a closure (`recompute(lambda x:
+    block(x, mask), x)` must still train block's weights — anything the
+    region reads that is NOT an input becomes a constant)."""
+    import functools as _functools
+
+    from ....nn.layer import Layer
+
+    seen, out, stack = set(), [], [function]
+    while stack:
+        f = stack.pop()
+        if id(f) in seen:
+            continue
+        seen.add(id(f))
+        if isinstance(f, Layer):
+            for p in f.parameters():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    out.append(p)
+            continue
+        if isinstance(f, _functools.partial):
+            stack.append(f.func)
+            stack.extend(f.args)
+            stack.extend(f.keywords.values())
+            continue
+        self_obj = getattr(f, "__self__", None)
+        if self_obj is not None:
+            stack.append(self_obj)
+        for cell in getattr(f, "__closure__", None) or ():
+            try:
+                stack.append(cell.cell_contents)
+            except ValueError:
+                pass
+    return out
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    """Run ``function(*args, **kwargs)`` with activation checkpointing.
+
+    Forward executes normally; backward re-runs the region to reproduce
+    its intermediates rather than loading saved ones.  Gradients flow to
+    the Tensor leaves of ``args``/``kwargs`` AND to ``function``'s own
+    parameters when it is a ``Layer``.  Outputs must be a Tensor or a
+    (nested) tuple/list of Tensors.
+    """
+    params = _collect_params(function)
+    leaves, treedef = jax.tree.flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    tensor_pos = [i for i, leaf in enumerate(leaves)
+                  if isinstance(leaf, Tensor)]
+    in_tensors = [leaves[i] for i in tensor_pos] + params
+    n_args = len(tensor_pos)
+    out_box = {}
+
+    def raw(*arrays):
+        arg_arrays, param_arrays = arrays[:n_args], arrays[n_args:]
+        new_leaves = list(leaves)
+        for pos, arr in zip(tensor_pos, arg_arrays):
+            old = leaves[pos]
+            new_leaves[pos] = Tensor(arr, stop_gradient=old.stop_gradient)
+        new_args, new_kwargs = jax.tree.unflatten(treedef, new_leaves)
+        saved = [(p, p._data_) for p in params]
+        try:
+            for p, arr in zip(params, param_arrays):
+                p._data_ = arr
+            # inner ops execute functionally (traced by the outer vjp);
+            # the eager tape must not record them
+            with _state.no_grad():
+                out = function(*new_args, **new_kwargs)
+        finally:
+            for p, old in saved:
+                p._data_ = old
+        out_leaves, out_tree = jax.tree.flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        if not all(isinstance(leaf, Tensor) for leaf in out_leaves):
+            raise TypeError(
+                "recompute(function, ...) outputs must be Tensors "
+                f"(got {out_tree})")
+        out_box["tree"] = out_tree
+        return tuple(leaf._data_ for leaf in out_leaves)
+
+    fused = jax.checkpoint(raw, prevent_cse=True)
+    result = apply_op("recompute", fused, tuple(in_tensors))
+    outs = result if isinstance(result, tuple) else (result,)
+    return jax.tree.unflatten(out_box["tree"], list(outs))
+
+
+__all__ = ["recompute"]

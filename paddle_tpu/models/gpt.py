@@ -36,6 +36,7 @@ class GPTConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
     use_flash_attention: bool = True
+    use_recompute: bool = False       # activation checkpointing per block
 
     def __post_init__(self):
         if self.intermediate_size == 0:
@@ -147,7 +148,12 @@ class GPTModel(Layer):
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         for i, block in enumerate(self.h):
-            x = block(x, cache=None if caches is None else caches[i])
+            if self.config.use_recompute and caches is None \
+                    and not x.stop_gradient:
+                from ..distributed.fleet.utils import recompute
+                x = recompute(block, x)
+            else:
+                x = block(x, cache=None if caches is None else caches[i])
         return self.ln_f(x)
 
 

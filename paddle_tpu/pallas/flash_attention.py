@@ -401,7 +401,9 @@ def _pick_blocks(s, d):
     entry would truncate the grid and leave rows unwritten), then shape
     heuristics."""
     from .autotune import lookup
-    cached = lookup("flash_attention", (s, d))
+    # key versioned by objective: v1 entries were timed forward-only and
+    # must not short-circuit the fwd+bwd sweep
+    cached = lookup("flash_attention.fwdbwd", (s, d))
     if cached is not None and len(cached) == 2:
         bq, bk = int(cached[0]), int(cached[1])
         if 0 < bq <= s and 0 < bk <= s and s % bq == 0 and s % bk == 0:
@@ -413,7 +415,9 @@ def _pick_blocks(s, d):
 
 def autotune_blocks(s, d, dtype=jnp.bfloat16, batch=1, heads=1):
     """Timed sweep over divisor block sizes for (seq, head_dim); caches
-    the winner (reference: phi/kernels/autotune switch_autotune.h)."""
+    the winner (reference: phi/kernels/autotune switch_autotune.h).
+    Times forward AND backward together — the training step runs both,
+    and the dkv/dq kernels prefer different shapes than the forward."""
     from . import autotune as at
 
     cands = [(bq, bk)
@@ -425,11 +429,13 @@ def autotune_blocks(s, d, dtype=jnp.bfloat16, batch=1, heads=1):
     q = jax.random.normal(key, (batch, s, heads, d), dtype)
 
     def run(cfg):
-        out, _ = _pallas_flash_fwd(q, q, q, causal=True, scale=1.0,
-                                   block_q=cfg[0], block_k=cfg[1])
-        jax.block_until_ready(out)
+        def fwd(q, k, v):
+            return jnp.sum(_flash_core(q, k, v, True, 1.0 / math.sqrt(d),
+                                       cfg[0], cfg[1]).astype(jnp.float32))
+        out, grads = jax.value_and_grad(fwd, argnums=(0, 1, 2))(q, q, q)
+        jax.block_until_ready(grads)
 
-    return at.sweep("flash_attention", (s, d), cands, run)
+    return at.sweep("flash_attention.fwdbwd", (s, d), cands, run)
 
 
 def _supports_pallas(q, k, v, attn_mask, dropout):
