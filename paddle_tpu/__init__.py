@@ -65,15 +65,57 @@ from .tensor_ops.search import (  # noqa: F401
     argmax, argmin, argsort, sort, topk, kthvalue, mode, nonzero, where,
     searchsorted, bucketize,
 )
+from .tensor_ops.extra import (  # noqa: F401
+    addmm, asinh, acosh, atanh, cdist, logaddexp, logcumsumexp, nanmedian,
+    nanquantile, digamma, lgamma, polygamma, i0, i0e, i1, i1e, ldexp,
+    frexp, nextafter, sgn, renorm, trapezoid, cumulative_trapezoid,
+    cummin, vander, floor_mod, mm, reverse, take, unflatten, unstack,
+    vsplit, crop, as_strided, view, view_as, unique_consecutive,
+    shard_index, increment, is_tensor, is_complex, is_floating_point,
+    is_integer, numel, rank, shape, tolist, broadcast_shape,
+    set_printoptions, disable_signal_handler, check_shape, batch,
+    LazyGuard, create_parameter, get_rng_state, set_rng_state,
+    get_cuda_rng_state, set_cuda_rng_state, CPUPlace, CUDAPlace,
+    CUDAPinnedPlace,
+)
 from .tensor_ops.random import (  # noqa: F401
     rand, randn, standard_normal, normal, uniform, randint, randint_like,
     randperm, multinomial, bernoulli, poisson, rand_like, randn_like,
 )
 
+# inplace variants (`tanh_` …): generated from the assembled namespace,
+# then re-exported flat plus installed as Tensor methods below
+from .tensor_ops import inplace as _inplace_mod  # noqa: E402
+from .tensor_ops.inplace import (  # noqa: F401,E402
+    normal_, uniform_, cauchy_, geometric_, exponential_,
+)
+
+for _n, _f in _inplace_mod._GENERATED.items():
+    globals()[_n] = _f
+
 # install Tensor methods now that ops exist
 from .core.tensor import _install_methods as _im
 _im()
 del _im
+
+# inplace + extra ops as Tensor methods (x.tanh_(), x.tolist(), …)
+from .tensor_ops import extra as _extra_mod  # noqa: E402
+
+for _n in list(_inplace_mod._GENERATED) + [
+        "normal_", "uniform_", "cauchy_", "geometric_", "exponential_"]:
+    if not hasattr(Tensor, _n):
+        setattr(Tensor, _n, getattr(_inplace_mod, _n))
+for _n in ("addmm", "asinh", "acosh", "atanh", "cdist", "logaddexp",
+           "logcumsumexp", "nanmedian", "nanquantile", "digamma",
+           "lgamma", "polygamma", "i0", "i0e", "i1", "i1e", "ldexp",
+           "frexp", "nextafter", "sgn", "renorm", "trapezoid",
+           "cumulative_trapezoid", "cummin", "vander", "floor_mod",
+           "reverse", "take", "unflatten", "unstack", "vsplit",
+           "unique_consecutive", "tolist", "is_complex",
+           "is_floating_point", "is_integer"):
+    if not hasattr(Tensor, _n):
+        setattr(Tensor, _n, getattr(_extra_mod, _n))
+del _n, _f
 
 # ---- subpackages (paddle-style namespaces) ----
 from . import nn  # noqa: F401,E402
@@ -110,6 +152,72 @@ from .ops.flops import attach_all as _attach_flops  # noqa: E402
 _attach_flops()
 from .hapi import Model  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
+from .nn.initializer import ParamAttr  # noqa: F401,E402
+from .core.dtype import bool_ as bool  # noqa: F401,E402,A001
+from .core.dtype import convert_dtype as _convert_dtype  # noqa: E402
+
+# paddle.dtype: the type callers isinstance-check / call to coerce names
+import jax.numpy as _jnp  # noqa: E402
+dtype = _jnp.dtype  # noqa: A001
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Model FLOPs from the registry-metadata counter (reference:
+    paddle.flops → hapi/dynamic_flops.py)."""
+    import numpy as _np
+    from .profiler import count_flops
+    from .core.tensor import Tensor as _T
+
+    x = _T(_jnp.asarray(_np.zeros(input_size, _np.float32)))
+    _, fc = count_flops(net, x)
+    total = int(fc.forward_flops)
+    if print_detail:
+        for name, fl in sorted(fc.by_op.items(), key=lambda kv: -kv[1]):
+            print(f"{name:30s} {fl:>16,}")
+        print(f"{'total':30s} {total:>16,}")
+    return total
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    """Layer-by-layer parameter summary (reference: paddle.summary →
+    hapi/model_summary.py)."""
+    import builtins
+    import numpy as _np
+
+    rows = []
+    own = builtins.sum(int(_np.prod(p.shape)) for p in
+                       net.parameters(include_sublayers=False))
+    if own:
+        rows.append(("(root)", type(net).__name__, own))
+    for name, layer in net.named_sublayers():
+        n = builtins.sum(int(_np.prod(p.shape)) for p in
+                         layer.parameters(include_sublayers=False))
+        if n == 0:
+            continue
+        rows.append((name, type(layer).__name__, n))
+    # totals from the full parameter set — rows are a breakdown, not the
+    # source of truth (sublayer iteration can miss root-owned params)
+    total = builtins.sum(int(_np.prod(p.shape)) for p in net.parameters())
+    trainable = builtins.sum(
+        int(_np.prod(p.shape)) for p in net.parameters()
+        if not p.stop_gradient)
+    header = f"{'Layer':34s}{'Type':22s}{'Params':>14s}"
+    lines = [header, "-" * len(header)]
+    lines += [f"{n[:33]:34s}{t[:21]:22s}{c:>14,}" for n, t, c in rows]
+    lines += ["-" * len(header),
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}"]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def __getattr__(name):
+    # heavy/circular-at-import symbols resolved lazily (PEP 562)
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel
+        return DataParallel
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 
 def in_dynamic_mode():

@@ -27,7 +27,13 @@ def _collect_params(function):
 
     from ....nn.layer import Layer
 
-    seen, out, stack = set(), [], [function]
+    seen, param_ids, out, stack = set(), set(), [], [function]
+
+    def _add(p):
+        if id(p) not in param_ids:
+            param_ids.add(id(p))
+            out.append(p)
+
     while stack:
         f = stack.pop()
         if id(f) in seen:
@@ -35,9 +41,13 @@ def _collect_params(function):
         seen.add(id(f))
         if isinstance(f, Layer):
             for p in f.parameters():
-                if id(p) not in seen:
-                    seen.add(id(p))
-                    out.append(p)
+                _add(p)
+            continue
+        if isinstance(f, Tensor):
+            # a bare Parameter captured directly (closure cell, partial
+            # arg) must become a differentiable input too
+            if not f.stop_gradient:
+                _add(f)
             continue
         if isinstance(f, _functools.partial):
             stack.append(f.func)
@@ -52,6 +62,15 @@ def _collect_params(function):
                 stack.append(cell.cell_contents)
             except ValueError:
                 pass
+        code = getattr(f, "__code__", None)
+        f_globals = getattr(f, "__globals__", None)
+        if code is not None and f_globals is not None:
+            # globals the code actually names (a module-level model used
+            # inside the function is not a closure cell)
+            for gname in code.co_names:
+                val = f_globals.get(gname)
+                if isinstance(val, (Layer, Tensor)):
+                    stack.append(val)
     return out
 
 

@@ -1,0 +1,504 @@
+"""Long-tail tensor ops completing the reference's top-level `paddle.*`
+surface (reference: python/paddle/tensor/math.py, manipulation.py,
+creation.py — the symbols its `python/paddle/__init__.py` exports that the
+core modules here don't cover).
+
+Everything gradient-relevant goes through @defop so the tape, AMP hooks,
+FLOPs counter, and NaN/Inf scanning all apply.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import defop, apply_op
+from ..core.tensor import Tensor
+
+
+# ------------------------------------------------------------------
+# math
+# ------------------------------------------------------------------
+
+@defop("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """beta*input + alpha*(x @ y) (reference: tensor/math.py addmm)."""
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@defop("asinh")
+def asinh(x, name=None):
+    return jnp.arcsinh(x)
+
+
+@defop("acosh")
+def acosh(x, name=None):
+    return jnp.arccosh(x)
+
+
+@defop("atanh")
+def atanh(x, name=None):
+    return jnp.arctanh(x)
+
+
+@defop("cdist")
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-norm distances, [..., M, D] × [..., N, D] → [..., M, N]."""
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        d2 = jnp.sum(diff * diff, axis=-1)
+        # zero-subgradient at coincident points: sqrt'(0) is inf, so mask
+        # the argument before sqrt (the standard double-where trick)
+        safe = jnp.where(d2 > 0, d2, 1.0)
+        return jnp.where(d2 > 0, jnp.sqrt(safe), 0.0)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    if p == 0:
+        return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+@defop("logaddexp")
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(x, y)
+
+
+@defop("logcumsumexp")
+def logcumsumexp(x, axis=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+@defop("nanmedian")
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+@defop("nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdim)
+
+
+@defop("digamma")
+def digamma(x, name=None):
+    return jax.scipy.special.digamma(x)
+
+
+@defop("lgamma")
+def lgamma(x, name=None):
+    return jax.scipy.special.gammaln(x)
+
+
+@defop("polygamma")
+def polygamma(x, n, name=None):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@defop("i0")
+def i0(x, name=None):
+    return jax.scipy.special.i0(x)
+
+
+@defop("i0e")
+def i0e(x, name=None):
+    return jax.scipy.special.i0e(x)
+
+
+@defop("i1")
+def i1(x, name=None):
+    return jax.scipy.special.i1(x)
+
+
+@defop("i1e")
+def i1e(x, name=None):
+    return jax.scipy.special.i1e(x)
+
+
+@defop("ldexp")
+def ldexp(x, y, name=None):
+    return (x * jnp.exp2(y.astype(jnp.float32))).astype(
+        jnp.result_type(x.dtype, jnp.float32)
+        if jnp.issubdtype(x.dtype, jnp.integer) else x.dtype)
+
+
+@defop("frexp", nondiff=True)
+def frexp(x, name=None):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+@defop("nextafter", nondiff=True)
+def nextafter(x, y, name=None):
+    return jnp.nextafter(x, y)
+
+
+@defop("sgn")
+def sgn(x, name=None):
+    """Complex-aware sign: x/|x| for complex, sign(x) for real."""
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.maximum(mag, 1e-38))
+    return jnp.sign(x)
+
+
+@defop("renorm")
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp the p-norm of every slice along `axis` to max_norm
+    (reference: tensor/math.py renorm)."""
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=reduce_axes,
+                    keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm,
+                       max_norm / jnp.maximum(norms, 1e-12), 1.0)
+    return x * factor
+
+
+@defop("trapezoid")
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+@defop("cumulative_trapezoid")
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y0 = jnp.take(y, jnp.arange(y.shape[axis] - 1), axis=axis)
+    y1 = jnp.take(y, jnp.arange(1, y.shape[axis]), axis=axis)
+    if x is not None:
+        x0 = jnp.take(x, jnp.arange(x.shape[axis] - 1), axis=axis)
+        x1 = jnp.take(x, jnp.arange(1, x.shape[axis]), axis=axis)
+        steps = x1 - x0
+    else:
+        steps = 1.0 if dx is None else dx
+    return jnp.cumsum((y0 + y1) * steps / 2.0, axis=axis)
+
+
+@defop("cummin")
+def cummin(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummin(x, axis=axis)
+    iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
+    inds = jax.lax.cummax(jnp.where(x == vals, iota, -1), axis=axis)
+    return vals, inds.astype(dtype)
+
+
+@defop("vander")
+def vander(x, n=None, increasing=False, name=None):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def floor_mod(x, y, name=None):
+    from . import math as M
+    return M.mod(x, y)
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    from . import linalg as L
+    return L.matmul(input, mat2)
+
+
+def reverse(x, axis, name=None):
+    from . import manipulation as MA
+    return MA.flip(x, axis)
+
+
+@defop("logit")
+def _logit_base(x, eps=None, name=None):
+    xc = jnp.clip(x, eps, 1.0 - eps) if eps else x
+    return jnp.log(xc / (1.0 - xc))
+
+
+# ------------------------------------------------------------------
+# manipulation
+# ------------------------------------------------------------------
+
+@defop("take")
+def take(x, index, mode="raise", name=None):
+    """Gather from the FLATTENED tensor; result has index's shape."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    idx = index
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    else:  # 'raise' can't raise inside traced code; clamp like paddle's
+        # clip mode after resolving python-style negative indices
+        idx = jnp.where(idx < 0, idx + n, idx)
+        idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(flat, idx)
+
+
+@defop("unflatten")
+def unflatten(x, axis, shape, name=None):
+    axis = axis % x.ndim
+    new_shape = x.shape[:axis] + tuple(shape) + x.shape[axis + 1:]
+    return x.reshape(new_shape)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    from . import manipulation as MA
+    return MA.unbind(x, axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    from . import manipulation as MA
+    if x.ndim < 2:
+        raise ValueError("vsplit expects ndim >= 2")
+    return MA.split(x, num_or_indices, axis=0)
+
+
+@defop("crop")
+def crop(x, shape=None, offsets=None, name=None):
+    shape = list(shape) if shape is not None else list(x.shape)
+    shape = [x.shape[i] if s in (-1, None) else s
+             for i, s in enumerate(shape)]
+    offsets = list(offsets) if offsets is not None else [0] * x.ndim
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+@defop("as_strided")
+def as_strided(x, shape, stride, offset=0, name=None):
+    """General strided view as a gather over computed flat indices
+    (no aliasing on an immutable-array backend)."""
+    flat = x.reshape(-1)
+    idx = np.zeros(tuple(shape), dtype=np.int64) + offset
+    for dim, (s, st) in enumerate(zip(shape, stride)):
+        ix = np.arange(s) * st
+        idx = idx + ix.reshape((-1,) + (1,) * (len(shape) - dim - 1))
+    return jnp.take(flat, jnp.asarray(idx))
+
+
+def view(x, shape_or_dtype, name=None):
+    from . import manipulation as MA
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return MA.reshape(x, shape_or_dtype)
+    return _bitcast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    from . import manipulation as MA
+    return MA.reshape(x, other.shape)
+
+
+@defop("bitcast_view", nondiff=True)
+def _bitcast(x, dtype, name=None):
+    """Reinterpret bytes with paddle.view's shape rule: the LAST dim
+    scales by the itemsize ratio (never gains/loses a trailing axis)."""
+    from ..core.dtype import convert_dtype
+    jdt = jnp.dtype(convert_dtype(dtype))
+    src = jnp.dtype(x.dtype).itemsize
+    dst = jdt.itemsize
+    if src == dst:
+        return jax.lax.bitcast_convert_type(x, jdt)
+    if dst < src:
+        # narrowing: bitcast appends a ratio-sized axis — fold into last
+        out = jax.lax.bitcast_convert_type(x, jdt)
+        return out.reshape(x.shape[:-1] + (x.shape[-1] * (src // dst),))
+    ratio = dst // src
+    if x.shape[-1] % ratio:
+        raise ValueError(
+            f"view: last dim {x.shape[-1]} not divisible by itemsize "
+            f"ratio {ratio} for {x.dtype} -> {jdt}")
+    grouped = x.reshape(x.shape[:-1] + (x.shape[-1] // ratio, ratio))
+    return jax.lax.bitcast_convert_type(grouped, jdt)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    """Eager-only (data-dependent output shape), like the reference's
+    dynamic-shape ops."""
+    arr = np.asarray(x._data_ if isinstance(x, Tensor) else x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        change = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        moved = np.moveaxis(arr, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        change = np.concatenate([[True],
+                                 np.any(flat[1:] != flat[:-1], axis=1)])
+    idx = np.nonzero(change)[0]
+    out = arr[change] if axis is None else np.moveaxis(
+        np.moveaxis(arr, axis, 0)[change], 0, axis)
+    results = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(change) - 1
+        results.append(Tensor(jnp.asarray(inv.astype(dtype))))
+    if return_counts:
+        counts = np.diff(np.append(idx, len(change)))
+        results.append(Tensor(jnp.asarray(counts.astype(dtype))))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+@defop("shard_index", nondiff=True)
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,  # noqa: A002
+                name=None):
+    """Map global label ids to shard-local ids (reference:
+    tensor/manipulation.py shard_index; used by sharded classifiers)."""
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (input // shard_size) == shard_id
+    return jnp.where(in_shard, input % shard_size, ignore_value)
+
+
+def increment(x, value=1.0, name=None):
+    """In-place add of a scalar (static-graph op in the reference) —
+    same leaf-protection and rebind contract as the generated `<op>_`s."""
+    from . import math as M
+    from .inplace import _make_inplace
+    return _make_inplace(
+        lambda t: M.add(t, Tensor(jnp.asarray(value, dtype=t.dtype))),
+        "increment_")(x)
+
+
+# ------------------------------------------------------------------
+# utility / introspection
+# ------------------------------------------------------------------
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x.dtype, jnp.integer)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.ndim else 1))
+
+
+def rank(x, name=None):
+    return Tensor(jnp.asarray(x.ndim))
+
+
+def shape(x, name=None):
+    """Tensor-valued shape (the reference returns an int32 1-D Tensor)."""
+    return Tensor(jnp.asarray(x.shape, jnp.int32))
+
+
+def tolist(x):
+    return np.asarray(x._data_ if isinstance(x, Tensor) else x).tolist()
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """No-op: the reference unhooks its C++ signal handlers; there are
+    none in this runtime."""
+
+
+def check_shape(shape):
+    """Legacy shape validation helper."""
+    for d in shape:
+        if d is not None and d < -1:
+            raise ValueError(f"invalid dim {d} in shape {shape}")
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader decorator (reference: paddle.batch)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+class LazyGuard:
+    """Context that defers parameter initialization to first use
+    (reference: paddle.LazyGuard).  On this functional backend parameter
+    arrays are built lazily by jax anyway; the guard is a compatibility
+    scope marker."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..core.dtype import convert_dtype
+    from ..core.tensor import Parameter
+    from ..nn.initializer import Constant, XavierNormal
+
+    init = default_initializer or (Constant(0.0) if is_bias
+                                   else XavierNormal())
+    arr = init._init(tuple(shape), convert_dtype(dtype))
+    p = Parameter(arr)
+    if name:
+        p.name = name
+    return p
+
+
+# rng-state surface (reference: paddle.get_rng_state/set_rng_state; the
+# cuda variants alias the same state on a single-runtime backend)
+def get_rng_state(device=None):
+    from ..core import state as _state
+    return [np.asarray(_state.STATE.rng_key)]
+
+
+def set_rng_state(state_list, device=None):
+    from ..core import state as _state
+    _state.STATE.rng_key = jnp.asarray(state_list[0])
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state_list):
+    set_rng_state(state_list)
+
+
+class CPUPlace:
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class CUDAPlace:
+    """Compatibility place: maps onto the TPU/default device."""
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(accelerator:{self.device_id})"
+
+
+class CUDAPinnedPlace:
+    def __repr__(self):
+        return "Place(pinned)"

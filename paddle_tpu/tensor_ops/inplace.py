@@ -1,0 +1,150 @@
+"""Inplace op variants (`paddle.tanh_`, `x.add_(y)`, …).
+
+Reference: the `<op>_` functions generated into python/paddle/tensor/*
+(backed by real inplace kernels + inplace-version checks in the eager
+engine, paddle/fluid/eager/tensor_wrapper.h).
+
+TPU-native realization: jax arrays are immutable, so `foo_(x, ...)`
+computes `foo(x, ...)`, rebinds x's storage to the result, and carries the
+result's grad node onto x — the observable contract (returns x, x holds
+the new value, autograd sees the op) is preserved; what's lost is only the
+buffer aliasing, which XLA's donation handles where it matters.
+
+Random fills (`normal_`, `uniform_`, `cauchy_`, `geometric_`,
+`exponential_`) are defined explicitly below.
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from ..core import state as _state
+from ..core.tensor import Tensor
+
+
+def _rebind(x, y):
+    x._data_ = y._data_
+    x._grad_node = y._grad_node
+    x._out_index = y._out_index
+    x.stop_gradient = y.stop_gradient
+    return x
+
+
+def _make_inplace(base_fn, name):
+    def inplace(x, *args, **kwargs):
+        if (_state.STATE.grad_enabled and not x.stop_gradient
+                and x._grad_node is None):
+            # same contract as the reference/torch: version-counted
+            # in-place on a grad-requiring leaf breaks autograd
+            raise RuntimeError(
+                f"{name}: a leaf Tensor that requires grad cannot be "
+                "used in an in-place operation (wrap in paddle.no_grad() "
+                "for data-only updates)")
+        # snapshot carries the PRE-rebind grad node: the new op's node
+        # must chain to the old history, not to itself after the rebind
+        snap = Tensor(x._data_, stop_gradient=x.stop_gradient)
+        snap._grad_node = x._grad_node
+        snap._out_index = x._out_index
+        return _rebind(x, base_fn(snap, *args, **kwargs))
+    inplace.__name__ = name
+    inplace.__doc__ = f"Inplace variant of `{name[:-1]}` (rebinds x)."
+    return inplace
+
+
+# base ops whose `<name>_` variant the reference exports at top level
+_INPLACE_BASES = [
+    "abs", "acos", "acosh", "addmm", "asin", "asinh", "atan", "atanh",
+    "bitwise_and", "bitwise_not", "bitwise_or", "bitwise_xor", "cast",
+    "ceil", "clip", "cos", "cosh", "cumprod", "cumsum", "digamma",
+    "divide", "equal", "erf", "exp", "expm1", "fill", "flatten", "floor",
+    "floor_divide", "floor_mod", "frac", "gcd", "greater_equal",
+    "greater_than", "i0", "lcm", "ldexp", "less_equal", "less_than",
+    "lerp", "lgamma", "log", "log10", "log1p", "log2", "logical_and",
+    "logical_not", "logical_or", "logical_xor", "logit", "mod",
+    "multiply", "nan_to_num", "neg", "not_equal", "polygamma", "pow",
+    "reciprocal", "remainder", "renorm", "round", "rsqrt", "scale",
+    "scatter", "sigmoid", "sign", "sin", "sinh", "sqrt", "square",
+    "squeeze", "subtract", "t", "tan", "tanh", "transpose", "tril",
+    "triu", "trunc", "unsqueeze", "where", "zero",
+]
+
+
+def _install():
+    """Generate `<base>_` functions for every base available in the
+    assembled tensor_ops namespace; returns the generated mapping."""
+    from . import (math, manipulation, linalg, reduction, logic, search,
+                   creation, extra)
+    sources = [math, manipulation, linalg, reduction, logic, search,
+               creation, extra]
+    mod = sys.modules[__name__]
+    made = {}
+    for base in _INPLACE_BASES:
+        fn = None
+        for src in sources:
+            fn = getattr(src, base, None)
+            if fn is not None:
+                break
+        if fn is None:
+            continue
+        name = base + "_"
+        wrapper = _make_inplace(fn, name)
+        setattr(mod, name, wrapper)
+        made[name] = wrapper
+    return made
+
+
+# ------------------------------------------------------------------
+# random fills (no out-of-place base with this signature)
+# ------------------------------------------------------------------
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    """Fill x with N(mean, std) samples (reference: Tensor.normal_)."""
+    key = _state.next_rng_key()
+    arr = mean + std * jax.random.normal(key, tuple(x.shape), jnp.float32)
+    x._data_ = arr.astype(x.dtype)
+    x._grad_node = None
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = _state.next_rng_key() if seed == 0 else jax.random.PRNGKey(seed)
+    arr = jax.random.uniform(key, tuple(x.shape), jnp.float32,
+                             minval=min, maxval=max)
+    x._data_ = arr.astype(x.dtype)
+    x._grad_node = None
+    return x
+
+
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    key = _state.next_rng_key()
+    u = jax.random.uniform(key, tuple(x.shape), jnp.float32,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    arr = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+    x._data_ = arr.astype(x.dtype)
+    x._grad_node = None
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """Fill with Geometric(probs) samples (number of Bernoulli trials
+    until first success, support {1, 2, ...})."""
+    key = _state.next_rng_key()
+    u = jax.random.uniform(key, tuple(x.shape), jnp.float32,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    arr = jnp.floor(jnp.log(u) / jnp.log1p(-probs)) + 1.0
+    x._data_ = arr.astype(x.dtype)
+    x._grad_node = None
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = _state.next_rng_key()
+    arr = jax.random.exponential(key, tuple(x.shape), jnp.float32) / lam
+    x._data_ = arr.astype(x.dtype)
+    x._grad_node = None
+    return x
+
+
+_GENERATED = _install()

@@ -80,9 +80,10 @@ def cummax(x, axis=None, dtype="int64", name=None):
         x = x.reshape(-1)
         axis = 0
     vals = jax.lax.cummax(x, axis=axis)
-    inds = jnp.argmax(
-        jnp.cumsum(jnp.ones_like(x, dtype=jnp.int32), axis=axis) *
-        (x == vals), axis=axis)
+    # per-prefix argmax: each position where the running max is (re)set
+    # contributes its own index; carry the latest such index forward
+    iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
+    inds = jax.lax.cummax(jnp.where(x == vals, iota, -1), axis=axis)
     return vals, inds.astype(dtype)
 
 
