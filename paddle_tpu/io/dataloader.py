@@ -127,6 +127,9 @@ class DataLoader:
         ctx = mp.get_context("fork")
 
         def worker(worker_id):
+            from . import worker_info as _wi
+            _wi._WORKER_INFO = _wi.WorkerInfo(
+                id=worker_id, num_workers=nw, dataset=self.dataset)
             if self.worker_init_fn is not None:
                 self.worker_init_fn(worker_id)
             try:

@@ -22,7 +22,9 @@ class IterableDataset(Dataset):
         raise RuntimeError("IterableDataset does not support indexing")
 
     def __len__(self):
-        raise RuntimeError("IterableDataset has no len()")
+        # TypeError (not RuntimeError): operator.length_hint — which
+        # list()/tuple() call — treats TypeError as "no length"
+        raise TypeError("IterableDataset has no len()")
 
 
 class TensorDataset(Dataset):
@@ -82,3 +84,39 @@ def random_split(dataset, lengths, generator=None):
         out.append(Subset(dataset, perm[offset:offset + l].tolist()))
         offset += l
     return out
+
+
+class ChainDataset(IterableDataset):
+    """Chain IterableDatasets end-to-end (reference:
+    io/dataloader/dataset.py ChainDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for ds in self.datasets:
+            yield from ds
+
+
+class ComposeDataset(Dataset):
+    """Zip map-style datasets field-wise (reference: ComposeDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert self.datasets, "ComposeDataset needs at least one dataset"
+        n = len(self.datasets[0])
+        for ds in self.datasets:
+            assert len(ds) == n, "datasets must share length"
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for ds in self.datasets:
+            item = ds[idx]
+            if isinstance(item, (list, tuple)):
+                out.extend(item)
+            else:
+                out.append(item)
+        return tuple(out)

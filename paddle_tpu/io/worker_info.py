@@ -1,0 +1,22 @@
+"""Worker introspection (reference: io/dataloader/worker.py
+get_worker_info): inside a DataLoader worker process it describes the
+worker; in the main process it returns None."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class WorkerInfo:
+    id: int  # noqa: A003
+    num_workers: int
+    dataset: Any = None
+    seed: int = 0
+
+
+_WORKER_INFO = None
+
+
+def get_worker_info():
+    return _WORKER_INFO

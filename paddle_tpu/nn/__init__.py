@@ -26,3 +26,27 @@ from .rnn import (  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm, clip_grad_norm_,
 )
+from .layers_extra import (  # noqa: F401
+    MaxPool1D, MaxPool3D, AvgPool1D, AvgPool3D, AdaptiveAvgPool1D,
+    AdaptiveAvgPool3D, AdaptiveMaxPool1D, AdaptiveMaxPool2D,
+    AdaptiveMaxPool3D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D, Conv3D,
+    Conv1DTranspose, Conv3DTranspose, InstanceNorm1D, InstanceNorm2D,
+    InstanceNorm3D, LocalResponseNorm, SpectralNorm, BatchNorm,
+    SyncBatchNorm, Fold, Unflatten, PixelShuffle, PixelUnshuffle,
+    ChannelShuffle, Pad1D, Pad3D, ZeroPad2D, UpsamplingBilinear2D,
+    UpsamplingNearest2D, Softmax2D, AlphaDropout, Dropout3D,
+    CosineSimilarity, PairwiseDistance, Bilinear, Maxout, CTCLoss,
+    RNNTLoss, GaussianNLLLoss, PoissonNLLLoss, SoftMarginLoss,
+    MultiLabelSoftMarginLoss, MultiMarginLoss, CosineEmbeddingLoss,
+    HingeEmbeddingLoss, TripletMarginLoss, TripletMarginWithDistanceLoss,
+    HSigmoidLoss, Unfold,
+)
+from .layers_common import _act_layer as _al  # noqa: E402
+CELU = _al("celu")
+Hardtanh = _al("hardtanh")
+LogSigmoid = _al("log_sigmoid")
+RReLU = _al("rrelu")
+Swish = _al("swish")
+ThresholdedReLU = _al("thresholded_relu")
+del _al
+from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401,E402

@@ -1,0 +1,157 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode (reference:
+python/paddle/nn/decode.py — Decoder/BeamSearchDecoder over an RNN cell,
+driven step-by-step by dynamic_decode).
+
+TPU note: the decode loop is host-driven (eager) like the reference's
+dygraph path; each step's tensor work is ordinary ops, so under
+`to_static` the per-step body compiles once and replays."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..tensor_ops import creation, manipulation
+from . import functional as F
+
+
+class Decoder:
+    """Abstract decoder interface (reference: nn/decode.py Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over a cell (reference: nn/decode.py
+    BeamSearchDecoder)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] → [batch*beam, ...] (repeat each row beam times)."""
+        from ..tensor_ops.manipulation import repeat_interleave
+        return repeat_interleave(x, beam_size, axis=0)
+
+    def _merge(self, x):
+        return x.reshape([-1] + list(x.shape[2:]))
+
+    def _split(self, x, batch):
+        return x.reshape([batch, self.beam_size] + list(x.shape[1:]))
+
+    def initialize(self, initial_cell_states):
+        states = initial_cell_states
+        leaves = states if isinstance(states, (list, tuple)) else [states]
+        batch = leaves[0].shape[0]
+        self._batch = batch
+        tiled = [self.tile_beam_merge_with_batch(s, self.beam_size)
+                 for s in leaves]
+        start = creation.full([batch * self.beam_size], self.start_token,
+                              dtype="int64")
+        # log-prob 0 for beam 0, -inf for the rest so step 1 is unique
+        lp = np.full((batch, self.beam_size), -1e9, np.float32)
+        lp[:, 0] = 0.0
+        beam_state = {
+            "cell_states": tiled if isinstance(states, (list, tuple))
+            else tiled[0],
+            "log_probs": Tensor(jnp.asarray(lp)),
+            "finished": Tensor(jnp.zeros((batch, self.beam_size),
+                                         jnp.bool_)),
+            "lengths": Tensor(jnp.zeros((batch, self.beam_size),
+                                        jnp.int64)),
+        }
+        return start, beam_state, Tensor(
+            jnp.zeros((batch * self.beam_size,), jnp.bool_))
+
+    def step(self, time, inputs, states, **kwargs):
+        batch, beam = self._batch, self.beam_size
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        cell_out, next_cell = self.cell(inputs, states["cell_states"])
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        vocab = cell_out.shape[-1]
+        logp = F.log_softmax(cell_out)                      # [B*beam, V]
+        logp = logp.reshape([batch, beam, vocab])
+        # finished beams only extend with end_token at no cost
+        fin = states["finished"]
+        end_only = np.full((1, 1, vocab), -1e9, np.float32)
+        end_only[0, 0, self.end_token] = 0.0
+        logp = Tensor(jnp.where(fin._data_[..., None],
+                                jnp.asarray(end_only), logp._data_))
+        total = states["log_probs"].unsqueeze(-1) + logp     # [B, beam, V]
+        flat = total.reshape([batch, beam * vocab])
+        top_lp, top_idx = flat.topk(beam, axis=-1)           # [B, beam]
+        beam_idx = (top_idx / vocab).astype("int64")         # parent beam
+        token = (top_idx % vocab).astype("int64")
+        # reorder cell states by parent beam
+        gather_idx = (beam_idx + Tensor(
+            jnp.arange(batch, dtype=jnp.int64)[:, None] * beam)
+        ).reshape([-1])
+
+        def reorder(s):
+            return manipulation.index_select(s, gather_idx, axis=0)
+
+        cs = next_cell
+        if isinstance(cs, (list, tuple)):
+            cs = type(cs)(reorder(s) for s in cs)
+        else:
+            cs = reorder(cs)
+        parent_fin = Tensor(jnp.take_along_axis(
+            fin._data_, beam_idx._data_.astype(jnp.int32), axis=1))
+        parent_len = Tensor(jnp.take_along_axis(
+            states["lengths"]._data_, beam_idx._data_.astype(jnp.int32),
+            axis=1))
+        now_fin = parent_fin | (token == self.end_token)
+        lengths = parent_len + (~parent_fin).astype("int64")
+        next_state = {"cell_states": cs, "log_probs": top_lp,
+                      "finished": now_fin, "lengths": lengths}
+        outputs = {"token": token, "parent": beam_idx, "scores": top_lp}
+        return outputs, next_state, token.reshape([-1]), now_fin
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrace parent pointers into full sequences [B, beam, T]."""
+        tokens = jnp.stack([o["token"]._data_ for o in outputs])  # [T,B,b]
+        parents = jnp.stack([o["parent"]._data_ for o in outputs])
+        out = F.gather_tree(Tensor(tokens), Tensor(parents))
+        return out.transpose([1, 2, 0]), final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Drive a Decoder until every sequence finishes or max_step_num
+    (reference: nn/decode.py dynamic_decode)."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    step = 0
+    max_steps = max_step_num if max_step_num is not None else 256
+    while step < max_steps:
+        out, states, inputs, step_fin = decoder.step(step, inputs, states,
+                                                     **kwargs)
+        outputs.append(out)
+        step += 1
+        if bool(np.asarray(step_fin._data_).all()):
+            break
+    final, final_states = decoder.finalize(outputs, states, None)
+    if return_length:
+        return final, final_states, states.get("lengths")
+    return final, final_states

@@ -544,19 +544,59 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      data_format="NCHW", output_size=None, name=None):
-    stride = _pair(stride)
-    dilation = _pair(dilation)
-    pad = padding if isinstance(padding, str) else _conv_padding(
-        padding, 2, weight.shape[2:], dilation)
-    # weight layout IOHW for transpose (reference convention [in, out, kh, kw])
-    out = jax.lax.conv_transpose(
-        x, weight, strides=stride,
-        padding=pad.upper() if isinstance(pad, str) else pad,
-        rhs_dilation=dilation,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True)
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, 2, "NCHW", "OIHW",
+                              groups=groups, output_size=output_size)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, n, lhs_spec, rhs_spec, groups=1,
+                       output_size=None):
+    """Transpose conv matching the reference/torch semantics (verified
+    element-wise against torch.conv_transpose*d): paddle's [in, out, *k]
+    kernel is the forward conv's [O, I, *k] under transpose_kernel=True,
+    and user padding p maps to jax padding dilation·(k−1) − p with
+    output_padding added on the high side.  groups are realized by
+    channel-slicing (lax.conv_transpose has no feature_group_count);
+    output_size resolves to the equivalent output_padding."""
+    stride = _pair(stride, n)
+    dilation = _pair(dilation, n)
+    k = weight.shape[2:]
+    if isinstance(padding, str):
+        if padding.upper() not in ("SAME", "VALID"):
+            raise ValueError(f"unsupported padding {padding!r}")
+        padding = [0] * n if padding.upper() == "VALID" else \
+            [(dilation[d] * (k[d] - 1)) // 2 for d in range(n)]
+    padding = _pair(padding, n)
+    out_pad = _pair(output_padding, n)
+    if output_size is not None:
+        sizes = list(output_size)[-n:]
+        out_pad = tuple(
+            int(sizes[d]) - ((x.shape[2 + d] - 1) * stride[d]
+                             - 2 * padding[d] + dilation[d] * (k[d] - 1)
+                             + 1)
+            for d in range(n))
+    pad = [(dilation[d] * (k[d] - 1) - padding[d],
+            dilation[d] * (k[d] - 1) - padding[d] + out_pad[d])
+           for d in range(n)]
+
+    def one_group(xg, wg):
+        return jax.lax.conv_transpose(
+            xg, wg, strides=stride, padding=pad, rhs_dilation=dilation,
+            dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
+            transpose_kernel=True)
+
+    if groups == 1:
+        out = one_group(x, weight)
+    else:
+        cin = x.shape[1] // groups
+        outs = [one_group(
+            jax.lax.slice_in_dim(x, g * cin, (g + 1) * cin, axis=1),
+            jax.lax.slice_in_dim(weight, g * cin, (g + 1) * cin, axis=0))
+            for g in range(groups)]
+        out = jnp.concatenate(outs, axis=1)
     if bias is not None:
-        out = out + bias.reshape([1, -1, 1, 1])
+        out = out + bias.reshape([1, -1] + [1] * n)
     return out
 
 
@@ -585,6 +625,9 @@ def _pool(x, op, init, kernel, stride, padding, data_format, n_spatial,
 @defop("max_pool2d")
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        from .functional_extra import _max_pool_with_index
+        return _max_pool_with_index(x, kernel_size, stride, padding, 2)
     return _pool(x, jax.lax.max, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
                  else jnp.iinfo(x.dtype).min,
                  kernel_size, stride, padding, data_format, 2, ceil_mode)
@@ -612,6 +655,9 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 @defop("max_pool1d")
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
+    if return_mask:
+        from .functional_extra import _max_pool_with_index
+        return _max_pool_with_index(x, kernel_size, stride, padding, 1)
     return _pool(x, jax.lax.max, -jnp.inf, kernel_size, stride, padding,
                  "NCL", 1, ceil_mode)
 
@@ -1046,3 +1092,28 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
     from ..tensor_ops.manipulation import pad as _pad
     return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+# ---- long-tail surface (1D/3D pools, unpool, loss zoo, decode) ----
+from .functional_extra import (  # noqa: F401,E402
+    max_pool3d, avg_pool3d, adaptive_avg_pool1d, adaptive_max_pool1d,
+    adaptive_avg_pool3d, adaptive_max_pool3d, max_unpool1d, max_unpool2d,
+    max_unpool3d, conv1d_transpose, conv3d_transpose, fold,
+    pixel_unshuffle, channel_shuffle, zeropad2d, sigmoid, tanh,
+    log_sigmoid, gumbel_softmax, pairwise_distance,
+    bilinear, diag_embed, log_loss, dice_loss, npair_loss,
+    sigmoid_focal_loss, soft_margin_loss, multi_label_soft_margin_loss,
+    multi_margin_loss, poisson_nll_loss, gaussian_nll_loss,
+    triplet_margin_with_distance_loss, hsigmoid_loss,
+    margin_cross_entropy, ctc_loss, rnnt_loss, affine_grid, gather_tree,
+    sparse_attention, class_center_sample,
+)
+from ..tensor_ops.inplace import _make_inplace as _mk_ip  # noqa: E402
+
+relu_ = _mk_ip(relu, "relu_")
+elu_ = _mk_ip(elu, "elu_")
+hardtanh_ = _mk_ip(hardtanh, "hardtanh_")
+leaky_relu_ = _mk_ip(leaky_relu, "leaky_relu_")
+softmax_ = _mk_ip(softmax, "softmax_")
+tanh_ = _mk_ip(tanh, "tanh_")
+thresholded_relu_ = _mk_ip(thresholded_relu, "thresholded_relu_")

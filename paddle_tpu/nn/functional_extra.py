@@ -1,0 +1,710 @@
+"""nn.functional long tail: 1-D/3-D pool+conv variants, unpooling, loss
+zoo, decode helpers (reference: python/paddle/nn/functional/__init__.py
+__all__ — the symbols the core functional.py doesn't cover).
+
+Everything goes through @defop / the existing functional helpers so AMP,
+the tape, and FLOPs counting apply uniformly.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import defop
+from ..core.tensor import Tensor
+from ..core import state as _state
+from . import functional as F
+from .functional import (_pair, _pool, _conv_padding)
+
+
+# ------------------------------------------------------------------
+# pooling: 3-D + adaptive 1-D/3-D + unpool
+# ------------------------------------------------------------------
+
+@defop("max_pool3d")
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_index(x, kernel_size, stride, padding, 3)
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+        jnp.iinfo(x.dtype).min
+    return _pool(x, jax.lax.max, init, kernel_size, stride, padding,
+                 data_format, 3, ceil_mode)
+
+
+@defop("avg_pool3d")
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    summed = _pool(x, jax.lax.add, 0.0, kernel_size, stride, padding,
+                   data_format, 3, ceil_mode)
+    k = _pair(kernel_size, 3)
+    if divisor_override:
+        div = divisor_override
+    elif exclusive and padding != 0:
+        div = _pool(jnp.ones_like(x), jax.lax.add, 0.0, kernel_size,
+                    stride, padding, data_format, 3, ceil_mode)
+        return summed / div
+    else:
+        div = k[0] * k[1] * k[2]
+    return summed / div
+
+
+def _adaptive_pool_nd(x, output_size, n_spatial, reduce_fn, data_format):
+    outs = _pair(output_size, n_spatial)
+    start = 2 if data_format.startswith("NC") else 1
+    arr = x
+
+    def pool_axis(arr, axis, n_out):
+        size = arr.shape[axis]
+        if size % n_out == 0:
+            k = size // n_out
+            shape = (arr.shape[:axis] + (n_out, k) + arr.shape[axis + 1:])
+            return reduce_fn(arr.reshape(shape), axis=axis + 1)
+        starts = (np.arange(n_out) * size) // n_out
+        ends = ((np.arange(n_out) + 1) * size + n_out - 1) // n_out
+        pieces = [reduce_fn(jax.lax.slice_in_dim(arr, int(s), int(e),
+                                                 axis=axis),
+                            axis=axis, keepdims=True)
+                  for s, e in zip(starts, ends)]
+        return jnp.concatenate(pieces, axis=axis)
+
+    for i, n_out in enumerate(outs):
+        arr = pool_axis(arr, start + i, int(n_out))
+    return arr
+
+
+@defop("adaptive_avg_pool1d")
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool_nd(x, output_size, 1, jnp.mean, "NCL")
+
+
+@defop("adaptive_max_pool1d")
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(x, output_size, 1, jnp.max, "NCL")
+
+
+@defop("adaptive_avg_pool3d")
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool_nd(x, output_size, 3, jnp.mean, data_format)
+
+
+@defop("adaptive_max_pool3d")
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(x, output_size, 3, jnp.max, "NCDHW")
+
+
+def _max_pool_with_index(x, kernel, stride, padding, n_spatial):
+    """(pooled, flat spatial indices) via patch extraction + argmax —
+    the reference's return_mask contract used by max_unpool*.  Padding is
+    applied up front with -inf so padded cells can never win the max
+    (conv_general_dilated_patches pads with 0)."""
+    kernel = _pair(kernel, n_spatial)
+    stride = _pair(stride if stride is not None else kernel, n_spatial)
+    pad = _conv_padding(padding, n_spatial, kernel, (1,) * n_spatial)
+    b, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    # large-but-finite: conv_general_dilated_patches extracts patches via
+    # a one-hot convolution, and -inf * 0 would produce NaN
+    neg = jnp.finfo(x.dtype).min / 2 if jnp.issubdtype(
+        x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, [(0, 0), (0, 0)] + list(pad), constant_values=neg)
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, filter_shape=kernel, window_strides=stride,
+        padding=[(0, 0)] * n_spatial)
+    # patches: [B, C*prod(k), *out_spatial]
+    ksize = int(np.prod(kernel))
+    out_sp = patches.shape[2:]
+    patches = patches.reshape(b, c, ksize, *out_sp)
+    pooled = jnp.max(patches, axis=2)
+    local = jnp.argmax(patches, axis=2)  # [B, C, *out_sp]
+    # local k-index + window origin − pad → flat index into the UNPADDED
+    # input's spatial dims
+    grids = jnp.meshgrid(*[jnp.arange(o) for o in out_sp], indexing="ij")
+    flat = jnp.zeros(out_sp, jnp.int32)
+    rem = local
+    for d in range(n_spatial - 1, -1, -1):
+        kd = kernel[d]
+        loc_d = rem % kd
+        rem = rem // kd
+        coord = grids[d] * stride[d] - pad[d][0]
+        pos_d = jnp.clip(coord[None, None] + loc_d, 0, spatial[d] - 1)
+        mult = int(np.prod(spatial[d + 1:])) if d + 1 < n_spatial else 1
+        flat = flat + pos_d * mult
+    return pooled, flat.astype(jnp.int32)
+
+
+def _max_unpool(x, indices, n_spatial, kernel_size, stride, padding,
+                output_size, data_format):
+    kernel = _pair(kernel_size, n_spatial)
+    stride_t = _pair(stride if stride is not None else kernel_size,
+                     n_spatial)
+    pad = _pair(padding, n_spatial)
+    in_sp = x.shape[2:]
+    if output_size is None:
+        out_sp = tuple((in_sp[d] - 1) * stride_t[d] - 2 * pad[d] + kernel[d]
+                       for d in range(n_spatial))
+    else:
+        out_sp = tuple(output_size[-n_spatial:])
+    b, c = x.shape[0], x.shape[1]
+    n_flat = int(np.prod(out_sp))
+    flat_out = jnp.zeros((b, c, n_flat), x.dtype)
+    idx = indices.reshape(b, c, -1).astype(jnp.int32)
+    vals = x.reshape(b, c, -1)
+    bi = jnp.arange(b)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    flat_out = flat_out.at[bi, ci, idx].set(vals)
+    return flat_out.reshape(b, c, *out_sp)
+
+
+@defop("max_unpool1d")
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+@defop("max_unpool2d")
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+@defop("max_unpool3d")
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+# ------------------------------------------------------------------
+# conv transposes (1-D / 3-D)
+# ------------------------------------------------------------------
+
+@defop("conv1d_transpose")
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    from .functional import _conv_transpose_nd
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, 1, "NCH", "OIH",
+                              groups=groups, output_size=output_size)
+
+
+@defop("conv3d_transpose")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    from .functional import _conv_transpose_nd
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, 3, "NCDHW",
+                              "OIDHW", groups=groups,
+                              output_size=output_size)
+
+
+# ------------------------------------------------------------------
+# shape ops: fold, pixel_unshuffle, channel_shuffle, zeropad2d
+# ------------------------------------------------------------------
+
+@defop("fold")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """Inverse of unfold: [B, C*kh*kw, L] → [B, C, H, W] with overlap-add."""
+    out_h, out_w = _pair(output_sizes, 2)
+    kh, kw = _pair(kernel_sizes, 2)
+    sh, sw = _pair(strides, 2)
+    ph, pw = _pair(paddings, 2)
+    dh, dw = _pair(dilations, 2)
+    b = x.shape[0]
+    c = x.shape[1] // (kh * kw)
+    nh = (out_h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    nw = (out_w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = x.reshape(b, c, kh, kw, nh, nw)
+    padded = jnp.zeros((b, c, out_h + 2 * ph, out_w + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            patch = cols[:, :, i, j]  # [b, c, nh, nw]
+            padded = padded.at[
+                :, :, hi:hi + nh * sh:sh, wj:wj + nw * sw:sw].add(patch)
+    return padded[:, :, ph:ph + out_h, pw:pw + out_w]
+
+
+@defop("pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        x = x.reshape(b, c, h // r, r, w // r, r)
+        return x.transpose(0, 1, 3, 5, 2, 4).reshape(
+            b, c * r * r, h // r, w // r)
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // r, r, w // r, r, c)
+    return x.transpose(0, 1, 3, 5, 2, 4).reshape(
+        b, h // r, w // r, c * r * r)
+
+
+@defop("channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        return x.reshape(b, groups, c // groups, h, w).transpose(
+            0, 2, 1, 3, 4).reshape(b, c, h, w)
+    b, h, w, c = x.shape
+    return x.reshape(b, h, w, groups, c // groups).transpose(
+        0, 1, 2, 4, 3).reshape(b, h, w, c)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    pl_, pr, pt, pb = _pair(padding, 4)
+    if data_format == "NCHW":
+        return F.pad(x, [pl_, pr, pt, pb], mode="constant", value=0.0,
+                     data_format=data_format)
+    return F.pad(x, [pl_, pr, pt, pb], mode="constant", value=0.0,
+                 data_format=data_format)
+
+
+# ------------------------------------------------------------------
+# activations / simple aliases
+# ------------------------------------------------------------------
+
+def sigmoid(x, name=None):
+    from ..tensor_ops import math as M
+    return M.sigmoid(x)
+
+
+def tanh(x, name=None):
+    from ..tensor_ops import math as M
+    return M.tanh(x)
+
+
+@defop("log_sigmoid")
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)
+
+
+@defop("gumbel_softmax_impl")
+def _gumbel_softmax_impl(x, g, temperature, hard, axis):
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        one_hot = (y == jnp.max(y, axis=axis, keepdims=True)).astype(y.dtype)
+        y = one_hot + y - jax.lax.stop_gradient(y)
+    return y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    key = _state.next_rng_key()
+    u = jax.random.uniform(key, tuple(x.shape), jnp.float32,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    g = Tensor(-jnp.log(-jnp.log(u)))
+    return _gumbel_softmax_impl(x, g, temperature, hard, axis)
+
+
+# ------------------------------------------------------------------
+# distance / similarity
+# ------------------------------------------------------------------
+
+@defop("pairwise_distance")
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    d = x - y + epsilon
+    return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+
+@defop("bilinear")
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """x1 [N, d1], x2 [N, d2], weight [out, d1, d2] → [N, out]."""
+    out = jnp.einsum("nd,ode,ne->no", x1, weight, x2)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return out
+
+
+@defop("diag_embed")
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):  # noqa: A002
+    n = input.shape[-1] + abs(offset)
+    out = jnp.zeros(input.shape[:-1] + (n, n), input.dtype)
+    i = jnp.arange(input.shape[-1])
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    out = out.at[..., r, c].set(input)
+    if (dim1, dim2) not in ((-2, -1), (input.ndim - 1, input.ndim)):
+        out = jnp.moveaxis(jnp.moveaxis(out, -2, dim1), -1, dim2)
+    return out
+
+
+# ------------------------------------------------------------------
+# loss zoo
+# ------------------------------------------------------------------
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@defop("log_loss")
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    x = jnp.clip(input, epsilon, 1.0 - epsilon)
+    return -(label * jnp.log(x) + (1.0 - label) * jnp.log(1.0 - x))
+
+
+@defop("dice_loss")
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """input [N, ..., C] probabilities, label [N, ..., 1] class ids."""
+    lbl = jax.nn.one_hot(label[..., 0], input.shape[-1],
+                         dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * lbl, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(lbl, axis=reduce_dims)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+@defop("npair_loss")
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    sim = anchor @ positive.T
+    lbl = labels.reshape(-1)
+    target = (lbl[:, None] == lbl[None, :]).astype(sim.dtype)
+    target = target / jnp.sum(target, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(target * logp, axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1)) +
+                    jnp.mean(jnp.sum(positive * positive, axis=1))) * 0.25
+    return ce + reg
+
+
+@defop("sigmoid_focal_loss")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum", name=None):
+    p = jax.nn.sigmoid(logit)
+    ce = -(label * jax.nn.log_sigmoid(logit) +
+           (1.0 - label) * jax.nn.log_sigmoid(-logit))
+    p_t = p * label + (1.0 - p) * (1.0 - label)
+    loss = ce * ((1.0 - p_t) ** gamma)
+    if alpha >= 0:
+        loss = loss * (alpha * label + (1.0 - alpha) * (1.0 - label))
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce_loss(loss, reduction)
+
+
+@defop("soft_margin_loss")
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _reduce_loss(jnp.log1p(jnp.exp(-label * input)), reduction)
+
+
+@defop("multi_label_soft_margin_loss")
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean", name=None):
+    loss = -(label * jax.nn.log_sigmoid(input) +
+             (1.0 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce_loss(jnp.mean(loss, axis=-1), reduction)
+
+
+@defop("multi_margin_loss")
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,  # noqa: A002
+                      reduction="mean", name=None):
+    n, c = input.shape
+    picked = jnp.take_along_axis(input, label[:, None].astype(jnp.int32),
+                                 axis=1)
+    diff = jnp.maximum(margin - picked + input, 0.0) ** p
+    if weight is not None:
+        diff = diff * jnp.take(weight, label.astype(jnp.int32))[:, None]
+    mask = jax.nn.one_hot(label, c, dtype=input.dtype)
+    loss = jnp.sum(diff * (1.0 - mask), axis=1) / c
+    return _reduce_loss(loss, reduction)
+
+
+@defop("poisson_nll_loss")
+def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
+                     epsilon=1e-8, reduction="mean", name=None):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = (label * jnp.log(label + epsilon) - label +
+                    0.5 * jnp.log(2 * jnp.pi * (label + epsilon)))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce_loss(loss, reduction)
+
+
+@defop("gaussian_nll_loss")
+def gaussian_nll_loss(input, label, variance, full=False,  # noqa: A002
+                      epsilon=1e-6, reduction="mean", name=None):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * math.log(2 * math.pi)
+    return _reduce_loss(loss, reduction)
+
+
+@defop("triplet_margin_with_distance_loss")
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function if distance_function is not None else \
+        (lambda a, b: jnp.linalg.norm(a - b + 1e-6, axis=-1))
+    d_ap = dist(input, positive)
+    d_an = dist(input, negative)
+    if swap:
+        d_pn = dist(positive, negative)
+        d_an = jnp.minimum(d_an, d_pn)
+    return _reduce_loss(jnp.maximum(d_ap - d_an + margin, 0.0), reduction)
+
+
+@defop("hsigmoid_loss")
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: nn/functional/loss.py hsigmoid_loss; custom path tables
+    supported via path_table/path_code)."""
+    depth = max(int(math.floor(math.log2(2 * num_classes - 1))) + 1, 1)
+    lbl = label.reshape(-1).astype(jnp.int32)
+    if path_table is None:
+        # complete-binary-tree: node index, left/right code, and a
+        # validity mask per level — leaves at different depths stop at the
+        # root (idx == 1), so non-power-of-2 class counts have ragged
+        # paths and the dead levels must contribute zero loss
+        codes, nodes, valids = [], [], []
+        idx = lbl + num_classes  # leaves sit after internal nodes
+        for _ in range(depth):
+            valids.append((idx >= 2).astype(input.dtype))
+            codes.append((idx % 2).astype(input.dtype))  # 0=left,1=right
+            idx = idx // 2
+            nodes.append(jnp.clip(idx - 1, 0, num_classes - 2))
+        node_idx = jnp.stack(nodes, axis=1)       # [N, depth]
+        code = jnp.stack(codes, axis=1)           # [N, depth]
+        valid = jnp.stack(valids, axis=1)
+    else:
+        node_idx = path_table.astype(jnp.int32)
+        code = path_code.astype(input.dtype)
+        valid = (path_table >= 0).astype(input.dtype)
+        node_idx = jnp.clip(node_idx, 0, num_classes - 2)
+    w = jnp.take(weight, node_idx, axis=0)        # [N, depth, D]
+    logits = jnp.einsum("nd,npd->np", input, w)
+    if bias is not None:
+        logits = logits + jnp.take(bias.reshape(-1), node_idx)
+    # code 1 → sigmoid(logit), code 0 → sigmoid(-logit)
+    sign = 2.0 * code - 1.0
+    loss = -jax.nn.log_sigmoid(sign * logits) * valid
+    return jnp.sum(loss, axis=1, keepdims=True)
+
+
+@defop("margin_cross_entropy")
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-style margin softmax (reference:
+    nn/functional/common.py margin_cross_entropy, single-rank path)."""
+    lbl = label.reshape(-1).astype(jnp.int32)
+    onehot = jax.nn.one_hot(lbl, logits.shape[-1], dtype=logits.dtype)
+    cos_t = jnp.clip(jnp.sum(logits * onehot, axis=-1), -1.0, 1.0)
+    theta = jnp.arccos(cos_t)
+    cos_m = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = logits * (1.0 - onehot) + cos_m[:, None] * onehot
+    adjusted = adjusted * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.take_along_axis(logp, lbl[:, None], axis=-1)
+    sm = jnp.exp(logp)
+    loss = _reduce_loss(loss, reduction)
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+@defop("ctc_loss")
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC forward (alpha) recursion in log space via lax.scan
+    (reference: ctc_loss over warpctc, paddle/phi/kernels/impl/warpctc_*)."""
+    logp = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    t_max, b, _ = logp.shape
+    u_max = labels.shape[1]
+    s_max = 2 * u_max + 1
+    lbl = labels.astype(jnp.int32)
+    # extended label sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.full((b, s_max), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lbl)
+    neg_inf = -1e30
+    s_idx = jnp.arange(s_max)
+    # can skip from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((b, 2), -1, jnp.int32),
+                              ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+    alpha0 = jnp.full((b, s_max), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(b), ext[:, 0]])
+    if u_max > 0:
+        alpha0 = alpha0.at[:, 1].set(logp[0, jnp.arange(b), ext[:, 1]])
+
+    def step(alpha, logp_t):
+        a_m1 = jnp.concatenate(
+            [jnp.full((b, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a_m2 = jnp.concatenate(
+            [jnp.full((b, 2), neg_inf), alpha[:, :-2]], axis=1)
+        merged = jnp.logaddexp(alpha, a_m1)
+        merged = jnp.where(can_skip, jnp.logaddexp(merged, a_m2), merged)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)
+        return merged + emit, merged + emit
+
+    _, alphas = jax.lax.scan(step, alpha0, logp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+    # gather alpha at t = input_length-1, s = 2*label_length-1 / 2*label_length
+    t_idx = jnp.clip(input_lengths.astype(jnp.int32) - 1, 0, t_max - 1)
+    s_last = 2 * label_lengths.astype(jnp.int32)
+    batch_idx = jnp.arange(b)
+    a_final = alphas[t_idx, batch_idx]  # [B, S]
+    ll = jnp.logaddexp(
+        jnp.take_along_axis(a_final, jnp.clip(s_last - 1, 0, s_max - 1)[:, None],
+                            axis=1)[:, 0],
+        jnp.take_along_axis(a_final, jnp.clip(s_last, 0, s_max - 1)[:, None],
+                            axis=1)[:, 0])
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths.astype(loss.dtype), 1.0)
+    return _reduce_loss(loss, reduction)
+
+
+@defop("rnnt_loss")
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,  # noqa: A002
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T (transducer) loss: log-space alpha DP over the (T, U) grid
+    as nested lax.scans — outer over T rows, inner a prefix recursion
+    over U — so the traced graph is O(1) in T·U (reference: rnnt_loss
+    over warprnnt)."""
+    logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=-1)
+    b, t_max, u1, _ = logp.shape  # [B, T, U+1, V]
+    u_max = u1 - 1
+    lbl = label.astype(jnp.int32)
+    blank_lp = logp[..., blank]                       # [B, T, U+1]
+    lbl_lp = jnp.take_along_axis(
+        logp[:, :, :u_max, :], lbl[:, None, :, None].repeat(t_max, 1),
+        axis=-1)[..., 0]                              # [B, T, U]
+
+    # t = 0 row: only label transitions -> shifted prefix-sum of lbl_lp
+    row0 = jnp.concatenate(
+        [jnp.zeros((b, 1)), jnp.cumsum(lbl_lp[:, 0, :], axis=1)], axis=1)
+
+    def row_step(prev_row, inputs):
+        blank_prev, lbl_row = inputs          # [B, U+1], [B, U]
+        base = prev_row + blank_prev          # from (t-1, u)
+
+        def u_step(carry, x):
+            b_u, l_um1 = x                    # [B], [B]
+            val = jnp.logaddexp(b_u, carry + l_um1)
+            return val, val
+
+        _, rest = jax.lax.scan(
+            u_step, base[:, 0],
+            (base[:, 1:].T, lbl_row.T))       # over u = 1..U
+        row = jnp.concatenate([base[:, :1], rest.T], axis=1)
+        return row, row
+
+    _, rows = jax.lax.scan(
+        row_step, row0,
+        (jnp.moveaxis(blank_lp[:, :-1, :], 1, 0),
+         jnp.moveaxis(lbl_lp[:, 1:, :], 1, 0)))
+    alpha = jnp.concatenate([row0[:, None], jnp.moveaxis(rows, 0, 1)],
+                            axis=1)           # [B, T, U+1]
+    t_idx = jnp.clip(input_lengths.astype(jnp.int32) - 1, 0, t_max - 1)
+    u_idx = jnp.clip(label_lengths.astype(jnp.int32), 0, u_max)
+    bi = jnp.arange(b)
+    ll = alpha[bi, t_idx, u_idx] + blank_lp[bi, t_idx, u_idx]
+    return _reduce_loss(-ll, reduction)
+
+
+# ------------------------------------------------------------------
+# geometry / decode helpers
+# ------------------------------------------------------------------
+
+@defop("affine_grid")
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N,2,3] → grid [N,H,W,2] (2-D); [N,3,4] → [N,D,H,W,3]."""
+    def lin(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        return (jnp.arange(n) * 2.0 + 1.0) / n - 1.0
+
+    if theta.shape[-2:] == (2, 3):
+        n, _, h, w = out_shape
+        ys, xs = jnp.meshgrid(lin(h), lin(w), indexing="ij")
+        base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # [H,W,3]
+        return jnp.einsum("hwk,njk->nhwj", base, theta)
+    n, _, d, h, w = out_shape
+    zs, ys, xs = jnp.meshgrid(lin(d), lin(h), lin(w), indexing="ij")
+    base = jnp.stack([xs, ys, zs, jnp.ones_like(xs)], axis=-1)
+    return jnp.einsum("dhwk,njk->ndhwj", base, theta)
+
+
+@defop("gather_tree", nondiff=True)
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace: [T, B, beam] ids + parent indices →
+    full sequences (reference: nn/functional/extension.py gather_tree)."""
+    t_max = ids.shape[0]
+
+    def step(beam_idx, t):
+        out_t = jnp.take_along_axis(ids[t], beam_idx, axis=1)
+        parent = jnp.take_along_axis(parents[t], beam_idx, axis=1)
+        return parent, out_t
+
+    beam0 = jnp.broadcast_to(jnp.arange(ids.shape[2])[None, :],
+                             ids.shape[1:])
+    _, outs = jax.lax.scan(step, beam0, jnp.arange(t_max - 1, -1, -1))
+    return outs[::-1]
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention: on TPU the CSR pattern is materialized as a
+    dense mask and the matmuls stay on the MXU — the XLA-idiomatic
+    realization (a gather/scatter CSR kernel would be slower than the
+    masked dense matmul for the MXU)."""
+    offs = np.asarray(sparse_csr_offset._data_
+                      if isinstance(sparse_csr_offset, Tensor)
+                      else sparse_csr_offset)
+    cols = np.asarray(sparse_csr_columns._data_
+                      if isinstance(sparse_csr_columns, Tensor)
+                      else sparse_csr_columns)
+    b, h, s, d = (query.shape if not isinstance(query, Tensor)
+                  else tuple(query.shape))
+    mask = np.zeros((b, h, s, s), np.bool_)
+    for bi in range(offs.shape[0]):
+        for hi in range(offs.shape[1]):
+            for row in range(s):
+                start, end = offs[bi, hi, row], offs[bi, hi, row + 1]
+                mask[bi, hi, row, cols[bi, hi, start:end]] = True
+    from .functional import scaled_dot_product_attention as _sdpa
+    mask_t = Tensor(jnp.asarray(mask))
+    q4 = query.transpose([0, 2, 1, 3])
+    k4 = key.transpose([0, 2, 1, 3])
+    v4 = value.transpose([0, 2, 1, 3])
+    out = _sdpa(q4, k4, v4, attn_mask=mask_t, is_causal=False)
+    return out.transpose([0, 2, 1, 3])
+
+
+@defop("class_center_sample", nondiff=True)
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (PartialFC; reference:
+    nn/functional/common.py class_center_sample). Positive classes always
+    kept; negatives uniformly sampled to reach num_samples."""
+    key = _state.next_rng_key()
+    pos = jnp.zeros((num_classes,), jnp.bool_).at[label.reshape(-1)].set(True)
+    noise = jax.random.uniform(key, (num_classes,))
+    # positives float to the top, then the best negatives
+    order = jnp.argsort(jnp.where(pos, 2.0, noise))[::-1]
+    sampled = jnp.sort(order[:num_samples])
+    # remap labels into the sampled index space
+    remap = jnp.full((num_classes,), -1, jnp.int32)
+    remap = remap.at[sampled].set(jnp.arange(num_samples, dtype=jnp.int32))
+    return jnp.take(remap, label), sampled

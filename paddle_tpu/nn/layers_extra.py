@@ -1,0 +1,654 @@
+"""nn layer long tail (reference: python/paddle/nn/__init__.py __all__ —
+the Layer classes layers_common/losses don't cover).  Thin Layer wrappers
+over nn.functional; parameters follow the reference's shapes/defaults."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from .layer import Layer
+from .initializer import Constant, Normal, XavierUniform
+from . import functional as F
+
+
+# ------------------------------------------------------------------
+# pooling
+# ------------------------------------------------------------------
+
+class _PoolND(Layer):
+    def __init__(self, fn, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self._fn, self._args = fn, (kernel_size, stride, padding)
+        self._kw = kw
+
+    def forward(self, x):
+        k, s, p = self._args
+        return self._fn(x, k, s, p, **self._kw)
+
+
+class MaxPool1D(_PoolND):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, name=None):
+        super().__init__(F.max_pool1d, kernel_size, stride, padding,
+                         return_mask=return_mask, ceil_mode=ceil_mode)
+
+
+class MaxPool3D(_PoolND):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCDHW", name=None):
+        super().__init__(F.max_pool3d, kernel_size, stride, padding,
+                         return_mask=return_mask, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+
+class AvgPool1D(_PoolND):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__(F.avg_pool1d, kernel_size, stride, padding,
+                         exclusive=exclusive, ceil_mode=ceil_mode)
+
+
+class AvgPool3D(_PoolND):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None,
+                 data_format="NCDHW", name=None):
+        super().__init__(F.avg_pool3d, kernel_size, stride, padding,
+                         ceil_mode=ceil_mode, exclusive=exclusive,
+                         divisor_override=divisor_override,
+                         data_format=data_format)
+
+
+class _AdaptivePool(Layer):
+    def __init__(self, fn, output_size):
+        super().__init__()
+        self._fn, self._out = fn, output_size
+
+    def forward(self, x):
+        return self._fn(x, self._out)
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    def __init__(self, output_size, name=None):
+        super().__init__(F.adaptive_avg_pool1d, output_size)
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__(F.adaptive_avg_pool3d, output_size)
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(F.adaptive_max_pool1d, output_size)
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(F.adaptive_max_pool2d, output_size)
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(F.adaptive_max_pool3d, output_size)
+
+
+class _MaxUnPool(Layer):
+    def __init__(self, fn, kernel_size, stride=None, padding=0,
+                 output_size=None):
+        super().__init__()
+        self._fn = fn
+        self._cfg = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, out = self._cfg
+        return self._fn(x, indices, k, s, p, output_size=out)
+
+
+class MaxUnPool1D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__(F.max_unpool1d, kernel_size, stride, padding,
+                         output_size)
+
+
+class MaxUnPool2D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__(F.max_unpool2d, kernel_size, stride, padding,
+                         output_size)
+
+
+class MaxUnPool3D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__(F.max_unpool3d, kernel_size, stride, padding,
+                         output_size)
+
+
+# ------------------------------------------------------------------
+# convs
+# ------------------------------------------------------------------
+
+class Conv3D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (tuple, list)) \
+            else (kernel_size,) * 3
+        self._cfg = (stride, padding, dilation, groups, data_format)
+        fan_in = in_channels * int(np.prod(k)) // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + tuple(k),
+            attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr,
+            default_initializer=Constant(0.0), is_bias=True)
+
+    def forward(self, x):
+        s, p, d, g, df = self._cfg
+        return F.conv3d(x, self.weight, self.bias, stride=s, padding=p,
+                        dilation=d, groups=g, data_format=df)
+
+
+class _ConvTransposeND(Layer):
+    def __init__(self, fn, n, in_channels, out_channels, kernel_size,
+                 stride, padding, output_padding, dilation, groups,
+                 weight_attr, bias_attr):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (tuple, list)) \
+            else (kernel_size,) * n
+        self._fn = fn
+        self._cfg = (stride, padding, output_padding, dilation, groups)
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups) + tuple(k),
+            attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr,
+            default_initializer=Constant(0.0), is_bias=True)
+
+    def forward(self, x, output_size=None):
+        s, p, op, d, g = self._cfg
+        return self._fn(x, self.weight, self.bias, stride=s, padding=p,
+                        output_padding=op, dilation=d, groups=g)
+
+
+class Conv1DTranspose(_ConvTransposeND):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(F.conv1d_transpose, 1, in_channels, out_channels,
+                         kernel_size, stride, padding, output_padding,
+                         dilation, groups, weight_attr, bias_attr)
+
+
+class Conv3DTranspose(_ConvTransposeND):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(F.conv3d_transpose, 3, in_channels, out_channels,
+                         kernel_size, stride, padding, output_padding,
+                         dilation, groups, weight_attr, bias_attr)
+
+
+# ------------------------------------------------------------------
+# norms
+# ------------------------------------------------------------------
+
+class _InstanceNormND(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._eps = epsilon
+        if weight_attr is not False:
+            self.scale = self.create_parameter(
+                (num_features,), attr=weight_attr,
+                default_initializer=Constant(1.0))
+        else:
+            self.scale = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (num_features,), attr=bias_attr,
+                default_initializer=Constant(0.0), is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._eps)
+
+
+class InstanceNorm1D(_InstanceNormND):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormND):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormND):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._cfg = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        size, alpha, beta, k, df = self._cfg
+        return F.local_response_norm(x, size, alpha=alpha, beta=beta, k=k,
+                                     data_format=df)
+
+
+class SpectralNorm(Layer):
+    """Spectrally-normalized weight via power iteration (reference:
+    nn/layer/norm.py SpectralNorm — the weight is the forward INPUT)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None):
+        super().__init__()
+        self._dim, self._iters, self._eps = dim, power_iters, eps
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            (h,), default_initializer=Normal(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            (w,), default_initializer=Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ..tensor_ops import manipulation as MA
+        dim = self._dim
+        if dim != 0:
+            perm = [dim] + [i for i in range(len(weight.shape)) if i != dim]
+            weight_mat = MA.transpose(weight, perm)
+        else:
+            weight_mat = weight
+        h = weight_mat.shape[0]
+        mat = weight_mat.reshape([h, -1])
+        u, v = self.weight_u, self.weight_v
+        for _ in range(self._iters):
+            v = (mat.t() @ u)
+            v = v / (v.norm() + self._eps)
+            u = (mat @ v)
+            u = u / (u.norm() + self._eps)
+        sigma = (u @ (mat @ v))
+        out = weight_mat / sigma
+        if dim != 0:
+            inv = list(np.argsort(perm))
+            out = MA.transpose(out, inv)
+        return out
+
+
+class BatchNorm(Layer):
+    """Legacy BatchNorm facade (reference: nn/layer/norm.py BatchNorm) —
+    works for NCL/NCHW/NCDHW inputs, optional activation."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", in_place=False, moving_mean_name=None,
+                 moving_variance_name=None, do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__()
+        from .layers_common import BatchNorm2D
+        self._bn = BatchNorm2D(num_channels, momentum=momentum,
+                               epsilon=epsilon)
+        self._act = act
+
+    def forward(self, x):
+        orig = None
+        if x.ndim == 3:
+            orig = 3
+            x = x.unsqueeze(-1)
+        elif x.ndim == 5:
+            orig = 5
+            b, c, d, h, w = x.shape
+            x = x.reshape([b, c, d * h, w])
+            dims = (d, h, w)
+        out = self._bn(x)
+        if orig == 3:
+            out = out.squeeze(-1)
+        elif orig == 5:
+            out = out.reshape([b, c, *dims])
+        if self._act:
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class SyncBatchNorm(Layer):
+    """Cross-replica batch norm.  Under GSPMD/jit the batch statistics of
+    a dp-sharded batch are computed over the GLOBAL batch by XLA (mean
+    over a sharded axis inserts the all-reduce), so the sync behavior is
+    the compiler's — this wrapper keeps the reference API, including
+    convert_sync_batchnorm."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        from .layers_common import BatchNorm2D
+        self._bn = BatchNorm2D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr,
+                               data_format=data_format)
+
+    def forward(self, x):
+        return self._bn(x)
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        from .layers_common import BatchNorm1D, BatchNorm2D, BatchNorm3D
+        if isinstance(layer, (BatchNorm1D, BatchNorm2D, BatchNorm3D)):
+            new = cls(layer.weight.shape[0])
+            new._bn = layer
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+# ------------------------------------------------------------------
+# shape / padding / vision
+# ------------------------------------------------------------------
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._cfg = (output_sizes, kernel_sizes, strides, paddings,
+                     dilations)
+
+    def forward(self, x):
+        o, k, s, p, d = self._cfg
+        return F.fold(x, o, k, s, p, d)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis, self._shape = axis, shape
+
+    def forward(self, x):
+        from ..tensor_ops.extra import unflatten
+        return unflatten(x, self._axis, self._shape)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._r, self._df = upscale_factor, data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self._r, data_format=self._df)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._r, self._df = downscale_factor, data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._r, data_format=self._df)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._g, self._df = groups, data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self._g, data_format=self._df)
+
+
+class _PadND(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self._cfg = (padding, mode, value, data_format)
+
+    def forward(self, x):
+        p, m, v, df = self._cfg
+        return F.pad(x, p, mode=m, value=v, data_format=df)
+
+
+class Pad1D(_PadND):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class Pad3D(_PadND):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class ZeroPad2D(_PadND):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._cfg = (size, scale_factor, data_format)
+
+    def forward(self, x):
+        size, sf, df = self._cfg
+        return F.interpolate(x, size=size, scale_factor=sf,
+                             mode="bilinear", align_corners=True,
+                             data_format=df)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._cfg = (size, scale_factor, data_format)
+
+    def forward(self, x):
+        size, sf, df = self._cfg
+        return F.interpolate(x, size=size, scale_factor=sf, mode="nearest",
+                             data_format=df)
+
+
+# ------------------------------------------------------------------
+# activations / dropout / similarity
+# ------------------------------------------------------------------
+
+class Softmax2D(Layer):
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self.p, training=self.training)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p, self._df = p, data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, training=self.training,
+                           data_format=self._df)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self._axis, self._eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self._axis, eps=self._eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._cfg = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        p, e, k = self._cfg
+        return F.pairwise_distance(x, y, p=p, epsilon=e, keepdim=k)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        bound = 1.0 / math.sqrt(in1_features)
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (1, out_features), attr=bias_attr,
+            default_initializer=Constant(0.0), is_bias=True)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight,
+                          self.bias.reshape([-1]) if self.bias is not None
+                          else None)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self._g, self._axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self._g, self._axis)
+
+
+# ------------------------------------------------------------------
+# loss layers
+# ------------------------------------------------------------------
+
+class _LossLayer(Layer):
+    def __init__(self, fn, **kw):
+        super().__init__()
+        self._fn, self._kw = fn, kw
+
+    def forward(self, *args):
+        return self._fn(*args, **self._kw)
+
+
+class CTCLoss(_LossLayer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__(F.ctc_loss, blank=blank, reduction=reduction)
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return self._fn(log_probs, labels, input_lengths, label_lengths,
+                        norm_by_times=norm_by_times, **self._kw)
+
+
+class RNNTLoss(_LossLayer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__(F.rnnt_loss, blank=blank,
+                         fastemit_lambda=fastemit_lambda,
+                         reduction=reduction)
+
+
+class GaussianNLLLoss(_LossLayer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__(F.gaussian_nll_loss, full=full, epsilon=epsilon,
+                         reduction=reduction)
+
+
+class PoissonNLLLoss(_LossLayer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__(F.poisson_nll_loss, log_input=log_input,
+                         full=full, epsilon=epsilon, reduction=reduction)
+
+
+class SoftMarginLoss(_LossLayer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__(F.soft_margin_loss, reduction=reduction)
+
+
+class MultiLabelSoftMarginLoss(_LossLayer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__(F.multi_label_soft_margin_loss, weight=weight,
+                         reduction=reduction)
+
+
+class MultiMarginLoss(_LossLayer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__(F.multi_margin_loss, p=p, margin=margin,
+                         weight=weight, reduction=reduction)
+
+
+class CosineEmbeddingLoss(_LossLayer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__(F.cosine_embedding_loss, margin=margin,
+                         reduction=reduction)
+
+
+class HingeEmbeddingLoss(_LossLayer):
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__(F.hinge_embedding_loss, margin=margin,
+                         reduction=reduction)
+
+
+class TripletMarginLoss(_LossLayer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__(F.triplet_margin_loss, margin=margin, p=p,
+                         epsilon=epsilon, swap=swap, reduction=reduction)
+
+
+class TripletMarginWithDistanceLoss(_LossLayer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__(F.triplet_margin_with_distance_loss,
+                         distance_function=distance_function,
+                         margin=margin, swap=swap, reduction=reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self._num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr,
+            default_initializer=Normal(0.0, 1.0 / math.sqrt(feature_size)))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_classes - 1, 1), attr=bias_attr,
+            default_initializer=Constant(0.0), is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self._num_classes,
+                               self.weight, self.bias,
+                               path_table=path_table, path_code=path_code)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._cfg = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        k, s, p, d = self._cfg
+        return F.unfold(x, k, strides=s, paddings=p, dilations=d)

@@ -1,5 +1,6 @@
 from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Lamb,
+    Adadelta, Adamax, LBFGS,
     L1Decay, L2Decay,
 )
 from . import lr  # noqa: F401

@@ -504,3 +504,211 @@ class Lamb(Optimizer):
             new_m2.append(m2)
             new_mw.append(w if mw is not None else None)
         return new_p, {"moment1": new_m1, "moment2": new_m2, "master": new_mw}
+
+
+class Adadelta(Optimizer):
+    """reference: python/paddle/optimizer/adadelta.py — accumulates E[g²]
+    and E[Δx²], step size adapts without an explicit learning-rate decay."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _state_spec(self):
+        return [
+            ("avg_sq_grad", lambda p: jnp.zeros_like(p._data,
+                                                     dtype=jnp.float32)),
+            ("avg_sq_update", lambda p: jnp.zeros_like(p._data,
+                                                       dtype=jnp.float32)),
+            ("master", lambda p: (p._data.astype(jnp.float32)
+                                  if self._master_weight_needed(p)
+                                  else None)),
+        ]
+
+    def _fused_update(self, lr, step_t, params, grads, states, lr_scales,
+                      wd_mask):
+        rho, eps = self._rho, self._epsilon
+        wd = _wd_coeff(self._weight_decay)
+        new_p, new_g2, new_u2, new_mw = [], [], [], []
+        for p, g, g2, u2, mw, s, use_wd in zip(
+                params, grads, states["avg_sq_grad"],
+                states["avg_sq_update"], states["master"], lr_scales,
+                wd_mask):
+            w = mw if mw is not None else p.astype(jnp.float32)
+            gf = g.astype(jnp.float32)
+            if wd and use_wd:
+                gf = gf + wd * w
+            g2 = rho * g2 + (1 - rho) * jnp.square(gf)
+            upd = jnp.sqrt(u2 + eps) / jnp.sqrt(g2 + eps) * gf
+            u2 = rho * u2 + (1 - rho) * jnp.square(upd)
+            w = w - lr * s * upd
+            new_p.append(w.astype(p.dtype))
+            new_g2.append(g2)
+            new_u2.append(u2)
+            new_mw.append(w if mw is not None else None)
+        return new_p, {"avg_sq_grad": new_g2, "avg_sq_update": new_u2,
+                       "master": new_mw}
+
+
+class Adamax(Optimizer):
+    """reference: python/paddle/optimizer/adamax.py — Adam with an
+    infinity-norm second moment."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _state_spec(self):
+        return [
+            ("moment", lambda p: jnp.zeros_like(p._data,
+                                                dtype=jnp.float32)),
+            ("inf_norm", lambda p: jnp.zeros_like(p._data,
+                                                  dtype=jnp.float32)),
+            ("master", lambda p: (p._data.astype(jnp.float32)
+                                  if self._master_weight_needed(p)
+                                  else None)),
+        ]
+
+    def _fused_update(self, lr, step_t, params, grads, states, lr_scales,
+                      wd_mask):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        wd = _wd_coeff(self._weight_decay)
+        bc1 = 1.0 - b1 ** step_t
+        new_p, new_m, new_u, new_mw = [], [], [], []
+        for p, g, m, u, mw, s, use_wd in zip(
+                params, grads, states["moment"], states["inf_norm"],
+                states["master"], lr_scales, wd_mask):
+            w = mw if mw is not None else p.astype(jnp.float32)
+            gf = g.astype(jnp.float32)
+            if wd and use_wd:
+                gf = gf + wd * w
+            m = b1 * m + (1 - b1) * gf
+            u = jnp.maximum(b2 * u, jnp.abs(gf))
+            w = w - lr * s / bc1 * m / (u + eps)
+            new_p.append(w.astype(p.dtype))
+            new_m.append(m)
+            new_u.append(u)
+            new_mw.append(w if mw is not None else None)
+        return new_p, {"moment": new_m, "inf_norm": new_u,
+                       "master": new_mw}
+
+
+class LBFGS(Optimizer):
+    """reference: python/paddle/optimizer/lbfgs.py — limited-memory BFGS
+    with a step(closure) interface.  Two-loop recursion over a bounded
+    (s, y) history; `line_search_fn='strong_wolfe'` uses a backtracking
+    Armijo search (the Wolfe curvature check is approximated by history
+    curvature filtering, the standard practical simplification)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision=False)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._hist = history_size
+        self._line_search = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat_g = None
+        self._prev_flat_w = None
+
+    def _flatten(self, arrs):
+        return jnp.concatenate([a.reshape(-1).astype(jnp.float32)
+                                for a in arrs])
+
+    def _flat_grads(self):
+        # params the closure didn't touch contribute zero gradient
+        return self._flatten([
+            p.grad._data_ if p.grad is not None
+            else jnp.zeros(tuple(p.shape), jnp.float32)
+            for p in self._parameter_list])
+
+    def _unflatten_to_params(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p.shape)) if p.ndim else 1
+            p._data_ = flat[off:off + n].reshape(tuple(p.shape)).astype(
+                p._data_.dtype)
+            off += n
+
+    def _direction(self, g):
+        q = g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.maximum(jnp.dot(y, s), 1e-10)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((rho, a, s, y))
+        if self._y:
+            y_last, s_last = self._y[-1], self._s[-1]
+            gamma = jnp.dot(s_last, y_last) / jnp.maximum(
+                jnp.dot(y_last, y_last), 1e-10)
+            q = gamma * q
+        for rho, a, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    def step(self, closure=None):
+        if closure is None:
+            raise RuntimeError("LBFGS.step requires a closure that "
+                               "re-evaluates the loss")
+        from ..core.state import no_grad
+
+        loss = closure()
+        flat_g = self._flat_grads()
+        flat_w = self._flatten([p._data_ for p in self._parameter_list])
+        for _ in range(self._max_iter):
+            if float(jnp.max(jnp.abs(flat_g))) <= self._tol_grad:
+                break
+            if self._prev_flat_g is not None:
+                s = flat_w - self._prev_flat_w
+                y = flat_g - self._prev_flat_g
+                if float(jnp.dot(s, y)) > 1e-10:   # curvature condition
+                    self._s.append(s)
+                    self._y.append(y)
+                    if len(self._s) > self._hist:
+                        self._s.pop(0)
+                        self._y.pop(0)
+            d = self._direction(flat_g)
+            self._prev_flat_w, self._prev_flat_g = flat_w, flat_g
+            t = float(self._current_lr())
+            g_dot_d = float(jnp.dot(flat_g, d))
+            f0 = float(loss)
+            for _ls in range(20 if self._line_search else 1):
+                new_w = flat_w + t * d
+                with no_grad():
+                    self._unflatten_to_params(new_w)
+                for p in self._parameter_list:
+                    p.clear_grad()
+                loss = closure()
+                if not self._line_search or \
+                        float(loss) <= f0 + 1e-4 * t * g_dot_d:
+                    break
+                t *= 0.5
+            flat_w = self._flatten([p._data_ for p in
+                                    self._parameter_list])
+            flat_g = self._flat_grads()
+            if float(jnp.max(jnp.abs(t * d))) <= self._tol_change:
+                break
+        return loss
+
+    def _current_lr(self):
+        lr = self._learning_rate
+        try:
+            from .lr import LRScheduler
+            if isinstance(lr, LRScheduler):
+                return lr()
+        except Exception:
+            pass
+        return lr

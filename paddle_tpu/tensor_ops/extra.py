@@ -207,12 +207,6 @@ def reverse(x, axis, name=None):
     return MA.flip(x, axis)
 
 
-@defop("logit")
-def _logit_base(x, eps=None, name=None):
-    xc = jnp.clip(x, eps, 1.0 - eps) if eps else x
-    return jnp.log(xc / (1.0 - xc))
-
-
 # ------------------------------------------------------------------
 # manipulation
 # ------------------------------------------------------------------

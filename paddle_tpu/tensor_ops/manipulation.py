@@ -284,15 +284,16 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # n
     if len(pad) == 2 * nd:
         width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
     else:
-        # paddle conv-style: pad applies to trailing spatial dims, reversed pairs
+        # paddle conv-style: the FIRST pair pads the LAST spatial dim
+        # ([left, right, top, bottom] → W gets (l, r), H gets (t, b))
         n_spatial = len(pad) // 2
-        width = [(0, 0)] * (nd - n_spatial)
+        pairs = [(pad[2 * i], pad[2 * i + 1])
+                 for i in reversed(range(n_spatial))]
         if data_format.endswith("C"):  # NHWC: spatial dims before channel
-            width = [(0, 0)] + [(pad[2 * i], pad[2 * i + 1])
-                                for i in range(n_spatial)] + [(0, 0)]
+            width = [(0, 0)] + pairs + [(0, 0)]
             width = width[:nd]
         else:
-            width += [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+            width = [(0, 0)] * (nd - n_spatial) + pairs
     mode_map = {"constant": "constant", "reflect": "reflect",
                 "replicate": "edge", "circular": "wrap"}
     if mode == "constant":
