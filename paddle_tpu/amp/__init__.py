@@ -154,3 +154,16 @@ class GradScaler:
         self._bad_steps = state["bad_steps"]
 
 from . import debugging  # noqa: F401
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is native on TPU (MXU) and emulated losslessly on CPU XLA."""
+    return True
+
+
+def is_float16_supported(device=None):
+    import jax
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon", "gpu")
+    except Exception:
+        return False

@@ -273,3 +273,40 @@ def _kl_geometric(p, q):
     qq = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
     return ((1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qq))
             + jnp.log(pp) - jnp.log(qq))
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference:
+    distribution/exponential_family.py): entropy via the Bregman
+    divergence of the log-normalizer — subclasses expose natural
+    parameters and `_log_normalizer`."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        import jax
+        nat = [Tensor(p) if not isinstance(p, Tensor) else p
+               for p in self._natural_parameters]
+        arrays = [p._data_ for p in nat]
+
+        def log_norm(*ps):
+            out = self._log_normalizer(*ps)
+            return out._data_ if isinstance(out, Tensor) else out
+
+        # per-ELEMENT Bregman identity: H = A(η) − Σ η·∇A(η) − carrier,
+        # batch shape preserved (grad of the summed A gives elementwise
+        # gradients since A is separable over the batch)
+        grads = jax.grad(lambda ps: jnp.sum(log_norm(*ps)))(arrays)
+        ent = jnp.asarray(log_norm(*arrays)) - self._mean_carrier_measure
+        for p, g in zip(arrays, grads):
+            ent = ent - p * g
+        return Tensor(ent)

@@ -61,3 +61,48 @@ def fftshift(x, axes=None, name=None):
 def ifftshift(x, axes=None, name=None):
     return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes),
                     (x if isinstance(x, Tensor) else Tensor(x),))
+
+
+def _data(x):
+    return x._data_ if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D Hermitian FFT: complex hermitian input → real output
+    (reference: fft.py hfft2 = fft over axes[:-1] then hfft on the last)."""
+    a = _data(x)
+    inner = jnp.fft.fft(a, n=None if s is None else s[0], axis=axes[0],
+                        norm=norm)
+    n_last = None if s is None else s[1]
+    return Tensor(jnp.fft.hfft(inner, n=n_last, axis=axes[1], norm=norm))
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    a = _data(x)
+    first = jnp.fft.ihfft(a, n=None if s is None else s[1], axis=axes[1],
+                          norm=norm)
+    return Tensor(jnp.fft.ifft(first, n=None if s is None else s[0],
+                               axis=axes[0], norm=norm))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    a = _data(x)
+    nd = a.ndim
+    axes = tuple(range(nd)) if axes is None else tuple(axes)
+    for i, ax in enumerate(axes[:-1]):
+        a = jnp.fft.fft(a, n=None if s is None else s[i], axis=ax,
+                        norm=norm)
+    return Tensor(jnp.fft.hfft(
+        a, n=None if s is None else s[-1], axis=axes[-1], norm=norm))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    a = _data(x)
+    nd = a.ndim
+    axes = tuple(range(nd)) if axes is None else tuple(axes)
+    a = jnp.fft.ihfft(a, n=None if s is None else s[-1], axis=axes[-1],
+                      norm=norm)
+    for i, ax in enumerate(axes[:-1]):
+        a = jnp.fft.ifft(a, n=None if s is None else s[i], axis=ax,
+                         norm=norm)
+    return Tensor(a)

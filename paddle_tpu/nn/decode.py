@@ -128,11 +128,12 @@ class BeamSearchDecoder(Decoder):
         return outputs, next_state, token.reshape([-1]), now_fin
 
     def finalize(self, outputs, final_states, sequence_lengths):
-        """Backtrace parent pointers into full sequences [B, beam, T]."""
+        """Backtrace parent pointers into full sequences
+        [batch, time, beam] (the reference's layout)."""
         tokens = jnp.stack([o["token"]._data_ for o in outputs])  # [T,B,b]
         parents = jnp.stack([o["parent"]._data_ for o in outputs])
         out = F.gather_tree(Tensor(tokens), Tensor(parents))
-        return out.transpose([1, 2, 0]), final_states
+        return out.transpose([1, 0, 2]), final_states
 
 
 def dynamic_decode(decoder, inits=None, max_step_num=None,
@@ -152,6 +153,10 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         if bool(np.asarray(step_fin._data_).all()):
             break
     final, final_states = decoder.finalize(outputs, states, None)
+    if output_time_major and isinstance(final, Tensor):
+        # [batch, time, ...] → [time, batch, ...]
+        perm = [1, 0] + list(range(2, len(final.shape)))
+        final = final.transpose(perm)
     if return_length:
         return final, final_states, states.get("lengths")
     return final, final_states

@@ -607,8 +607,17 @@ def _pool(x, op, init, kernel, stride, padding, data_format, n_spatial,
     if isinstance(padding, str):
         pad = padding.upper()
     else:
-        p = _conv_padding(padding, n_spatial, kernel, (1,) * n_spatial)
-        pad = p
+        pad = _conv_padding(padding, n_spatial, kernel, (1,) * n_spatial)
+        if ceil_mode:
+            # extend the high-side pad so partial windows yield an output
+            # (reduce_window floors otherwise); init-padding is neutral
+            sp_off = 2 if data_format.startswith("NC") else 1
+            pad = list(pad)
+            for d in range(n_spatial):
+                size = x.shape[sp_off + d] + pad[d][0] + pad[d][1]
+                rem = (size - kernel[d]) % stride[d]
+                if rem:
+                    pad[d] = (pad[d][0], pad[d][1] + stride[d] - rem)
     if data_format.startswith("NC"):
         dims = (1, 1) + kernel
         strides = (1, 1) + stride
@@ -627,7 +636,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
     if return_mask:
         from .functional_extra import _max_pool_with_index
-        return _max_pool_with_index(x, kernel_size, stride, padding, 2)
+        return _max_pool_with_index(x, kernel_size, stride, padding, 2,
+                                    ceil_mode=ceil_mode,
+                                    data_format=data_format)
     return _pool(x, jax.lax.max, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
                  else jnp.iinfo(x.dtype).min,
                  kernel_size, stride, padding, data_format, 2, ceil_mode)
@@ -642,7 +653,7 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     k = _pair(kernel_size, 2)
     if divisor_override:
         div = divisor_override
-    elif exclusive and padding != 0:
+    elif exclusive and (padding != 0 or ceil_mode):
         ones = jnp.ones_like(x)
         div = _pool(ones, jax.lax.add, 0.0, kernel_size, stride, padding,
                     data_format, 2, ceil_mode)
@@ -657,7 +668,8 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
     if return_mask:
         from .functional_extra import _max_pool_with_index
-        return _max_pool_with_index(x, kernel_size, stride, padding, 1)
+        return _max_pool_with_index(x, kernel_size, stride, padding, 1,
+                                    ceil_mode=ceil_mode)
     return _pool(x, jax.lax.max, -jnp.inf, kernel_size, stride, padding,
                  "NCL", 1, ceil_mode)
 
@@ -699,6 +711,9 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
 
 @defop("adaptive_max_pool2d")
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        from .functional_extra import _adaptive_max_with_index
+        return _adaptive_max_with_index(x, output_size, 2)
     out = _pair(output_size, 2)
     h, w = x.shape[2], x.shape[3]
     kh, kw = h // out[0], w // out[1]

@@ -28,7 +28,9 @@ from .functional import (_pair, _pool, _conv_padding)
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
     if return_mask:
-        return _max_pool_with_index(x, kernel_size, stride, padding, 3)
+        return _max_pool_with_index(x, kernel_size, stride, padding, 3,
+                                    ceil_mode=ceil_mode,
+                                    data_format=data_format)
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
         jnp.iinfo(x.dtype).min
     return _pool(x, jax.lax.max, init, kernel_size, stride, padding,
@@ -44,7 +46,7 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     k = _pair(kernel_size, 3)
     if divisor_override:
         div = divisor_override
-    elif exclusive and padding != 0:
+    elif exclusive and (padding != 0 or ceil_mode):
         div = _pool(jnp.ones_like(x), jax.lax.add, 0.0, kernel_size,
                     stride, padding, data_format, 3, ceil_mode)
         return summed / div
@@ -82,8 +84,46 @@ def adaptive_avg_pool1d(x, output_size, name=None):
     return _adaptive_pool_nd(x, output_size, 1, jnp.mean, "NCL")
 
 
+def _adaptive_max_with_index(x, output_size, n_spatial):
+    """Adaptive max pooling with argmax indices: per-bin slices (bin
+    counts are small), indices flat over the input's spatial dims."""
+    outs = _pair(output_size, n_spatial)
+    spatial = x.shape[2:]
+    import itertools
+
+    def bounds(size, n_out):
+        s = (np.arange(n_out) * size) // n_out
+        e = ((np.arange(n_out) + 1) * size + n_out - 1) // n_out
+        return list(zip(s.tolist(), e.tolist()))
+
+    per_dim = [bounds(spatial[d], int(outs[d])) for d in range(n_spatial)]
+    pooled_bins, index_bins = [], []
+    for bin_bounds in itertools.product(*per_dim):
+        sl = (np.s_[:], np.s_[:]) + tuple(np.s_[s:e] for s, e in bin_bounds)
+        piece = x[sl]
+        flat = piece.reshape(piece.shape[0], piece.shape[1], -1)
+        pooled_bins.append(jnp.max(flat, axis=-1))
+        loc = jnp.argmax(flat, axis=-1)
+        # local flat index within the bin → global flat index
+        glob = jnp.zeros_like(loc)
+        rem = loc
+        for d in range(n_spatial - 1, -1, -1):
+            dim_len = bin_bounds[d][1] - bin_bounds[d][0]
+            coord = rem % dim_len + bin_bounds[d][0]
+            rem = rem // dim_len
+            mult = int(np.prod(spatial[d + 1:])) if d + 1 < n_spatial else 1
+            glob = glob + coord * mult
+        index_bins.append(glob)
+    out_shape = (x.shape[0], x.shape[1]) + tuple(int(o) for o in outs)
+    pooled = jnp.stack(pooled_bins, axis=-1).reshape(out_shape)
+    idx = jnp.stack(index_bins, axis=-1).reshape(out_shape)
+    return pooled, idx.astype(jnp.int32)
+
+
 @defop("adaptive_max_pool1d")
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_with_index(x, output_size, 1)
     return _adaptive_pool_nd(x, output_size, 1, jnp.max, "NCL")
 
 
@@ -94,17 +134,36 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 @defop("adaptive_max_pool3d")
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_with_index(x, output_size, 3)
     return _adaptive_pool_nd(x, output_size, 3, jnp.max, "NCDHW")
 
 
-def _max_pool_with_index(x, kernel, stride, padding, n_spatial):
+def _max_pool_with_index(x, kernel, stride, padding, n_spatial,
+                         ceil_mode=False, data_format=None):
     """(pooled, flat spatial indices) via patch extraction + argmax —
     the reference's return_mask contract used by max_unpool*.  Padding is
     applied up front with -inf so padded cells can never win the max
     (conv_general_dilated_patches pads with 0)."""
+    if data_format is not None and data_format.endswith("C"):
+        # channels-last: pool in NC-first layout, return in caller layout
+        perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+        inv = (0,) + tuple(range(2, x.ndim)) + (1,)
+        pooled, idx = _max_pool_with_index(
+            x.transpose(perm), kernel, stride, padding, n_spatial,
+            ceil_mode=ceil_mode)
+        return pooled.transpose(inv), idx.transpose(inv)
     kernel = _pair(kernel, n_spatial)
     stride = _pair(stride if stride is not None else kernel, n_spatial)
     pad = _conv_padding(padding, n_spatial, kernel, (1,) * n_spatial)
+    if ceil_mode:
+        # extend the high-side pad so partial windows produce an output
+        pad = list(pad)
+        for d in range(n_spatial):
+            size = x.shape[2 + d] + pad[d][0] + pad[d][1]
+            rem = (size - kernel[d]) % stride[d]
+            if rem:
+                pad[d] = (pad[d][0], pad[d][1] + stride[d] - rem)
     b, c = x.shape[0], x.shape[1]
     spatial = x.shape[2:]
     # large-but-finite: conv_general_dilated_patches extracts patches via
@@ -262,9 +321,6 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
 
 def zeropad2d(x, padding, data_format="NCHW", name=None):
     pl_, pr, pt, pb = _pair(padding, 4)
-    if data_format == "NCHW":
-        return F.pad(x, [pl_, pr, pt, pb], mode="constant", value=0.0,
-                     data_format=data_format)
     return F.pad(x, [pl_, pr, pt, pb], mode="constant", value=0.0,
                  data_format=data_format)
 
@@ -590,6 +646,12 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,  # noqa: A002
     lbl_lp = jnp.take_along_axis(
         logp[:, :, :u_max, :], lbl[:, None, :, None].repeat(t_max, 1),
         axis=-1)[..., 0]                              # [B, T, U]
+    if fastemit_lambda:
+        # FastEmit regularization (arXiv:2010.11148): boost label-arc
+        # probability so the model emits early; realized by up-weighting
+        # label transitions by log1p(λ) in the DP — gradients on label
+        # arcs scale by ≈(1+λ) and λ→0 recovers the exact loss
+        lbl_lp = lbl_lp + math.log1p(fastemit_lambda)
 
     # t = 0 row: only label transitions -> shifted prefix-sum of lbl_lp
     row0 = jnp.concatenate(

@@ -61,12 +61,12 @@ class AvgPool3D(_PoolND):
 
 
 class _AdaptivePool(Layer):
-    def __init__(self, fn, output_size):
+    def __init__(self, fn, output_size, **kw):
         super().__init__()
-        self._fn, self._out = fn, output_size
+        self._fn, self._out, self._kw = fn, output_size, kw
 
     def forward(self, x):
-        return self._fn(x, self._out)
+        return self._fn(x, self._out, **self._kw)
 
 
 class AdaptiveAvgPool1D(_AdaptivePool):
@@ -81,17 +81,20 @@ class AdaptiveAvgPool3D(_AdaptivePool):
 
 class AdaptiveMaxPool1D(_AdaptivePool):
     def __init__(self, output_size, return_mask=False, name=None):
-        super().__init__(F.adaptive_max_pool1d, output_size)
+        super().__init__(F.adaptive_max_pool1d, output_size,
+                         return_mask=return_mask)
 
 
 class AdaptiveMaxPool2D(_AdaptivePool):
     def __init__(self, output_size, return_mask=False, name=None):
-        super().__init__(F.adaptive_max_pool2d, output_size)
+        super().__init__(F.adaptive_max_pool2d, output_size,
+                         return_mask=return_mask)
 
 
 class AdaptiveMaxPool3D(_AdaptivePool):
     def __init__(self, output_size, return_mask=False, name=None):
-        super().__init__(F.adaptive_max_pool3d, output_size)
+        super().__init__(F.adaptive_max_pool3d, output_size,
+                         return_mask=return_mask)
 
 
 class _MaxUnPool(Layer):
@@ -139,8 +142,6 @@ class Conv3D(Layer):
         k = kernel_size if isinstance(kernel_size, (tuple, list)) \
             else (kernel_size,) * 3
         self._cfg = (stride, padding, dilation, groups, data_format)
-        fan_in = in_channels * int(np.prod(k)) // groups
-        bound = 1.0 / math.sqrt(fan_in)
         self.weight = self.create_parameter(
             (out_channels, in_channels // groups) + tuple(k),
             attr=weight_attr, default_initializer=XavierUniform())
@@ -279,6 +280,11 @@ class SpectralNorm(Layer):
             v = v / (v.norm() + self._eps)
             u = (mat @ v)
             u = u / (u.norm() + self._eps)
+        # persist the power-iteration state so successive forwards warm-
+        # start (the reference stores u/v as non-trainable weights)
+        import jax as _jax
+        self.weight_u._data_ = _jax.lax.stop_gradient(u._data_)
+        self.weight_v._data_ = _jax.lax.stop_gradient(v._data_)
         sigma = (u @ (mat @ v))
         out = weight_mat / sigma
         if dim != 0:
@@ -511,7 +517,6 @@ class Bilinear(Layer):
     def __init__(self, in1_features, in2_features, out_features,
                  weight_attr=None, bias_attr=None, name=None):
         super().__init__()
-        bound = 1.0 / math.sqrt(in1_features)
         self.weight = self.create_parameter(
             (out_features, in1_features, in2_features), attr=weight_attr,
             default_initializer=XavierUniform())

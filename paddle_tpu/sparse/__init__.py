@@ -149,3 +149,161 @@ class nn:
     class ReLU:
         def __call__(self, x):
             return relu(x)
+
+
+# ------------------------------------------------------------------
+# elementwise / unary surface (reference: python/paddle/sparse/unary.py,
+# binary.py — values-only ops preserve the sparsity pattern)
+# ------------------------------------------------------------------
+
+def _unary(fn):
+    def op(x, name=None):
+        b = x._data_
+        if isinstance(b, jsparse.BCOO):
+            new = jsparse.BCOO((fn(b.data), b.indices), shape=b.shape)
+            return SparseCooTensor(new, stop_gradient=x.stop_gradient)
+        return Tensor(fn(b))
+    return op
+
+
+abs = _unary(jnp.abs)  # noqa: A001
+sin = _unary(jnp.sin)
+sinh = _unary(jnp.sinh)
+tan = _unary(jnp.tan)
+tanh = _unary(jnp.tanh)
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+expm1 = _unary(jnp.expm1)
+neg = _unary(jnp.negative)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+isnan = _unary(jnp.isnan)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    b = x._data_
+    vals = b.data if value_dtype is None else b.data.astype(value_dtype)
+    idx = b.indices if index_dtype is None else \
+        b.indices.astype(index_dtype)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=b.shape))
+
+
+def _binary(fn):
+    def op(x, y, name=None):
+        xb, yb = x._data_, y._data_
+        both = isinstance(xb, jsparse.BCOO) and isinstance(yb, jsparse.BCOO)
+        if both and xb.indices.shape == yb.indices.shape and \
+                bool(jnp.all(xb.indices == yb.indices)):
+            # same pattern: values-only (the common case the reference's
+            # same-shape kernels handle)
+            return SparseCooTensor(jsparse.BCOO(
+                (fn(xb.data, yb.data), xb.indices), shape=xb.shape))
+        xd = xb.todense() if isinstance(xb, jsparse.BCOO) else xb
+        yd = yb.todense() if isinstance(yb, jsparse.BCOO) else yb
+        out = fn(xd, yd)
+        dense = np.asarray(out)
+        return sparse_coo_tensor(np.nonzero(dense), dense[dense != 0],
+                                 dense.shape)
+    return op
+
+
+subtract = _binary(jnp.subtract)
+multiply = _binary(jnp.multiply)
+divide = _binary(jnp.divide)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def coalesce(x, name=None):
+    b = x._data_
+    return SparseCooTensor(b.sum_duplicates(), stop_gradient=x.stop_gradient)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    d = x._data_.todense() if isinstance(x._data_, jsparse.BCOO) else x._data_
+    out = jnp.sum(d, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return Tensor(out)
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix × dense vector."""
+    b = x._data_
+    v = vec._data_ if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(jsparse.bcoo_dot_general(
+        b, v, dimension_numbers=(((b.ndim - 1,), (0,)), ((), ()))))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """beta*input + alpha*(sparse x @ dense y)."""
+    prod = matmul(x, y)
+    return Tensor(beta * _dense_data(input) + alpha * prod._data_)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Dense @ dense evaluated ONLY at mask's sparsity pattern
+    (reference: sparse/binary.py masked_matmul — SDDMM)."""
+    xd, yd = _dense_data(x), _dense_data(y)
+    mb = mask._data_
+    rows = mb.indices[:, 0]
+    cols = mb.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xd[rows, :], yd[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, mb.indices),
+                                        shape=mb.shape))
+
+
+def transpose(x, perm, name=None):
+    b = x._data_
+    return SparseCooTensor(jsparse.bcoo_transpose(b, permutation=tuple(perm)))
+
+
+def reshape(x, shape, name=None):
+    b = x._data_
+    shape = tuple(int(s) if s != -1 else -1 for s in shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        total = int(np.prod(b.shape))
+        shape = tuple(total // known if s == -1 else s for s in shape)
+    return SparseCooTensor(jsparse.bcoo_reshape(b, new_sizes=shape))
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    d = x._data_.todense()
+    idx = [np.s_[:]] * d.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = np.s_[s:e]
+    out = np.asarray(d[tuple(idx)])
+    return sparse_coo_tensor(np.nonzero(out), out[out != 0], out.shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized low-rank PCA (reference: sparse/multiary.py
+    pca_lowrank); the sparse matmuls ride bcoo_dot_general."""
+    d = x._data_.todense() if isinstance(x._data_, jsparse.BCOO) \
+        else _dense_data(x)
+    m, n = d.shape
+    q = q if q is not None else min(6, m, n)
+    if center:
+        d = d - jnp.mean(d, axis=0, keepdims=True)
+    key = jax.random.PRNGKey(0)
+    omega = jax.random.normal(key, (n, q), d.dtype)
+    y = d @ omega
+    for _ in range(niter):
+        y = d @ (d.T @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = qmat.T @ d
+    u_hat, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ u_hat
+    return Tensor(u), Tensor(s), Tensor(vt.T)

@@ -358,3 +358,18 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 # reference-parity aliases
 save = save_inference_model
 load = load_inference_model
+
+
+# ---- compat surface (reference: static/__init__.py __all__) ----
+from .compat import (  # noqa: F401,E402
+    Variable, BuildStrategy, ExecutionStrategy, WeightNormParamAttr,
+    IpuStrategy, IpuCompiledProgram, ipu_shard_guard, set_ipu_shard,
+    name_scope, device_guard, cpu_places, cuda_places, xpu_places,
+    create_parameter, create_global_var, append_backward, gradients,
+    py_func, Print, accuracy, auc, ctr_metric_bundle,
+    ExponentialMovingAverage, serialize_program, deserialize_program,
+    serialize_persistables, deserialize_persistables, save_to_file,
+    load_from_file, load_program_state, set_program_state,
+    normalize_program,
+)
+from . import nn  # noqa: F401,E402

@@ -1,0 +1,307 @@
+"""Static-graph compatibility surface (reference: python/paddle/static/
+__init__.py __all__ + static/nn/).  The real static engine here is the
+two-phase tracer (`jit/tracer.py` → jax.jit), so this module provides the
+reference's *API* over eager/traced execution: strategy/config bags,
+program (de)serialization, EMA, metrics, and the static.nn functional
+namespace that forwards to nn.functional with layer-managed parameters."""
+from __future__ import annotations
+
+import contextlib
+import io as _io
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import state as _state
+
+# Variable is the static-graph Tensor handle; one tensor type here
+Variable = Tensor
+
+
+class BuildStrategy:
+    """Config bag (reference: BuildStrategy) — XLA owns fusion decisions,
+    so the knobs are recorded but the compiler is authoritative."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.memory_optimize = True
+        self.build_cinn_pass = False
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+
+
+class WeightNormParamAttr:
+    """reference: static/__init__.py WeightNormParamAttr — parameter
+    attribute requesting weight normalization; recorded for API parity
+    (apply paddle.nn.utils-style weight norm in layers that honor it)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+
+
+class _NoIpu:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "IPU (Graphcore) support is device-specific to the reference; "
+            "this TPU-native framework targets TPU via XLA")
+
+
+class IpuStrategy(_NoIpu):
+    pass
+
+
+class IpuCompiledProgram(_NoIpu):
+    pass
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("no IPU runtime")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("no IPU runtime")
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Name scoping for program readability (no-op on the traced path)."""
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Device placement guard; single-device-type runtime → no-op."""
+    yield
+
+
+def cpu_places(device_count=None):
+    from ..tensor_ops.extra import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..tensor_ops.extra import CUDAPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..tensor_ops.extra import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = Tensor(jnp.full(tuple(shape), value, dtype))
+    t.persistable = persistable
+    t.name = name
+    return t
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference: base/backward.py append_backward — returns
+    [(param, grad)] after running the backward pass."""
+    loss.backward()
+    out = []
+    for p in (parameter_list or []):
+        if isinstance(p, Tensor) and p.grad is not None:
+            out.append((p, p.grad))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from .. import autograd
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return autograd.grad(ts, xs, grad_outputs=target_gradients,
+                         allow_unused=True)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op: eager path simply calls through."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    return res
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    vals = np.asarray(input._data_)
+    print(f"{message or 'Variable'}: shape={list(vals.shape)} "
+          f"dtype={vals.dtype} values={vals.ravel()[:summarize]}")
+    return input
+
+
+def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
+    """Top-k accuracy op (reference: static/nn/metric.py accuracy)."""
+    lbl = label._data_.reshape(-1)
+    topk = jnp.argsort(-input._data_, axis=-1)[:, :k]
+    hit = jnp.any(topk == lbl[:, None], axis=-1)
+    return Tensor(jnp.mean(hit.astype(jnp.float32)))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):  # noqa: A002
+    """Batch AUC (reference: static/nn/metric.py auc) — returns
+    (auc_value, batch_auc, [stat tensors])."""
+    scores = np.asarray(input._data_)
+    if scores.ndim == 2 and scores.shape[1] == 2:
+        scores = scores[:, 1]
+    lbl = np.asarray(label._data_).reshape(-1)
+    order = np.argsort(-scores.reshape(-1))
+    lbl_sorted = lbl[order]
+    pos = lbl_sorted.sum()
+    neg = len(lbl_sorted) - pos
+    if pos == 0 or neg == 0:
+        val = 0.5
+    else:
+        ranks = np.arange(1, len(lbl_sorted) + 1)
+        pos_rank_sum = ranks[lbl_sorted == 1].sum()
+        val = float((len(lbl_sorted) * (len(lbl_sorted) + 1) / 2
+                     - pos_rank_sum - pos * (pos + 1) / 2) / (pos * neg))
+    t = Tensor(jnp.asarray(np.float32(val)))
+    return t, t, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
+    """CTR metrics bundle (reference: static/nn/metric.py): returns
+    (auc, sqrerr, abserr, prob, q, pos, total)."""
+    scores = np.asarray(input._data_).reshape(-1)
+    lbl = np.asarray(label._data_).reshape(-1).astype(np.float32)
+    auc_t, _, _ = auc(input, label)
+    sqrerr = Tensor(jnp.asarray(np.float32(((scores - lbl) ** 2).sum())))
+    abserr = Tensor(jnp.asarray(np.float32(np.abs(scores - lbl).sum())))
+    prob = Tensor(jnp.asarray(np.float32(scores.sum())))
+    q = Tensor(jnp.asarray(np.float32(scores.sum())))
+    pos = Tensor(jnp.asarray(np.float32(lbl.sum())))
+    total = Tensor(jnp.asarray(np.float32(len(lbl))))
+    return auc_t, sqrerr, abserr, prob, q, pos, total
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference: static/__init__.py
+    ExponentialMovingAverage with apply()/restore())."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._params = []
+        self._step = 0
+
+    def update(self, parameters=None):
+        params = parameters
+        if params is None:
+            params = self._params
+        else:
+            self._params = list(params)
+        self._step += 1
+        for p in params:
+            cur = p._data_.astype(jnp.float32)
+            if id(p) not in self._ema:
+                self._ema[id(p)] = cur
+            else:
+                d = self._decay
+                self._ema[id(p)] = d * self._ema[id(p)] + (1 - d) * cur
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._data_
+            p._data_ = self._ema[id(p)].astype(p._data_.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data_ = self._backup.pop(id(p))
+
+
+# ---------------- program/state serialization ----------------
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    from . import default_main_program
+    prog = default_main_program()
+    return pickle.dumps({"kind": "paddle_tpu_program",
+                         "desc": repr(prog)})
+
+
+def deserialize_program(data):
+    from . import Program
+    meta = pickle.loads(data)
+    assert meta.get("kind") == "paddle_tpu_program"
+    return Program()
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    from . import global_scope
+    scope = global_scope()
+    state = {k: np.asarray(v._data_) for k, v in scope._vars.items()
+             if isinstance(v, Tensor)}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    from . import global_scope
+    state = pickle.loads(data)
+    scope = global_scope()
+    for k, v in state.items():
+        scope._vars[k] = Tensor(jnp.asarray(v))
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams" if not model_path.endswith(
+            ".pdparams") else model_path, "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    from . import global_scope
+    scope = global_scope()
+    for k, v in state_dict.items():
+        scope._vars[k] = Tensor(jnp.asarray(v))
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program

@@ -1,0 +1,487 @@
+"""paddle.static.nn (reference: python/paddle/static/nn/__init__.py) —
+the static-graph functional layer API.  Parameters are created inline via
+create_parameter (the reference creates them in the startup program);
+control-flow ops forward to the dygraph implementations, which the tracer
+compiles.  Sequence ops operate on (data, lengths) pairs — LoD made
+explicit, the TPU-friendly padded-batch form."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from .compat import create_parameter, py_func  # noqa: F401
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = None
+    for xi in xs:
+        flat = xi.reshape([int(np.prod(xi.shape[:num_flatten_dims])), -1])
+        w = create_parameter([flat.shape[-1], size], "float32",
+                             attr=weight_attr)
+        y = F.linear(flat, w)
+        out = y if out is None else out + y
+    if bias_attr is not False:
+        b = create_parameter([size], "float32", attr=bias_attr,
+                             is_bias=True)
+        out = out + b
+    if activation:
+        out = getattr(F, activation)(out)
+    return out.reshape(list(xs[0].shape[:num_flatten_dims]) + [size])
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,  # noqa: A002
+              padding_idx=None, param_attr=None, dtype="float32"):
+    w = create_parameter(list(size), dtype, attr=param_attr)
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+sparse_embedding = embedding
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = create_parameter([num_filters, cin // (groups or 1), k[0], k[1]],
+                         "float32", attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], "float32", attr=bias_attr, is_bias=True)
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups or 1,
+                   data_format=data_format)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    cin = input.shape[1]
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    w = create_parameter([num_filters, cin // (groups or 1), *k],
+                         "float32", attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], "float32", attr=bias_attr, is_bias=True)
+    out = F.conv3d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups or 1)
+    return getattr(F, act)(out) if act else out
+
+
+def conv2d_transpose(input, num_filters, output_size=None,  # noqa: A002
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCHW"):
+    cin = input.shape[1]
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = create_parameter([cin, num_filters // (groups or 1), k[0], k[1]],
+                         "float32", attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], "float32", attr=bias_attr, is_bias=True)
+    out = F.conv2d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups or 1,
+                             output_size=output_size)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None,  # noqa: A002
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCDHW"):
+    cin = input.shape[1]
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    w = create_parameter([cin, num_filters // (groups or 1), *k],
+                         "float32", attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], "float32", attr=bias_attr, is_bias=True)
+    out = F.conv3d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups or 1,
+                             output_size=output_size)
+    return getattr(F, act)(out) if act else out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,  # noqa: A002
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = create_parameter([c], "float32", attr=param_attr)
+    scale._data_ = jnp.ones_like(scale._data_)
+    bias = create_parameter([c], "float32", attr=bias_attr, is_bias=True)
+    out = F.batch_norm(input, Tensor(jnp.zeros((c,), jnp.float32)),
+                       Tensor(jnp.ones((c,), jnp.float32)), weight=scale,
+                       bias=bias, training=not use_global_stats,
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,  # noqa: A002
+                  name=None):
+    c = input.shape[1]
+    scale = create_parameter([c], "float32", attr=param_attr)
+    scale._data_ = jnp.ones_like(scale._data_)
+    bias = create_parameter([c], "float32", attr=bias_attr, is_bias=True)
+    return F.instance_norm(input, weight=scale, bias=bias, eps=epsilon)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,  # noqa: A002
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = list(input.shape[begin_norm_axis:])
+    n = int(np.prod(shape))
+    w = create_parameter([n], "float32", attr=param_attr) if scale else None
+    if w is not None:
+        w._data_ = jnp.ones_like(w._data_)
+    b = create_parameter([n], "float32", attr=bias_attr, is_bias=True) \
+        if shift else None
+    flat = input.reshape(list(input.shape[:begin_norm_axis]) + [n])
+    out = F.layer_norm(flat, n, weight=w, bias=b, epsilon=epsilon)
+    out = out.reshape(list(input.shape))
+    return getattr(F, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,  # noqa: A002
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    c = input.shape[1]
+    w = create_parameter([c], "float32", attr=param_attr)
+    w._data_ = jnp.ones_like(w._data_)
+    b = create_parameter([c], "float32", attr=bias_attr, is_bias=True)
+    out = F.group_norm(input, groups, weight=w, bias=b, epsilon=epsilon)
+    return getattr(F, act)(out) if act else out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,  # noqa: A002
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_rate=0.9999999, sync_stats=False,
+              enable_scale_and_shift=False):
+    """Feature-scale normalization by accumulated batch statistics
+    (reference: static/nn/common.py data_norm, PS-style CTR models)."""
+    mean = input.mean(axis=0, keepdim=True)
+    var = ((input - mean) ** 2).mean(axis=0, keepdim=True)
+    out = (input - mean) / (var + epsilon).sqrt()
+    return getattr(F, act)(out) if act else out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    if mode == "all":
+        n = 1
+    elif mode == "channel":
+        n = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    else:
+        n = int(np.prod(x.shape[1:]))
+    w = create_parameter([n], "float32", attr=param_attr)
+    w._data_ = jnp.full_like(w._data_, 0.25)
+    return F.prelu(x, w, data_format=data_format)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    w = create_parameter([size, x.shape[-1], y.shape[-1]], "float32",
+                         attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [1, size], "float32", attr=bias_attr, is_bias=True)
+    out = F.bilinear(x, y, w, b.reshape([-1]) if b is not None else None)
+    return getattr(F, act)(out) if act else out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn.layers_extra import SpectralNorm
+    return SpectralNorm(list(weight.shape), dim=dim,
+                        power_iters=power_iters, eps=eps)(weight)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..vision.ops import deform_conv2d as _dc
+    cin = x.shape[1]
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = create_parameter([num_filters, cin // groups, k[0], k[1]],
+                         "float32", attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], "float32", attr=bias_attr, is_bias=True)
+    return _dc(x, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference: static/nn/common.py
+    nce) — uniform negative sampling."""
+    d = input.shape[-1]
+    n_neg = num_neg_samples or 10
+    w = create_parameter([num_total_classes, d], "float32",
+                         attr=param_attr)
+    b = create_parameter([num_total_classes], "float32", attr=bias_attr,
+                         is_bias=True)
+    lbl = label.reshape([-1]).astype("int64")
+    pos_logit = (input * w.gather(lbl)).sum(axis=-1) + b.gather(lbl)
+    key = _next_key()
+    neg = Tensor(jax.random.randint(key, (n_neg,), 0, num_total_classes))
+    neg_logit = input @ w.gather(neg).t() + b.gather(neg)
+    pos_loss = -F.log_sigmoid(pos_logit)
+    neg_loss = -F.log_sigmoid(-neg_logit).sum(axis=-1)
+    return (pos_loss + neg_loss).reshape([-1, 1])
+
+
+def _next_key():
+    from ..core import state
+    return state.next_rng_key()
+
+
+# ---------------- control flow (forward to the traced impls) ----------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    from ..tensor_ops.control import cond as _cond
+    return _cond(pred, true_fn, false_fn)
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    from ..tensor_ops.control import while_loop as _wl
+    return _wl(cond_fn, body, loop_vars)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        if bool(np.asarray(pred._data_ if isinstance(pred, Tensor)
+                           else pred)):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(np.asarray(branch_index._data_
+                         if isinstance(branch_index, Tensor)
+                         else branch_index))
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) \
+        else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    return fns[max(fns)]()
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    from ..autograd import PyLayer
+
+    class _P(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            return backward_fn(*grads)
+
+    return _P.apply(*inputs)
+
+
+# ---------------- sequence ops over (data, lengths) ----------------
+
+def _lengths_mask(lengths, max_len):
+    ar = jnp.arange(max_len)
+    return ar[None, :] < lengths._data_.reshape(-1, 1)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Ragged rows (list of Tensors) → (padded [B, T, ...], lengths)."""
+    seqs = x if isinstance(x, (list, tuple)) else [x]
+    t_max = maxlen or max(s.shape[0] for s in seqs)
+    pv = float(pad_value if not isinstance(pad_value, Tensor)
+               else pad_value.item())
+    rows, lens = [], []
+    for s in seqs:
+        n = s.shape[0]
+        pad_n = t_max - n
+        arr = s._data_
+        pad_width = [(0, pad_n)] + [(0, 0)] * (arr.ndim - 1)
+        rows.append(jnp.pad(arr, pad_width, constant_values=pv))
+        lens.append(n)
+    return (Tensor(jnp.stack(rows)),
+            Tensor(jnp.asarray(lens, jnp.int64)))
+
+
+def sequence_unpad(x, length, name=None):
+    lens = np.asarray(length._data_).reshape(-1).tolist()
+    return [Tensor(x._data_[i, :int(n)]) for i, n in enumerate(lens)]
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,  # noqa: A002
+                  lengths=None, name=None):
+    data = input._data_
+    b, t = data.shape[0], data.shape[1]
+    mask = _lengths_mask(lengths, t) if lengths is not None else \
+        jnp.ones((b, t), bool)
+    m = mask[(...,) + (None,) * (data.ndim - 2)]
+    pt = pool_type.lower()
+    if pt == "sum":
+        return Tensor(jnp.where(m, data, 0).sum(axis=1))
+    if pt == "average":
+        denom = jnp.maximum(mask.sum(axis=1), 1)[(...,) + (None,) *
+                                                 (data.ndim - 2)]
+        return Tensor(jnp.where(m, data, 0).sum(axis=1) / denom)
+    if pt == "max":
+        return Tensor(jnp.where(m, data, -jnp.inf).max(axis=1))
+    if pt == "sqrt":
+        denom = jnp.sqrt(jnp.maximum(mask.sum(axis=1), 1).astype(
+            data.dtype))[(...,) + (None,) * (data.ndim - 2)]
+        return Tensor(jnp.where(m, data, 0).sum(axis=1) / denom)
+    if pt in ("first", "last"):
+        if pt == "first":
+            return Tensor(data[:, 0])
+        idx = (jnp.maximum(lengths._data_.reshape(-1), 1) - 1
+               if lengths is not None
+               else jnp.full((b,), t - 1))
+        return Tensor(data[jnp.arange(b), idx.astype(jnp.int32)])
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+def sequence_first_step(input, lengths=None, name=None):  # noqa: A002
+    return sequence_pool(input, "first", lengths=lengths)
+
+
+def sequence_last_step(input, lengths=None, name=None):  # noqa: A002
+    return sequence_pool(input, "last", lengths=lengths)
+
+
+def sequence_softmax(input, lengths=None, use_cudnn=False, name=None):  # noqa: A002
+    data = input._data_
+    t = data.shape[1]
+    mask = _lengths_mask(lengths, t) if lengths is not None else \
+        jnp.ones(data.shape[:2], bool)
+    logits = jnp.where(mask, data, -jnp.inf)
+    return Tensor(jax.nn.softmax(logits, axis=1))
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    data = x._data_
+    t = data.shape[1]
+    if lengths is None:
+        return Tensor(data[:, ::-1])
+    lens = lengths._data_.reshape(-1, 1)
+    ar = jnp.arange(t)[None, :]
+    idx = jnp.where(ar < lens, lens - 1 - ar, ar)
+    return Tensor(jnp.take_along_axis(
+        data, idx[(...,) + (None,) * (data.ndim - 2)].astype(jnp.int32)
+        if data.ndim > 2 else idx.astype(jnp.int32), axis=1))
+
+
+def sequence_concat(input, name=None):  # noqa: A002
+    return Tensor(jnp.concatenate([t._data_ for t in input], axis=1))
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    reps = y.shape[1] if y.ndim > 1 else 1
+    return Tensor(jnp.repeat(x._data_, reps, axis=0))
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_reshape(input, new_dim, name=None):  # noqa: A002
+    data = input._data_
+    return Tensor(data.reshape(data.shape[0], -1, new_dim))
+
+
+def sequence_slice(input, offset, length, name=None):  # noqa: A002
+    data = input._data_
+    off = np.asarray(offset._data_ if isinstance(offset, Tensor)
+                     else offset).reshape(-1)
+    ln = np.asarray(length._data_ if isinstance(length, Tensor)
+                    else length).reshape(-1)
+    rows = [data[i, int(o):int(o) + int(n)]
+            for i, (o, n) in enumerate(zip(off, ln))]
+    return Tensor(jnp.stack(rows)) if len({r.shape for r in rows}) == 1 \
+        else rows
+
+
+def sequence_scatter(input, index, updates, name=None):  # noqa: A002
+    data = input._data_
+    idx = index._data_.astype(jnp.int32)
+    return Tensor(data.at[jnp.arange(data.shape[0])[:, None], idx].add(
+        updates._data_))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):  # noqa: A002
+    data = input._data_
+    b, t = data.shape[:2]
+    cols = []
+    for w in range(win_size):
+        shifted = jnp.concatenate(
+            [data[:, w:], jnp.full((b, w) + data.shape[2:], pad_value,
+                                   data.dtype)], axis=1)
+        cols.append(shifted)
+    return Tensor(jnp.stack(cols, axis=-1))
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,  # noqa: A002
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Windowed sequence convolution: context window flattened then
+    projected (reference: static/nn/sequence_lod.py sequence_conv)."""
+    data = input._data_  # [B, T, D]
+    d = data.shape[-1]
+    w = create_parameter([filter_size * d, num_filters], "float32",
+                         attr=param_attr)
+    start = padding_start if padding_start is not None \
+        else -(filter_size // 2)
+    cols = []
+    t = data.shape[1]
+    for k in range(filter_size):
+        shift = start + k
+        if shift < 0:
+            pad = jnp.zeros((data.shape[0], -shift, d), data.dtype)
+            piece = jnp.concatenate([pad, data[:, :t + shift]], axis=1)
+        elif shift > 0:
+            pad = jnp.zeros((data.shape[0], shift, d), data.dtype)
+            piece = jnp.concatenate([data[:, shift:], pad], axis=1)
+        else:
+            piece = data
+        cols.append(piece)
+    ctx = jnp.concatenate(cols, axis=-1)  # [B, T, k*D]
+    out = F.linear(Tensor(ctx), w)
+    if bias_attr is not False:
+        b = create_parameter([num_filters], "float32", attr=bias_attr,
+                             is_bias=True)
+        out = out + b
+    return getattr(F, act)(out) if act else out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):  # noqa: A002
+    """Lookahead row convolution (reference: static/nn/common.py
+    row_conv, DeepSpeech2)."""
+    data = input._data_  # [B, T, D]
+    d = data.shape[-1]
+    k = future_context_size + 1
+    w = create_parameter([k, d], "float32", attr=param_attr)
+    t = data.shape[1]
+    out = jnp.zeros_like(data)
+    for i in range(k):
+        piece = jnp.concatenate(
+            [data[:, i:], jnp.zeros((data.shape[0], i, d), data.dtype)],
+            axis=1)
+        out = out + piece * w._data_[i]
+    out = Tensor(out)
+    return getattr(F, act)(out) if act else out

@@ -1,0 +1,30 @@
+"""Control-flow ops (reference: python/paddle/static/nn/control_flow.py —
+cond/while_loop as program ops).
+
+TPU-native realization: the predicate read goes through Tensor.__bool__,
+which the two-phase tracer records as an in-graph GUARD — so under
+`to_static` each taken branch compiles to its own entry and re-dispatches
+on the branch bit (the SOT analog), while eager execution is a plain
+python branch.  A data-dependent `while_loop` trip count is inherently
+host-driven (the reference unrolls it as a program op; XLA would need
+lax.while_loop with traced state, which the eager tape cannot replay), so
+it runs as a python loop — each iteration's body is still traced/compiled
+work."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    taken = bool(pred) if isinstance(pred, Tensor) else bool(pred)
+    if taken:
+        return true_fn() if true_fn is not None else None
+    return false_fn() if false_fn is not None else None
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    vars_ = list(loop_vars)
+    while bool(cond_fn(*vars_)):
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_

@@ -3,3 +3,32 @@ from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 
 from . import ops  # noqa: F401
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference: vision/image.py image_load)."""
+    b = backend or _image_backend
+    if b == "cv2":
+        raise NotImplementedError("cv2 not available in this environment")
+    from PIL import Image
+    img = Image.open(path)
+    if b == "tensor":
+        import numpy as np
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(np.asarray(img)))
+    return img

@@ -183,3 +183,80 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         return jax.vmap(one_roi)(img_of_roi, sb)
 
     return apply_op("roi_pool", fn, (x, boxes, boxes_num))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference:
+    python/paddle/vision/ops.py deform_conv2d over the CUDA
+    deformable_conv kernel).  TPU-native: per-tap bilinear gathers
+    (vectorized over the kernel window) followed by a grouped 1x1
+    contraction — sampling rides the gather unit, the contraction the
+    MXU.
+
+    x [N,Cin,H,W]; offset [N, 2*dg*kh*kw, Ho, Wo];
+    mask [N, dg*kh*kw, Ho, Wo] (v2) or None (v1);
+    weight [Cout, Cin//groups, kh, kw]."""
+    import numpy as np
+
+    def fn(xa, off, w, b, m):
+        n, cin, h, wid = xa.shape
+        cout, cin_g, kh, kw = w.shape
+        sh, sw = (stride, stride) if isinstance(stride, int) else stride
+        ph, pw = (padding, padding) if isinstance(padding, int) else padding
+        dh, dw = (dilation, dilation) if isinstance(dilation, int) \
+            else dilation
+        ho = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        wo = (wid + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        dg = deformable_groups
+        off = off.reshape(n, dg, kh * kw, 2, ho, wo)
+        if m is not None:
+            m = m.reshape(n, dg, kh * kw, ho, wo)
+        base_y = (jnp.arange(ho) * sh - ph)[:, None]
+        base_x = (jnp.arange(wo) * sw - pw)[None, :]
+        cpg = cin // dg  # channels per deformable group
+        taps = []
+        for ki in range(kh):
+            for kj in range(kw):
+                t = ki * kw + kj
+                # sample position per deformable group: [N, dg, Ho, Wo]
+                py = base_y[None, None] + ki * dh + off[:, :, t, 0]
+                px = base_x[None, None] + kj * dw + off[:, :, t, 1]
+                y0 = jnp.floor(py)
+                x0 = jnp.floor(px)
+                wy = py - y0
+                wx = px - x0
+
+                def gather(yy, xx):
+                    yi = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+                    xi = jnp.clip(xx.astype(jnp.int32), 0, wid - 1)
+                    # group-expanded gather: [N, dg, Cpg, Ho, Wo]
+                    xg = xa.reshape(n, dg, cpg, h, wid)
+                    ni = jnp.arange(n)[:, None, None, None]
+                    gi = jnp.arange(dg)[None, :, None, None]
+                    v = xg[ni, gi, :, yi, xi]      # [N,dg,Ho,Wo,Cpg]
+                    inb = ((yy >= 0) & (yy <= h - 1) &
+                           (xx >= 0) & (xx <= wid - 1))
+                    return jnp.moveaxis(v, -1, 2) * \
+                        inb[:, :, None].astype(xa.dtype)
+
+                val = ((1 - wy) * (1 - wx))[:, :, None] * gather(y0, x0) \
+                    + ((1 - wy) * wx)[:, :, None] * gather(y0, x0 + 1) \
+                    + (wy * (1 - wx))[:, :, None] * gather(y0 + 1, x0) \
+                    + (wy * wx)[:, :, None] * gather(y0 + 1, x0 + 1)
+                if m is not None:
+                    val = val * m[:, :, t][:, :, None]
+                taps.append(val.reshape(n, cin, ho, wo))
+        # [N, kh*kw, Cin, Ho, Wo] → grouped contraction with the kernel
+        col = jnp.stack(taps, axis=1)
+        col = col.reshape(n, kh * kw, groups, cin // groups, ho, wo)
+        wg = w.reshape(groups, cout // groups, cin // groups, kh * kw)
+        out = jnp.einsum("nkgchw,gfck->ngfhw", col, wg)
+        out = out.reshape(n, cout, ho, wo)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out.astype(xa.dtype)
+
+    args = (x, offset, weight, bias, mask)
+    return apply_op("deform_conv2d", fn, args)

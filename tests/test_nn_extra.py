@@ -231,10 +231,14 @@ def test_beam_search_decoder():
                                paddle.nn.functional.one_hot(t, V))
     init = paddle.zeros([B, 1])
     out, _ = paddle.nn.dynamic_decode(dec, inits=init, max_step_num=6)
-    seqs = np.asarray(out.numpy())
-    assert seqs.shape[:2] == (B, beam)
-    # best beam: 1,2,3,4 then end padding
-    np.testing.assert_array_equal(seqs[0, 0, :4], [1, 2, 3, 4])
+    seqs = np.asarray(out.numpy())          # [batch, time, beam]
+    assert seqs.shape[0] == B and seqs.shape[2] == beam
+    # best beam counts up: 1,2,3,4 then end padding
+    np.testing.assert_array_equal(seqs[0, :4, 0], [1, 2, 3, 4])
+    # time-major flag transposes the leading dims
+    out_tm, _ = paddle.nn.dynamic_decode(dec, inits=init, max_step_num=6,
+                                         output_time_major=True)
+    assert list(out_tm.shape)[:2] == [seqs.shape[1], B]
 
 
 def test_io_extras():

@@ -1,0 +1,137 @@
+"""Subpackage __all__ parity vs the reference + functional smoke of the
+static/sparse/fft compat surface."""
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _ref_all(path):
+    s = open(path).read()
+    return set(re.findall(r"'([^']+)'",
+                          re.search(r"__all__ = \[(.*?)\]", s, re.S).group(1)))
+
+
+def test_all_subpackages_parity():
+    R = "/root/reference/python/paddle"
+    for mod, path in [
+            (paddle.static, f"{R}/static/__init__.py"),
+            (paddle.static.nn, f"{R}/static/nn/__init__.py"),
+            (paddle.amp, f"{R}/amp/__init__.py"),
+            (paddle.vision, f"{R}/vision/__init__.py"),
+            (paddle.fft, f"{R}/fft.py"),
+            (paddle.sparse, f"{R}/sparse/__init__.py"),
+            (paddle.distribution, f"{R}/distribution/__init__.py")]:
+        missing = sorted(s for s in _ref_all(path) if not hasattr(mod, s))
+        assert missing == [], f"{path}: {missing}"
+
+
+def test_sparse_ops():
+    sp = paddle.sparse
+    x = sp.sparse_coo_tensor([[0, 1], [1, 0]], [2.0, -3.0], [2, 2])
+    np.testing.assert_allclose(sp.abs(x).to_dense().numpy(),
+                               [[0, 2], [3, 0]])
+    np.testing.assert_allclose(
+        sp.mv(x, paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+        .numpy(), [4.0, -3.0])
+    np.testing.assert_allclose(sp.multiply(x, x).to_dense().numpy(),
+                               [[0, 4], [9, 0]])
+    np.testing.assert_allclose(
+        sp.transpose(x, [1, 0]).to_dense().numpy(), [[0, -3], [2, 0]])
+    m = sp.masked_matmul(paddle.ones([2, 3]), paddle.ones([3, 2]), x)
+    np.testing.assert_allclose(m.to_dense().numpy(), [[0, 3], [3, 0]])
+    assert sp.is_same_shape(x, x)
+    assert float(sp.sum(x)) == pytest.approx(-1.0)
+    u, s, v = sp.pca_lowrank(x, q=1)
+    assert u.shape == [2, 1] and s.shape == [1]
+
+
+def test_static_nn_fc_trains():
+    import paddle_tpu.static as static
+    x = paddle.to_tensor(np.ones((3, 4), np.float32))
+    out = static.nn.fc(x, 5, activation="relu")
+    assert out.shape == [3, 5]
+    out2 = static.nn.conv2d(paddle.ones([1, 2, 6, 6]), 3, 3, act="relu")
+    assert out2.shape == [1, 3, 4, 4]
+    seq = paddle.to_tensor(
+        np.arange(12, dtype=np.float32).reshape(2, 3, 2))
+    lens = paddle.to_tensor(np.array([2, 3]))
+    pooled = static.nn.sequence_pool(seq, "average", lengths=lens)
+    np.testing.assert_allclose(pooled.numpy()[0],
+                               seq.numpy()[0, :2].mean(0))
+    last = static.nn.sequence_last_step(seq, lengths=lens)
+    np.testing.assert_allclose(last.numpy()[0], seq.numpy()[0, 1])
+    rev = static.nn.sequence_reverse(seq, lengths=lens)
+    np.testing.assert_allclose(rev.numpy()[0, 0], seq.numpy()[0, 1])
+    np.testing.assert_allclose(rev.numpy()[0, 2], seq.numpy()[0, 2])
+
+
+def test_static_control_flow_and_metrics():
+    import paddle_tpu.static as static
+    r = static.nn.cond(paddle.to_tensor(np.array(True)),
+                       lambda: paddle.ones([2]),
+                       lambda: paddle.zeros([2]))
+    np.testing.assert_allclose(r.numpy(), [1, 1])
+    i, = static.nn.while_loop(
+        lambda i: i < 5,
+        lambda i: i + 1,
+        [paddle.to_tensor(np.array(0.0, np.float32))])
+    assert float(i) == 5.0
+    pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    lbl = paddle.to_tensor(np.array([[1], [0]]))
+    acc = static.accuracy(pred, lbl)
+    assert float(acc) == pytest.approx(1.0)
+    a, _, _ = static.auc(pred, lbl)
+    assert float(a) == pytest.approx(1.0)
+
+
+def test_static_ema():
+    import paddle_tpu.static as static
+    p = paddle.create_parameter([2], "float32")
+    with paddle.no_grad():
+        paddle.fill_(p, 1.0) if hasattr(paddle, "fill_") else None
+        p.set_value(np.ones(2, np.float32))
+    ema = static.ExponentialMovingAverage(decay=0.5)
+    ema.update([p])
+    with paddle.no_grad():
+        p.set_value(np.full(2, 3.0, np.float32))
+    ema.update([p])
+    with ema.apply():
+        np.testing.assert_allclose(p.numpy(), [2.0, 2.0])  # 0.5*1+0.5*3
+    np.testing.assert_allclose(p.numpy(), [3.0, 3.0])  # restored
+
+
+def test_deform_conv2d_zero_offset_is_conv():
+    from paddle_tpu.vision.ops import deform_conv2d
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(1, 4, 6, 6)).astype(np.float32))
+    w = paddle.to_tensor(rng.normal(size=(5, 4, 3, 3)).astype(np.float32))
+    off = paddle.zeros([1, 18, 4, 4])
+    got = deform_conv2d(x, off, w)
+    ref = paddle.nn.functional.conv2d(x, w)
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), atol=1e-4)
+    m = paddle.ones([1, 9, 4, 4]) * 0.5
+    np.testing.assert_allclose(deform_conv2d(x, off, w, mask=m).numpy(),
+                               0.5 * ref.numpy(), atol=1e-4)
+
+
+def test_fft_hermitian_family():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 5)).astype(np.complex64)
+    got = paddle.fft.hfft2(paddle.to_tensor(x)).numpy()
+    ref = np.fft.hfft(np.fft.fft(x, axis=0), axis=1)
+    np.testing.assert_allclose(got, ref, atol=1e-3)
+    y = rng.normal(size=(4, 8)).astype(np.float32)
+    got = paddle.fft.ihfft2(paddle.to_tensor(y)).numpy()
+    ref = np.fft.ifft(np.fft.ihfft(y, axis=1), axis=0)
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_vision_image_backend():
+    paddle.vision.set_image_backend("pil")
+    assert paddle.vision.get_image_backend() == "pil"
+    with pytest.raises(ValueError):
+        paddle.vision.set_image_backend("nope")
+    assert paddle.amp.is_bfloat16_supported()
