@@ -169,3 +169,28 @@ def hessian(func, xs, create_graph=False):
         h = hess[0][0] if isinstance(hess, tuple) else hess
         return Tensor(h)
     return hess
+
+
+class saved_tensors_hooks:
+    """Hooks over tensors the autograd engine saves for backward
+    (reference: autograd/saved_tensors_hooks.py).  pack_hook runs when a
+    forward op records its inputs on the tape; unpack_hook runs when
+    backward consumes them.  On this backend the op's residuals live
+    inside jax.vjp closures, so the hooks see the op's INPUT tensors —
+    the offload/inspection side effects match, numerics are unaffected."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from ..core import state as _state
+        self._prev = getattr(_state.STATE, "saved_tensor_hooks", None)
+        _state.STATE.saved_tensor_hooks = (self.pack_hook,
+                                           self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import state as _state
+        _state.STATE.saved_tensor_hooks = self._prev
+        return False

@@ -25,7 +25,7 @@ class GradNode:
     """One autograd graph node = one recorded op."""
 
     __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "single_output",
-                 "pure", "__weakref__")
+                 "pure", "packed_saved", "saved_hooks", "__weakref__")
 
     def __init__(self, name, vjp_fn, inputs, out_avals, single_output,
                  pure=None):
@@ -35,6 +35,8 @@ class GradNode:
         self.out_avals = out_avals    # [(shape, dtype), ...]
         self.single_output = single_output
         self.pure = pure              # primal fn, kept for create_graph replay
+        self.packed_saved = None      # saved_tensors_hooks pack() results
+        self.saved_hooks = None
 
     def __repr__(self):
         return f"<GradNode {self.name}>"
@@ -159,6 +161,13 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
             cots.append(g)
         if not any_live:
             continue
+        if node.packed_saved is not None:
+            # saved_tensors_hooks: unpack fires when backward consumes
+            # this node's saved tensors (both vjp and create_graph paths)
+            _, _unpack = node.saved_hooks
+            for _packed in node.packed_saved:
+                _unpack(_packed)
+            node.packed_saved = None
         if create_graph and node.pure is not None:
             # Higher-order mode: re-derive the VJP as a *recorded op* over
             # (cotangents, primal inputs) so the gradient computation itself

@@ -139,6 +139,13 @@ def apply_op(name, fn, args, static=None, nondiff=False):
     if need_grad:
         out_avals = [(o.shape, o.dtype) for o in outs]
         node = GradNode(name, vjp_fn, tensors, out_avals, single, pure=pure)
+        hooks = getattr(_state.STATE, "saved_tensor_hooks", None)
+        if hooks is not None:
+            # autograd.saved_tensors_hooks: pack runs at save time; the
+            # packed values are unpacked when backward reaches this node
+            pack, _ = hooks
+            node.packed_saved = [pack(t) for t in tensors]
+            node.saved_hooks = hooks
     for i, o in enumerate(outs):
         t = Tensor(o, stop_gradient=not need_grad)
         if node is not None:

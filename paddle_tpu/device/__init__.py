@@ -93,3 +93,78 @@ def cuda_stream_guard(*a, **k):
     def _g():
         yield
     return _g()
+
+
+class XPUPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(xpu:{self.device_id})"
+
+
+class IPUPlace:
+    def __repr__(self):
+        return "Place(ipu)"
+
+
+def get_cudnn_version():
+    """No cuDNN in an XLA/TPU runtime (reference returns the linked
+    version on CUDA builds)."""
+    return None
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type):
+    return device_type in ("tpu", "axon")
+
+
+def get_all_device_type():
+    import jax
+    try:
+        return sorted({d.platform for d in jax.devices()} | {"cpu"})
+    except Exception:
+        return ["cpu"]
+
+
+def get_all_custom_device_type():
+    return [t for t in get_all_device_type() if t not in ("cpu", "gpu")]
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if not d.startswith(("cpu", "gpu"))]
+
+
+def current_stream(device=None):
+    """XLA orders execution per device; the Stream object is the
+    compatibility handle (reference: device/cuda streams)."""
+    return Stream(device)
+
+
+def set_stream(stream):
+    return stream
+
+
+def stream_guard(stream):
+    """Context placing ops on a stream (reference: device/__init__.py
+    stream_guard) — XLA orders per-device execution, so this scopes the
+    compatibility handle only."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        yield stream
+    return ctx()

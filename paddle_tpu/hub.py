@@ -1,0 +1,47 @@
+"""paddle.hub (reference: python/paddle/hub.py): list/help/load models
+from a hubconf.py.  Local directories work fully; github sources require
+network access this environment doesn't have and raise clearly."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve(repo_dir, source):
+    if source == "local":
+        return _load_hubconf(repo_dir)
+    raise RuntimeError(
+        "paddle.hub: only source='local' is supported in this "
+        "environment (no network egress for github/gitee sources)")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    mod = _resolve(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    mod = _resolve(repo_dir, source)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    mod = _resolve(repo_dir, source)
+    return getattr(mod, model)(**kwargs)

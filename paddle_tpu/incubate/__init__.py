@@ -2,3 +2,238 @@
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import asp  # noqa: F401
+
+# top-level incubate surface (reference: incubate/__init__.py __all__)
+from ..geometric import (  # noqa: F401
+    segment_sum, segment_mean, segment_max, segment_min,
+)
+
+
+def _sampler_rng():
+    """Per-call RNG derived from the framework key stream so repeated
+    sampling draws fresh neighborhoods (and paddle.seed reproduces)."""
+    import numpy as np
+    from ..core import state as _state
+    key = _state.next_rng_key()
+    return np.random.default_rng(np.asarray(key, np.uint32))
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Gather-scatter message passing (reference: incubate/operators/
+    graph_send_recv.py; superseded by geometric.send_u_recv)."""
+    from ..geometric import segment_sum, segment_mean, segment_max, \
+        segment_min
+    from ..tensor_ops import manipulation as MA
+    gathered = MA.gather(x, src_index, axis=0)
+    red = {"sum": segment_sum, "mean": segment_mean,
+           "max": segment_max, "min": segment_min}[pool_type.lower()]
+    return red(gathered, dst_index, out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop neighbor sampling over CSC (eager, host-side — sampling is
+    data-dependent; reference: incubate/operators/graph_khop_sampler.py)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    rown = np.asarray(row._data_)
+    cp = np.asarray(colptr._data_)
+    nodes = np.asarray(input_nodes._data_).reshape(-1)
+    rng = _sampler_rng()
+    edge_src, edge_dst, edge_pos, frontier = [], [], [], nodes
+    for fanout in sample_sizes:
+        nxt = []
+        for v in frontier:
+            beg, end = int(cp[v]), int(cp[v + 1])
+            pos = np.arange(beg, end)
+            if fanout >= 0 and len(pos) > fanout:
+                pos = rng.choice(pos, size=fanout, replace=False)
+            for pidx in pos:
+                u = rown[pidx]
+                edge_src.append(int(u))
+                edge_dst.append(int(v))
+                edge_pos.append(int(pidx))
+                nxt.append(int(u))
+        frontier = np.asarray(nxt, np.int64) if nxt else np.empty(0, np.int64)
+    uniq, remap = np.unique(
+        np.concatenate([nodes, np.asarray(edge_src, np.int64),
+                        np.asarray(edge_dst, np.int64)]),
+        return_inverse=True)
+    n_in = len(nodes)
+    n_e = len(edge_src)
+    src_l = remap[n_in:n_in + n_e]
+    dst_l = remap[n_in + n_e:]
+    if return_eids:
+        pos = np.asarray(edge_pos, np.int64)
+        if sorted_eids is not None:
+            se = np.asarray(sorted_eids._data_).reshape(-1)
+            eids = se[pos]
+        else:
+            eids = pos  # CSC position IS the edge id absent a mapping
+        return (Tensor(jnp.asarray(src_l)), Tensor(jnp.asarray(dst_l)),
+                Tensor(jnp.asarray(uniq)), Tensor(jnp.asarray(eids)))
+    return (Tensor(jnp.asarray(src_l)), Tensor(jnp.asarray(dst_l)),
+            Tensor(jnp.asarray(uniq)), None)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a neighborhood into contiguous local ids (reference:
+    incubate/operators/graph_reindex.py)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    xs = np.asarray(x._data_).reshape(-1)
+    nb = np.asarray(neighbors._data_).reshape(-1)
+    uniq = {}
+    for v in np.concatenate([xs, nb]):
+        if int(v) not in uniq:
+            uniq[int(v)] = len(uniq)
+    reindex = np.asarray([uniq[int(v)] for v in nb], np.int64)
+    cnt = np.asarray(count._data_).reshape(-1)
+    dst = np.repeat(np.arange(len(xs)), cnt)
+    keys = np.asarray(sorted(uniq, key=uniq.get), np.int64)
+    return (Tensor(jnp.asarray(reindex)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(keys)))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """One-hop neighbor sampling (reference:
+    incubate/operators/graph_sample_neighbors.py)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    rown = np.asarray(row._data_)
+    cp = np.asarray(colptr._data_)
+    nodes = np.asarray(input_nodes._data_).reshape(-1)
+    rng = _sampler_rng()
+    out, counts = [], []
+    for v in nodes:
+        beg, end = int(cp[v]), int(cp[v + 1])
+        neigh = rown[beg:end]
+        if sample_size >= 0 and len(neigh) > sample_size:
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out.extend(int(u) for u in neigh)
+        counts.append(len(neigh))
+    return (Tensor(jnp.asarray(np.asarray(out, np.int64))),
+            Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as the loss (IPU-era identity; reference:
+    incubate/operators/identity_loss.py)."""
+    if reduction in ("mean", 1):
+        return x.mean()
+    if reduction in ("sum", 0):
+        return x.sum()
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (reference:
+    incubate/operators/softmax_mask_fuse.py — a CUDA fusion; XLA fuses
+    the composition natively)."""
+    from ..nn import functional as F
+    return F.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    from ..nn import functional as F
+    import jax.numpy as jnp
+    from ..core.dispatch import apply_op
+
+    def fn(xa):
+        s_q, s_k = xa.shape[-2], xa.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), bool))
+        import jax
+        return jax.nn.softmax(jnp.where(causal, xa, -1e30), axis=-1)
+    return apply_op("softmax_mask_fuse_upper_triangle", fn, (x,))
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (reference: incubate/optimizer/lookahead.py):
+    k inner steps, then slow weights interpolate toward fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step = 0
+        self._slow = None
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step += 1
+        params = self.inner_optimizer._parameter_list
+        if self._slow is None:
+            self._slow = [p._data_ for p in params]
+        if self._step % self.k == 0:
+            import jax.numpy as jnp
+            for i, p in enumerate(params):
+                slow = self._slow[i] + self.alpha * (
+                    p._data_.astype(self._slow[i].dtype) - self._slow[i])
+                self._slow[i] = slow
+                p._data_ = slow.astype(p._data_.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step": self._step}
+
+
+class ModelAverage:
+    """Running average of parameters applied at eval (reference:
+    incubate/optimizer/modelaverage.py)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sums = None
+        self._count = 0
+        self._backup = {}
+
+    def step(self):
+        import jax.numpy as jnp
+        if self._sums is None:
+            self._sums = [jnp.zeros_like(p._data_, dtype=jnp.float32)
+                          for p in self._params]
+        self._count += 1
+        for i, p in enumerate(self._params):
+            self._sums[i] = self._sums[i] + p._data_.astype(jnp.float32)
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            for i, p in enumerate(self._params):
+                self._backup[id(p)] = p._data_
+                p._data_ = (self._sums[i] / max(self._count, 1)).astype(
+                    p._data_.dtype)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data_ = self._backup.pop(id(p))

@@ -66,3 +66,37 @@ class InputSpec:
 
     def __repr__(self):
         return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+_TO_STATIC_ENABLED = True
+_CODE_LEVEL = 100
+_VERBOSITY = 0
+
+
+def enable_to_static(flag=True):
+    """Globally toggle to_static compilation (reference: jit/api.py
+    enable_to_static): when off, StaticFunction runs eagerly."""
+    global _TO_STATIC_ENABLED
+    _TO_STATIC_ENABLED = bool(flag)
+
+
+def ignore_module(modules):
+    """Modules the dy2static transformer should skip (reference:
+    sot/opcode_translator skip rules) — recorded; the tracer's
+    graph-break fallback already handles foreign-module host code."""
+    _IGNORED_MODULES.extend(modules if isinstance(modules, (list, tuple))
+                            else [modules])
+
+
+_IGNORED_MODULES = []
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Log level for transformed-code dumps (reference: jit/set_code_level)."""
+    global _CODE_LEVEL
+    _CODE_LEVEL = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _VERBOSITY
+    _VERBOSITY = level

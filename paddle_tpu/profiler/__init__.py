@@ -6,3 +6,17 @@ from .timer import benchmark, TimerHub, mfu  # noqa: F401
 from ..ops.flops import FlopsCounter, count_flops  # noqa: F401
 from . import profiler_statistic  # noqa: F401
 from .profiler_statistic import SortedKeys, summary  # noqa: F401
+
+
+class SummaryView:
+    """Profiler stats view selector (reference: profiler/profiler.py
+    SummaryView enum)."""
+    DeviceView = "device"
+    OverView = "overview"
+    ModelView = "model"
+    DistributedView = "dist"
+    KernelView = "kernel"
+    OperatorView = "operator"
+    MemoryView = "memory"
+    MemoryManipulationView = "memory_manipulation"
+    UDFView = "udf"

@@ -125,9 +125,25 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None, checkpoints=None):
     """reference: base/backward.py append_backward — returns
     [(param, grad)] after running the backward pass."""
+    params = parameter_list
+    if params is None:
+        # default: every trainable leaf reachable from the loss's graph
+        params, seen, stack = [], set(), [loss]
+        while stack:
+            t = stack.pop()
+            node = getattr(t, "_grad_node", None)
+            if node is None:
+                if not t.stop_gradient and id(t) not in seen:
+                    seen.add(id(t))
+                    params.append(t)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.extend(node.inputs)
     loss.backward()
     out = []
-    for p in (parameter_list or []):
+    for p in params:
         if isinstance(p, Tensor) and p.grad is not None:
             out.append((p, p.grad))
     return out

@@ -299,9 +299,9 @@ def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
 
 # ---------------- sequence ops over (data, lengths) ----------------
 
-def _lengths_mask(lengths, max_len):
+def _lengths_mask(lengths_arr, max_len):
     ar = jnp.arange(max_len)
-    return ar[None, :] < lengths._data_.reshape(-1, 1)
+    return ar[None, :] < lengths_arr.reshape(-1, 1)
 
 
 def sequence_pad(x, pad_value, maxlen=None, name=None):
@@ -318,6 +318,7 @@ def sequence_pad(x, pad_value, maxlen=None, name=None):
         pad_width = [(0, pad_n)] + [(0, 0)] * (arr.ndim - 1)
         rows.append(jnp.pad(arr, pad_width, constant_values=pv))
         lens.append(n)
+    from ..core.dispatch import apply_op as _ao
     return (Tensor(jnp.stack(rows)),
             Tensor(jnp.asarray(lens, jnp.int64)))
 
@@ -329,32 +330,35 @@ def sequence_unpad(x, length, name=None):
 
 def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,  # noqa: A002
                   lengths=None, name=None):
-    data = input._data_
-    b, t = data.shape[0], data.shape[1]
-    mask = _lengths_mask(lengths, t) if lengths is not None else \
-        jnp.ones((b, t), bool)
-    m = mask[(...,) + (None,) * (data.ndim - 2)]
+    from ..core.dispatch import apply_op
     pt = pool_type.lower()
-    if pt == "sum":
-        return Tensor(jnp.where(m, data, 0).sum(axis=1))
-    if pt == "average":
-        denom = jnp.maximum(mask.sum(axis=1), 1)[(...,) + (None,) *
-                                                 (data.ndim - 2)]
-        return Tensor(jnp.where(m, data, 0).sum(axis=1) / denom)
-    if pt == "max":
-        return Tensor(jnp.where(m, data, -jnp.inf).max(axis=1))
-    if pt == "sqrt":
-        denom = jnp.sqrt(jnp.maximum(mask.sum(axis=1), 1).astype(
-            data.dtype))[(...,) + (None,) * (data.ndim - 2)]
-        return Tensor(jnp.where(m, data, 0).sum(axis=1) / denom)
-    if pt in ("first", "last"):
+
+    def fn(data, lens):
+        b, t = data.shape[0], data.shape[1]
+        mask = _lengths_mask(lens, t) if lens is not None else \
+            jnp.ones((b, t), bool)
+        m = mask[(...,) + (None,) * (data.ndim - 2)]
+        if pt == "sum":
+            return jnp.where(m, data, 0).sum(axis=1)
+        if pt == "average":
+            denom = jnp.maximum(mask.sum(axis=1), 1)[
+                (...,) + (None,) * (data.ndim - 2)]
+            return jnp.where(m, data, 0).sum(axis=1) / denom
+        if pt == "max":
+            return jnp.where(m, data, -jnp.inf).max(axis=1)
+        if pt == "sqrt":
+            denom = jnp.sqrt(jnp.maximum(mask.sum(axis=1), 1).astype(
+                data.dtype))[(...,) + (None,) * (data.ndim - 2)]
+            return jnp.where(m, data, 0).sum(axis=1) / denom
         if pt == "first":
-            return Tensor(data[:, 0])
-        idx = (jnp.maximum(lengths._data_.reshape(-1), 1) - 1
-               if lengths is not None
-               else jnp.full((b,), t - 1))
-        return Tensor(data[jnp.arange(b), idx.astype(jnp.int32)])
-    raise ValueError(f"unknown pool_type {pool_type}")
+            return data[:, 0]
+        if pt == "last":
+            idx = (jnp.maximum(lens.reshape(-1), 1) - 1
+                   if lens is not None else jnp.full((b,), t - 1))
+            return data[jnp.arange(b), idx.astype(jnp.int32)]
+        raise ValueError(f"unknown pool_type {pool_type}")
+
+    return apply_op("sequence_pool", fn, (input, lengths))
 
 
 def sequence_first_step(input, lengths=None, name=None):  # noqa: A002
@@ -366,34 +370,51 @@ def sequence_last_step(input, lengths=None, name=None):  # noqa: A002
 
 
 def sequence_softmax(input, lengths=None, use_cudnn=False, name=None):  # noqa: A002
-    data = input._data_
-    t = data.shape[1]
-    mask = _lengths_mask(lengths, t) if lengths is not None else \
-        jnp.ones(data.shape[:2], bool)
-    logits = jnp.where(mask, data, -jnp.inf)
-    return Tensor(jax.nn.softmax(logits, axis=1))
+    from ..core.dispatch import apply_op
+
+    def fn(data, lens):
+        t = data.shape[1]
+        mask = _lengths_mask(lens, t) if lens is not None else \
+            jnp.ones(data.shape[:2], bool)
+        return jax.nn.softmax(jnp.where(mask, data, -jnp.inf), axis=1)
+
+    return apply_op("sequence_softmax", fn, (input, lengths))
 
 
 def sequence_reverse(x, lengths=None, name=None):
-    data = x._data_
-    t = data.shape[1]
-    if lengths is None:
-        return Tensor(data[:, ::-1])
-    lens = lengths._data_.reshape(-1, 1)
-    ar = jnp.arange(t)[None, :]
-    idx = jnp.where(ar < lens, lens - 1 - ar, ar)
-    return Tensor(jnp.take_along_axis(
-        data, idx[(...,) + (None,) * (data.ndim - 2)].astype(jnp.int32)
-        if data.ndim > 2 else idx.astype(jnp.int32), axis=1))
+    from ..core.dispatch import apply_op
+
+    def fn(data, lens):
+        t = data.shape[1]
+        if lens is None:
+            return data[:, ::-1]
+        ll = lens.reshape(-1, 1)
+        ar = jnp.arange(t)[None, :]
+        idx = jnp.where(ar < ll, ll - 1 - ar, ar).astype(jnp.int32)
+        full = idx[(...,) + (None,) * (data.ndim - 2)] if data.ndim > 2 \
+            else idx
+        return jnp.take_along_axis(data, full, axis=1)
+
+    return apply_op("sequence_reverse", fn, (x, lengths))
 
 
 def sequence_concat(input, name=None):  # noqa: A002
-    return Tensor(jnp.concatenate([t._data_ for t in input], axis=1))
+    from ..core.dispatch import apply_op
+
+    def fn(*arrs):
+        return jnp.concatenate(arrs, axis=1)
+
+    return apply_op("sequence_concat", fn, tuple(input))
 
 
 def sequence_expand(x, y, ref_level=-1, name=None):
+    from ..core.dispatch import apply_op
     reps = y.shape[1] if y.ndim > 1 else 1
-    return Tensor(jnp.repeat(x._data_, reps, axis=0))
+
+    def fn(data):
+        return jnp.repeat(data, reps, axis=0)
+
+    return apply_op("sequence_expand", fn, (x,))
 
 
 def sequence_expand_as(x, y, name=None):
@@ -401,39 +422,52 @@ def sequence_expand_as(x, y, name=None):
 
 
 def sequence_reshape(input, new_dim, name=None):  # noqa: A002
-    data = input._data_
-    return Tensor(data.reshape(data.shape[0], -1, new_dim))
+    from ..core.dispatch import apply_op
+
+    def fn(data):
+        return data.reshape(data.shape[0], -1, new_dim)
+
+    return apply_op("sequence_reshape", fn, (input,))
 
 
 def sequence_slice(input, offset, length, name=None):  # noqa: A002
-    data = input._data_
+    data = input
     off = np.asarray(offset._data_ if isinstance(offset, Tensor)
                      else offset).reshape(-1)
     ln = np.asarray(length._data_ if isinstance(length, Tensor)
                     else length).reshape(-1)
     rows = [data[i, int(o):int(o) + int(n)]
             for i, (o, n) in enumerate(zip(off, ln))]
-    return Tensor(jnp.stack(rows)) if len({r.shape for r in rows}) == 1 \
-        else rows
+    if len({tuple(r.shape) for r in rows}) == 1:
+        from ..tensor_ops.manipulation import stack
+        return stack(rows)
+    return rows
 
 
 def sequence_scatter(input, index, updates, name=None):  # noqa: A002
-    data = input._data_
-    idx = index._data_.astype(jnp.int32)
-    return Tensor(data.at[jnp.arange(data.shape[0])[:, None], idx].add(
-        updates._data_))
+    from ..core.dispatch import apply_op
+
+    def fn(data, idx, upd):
+        return data.at[jnp.arange(data.shape[0])[:, None],
+                       idx.astype(jnp.int32)].add(upd)
+
+    return apply_op("sequence_scatter", fn, (input, index, updates))
 
 
 def sequence_enumerate(input, win_size, pad_value=0, name=None):  # noqa: A002
-    data = input._data_
-    b, t = data.shape[:2]
-    cols = []
-    for w in range(win_size):
-        shifted = jnp.concatenate(
-            [data[:, w:], jnp.full((b, w) + data.shape[2:], pad_value,
-                                   data.dtype)], axis=1)
-        cols.append(shifted)
-    return Tensor(jnp.stack(cols, axis=-1))
+    from ..core.dispatch import apply_op
+
+    def fn(data):
+        b, t = data.shape[:2]
+        cols = []
+        for w in range(win_size):
+            shifted = jnp.concatenate(
+                [data[:, w:], jnp.full((b, w) + data.shape[2:], pad_value,
+                                       data.dtype)], axis=1)
+            cols.append(shifted)
+        return jnp.stack(cols, axis=-1)
+
+    return apply_op("sequence_enumerate", fn, (input,))
 
 
 def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,  # noqa: A002
@@ -441,27 +475,31 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,  # noqa: A
                   param_attr=None, act=None, name=None):
     """Windowed sequence convolution: context window flattened then
     projected (reference: static/nn/sequence_lod.py sequence_conv)."""
-    data = input._data_  # [B, T, D]
-    d = data.shape[-1]
+    from ..core.dispatch import apply_op
+    d = input.shape[-1]
     w = create_parameter([filter_size * d, num_filters], "float32",
                          attr=param_attr)
     start = padding_start if padding_start is not None \
         else -(filter_size // 2)
-    cols = []
-    t = data.shape[1]
-    for k in range(filter_size):
-        shift = start + k
-        if shift < 0:
-            pad = jnp.zeros((data.shape[0], -shift, d), data.dtype)
-            piece = jnp.concatenate([pad, data[:, :t + shift]], axis=1)
-        elif shift > 0:
-            pad = jnp.zeros((data.shape[0], shift, d), data.dtype)
-            piece = jnp.concatenate([data[:, shift:], pad], axis=1)
-        else:
-            piece = data
-        cols.append(piece)
-    ctx = jnp.concatenate(cols, axis=-1)  # [B, T, k*D]
-    out = F.linear(Tensor(ctx), w)
+
+    def ctx_fn(data):
+        t = data.shape[1]
+        cols = []
+        for k in range(filter_size):
+            shift = start + k
+            if shift < 0:
+                pad = jnp.zeros((data.shape[0], -shift, d), data.dtype)
+                piece = jnp.concatenate([pad, data[:, :t + shift]], axis=1)
+            elif shift > 0:
+                pad = jnp.zeros((data.shape[0], shift, d), data.dtype)
+                piece = jnp.concatenate([data[:, shift:], pad], axis=1)
+            else:
+                piece = data
+            cols.append(piece)
+        return jnp.concatenate(cols, axis=-1)
+
+    ctx = apply_op("sequence_conv_ctx", ctx_fn, (input,))
+    out = F.linear(ctx, w)
     if bias_attr is not False:
         b = create_parameter([num_filters], "float32", attr=bias_attr,
                              is_bias=True)
@@ -472,16 +510,19 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,  # noqa: A
 def row_conv(input, future_context_size, param_attr=None, act=None):  # noqa: A002
     """Lookahead row convolution (reference: static/nn/common.py
     row_conv, DeepSpeech2)."""
-    data = input._data_  # [B, T, D]
-    d = data.shape[-1]
+    from ..core.dispatch import apply_op
+    d = input.shape[-1]
     k = future_context_size + 1
     w = create_parameter([k, d], "float32", attr=param_attr)
-    t = data.shape[1]
-    out = jnp.zeros_like(data)
-    for i in range(k):
-        piece = jnp.concatenate(
-            [data[:, i:], jnp.zeros((data.shape[0], i, d), data.dtype)],
-            axis=1)
-        out = out + piece * w._data_[i]
-    out = Tensor(out)
+
+    def fn(data, wk):
+        out = jnp.zeros_like(data)
+        for i in range(k):
+            piece = jnp.concatenate(
+                [data[:, i:], jnp.zeros((data.shape[0], i, d),
+                                        data.dtype)], axis=1)
+            out = out + piece * wk[i]
+        return out
+
+    out = apply_op("row_conv", fn, (input, w))
     return getattr(F, act)(out) if act else out

@@ -16,8 +16,7 @@ from ..core.tensor import Tensor
 
 
 def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
-    taken = bool(pred) if isinstance(pred, Tensor) else bool(pred)
-    if taken:
+    if bool(pred):
         return true_fn() if true_fn is not None else None
     return false_fn() if false_fn is not None else None
 

@@ -1,0 +1,218 @@
+"""paddle.text (reference: python/paddle/text/__init__.py): NLP datasets
+plus the Viterbi decoder ops.
+
+Datasets follow the reference's file-backed protocol but accept a local
+``data_file`` (this environment has no network egress); downloading
+constructors raise with a clear message instead of hanging."""
+from __future__ import annotations
+
+import gzip
+import os
+import tarfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..io import Dataset
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
+
+
+class _FileDataset(Dataset):
+    _name = "dataset"
+
+    def __init__(self, data_file=None, mode="train", **kwargs):
+        self.mode = mode
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                f"{type(self).__name__}: pass data_file= pointing at a "
+                f"local copy of the {self._name} archive — this "
+                f"environment has no network access for auto-download "
+                f"(reference datasets download from paddle dataset CDNs)")
+        self.data_file = data_file
+        self._examples = self._load()
+
+    def _load(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self._examples)
+
+    def __getitem__(self, i):
+        return self._examples[i]
+
+
+class Imdb(_FileDataset):
+    """IMDB sentiment (reference: text/datasets/imdb.py)."""
+    _name = "aclImdb"
+
+    def _load(self):
+        out = []
+        with tarfile.open(self.data_file) as tf:
+            for m in tf.getmembers():
+                path = m.name
+                if f"/{self.mode}/pos/" in path and path.endswith(".txt"):
+                    out.append((tf.extractfile(m).read().decode(), 1))
+                elif f"/{self.mode}/neg/" in path and path.endswith(".txt"):
+                    out.append((tf.extractfile(m).read().decode(), 0))
+        return out
+
+
+class Imikolov(_FileDataset):
+    """PTB language-model ngrams (reference: text/datasets/imikolov.py)."""
+    _name = "simple-examples"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=2,
+                 mode="train", min_word_freq=50):
+        self.data_type = data_type
+        self.window_size = window_size
+        super().__init__(data_file, mode)
+
+    def _load(self):
+        split = {"train": "ptb.train.txt", "test": "ptb.test.txt"}.get(
+            self.mode, "ptb.valid.txt")
+        with tarfile.open(self.data_file) as tf:
+            member = [m for m in tf.getmembers()
+                      if m.name.endswith(split)][0]
+            text = tf.extractfile(member).read().decode()
+        out = []
+        for line in text.splitlines():
+            words = line.split()
+            for i in range(len(words) - self.window_size + 1):
+                out.append(tuple(words[i:i + self.window_size]))
+        return out
+
+
+class Conll05st(_FileDataset):
+    """CoNLL-2005 SRL (reference: text/datasets/conll05.py)."""
+    _name = "conll05st"
+
+    def _load(self):
+        out = []
+        with tarfile.open(self.data_file) as tf:
+            for m in tf.getmembers():
+                if m.isfile() and m.name.endswith(".txt"):
+                    for line in tf.extractfile(m).read().decode(
+                            errors="replace").splitlines():
+                        if line.strip():
+                            out.append(tuple(line.split()))
+        return out
+
+
+class Movielens(_FileDataset):
+    """MovieLens ratings (reference: text/datasets/movielens.py)."""
+    _name = "ml-1m"
+
+    def _load(self):
+        out = []
+        with (gzip.open(self.data_file, "rt", errors="replace")
+              if self.data_file.endswith(".gz")
+              else open(self.data_file, errors="replace")) as f:
+            for line in f:
+                parts = line.strip().split("::")
+                if len(parts) == 4:
+                    u, m, r, _ = parts
+                    out.append((int(u), int(m), float(r)))
+        return out
+
+
+class UCIHousing(_FileDataset):
+    """Boston housing regression (reference: text/datasets/uci_housing.py)."""
+    _name = "housing.data"
+
+    def _load(self):
+        rows = np.loadtxt(self.data_file)
+        feats = rows[:, :-1].astype(np.float32)
+        feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-6)
+        split = int(0.8 * len(rows))
+        sel = slice(0, split) if self.mode == "train" else \
+            slice(split, None)
+        return [(feats[i], np.float32(rows[i, -1]))
+                for i in range(*sel.indices(len(rows)))]
+
+
+class WMT14(_FileDataset):
+    """WMT-14 en-fr pairs (reference: text/datasets/wmt14.py)."""
+    _name = "wmt14"
+
+    def _load(self):
+        out = []
+        with tarfile.open(self.data_file) as tf:
+            names = [m.name for m in tf.getmembers()]
+            src = [n for n in names if self.mode in n and n.endswith(".en")]
+            trg = [n for n in names if self.mode in n and n.endswith(".fr")]
+            if src and trg:
+                s = tf.extractfile(src[0]).read().decode().splitlines()
+                t = tf.extractfile(trg[0]).read().decode().splitlines()
+                out = list(zip(s, t))
+        return out
+
+
+class WMT16(WMT14):
+    """WMT-16 en-de pairs (reference: text/datasets/wmt16.py)."""
+    _name = "wmt16"
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Viterbi decoding over emission potentials [B, T, N] with a
+    transition matrix [N(+2), N(+2)] (reference: text/viterbi_decode.py
+    over the viterbi_decode kernel).  Returns (scores [B], paths [B, T])."""
+    def fn(pot, trans, lens):
+        b, t_max, n = pot.shape
+        if include_bos_eos_tag:
+            start = trans[-2, :n]
+            stop = trans[:n, -1]
+        else:
+            start = jnp.zeros((n,), pot.dtype)
+            stop = jnp.zeros((n,), pot.dtype)
+        trans_nn = trans[:n, :n]
+
+        alpha0 = pot[:, 0] + start[None, :]
+
+        def step(alpha, pot_t):
+            scores = alpha[:, :, None] + trans_nn[None]  # [B, from, to]
+            best = jnp.max(scores, axis=1) + pot_t
+            back = jnp.argmax(scores, axis=1)
+            return best, (best, back)
+
+        _, (alphas_rest, backs) = jax.lax.scan(
+            step, alpha0, jnp.moveaxis(pot[:, 1:], 1, 0))
+        alphas = jnp.concatenate([alpha0[None], alphas_rest], axis=0)
+        t_idx = jnp.clip(lens.astype(jnp.int32) - 1, 0, t_max - 1)
+        final = alphas[t_idx, jnp.arange(b)] + stop[None, :]
+        scores = jnp.max(final, axis=-1)
+        last_tag = jnp.argmax(final, axis=-1).astype(jnp.int32)
+
+        def backtrace(carry, back_t_rev):
+            tag, t = carry
+            prev = back_t_rev[jnp.arange(b), tag].astype(jnp.int32)
+            within = (t <= t_idx) & (t >= 1)
+            tag = jnp.where(within, prev, tag)
+            return (tag, t - 1), tag
+
+        # backs[k] maps alpha at step k → best predecessor; iterate from
+        # the top (t = T-1 .. 1), emitting the tag at t-1 each step
+        (_, _), tags_rev = jax.lax.scan(
+            backtrace, (last_tag, jnp.full((b,), t_max - 1)), backs[::-1])
+        path = jnp.concatenate([tags_rev[::-1].T, last_tag[:, None]],
+                               axis=1)
+        return scores, path.astype(jnp.int64)
+
+    return apply_op("viterbi_decode", fn,
+                    (potentials, transition_params, lengths))
+
+
+class ViterbiDecoder:
+    """Layer wrapper (reference: text/viterbi_decode.py ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

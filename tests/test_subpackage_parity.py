@@ -135,3 +135,62 @@ def test_vision_image_backend():
     with pytest.raises(ValueError):
         paddle.vision.set_image_backend("nope")
     assert paddle.amp.is_bfloat16_supported()
+
+
+def test_remaining_namespaces_parity():
+    import importlib
+    R = "/root/reference/python/paddle"
+    for name, path in [("incubate", f"{R}/incubate/__init__.py"),
+                       ("text", f"{R}/text/__init__.py"),
+                       ("device", f"{R}/device/__init__.py"),
+                       ("profiler", f"{R}/profiler/__init__.py"),
+                       ("jit", f"{R}/jit/__init__.py"),
+                       ("utils", f"{R}/utils/__init__.py"),
+                       ("autograd", f"{R}/autograd/__init__.py"),
+                       ("hub", f"{R}/hub.py")]:
+        refs = _ref_all(path)
+        mod = importlib.import_module(f"paddle_tpu.{name}")
+        missing = sorted(s for s in refs if not hasattr(mod, s))
+        assert missing == [], f"{name}: {missing}"
+
+
+def test_viterbi_matches_bruteforce():
+    import itertools
+    rng = np.random.default_rng(0)
+    pot = rng.normal(size=(1, 4, 3)).astype(np.float32)
+    trans = rng.normal(size=(5, 5)).astype(np.float32)
+    sc, path = paddle.text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(np.array([4])))
+    best, bs = None, -1e9
+    for seq in itertools.product(range(3), repeat=4):
+        s = trans[-2, seq[0]] + pot[0, 0, seq[0]]
+        for t in range(1, 4):
+            s += trans[seq[t - 1], seq[t]] + pot[0, t, seq[t]]
+        s += trans[seq[-1], -1]
+        if s > bs:
+            bs, best = s, seq
+    assert abs(float(sc) - bs) < 1e-4
+    assert tuple(path.numpy()[0]) == best
+
+
+def test_saved_tensors_hooks_fire():
+    events = []
+    with paddle.autograd.saved_tensors_hooks(
+            lambda t: events.append("pack") or t,
+            lambda p: events.append("unpack") or p):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = (x * 2.0).sum()
+    y.backward()
+    assert "pack" in events and "unpack" in events
+    np.testing.assert_allclose(x.grad.numpy(), np.full(3, 2.0))
+
+
+def test_hub_local_repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def toy(scale=2):\n"
+        "    'a toy model'\n"
+        "    return ('model', scale)\n")
+    assert paddle.hub.list(str(tmp_path)) == ["toy"]
+    assert "toy model" in paddle.hub.help(str(tmp_path), "toy")
+    assert paddle.hub.load(str(tmp_path), "toy", scale=3) == ("model", 3)
