@@ -303,6 +303,10 @@ class StaticFunction:
         return treedef, tuple(out)
 
     def __call__(self, *args, **kwargs):
+        from . import _TO_STATIC_ENABLED
+        if not _TO_STATIC_ENABLED:
+            # jit.enable_to_static(False): run the original eagerly
+            return self._fn(*args, **kwargs)
         if _state.STATE.tracer is not None:
             # nested to_static: inline into the enclosing trace
             return self._fn(*args, **kwargs)

@@ -349,3 +349,26 @@ def test_to_static_buffer_donation():
     step(x)
     assert old.is_deleted()
     assert not lin.weight._data_.is_deleted()
+
+
+def test_enable_to_static_toggle():
+    calls = {"n": 0}
+
+    @paddle.jit.to_static
+    def f(x):
+        calls["n"] += 1
+        return x * 2.0
+
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    f(x); f(x); f(x)          # warmup/discovery/compiled
+    n_compiled = calls["n"]
+    f(x)
+    assert calls["n"] == n_compiled  # compiled: python fn not re-run
+    paddle.jit.enable_to_static(False)
+    try:
+        np.testing.assert_allclose(f(x).numpy(), 2 * np.ones(3))
+        assert calls["n"] == n_compiled + 1  # ran eagerly
+    finally:
+        paddle.jit.enable_to_static(True)
+    f(x)
+    assert calls["n"] == n_compiled + 1  # compiled path again
