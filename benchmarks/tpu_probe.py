@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""On-chip perf decomposition for the flagship config (run when a real
+TPU is reachable; complements bench.py).
+
+Measures, with slope-based timing (enqueue N steps, end with a value
+fetch; slope over N removes the tunnel RTT — see
+docs/PARITY.md / project notes on axon measurement quirks):
+  - full train step vs forward-only (isolates backward+optimizer)
+  - flash attention vs XLA-fallback attention
+  - recompute on/off (memory-for-FLOPs lever)
+
+Usage: python benchmarks/tpu_probe.py [--batch 8] [--seq 1024]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def slope_time(step, x, y, n=8):
+    """Seconds/step: (time(n runs) - time(1 run)) / (n - 1), each ended
+    by a full value fetch so the relay cannot fake completion."""
+    def run_n(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            loss = step(x, y)
+        float(loss)
+        return time.perf_counter() - t0
+
+    n = max(n, 2)  # the slope needs at least two points
+    run_n(2)  # settle
+    t1 = min(run_n(1) for _ in range(2))
+    tn = run_n(n)
+    return (tn - t1) / (n - 1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import gpt_config
+
+    plat = jax.devices()[0].platform
+    print(f"platform: {plat}", flush=True)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 50304, (args.batch, args.seq + 1),
+                        dtype=np.int32)
+    x = paddle.to_tensor(data[:, :-1])
+    y = paddle.to_tensor(data[:, 1:])
+    x1 = paddle.to_tensor(data[:1, :-1])
+    y1 = paddle.to_tensor(data[:1, 1:])
+    spec = [paddle.jit.InputSpec([None, args.seq], "int32"),
+            paddle.jit.InputSpec([None, args.seq], "int32")]
+
+    results = {}
+    for label, flash, recompute, train in [
+            ("train+flash", True, False, True),
+            ("train+xla_attn", False, False, True),
+            ("train+flash+remat", True, True, True),
+            ("fwd+flash", True, False, False)]:
+        paddle.seed(0)
+        with paddle.amp.auto_cast(enable=True, level="O2",
+                                  dtype="bfloat16"):
+            model = GPTForCausalLM(gpt_config(
+                "gpt2-124m", max_seq_len=args.seq,
+                use_flash_attention=flash, use_recompute=recompute))
+        opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                     weight_decay=0.01)
+
+        if train:
+            @paddle.jit.to_static(input_spec=spec)
+            def step(x, y):
+                with paddle.amp.auto_cast(enable=True, level="O2",
+                                          dtype="bfloat16"):
+                    _, loss = model(x, labels=y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+        else:
+            @paddle.jit.to_static(input_spec=spec)
+            def step(x, y):
+                with paddle.no_grad(), paddle.amp.auto_cast(
+                        enable=True, level="O2", dtype="bfloat16"):
+                    _, loss = model(x, labels=y)
+                return loss
+
+        step(x1, y1)
+        step(x1, y1)
+        float(step(x, y))
+        float(step(x, y))  # donating variant compiles here
+        dt = slope_time(step, x, y, n=args.steps)
+        tput = args.batch * args.seq / dt
+        results[label] = dt
+        print(f"{label:22s} {dt * 1000:8.1f} ms/step  {tput:>10,.0f} tok/s",
+              flush=True)
+
+    if "train+flash" in results and "fwd+flash" in results:
+        bwd = results["train+flash"] - results["fwd+flash"]
+        print(f"{'bwd+opt (derived)':22s} {bwd * 1000:8.1f} ms/step")
+
+
+if __name__ == "__main__":
+    main()
