@@ -1,1 +1,223 @@
 from . import functional  # noqa: F401
+
+# fused layer family (reference: incubate/nn/__init__.py __all__;
+# CUDA fused kernels there — here thin Layers over the fused functional
+# compositions, which XLA fuses into comparable programs)
+from ...nn.layer import Layer as _Layer
+from ...nn.initializer import Constant as _Constant, \
+    XavierUniform as _XavierUniform
+from . import functional as _IF
+
+
+class FusedLinear(_Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._transpose = transpose_weight
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=_XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_features,), attr=bias_attr,
+            default_initializer=_Constant(0.0), is_bias=True)
+
+    def forward(self, x):
+        return _IF.fused_linear(x, self.weight, self.bias,
+                                transpose_weight=self._transpose)
+
+
+class FusedDropoutAdd(_Layer):
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p, self._mode = p, mode
+
+    def forward(self, x, y):
+        return _IF.fused_dropout_add(x, y, p=self.p,
+                                     training=self.training,
+                                     mode=self._mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(_Layer):
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self._p, self._eps = dropout_rate, epsilon
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=bias_attr,
+            default_initializer=_Constant(0.0), is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr,
+            default_initializer=_Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), default_initializer=_Constant(0.0), is_bias=True)
+
+    def forward(self, x, residual):
+        return _IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self._p,
+            ln_epsilon=self._eps, training=self.training)
+
+
+class FusedFeedForward(_Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._cfg = (dropout_rate,
+                     dropout_rate if act_dropout_rate is None
+                     else act_dropout_rate, activation, epsilon,
+                     normalize_before)
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), attr=linear1_weight_attr,
+            default_initializer=_XavierUniform())
+        self.linear1_bias = self.create_parameter(
+            (dim_feedforward,), attr=linear1_bias_attr,
+            default_initializer=_Constant(0.0), is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), attr=linear2_weight_attr,
+            default_initializer=_XavierUniform())
+        self.linear2_bias = self.create_parameter(
+            (d_model,), attr=linear2_bias_attr,
+            default_initializer=_Constant(0.0), is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            (d_model,), default_initializer=_Constant(1.0))
+        self.ln1_bias = self.create_parameter(
+            (d_model,), default_initializer=_Constant(0.0), is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            (d_model,), default_initializer=_Constant(1.0))
+        self.ln2_bias = self.create_parameter(
+            (d_model,), default_initializer=_Constant(0.0), is_bias=True)
+
+    def forward(self, x):
+        p, act_p, act, eps, pre = self._cfg
+        return _IF.fused_feedforward(
+            x, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=act_p, dropout2_rate=p, activation=act,
+            ln1_epsilon=eps, ln2_epsilon=eps, pre_layer_norm=pre,
+            training=self.training)
+
+
+class FusedMultiHeadAttention(_Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        head_dim = embed_dim // num_heads
+        self._cfg = (num_heads, dropout_rate, attn_dropout_rate, epsilon,
+                     normalize_before)
+        self.qkv_weight = self.create_parameter(
+            (3, num_heads, head_dim, embed_dim), attr=qkv_weight_attr,
+            default_initializer=_XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            (3, num_heads, head_dim), attr=qkv_bias_attr,
+            default_initializer=_Constant(0.0), is_bias=True)
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), attr=linear_weight_attr,
+            default_initializer=_XavierUniform())
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=linear_bias_attr,
+            default_initializer=_Constant(0.0), is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            (embed_dim,), default_initializer=_Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            (embed_dim,), default_initializer=_Constant(0.0), is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), default_initializer=_Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), default_initializer=_Constant(0.0), is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        nh, p, attn_p, eps, pre = self._cfg
+        return _IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=pre, pre_ln_scale=self.pre_ln_scale,
+            pre_ln_bias=self.pre_ln_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, attn_mask=attn_mask,
+            dropout_rate=p, attn_dropout_rate=attn_p, ln_epsilon=eps,
+            training=self.training, num_heads=nh)
+
+
+class FusedTransformerEncoderLayer(_Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(_Layer):
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, num_layers=1, nranks=1,
+                 trans_qkvw=True, ring_id=-1, name=None, **kwargs):
+        super().__init__()
+        from ...nn.containers import LayerList
+        self.layers = LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=normalize_before)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None, **kwargs):
+        h = src
+        for layer in self.layers:
+            h = layer(h, src_mask=attn_mask)
+        return h
+
+
+class FusedEcMoe(_Layer):
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        self._act = act_type
+        self.gate_weight = self.create_parameter(
+            (hidden_size, num_experts), attr=weight_attr,
+            default_initializer=_XavierUniform())
+        self.expert_w1 = self.create_parameter(
+            (num_experts, hidden_size, inter_size), attr=weight_attr,
+            default_initializer=_XavierUniform())
+        self.expert_b1 = self.create_parameter(
+            (num_experts, 1, inter_size),
+            default_initializer=_Constant(0.0), is_bias=True)
+        self.expert_w2 = self.create_parameter(
+            (num_experts, inter_size, hidden_size), attr=weight_attr,
+            default_initializer=_XavierUniform())
+        self.expert_b2 = self.create_parameter(
+            (num_experts, 1, hidden_size),
+            default_initializer=_Constant(0.0), is_bias=True)
+
+    def forward(self, x, gate=None):
+        if gate is None:
+            gate = x @ self.gate_weight
+        return _IF.fused_ec_moe(x, gate, self.expert_w1, self.expert_b1,
+                                self.expert_w2, self.expert_b2,
+                                act_type=self._act)

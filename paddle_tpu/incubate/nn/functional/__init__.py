@@ -237,3 +237,186 @@ def masked_multihead_attention(q, k, v, cache_k, cache_v, offset,
 
     return apply_op("masked_multihead_attention", fn,
                     (q, k, v, cache_k, cache_v, offset))
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """reference: incubate/nn/functional/fused_matmul_bias.py
+    fused_linear — alias of the fused matmul+bias epilogue (XLA fuses)."""
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """matmul + bias + activation in one fusion (reference:
+    fused_gemm_epilogue kernels)."""
+    from ....nn import functional as F
+    out = fused_matmul_bias(x, y, bias, transpose_x=trans_x,
+                            transpose_y=trans_y)
+    act = activation or "identity"
+    if act in ("none", "identity"):
+        return out
+    return getattr(F, act)(out)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one pass (reference:
+    incubate/nn/functional/fused_dropout_add.py)."""
+    from ....nn import functional as F
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode=None,
+        name=None):
+    """(x + bias) → dropout → + residual → layer_norm, the transformer
+    epilogue fusion (reference:
+    incubate/nn/functional/fused_bias_dropout_residual_layer_norm)."""
+    from ....nn import functional as F
+    h = x if bias is None else x + bias
+    h = F.dropout(h, p=dropout_rate, training=training)
+    h = h + residual
+    n = h.shape[-1]
+    return F.layer_norm(h, n, weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode=None,
+                      name=None):
+    """Transformer FFN block as one fusion (reference:
+    incubate/nn/functional/fused_transformer.py fused_feedforward)."""
+    from ....nn import functional as F
+    n = x.shape[-1]
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, n, weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = fused_matmul_bias(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, p=dropout1_rate, training=training)
+    h = fused_matmul_bias(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, p=dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, n, weight=ln2_scale, bias=ln2_bias,
+                           epsilon=ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode=None, ring_id=-1, add_residual=True,
+                               num_heads=None, transpose_qkv_wb=False,
+                               name=None):
+    """Full MHA block fusion (reference:
+    incubate/nn/functional/fused_transformer.py
+    fused_multi_head_attention).  qkv_weight [3, H, D, E] (the
+    reference's fused layout); attention itself rides the Pallas/XLA
+    path of scaled_dot_product_attention."""
+    from ....nn import functional as F
+    b, s, e = x.shape
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, e, weight=pre_ln_scale, bias=pre_ln_bias,
+                         epsilon=pre_ln_epsilon)
+    if transpose_qkv_wb:
+        nh = num_heads
+        qkv = fused_matmul_bias(h, qkv_weight, qkv_bias)  # [B,S,3E]
+        qkv = qkv.reshape([b, s, 3, nh, e // nh])
+    else:
+        nh = qkv_weight.shape[1]
+        hd = qkv_weight.shape[2]
+        w = qkv_weight.reshape([3 * nh * hd, e]).t()
+        qkv = h @ w
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias.reshape([-1])
+        qkv = qkv.reshape([b, s, 3, nh, hd])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D]
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        is_causal=False, training=training)
+    out = out.reshape([b, s, -1])
+    out = fused_matmul_bias(out, linear_weight, linear_bias)
+    out = F.dropout(out, p=dropout_rate, training=training)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, e, weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    return out
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
+                            qkv_biases, linear_weights, linear_biases,
+                            ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+                            ffn1_biases, ffn2_weights, ffn2_biases,
+                            pre_layer_norm=True, epsilon=1e-5,
+                            cache_kvs=None, pre_caches=None,
+                            seq_lens=None, rotary_embs=None,
+                            rotary_emb_dims=0, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            activation="gelu", training=False,
+                            mode=None, trans_qkvw=True, ring_id=-1,
+                            name=None):
+    """Stacked decoder blocks in one call (reference:
+    incubate/nn/functional/fused_transformer.py
+    fused_multi_transformer — the inference fast path)."""
+    h = x
+    for i in range(len(qkv_weights)):
+        h = fused_multi_head_attention(
+            h, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm,
+            pre_ln_scale=ln_scales[i] if ln_scales else None,
+            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, training=training)
+        h = fused_feedforward(
+            h, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, pre_layer_norm=pre_layer_norm,
+            training=training)
+    return h
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
+                 bmm1_bias, act_type="gelu", name=None):
+    """Expert-choice MoE FFN fusion (reference:
+    incubate/nn/functional/fused_ec_moe.py — fused_ec_moe(x, gate,
+    bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias, act_type)): `gate`
+    is the precomputed [B, S, E] gate logits; dense einsum dispatch over
+    the expert dim — the MXU-friendly realization."""
+    from ....nn import functional as F
+    gates = F.softmax(gate, axis=-1)                   # [B,S,E]
+    h = jnp_einsum("bsd,edh->bseh", x, bmm0_weight)
+    if bmm0_bias is not None:
+        h = h + bmm0_bias[:, 0]                        # [E,H] broadcast
+    h = getattr(F, act_type)(h)
+    out = jnp_einsum("bseh,ehd->bsed", h, bmm1_weight)
+    if bmm1_bias is not None:
+        out = out + bmm1_bias[:, 0]
+    return (out * gates.unsqueeze(-1)).sum(axis=2)
+
+
+def jnp_einsum(eq, *ops):
+    from ....tensor_ops.linalg import einsum
+    return einsum(eq, *ops)

@@ -1,0 +1,3 @@
+"""paddle.incubate.optimizer (reference: incubate/optimizer/__init__.py)."""
+from ...optimizer import LBFGS  # noqa: F401
+from .. import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,62 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py — re-export
+of the tensor linalg ops plus a few statistics helpers)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import defop
+from .tensor_ops.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, det, eig, eigh, eigvals, eigvalsh,
+    inv, lstsq, lu, matrix_power, matrix_rank, multi_dot, norm, pinv, qr,
+    slogdet, solve, svd, triangular_solve,
+)
+
+__all__ = ["cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det",
+           "eig", "eigh", "eigvals", "eigvalsh", "inv", "lstsq", "lu",
+           "lu_unpack", "matrix_power", "matrix_rank", "multi_dot",
+           "norm", "pca_lowrank", "pinv", "qr", "slogdet", "solve", "svd",
+           "triangular_solve"]
+
+
+@defop("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None,
+        name=None):
+    """reference: tensor/linalg.py cov."""
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=None if fweights is None else fweights,
+                   aweights=None if aweights is None else aweights)
+
+
+@defop("corrcoef")
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@defop("lu_unpack", nondiff=True)
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack the packed LU factorization (reference: tensor/linalg.py
+    lu_unpack): x = packed LU [.., N, N], y = pivots [.., N]."""
+    n = x.shape[-1]
+    l = jnp.tril(x, k=-1) + jnp.eye(n, dtype=x.dtype)  # noqa: E741
+    u = jnp.triu(x)
+    # pivots are 1-based sequential row swaps (LAPACK getrf); applying
+    # them to the identity yields sigma with L@U = A[sigma], so
+    # A = P @ L @ U with P[sigma[k], k] = 1 (eye[sigma].T)
+    piv = y.astype(jnp.int32) - 1
+    perm = jnp.arange(n)
+
+    def body(i, p):
+        j = piv[i]
+        pi, pj = p[i], p[j]
+        return p.at[i].set(pj).at[j].set(pi)
+
+    perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+    p_mat = jnp.eye(n, dtype=x.dtype)[perm].T
+    return p_mat, l, u
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (reference: tensor/linalg.py pca_lowrank)."""
+    from .sparse import pca_lowrank as _sp
+    return _sp(x, q=q, center=center, niter=niter)

@@ -145,3 +145,14 @@ class Auc(Metric):
         tpr = pos_c / tot_pos
         fpr = neg_c / tot_neg
         return float(np.trapezoid(tpr, fpr))
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    """Functional top-k accuracy (reference: metric/metrics.py accuracy)."""
+    import jax.numpy as jnp
+    pred = input._data_ if isinstance(input, Tensor) else jnp.asarray(input)
+    lbl = (label._data_ if isinstance(label, Tensor)
+           else jnp.asarray(label)).reshape(-1)
+    topk = jnp.argsort(-pred, axis=-1)[:, :k]
+    hit = jnp.any(topk == lbl[:, None], axis=-1)
+    return Tensor(jnp.mean(hit.astype(jnp.float32)))

@@ -173,3 +173,51 @@ class ParamAttr:
         self.regularizer = regularizer
         self.trainable = trainable
         self.need_clip = need_clip
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (reference: nn/initializer/Bilinear)."""
+
+    def _init(self, shape, dtype):
+        import numpy as _np
+        w = _np.zeros(tuple(shape), dtype=_np.float32)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects 4-D weights")
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(_np.prod(shape[2:])):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            val = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            w[:, :, y, x] = val
+        return jnp.asarray(w).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference: nn/initializer/Dirac)."""
+
+    def __init__(self, groups=1, name=None):
+        self._groups = groups
+
+    def _init(self, shape, dtype):
+        import numpy as _np
+        w = _np.zeros(tuple(shape), dtype=_np.float32)
+        out_per_group = shape[0] // self._groups
+        mid = tuple(s // 2 for s in shape[2:])
+        for g in range(self._groups):
+            for i in range(min(out_per_group, shape[1])):
+                w[(g * out_per_group + i, i) + mid] = 1.0
+        return jnp.asarray(w).astype(dtype)
+
+
+_GLOBAL_WEIGHT_INIT = None
+_GLOBAL_BIAS_INIT = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """reference: nn/initializer/set_global_initializer — default
+    initializers used when a layer's attr doesn't specify one."""
+    global _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT
+    _GLOBAL_WEIGHT_INIT = weight_init
+    _GLOBAL_BIAS_INIT = bias_init

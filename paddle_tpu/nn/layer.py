@@ -72,7 +72,12 @@ class Layer:
                          default_initializer=None):
         from .initializer import XavierNormal, Constant, _apply_initializer
         dtype = _dtype.convert_dtype(dtype) or self._dtype
-        init = default_initializer
+        # precedence (reference set_global_initializer semantics):
+        # attr-specified > global override > layer default > builtin
+        from . import initializer as _init_mod
+        glob = _init_mod._GLOBAL_BIAS_INIT if is_bias \
+            else _init_mod._GLOBAL_WEIGHT_INIT
+        init = glob or default_initializer
         if attr is not None and getattr(attr, "initializer", None) is not None:
             init = attr.initializer
         if init is None:

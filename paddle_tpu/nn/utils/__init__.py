@@ -1,0 +1,138 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/): weight/spectral
+norm reparameterizations, parameter flattening, gradient clipping."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate(
+        [p._data_.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    off = 0
+    arr = vec._data_ if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.ndim else 1
+        p._data_ = arr[off:off + n].reshape(tuple(p.shape)).astype(
+            p._data_.dtype)
+        off += n
+    return parameters
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p.grad._data_)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p.grad._data_.astype(jnp.float32))
+                     ** norm_type) for p in params])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("gradient norm is non-finite")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._data_ = (p.grad._data_.astype(jnp.float32) * scale).astype(
+            p.grad._data_.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in (parameters if isinstance(parameters, (list, tuple))
+              else [parameters]):
+        if p.grad is not None:
+            p.grad._data_ = jnp.clip(p.grad._data_, -clip_value, clip_value)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize layer.<name> as g * v/||v|| (reference:
+    nn/utils/weight_norm_hook.py).  The decomposition happens on every
+    forward via a pre-hook; remove_weight_norm folds it back."""
+    import jax.numpy as jnp
+
+    w = getattr(layer, name)
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    g0 = jnp.sqrt(jnp.sum(w._data_.astype(jnp.float32) ** 2, axis=axes,
+                          keepdims=True))
+    v = layer.create_parameter(list(w.shape))
+    v._data_ = w._data_
+    g = layer.create_parameter(list(g0.shape))
+    g._data_ = g0.astype(w._data_.dtype)
+    layer.add_parameter(name + "_v", v)
+    layer.add_parameter(name + "_g", g)
+    # the original becomes derived state, not a trainable parameter
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _compute(lay):
+        vv = getattr(lay, name + "_v")
+        gg = getattr(lay, name + "_g")
+        nrm = (vv * vv).sum(axis=list(axes), keepdim=True).sqrt()
+        return gg * vv / (nrm + 1e-12)
+
+    def pre_hook(lay, inputs):
+        object.__setattr__(lay, name, _compute(lay))
+        return inputs
+
+    handle = layer.register_forward_pre_hook(pre_hook)
+    layer._weight_norm_state = (name, dim, handle)
+    object.__setattr__(layer, name, _compute(layer))
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    state = getattr(layer, "_weight_norm_state", None)
+    if state is None:
+        return layer
+    pname, dim, handle = state
+    handle.remove()
+    w = getattr(layer, pname)
+    p = layer.create_parameter(list(w.shape))
+    p._data_ = w._data_ if not isinstance(w, Tensor) else w._data_
+    # the pre-hook stored the computed weight as an INSTANCE attribute,
+    # which would shadow the re-registered parameter
+    if pname in layer.__dict__:
+        object.__delattr__(layer, pname)
+    layer.add_parameter(pname, p)
+    for suffix in ("_v", "_g"):
+        layer._parameters.pop(pname + suffix, None)
+    del layer._weight_norm_state
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral-norm reparameterization via a forward pre-hook
+    (reference: nn/utils/spectral_norm_hook.py)."""
+    from ..layers_extra import SpectralNorm
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(list(w.shape), dim=dim,
+                      power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(name + "_sn", sn)
+    orig = layer.create_parameter(list(w.shape))
+    orig._data_ = w._data_
+    layer.add_parameter(name + "_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def pre_hook(lay, inputs):
+        object.__setattr__(lay, name,
+                           getattr(lay, name + "_sn")(
+                               getattr(lay, name + "_orig")))
+        return inputs
+
+    layer.register_forward_pre_hook(pre_hook)
+    object.__setattr__(layer, name, sn(orig))
+    return layer

@@ -201,9 +201,11 @@ def cond(x, p=None, name=None):
 @defop("lu", nondiff=True)
 def lu(x, pivot=True, get_infos=False, name=None):
     lu_, piv = jax.scipy.linalg.lu_factor(x)
+    # reference (LAPACK getrf) pivots are 1-based; jax returns 0-based
+    piv = piv.astype(jnp.int32) + 1
     if get_infos:
-        return lu_, piv.astype(jnp.int32), jnp.zeros((), jnp.int32)
-    return lu_, piv.astype(jnp.int32)
+        return lu_, piv, jnp.zeros((), jnp.int32)
+    return lu_, piv
 
 
 @defop("kron")

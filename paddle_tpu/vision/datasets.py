@@ -85,3 +85,221 @@ class MNIST(Dataset):
 
 
 FashionMNIST = MNIST  # same idx format, different files
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class dataset (reference:
+    vision/datasets/folder.py DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(extensions or (".jpg", ".jpeg", ".png", ".bmp",
+                                    ".npy"))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fname.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        from PIL import Image
+        return Image.open(path).convert("RGB")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        path, target = self.samples[i]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+
+class ImageFolder(DatasetFolder):
+    """Flat image folder without labels (reference: ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(extensions or (".jpg", ".jpeg", ".png", ".bmp",
+                                    ".npy"))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(exts))
+                if ok:
+                    self.samples.append(path)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        img = self.loader(self.samples[i])
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+
+class _Cifar(Dataset):
+    _n_coarse = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        import os
+        import pickle
+        import tarfile
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                f"{type(self).__name__}: pass data_file= pointing at the "
+                "local CIFAR archive (no network egress for download)")
+        self.transform = transform
+        self.mode = mode
+        data, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                name = os.path.basename(m.name)
+                want = self._member_wanted(name, mode)
+                if want:
+                    d = pickle.loads(tf.extractfile(m).read(),
+                                     encoding="bytes")
+                    data.append(d[b"data"])
+                    labels.extend(d.get(self._label_key,
+                                        d.get(b"labels", [])))
+        self.data = np.concatenate(data).reshape(-1, 3, 32, 32) \
+            if data else np.empty((0, 3, 32, 32), np.uint8)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        img = self.data[i]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, int(self.labels[i])
+
+
+class Cifar10(_Cifar):
+    """reference: vision/datasets/cifar.py Cifar10."""
+    _label_key = b"labels"
+
+    @staticmethod
+    def _member_wanted(name, mode):
+        return name.startswith("data_batch") if mode == "train" \
+            else name == "test_batch"
+
+
+class Cifar100(_Cifar):
+    """reference: vision/datasets/cifar.py Cifar100."""
+    _label_key = b"fine_labels"
+
+    @staticmethod
+    def _member_wanted(name, mode):
+        return name == ("train" if mode == "train" else "test")
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers (reference: vision/datasets/flowers.py):
+    needs the images archive + labels .mat + setid .mat."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True,
+                 backend=None):
+        import os
+        for f in (data_file, label_file, setid_file):
+            if f is None or not os.path.exists(f):
+                raise RuntimeError(
+                    "Flowers: pass data_file=, label_file=, setid_file= "
+                    "pointing at local copies (no network egress)")
+        import scipy.io as sio
+        labels = sio.loadmat(label_file)["labels"].ravel()
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key].ravel()
+        self.labels = labels
+        self.transform = transform
+        # open once; scanning the archive per __getitem__ would be
+        # O(archive) I/O per sample (the reference caches the tar too)
+        import tarfile
+        self._tar = tarfile.open(data_file)
+        self._members = {m.name: m for m in self._tar.getmembers()}
+
+    def __len__(self):
+        return len(self.indexes)
+
+    def __getitem__(self, i):
+        from PIL import Image
+        import io as _io
+        idx = int(self.indexes[i])
+        m = self._tar.extractfile(
+            self._members[f"jpg/image_{idx:05d}.jpg"])
+        img = Image.open(_io.BytesIO(m.read())).convert("RGB")
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx - 1])
+
+
+class VOC2012(Dataset):
+    """Pascal VOC-2012 segmentation pairs (reference:
+    vision/datasets/voc2012.py)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        import os
+        import tarfile
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError("VOC2012: pass data_file= pointing at the "
+                               "local VOCtrainval archive")
+        self.transform = transform
+        split = {"train": "train.txt", "valid": "val.txt",
+                 "test": "val.txt"}[mode]
+        self._tar = tarfile.open(data_file)
+        self._members = {m.name: m for m in self._tar.getmembers()}
+        seg_dir = "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+        names = self._tar.extractfile(
+            self._members[seg_dir + split]).read().decode().split()
+        self.names = names
+
+    def __len__(self):
+        return len(self.names)
+
+    def __getitem__(self, i):
+        import io as _io
+        from PIL import Image
+        name = self.names[i]
+        img = Image.open(_io.BytesIO(self._tar.extractfile(
+            self._members[
+                f"VOCdevkit/VOC2012/JPEGImages/{name}.jpg"]).read()))
+        lbl = Image.open(_io.BytesIO(self._tar.extractfile(
+            self._members[
+                f"VOCdevkit/VOC2012/SegmentationClass/{name}.png"]
+        ).read()))
+        img = np.asarray(img.convert("RGB"))
+        lbl = np.asarray(lbl)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl

@@ -194,3 +194,96 @@ def test_hub_local_repo(tmp_path):
     assert paddle.hub.list(str(tmp_path)) == ["toy"]
     assert "toy model" in paddle.hub.help(str(tmp_path), "toy")
     assert paddle.hub.load(str(tmp_path), "toy", scale=3) == ("model", 3)
+
+
+def test_deep_namespaces_parity():
+    import importlib
+    R = "/root/reference/python/paddle"
+    for name in ["vision.datasets", "incubate.nn", "incubate.nn.functional",
+                 "incubate.optimizer", "metric", "nn.initializer",
+                 "nn.utils"]:
+        refs = _ref_all(f"{R}/{name.replace('.', '/')}/__init__.py")
+        mod = importlib.import_module(f"paddle_tpu.{name}")
+        missing = sorted(s for s in refs if not hasattr(mod, s))
+        assert missing == [], f"{name}: {missing}"
+    refs = _ref_all(f"{R}/linalg.py")
+    missing = sorted(s for s in refs if not hasattr(paddle.linalg, s))
+    assert missing == [], f"linalg: {missing}"
+
+
+def test_fused_layers_forward_and_train():
+    from paddle_tpu.incubate import nn as inn
+    paddle.seed(0)
+    enc = inn.FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=enc.parameters())
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(2, 5, 16)).astype(np.float32))
+    losses = []
+    for _ in range(4):
+        loss = (enc(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    moe = inn.FusedEcMoe(16, 32, 4)
+    assert moe(x).shape == [2, 5, 16]
+
+
+def test_nn_utils_weight_norm():
+    from paddle_tpu.nn.utils import (weight_norm, remove_weight_norm,
+                                     parameters_to_vector,
+                                     vector_to_parameters,
+                                     clip_grad_norm_, clip_grad_value_)
+    lin = paddle.nn.Linear(4, 3)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    weight_norm(lin)
+    o1 = lin(x)
+    # g/v reparameterization reproduces the original weight exactly
+    remove_weight_norm(lin)
+    np.testing.assert_allclose(lin(x).numpy(), o1.numpy(), rtol=1e-5)
+    vec = parameters_to_vector(lin.parameters())
+    assert vec.shape == [15]
+    vector_to_parameters(vec * 0.0, lin.parameters())
+    assert float(np.abs(lin(x).numpy()).sum()) == 0.0
+    loss = (lin(x) ** 2).sum()
+    loss.backward()
+    clip_grad_value_(lin.parameters(), 1e-8)
+    n = clip_grad_norm_(lin.parameters(), 1.0)
+    assert float(n) <= 1e-6
+
+
+def test_linalg_extras():
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(3, 5)).astype(np.float32))
+    np.testing.assert_allclose(paddle.linalg.cov(x).numpy(),
+                               np.cov(x.numpy()), rtol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.corrcoef(x).numpy(),
+                               np.corrcoef(x.numpy()), rtol=1e-4)
+    a = rng.normal(size=(4, 4)).astype(np.float32)
+    lu, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    p, l, u = paddle.linalg.lu_unpack(lu, piv)
+    np.testing.assert_allclose(
+        (p.numpy() @ l.numpy() @ u.numpy()), a, atol=1e-4)
+
+
+def test_metric_accuracy_fn():
+    pred = paddle.to_tensor(
+        np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32))
+    lbl = paddle.to_tensor(np.array([1, 0, 0]))
+    assert float(paddle.metric.accuracy(pred, lbl)) == pytest.approx(2 / 3)
+
+
+def test_dataset_folder(tmp_path):
+    import numpy as _np
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(2):
+            _np.save(d / f"{i}.npy", _np.full((2, 2), i, _np.float32))
+    ds = paddle.vision.datasets.DatasetFolder(str(tmp_path))
+    assert len(ds) == 4
+    img, target = ds[0]
+    assert target in (0, 1)
+    flat = paddle.vision.datasets.ImageFolder(str(tmp_path))
+    assert len(flat) == 4
