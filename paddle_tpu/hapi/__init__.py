@@ -2,4 +2,5 @@ from .model import Model  # noqa: F401
 from . import callbacks  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
+    ReduceLROnPlateau, VisualDL, WandbCallback,
 )

@@ -179,3 +179,102 @@ def config_callbacks(callbacks, model, epochs=None, steps=None,
                       params={"epochs": epochs, "steps": steps,
                               "verbose": verbose, "metrics": metrics or []})
     return cl
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the LR when a monitored metric plateaus (reference:
+    hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._best = None
+        self._wait = 0
+        self._cooldown_left = 0
+        lower_better = mode == "min" or (mode == "auto"
+                                         and "acc" not in monitor)
+        self._better = ((lambda a, b: a < b - min_delta) if lower_better
+                        else (lambda a, b: a > b + min_delta))
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            self._wait = 0
+        if self._best is None or self._better(cur, self._best):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                lr = opt.get_lr()
+                new_lr = max(lr * self.factor, self.min_lr)
+                if new_lr < lr:
+                    opt.set_lr(new_lr)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
+            self._cooldown_left = self.cooldown
+            self._wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference: hapi/callbacks.py VisualDL
+    over the visualdl package).  The visualdl writer is not in this
+    image, so scalars are appended to a jsonl file under log_dir that
+    any dashboard can tail — same call points, file-backed sink."""
+
+    def __init__(self, log_dir="./vdl_log"):
+        import os
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._path = os.path.join(log_dir, "scalars.jsonl")
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+        logs = logs or {}
+        rec = {"step": self._step, "tag": tag}
+        for k, v in logs.items():
+            try:
+                rec[k] = float(v[0] if isinstance(v, (list, tuple))
+                               else v)
+            except (TypeError, ValueError):
+                continue
+        with open(self._path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (reference: hapi/callbacks.py
+    WandbCallback).  wandb is not installed in this image; raises with a
+    clear message at construction rather than failing mid-training."""
+
+    def __init__(self, project=None, **kwargs):
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the `wandb` package, which is "
+                "not available in this environment") from e

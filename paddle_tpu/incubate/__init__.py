@@ -237,3 +237,7 @@ class ModelAverage:
         for p in self._params:
             if id(p) in self._backup:
                 p._data_ = self._backup.pop(id(p))
+
+
+# imported last: optimizer re-exports LookAhead/ModelAverage above
+from . import optimizer  # noqa: F401,E402

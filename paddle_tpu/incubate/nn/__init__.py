@@ -149,7 +149,7 @@ class FusedMultiHeadAttention(_Layer):
             ln_bias=self.ln_bias, qkv_bias=self.qkv_bias,
             linear_bias=self.linear_bias, attn_mask=attn_mask,
             dropout_rate=p, attn_dropout_rate=attn_p, ln_epsilon=eps,
-            training=self.training, num_heads=nh)
+            pre_ln_epsilon=eps, training=self.training, num_heads=nh)
 
 
 class FusedTransformerEncoderLayer(_Layer):

@@ -377,23 +377,33 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
     fused_multi_transformer — the inference fast path)."""
     h = x
     for i in range(len(qkv_weights)):
+        ln_s = ln_scales[i] if ln_scales else None
+        ln_b = ln_biases[i] if ln_biases else None
         h = fused_multi_head_attention(
             h, qkv_weights[i], linear_weights[i],
             pre_layer_norm=pre_layer_norm,
-            pre_ln_scale=ln_scales[i] if ln_scales else None,
-            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            pre_ln_scale=ln_s if pre_layer_norm else None,
+            pre_ln_bias=ln_b if pre_layer_norm else None,
+            ln_scale=None if pre_layer_norm else ln_s,
+            ln_bias=None if pre_layer_norm else ln_b,
             qkv_bias=qkv_biases[i] if qkv_biases else None,
             linear_bias=linear_biases[i] if linear_biases else None,
             attn_mask=attn_mask, dropout_rate=dropout_rate,
-            attn_dropout_rate=dropout_rate, training=training)
+            attn_dropout_rate=dropout_rate, ln_epsilon=epsilon,
+            training=training)
+        ffn_s = ffn_ln_scales[i] if ffn_ln_scales else None
+        ffn_b = ffn_ln_biases[i] if ffn_ln_biases else None
         h = fused_feedforward(
             h, ffn1_weights[i], ffn2_weights[i],
             linear1_bias=ffn1_biases[i] if ffn1_biases else None,
             linear2_bias=ffn2_biases[i] if ffn2_biases else None,
-            ln1_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
-            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            ln1_scale=ffn_s if pre_layer_norm else None,
+            ln1_bias=ffn_b if pre_layer_norm else None,
+            ln2_scale=None if pre_layer_norm else ffn_s,
+            ln2_bias=None if pre_layer_norm else ffn_b,
             dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
-            activation=activation, pre_layer_norm=pre_layer_norm,
+            activation=activation, ln1_epsilon=epsilon,
+            ln2_epsilon=epsilon, pre_layer_norm=pre_layer_norm,
             training=training)
     return h
 

@@ -38,22 +38,34 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     """Unpack the packed LU factorization (reference: tensor/linalg.py
     lu_unpack): x = packed LU [.., N, N], y = pivots [.., N]."""
     n = x.shape[-1]
-    l = jnp.tril(x, k=-1) + jnp.eye(n, dtype=x.dtype)  # noqa: E741
-    u = jnp.triu(x)
-    # pivots are 1-based sequential row swaps (LAPACK getrf); applying
-    # them to the identity yields sigma with L@U = A[sigma], so
-    # A = P @ L @ U with P[sigma[k], k] = 1 (eye[sigma].T)
-    piv = y.astype(jnp.int32) - 1
-    perm = jnp.arange(n)
 
-    def body(i, p):
-        j = piv[i]
-        pi, pj = p[i], p[j]
-        return p.at[i].set(pj).at[j].set(pi)
+    def one(mat, pivots):
+        l = jnp.tril(mat, k=-1) + jnp.eye(n, dtype=mat.dtype)  # noqa: E741
+        u = jnp.triu(mat)
+        # pivots are 1-based sequential row swaps (LAPACK getrf);
+        # applying them to the identity yields sigma with L@U = A[sigma],
+        # so A = P @ L @ U with P[sigma[k], k] = 1 (eye[sigma].T)
+        piv = pivots.astype(jnp.int32) - 1
+        perm = jnp.arange(n)
 
-    perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
-    p_mat = jnp.eye(n, dtype=x.dtype)[perm].T
-    return p_mat, l, u
+        def body(i, p):
+            j = piv[i]
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        p_mat = jnp.eye(n, dtype=mat.dtype)[perm].T
+        return p_mat, l, u
+
+    if x.ndim == 2:
+        return one(x, y)
+    # batched: flatten leading dims and vmap the single-matrix unpack
+    lead = x.shape[:-2]
+    xm = x.reshape((-1, n, n))
+    ym = y.reshape((-1, y.shape[-1]))
+    p_mat, l, u = jax.vmap(one)(xm, ym)
+    return (p_mat.reshape(lead + (n, n)), l.reshape(lead + (n, n)),
+            u.reshape(lead + (n, n)))
 
 
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):

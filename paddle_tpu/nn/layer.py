@@ -73,13 +73,16 @@ class Layer:
         from .initializer import XavierNormal, Constant, _apply_initializer
         dtype = _dtype.convert_dtype(dtype) or self._dtype
         # precedence (reference set_global_initializer semantics):
-        # attr-specified > global override > layer default > builtin
+        # attr-specified > layer default_initializer > global > builtin
+        # (norm layers pass Constant defaults the global must not break)
         from . import initializer as _init_mod
-        glob = _init_mod._GLOBAL_BIAS_INIT if is_bias \
-            else _init_mod._GLOBAL_WEIGHT_INIT
-        init = glob or default_initializer
+        init = default_initializer
         if attr is not None and getattr(attr, "initializer", None) is not None:
             init = attr.initializer
+        if init is None:
+            glob = _init_mod._GLOBAL_BIAS_INIT if is_bias \
+                else _init_mod._GLOBAL_WEIGHT_INIT
+            init = glob
         if init is None:
             init = Constant(0.0) if is_bias else XavierNormal()
         data = _apply_initializer(init, shape, dtype)

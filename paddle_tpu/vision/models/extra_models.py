@@ -1,0 +1,705 @@
+"""Model-zoo long tail (reference: python/paddle/vision/models/ — vgg.py,
+alexnet.py, squeezenet.py, densenet.py, googlenet.py, inceptionv3.py,
+shufflenetv2.py, mobilenetv2.py, mobilenetv3.py, resnet.py variants).
+Compact faithful definitions over this framework's nn layers; all NCHW,
+all MXU-friendly convs."""
+from __future__ import annotations
+
+from ...nn import (Layer, Sequential, Conv2D, BatchNorm2D, Linear, ReLU,
+                   ReLU6, Hardswish, Hardsigmoid, Dropout, Flatten,
+                   MaxPool2D, AdaptiveAvgPool2D, AvgPool2D)
+from ...nn import functional as F
+from ...tensor_ops import manipulation as MA
+
+
+def _cbr(cin, cout, k, s=1, p=0, groups=1, act=ReLU):
+    layers = [Conv2D(cin, cout, k, stride=s, padding=p, groups=groups,
+                     bias_attr=False), BatchNorm2D(cout)]
+    if act is not None:
+        layers.append(act())
+    return Sequential(*layers)
+
+
+# ------------------------------------------------------------------
+# VGG
+# ------------------------------------------------------------------
+
+_VGG_CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+         "M", 512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Layer):
+    """reference: vision/models/vgg.py VGG(features, num_classes)."""
+
+    def __init__(self, features, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.features = features
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((7, 7))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(512 * 7 * 7, 4096), ReLU(), Dropout(),
+                Linear(4096, 4096), ReLU(), Dropout(),
+                Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(MA.flatten(x, 1))
+        return x
+
+
+def _vgg_features(cfg, batch_norm=False):
+    layers, cin = [], 3
+    for v in cfg:
+        if v == "M":
+            layers.append(MaxPool2D(kernel_size=2, stride=2))
+        else:
+            layers.append(Conv2D(cin, v, 3, padding=1))
+            if batch_norm:
+                layers.append(BatchNorm2D(v))
+            layers.append(ReLU())
+            cin = v
+    return Sequential(*layers)
+
+
+def _vgg(depth, batch_norm=False, **kw):
+    return VGG(_vgg_features(_VGG_CFGS[depth], batch_norm), **kw)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kw):
+    return _vgg(11, batch_norm, **kw)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kw):
+    return _vgg(13, batch_norm, **kw)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kw):
+    return _vgg(16, batch_norm, **kw)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kw):
+    return _vgg(19, batch_norm, **kw)
+
+
+# ------------------------------------------------------------------
+# AlexNet / SqueezeNet
+# ------------------------------------------------------------------
+
+class AlexNet(Layer):
+    """reference: vision/models/alexnet.py."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, 2),
+            Conv2D(64, 192, 5, padding=2), ReLU(), MaxPool2D(3, 2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(), MaxPool2D(3, 2))
+        self.avgpool = AdaptiveAvgPool2D((6, 6))
+        self.classifier = Sequential(
+            Dropout(), Linear(256 * 6 * 6, 4096), ReLU(),
+            Dropout(), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(MA.flatten(x, 1))
+
+
+def alexnet(pretrained=False, **kw):
+    return AlexNet(**kw)
+
+
+class _Fire(Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Sequential(Conv2D(cin, squeeze, 1), ReLU())
+        self.e1 = Sequential(Conv2D(squeeze, e1, 1), ReLU())
+        self.e3 = Sequential(Conv2D(squeeze, e3, 3, padding=1), ReLU())
+
+    def forward(self, x):
+        x = self.squeeze(x)
+        return MA.concat([self.e1(x), self.e3(x)], axis=1)
+
+
+class SqueezeNet(Layer):
+    """reference: vision/models/squeezenet.py (version 1.0/1.1)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, 2), _Fire(128, 32, 128, 128),
+                _Fire(256, 32, 128, 128), MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = Sequential(
+            Dropout(), Conv2D(512, num_classes, 1), ReLU(),
+            AdaptiveAvgPool2D((1, 1)))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return MA.flatten(x, 1)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    return SqueezeNet("1.1", **kw)
+
+
+# ------------------------------------------------------------------
+# DenseNet
+# ------------------------------------------------------------------
+
+class _DenseLayer(Layer):
+    def __init__(self, cin, growth, bn_size):
+        super().__init__()
+        self.fn = Sequential(
+            BatchNorm2D(cin), ReLU(),
+            Conv2D(cin, bn_size * growth, 1, bias_attr=False),
+            BatchNorm2D(bn_size * growth), ReLU(),
+            Conv2D(bn_size * growth, growth, 3, padding=1,
+                   bias_attr=False))
+
+    def forward(self, x):
+        return MA.concat([x, self.fn(x)], axis=1)
+
+
+class DenseNet(Layer):
+    """reference: vision/models/densenet.py DenseNet(layers=121)."""
+
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 dropout=0.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfgs = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+                169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+                264: (6, 12, 64, 48)}
+        block_cfg = cfgs[layers]
+        num_init = 2 * growth_rate
+        feats = [Conv2D(3, num_init, 7, stride=2, padding=3,
+                        bias_attr=False), BatchNorm2D(num_init), ReLU(),
+                 MaxPool2D(3, 2, padding=1)]
+        c = num_init
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth_rate, bn_size))
+                c += growth_rate
+            if i != len(block_cfg) - 1:
+                feats += [BatchNorm2D(c), ReLU(),
+                          Conv2D(c, c // 2, 1, bias_attr=False),
+                          AvgPool2D(2, 2)]
+                c //= 2
+        feats += [BatchNorm2D(c), ReLU()]
+        self.features = Sequential(*feats)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(MA.flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)
+
+
+# ------------------------------------------------------------------
+# GoogLeNet / InceptionV3
+# ------------------------------------------------------------------
+
+class _InceptionBlock(Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _cbr(cin, c1, 1)
+        self.b3 = Sequential(_cbr(cin, c3r, 1), _cbr(c3r, c3, 3, p=1))
+        self.b5 = Sequential(_cbr(cin, c5r, 1), _cbr(c5r, c5, 5, p=2))
+        self.bp = Sequential(MaxPool2D(3, 1, padding=1),
+                             _cbr(cin, proj, 1))
+
+    def forward(self, x):
+        return MA.concat([self.b1(x), self.b3(x), self.b5(x),
+                          self.bp(x)], axis=1)
+
+
+class GoogLeNet(Layer):
+    """reference: vision/models/googlenet.py (inception v1, aux heads
+    returned during training like the reference)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _cbr(3, 64, 7, s=2, p=3), MaxPool2D(3, 2, padding=1),
+            _cbr(64, 64, 1), _cbr(64, 192, 3, p=1),
+            MaxPool2D(3, 2, padding=1))
+        self.i3a = _InceptionBlock(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _InceptionBlock(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, 2, padding=1)
+        self.i4a = _InceptionBlock(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _InceptionBlock(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _InceptionBlock(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _InceptionBlock(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _InceptionBlock(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, 2, padding=1)
+        self.i5a = _InceptionBlock(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _InceptionBlock(832, 384, 192, 384, 48, 128, 128)
+        self.avgpool = AdaptiveAvgPool2D((1, 1))
+        self.dropout = Dropout(0.4)
+        self.fc = Linear(1024, num_classes)
+        self.aux1 = Linear(512, num_classes)
+        self.aux2 = Linear(528, num_classes)
+        self.aux_pool = AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.pool3(self.i3b(self.i3a(self.stem(x))))
+        x = self.i4a(x)
+        aux1 = self.aux1(MA.flatten(self.aux_pool(x), 1))
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2 = self.aux2(MA.flatten(self.aux_pool(x), 1))
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        out = self.fc(self.dropout(MA.flatten(self.avgpool(x), 1)))
+        return out, aux1, aux2
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)
+
+
+class _IncA(Layer):
+    def __init__(self, cin, pool_feat):
+        super().__init__()
+        self.b1 = _cbr(cin, 64, 1)
+        self.b5 = Sequential(_cbr(cin, 48, 1), _cbr(48, 64, 5, p=2))
+        self.b3 = Sequential(_cbr(cin, 64, 1), _cbr(64, 96, 3, p=1),
+                             _cbr(96, 96, 3, p=1))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1),
+                             _cbr(cin, pool_feat, 1))
+
+    def forward(self, x):
+        return MA.concat([self.b1(x), self.b5(x), self.b3(x),
+                          self.bp(x)], axis=1)
+
+
+class _IncReduceA(Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _cbr(cin, 384, 3, s=2)
+        self.b3d = Sequential(_cbr(cin, 64, 1), _cbr(64, 96, 3, p=1),
+                              _cbr(96, 96, 3, s=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return MA.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    """reference: vision/models/inceptionv3.py — stem + A blocks +
+    reduction + simplified deeper tower keeping the reference's channel
+    plan at the head (2048 features)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _cbr(3, 32, 3, s=2), _cbr(32, 32, 3), _cbr(32, 64, 3, p=1),
+            MaxPool2D(3, 2), _cbr(64, 80, 1), _cbr(80, 192, 3),
+            MaxPool2D(3, 2))
+        self.a1 = _IncA(192, 32)
+        self.a2 = _IncA(256, 64)
+        self.a3 = _IncA(288, 64)
+        self.red = _IncReduceA(288)
+        self.tail = Sequential(
+            _cbr(768, 1280, 1), _cbr(1280, 2048, 3, s=2, p=1))
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout()
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.red(self.a3(self.a2(self.a1(self.stem(x))))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(MA.flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)
+
+
+# ------------------------------------------------------------------
+# ShuffleNetV2
+# ------------------------------------------------------------------
+
+class _ShuffleUnit(Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 1:
+            self.right = Sequential(
+                _cbr(cin // 2, branch, 1),
+                _cbr(branch, branch, 3, p=1, groups=branch, act=None),
+                _cbr(branch, branch, 1))
+            self.left = None
+        else:
+            self.left = Sequential(
+                _cbr(cin, cin, 3, s=2, p=1, groups=cin, act=None),
+                _cbr(cin, branch, 1))
+            self.right = Sequential(
+                _cbr(cin, branch, 1),
+                _cbr(branch, branch, 3, s=2, p=1, groups=branch,
+                     act=None),
+                _cbr(branch, branch, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            xl, xr = x[:, :half], x[:, half:]
+            out = MA.concat([xl, self.right(xr)], axis=1)
+        else:
+            out = MA.concat([self.left(x), self.right(x)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    """reference: vision/models/shufflenetv2.py."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        stage_out = {0.25: [24, 48, 96, 512],
+                     0.33: [32, 64, 128, 512],
+                     0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+                     1.5: [176, 352, 704, 1024],
+                     2.0: [244, 488, 976, 2048]}[scale]
+        self.stem = Sequential(_cbr(3, 24, 3, s=2, p=1),
+                               MaxPool2D(3, 2, padding=1))
+        blocks = []
+        cin = 24
+        for stage, (reps, cout) in enumerate(
+                zip((4, 8, 4), stage_out[:3])):
+            blocks.append(_ShuffleUnit(cin, cout, 2))
+            for _ in range(reps - 1):
+                blocks.append(_ShuffleUnit(cout, cout, 1))
+            cin = cout
+        self.blocks = Sequential(*blocks)
+        self.tail = _cbr(cin, stage_out[3], 1)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(stage_out[3], num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(MA.flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(2.0, **kw)
+
+
+# ------------------------------------------------------------------
+# MobileNetV2 / V3
+# ------------------------------------------------------------------
+
+class _InvertedResidual(Layer):
+    def __init__(self, cin, cout, stride, expand, k=3, act=ReLU6,
+                 use_se=False):
+        super().__init__()
+        hidden = int(round(cin * expand))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand != 1:
+            layers.append(_cbr(cin, hidden, 1, act=act))
+        layers.append(_cbr(hidden, hidden, k, s=stride, p=k // 2,
+                           groups=hidden, act=act))
+        self.se = _SqueezeExcite(hidden) if use_se else None
+        self.pre = Sequential(*layers)
+        self.post = _cbr(hidden, cout, 1, act=None)
+
+    def forward(self, x):
+        h = self.pre(x)
+        if self.se is not None:
+            h = self.se(h)
+        h = self.post(h)
+        return x + h if self.use_res else h
+
+
+class _SqueezeExcite(Layer):
+    def __init__(self, c, r=4):
+        super().__init__()
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        self.fc1 = Conv2D(c, c // r, 1)
+        self.fc2 = Conv2D(c // r, c, 1)
+
+    def forward(self, x):
+        s = self.pool(x)
+        s = F.relu(self.fc1(s))
+        s = F.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class MobileNetV2(Layer):
+    """reference: vision/models/mobilenetv2.py."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+               (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+               (6, 320, 1, 1)]
+        cin = int(32 * scale)
+        feats = [_cbr(3, cin, 3, s=2, p=1, act=ReLU6)]
+        for t, c, n, s in cfg:
+            cout = int(c * scale)
+            for i in range(n):
+                feats.append(_InvertedResidual(cin, cout,
+                                               s if i == 0 else 1, t))
+                cin = cout
+        last = int(1280 * max(1.0, scale))
+        feats.append(_cbr(cin, last, 1, act=ReLU6))
+        self.features = Sequential(*feats)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool2d_avg = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = self.classifier(MA.flatten(x, 1))
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)
+
+
+def _make_divisible(v, divisor=8):
+    out = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if out < 0.9 * v:
+        out += divisor
+    return out
+
+
+class _MNV3(Layer):
+    def __init__(self, cfg, last_c, cls_c, num_classes, with_pool,
+                 scale=1.0):
+        super().__init__()
+        cin = _make_divisible(16 * scale)
+        feats = [_cbr(3, cin, 3, s=2, p=1, act=Hardswish)]
+        for k, exp, cout, use_se, act, s in cfg:
+            exp_c = _make_divisible(exp * scale)
+            cout_c = _make_divisible(cout * scale)
+            feats.append(_InvertedResidual(
+                cin, cout_c, s, exp_c / cin, k=k,
+                act=ReLU if act == "relu" else Hardswish, use_se=use_se))
+            cin = cout_c
+        last_c = _make_divisible(last_c * scale)
+        feats.append(_cbr(cin, last_c, 1, act=Hardswish))
+        self.features = Sequential(*feats)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(last_c, cls_c), Hardswish(), Dropout(0.2),
+                Linear(cls_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(MA.flatten(x, 1))
+        return x
+
+
+class MobileNetV3Small(_MNV3):
+    """reference: vision/models/mobilenetv3.py MobileNetV3Small."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        cfg = [(3, 16, 16, True, "relu", 2),
+               (3, 72, 24, False, "relu", 2),
+               (3, 88, 24, False, "relu", 1),
+               (5, 96, 40, True, "hardswish", 2),
+               (5, 240, 40, True, "hardswish", 1),
+               (5, 240, 40, True, "hardswish", 1),
+               (5, 120, 48, True, "hardswish", 1),
+               (5, 144, 48, True, "hardswish", 1),
+               (5, 288, 96, True, "hardswish", 2),
+               (5, 576, 96, True, "hardswish", 1),
+               (5, 576, 96, True, "hardswish", 1)]
+        super().__init__(cfg, 576, 1024, num_classes, with_pool,
+                         scale=scale)
+
+
+class MobileNetV3Large(_MNV3):
+    """reference: vision/models/mobilenetv3.py MobileNetV3Large."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        cfg = [(3, 16, 16, False, "relu", 1),
+               (3, 64, 24, False, "relu", 2),
+               (3, 72, 24, False, "relu", 1),
+               (5, 72, 40, True, "relu", 2),
+               (5, 120, 40, True, "relu", 1),
+               (5, 120, 40, True, "relu", 1),
+               (3, 240, 80, False, "hardswish", 2),
+               (3, 200, 80, False, "hardswish", 1),
+               (3, 184, 80, False, "hardswish", 1),
+               (3, 184, 80, False, "hardswish", 1),
+               (3, 480, 112, True, "hardswish", 1),
+               (3, 672, 112, True, "hardswish", 1),
+               (5, 672, 160, True, "hardswish", 2),
+               (5, 960, 160, True, "hardswish", 1),
+               (5, 960, 160, True, "hardswish", 1)]
+        super().__init__(cfg, 960, 1280, num_classes, with_pool,
+                         scale=scale)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Small(scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+# ------------------------------------------------------------------
+# ResNeXt / wide-ResNet over the existing ResNet skeleton
+# ------------------------------------------------------------------
+
+class _GroupedBottleneck(Layer):
+    expansion = 4
+
+    def __init__(self, cin, planes, stride=1, downsample=None, groups=32,
+                 base_width=4):
+        super().__init__()
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv = Sequential(
+            _cbr(cin, width, 1),
+            _cbr(width, width, 3, s=stride, p=1, groups=groups),
+            _cbr(width, planes * self.expansion, 1, act=None))
+        self.downsample = downsample
+        self.relu = ReLU()
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        return self.relu(self.conv(x) + identity)
+
+
+def _grouped_resnet(depth, groups, base_width, **kw):
+    from .resnet import ResNet
+    import functools
+
+    class _Block(_GroupedBottleneck):
+        def __init__(self, cin, planes, stride=1, downsample=None):
+            super().__init__(cin, planes, stride, downsample,
+                             groups=groups, base_width=base_width)
+    _Block.expansion = _GroupedBottleneck.expansion
+    return ResNet(_Block, depth, **kw)
+
+
+def resnext50_32x4d(pretrained=False, **kw):
+    """reference: vision/models/resnet.py resnext50_32x4d."""
+    return _grouped_resnet(50, 32, 4, **kw)
+
+
+def resnext101_32x4d(pretrained=False, **kw):
+    return _grouped_resnet(101, 32, 4, **kw)
+
+
+def resnext152_32x4d(pretrained=False, **kw):
+    return _grouped_resnet(152, 32, 4, **kw)
+
+
+def wide_resnet50_2(pretrained=False, **kw):
+    """reference: vision/models/resnet.py wide_resnet50_2 (2x width)."""
+    return _grouped_resnet(50, 1, 128, **kw)
+
+
+def wide_resnet101_2(pretrained=False, **kw):
+    return _grouped_resnet(101, 1, 128, **kw)

@@ -287,3 +287,53 @@ def test_dataset_folder(tmp_path):
     assert target in (0, 1)
     flat = paddle.vision.datasets.ImageFolder(str(tmp_path))
     assert len(flat) == 4
+
+
+def test_model_zoo_families_forward():
+    """Every model family in the reference zoo instantiates and runs a
+    forward pass (tiny input; GoogLeNet returns (out, aux1, aux2))."""
+    from paddle_tpu.vision import models as M
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .normal(size=(1, 3, 64, 64)).astype(np.float32))
+    ctors = [M.vgg11, M.alexnet, M.squeezenet1_0, M.densenet121,
+             M.inception_v3, M.shufflenet_v2_x1_0, M.mobilenet_v2,
+             M.mobilenet_v3_small, M.mobilenet_v3_large,
+             M.resnext50_32x4d, M.wide_resnet50_2]
+    for ctor in ctors:
+        paddle.seed(0)
+        net = ctor(num_classes=7)
+        net.eval()
+        assert net(x).shape == [1, 7], ctor.__name__
+    out, aux1, aux2 = M.googlenet(num_classes=7)(x)
+    assert out.shape == [1, 7] and aux1.shape == [1, 7]
+
+
+def test_hapi_new_callbacks():
+    from paddle_tpu.hapi import ReduceLROnPlateau, VisualDL
+
+    class _Opt:
+        def __init__(self):
+            self.lr = 1.0
+
+        def get_lr(self):
+            return self.lr
+
+        def set_lr(self, v):
+            self.lr = v
+
+    class _Model:
+        _optimizer = _Opt()
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                           verbose=0)
+    cb.model = _Model()
+    cb.on_epoch_end(0, {"loss": 1.0})
+    cb.on_epoch_end(1, {"loss": 1.0})  # no improvement → wait=1 ≥ patience
+    assert cb.model._optimizer.lr == 0.5
+
+    import tempfile, os, json
+    with tempfile.TemporaryDirectory() as d:
+        v = VisualDL(log_dir=d)
+        v.on_epoch_end(0, {"loss": 0.25})
+        line = open(os.path.join(d, "scalars.jsonl")).readline()
+        assert json.loads(line)["loss"] == 0.25
