@@ -42,6 +42,23 @@ class GradNode:
         return f"<GradNode {self.name}>"
 
 
+class _EdgeRef:
+    """Topology-only stand-in for an intermediate input tensor when
+    saved_tensors_hooks are active: keeps the autograd edge (producer
+    node, output index, registered hooks) WITHOUT pinning the tensor's
+    device array, so pack() genuinely controls what stays resident
+    between forward and backward (reference: TensorWrapper's
+    unpack_hook-backed storage, paddle/fluid/eager/tensor_wrapper.h)."""
+
+    __slots__ = ("_grad_node", "_out_index", "stop_gradient", "_hooks")
+
+    def __init__(self, t):
+        self._grad_node = t._grad_node
+        self._out_index = t._out_index
+        self.stop_gradient = t.stop_gradient
+        self._hooks = t._hooks
+
+
 def _is_float0(g):
     return g is None or getattr(g, "dtype", None) == jax.dtypes.float0
 
@@ -70,8 +87,12 @@ def _topo_order(roots):
     return order
 
 
-def _symbolic_vjp(node, cots):
-    """Compute input cotangents as recorded tape ops (differentiable)."""
+def _symbolic_vjp(node, cots, prims=None):
+    """Compute input cotangents as recorded tape ops (differentiable).
+
+    `prims` overrides the primal tensors read for linearization (used by
+    saved_tensors_hooks so unpack's returns are what backward consumes);
+    defaults to node.inputs."""
     from .tensor import Tensor
     from .dispatch import apply_op
     n_out = len(cots)
@@ -81,13 +102,14 @@ def _symbolic_vjp(node, cots):
 
     def grad_fn(*all_args):
         cs = all_args[:n_out]
-        prims = all_args[n_out:]
-        _, vjp = jax.vjp(node.pure, *prims)
+        prim_arrays = all_args[n_out:]
+        _, vjp = jax.vjp(node.pure, *prim_arrays)
         out = vjp(cs[0] if single else tuple(cs))
         return tuple(out)
 
     res = apply_op(node.name + "_grad", grad_fn,
-                   cot_tensors + tuple(node.inputs))
+                   cot_tensors + tuple(prims if prims is not None
+                                       else node.inputs))
     if not isinstance(res, tuple):
         res = (res,)
     return res
@@ -162,18 +184,53 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
         if not any_live:
             continue
         if node.packed_saved is not None:
-            # saved_tensors_hooks: unpack fires when backward consumes
-            # this node's saved tensors (both vjp and create_graph paths)
+            # saved_tensors_hooks: pack() REPLACED the saved tensors at
+            # forward time (no vjp closure was kept), so backward must
+            # unpack and re-linearize the op from unpack's returns — the
+            # values backward consumes ARE what unpack produced.  Under
+            # retain_graph/create_graph the packed values are kept so the
+            # hooks fire again on every backward pass.
             _, _unpack = node.saved_hooks
-            for _packed in node.packed_saved:
-                _unpack(_packed)
-            node.packed_saved = None
+            unpacked = [_unpack(p) for p in node.packed_saved]
+            arrs = [u._data if isinstance(u, Tensor) else jnp.asarray(u)
+                    for u in unpacked]
+            if create_graph:
+                # the symbolic-replay path must linearize at unpack's
+                # returns: build per-PASS substitute tensors carrying the
+                # unpacked values with the original autograd edges, and
+                # transiently swap leaf data so identity-keyed .grad
+                # routing still lands on the user's tensors.  node.inputs
+                # is never overwritten — every later pass re-unpacks.
+                hook_prims, hook_swaps = [], []
+                for e, a in zip(node.inputs, arrs):
+                    if isinstance(e, Tensor):
+                        hook_swaps.append((e, e._data_))
+                        e._data_ = a
+                        hook_prims.append(e)
+                        continue
+                    t = Tensor(a, stop_gradient=e.stop_gradient)
+                    t._grad_node = e._grad_node
+                    t._out_index = e._out_index
+                    t._hooks = e._hooks
+                    hook_prims.append(t)
+            else:
+                _, node.vjp_fn = jax.vjp(node.pure, *arrs)
+            if not (retain_graph or create_graph):
+                node.packed_saved = None
+        else:
+            hook_prims, hook_swaps = None, ()
         if create_graph and node.pure is not None:
             # Higher-order mode: re-derive the VJP as a *recorded op* over
             # (cotangents, primal inputs) so the gradient computation itself
             # is differentiable (reference: GeneralGrad create_graph,
             # paddle/fluid/eager/backward.cc:102).
-            in_grads = _symbolic_vjp(node, cots)
+            try:
+                in_grads = _symbolic_vjp(node, cots, prims=hook_prims)
+            finally:
+                # reverse: a tensor appearing twice in node.inputs (x*x)
+                # records the already-swapped value as its second "orig"
+                for t, orig in reversed(hook_swaps):
+                    t._data_ = orig
         else:
             seed = cots[0] if node.single_output else tuple(cots)
             if node.vjp_fn is None:

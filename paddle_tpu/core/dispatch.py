@@ -99,8 +99,19 @@ def apply_op(name, fn, args, static=None, nondiff=False):
     if _state.STATE.amp_level in ("O1", "O2"):
         arrays = _amp_cast(name, arrays)
 
+    # `pure` must not close over the input Tensors (or their arrays): under
+    # saved_tensors_hooks the node keeps `pure` for backward re-linearization,
+    # and a closure pinning the original device arrays would defeat offload
+    # hooks.  Blank the tensor slots out of the captured template.
+    template = [list(a) if isinstance(a, (list, tuple)) else a for a in args]
+    for (i, j) in tensor_paths:
+        if j is None:
+            template[i] = None
+        else:
+            template[i][j] = None
+
     def pure(*xs):
-        full = [list(a) if isinstance(a, (list, tuple)) else a for a in args]
+        full = [list(a) if isinstance(a, list) else a for a in template]
         for (i, j), x in zip(tensor_paths, xs):
             if j is None:
                 full[i] = x
@@ -110,8 +121,20 @@ def apply_op(name, fn, args, static=None, nondiff=False):
 
     need_grad = (_state.STATE.grad_enabled and not nondiff
                  and any(not t.stop_gradient for t in tensors))
+    hooks = getattr(_state.STATE, "saved_tensor_hooks", None) \
+        if need_grad else None
 
-    if need_grad:
+    if hooks is not None:
+        # saved_tensors_hooks active: do NOT linearize now — jax.vjp's
+        # closure would pin every residual, defeating offload/quantize
+        # hooks.  pack() the op inputs (as the op sees them, i.e. after
+        # AMP cast) instead; backward unpacks and re-linearizes from the
+        # packed values, so pack's result REPLACES the saved tensors and
+        # unpack's return is what backward consumes (reference contract:
+        # python/paddle/autograd/saved_tensors_hooks.py).
+        out = pure(*arrays)
+        vjp_fn = None
+    elif need_grad:
         out, vjp_fn = jax.vjp(pure, *arrays)
     else:
         out = pure(*arrays)
@@ -138,14 +161,24 @@ def apply_op(name, fn, args, static=None, nondiff=False):
     node = None
     if need_grad:
         out_avals = [(o.shape, o.dtype) for o in outs]
-        node = GradNode(name, vjp_fn, tensors, out_avals, single, pure=pure)
-        hooks = getattr(_state.STATE, "saved_tensor_hooks", None)
         if hooks is not None:
-            # autograd.saved_tensors_hooks: pack runs at save time; the
-            # packed values are unpacked when backward reaches this node
+            from .autograd import _EdgeRef
             pack, _ = hooks
-            node.packed_saved = [pack(t) for t in tensors]
+            # pack the arrays the op actually consumed (post-AMP-cast), so
+            # backward's re-linearization reproduces the forward exactly
+            packed = [pack(t if a is t._data else
+                           Tensor(a, stop_gradient=True))
+                      for t, a in zip(tensors, arrays)]
+            # keep only the autograd edge for intermediates — holding the
+            # Tensor itself would pin the activation pack() just offloaded
+            edges = tuple(_EdgeRef(t) if t._grad_node is not None else t
+                          for t in tensors)
+            node = GradNode(name, None, edges, out_avals, single, pure=pure)
+            node.packed_saved = packed
             node.saved_hooks = hooks
+        else:
+            node = GradNode(name, vjp_fn, tensors, out_avals, single,
+                            pure=pure)
     for i, o in enumerate(outs):
         t = Tensor(o, stop_gradient=not need_grad)
         if node is not None:

@@ -458,12 +458,22 @@ class StaticFunction:
         host_vals = [p() for p in entry.providers]
         donate_ok = (not entry.guard_bools
                      and _flags.flag("FLAGS_jit_donate_buffers", True))
+        use_donate = entry.jitted_donate is not None and donate_ok
+        if use_donate:
+            mut_set = set(entry.mut_idx)
+            mut_caps = [cap_arrays[i] for i in entry.mut_idx]
+            const_caps = [a for i, a in enumerate(cap_arrays)
+                          if i not in mut_set]
+            # donation is unsound when a to-be-donated buffer is aliased
+            # by another capture (two mut_targets sharing one array would
+            # donate it twice; a const capture aliasing it would read a
+            # deleted buffer) — fall back to the copying path for this call
+            mut_buf_ids = {id(a) for a in mut_caps}
+            if (len(mut_buf_ids) != len(mut_caps)
+                    or any(id(a) in mut_buf_ids for a in const_caps)):
+                use_donate = False
         try:
-            if entry.jitted_donate is not None and donate_ok:
-                mut_set = set(entry.mut_idx)
-                mut_caps = [cap_arrays[i] for i in entry.mut_idx]
-                const_caps = [a for i, a in enumerate(cap_arrays)
-                              if i not in mut_set]
+            if use_donate:
                 try:
                     out_arrays, mut_arrays, grad_arrays, guard_arrays = \
                         entry.jitted_donate(arg_arrays, mut_caps,

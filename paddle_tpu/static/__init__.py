@@ -58,12 +58,19 @@ class Program:
         self._input_specs = input_specs or []
         self._exported = None   # jax.export.Exported for deserialized progs
         self._params = {}
+        self._name_uid = {}     # auto-name counters for static.nn params
 
     def clone(self, for_test=False):
         p = Program(self._fn, list(self._input_specs))
         p._exported = self._exported
         p._params = dict(self._params)
         return p
+
+    def _reset_uids(self):
+        """Restart auto-name sequencing so a re-run of the same
+        construction code resolves to the SAME cached parameters
+        (reference: params persist in the startup program scope)."""
+        self._name_uid.clear()
 
     @property
     def num_blocks(self):
@@ -206,7 +213,11 @@ class Executor:
                     for s in program._input_specs] if \
                 program._input_specs else \
                 [Tensor(np.asarray(v)) for v in feed.values()]
-            with _state.no_grad():
+            # the running program is the default while its fn executes, so
+            # static.nn parameter creation scopes to THIS program and
+            # re-runs resolve to the same cached weights
+            program._reset_uids()
+            with program_guard(program), _state.no_grad():
                 outs = program._fn(*args)
         if isinstance(outs, Tensor):
             outs = [outs]
@@ -373,3 +384,6 @@ from .compat import (  # noqa: F401,E402
     normalize_program,
 )
 from . import nn  # noqa: F401,E402
+# paddle.static.create_parameter persists in the program scope like the
+# reference's startup-program parameters (overrides the raw compat one)
+from .nn import create_parameter  # noqa: F401,E402,F811

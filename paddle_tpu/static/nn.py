@@ -12,7 +12,63 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..nn import functional as F
-from .compat import create_parameter, py_func  # noqa: F401
+from ..nn.initializer import Constant as _Constant
+from .compat import py_func  # noqa: F401
+from .compat import create_parameter as _create_parameter_raw
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Create-or-reuse a parameter scoped to the active Program.
+
+    The reference's static.nn layers create parameters ONCE in the startup
+    program under a unique_name and reuse them across executor runs
+    (reference: python/paddle/static/nn/common.py fc ->
+    LayerHelper.create_parameter).  Here the Program caches parameters
+    keyed by the explicit attr/name, or by (caller, sequence, shape,
+    dtype) for auto-named ones; Executor.run resets the sequence counters
+    before each invocation so re-running the same construction code (a
+    training loop, the tracer's warmup+discovery double pass) hits the
+    cache and keeps training the same weights instead of silently
+    re-initializing them every step.
+    """
+    import sys
+    from . import default_main_program
+    prog = default_main_program()
+    explicit = name or (getattr(attr, "name", None)
+                        if not isinstance(attr, (str, bool)) else
+                        (attr if isinstance(attr, str) else None))
+    shape_key = tuple(int(s) for s in shape)
+    if explicit:
+        key = explicit
+    else:
+        kind = sys._getframe(1).f_code.co_name
+        uid = prog._name_uid
+        seq = uid.get(kind, 0)
+        uid[kind] = seq + 1
+        # string key: prog._params is sorted for export, keys must compare
+        key = (f"{kind}_{seq}.{'b' if is_bias else 'w'}_0"
+               f"@{'x'.join(map(str, shape_key))}:{dtype}")
+    cached = prog._params.get(key)
+    if cached is not None:
+        from ..core.dtype import convert_dtype
+        matches = (tuple(cached.shape) == shape_key
+                   and cached._data.dtype == convert_dtype(dtype))
+        if matches:
+            return cached
+        if explicit:
+            # reusing an explicit name with a different shape/dtype would
+            # silently discard trained weights — reference errors here too
+            # (unique-name variable reuse mismatch)
+            raise ValueError(
+                f"parameter '{explicit}' already exists with shape "
+                f"{tuple(cached.shape)}/{cached._data.dtype}, requested "
+                f"{shape_key}/{dtype}")
+    p = _create_parameter_raw(shape, dtype, name=name, attr=attr,
+                              is_bias=is_bias,
+                              default_initializer=default_initializer)
+    prog._params[key] = p
+    return p
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
@@ -116,8 +172,8 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,  # noqa: A002
                moving_variance_name=None, do_model_average_for_mean_and_var=True,
                use_global_stats=False):
     c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
-    scale = create_parameter([c], "float32", attr=param_attr)
-    scale._data_ = jnp.ones_like(scale._data_)
+    scale = create_parameter([c], "float32", attr=param_attr,
+                             default_initializer=_Constant(1.0))
     bias = create_parameter([c], "float32", attr=bias_attr, is_bias=True)
     out = F.batch_norm(input, Tensor(jnp.zeros((c,), jnp.float32)),
                        Tensor(jnp.ones((c,), jnp.float32)), weight=scale,
@@ -130,8 +186,8 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,  # noqa: A002
 def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,  # noqa: A002
                   name=None):
     c = input.shape[1]
-    scale = create_parameter([c], "float32", attr=param_attr)
-    scale._data_ = jnp.ones_like(scale._data_)
+    scale = create_parameter([c], "float32", attr=param_attr,
+                             default_initializer=_Constant(1.0))
     bias = create_parameter([c], "float32", attr=bias_attr, is_bias=True)
     return F.instance_norm(input, weight=scale, bias=bias, eps=epsilon)
 
@@ -141,9 +197,9 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,  # noqa: A002
                name=None):
     shape = list(input.shape[begin_norm_axis:])
     n = int(np.prod(shape))
-    w = create_parameter([n], "float32", attr=param_attr) if scale else None
-    if w is not None:
-        w._data_ = jnp.ones_like(w._data_)
+    w = create_parameter([n], "float32", attr=param_attr,
+                         default_initializer=_Constant(1.0)) \
+        if scale else None
     b = create_parameter([n], "float32", attr=bias_attr, is_bias=True) \
         if shift else None
     flat = input.reshape(list(input.shape[:begin_norm_axis]) + [n])
@@ -155,8 +211,8 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,  # noqa: A002
 def group_norm(input, groups, epsilon=1e-5, param_attr=None,  # noqa: A002
                bias_attr=None, act=None, data_layout="NCHW", name=None):
     c = input.shape[1]
-    w = create_parameter([c], "float32", attr=param_attr)
-    w._data_ = jnp.ones_like(w._data_)
+    w = create_parameter([c], "float32", attr=param_attr,
+                         default_initializer=_Constant(1.0))
     b = create_parameter([c], "float32", attr=bias_attr, is_bias=True)
     out = F.group_norm(input, groups, weight=w, bias=b, epsilon=epsilon)
     return getattr(F, act)(out) if act else out
@@ -183,8 +239,8 @@ def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
         n = x.shape[1] if data_format == "NCHW" else x.shape[-1]
     else:
         n = int(np.prod(x.shape[1:]))
-    w = create_parameter([n], "float32", attr=param_attr)
-    w._data_ = jnp.full_like(w._data_, 0.25)
+    w = create_parameter([n], "float32", attr=param_attr,
+                         default_initializer=_Constant(0.25))
     return F.prelu(x, w, data_format=data_format)
 
 

@@ -183,7 +183,12 @@ def cummin(x, axis=None, dtype="int64", name=None):
         axis = 0
     vals = jax.lax.cummin(x, axis=axis)
     iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
-    inds = jax.lax.cummax(jnp.where(x == vals, iota, -1), axis=axis)
+    hit = x == vals
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        # NaN wins the running min but NaN != NaN — record its own index
+        # (reference: cum_maxmin_kernel.cc isnan_ branch)
+        hit = hit | jnp.isnan(x)
+    inds = jax.lax.cummax(jnp.where(hit, iota, -1), axis=axis)
     return vals, inds.astype(dtype)
 
 

@@ -128,12 +128,14 @@ def cauchy_(x, loc=0.0, scale=1.0, name=None):
 
 
 def geometric_(x, probs, name=None):
-    """Fill with Geometric(probs) samples (number of Bernoulli trials
-    until first success, support {1, 2, ...})."""
+    """Fill with continuous geometric samples log(u)/log1p(-probs) —
+    the reference fills the CONTINUOUS value, not the discretized trial
+    count (reference: tensor/creation.py geometric_ =
+    uniform_.log_().divide_(log1p(-probs)), non-integer by example)."""
     key = _state.next_rng_key()
     u = jax.random.uniform(key, tuple(x.shape), jnp.float32,
                            minval=1e-7, maxval=1.0 - 1e-7)
-    arr = jnp.floor(jnp.log(u) / jnp.log1p(-probs)) + 1.0
+    arr = jnp.log(u) / jnp.log1p(-probs)
     x._data_ = arr.astype(x.dtype)
     x._grad_node = None
     return x

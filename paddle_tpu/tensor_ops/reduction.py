@@ -81,9 +81,15 @@ def cummax(x, axis=None, dtype="int64", name=None):
         axis = 0
     vals = jax.lax.cummax(x, axis=axis)
     # per-prefix argmax: each position where the running max is (re)set
-    # contributes its own index; carry the latest such index forward
+    # contributes its own index; carry the latest such index forward.
+    # NaN propagates as the running max but NaN != NaN, so a NaN entry
+    # must count as a hit or the index freezes at the pre-NaN argmax
+    # (reference: cum_maxmin_kernel.cc isnan_ branch).
     iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
-    inds = jax.lax.cummax(jnp.where(x == vals, iota, -1), axis=axis)
+    hit = x == vals
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        hit = hit | jnp.isnan(x)
+    inds = jax.lax.cummax(jnp.where(hit, iota, -1), axis=axis)
     return vals, inds.astype(dtype)
 
 

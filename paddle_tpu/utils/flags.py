@@ -22,7 +22,10 @@ _FLAGS: dict[str, Any] = {
     "FLAGS_matmul_precision": "default",  # maps to jax.default_matmul_precision
     # donate mutated captures (params/opt state) in compiled train steps so
     # XLA updates them in place; disable if user code holds raw jax arrays
-    # of parameters across steps
+    # of parameters across steps, or Tensors that SHARE a parameter's
+    # buffer across steps (e.g. a detach()'d view taken before the step) —
+    # after donation such holds read a deleted buffer.  Captures aliasing
+    # each other within one step are detected and skip donation.
     "FLAGS_jit_donate_buffers": True,
 }
 

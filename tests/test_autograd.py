@@ -166,3 +166,80 @@ def test_cross_entropy_grad():
     def fn(x):
         return paddle.nn.functional.cross_entropy(x, labels)
     check_grad(fn, logits_np)
+
+
+def test_saved_tensors_hooks_unpack_value_consumed():
+    # pack REPLACES the saved tensor; unpack's return is what backward
+    # consumes (reference: python/paddle/autograd/saved_tensors_hooks.py).
+    # y = x*x with saved values replaced by ones -> grad becomes 2, not 2x.
+    x = paddle.to_tensor(np.full(3, 3.0, np.float32), stop_gradient=False)
+    with paddle.autograd.saved_tensors_hooks(
+            lambda t: t.numpy(),
+            lambda p: paddle.to_tensor(np.ones_like(p))):
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full(3, 2.0))
+
+
+def test_saved_tensors_hooks_offload_roundtrip():
+    # host-offload hook: pack -> numpy, unpack -> device; grads must match
+    # the no-hook baseline exactly.
+    xnp = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    x0 = paddle.to_tensor(xnp, stop_gradient=False)
+    ((x0 * x0).sum() * 2.0).backward()
+    x1 = paddle.to_tensor(xnp, stop_gradient=False)
+    with paddle.autograd.saved_tensors_hooks(
+            lambda t: t.numpy(), lambda p: paddle.to_tensor(p)):
+        y = (x1 * x1).sum() * 2.0
+    y.backward()
+    np.testing.assert_allclose(x1.grad.numpy(), x0.grad.numpy(), rtol=1e-6)
+
+
+def test_saved_tensors_hooks_retain_graph_refire():
+    # under retain_graph the packed values are kept, so unpack fires on
+    # EVERY backward pass, not just the first.
+    events = []
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    with paddle.autograd.saved_tensors_hooks(
+            lambda t: events.append("pack") or t.numpy(),
+            lambda p: events.append("unpack") or paddle.to_tensor(p)):
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    n1 = events.count("unpack")
+    y.backward()
+    assert n1 > 0 and events.count("unpack") == 2 * n1
+    np.testing.assert_allclose(x.grad.numpy(), np.full(2, 4.0))
+
+
+def test_saved_tensors_hooks_create_graph_uses_unpack():
+    # create_graph path must ALSO linearize at unpack's returns (code
+    # review: leaf values were read from the original tensors)
+    x = paddle.to_tensor(np.full(3, 3.0, np.float32), stop_gradient=False)
+    with paddle.autograd.saved_tensors_hooks(
+            lambda t: t.numpy(),
+            lambda p: paddle.to_tensor(np.ones_like(p))):
+        y = (x * x).sum()
+    (g,) = paddle.grad([y], [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), np.full(3, 2.0))
+    # and the user's tensor data is restored after the pass
+    np.testing.assert_allclose(x.numpy(), np.full(3, 3.0))
+
+
+def test_saved_tensors_hooks_create_graph_refreshes_per_pass():
+    # each backward pass under retain_graph re-unpacks: an unpack whose
+    # return changes between passes must be honored (code review: stale
+    # first-pass arrays were pinned into node.inputs)
+    calls = []
+    x = paddle.to_tensor(np.full(2, 3.0, np.float32), stop_gradient=False)
+
+    def unpack(p):
+        calls.append(1)
+        return paddle.to_tensor(np.full_like(p, float(len(calls))))
+
+    with paddle.autograd.saved_tensors_hooks(lambda t: t.numpy(), unpack):
+        y = (x * x).sum()
+    (g1,) = paddle.grad([y], [x], retain_graph=True, create_graph=True)
+    n1 = len(calls)
+    (g2,) = paddle.grad([y], [x], retain_graph=True, create_graph=True)
+    assert len(calls) == 2 * n1, "unpack must re-fire on every pass"
+    assert not np.allclose(g1.numpy(), g2.numpy())

@@ -187,7 +187,7 @@ def test_random_fills():
     paddle.uniform_(x, min=0.0, max=2.0)
     assert 0.0 <= float(x.min()) and float(x.max()) <= 2.0
     paddle.geometric_(x, probs=0.5)
-    assert float(x.min()) >= 1.0
+    assert float(x.min()) > 0.0  # continuous value, support (0, inf)
     paddle.cauchy_(x)
     assert np.isfinite(x.numpy()).all()
 

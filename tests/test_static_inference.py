@@ -139,3 +139,74 @@ def test_dynamic_batch_export():
     for bsz in (1, 3, 8):
         out = exe.run(prog, feed={"x": np.ones((bsz, 4), np.float32)})
         assert out[0].shape == (bsz, 3)
+
+
+def test_static_nn_params_persist_across_runs():
+    # reference: static.nn params live in the startup program and persist
+    # across executor runs — re-running the program must NOT re-initialize
+    # the weights (advisor round-2 medium finding).
+    prog = static.Program(
+        lambda x: static.nn.fc(x, 4), [static.data("x", [2, 8])])
+    exe = static.Executor()
+    x = np.random.default_rng(2).standard_normal((2, 8)).astype(np.float32)
+    with static.program_guard(prog):
+        (o1,) = exe.run(prog, feed={"x": x})
+        params1 = dict(prog._params)
+        (o2,) = exe.run(prog, feed={"x": x})
+        params2 = dict(prog._params)
+    assert params1.keys() == params2.keys()
+    for k in params1:
+        assert params1[k] is params2[k], f"param {k} was re-created"
+    np.testing.assert_allclose(o1, o2, rtol=1e-6)
+    # simulated optimizer update is visible on the next run
+    with static.program_guard(prog):
+        for p in prog._params.values():
+            p._data = p._data * 0.0
+        (o3,) = exe.run(prog, feed={"x": x})
+    np.testing.assert_allclose(o3, np.zeros_like(o3), atol=1e-7)
+
+
+def test_static_create_parameter_named_scope():
+    prog = static.Program()
+    with static.program_guard(prog):
+        a = static.create_parameter([3, 3], "float32", name="shared_w")
+        b = static.create_parameter([3, 3], "float32", name="shared_w")
+    assert a is b
+
+
+def test_static_nn_params_persist_without_guard():
+    # exe.run scopes parameter creation to the program it runs even when
+    # no program_guard is active at the call site.
+    prog = static.Program(
+        lambda x: static.nn.fc(x, 4), [static.data("x", [2, 8])])
+    exe = static.Executor()
+    x = np.ones((2, 8), np.float32)
+    (o1,) = exe.run(prog, feed={"x": x})
+    (o2,) = exe.run(prog, feed={"x": x})
+    assert prog._params, "params must be cached on the run program"
+    np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+
+def test_static_nn_batch_norm_scale_persists():
+    # norm-layer scales are initialized via default_initializer, not by
+    # post-creation mutation — re-running must not reset trained values
+    # (code review round 3)
+    prog = static.Program(
+        lambda x: static.nn.batch_norm(x, use_global_stats=True),
+        [static.data("x", [4, 3, 2, 2])])
+    exe = static.Executor()
+    x = np.random.default_rng(3).standard_normal((4, 3, 2, 2)).astype(
+        np.float32)
+    (o1,) = exe.run(prog, feed={"x": x})
+    scale = [p for p in prog._params.values() if p.shape == [3]][0]
+    scale._data = scale._data * 5.0
+    (o2,) = exe.run(prog, feed={"x": x})
+    assert not np.allclose(o1, o2), "scale update must survive re-run"
+
+
+def test_static_create_parameter_name_mismatch_errors():
+    prog = static.Program()
+    with static.program_guard(prog):
+        static.create_parameter([3, 3], "float32", name="w_mm")
+        with pytest.raises(ValueError):
+            static.create_parameter([4, 4], "float32", name="w_mm")

@@ -141,3 +141,26 @@ def test_clone_detach():
     assert not y.stop_gradient
     z = x.detach()
     assert z.stop_gradient
+
+
+def test_geometric_inplace_continuous():
+    # reference geometric_ fills the CONTINUOUS value log(u)/log1p(-p),
+    # not the discretized trial count (advisor round-2 finding)
+    paddle.seed(7)
+    x = paddle.zeros([2000], dtype="float32")
+    x.geometric_(0.5)
+    v = x.numpy()
+    assert (v > 0).all()
+    assert np.abs(v - np.round(v)).max() > 1e-3, "values must not be integral"
+    # mean of continuous variant is 1/ln(1/(1-p)) ~ 1.4427 for p=0.5
+    assert abs(v.mean() - 1.0 / np.log(2.0)) < 0.15
+
+
+def test_cummax_cummin_nan_index():
+    # NaN becomes the running max/min and must record its OWN index
+    # (reference: cum_maxmin_kernel.cc isnan_ branch)
+    x = paddle.to_tensor(np.array([1.0, 3.0, np.nan, 2.0], np.float32))
+    _, imax = paddle.cummax(x, axis=0)
+    _, imin = paddle.cummin(x, axis=0)
+    assert list(imax.numpy()) == [0, 1, 2, 2]
+    assert list(imin.numpy()) == [0, 0, 2, 2]
