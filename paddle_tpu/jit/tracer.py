@@ -284,6 +284,26 @@ class StaticFunction:
     def concrete_cache_size(self):
         return len(self._cache)
 
+    def hlo_fingerprint(self, *args, **kwargs):
+        """sha256 (first 16 hex) of the StableHLO of the compiled entry
+        matching these args — the auditable program identity a benchmark
+        run records so a number can be tied to the exact computation.
+        None if this signature hasn't compiled yet or lowering fails."""
+        import hashlib
+        state = self._cache.get(self._canon_key(args, kwargs))
+        entry = state.last if state is not None else None
+        if entry is None or entry.jitted is None:
+            return None
+        try:
+            arg_arrays, arg_struct = _flatten_args(args, kwargs)
+            cap_arrays = [t._data_ for t in entry.captures]
+            host_vals = [p() for p in entry.providers]
+            text = entry.jitted.lower(arg_arrays, cap_arrays, host_vals,
+                                      arg_struct).as_text()
+        except Exception:
+            return None
+        return hashlib.sha256(text.encode()).hexdigest()[:16]
+
     def _canon_key(self, args, kwargs):
         treedef, sig = _signature(args, kwargs)
         if not self._input_spec:
