@@ -69,18 +69,6 @@ def llama_config(name: str, **overrides) -> LlamaConfig:
     return LlamaConfig(**cfg)
 
 
-def _repeat_kv(x, n_rep):
-    """[b, s, kv_heads, d] → [b, s, kv_heads*n_rep, d] (GQA broadcast;
-    reference: llama modeling repeat_kv — XLA fuses the broadcast into the
-    attention input so no HBM copy materializes)."""
-    if n_rep == 1:
-        return x
-    b, s, h, d = x.shape
-    x = MA.unsqueeze(x, axis=3)                       # [b,s,h,1,d]
-    x = MA.expand(x, [b, s, h, n_rep, d])
-    return MA.reshape(x, [b, s, h * n_rep, d])
-
-
 class LlamaAttention(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -116,9 +104,10 @@ class LlamaAttention(Layer):
             out, cache["k"], cache["v"] = IF.masked_multihead_attention(
                 q, k, v, cache["k"], cache["v"], cache["offset"])
         else:
-            rep = cfg.num_heads // cfg.num_kv_heads
-            k = _repeat_kv(k, rep)
-            v = _repeat_kv(v, rep)
+            # K/V stay at num_kv_heads: the flash kernels index the shared
+            # kv head natively (q_head // n_rep in the BlockSpecs), so GQA
+            # keeps its K/V HBM-traffic win end to end (reference keeps kv
+            # heads distinct in fusion/gpu/masked_multihead_attention.cu)
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                                  training=self.training)
         return self.o_proj(MA.reshape(out, [b, s, h]))

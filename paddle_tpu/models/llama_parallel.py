@@ -24,7 +24,22 @@ from ..distributed.api import shard_constraint
 from ..distributed.mesh import get_mesh
 from ..incubate.nn import functional as IF
 from .gpt_parallel import _constrain_act, _masked_parallel_ce
-from .llama import LlamaConfig, llama_config, _repeat_kv  # noqa: F401
+from .llama import LlamaConfig, llama_config  # noqa: F401
+
+
+def _repeat_kv(x, n_rep):
+    """[b, s, kv_heads, d] → [b, s, kv_heads*n_rep, d].  Only the
+    TP-sharded model broadcasts kv heads: the head axis is sharded over
+    'mp', and repeating keeps the q/k/v head-axis sharding uniform (each
+    mp rank holds whole q-head groups).  The single-chip model passes
+    num_kv_heads K/V straight to the flash kernels, which index the
+    shared head natively."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    x = MA.unsqueeze(x, axis=3)                       # [b,s,h,1,d]
+    x = MA.expand(x, [b, s, h, n_rep, d])
+    return MA.reshape(x, [b, s, h * n_rep, d])
 
 
 class ParallelLlamaAttention(Layer):

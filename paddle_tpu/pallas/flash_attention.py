@@ -2,14 +2,33 @@
 
 Reference capability: FlashAttention-2 via dynloaded CUDA lib (reference:
 paddle/phi/kernels/gpu/flash_attn_kernel.cu:203 → phi::dynload::flash_attn_fwd,
-backward at paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu).  TPU-native
-realization: Pallas kernels that tile Q into VMEM blocks and stream K/V
-blocks **via the grid** (one K/V block resident at a time, double-buffered
-by the Mosaic pipeline), with online softmax in fp32 scratch accumulators.
-Backward is the flash-attention backward: probabilities are recomputed per
-block from the saved logsumexp — never an O(S^2) materialization — with a
-dK/dV kernel (streaming Q innermost) and a dQ kernel (streaming K/V
-innermost).
+backward at paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu; dropout args at
+flash_attn_kernel.cu:203; varlen variant at incubate/nn/functional/
+variable_length_memory_efficient_attention.py).  TPU-native realization:
+Pallas kernels that tile Q into VMEM blocks and stream K/V blocks **via the
+grid** (one K/V block resident at a time, double-buffered by the Mosaic
+pipeline), with online softmax in fp32 scratch accumulators.  Backward is the
+flash-attention backward: probabilities are recomputed per block from the
+saved logsumexp — never an O(S^2) materialization — with a dK/dV kernel
+(streaming Q innermost) and a dQ kernel (streaming K/V innermost).
+
+Feature coverage (all composable, fwd AND bwd):
+
+- **causal** masking with dead-block skipping (clamped index maps dedupe the
+  skipped fetches).
+- **attention dropout** on the probabilities via a counter-based in-kernel
+  PRNG (position+seed hash) — the identical keep-mask is regenerated in the
+  backward kernels, so no O(S^2) mask is ever materialized.
+- **additive/boolean masks** of shape [B|1, H|1, S, S], streamed block-wise
+  through the grid (the analog of the reference's attn_mask path).
+- **segment ids** [B, S]: packed-varlen attention — tokens attend only
+  within their segment (the TPU-native replacement for the reference's
+  cu_seqlens varlen kernels; padding is just a dedicated segment id).
+- **grouped-query attention**: K/V carry num_kv_heads < num_heads and the
+  kernels index the shared K/V head directly (q_head // n_rep) in the
+  BlockSpecs — K/V HBM traffic stays at num_kv_heads scale, never
+  materializing repeated heads (reference keeps kv heads distinct in
+  fusion/gpu/masked_multihead_attention.cu).
 
 Layout: the public op takes [batch, seq, heads, head_dim] (the reference's
 flash-attn layout); internally the kernels run on [batch*heads, seq, d] so
@@ -18,9 +37,10 @@ two block dims to be (8k, 128k) or equal to the array dims, which a
 squeezed head dim in second-to-last position violates.  The relayout is one
 XLA transpose each way, negligible next to the attention itself.
 
-Falls back to a fused XLA attention for masks, dropout, or shapes that
-don't tile.  On CPU the Pallas path can be exercised in interpreter mode
-(set ``PADDLE_TPU_PALLAS_INTERPRET=1``) — that is how CI tests the kernels
+Falls back to a fused XLA attention for shapes that don't tile (seq not a
+multiple of 128, head_dim > 256, mask shapes outside [B|1, H|1, S, S]).
+On CPU the Pallas path can be exercised in interpreter mode (set
+``PADDLE_TPU_PALLAS_INTERPRET=1``) — that is how CI tests the kernels
 without a TPU.
 """
 from __future__ import annotations
@@ -31,6 +51,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.dispatch import apply_op
 from ..core.tensor import Tensor
@@ -52,19 +73,27 @@ def _on_tpu():
 
 
 # ------------------------------------------------------------------
-# XLA fallback (fused by XLA; used on CPU, with masks, or odd shapes)
+# XLA fallback (fused by XLA; used on CPU, for odd shapes)
 # ------------------------------------------------------------------
 
 def _xla_attention(q, k, v, attn_mask=None, causal=False, scale=None,
-                   dropout=0.0, dropout_key=None):
+                   dropout=0.0, dropout_key=None, segment_ids=None):
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if k.shape[2] != q.shape[2]:  # GQA: broadcast kv heads for the fallback
+        n_rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
         s_q, s_k = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
         logits = jnp.where(mask, logits, NEG_INF)
+    if segment_ids is not None:
+        seg = segment_ids.astype(jnp.int32)
+        same = seg[:, None, :, None] == seg[:, None, None, :]
+        logits = jnp.where(same, logits, NEG_INF)
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:
             logits = jnp.where(attn_mask, logits, NEG_INF)
@@ -78,7 +107,7 @@ def _xla_attention(q, k, v, attn_mask=None, causal=False, scale=None,
 
 
 # ------------------------------------------------------------------
-# Pallas forward: grid (B*H, num_q, num_kv), K/V streamed by the grid
+# shared kernel helpers
 # ------------------------------------------------------------------
 
 def _to_bh(x):
@@ -93,8 +122,65 @@ def _from_bh(y, b, h):
     return y.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, block_q, block_k):
+def _apply_masks(s, *, causal, q_start, k_start, block_q, block_k,
+                 qseg=None, kseg=None, mask=None):
+    """Score masking shared by all three kernels: causal position mask,
+    same-segment mask (varlen packing), additive attention mask."""
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if qseg is not None:
+        # qseg (block_q, 1) vs kseg (1, block_k) broadcast — no relayout
+        s = jnp.where(qseg == kseg, s, NEG_INF)
+    if mask is not None:
+        s = s + mask
+    return s
+
+
+def _dropout_uniform(seed, head, q_start, k_start, block_q, block_k):
+    """Counter-based stateless uniform(0,1) per (head, q_pos, k_pos):
+    a murmur-style integer hash, regenerated identically in forward and
+    backward so the same probabilities drop — no mask is materialized."""
+    qp = (q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)).astype(jnp.uint32)
+    kp = (k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)).astype(jnp.uint32)
+    x = qp * jnp.uint32(0x9E3779B1) + kp * jnp.uint32(0x85EBCA77)
+    x = x ^ (seed.astype(jnp.uint32)
+             + head.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F))
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x2C1B3C6D)
+    x = x ^ (x >> 12)
+    x = x * jnp.uint32(0x297A2D39)
+    x = x ^ (x >> 15)
+    return (x >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+
+
+def _unpack_rest(rest, *, dropout, has_mask, has_seg):
+    """Positional ref unpacking for the optional feature inputs."""
+    idx = 0
+    seed_ref = mask_ref = qseg_ref = kseg_ref = None
+    if dropout > 0.0:
+        seed_ref = rest[idx]
+        idx += 1
+    if has_mask:
+        mask_ref = rest[idx]
+        idx += 1
+    if has_seg:
+        qseg_ref, kseg_ref = rest[idx], rest[idx + 1]
+        idx += 2
+    return (seed_ref, mask_ref, qseg_ref, kseg_ref) + tuple(rest[idx:])
+
+
+# ------------------------------------------------------------------
+# Pallas forward: grid (B*H, num_q, num_kv), K/V streamed by the grid
+# ------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
+                dropout, has_mask, has_seg):
     """One (bh, q_block, kv_block) step of the online softmax.
 
     The kv grid axis is innermost: scratch (m, l, acc) carries the running
@@ -102,6 +188,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     """
     from jax.experimental import pallas as pl
 
+    (seed_ref, mask_ref, qseg_ref, kseg_ref,
+     o_ref, lse_ref, m_scr, l_scr, acc_scr) = _unpack_rest(
+        rest, dropout=dropout, has_mask=has_mask, has_seg=has_seg)
+
+    n = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
     num_kv = pl.num_programs(2)
@@ -127,18 +218,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _apply_masks(
+            s, causal=causal, q_start=q_start, k_start=k_start,
+            block_q=block_q, block_k=block_k,
+            qseg=qseg_ref[:] if has_seg else None,
+            kseg=kseg_ref[:] if has_seg else None,
+            mask=mask_ref[:].astype(jnp.float32) if has_mask else None)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
+        if has_mask or has_seg:
+            # fully-masked rows: m_new == NEG_INF makes exp(s-m) == 1 —
+            # zero them so such rows emit 0, not garbage
+            p = jnp.where(s > NEG_INF * 0.5, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         m_scr[:] = m_new
         l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout > 0.0:
+            # softmax normalizes over the UNdropped probabilities; dropout
+            # applies to what multiplies V
+            u = _dropout_uniform(seed_ref[0, 0], n, q_start, k_start,
+                                 block_q, block_k)
+            p = jnp.where(u >= dropout, p, 0.0) / (1.0 - dropout)
         acc_scr[:] = alpha * acc_scr[:] + jnp.dot(
             p, v, preferred_element_type=jnp.float32)
 
@@ -149,11 +250,55 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[:] = (m_scr[:] + jnp.log(l)).astype(lse_ref.dtype)
 
 
-def _causal_kv_spec(block_q, block_k, d, q_axis, kv_axis, causal):
+def _feature_specs(*, b, s, h, h_kv, block_q, block_k, dropout, mask, qseg,
+                   kseg, q_axis, kv_axis, head_of, batch_of, causal,
+                   grid_qi=None):
+    """(in_specs, inputs) for the optional seed/mask/segment inputs, shared
+    by the three kernels.  head_of/batch_of map grid indices to the global
+    q-head / batch; grid_qi maps grid indices to the (clamped) q block."""
+    from jax.experimental import pallas as pl
+
+    specs, inputs = [], []
+    if dropout > 0.0:
+        specs.append(pl.BlockSpec((1, 1), lambda *g: (0, 0)))
+        inputs.append(None)   # seed filled by caller
+    if mask is not None:
+        mb, mh = mask.shape[0], mask.shape[1]
+
+        def mask_index(*g):
+            bi = batch_of(*g) if mb > 1 else 0
+            hi = head_of(*g) if mh > 1 else 0
+            qi = grid_qi(*g) if grid_qi is not None else g[q_axis]
+            j = g[kv_axis]
+            if causal and grid_qi is None:
+                j = jnp.minimum(j, (qi * block_q + block_q - 1) // block_k)
+            return (bi, hi, qi, j)
+        specs.append(pl.BlockSpec((None, None, block_q, block_k),
+                                  mask_index))
+        inputs.append(mask)
+    if qseg is not None:
+        def qseg_index(*g):
+            qi = grid_qi(*g) if grid_qi is not None else g[q_axis]
+            return (batch_of(*g), qi, 0)
+
+        def kseg_index(*g):
+            j = g[kv_axis]
+            if causal and grid_qi is None:
+                qi = g[q_axis]
+                j = jnp.minimum(j, (qi * block_q + block_q - 1) // block_k)
+            return (batch_of(*g), 0, j)
+        specs.append(pl.BlockSpec((None, block_q, 1), qseg_index))
+        specs.append(pl.BlockSpec((None, 1, block_k), kseg_index))
+        inputs.extend([qseg, kseg])
+    return specs, inputs
+
+
+def _causal_kv_spec(block_q, block_k, d, q_axis, kv_axis, causal,
+                    kv_row):
     """kv BlockSpec for a (bh, …) grid: on causal, beyond-diagonal kv
     fetches clamp to the diagonal block (Mosaic dedupes the repeated
     index, so the pl.when-skipped steps cost no HBM traffic).
-    q_axis/kv_axis give the grid positions of the q and kv indices."""
+    kv_row maps the leading grid index to the K/V head row (GQA)."""
     from jax.experimental import pallas as pl
 
     def index(*g):
@@ -161,44 +306,46 @@ def _causal_kv_spec(block_q, block_k, d, q_axis, kv_axis, causal):
         if causal:
             i = g[q_axis]
             j = jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
-        return (g[0], j, 0)
+        return (kv_row(g[0]), j, 0)
     return pl.BlockSpec((None, block_k, d), index)
 
 
-def _causal_q_specs(block_q, block_k, d, q_axis, kv_axis, causal):
-    """(q/do spec, lse/delta spec) for the dkv grid: on causal, dead
-    (above-diagonal) q fetches clamp forward to the first live block
-    (j*block_k)//block_q."""
-    from jax.experimental import pallas as pl
-
-    def qi(*g):
-        i = g[q_axis]
-        if causal:
-            i = jnp.maximum(i, (g[kv_axis] * block_k) // block_q)
-        return (g[0], i, 0)
-    return (pl.BlockSpec((None, block_q, d), qi),
-            pl.BlockSpec((None, block_q, 1), qi))
-
-
-def _pallas_flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
-    """q,k,v: [B, S, H, D] → (out [B, S, H, D], lse [B, H, S, 1] fp32)."""
+def _pallas_flash_fwd(q, k, v, mask=None, qseg=None, kseg=None, seed=None,
+                      *, causal, scale, block_q, block_k, dropout=0.0):
+    """q: [B, S, H, D], k/v: [B, S, H_kv, D] → (out [B, S, H, D],
+    lse [B, H, S, 1] fp32).  mask: [B|1, H|1, S, S] additive fp32;
+    qseg/kseg: [B, S, 1]/[B, 1, S] int32; seed: [1,1] uint32."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    n_rep = h // h_kv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     grid = (b * h, s // block_q, s // block_k)
+    has_mask, has_seg = mask is not None, qseg is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               dropout=dropout, has_mask=has_mask,
+                               has_seg=has_seg)
     qo_spec = pl.BlockSpec((None, block_q, d), lambda n, i, j: (n, i, 0))
     kv_spec = _causal_kv_spec(block_q, block_k, d, q_axis=1, kv_axis=2,
-                              causal=causal)
+                              causal=causal,
+                              kv_row=lambda n: (n // h) * h_kv
+                              + (n % h) // n_rep)
     lse_spec = pl.BlockSpec((None, block_q, 1), lambda n, i, j: (n, i, 0))
+    feat_specs, feat_inputs = _feature_specs(
+        b=b, s=s, h=h, h_kv=h_kv, block_q=block_q, block_k=block_k,
+        dropout=dropout, mask=mask, qseg=qseg, kseg=kseg,
+        q_axis=1, kv_axis=2, head_of=lambda *g: g[0] % h,
+        batch_of=lambda *g: g[0] // h, causal=causal)
+    if dropout > 0.0:
+        feat_inputs[0] = seed
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[qo_spec, kv_spec, kv_spec],
+        in_specs=[qo_spec, kv_spec, kv_spec] + feat_specs,
         out_specs=[qo_spec, lse_spec],
         out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
                    jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32)],
@@ -206,7 +353,7 @@ def _pallas_flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
                         pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(_to_bh(q), _to_bh(k), _to_bh(v))
+    )(_to_bh(q), _to_bh(k), _to_bh(v), *feat_inputs)
     return _from_bh(out, b, h), lse.reshape(b, h, s, 1)
 
 
@@ -215,17 +362,28 @@ def _pallas_flash_fwd(q, k, v, *, causal, scale, block_q, block_k):
 # ------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, block_q, block_k):
-    """grid (B*H, num_kv, num_q): accumulate dK/dV for one kv block while
-    streaming q blocks.  p is recomputed per block from the saved lse."""
+                    *rest, scale, causal, block_q, block_k, dropout,
+                    has_mask, has_seg, h, h_kv, num_q):
+    """grid (B*H_kv, num_kv, num_q*n_rep): accumulate dK/dV for one kv
+    block while streaming (q_head_rep, q_block) innermost — GQA heads
+    sharing this kv head accumulate into the same scratch.  p is
+    recomputed per block from the saved lse."""
     from jax.experimental import pallas as pl
 
-    j = pl.program_id(1)   # kv block
-    i = pl.program_id(2)   # q block (innermost)
-    num_q = pl.num_programs(2)
+    (seed_ref, mask_ref, qseg_ref, kseg_ref,
+     dk_ref, dv_ref, dk_scr, dv_scr) = _unpack_rest(
+        rest, dropout=dropout, has_mask=has_mask, has_seg=has_seg)
 
-    @pl.when(i == 0)
+    n = pl.program_id(0)   # b * h_kv + kv_head
+    j = pl.program_id(1)   # kv block
+    r = pl.program_id(2)   # rep * num_q + q block (innermost)
+    num_r = pl.num_programs(2)
+    i = r % num_q
+    n_rep = h // h_kv
+    # global q-head id (matches the forward's grid index 0) for dropout
+    head = (n // h_kv) * h + (n % h_kv) * n_rep + r // num_q
+
+    @pl.when(r == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -245,38 +403,55 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _apply_masks(
+            s, causal=causal, q_start=q_start, k_start=k_start,
+            block_q=block_q, block_k=block_k,
+            qseg=qseg_ref[:] if has_seg else None,
+            kseg=kseg_ref[:] if has_seg else None,
+            mask=mask_ref[:].astype(jnp.float32) if has_mask else None)
         p = jnp.exp(s - lse)                       # [block_q, block_k]
-        # dv += p^T do
-        dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        # ds = p * (do v^T - delta) * scale;  dk += ds^T q
+        if has_mask or has_seg:
+            # fully-masked rows: lse == NEG_INF would give exp(0) == 1
+            p = jnp.where(s > NEG_INF * 0.5, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            u = _dropout_uniform(seed_ref[0, 0], head, q_start, k_start,
+                                 block_q, block_k)
+            keep = u >= dropout
+            p_v = jnp.where(keep, p, 0.0) / (1.0 - dropout)
+            dp = jnp.where(keep, dp, 0.0) / (1.0 - dropout)
+        else:
+            p_v = p
+        # dv += p̃^T do
+        dv_scr[:] += jax.lax.dot_general(
+            p_v, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # ds = p * (dp - delta) * scale;  dk += ds^T q
         ds = p * (dp - delta) * scale
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(i == num_q - 1)
+    @pl.when(r == num_r - 1)
     def _finalize():
         dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *, scale, causal, block_q, block_k):
+                   *rest, scale, causal, block_q, block_k, dropout,
+                   has_mask, has_seg):
     """grid (B*H, num_q, num_kv): accumulate dQ for one q block while
     streaming kv blocks."""
     from jax.experimental import pallas as pl
 
+    (seed_ref, mask_ref, qseg_ref, kseg_ref,
+     dq_ref, dq_scr) = _unpack_rest(
+        rest, dropout=dropout, has_mask=has_mask, has_seg=has_seg)
+
+    n = pl.program_id(0)
     i = pl.program_id(1)   # q block
     j = pl.program_id(2)   # kv block (innermost)
     num_kv = pl.num_programs(2)
@@ -300,16 +475,22 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _apply_masks(
+            s, causal=causal, q_start=q_start, k_start=k_start,
+            block_q=block_q, block_k=block_k,
+            qseg=qseg_ref[:] if has_seg else None,
+            kseg=kseg_ref[:] if has_seg else None,
+            mask=mask_ref[:].astype(jnp.float32) if has_mask else None)
         p = jnp.exp(s - lse)
+        if has_mask or has_seg:
+            p = jnp.where(s > NEG_INF * 0.5, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            u = _dropout_uniform(seed_ref[0, 0], n, q_start, k_start,
+                                 block_q, block_k)
+            dp = jnp.where(u >= dropout, dp, 0.0) / (1.0 - dropout)
         ds = p * (dp - delta) * scale
         dq_scr[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
@@ -318,79 +499,137 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _pallas_flash_bwd(q, k, v, out, lse, dout, *, causal, scale,
-                      block_q, block_k):
+def _pallas_flash_bwd(q, k, v, out, lse, dout, mask=None, qseg=None,
+                      kseg=None, seed=None, *, causal, scale, block_q,
+                      block_k, dropout=0.0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    n_rep = h // h_kv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
+    has_mask, has_seg = mask is not None, qseg is not None
     # delta_i = rowsum(dO_i * O_i): cheap elementwise+reduce, XLA fuses it
     delta = jnp.einsum("bshd,bshd->bhs", dout.astype(jnp.float32),
                        out.astype(jnp.float32)).reshape(b * h, s, 1)
-    q3, k3, v3, do3 = _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(dout)
+    q3, do3 = _to_bh(q), _to_bh(dout)
+    k3, v3 = _to_bh(k), _to_bh(v)
     lse3 = lse.reshape(b * h, s, 1)
+    num_q = s // block_q
 
-    qo_spec_q, lse_spec_q = _causal_q_specs(block_q, block_k, d,
-                                            q_axis=2, kv_axis=1,
-                                            causal=causal)
-    kv_spec_q = pl.BlockSpec((None, block_k, d), lambda n, j, i: (n, j, 0))
-    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
-                                   causal=causal, block_q=block_q,
-                                   block_k=block_k)
+    # ---- dK/dV: grid (b*h_kv, num_kv, num_q*n_rep) — GQA q-heads that
+    # share a kv head stream through the innermost axis and accumulate
+    def q_row(n, j, r):
+        return (n // h_kv) * h + (n % h_kv) * n_rep + r // num_q
+
+    def qi_clamped(n, j, r):
+        i = r % num_q
+        if causal:
+            i = jnp.maximum(i, (j * block_k) // block_q)
+        return i
+
+    qo_spec_q = pl.BlockSpec(
+        (None, block_q, d), lambda n, j, r: (q_row(n, j, r),
+                                             qi_clamped(n, j, r), 0))
+    lse_spec_q = pl.BlockSpec(
+        (None, block_q, 1), lambda n, j, r: (q_row(n, j, r),
+                                             qi_clamped(n, j, r), 0))
+    kv_spec_q = pl.BlockSpec((None, block_k, d), lambda n, j, r: (n, j, 0))
+    feat_specs_q, feat_inputs_q = _feature_specs(
+        b=b, s=s, h=h, h_kv=h_kv, block_q=block_q, block_k=block_k,
+        dropout=dropout, mask=mask, qseg=qseg, kseg=kseg,
+        q_axis=2, kv_axis=1,
+        head_of=lambda n, j, r: (n % h_kv) * n_rep + r // num_q,
+        batch_of=lambda n, j, r: n // h_kv, causal=causal,
+        grid_qi=qi_clamped)
+    if dropout > 0.0:
+        feat_inputs_q[0] = seed
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, dropout=dropout, has_mask=has_mask,
+        has_seg=has_seg, h=h, h_kv=h_kv, num_q=num_q)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b * h, s // block_k, s // block_q),
+        grid=(b * h_kv, s // block_k, num_q * n_rep),
         in_specs=[qo_spec_q, kv_spec_q, kv_spec_q, qo_spec_q,
-                  lse_spec_q, lse_spec_q],
+                  lse_spec_q, lse_spec_q] + feat_specs_q,
         out_specs=[kv_spec_q, kv_spec_q],
-        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((b * h_kv, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h_kv, s, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=_interpret(),
-    )(q3, k3, v3, do3, lse3, delta)
+    )(q3, k3, v3, do3, lse3, delta, *feat_inputs_q)
 
+    # ---- dQ: grid (b*h, num_q, num_kv)
+    kv_row = lambda n: (n // h) * h_kv + (n % h) // n_rep  # noqa: E731
     qo_spec = pl.BlockSpec((None, block_q, d), lambda n, i, j: (n, i, 0))
     kv_spec = _causal_kv_spec(block_q, block_k, d, q_axis=1, kv_axis=2,
-                              causal=causal)
+                              causal=causal, kv_row=kv_row)
     lse_spec = pl.BlockSpec((None, block_q, 1), lambda n, i, j: (n, i, 0))
-    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                                  block_q=block_q, block_k=block_k)
+    feat_specs, feat_inputs = _feature_specs(
+        b=b, s=s, h=h, h_kv=h_kv, block_q=block_q, block_k=block_k,
+        dropout=dropout, mask=mask, qseg=qseg, kseg=kseg,
+        q_axis=1, kv_axis=2, head_of=lambda *g: g[0] % h,
+        batch_of=lambda *g: g[0] // h, causal=causal)
+    if dropout > 0.0:
+        feat_inputs[0] = seed
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, dropout=dropout, has_mask=has_mask,
+        has_seg=has_seg)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(b * h, s // block_q, s // block_k),
-        in_specs=[qo_spec, kv_spec, kv_spec, qo_spec, lse_spec, lse_spec],
+        grid=(b * h, num_q, s // block_k),
+        in_specs=[qo_spec, kv_spec, kv_spec, qo_spec, lse_spec, lse_spec]
+        + feat_specs,
         out_specs=qo_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q3, k3, v3, do3, lse3, delta)
-    return _from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h)
+    )(q3, k3, v3, do3, lse3, delta, *feat_inputs)
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h_kv),
+            _from_bh(dv, b, h_kv))
 
 
 # ------------------------------------------------------------------
 # custom VJP wiring
 # ------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q, k, v, causal, scale, block_q, block_k):
-    out, _ = _pallas_flash_fwd(q, k, v, causal=causal, scale=scale,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def _flash_core(q, k, v, mask, qseg, kseg, seed, causal, scale, dropout,
+                block_q, block_k):
+    out, _ = _pallas_flash_fwd(q, k, v, mask, qseg, kseg, seed,
+                               causal=causal, scale=scale, dropout=dropout,
                                block_q=block_q, block_k=block_k)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
-    out, lse = _pallas_flash_fwd(q, k, v, causal=causal, scale=scale,
-                                 block_q=block_q, block_k=block_k)
-    return out, (q, k, v, out, lse)
+def _flash_fwd_rule(q, k, v, mask, qseg, kseg, seed, causal, scale, dropout,
+                    block_q, block_k):
+    out, lse = _pallas_flash_fwd(q, k, v, mask, qseg, kseg, seed,
+                                 causal=causal, scale=scale,
+                                 dropout=dropout, block_q=block_q,
+                                 block_k=block_k)
+    return out, (q, k, v, mask, qseg, kseg, seed, out, lse)
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_k, res, dout):
-    q, k, v, out, lse = res
-    return _pallas_flash_bwd(q, k, v, out, lse, dout, causal=causal,
-                             scale=scale, block_q=block_q, block_k=block_k)
+def _flash_bwd_rule(causal, scale, dropout, block_q, block_k, res, dout):
+    q, k, v, mask, qseg, kseg, seed, out, lse = res
+    dq, dk, dv = _pallas_flash_bwd(
+        q, k, v, out, lse, dout, mask, qseg, kseg, seed, causal=causal,
+        scale=scale, dropout=dropout, block_q=block_q, block_k=block_k)
+    # the mask gradient is NOT computed in-kernel; the public op only
+    # routes non-trainable (stop_gradient) masks here — a learned additive
+    # bias takes the XLA path, which differentiates it exactly
+    dmask = jnp.zeros_like(mask) if mask is not None else None
+    f0 = jax.dtypes.float0
+    dqseg = np.zeros(qseg.shape, f0) if qseg is not None else None
+    dkseg = np.zeros(kseg.shape, f0) if kseg is not None else None
+    dseed = np.zeros(seed.shape, f0) if seed is not None else None
+    return dq, dk, dv, dmask, dqseg, dkseg, dseed
 
 
 _flash_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -430,17 +669,17 @@ def autotune_blocks(s, d, dtype=jnp.bfloat16, batch=1, heads=1):
 
     def run(cfg):
         def fwd(q, k, v):
-            return jnp.sum(_flash_core(q, k, v, True, 1.0 / math.sqrt(d),
-                                       cfg[0], cfg[1]).astype(jnp.float32))
+            return jnp.sum(_flash_core(
+                q, k, v, None, None, None, None, True,
+                1.0 / math.sqrt(d), 0.0, cfg[0],
+                cfg[1]).astype(jnp.float32))
         out, grads = jax.value_and_grad(fwd, argnums=(0, 1, 2))(q, q, q)
         jax.block_until_ready(grads)
 
     return at.sweep("flash_attention.fwdbwd", (s, d), cands, run)
 
 
-def _supports_pallas(q, k, v, attn_mask, dropout):
-    if attn_mask is not None or dropout > 0.0:
-        return False
+def _supports_pallas(q, k, v, attn_mask, segment_ids):
     if not (_on_tpu() or _interpret()):
         return False
     b, s, h, d = q.shape
@@ -448,26 +687,70 @@ def _supports_pallas(q, k, v, attn_mask, dropout):
         return False
     if d > 256:
         return False
-    return k.shape == q.shape and v.shape == q.shape
+    if v.shape != k.shape:
+        return False
+    if (k.shape[0], k.shape[1], k.shape[3]) != (b, s, d):
+        return False
+    if h % k.shape[2] != 0:   # GQA: kv heads must divide q heads
+        return False
+    if attn_mask is not None:
+        am = attn_mask
+        if am.ndim != 4 or am.shape[2] != s or am.shape[3] != s:
+            return False
+        if am.shape[0] not in (1, b) or am.shape[1] not in (1, h):
+            return False
+    if segment_ids is not None:
+        if tuple(segment_ids.shape) != (b, s):
+            return False
+    return True
 
 
 def flash_attention(query, key, value, attn_mask=None, dropout=0.0,
-                    causal=False, training=True, scale=None, name=None):
-    """Public op: Tensor-level flash attention, [B, S, H, D]."""
+                    causal=False, training=True, scale=None,
+                    segment_ids=None, name=None):
+    """Public op: Tensor-level flash attention, [B, S, H, D].
+
+    K/V may carry fewer heads than Q (GQA) — the Pallas kernels index the
+    shared kv head directly.  ``segment_ids`` [B, S] enables packed-varlen
+    attention (tokens attend only within their segment).  Dropout and
+    additive/boolean masks run inside the kernels; no O(S^2) fallback."""
     dropout = dropout if training else 0.0
     dropout_key = _state.next_rng_key() if dropout > 0.0 else None
+    # a TRAINABLE additive bias (learned relative-position bias / ALiBi)
+    # must take the XLA path: the Pallas backward does not produce a mask
+    # gradient, and fabricating zeros would silently freeze the bias
+    mask_trainable = (isinstance(attn_mask, Tensor)
+                      and not attn_mask.stop_gradient)
 
-    def fn(q, k, v, m):
+    def fn(q, k, v, m, seg):
         sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-        if _supports_pallas(q, k, v, m, dropout):
+        if _supports_pallas(q, k, v, m, seg) and not mask_trainable:
             block_q, block_k = _pick_blocks(q.shape[1], q.shape[-1])
-            return _flash_core(q, k, v, causal, sc, block_q, block_k)
+            mask_add = None
+            if m is not None:
+                mask_add = (jnp.where(m, 0.0, NEG_INF).astype(jnp.float32)
+                            if m.dtype == jnp.bool_
+                            else m.astype(jnp.float32))
+            qseg = kseg = None
+            if seg is not None:
+                seg32 = seg.astype(jnp.int32)
+                qseg = seg32[:, :, None]
+                kseg = seg32[:, None, :]
+            seed = (jax.random.bits(dropout_key, (1, 1), jnp.uint32)
+                    if dropout > 0.0 else None)
+            return _flash_core(q, k, v, mask_add, qseg, kseg, seed,
+                               causal, sc, float(dropout), block_q,
+                               block_k)
         return _xla_attention(q, k, v, attn_mask=m, causal=causal, scale=sc,
-                              dropout=dropout, dropout_key=dropout_key)
+                              dropout=dropout, dropout_key=dropout_key,
+                              segment_ids=seg)
 
     mask_t = attn_mask if isinstance(attn_mask, Tensor) else None
     if attn_mask is not None and mask_t is None:
         attn_mask = Tensor(jnp.asarray(attn_mask))
         mask_t = attn_mask
-    args = (query, key, value, mask_t)
+    seg_t = segment_ids if isinstance(segment_ids, Tensor) else None
+    if segment_ids is not None and seg_t is None:
+        seg_t = Tensor(jnp.asarray(segment_ids))
+    args = (query, key, value, mask_t, seg_t)
     return apply_op("flash_attention", fn, args)

@@ -6,7 +6,6 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.models import LlamaForCausalLM, llama_config
-from paddle_tpu.models.llama import _repeat_kv
 
 
 def _ids(b=2, s=64, vocab=512, seed=0):
@@ -30,16 +29,20 @@ def test_eager_trains():
     assert losses[-1] < losses[0]
 
 
-def test_gqa_repeat_kv():
-    x = paddle.to_tensor(
-        np.arange(2 * 3 * 2 * 4, dtype=np.float32).reshape(2, 3, 2, 4))
-    y = _repeat_kv(x, 3)
-    assert tuple(y.shape) == (2, 3, 6, 4)
-    xn = np.asarray(x._data_)
-    yn = np.asarray(y._data_)
-    for rep in range(3):
-        np.testing.assert_allclose(yn[:, :, rep], xn[:, :, 0])
-        np.testing.assert_allclose(yn[:, :, 3 + rep], xn[:, :, 1])
+def test_gqa_sdpa_accepts_kv_heads():
+    # K/V at num_kv_heads flow straight into sdpa (no repeat_kv in the
+    # model); result must equal the manual head-broadcast reference
+    import paddle_tpu.nn.functional as F
+    rng = np.random.default_rng(0)
+    q = paddle.to_tensor(rng.standard_normal((2, 8, 6, 4)).astype("float32"))
+    k = paddle.to_tensor(rng.standard_normal((2, 8, 2, 4)).astype("float32"))
+    v = paddle.to_tensor(rng.standard_normal((2, 8, 2, 4)).astype("float32"))
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    kr = paddle.to_tensor(np.repeat(np.asarray(k._data_), 3, axis=2))
+    vr = paddle.to_tensor(np.repeat(np.asarray(v._data_), 3, axis=2))
+    ref = F.scaled_dot_product_attention(q, kr, vr, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out._data_),
+                               np.asarray(ref._data_), atol=1e-5)
 
 
 def test_gqa_matches_mha_when_equal_heads():

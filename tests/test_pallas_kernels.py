@@ -54,7 +54,7 @@ def test_flash_backward_matches_xla(causal):
     sc = 1.0 / np.sqrt(q.shape[-1])
 
     def f_pallas(q_, k_, v_):
-        return (fa._flash_core(q_, k_, v_, causal, sc, 128, 128) ** 2).sum()
+        return (fa._flash_core(q_, k_, v_, None, None, None, None, causal, sc, 0.0, 128, 128) ** 2).sum()
 
     def f_ref(q_, k_, v_):
         return (fa._xla_attention(q_, k_, v_, causal=causal,
@@ -173,7 +173,7 @@ def test_flash_backward_mixed_blocks_causal(bq, bk):
     sc = 1.0 / np.sqrt(q.shape[-1])
 
     def f_pallas(q_, k_, v_):
-        return (fa._flash_core(q_, k_, v_, True, sc, bq, bk) ** 2).sum()
+        return (fa._flash_core(q_, k_, v_, None, None, None, None, True, sc, 0.0, bq, bk) ** 2).sum()
 
     def f_ref(q_, k_, v_):
         return (fa._xla_attention(q_, k_, v_, causal=True,
@@ -184,3 +184,234 @@ def test_flash_backward_mixed_blocks_causal(bq, bk):
     for gp, gr in zip(g_p, g_r):
         np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
                                    atol=5e-5, rtol=5e-5)
+
+
+def _dense_dropout_ref(q, k, v, seed, rate, sc, causal=False):
+    """Dense attention applying the EXACT kernel keep-mask (the hash is
+    position-based, so evaluating it with block = whole matrix reproduces
+    the blocked kernel's mask bit-for-bit)."""
+    b, s, h, d = q.shape
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sc
+    if causal:
+        m = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(m, logits, fa.NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    keeps = []
+    for n in range(b * h):
+        u = fa._dropout_uniform(jnp.uint32(seed), jnp.int32(n), 0, 0, s, s)
+        keeps.append(u >= rate)
+    keep = jnp.stack(keeps).reshape(b, h, s, s)
+    probs = jnp.where(keep, probs / (1.0 - rate), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_dropout_matches_dense_hash(causal):
+    q, k, v = _qkv(b=1, s=256, h=2, d=64, seed=3)
+    sc = 1.0 / np.sqrt(q.shape[-1])
+    seed = jnp.full((1, 1), 1234, jnp.uint32)
+    rate = 0.3
+
+    def f_pallas(q_, k_, v_):
+        return (fa._flash_core(q_, k_, v_, None, None, None, seed,
+                               causal, sc, rate, 128, 128) ** 2).sum()
+
+    def f_ref(q_, k_, v_):
+        return (_dense_dropout_ref(q_, k_, v_, 1234, rate, sc,
+                                   causal) ** 2).sum()
+
+    out = fa._flash_core(q, k, v, None, None, None, seed, causal, sc,
+                         rate, 128, 128)
+    ref = _dense_dropout_ref(q, k, v, 1234, rate, sc, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+    g_p = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gp, gr in zip(g_p, g_r):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                   atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("mask_kind", ["bool_padding", "additive"])
+def test_flash_mask_matches_xla(mask_kind):
+    b, s, h, d = 2, 256, 2, 64
+    q, k, v = _qkv(b=b, s=s, h=h, d=d, seed=4)
+    sc = 1.0 / np.sqrt(d)
+    rng = np.random.default_rng(7)
+    if mask_kind == "bool_padding":
+        # padded-batch key mask: [B, 1, S, S] bool, last 64 keys dead
+        keep = np.ones((b, 1, s, s), bool)
+        keep[:, :, :, s - 64:] = False
+        mask = jnp.asarray(keep)
+        mask_add = jnp.where(mask, 0.0, fa.NEG_INF).astype(jnp.float32)
+    else:
+        mask_add = jnp.asarray(
+            rng.standard_normal((b, h, s, s)), jnp.float32)
+        mask = mask_add
+
+    def f_pallas(q_, k_, v_):
+        return (fa._flash_core(q_, k_, v_, mask_add, None, None, None,
+                               False, sc, 0.0, 128, 128) ** 2).sum()
+
+    def f_ref(q_, k_, v_):
+        return (fa._xla_attention(q_, k_, v_, attn_mask=mask,
+                                  scale=sc) ** 2).sum()
+
+    out = fa._flash_core(q, k, v, mask_add, None, None, None, False, sc,
+                         0.0, 128, 128)
+    ref = fa._xla_attention(q, k, v, attn_mask=mask, scale=sc)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+    g_p = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gp, gr in zip(g_p, g_r):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                   atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segment_ids_varlen(causal):
+    # packed varlen: two sequences of 160+96 tokens in one row
+    b, s, h, d = 2, 256, 2, 64
+    q, k, v = _qkv(b=b, s=s, h=h, d=d, seed=5)
+    sc = 1.0 / np.sqrt(d)
+    seg_np = np.zeros((b, s), np.int32)
+    seg_np[:, 160:] = 1
+    seg = jnp.asarray(seg_np)
+    qseg = seg[:, :, None]
+    kseg = seg[:, None, :]
+
+    def f_pallas(q_, k_, v_):
+        return (fa._flash_core(q_, k_, v_, None, qseg, kseg, None,
+                               causal, sc, 0.0, 128, 64) ** 2).sum()
+
+    def f_ref(q_, k_, v_):
+        return (fa._xla_attention(q_, k_, v_, causal=causal, scale=sc,
+                                  segment_ids=seg) ** 2).sum()
+
+    out = fa._flash_core(q, k, v, None, qseg, kseg, None, causal, sc,
+                         0.0, 128, 64)
+    ref = fa._xla_attention(q, k, v, causal=causal, scale=sc,
+                            segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+    g_p = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gp, gr in zip(g_p, g_r):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                   atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_native_kv_heads(causal):
+    # K/V carry 2 heads, Q carries 4 — kernels must index q_head // n_rep
+    # without materializing repeated K/V (VERDICT r2 item 4)
+    b, s, h, h_kv, d = 2, 256, 4, 2, 64
+    rng = np.random.default_rng(6)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h_kv, d)), jnp.float32)
+    sc = 1.0 / np.sqrt(d)
+
+    def f_pallas(q_, k_, v_):
+        return (fa._flash_core(q_, k_, v_, None, None, None, None,
+                               causal, sc, 0.0, 128, 128) ** 2).sum()
+
+    def f_ref(q_, k_, v_):
+        return (fa._xla_attention(q_, k_, v_, causal=causal,
+                                  scale=sc) ** 2).sum()
+
+    out = fa._flash_core(q, k, v, None, None, None, None, causal, sc,
+                         0.0, 128, 128)
+    ref = fa._xla_attention(q, k, v, causal=causal, scale=sc)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+    g_p = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    assert g_p[1].shape == (b, s, h_kv, d)
+    for gp, gr in zip(g_p, g_r):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_flash_all_features_combined():
+    # GQA + segment ids + dropout + causal in one call: smoke + shapes +
+    # determinism (same seed → same output)
+    b, s, h, h_kv, d = 1, 256, 4, 2, 64
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h_kv, d)), jnp.float32)
+    seg = jnp.asarray(np.repeat([[0, 1]], 128, axis=1).reshape(1, s))
+    qseg, kseg = seg[:, :, None], seg[:, None, :]
+    seed = jnp.full((1, 1), 42, jnp.uint32)
+    sc = 1.0 / np.sqrt(d)
+
+    def run():
+        return fa._flash_core(q, k, v, None, qseg, kseg, seed, True, sc,
+                              0.2, 128, 128)
+    o1, o2 = run(), run()
+    assert o1.shape == (b, s, h, d)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    g = jax.grad(lambda q_: (fa._flash_core(
+        q_, k, v, None, qseg, kseg, seed, True, sc, 0.2, 128,
+        128) ** 2).sum())(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_dropout_gqa_matches_dense_hash(causal):
+    # pins the fwd/bwd dropout-stream head-id algebra under GQA: the dkv
+    # kernel reconstructs head = (n//h_kv)*h + (n%h_kv)*n_rep + r//num_q,
+    # which must match the forward's grid index exactly
+    b, s, h, h_kv, d = 1, 256, 4, 2, 64
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h_kv, d)), jnp.float32)
+    sc = 1.0 / np.sqrt(d)
+    seed = jnp.full((1, 1), 77, jnp.uint32)
+    rate = 0.25
+    n_rep = h // h_kv
+
+    def dense_ref(q_, k_, v_):
+        kr = jnp.repeat(k_, n_rep, axis=2)
+        vr = jnp.repeat(v_, n_rep, axis=2)
+        return _dense_dropout_ref(q_, kr, vr, 77, rate, sc, causal)
+
+    def f_pallas(q_, k_, v_):
+        return (fa._flash_core(q_, k_, v_, None, None, None, seed,
+                               causal, sc, rate, 128, 64) ** 2).sum()
+
+    def f_ref(q_, k_, v_):
+        return (dense_ref(q_, k_, v_) ** 2).sum()
+
+    out = fa._flash_core(q, k, v, None, None, None, seed, causal, sc,
+                         rate, 128, 64)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(dense_ref(q, k, v)),
+                               atol=5e-5, rtol=5e-5)
+    g_p = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    assert g_p[1].shape == (b, s, h_kv, d)
+    for gp, gr in zip(g_p, g_r):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_flash_trainable_mask_gets_gradient():
+    # a learned additive bias must receive its true gradient (XLA path);
+    # the pallas backward produces no mask grad so routing must avoid it
+    import os
+    import paddle_tpu as paddle
+    os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+    rng = np.random.default_rng(10)
+    qv = rng.standard_normal((1, 128, 2, 64)).astype("float32")
+    bias = paddle.to_tensor(
+        np.zeros((1, 2, 128, 128), np.float32), stop_gradient=False)
+    q = paddle.to_tensor(qv, stop_gradient=False)
+    k, v = paddle.to_tensor(qv), paddle.to_tensor(qv)
+    out = fa.flash_attention(q, k, v, attn_mask=bias)
+    (out ** 2).sum().backward()
+    assert bias.grad is not None
+    assert float(np.abs(np.asarray(bias.grad._data_)).max()) > 0
