@@ -284,6 +284,14 @@ class StaticFunction:
     def concrete_cache_size(self):
         return len(self._cache)
 
+    def guard_cache_size(self):
+        """Total compiled guard entries across input signatures.  Bounded:
+        a signature whose guards keep flipping into undiscovered tuples
+        respecializes at most 4 times before falling back to eager (with a
+        warning), so entries per signature never exceed ~6."""
+        return sum(len(s.entries) for s in self._cache.values()
+                   if isinstance(s, _SigState))
+
     def hlo_fingerprint(self, *args, **kwargs):
         """sha256 (first 16 hex) of the StableHLO of the compiled entry
         matching these args — the auditable program identity a benchmark

@@ -1,29 +1,113 @@
 """Control-flow ops (reference: python/paddle/static/nn/control_flow.py —
 cond/while_loop as program ops).
 
-TPU-native realization: the predicate read goes through Tensor.__bool__,
-which the two-phase tracer records as an in-graph GUARD — so under
-`to_static` each taken branch compiles to its own entry and re-dispatches
-on the branch bit (the SOT analog), while eager execution is a plain
-python branch.  A data-dependent `while_loop` trip count is inherently
-host-driven (the reference unrolls it as a program op; XLA would need
-lax.while_loop with traced state, which the eager tape cannot replay), so
-it runs as a python loop — each iteration's body is still traced/compiled
-work."""
+TPU-native realization, two regimes:
+
+- **Gradients disabled** (inference, decode loops, convergence loops):
+  `while_loop` lowers to ONE `jax.lax.while_loop` and `cond` to ONE
+  `jax.lax.cond` — a tensor-dependent trip count executes as a single
+  compiled program under `to_static` (no per-trip-count respecialization,
+  no host round-trip per iteration).  This is the analog of the
+  reference's while/conditional_block program ops executed by
+  InterpreterCore (reference: python/paddle/static/nn/control_flow.py:218
+  While, :1069 cond).
+
+- **Gradients enabled**: the taken path must be materialized on the tape
+  for reverse mode (JAX has no vjp through `lax.while_loop` either), so
+  the loop runs as a python loop whose iterations are tape-recorded; the
+  predicate read goes through Tensor.__bool__, which the two-phase tracer
+  records as an in-graph GUARD — each taken branch compiles to its own
+  entry and re-dispatches on the branch bit (the SOT analog).  The guard
+  cache is bounded (see jit/tracer.py rediscovery cap).
+"""
 from __future__ import annotations
 
+import jax
+
 from ..core.tensor import Tensor
+from ..core import state as _state
+
+_UNMATCHED = object()
 
 
 def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    if (isinstance(pred, Tensor) and true_fn is not None
+            and false_fn is not None and not _state.STATE.grad_enabled):
+        out = _lax_cond(pred, true_fn, false_fn)
+        if out is not _UNMATCHED:
+            return out
     if bool(pred):
         return true_fn() if true_fn is not None else None
     return false_fn() if false_fn is not None else None
 
 
+def _arm(fn, box):
+    """Wrap a branch thunk as arrays->arrays for lax.cond; the output
+    pytree structure is recorded in `box` (identical across arms when the
+    lowering succeeds — lax.cond enforces matching avals)."""
+    def f(_):
+        with _state.no_grad():
+            out = fn()
+        leaves, tree = jax.tree.flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        if not leaves or not all(isinstance(x, Tensor) for x in leaves):
+            raise TypeError("cond arms must return Tensor pytrees")
+        box["tree"] = tree
+        return tuple(x._data for x in leaves)
+    return f
+
+
+def _lax_cond(pred, true_fn, false_fn):
+    """Lower to one lax.cond program; _UNMATCHED falls back to the python
+    branch (mismatched arm structures, non-tensor outputs, arms that
+    mutate outside state in ways tracing rejects)."""
+    box = {}
+    try:
+        arrays = jax.lax.cond(
+            pred._data.reshape(()).astype(jax.numpy.bool_),
+            _arm(true_fn, box), _arm(false_fn, box), 0)
+    except Exception:
+        return _UNMATCHED
+    leaves = [Tensor(a) for a in arrays]
+    return jax.tree.unflatten(box["tree"], leaves)
+
+
 def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
     vars_ = list(loop_vars)
+    if (vars_ and all(isinstance(v, Tensor) for v in vars_)
+            and not _state.STATE.grad_enabled):
+        out = _lax_while(cond_fn, body, vars_)
+        if out is not _UNMATCHED:
+            return out
+    # tape-recorded python loop (reverse mode needs the unrolled tape)
     while bool(cond_fn(*vars_)):
         out = body(*vars_)
         vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
     return vars_
+
+
+def _lax_while(cond_fn, body, vars_):
+    """Lower to one lax.while_loop program: a tensor trip count runs as a
+    single compiled program (under to_static it composes into the step
+    program with NO guard outputs — one entry regardless of trip count)."""
+    def c(arrays):
+        with _state.no_grad():
+            r = cond_fn(*[Tensor(a) for a in arrays])
+        r = r._data if isinstance(r, Tensor) else jax.numpy.asarray(r)
+        return r.reshape(()).astype(jax.numpy.bool_)
+
+    def b(arrays):
+        with _state.no_grad():
+            out = body(*[Tensor(a) for a in arrays])
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        if len(out) != len(arrays) or not all(
+                isinstance(x, Tensor) for x in out):
+            raise TypeError("body must return the loop_vars structure")
+        return tuple(x._data.astype(a.dtype).reshape(a.shape)
+                     for x, a in zip(out, arrays))
+
+    try:
+        res = jax.lax.while_loop(c, b, tuple(v._data for v in vars_))
+    except Exception:
+        return _UNMATCHED
+    return [Tensor(a) for a in res]

@@ -372,3 +372,97 @@ def test_enable_to_static_toggle():
         paddle.jit.enable_to_static(True)
     f(x)
     assert calls["n"] == n_compiled + 1  # compiled path again
+
+
+def test_while_loop_single_program_tensor_trip_count():
+    # a tensor-dependent trip count must execute as ONE compiled program
+    # (lax.while_loop capture), not one entry per trip count
+    from paddle_tpu import static
+
+    calls = {"n": 0}
+
+    @paddle.jit.to_static
+    def run(x, n):
+        calls["n"] += 1  # python body executes only on warmup/discovery
+
+        def cond_fn(i, acc):
+            return i < n
+
+        def body(i, acc):
+            return i + 1, acc * 2.0
+
+        with paddle.no_grad():
+            i0 = paddle.to_tensor(np.int32(0))
+            _, acc = static.nn.while_loop(cond_fn, body, [i0, x])
+        return acc
+
+    for trip, expect in [(3, 8.0), (5, 32.0), (1, 2.0), (7, 128.0)]:
+        out = run(paddle.to_tensor(np.float32(1.0)),
+                  paddle.to_tensor(np.int32(trip)))
+        assert float(out.numpy()) == expect, (trip, float(out.numpy()))
+    # one signature, one guard entry, python body not re-traced per count
+    assert run.guard_cache_size() == 1
+    assert calls["n"] <= 3  # warmup + discovery + bind trace
+
+
+def test_lax_cond_single_program_no_grad():
+    from paddle_tpu import static
+
+    @paddle.jit.to_static
+    def run(x, flag):
+        with paddle.no_grad():
+            return static.nn.cond(flag > 0,
+                                  lambda: x * 2.0,
+                                  lambda: x - 1.0)
+
+    for val, expect in [(1.0, 6.0), (-1.0, 2.0), (1.0, 6.0), (-1.0, 2.0)]:
+        out = run(paddle.to_tensor(np.float32(3.0)),
+                  paddle.to_tensor(np.float32(val)))
+        assert float(out.numpy()) == expect
+    # both branch values served by ONE compiled entry (lax.cond in-graph)
+    assert run.guard_cache_size() == 1
+
+
+def test_guard_cache_bounded_under_flapping_branch():
+    # a data-dependent python branch that flips every call must not grow
+    # the compile cache unboundedly; after the rediscovery cap the
+    # signature falls back to eager with a warning
+    import warnings as _w
+
+    @paddle.jit.to_static
+    def step(x, t):
+        if (x.sum() > t):          # Tensor.__bool__ -> guard
+            y = x * 2.0
+        else:
+            y = x * 3.0
+        return y.sum()
+
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        for i in range(30):
+            t = paddle.to_tensor(np.float32(0.0 if i % 2 == 0 else 100.0))
+            out = step(x, t)
+            expect = 8.0 if i % 2 == 0 else 12.0
+            assert float(out.numpy()) == expect, i
+    assert step.guard_cache_size() <= 6
+
+
+def test_while_loop_with_grad_still_differentiates():
+    # gradients require the unrolled tape: python-loop path must be taken
+    # and produce correct grads eagerly
+    from paddle_tpu import static
+    x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+
+    def cond_fn(i, acc):
+        return i < 3
+
+    def body(i, acc):
+        return i + 1, acc * x
+
+    i0 = paddle.to_tensor(np.int32(0))
+    acc0 = paddle.to_tensor(np.float32(1.0), stop_gradient=False)
+    _, acc = static.nn.while_loop(cond_fn, body, [i0, acc0])
+    acc.backward()
+    assert float(acc.numpy()) == 8.0
+    assert float(x.grad.numpy()) == 12.0  # d(x^3)/dx = 3x^2
