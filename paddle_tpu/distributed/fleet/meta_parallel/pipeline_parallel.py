@@ -90,6 +90,134 @@ class _ScheduleMixin:
         return total
 
 
+class Host1F1B:
+    """Genuine 1F1B over per-stage programs for stage structures that
+    homogenize() rejects (reference: the host-driven schedule of
+    fleet/meta_parallel/pipeline_parallel.py:397-603).
+
+    Each (stage, micro) forward/backward is a separate tape-scoped
+    program: the activation entering a stage is a fresh leaf, so backward
+    of one stage never drags the rest of the chain.  Actions are issued
+    in the per-stage 1F1B order  [F]*W + [F,B]*(M-W) + [B]*W  with
+    W_s = min(M, S-1-s), driven by a dependency scheduler — per-device
+    dispatch queues then interleave micro-batches exactly as 1F1B
+    prescribes, so stage devices overlap instead of blocking behind a
+    not-yet-ready backward (the failure mode of plain sequential
+    accumulation).  The realized issue order is kept in `last_schedule`
+    and surfaced through utils.monitor for the profiler."""
+
+    def __init__(self, pipeline_layer, n_micro, loss_fn):
+        self._layers = pipeline_layer
+        self._n_micro = n_micro
+        self._loss_fn = loss_fn
+        self._num_stages = pipeline_layer.get_num_stages()
+        self.last_schedule = []
+
+    def _stage_forward(self, stage, x):
+        """Run stage's items; activations ride the stage submesh, shared
+        (tied) layers the full mesh — same residence rules as the
+        global-view PipelineLayer.forward."""
+        part = self._layers.stage_layers(stage)
+        mesh = getattr(self._layers, "_mesh", None)
+        subs = getattr(self._layers, "_submeshes", [])
+        current = None
+        for item, fwd, is_shared in part:
+            if subs:
+                target = mesh if is_shared else subs[stage]
+                if target is not current:
+                    x = _to_stage_mesh(x, target)
+                    current = target
+                with target:
+                    x = fwd(item, x) if fwd is not None else item(x)
+            else:
+                x = fwd(item, x) if fwd is not None else item(x)
+        return x
+
+    def _plan(self):
+        S, M = self._num_stages, self._n_micro
+        plans = []
+        for s in range(S):
+            w = min(M, S - 1 - s)
+            plans.append([("F", m) for m in range(w)]
+                         + [op for m in range(w, M)
+                            for op in (("F", m), ("B", m - w))]
+                         + [("B", m) for m in range(M - w, M)])
+        return plans
+
+    def run(self, data, scaler=None):
+        from ....core.autograd import run_backward
+        from ....utils import monitor as _monitor
+
+        inputs, labels = data if isinstance(data, tuple) and len(data) == 2 \
+            else (data, None)
+        micros_x = _split_micro(inputs, self._n_micro)
+        micros_y = _split_micro(labels, self._n_micro) \
+            if labels is not None else [None] * self._n_micro
+        S, M = self._num_stages, self._n_micro
+        plans = self._plan()
+        ptr = [0] * S
+        acts_in = {}      # (s, m) -> incoming leaf (stop_gradient=False)
+        acts_out = {}     # (s, m) -> stage output (pre-detach)
+        handoff = {(0, m): micros_x[m] for m in range(M)}
+        cots = {}         # (s, m) -> cotangent arriving from stage s+1
+        losses = []
+        self.last_schedule = []
+        total = sum(len(p) for p in plans)
+        done = 0
+        while done < total:
+            progressed = False
+            for s in range(S):
+                if ptr[s] >= len(plans[s]):
+                    continue
+                op, m = plans[s][ptr[s]]
+                if op == "F":
+                    if (s, m) not in handoff:
+                        continue
+                    x_in = handoff.pop((s, m))
+                    if isinstance(x_in, Tensor):
+                        x_in = x_in.detach()
+                        x_in.stop_gradient = False
+                    acts_in[(s, m)] = x_in
+                    out = self._stage_forward(s, x_in)
+                    if s == S - 1:
+                        loss = self._loss_fn(out, micros_y[m]) \
+                            if (self._loss_fn is not None
+                                and micros_y[m] is not None) else out
+                        acts_out[(s, m)] = loss / float(M)
+                        losses.append(acts_out[(s, m)])
+                    else:
+                        acts_out[(s, m)] = out
+                        handoff[(s + 1, m)] = out
+                else:  # backward
+                    if s != S - 1 and (s, m) not in cots:
+                        continue
+                    out = acts_out.pop((s, m))
+                    if s == S - 1:
+                        if scaler is not None:
+                            scaler.scale(out).backward()
+                        else:
+                            out.backward()
+                    else:
+                        run_backward([out], grad_tensors=[cots.pop((s, m))])
+                    if s > 0:
+                        g = acts_in[(s, m)].grad
+                        acts_in[(s, m)].grad = None
+                        cots[(s - 1, m)] = g
+                    acts_in.pop((s, m), None)
+                self.last_schedule.append((s, op, m))
+                ptr[s] += 1
+                done += 1
+                progressed = True
+            if not progressed:
+                raise RuntimeError("1F1B schedule deadlocked "
+                                   f"(ptr={ptr}, plans={plans})")
+        _monitor.incr("pp.schedule.host_1f1b_steps")
+        total_loss = losses[0].detach()
+        for lo in losses[1:]:
+            total_loss = total_loss + lo.detach()
+        return total_loss
+
+
 class PipelineParallel(Layer, _ScheduleMixin):
     """reference: fleet/meta_parallel/pipeline_parallel.py:133."""
 
@@ -107,6 +235,7 @@ class PipelineParallel(Layer, _ScheduleMixin):
         self._n_micro = int(cfg.get("accumulate_steps", 1))
         self._loss_fn = layers._loss_fn
         self.total_loss = None
+        self._host1f1b = None
         # schedule selection: "spmd" = single-program collective-permute
         # pipelining (requires stackable stages), "host" = sequential
         # accumulation, "auto" = spmd when possible
@@ -122,9 +251,20 @@ class PipelineParallel(Layer, _ScheduleMixin):
                 if schedule == "spmd":
                     raise
                 import warnings
-                warnings.warn(
-                    f"pipeline schedule falling back to host-sequential "
-                    f"accumulation (stages not stackable: {e})")
+                from ....utils import monitor as _monitor
+                if self._n_micro > 1 and layers._num_chunks == 1:
+                    self._host1f1b = Host1F1B(layers, self._n_micro,
+                                              self._loss_fn)
+                    _monitor.incr("pp.schedule.fallback_host_1f1b")
+                    warnings.warn(
+                        f"pipeline stages not stackable ({e}); using "
+                        f"host-scheduled 1F1B over per-stage programs "
+                        f"(single-program SPMD schedule unavailable)")
+                else:
+                    _monitor.incr("pp.schedule.fallback_sequential")
+                    warnings.warn(
+                        f"pipeline schedule falling back to host-sequential"
+                        f" accumulation (stages not stackable: {e})")
 
     def parameters(self, include_sublayers=True):
         """Optimizer-visible params: under the SPMD schedule the stacked
@@ -164,6 +304,8 @@ class PipelineParallel(Layer, _ScheduleMixin):
             # optimizer.step below mutates the stacked params → per-part
             # layer params go stale until the next write_back()
             self._spmd._dirty = True
+        elif self._host1f1b is not None:
+            self.total_loss = self._host1f1b.run(data, scaler=scaler)
         else:
             self.total_loss = self._run_accumulated(data, scaler=scaler)
         if scaler is not None:

@@ -347,3 +347,95 @@ def test_spmd_set_state_dict_keeps_optimizer_binding():
     after = np.asarray(model._spmd.stacked[0]._data_)
     assert l2 < l1, "training must keep reducing loss after restore"
     assert not np.allclose(before, after), "params must keep updating"
+
+
+def _build_hetero_serial(seed=11):
+    # deliberately non-stackable: stage widths and layer compositions differ
+    paddle.seed(seed)
+    return nn.Sequential(
+        nn.Linear(8, 32), nn.Tanh(),
+        nn.Linear(32, 16), nn.Sigmoid(), nn.Linear(16, 16),
+        nn.Linear(16, 24), nn.Tanh(),
+        nn.Linear(24, 8))
+
+
+def _build_hetero_pipeline(seed=11, loss_fn=None):
+    paddle.seed(seed)
+    descs = [
+        LayerDesc(nn.Linear, 8, 32), LayerDesc(nn.Tanh),
+        LayerDesc(nn.Linear, 32, 16), LayerDesc(nn.Sigmoid),
+        LayerDesc(nn.Linear, 16, 16),
+        LayerDesc(nn.Linear, 16, 24), LayerDesc(nn.Tanh),
+        LayerDesc(nn.Linear, 24, 8),
+    ]
+    return PipelineLayer(descs, num_stages=4, loss_fn=loss_fn)
+
+
+def test_host_1f1b_heterogeneous_matches_serial():
+    """Non-stackable stages must use the host-scheduled 1F1B (not plain
+    sequential accumulation) and match the serial whole-batch step."""
+    import warnings as _w
+
+    def mse(out, y):
+        return ((out - y) ** 2).mean()
+
+    serial = _build_hetero_serial()
+    opt_s = paddle.optimizer.SGD(0.1, parameters=serial.parameters())
+
+    fleet.init(strategy=_pp_strategy(pp=4, accumulate_steps=4))
+    pipe = _build_hetero_pipeline(loss_fn=mse)
+    for p_p, p_s in zip(pipe.parameters(), serial.parameters()):
+        p_p.set_value(p_s.numpy())
+    pipe._commit_stage_placements()
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        model = fleet.distributed_model(pipe)
+    assert model._spmd is None, "hetero stages must not stack"
+    assert model._host1f1b is not None, "host 1F1B must be selected"
+    opt_p = paddle.optimizer.SGD(0.1, parameters=pipe.parameters())
+
+    x = paddle.randn([8, 8])
+    y = paddle.randn([8, 8])
+    loss_s = mse(serial(x), y)
+    loss_s.backward()
+    opt_s.step()
+    opt_s.clear_grad()
+
+    loss_p = model.train_batch((x, y), opt_p)
+    np.testing.assert_allclose(float(loss_p), float(loss_s), rtol=1e-5)
+    for p_p, p_s in zip(pipe.parameters(), serial.parameters()):
+        np.testing.assert_allclose(np.asarray(p_p._data_), p_s.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    # the realized issue order IS 1F1B: stage 0 runs warmup forwards for
+    # micros 1.. BEFORE its first backward (sequential accumulation would
+    # issue B(0, m0) before F(0, m1))
+    sched = model._host1f1b.last_schedule
+    s0 = [(op, m) for (s, op, m) in sched if s == 0]
+    first_b = s0.index(("B", 0))
+    warmup_fwds = [a for a in s0[:first_b] if a[0] == "F"]
+    assert len(warmup_fwds) >= 4, s0  # W_0 = min(M, S-1) = 3, +1 steady F
+    # per-stage order matches the canonical plan
+    plans = model._host1f1b._plan()
+    for s in range(4):
+        assert [(op, m) for (st, op, m) in sched if st == s] == plans[s]
+
+
+def test_host_1f1b_schedule_plan_shape():
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+        import Host1F1B
+
+    class _Stub:
+        def get_num_stages(self):
+            return 4
+    h = Host1F1B(_Stub(), 6, None)
+    plans = h._plan()
+    # stage 0: 3 warmup F, then FB steady, 3 cooldown B
+    assert plans[0][:3] == [("F", 0), ("F", 1), ("F", 2)]
+    assert plans[0][3:5] == [("F", 3), ("B", 0)]
+    assert plans[-1][:2] == [("F", 0), ("B", 0)]  # last stage alternates
+    for p in plans:
+        assert len(p) == 12
+        # every micro appears exactly once as F and once as B
+        assert sorted(m for op, m in p if op == "F") == list(range(6))
+        assert sorted(m for op, m in p if op == "B") == list(range(6))
