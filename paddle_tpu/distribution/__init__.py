@@ -190,7 +190,7 @@ from .transform import (  # noqa: E402, F401
     Transform, AffineTransform, ExpTransform, PowerTransform,
     SigmoidTransform, TanhTransform, AbsTransform, SoftmaxTransform,
     StickBreakingTransform, ChainTransform, IndependentTransform,
-    ReshapeTransform,
+    ReshapeTransform, StackTransform,
 )
 from .families import (  # noqa: E402, F401
     Exponential, Gamma, Chi2, Dirichlet, Laplace, LogNormal, Geometric,
@@ -310,3 +310,47 @@ class ExponentialFamily(Distribution):
         for p, g in zip(arrays, grads):
             ent = ent - p * g
         return Tensor(ent)
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy(p, q):
+    # closed form (reference: distribution/kl.py:190 _kl_cauchy_cauchy)
+    t1 = jnp.square(p.scale + q.scale) + jnp.square(p.loc - q.loc)
+    return jnp.log(t1 / (4.0 * p.scale * q.scale))
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    # KL of the underlying normals (reference: kl.py:255)
+    var_p = jnp.square(p.scale)
+    var_q = jnp.square(q.scale)
+    return (jnp.log(q.scale / p.scale)
+            + (var_p + jnp.square(p.loc - q.loc)) / (2 * var_q) - 0.5)
+
+
+@register_kl(ExponentialFamily, ExponentialFamily)
+def _kl_expfamily(p, q):
+    """Bregman divergence of the log-normalizer (reference: kl.py:215
+    _kl_expfamily_expfamily): KL(p||q) = A(ηq) − A(ηp) − ∇A(ηp)·(ηq − ηp)."""
+    import jax
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            "Bregman KL needs p and q from the same exponential family")
+    eta_p = [x._data_ if isinstance(x, Tensor) else jnp.asarray(x)
+             for x in p._natural_parameters]
+    eta_q = [x._data_ if isinstance(x, Tensor) else jnp.asarray(x)
+             for x in q._natural_parameters]
+
+    def log_norm_p(*ps):
+        out = p._log_normalizer(*ps)
+        return out._data_ if isinstance(out, Tensor) else jnp.asarray(out)
+
+    def log_norm_q(*qs):
+        out = q._log_normalizer(*qs)
+        return out._data_ if isinstance(out, Tensor) else jnp.asarray(out)
+
+    grads = jax.grad(lambda ps: jnp.sum(log_norm_p(*ps)))(eta_p)
+    kl = log_norm_q(*eta_q) - log_norm_p(*eta_p)
+    for gp, ep, eq in zip(grads, eta_p, eta_q):
+        kl = kl - gp * (eq - ep)
+    return kl

@@ -17,7 +17,7 @@ __all__ = [
     "Type", "Transform", "AffineTransform", "ExpTransform",
     "PowerTransform", "SigmoidTransform", "TanhTransform", "AbsTransform",
     "SoftmaxTransform", "StickBreakingTransform", "ChainTransform",
-    "IndependentTransform", "ReshapeTransform",
+    "IndependentTransform", "ReshapeTransform", "StackTransform",
 ]
 
 
@@ -291,3 +291,50 @@ class ReshapeTransform(Transform):
     def _forward_log_det_jacobian(self, x):
         batch = x.shape[:x.ndim - len(self.in_event_shape)]
         return jnp.zeros(batch, x.dtype)
+
+
+class StackTransform(Transform):
+    """Applies a sequence of transforms to slices along `axis`
+    (reference: python/paddle/distribution/transform.py:1051)."""
+
+    def __init__(self, transforms, axis=0):
+        import typing
+        if not transforms or not isinstance(transforms, typing.Sequence):
+            raise TypeError(
+                f"Expected 'transforms' is Sequence[Transform], but got "
+                f"{type(transforms)}.")
+        if not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError(
+                "Expected all element in transforms is Transform Type.")
+        if not isinstance(axis, int):
+            raise TypeError(f"Expected 'axis' is int, but got {type(axis)}.")
+        self._transforms = list(transforms)
+        self._axis = axis
+        self._type = (Type.BIJECTION if all(
+            t.type == Type.BIJECTION for t in self._transforms)
+            else Type.OTHER)
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    @property
+    def axis(self):
+        return self._axis
+
+    def _map(self, fn_name, v):
+        slices = [jnp.squeeze(s, self._axis)
+                  for s in jnp.split(v, v.shape[self._axis],
+                                     axis=self._axis)]
+        outs = [getattr(t, fn_name)(s)
+                for t, s in zip(self._transforms, slices)]
+        return jnp.stack(outs, axis=self._axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)

@@ -160,3 +160,58 @@ def test_multivariate_normal_batched_values():
     ref3 = D.Normal(0., 0.5).log_prob(paddle.to_tensor(_np(s)[:, 2]))
     np.testing.assert_allclose(_np(lp), _np(ref) + _np(ref2) + _np(ref3),
                                atol=1e-4)
+
+
+def test_transform_all_parity_with_reference():
+    # paddle.distribution.transform __all__ must cover the reference's
+    import ast
+    src = open("/root/reference/python/paddle/distribution/"
+               "transform.py").read()
+    ref_all = None
+    for n in ast.walk(ast.parse(src)):
+        if isinstance(n, ast.Assign) and \
+                getattr(n.targets[0], "id", "") == "__all__":
+            ref_all = {e.value for e in n.value.elts}
+    assert ref_all, "reference __all__ not found"
+    from paddle_tpu.distribution import transform as T
+    missing = ref_all - set(T.__all__)
+    assert not missing, f"missing transforms: {missing}"
+    for name in ref_all:
+        assert callable(getattr(T, name)), name
+
+
+def test_stack_transform_matches_reference_example():
+    from paddle_tpu import distribution as D
+    x = paddle.to_tensor(
+        np.stack([[1.0, 2, 3], [1, 2, 3]], 1).astype("float32"))
+    t = D.StackTransform(
+        (D.ExpTransform(), D.PowerTransform(paddle.to_tensor(2.0))), 1)
+    f = t.forward(x)
+    np.testing.assert_allclose(np.asarray(f._data_)[:, 0],
+                               np.exp([1.0, 2, 3]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(f._data_)[:, 1],
+                               [1.0, 4, 9], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(t.inverse(f)._data_),
+                               np.asarray(x._data_), rtol=1e-5)
+    ldj = t.forward_log_det_jacobian(x)
+    np.testing.assert_allclose(np.asarray(ldj._data_)[:, 0],
+                               [1.0, 2, 3], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ldj._data_)[:, 1],
+                               np.log([2.0, 4, 6]), rtol=1e-5)
+
+
+def test_kl_cauchy_lognormal_expfamily():
+    from paddle_tpu import distribution as D
+    kl = D.kl_divergence(D.Cauchy(paddle.to_tensor(0.0),
+                                  paddle.to_tensor(1.0)),
+                         D.Cauchy(paddle.to_tensor(1.0),
+                                  paddle.to_tensor(2.0)))
+    np.testing.assert_allclose(float(np.asarray(kl._data_)),
+                               np.log((9 + 1) / 8), rtol=1e-5)
+    kl = D.kl_divergence(D.LogNormal(paddle.to_tensor(0.0),
+                                     paddle.to_tensor(1.0)),
+                         D.LogNormal(paddle.to_tensor(0.5),
+                                     paddle.to_tensor(1.5)))
+    expect = np.log(1.5) + (1.0 + 0.25) / (2 * 2.25) - 0.5
+    np.testing.assert_allclose(float(np.asarray(kl._data_)), expect,
+                               rtol=1e-5)
