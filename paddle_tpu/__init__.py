@@ -148,6 +148,7 @@ from . import audio  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
 from . import linalg  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 
 # populate registry flops metadata once every op module has registered

@@ -20,6 +20,9 @@ from ..core.tensor import Tensor
 from .. import signal as _signal
 
 __all__ = [
+    "functional", "features", "datasets", "backends",
+    "load", "info", "save",
+    # implementation surface kept importable from the package root
     "hz_to_mel", "mel_to_hz", "mel_frequencies", "compute_fbank_matrix",
     "create_dct", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
     "MFCC",
@@ -191,3 +194,9 @@ class MFCC(Layer):
         from ..tensor_ops import linalg as LA
         lm = self.log_mel(x)             # [..., n_mels, time]
         return LA.matmul(LA.transpose(self.dct, [1, 0]), lm)
+
+from . import functional  # noqa: E402, F401
+from . import features  # noqa: E402, F401
+from . import backends  # noqa: E402, F401
+from . import datasets  # noqa: E402, F401
+from .backends import info, load, save  # noqa: E402, F401

@@ -1,0 +1,93 @@
+"""paddle.audio.backends (reference: python/paddle/audio/backends/).
+
+One built-in backend ("wave_backend"): PCM WAV via the stdlib `wave`
+module — the reference's default backend is the same pure-python wave
+reader; soundfile-style plugin backends can register via set_backend."""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["get_current_backend", "list_available_backends", "set_backend"]
+
+_BACKENDS = {"wave_backend"}
+_current = "wave_backend"
+
+
+def list_available_backends():
+    return sorted(_BACKENDS)
+
+
+def get_current_backend():
+    return _current
+
+
+def set_backend(backend_name):
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"backend {backend_name!r} not available; "
+            f"available: {list_available_backends()}")
+    global _current
+    _current = backend_name
+
+
+class AudioInfo:
+    """reference: backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    """reference: backends/wave_backend.py info."""
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8,
+                         f"PCM_{'S' if f.getsampwidth() > 1 else 'U'}"
+                         f"{f.getsampwidth() * 8}")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """reference: backends/wave_backend.py load — returns
+    (waveform Tensor, sample_rate)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        width = f.getsampwidth()
+        n_ch = f.getnchannels()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, n_ch)
+    if normalize:
+        if width == 1:
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         bits_per_sample=16):
+    """reference: backends/wave_backend.py save — PCM16 only."""
+    data = np.asarray(src._data_ if isinstance(src, Tensor) else src)
+    if channels_first:
+        data = data.T
+    if data.dtype.kind == "f":
+        data = np.clip(data, -1.0, 1.0)
+        data = (data * (2 ** (bits_per_sample - 1) - 1)).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1] if data.ndim > 1 else 1)
+        f.setsampwidth(bits_per_sample // 8)
+        f.setframerate(int(sample_rate))
+        f.writeframes(data.astype("<i2").tobytes())

@@ -1,0 +1,147 @@
+"""paddle.audio.datasets (reference: python/paddle/audio/datasets/ —
+esc50.py, tess.py over AudioClassificationDataset).
+
+Zero-egress realization: datasets read from a LOCAL copy under
+``data_home`` (or DATA_HOME) — the download step is the only part not
+reproduced (no network in this environment); pass the extracted archive
+directory and everything else (fold/split selection, feature extraction
+via the audio feature Layers) matches the reference."""
+from __future__ import annotations
+
+import collections
+import os
+
+import numpy as np
+
+from ..io import Dataset
+from . import MelSpectrogram, MFCC, LogMelSpectrogram, Spectrogram
+from .backends import load as _load
+
+__all__ = ["ESC50", "TESS"]
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/datasets"))
+
+_FEATS = {"raw": None, "melspectrogram": MelSpectrogram, "mfcc": MFCC,
+          "logmelspectrogram": LogMelSpectrogram,
+          "spectrogram": Spectrogram}
+
+
+class AudioClassificationDataset(Dataset):
+    """reference: audio/datasets/dataset.py AudioClassificationDataset."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        super().__init__()
+        if feat_type not in _FEATS:
+            raise RuntimeError(f"Unknown feat_type: {feat_type}, it must "
+                               f"be one in {list(_FEATS)}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        cls = _FEATS[feat_type]
+        self.feature_extractor = cls(**kwargs) if cls is not None else None
+
+    def _convert_to_record(self, idx):
+        file, label = self.files[idx], self.labels[idx]
+        waveform, _sr = _load(file)
+        wav = np.asarray(waveform._data_)
+        if wav.ndim > 1:
+            wav = wav[0]
+        if self.feature_extractor is not None:
+            from ..core.tensor import Tensor
+            feat = self.feature_extractor(Tensor(wav[None, :]))
+            return np.asarray(feat._data_)[0], label
+        return wav, label
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """reference: audio/datasets/esc50.py:26 — 50-class environmental
+    sound clips, 5 folds; `split` selects the held-out fold."""
+
+    meta = os.path.join("ESC-50-master", "meta", "esc50.csv")
+    meta_info = collections.namedtuple(
+        "META_INFO",
+        ("filename", "fold", "target", "category", "esc10", "src_file",
+         "take"))
+    audio_path = os.path.join("ESC-50-master", "audio")
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_home=None, **kwargs):
+        assert split in range(1, 6), (
+            f"The selected split should be integer, and 1 <= split <= 5, "
+            f"but got {split}")
+        self._home = data_home or DATA_HOME
+        files, labels = self._get_data(mode, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_meta_info(self):
+        with open(os.path.join(self._home, self.meta)) as rf:
+            return [self.meta_info(*ln.strip().split(","))
+                    for ln in rf.readlines()[1:]]
+
+    def _get_data(self, mode, split):
+        if not os.path.isdir(os.path.join(self._home, self.audio_path)) \
+                or not os.path.isfile(os.path.join(self._home, self.meta)):
+            raise RuntimeError(
+                f"ESC-50 data not found under {self._home} (this "
+                "environment has no network egress; place the extracted "
+                "ESC-50-master archive there, or pass data_home=)")
+        files, labels = [], []
+        for s in self._get_meta_info():
+            in_split = int(s.fold) == split
+            if (mode == "train") != in_split:
+                files.append(os.path.join(self._home, self.audio_path,
+                                          s.filename))
+                labels.append(int(s.target))
+        return files, labels
+
+
+class TESS(AudioClassificationDataset):
+    """reference: audio/datasets/tess.py:26 — Toronto emotional speech,
+    n-fold split over sorted utterances."""
+
+    audio_path = "TESS_Toronto_emotional_speech_set"
+    meta_info = collections.namedtuple("META_INFO",
+                                       ("speaker", "word", "emotion"))
+    labels_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                   "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_home=None, **kwargs):
+        assert isinstance(n_folds, int) and n_folds >= 1
+        assert split in range(1, n_folds + 1)
+        self._home = data_home or DATA_HOME
+        files, labels = self._get_data(mode, n_folds, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_data(self, mode, n_folds, split):
+        root = os.path.join(self._home, self.audio_path)
+        if not os.path.isdir(root):
+            raise RuntimeError(
+                f"TESS data not found under {self._home} (no network "
+                "egress; place the extracted archive there, or pass "
+                "data_home=)")
+        wavs = []
+        for base, _dirs, fnames in sorted(os.walk(root)):
+            wavs += [os.path.join(base, f) for f in sorted(fnames)
+                     if f.endswith(".wav")]
+        files, labels = [], []
+        for i, f in enumerate(wavs):
+            fold = i % n_folds + 1
+            in_split = fold == split
+            if (mode == "train") != in_split:
+                emotion = os.path.basename(f)[:-4].split("_")[-1].lower()
+                if emotion in self.labels_list:
+                    files.append(f)
+                    labels.append(self.labels_list.index(emotion))
+        return files, labels

@@ -1,0 +1,7 @@
+"""paddle.audio.features (reference: python/paddle/audio/features/
+layers.py) — re-exports the feature Layers implemented in the package."""
+from . import (  # noqa: F401
+    LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,
+)
+
+__all__ = ["LogMelSpectrogram", "MelSpectrogram", "MFCC", "Spectrogram"]

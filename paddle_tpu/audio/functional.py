@@ -1,0 +1,145 @@
+"""paddle.audio.functional (reference: python/paddle/audio/functional/
+functional.py + window.py).  Filterbank/DCT builders return numpy (host
+constants baked into the model's first program); windows return Tensors."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import (  # noqa: F401  (shared implementations live in the package)
+    hz_to_mel, mel_to_hz, mel_frequencies, compute_fbank_matrix, create_dct,
+)
+
+__all__ = [
+    "compute_fbank_matrix", "create_dct", "fft_frequencies", "hz_to_mel",
+    "mel_frequencies", "mel_to_hz", "power_to_db", "get_window",
+]
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """Bin center frequencies [0, sr/2] (reference: functional.py
+    fft_frequencies)."""
+    return Tensor(np.linspace(0, sr / 2.0, 1 + n_fft // 2,
+                              dtype=np.dtype(dtype)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(spect/ref) with floor/top clipping (reference:
+    functional.py power_to_db)."""
+    from ..tensor_ops import math as MM
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+    x = spect if isinstance(spect, Tensor) else Tensor(np.asarray(spect))
+    log_spec = 10.0 * MM.log10(MM.clip(x, min=amin))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = MM.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+def _sym_np(w, sym, extended):
+    # periodic windows are the symmetric window of length M+1 truncated
+    return w[:-1] if (not sym and extended) else w
+
+
+def _window_np(name, m, sym, args):
+    n = np.arange(m, dtype=np.float64)
+    if name in ("hamming",):
+        return 0.54 - 0.46 * np.cos(2 * np.pi * n / (m - 1))
+    if name in ("hann",):
+        return 0.5 - 0.5 * np.cos(2 * np.pi * n / (m - 1))
+    if name == "blackman":
+        return (0.42 - 0.5 * np.cos(2 * np.pi * n / (m - 1))
+                + 0.08 * np.cos(4 * np.pi * n / (m - 1)))
+    if name in ("bartlett", "triang"):
+        if name == "bartlett":
+            return np.bartlett(m)
+        # triang (scipy): no zero endpoints
+        k = np.arange(1, (m + 1) // 2 + 1, dtype=np.float64)
+        if m % 2 == 0:
+            w = (2 * k - 1.0) / m
+            return np.concatenate([w, w[::-1]])
+        w = 2 * k / (m + 1.0)
+        return np.concatenate([w, w[-2::-1]])
+    if name == "cosine":
+        return np.sin(np.pi / m * (n + 0.5))
+    if name == "bohman":
+        fac = np.abs(np.linspace(-1, 1, m))
+        return ((1 - fac) * np.cos(np.pi * fac)
+                + 1.0 / np.pi * np.sin(np.pi * fac))
+    if name == "tukey":
+        alpha = args[0] if args else 0.5
+        if alpha <= 0:
+            return np.ones(m)
+        if alpha >= 1:
+            return 0.5 - 0.5 * np.cos(2 * np.pi * n / (m - 1))
+        width = int(alpha * (m - 1) / 2.0)
+        n1 = n[: width + 1]
+        n3 = n[m - width - 1:]
+        w1 = 0.5 * (1 + np.cos(np.pi * (-1 + 2.0 * n1 / alpha / (m - 1))))
+        w3 = 0.5 * (1 + np.cos(np.pi * (-2.0 / alpha + 1
+                                        + 2.0 * n3 / alpha / (m - 1))))
+        return np.concatenate([w1, np.ones(m - 2 * width - 2), w3])
+    if name == "gaussian":
+        std = args[0]
+        nn = n - (m - 1.0) / 2.0
+        return np.exp(-(nn ** 2) / (2 * std * std))
+    if name == "general_gaussian":
+        p, sig = args[0], args[1]
+        nn = n - (m - 1.0) / 2.0
+        return np.exp(-0.5 * np.abs(nn / sig) ** (2 * p))
+    if name == "exponential":
+        center = args[0] if args else None
+        tau = args[1] if len(args) > 1 else 1.0
+        if center is None:
+            center = (m - 1) / 2.0
+        return np.exp(-np.abs(n - center) / tau)
+    if name == "kaiser":
+        beta = args[0]
+        return np.kaiser(m, beta)
+    if name == "taylor":
+        nbar = int(args[0]) if args else 4
+        sll = float(args[1]) if len(args) > 1 else 30.0
+        b = 10 ** (sll / 20)
+        a = np.arccosh(b) / np.pi
+        s2 = nbar ** 2 / (a ** 2 + (nbar - 0.5) ** 2)
+        ma = np.arange(1, nbar, dtype=np.float64)
+        fac_num = np.ones(nbar - 1)
+        for i, mi in enumerate(ma):
+            fac_num[i] = np.prod(
+                1 - mi ** 2 / s2 / (a ** 2 + (ma - 0.5) ** 2))
+            fac_num[i] /= np.prod(
+                [1 - mi ** 2 / j ** 2 for j in ma if j != mi])
+        w = np.ones(m)
+        for i, mi in enumerate(ma):
+            w += 2 * fac_num[i] * np.cos(
+                2 * np.pi * mi * (n - m / 2.0 + 0.5) / m)
+        return w / w.max()
+    raise ValueError(f"unsupported window {name!r}")
+
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """reference: audio/functional/window.py:335 get_window."""
+    sym = not fftbins
+    args = ()
+    if isinstance(window, tuple):
+        name, args = window[0], tuple(window[1:])
+    elif isinstance(window, str):
+        if window in ("gaussian", "exponential", "kaiser",
+                      "general_gaussian"):
+            raise ValueError(f"The '{window}' window needs one or more "
+                             "parameters -- pass a tuple.")
+        name = window
+    else:
+        raise ValueError(f"invalid window spec {window!r}")
+    m = win_length if sym else win_length + 1
+    w = np.asarray(_window_np(name, m, sym, args), np.float64)
+    if not sym:
+        w = w[:-1]
+    return Tensor(w.astype(np.dtype(dtype)))

@@ -45,3 +45,12 @@ from .spawn import spawn  # noqa: F401
 from .env import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, ParallelEnv,
 )
+from . import io  # noqa: F401,E402
+from .compat import (  # noqa: F401,E402
+    ParallelMode, DistAttr, ProbabilityEntry, CountFilterEntry,
+    ShowClickEntry, is_available, get_backend, destroy_process_group,
+    wait, isend, irecv, alltoall, alltoall_single, gather,
+    all_gather_object, broadcast_object_list, scatter_object_list,
+    split, gloo_init_parallel_env, gloo_barrier, gloo_release,
+)
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402

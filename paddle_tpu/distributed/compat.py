@@ -1,0 +1,291 @@
+"""Remaining paddle.distributed public surface (reference:
+python/paddle/distributed/__init__.py __all__): object collectives,
+async send/recv tasks, parallel-mode enums, PS entry configs, the
+model-parallel `split` helper, and backend introspection."""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import collective as C
+from .env import get_rank, get_world_size
+
+
+class ParallelMode:
+    """reference: distributed/fleet/base/topology.py:33."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class DistAttr:
+    """Tensor distributed attribute (reference:
+    distributed/auto_parallel/api.py DistAttr — mesh + per-dim sharding
+    specs)."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"sharding_specs={self.sharding_specs})")
+
+
+class EntryAttr:
+    """reference: distributed/entry_attr.py — sparse-table admission
+    policies consumed by distributed/ps sparse tables."""
+
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self):
+        return f"{self._name}:{self._probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be non-negative")
+        self._name = "count_filter_entry"
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return f"{self._name}:{self._count_filter}"
+
+
+class ShowClickEntry(EntryAttr):
+    def __init__(self, show_name, click_name):
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return f"{self._name}:{self._show_name}:{self._click_name}"
+
+
+# ------------------------------------------------------------------
+# backend / lifecycle introspection
+# ------------------------------------------------------------------
+
+def is_available():
+    """reference: distributed/parallel.py is_available — collectives are
+    always available (XLA backend, world=1 degenerates gracefully)."""
+    return True
+
+
+def get_backend(group=None):
+    """The communication backend name (reference returns 'NCCL'/'GLOO';
+    here collectives compile to XLA ICI/DCN programs)."""
+    return "XLA"
+
+
+def destroy_process_group(group=None):
+    """reference: communication/group.py destroy_process_group — drops
+    cached sub-groups; the world group (PJRT runtime) persists for the
+    process lifetime like the reference's default group."""
+    if group is None:
+        getattr(C, "_GROUP_CACHE", {}).clear()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Block until `tensor`'s producing collective completes (async
+    dispatch: jax block_until_ready)."""
+    import jax
+    if isinstance(tensor, Tensor):
+        jax.block_until_ready(tensor._data_)
+    return tensor
+
+
+class _CompletedTask:
+    """Async handle for isend/irecv (dispatch is async already — the
+    task exposes wait() for API parity)."""
+
+    def __init__(self, tensor=None):
+        self._tensor = tensor
+
+    def wait(self):
+        if self._tensor is not None:
+            wait(self._tensor)
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    C.send(tensor, dst=dst, group=group, sync_op=False)
+    return _CompletedTask(tensor)
+
+
+def irecv(tensor, src=0, group=None):
+    C.recv(tensor, src=src, group=group, sync_op=False)
+    return _CompletedTask(tensor)
+
+
+# ------------------------------------------------------------------
+# tensor-list and object collectives
+# ------------------------------------------------------------------
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """reference: communication/all_to_all.py alltoall."""
+    return C.all_to_all(out_tensor_list, in_tensor_list, group=group,
+                        sync_op=sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all: dim 0 splits across ranks (reference:
+    communication/all_to_all.py alltoall_single)."""
+    world = get_world_size()
+    if world <= 1:
+        out_tensor._data_ = in_tensor._data_
+        return out_tensor
+    from ..tensor_ops import manipulation as MA
+    parts = MA.split(in_tensor, world, axis=0)
+    outs = [Tensor(np.zeros_like(np.asarray(p._data_))) for p in parts]
+    C.all_to_all(outs, list(parts), group=group, sync_op=sync_op)
+    cat = MA.concat(outs, axis=0)
+    out_tensor._data_ = cat._data_
+    return out_tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """reference: communication/gather.py — all ranks contribute, dst
+    receives the list (single-controller: every rank can materialize)."""
+    world = get_world_size()
+    if gather_list is None:
+        gather_list = []
+    if world <= 1:
+        gather_list.append(tensor)
+        return gather_list
+    tl = [Tensor(np.zeros_like(np.asarray(tensor._data_)))
+          for _ in range(world)]
+    C.all_gather(tl, tensor, group=group, sync_op=sync_op)
+    if get_rank() == dst:
+        gather_list[:] = tl
+    return gather_list
+
+
+def _obj_to_tensor(obj):
+    buf = np.frombuffer(pickle.dumps(obj), np.uint8)
+    return Tensor(buf.copy()), len(buf)
+
+
+def _tensor_to_obj(t, length):
+    data = np.asarray(t._data_)[:length].tobytes()
+    return pickle.loads(data)
+
+
+def all_gather_object(object_list, obj, group=None):
+    """reference: communication/all_gather.py all_gather_object —
+    pickle → uint8 tensor → all_gather (max-padded) → unpickle."""
+    world = get_world_size()
+    t, n = _obj_to_tensor(obj)
+    if world <= 1:
+        object_list.append(obj)
+        return object_list
+    # exchange lengths, pad to max, gather, trim
+    len_t = Tensor(np.asarray([n], np.int64))
+    lens = []
+    all_gather_lens = [Tensor(np.zeros(1, np.int64)) for _ in range(world)]
+    C.all_gather(all_gather_lens, len_t, group=group)
+    lens = [int(np.asarray(x._data_)[0]) for x in all_gather_lens]
+    m = max(lens)
+    pad = Tensor(np.concatenate([np.asarray(t._data_),
+                                 np.zeros(m - n, np.uint8)]))
+    outs = [Tensor(np.zeros(m, np.uint8)) for _ in range(world)]
+    C.all_gather(outs, pad, group=group)
+    object_list[:] = [_tensor_to_obj(o, ln) for o, ln in zip(outs, lens)]
+    return object_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """reference: communication/broadcast.py broadcast_object_list."""
+    world = get_world_size()
+    if world <= 1:
+        return object_list
+    if get_rank() == src:
+        payload = pickle.dumps(list(object_list))
+    else:
+        payload = b""
+    n = Tensor(np.asarray([len(payload)], np.int64))
+    C.broadcast(n, src=src, group=group)
+    ln = int(np.asarray(n._data_)[0])
+    buf = np.zeros(ln, np.uint8)
+    if get_rank() == src:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    t = Tensor(buf)
+    C.broadcast(t, src=src, group=group)
+    object_list[:] = pickle.loads(np.asarray(t._data_).tobytes())
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """reference: communication/scatter.py scatter_object_list."""
+    world = get_world_size()
+    if world <= 1:
+        out_object_list[:] = [in_object_list[0]] \
+            if in_object_list else [None]
+        return out_object_list
+    objs = [None] * world
+    broadcast_object_list(
+        objs if get_rank() != src else (in_object_list or objs),
+        src=src, group=group)
+    source = in_object_list if get_rank() == src else objs
+    out_object_list[:] = [source[get_rank()]]
+    return out_object_list
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel weight split (reference:
+    distributed/fleet/layers/mpu/mp_ops.py:698 split): builds the
+    column/row-parallel linear or vocab-parallel embedding over the mp
+    mesh axis and applies it."""
+    from .fleet.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError("operation must be 'linear' or 'embedding'")
+    if axis == 0:
+        layer = RowParallelLinear(size[0], size[1],
+                                  weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=not gather_out)
+    else:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out)
+    return layer(x)
+
+
+# gloo shims: the CPU rendezvous the reference does over gloo is handled
+# by the TCP store; these keep script compatibility
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    from .env import init_parallel_env
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    C.barrier()
+
+
+def gloo_release():
+    pass

@@ -29,3 +29,9 @@ from .meta_parallel import (  # noqa: F401
     ShardingParallel,
 )
 from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from ..topology import CommunicateTopology  # noqa: F401,E402
+from .base import Role, UtilBase, Fleet  # noqa: F401,E402
+from . import data_generator  # noqa: F401,E402
+from .data_generator import (  # noqa: F401,E402
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)

@@ -182,3 +182,100 @@ def is_first_worker():
 def barrier_worker():
     from ..collective import barrier
     barrier()
+
+
+class Role:
+    """reference: fleet/base/role_maker.py:33."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class UtilBase:
+    """reference: fleet/base/util_factory.py:49 — collective utilities
+    over the fleet's communication backend."""
+
+    def __init__(self):
+        self.role_maker = None
+        self.dist_strategy = None
+
+    def _set_strategy(self, dist_strategy):
+        self.dist_strategy = dist_strategy
+
+    def _set_role_maker(self, role_maker):
+        self.role_maker = role_maker
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        import numpy as np
+        from .. import collective as C
+        from ...core.tensor import Tensor
+        t = input if isinstance(input, Tensor) else \
+            Tensor(np.asarray(input))
+        op = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
+              "min": C.ReduceOp.MIN}[mode]
+        C.all_reduce(t, op=op)
+        return np.asarray(t._data_)
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as C
+        C.barrier()
+
+    def all_gather(self, input, comm_world="worker"):  # noqa: A002
+        from ..compat import all_gather_object
+        out = []
+        all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files):
+        """Contiguous file shard for this worker (reference:
+        util_factory.get_file_shard)."""
+        from ..env import get_rank, get_world_size
+        n, w, r = len(files), get_world_size(), get_rank()
+        base, rem = divmod(n, w)
+        start = r * base + min(r, rem)
+        return files[start:start + base + (1 if r < rem else 0)]
+
+    def print_on_rank(self, message, rank_id):
+        from ..env import get_rank
+        if get_rank() == rank_id:
+            print(message)
+
+
+class Fleet:
+    """reference: fleet/fleet.py:99 — the stateful facade behind the
+    module-level fleet.init/distributed_model/... functions; exposed for
+    users who instantiate it directly."""
+
+    def __init__(self):
+        self._util = UtilBase()
+        self._strategy = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        self._strategy = strategy
+        return init(role_maker, is_collective=is_collective,
+                    strategy=strategy, log_level=log_level)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy=strategy)
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def barrier_worker(self):
+        return barrier_worker()
+
+    @property
+    def util(self):
+        return self._util

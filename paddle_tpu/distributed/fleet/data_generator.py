@@ -1,0 +1,110 @@
+"""paddle.distributed.fleet data generators (reference:
+python/paddle/distributed/fleet/data_generator/data_generator.py) —
+user-subclassed line→slots converters whose stdout feeds
+InMemoryDataset/QueueDataset (MultiSlotDataFeed text format)."""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    """Base class; subclasses implement generate_sample(line) (and
+    optionally generate_batch)."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or tuple: "
+            "[(name, [feasign, ...]), ...]")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    def _flush(self, batch_samples, out):
+        batch_iter = self.generate_batch(batch_samples)
+        for sample in batch_iter():
+            out.write(self._gen_str(sample))
+
+    def run_from_memory(self, out=None):
+        out = out or sys.stdout
+        batch_samples = []
+        for parsed in self.generate_sample(None)():
+            if parsed is None:
+                continue
+            batch_samples.append(parsed)
+            if len(batch_samples) == self.batch_size_:
+                self._flush(batch_samples, out)
+                batch_samples = []
+        if batch_samples:
+            self._flush(batch_samples, out)
+
+    def run_from_stdin(self, stdin=None, out=None):
+        stdin = stdin or sys.stdin
+        out = out or sys.stdout
+        batch_samples = []
+        for line in stdin:
+            for parsed in self.generate_sample(line)():
+                if parsed is None:
+                    continue
+                batch_samples.append(parsed)
+                if len(batch_samples) == self.batch_size_:
+                    self._flush(batch_samples, out)
+                    batch_samples = []
+        if batch_samples:
+            self._flush(batch_samples, out)
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """[(name, [str, ...]), ...] → 'n id1 id2 ...' lines."""
+
+    def _gen_str(self, line):
+        if isinstance(line, zip):
+            line = list(line)
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type "
+                "Examples: [('words', ['1926', '08']), ('label', ['1'])]")
+        parts = []
+        for _name, elements in line:
+            parts.append(" ".join([str(len(elements))]
+                                  + [str(e) for e in elements]))
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """[(name, [feasign, ...]), ...] → 'n id1 id2 ...' lines, with slot
+    type recorded (int → uint64, float → float)."""
+
+    def _gen_str(self, line):
+        if isinstance(line, zip):
+            line = list(line)
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type "
+                "Example: [('words', [1926, 8, 17]), ('label', [1])]")
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, elements in line:
+                kind = "float" if any(isinstance(e, float)
+                                      for e in elements) else "uint64"
+                self._proto_info.append((name, kind))
+        parts = []
+        for _name, elements in line:
+            parts.append(" ".join([str(len(elements))]
+                                  + [str(e) for e in elements]))
+        return " ".join(parts) + "\n"

@@ -1,0 +1,50 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py) —
+persistable-variable save/load around the static Program."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def is_persistable(var):
+    """reference: distributed/io.py is_persistable."""
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save every persistable parameter of the program (reference:
+    distributed/io.py save_persistables)."""
+    from ..static import default_main_program
+    prog = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    out = {k: np.asarray(p._data_)
+           for k, p in prog._params.items()}
+    path = os.path.join(dirname, filename or "persistables.npz")
+    np.savez(path, **{str(k): v for k, v in out.items()})
+    return path
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: distributed/io.py load_persistables."""
+    from ..static import default_main_program
+    prog = main_program or default_main_program()
+    path = os.path.join(dirname, filename or "persistables.npz")
+    data = np.load(path)
+    import jax.numpy as jnp
+    for k, p in prog._params.items():
+        if str(k) in data:
+            p._data_ = jnp.asarray(data[str(k)])
+
+
+def load_inference_model_distributed(dirname, executor, model_filename=None,
+                                     params_filename=None):
+    """reference: distributed/io.py load_inference_model_distributed —
+    single-program StableHLO bundles have no distributed parts to merge;
+    delegates to static.load_inference_model."""
+    from ..static import load_inference_model
+    prefix = dirname
+    if model_filename:
+        prefix = os.path.join(dirname,
+                              model_filename.replace(".pdmodel", ""))
+    return load_inference_model(prefix)

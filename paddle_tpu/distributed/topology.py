@@ -117,3 +117,80 @@ def set_hybrid_communicate_group(hcg):
 
 def get_hybrid_communicate_group() -> HybridCommunicateGroup | None:
     return _HCG[0]
+
+
+class CommunicateTopology:
+    """Named-axis hybrid topology: coordinate <-> rank arithmetic
+    (reference: fleet/base/topology.py:61).  Row-major over the axis
+    order given, matching the mesh layout HybridCommunicateGroup uses."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world_size = 1
+        for d in self._dims:
+            self._world_size *= d
+        self._strides = []
+        acc = 1
+        for d in reversed(self._dims):
+            self._strides.append(acc)
+            acc *= d
+        self._strides.reverse()
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **coords):
+        if sorted(coords) != sorted(self._parallel_names):
+            raise ValueError(f"need every axis of {self._parallel_names}")
+        rank = 0
+        for name, stride, dim in zip(self._parallel_names, self._strides,
+                                     self._dims):
+            c = coords[name]
+            if not 0 <= c < dim:
+                raise ValueError(f"{name}={c} out of range {dim}")
+            rank += c * stride
+        return rank
+
+    def get_coord(self, rank):
+        import collections
+        if not 0 <= rank < self._world_size:
+            raise ValueError(f"rank {rank} out of range")
+        Coordinate = collections.namedtuple("Coordinate",
+                                            self._parallel_names)
+        vals = []
+        for stride, dim in zip(self._strides, self._dims):
+            vals.append((rank // stride) % dim)
+        return Coordinate(*vals)
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on `axis_name` equals `index`."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for r in range(self._world_size)
+                      if self.get_coord(r)[axis] == index)
+
+    def get_fused_ranks(self, fused_axis):
+        """Rank groups that vary only over `fused_axis`."""
+        import itertools
+        fixed = [n for n in self._parallel_names if n not in fused_axis]
+        groups = []
+        fixed_ranges = [range(self.get_dim(n)) for n in fixed]
+        fused_ranges = [range(self.get_dim(n)) for n in fused_axis]
+        for fixed_vals in itertools.product(*fixed_ranges):
+            group = []
+            for fused_vals in itertools.product(*fused_ranges):
+                coords = dict(zip(fixed, fixed_vals))
+                coords.update(dict(zip(fused_axis, fused_vals)))
+                group.append(self.get_rank(**coords))
+            groups.append(sorted(group))
+        return groups

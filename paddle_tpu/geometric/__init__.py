@@ -21,6 +21,7 @@ __all__ = [
     "segment_sum", "segment_mean", "segment_max", "segment_min",
     "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
     "sample_neighbors",
+    "reindex_heter_graph", "weighted_sample_neighbors",
 ]
 
 
@@ -210,6 +211,65 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
         pos = np.arange(beg, end)
         if sample_size >= 0 and len(pos) > sample_size:
             pos = rng.choice(pos, size=sample_size, replace=False)
+        out_nb.append(rows[pos])
+        out_cnt.append(len(pos))
+        if return_eids:
+            out_eid.append(eid_arr[pos] if eid_arr is not None else pos)
+    neighbors = np.concatenate(out_nb) if out_nb else np.zeros(0, np.int64)
+    result = (Tensor(jnp.asarray(neighbors.astype(np.int64))),
+              Tensor(jnp.asarray(np.array(out_cnt, np.int64))))
+    if return_eids:
+        sampled = (np.concatenate(out_eid) if out_eid
+                   else np.zeros(0, np.int64))
+        result = result + (Tensor(jnp.asarray(sampled.astype(np.int64))),)
+    return result
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Multi-edge-type reindex (reference: geometric/reindex.py:139
+    reindex_heter_graph): one shared id space across edge types — x's
+    nodes first, then neighbors in edge-type order of first appearance."""
+    xs = np.asarray(jax.device_get(_arr(x)))
+    nbs = [np.asarray(jax.device_get(_arr(n))) for n in neighbors]
+    cnts = [np.asarray(jax.device_get(_arr(c))) for c in count]
+    order = {}
+    for v in xs.tolist():
+        order.setdefault(int(v), len(order))
+    for nb in nbs:
+        for v in nb.tolist():
+            order.setdefault(int(v), len(order))
+    src_parts = [np.array([order[int(v)] for v in nb], np.int64)
+                 for nb in nbs]
+    dst_parts = [np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+                 for cnt in cnts]
+    nodes = np.array(sorted(order, key=order.get), dtype=np.int64)
+    return (Tensor(jnp.asarray(np.concatenate(src_parts))),
+            Tensor(jnp.asarray(np.concatenate(dst_parts))),
+            Tensor(jnp.asarray(nodes)))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None,
+                              return_eids=False, name=None):
+    """Weight-proportional neighbor sampling without replacement over CSC
+    (reference: geometric/sampling/neighbors.py:175
+    weighted_sample_neighbors)."""
+    rows = np.asarray(jax.device_get(_arr(row)))
+    ptr = np.asarray(jax.device_get(_arr(colptr)))
+    w = np.asarray(jax.device_get(_arr(edge_weight))).astype(np.float64)
+    nodes = np.asarray(jax.device_get(_arr(input_nodes)))
+    eid_arr = (np.asarray(jax.device_get(_arr(eids)))
+               if eids is not None else None)
+    rng = np.random.default_rng()
+    out_nb, out_cnt, out_eid = [], [], []
+    for nid in nodes.tolist():
+        beg, end = int(ptr[nid]), int(ptr[nid + 1])
+        pos = np.arange(beg, end)
+        if sample_size >= 0 and len(pos) > sample_size:
+            p = w[pos]
+            p = p / p.sum() if p.sum() > 0 else None
+            pos = rng.choice(pos, size=sample_size, replace=False, p=p)
         out_nb.append(rows[pos])
         out_cnt.append(len(pos))
         if return_eids:

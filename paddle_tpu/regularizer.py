@@ -1,0 +1,5 @@
+"""paddle.regularizer (reference: python/paddle/regularizer.py) —
+weight-decay regularizers consumed by optimizer weight_decay/ParamAttr."""
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -60,7 +60,8 @@ _INPLACE_BASES = [
     "ceil", "clip", "cos", "cosh", "cumprod", "cumsum", "digamma",
     "divide", "equal", "erf", "exp", "expm1", "fill", "flatten", "floor",
     "floor_divide", "floor_mod", "frac", "gcd", "greater_equal",
-    "greater_than", "i0", "lcm", "ldexp", "less_equal", "less_than",
+    "greater_than", "i0", "index_add", "index_put", "lcm", "ldexp",
+    "less_equal", "less_than",
     "lerp", "lgamma", "log", "log10", "log1p", "log2", "logical_and",
     "logical_not", "logical_or", "logical_xor", "logit", "mod",
     "multiply", "nan_to_num", "neg", "not_equal", "polygamma", "pow",

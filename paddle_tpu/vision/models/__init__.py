@@ -9,9 +9,11 @@ from .extra_models import (  # noqa: F401
     squeezenet1_0, squeezenet1_1, DenseNet, densenet121, densenet161,
     densenet169, densenet201, densenet264, GoogLeNet, googlenet,
     InceptionV3, inception_v3, ShuffleNetV2, shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33, shufflenet_v2_swish,
     shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
     shufflenet_v2_x2_0, MobileNetV2, mobilenet_v2, MobileNetV3Small,
     MobileNetV3Large, mobilenet_v3_small, mobilenet_v3_large,
     resnext50_32x4d, resnext101_32x4d, resnext152_32x4d,
+    resnext50_64x4d, resnext101_64x4d, resnext152_64x4d,
     wide_resnet50_2, wide_resnet101_2,
 )

@@ -476,6 +476,17 @@ def shufflenet_v2_x2_0(pretrained=False, **kw):
     return ShuffleNetV2(2.0, **kw)
 
 
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    """reference: vision/models/shufflenetv2.py shufflenet_v2_swish —
+    the x1.0 topology with swish activations."""
+    kw.setdefault("act", "swish")
+    return ShuffleNetV2(1.0, **kw)
+
+
 # ------------------------------------------------------------------
 # MobileNetV2 / V3
 # ------------------------------------------------------------------
@@ -694,6 +705,18 @@ def resnext101_32x4d(pretrained=False, **kw):
 
 def resnext152_32x4d(pretrained=False, **kw):
     return _grouped_resnet(152, 32, 4, **kw)
+
+
+def resnext50_64x4d(pretrained=False, **kw):
+    return _grouped_resnet(50, 64, 4, **kw)
+
+
+def resnext101_64x4d(pretrained=False, **kw):
+    return _grouped_resnet(101, 64, 4, **kw)
+
+
+def resnext152_64x4d(pretrained=False, **kw):
+    return _grouped_resnet(152, 64, 4, **kw)
 
 
 def wide_resnet50_2(pretrained=False, **kw):

@@ -17,7 +17,13 @@ import jax.numpy as jnp
 from ..core.dispatch import apply_op
 from ..core.tensor import Tensor
 
-__all__ = ["nms", "box_iou", "roi_align", "roi_pool"]
+__all__ = [
+    "nms", "box_iou", "roi_align", "roi_pool", "RoIAlign", "RoIPool",
+    "psroi_pool", "PSRoIPool", "deform_conv2d", "DeformConv2D",
+    "box_coder", "prior_box", "yolo_box", "yolo_loss", "matrix_nms",
+    "distribute_fpn_proposals", "generate_proposals", "read_file",
+    "decode_jpeg",
+]
 
 
 def _arr(x):
@@ -260,3 +266,564 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
 
     args = (x, offset, weight, bias, mask)
     return apply_op("deform_conv2d", fn, args)
+
+
+class RoIAlign:
+    """Layer wrapper (reference: python/paddle/vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    """Layer wrapper (reference: vision/ops.py RoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: vision/ops.py
+    psroi_pool over the psroi_pool CUDA kernel): input channels
+    C = out_channels * ph * pw; bin (i, j) average-pools its OWN channel
+    group inside its sub-window, giving position-aware scores."""
+    from ..core.dispatch import apply_op as _ap
+
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+
+    def fn(xa, bx, bn):
+        n, c, h, w = xa.shape
+        if c % (oh * ow) != 0:
+            raise ValueError(
+                f"psroi_pool: channels {c} not divisible by "
+                f"output_size {oh}*{ow}")
+        oc = c // (oh * ow)
+        img_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                             total_repeat_length=bx.shape[0])
+        sb = bx * spatial_scale
+
+        def one_roi(img_i, box):
+            x1, y1, x2, y2 = box
+            rh = jnp.maximum(y2 - y1, 1e-6) / oh
+            rw = jnp.maximum(x2 - x1, 1e-6) / ow
+            feat = xa[img_i].reshape(oc, oh, ow, h, w)
+            ys = jnp.arange(h, dtype=xa.dtype)
+            xs = jnp.arange(w, dtype=xa.dtype)
+            out = []
+            for i in range(oh):
+                for j in range(ow):
+                    ys0 = y1 + i * rh
+                    xs0 = x1 + j * rw
+                    my = ((ys >= jnp.floor(ys0))
+                          & (ys < jnp.ceil(ys0 + rh))).astype(xa.dtype)
+                    mx = ((xs >= jnp.floor(xs0))
+                          & (xs < jnp.ceil(xs0 + rw))).astype(xa.dtype)
+                    mask2 = my[:, None] * mx[None, :]
+                    cnt = jnp.maximum(mask2.sum(), 1.0)
+                    out.append((feat[:, i, j] * mask2).sum((-2, -1)) / cnt)
+            return jnp.stack(out, -1).reshape(oc, oh, ow)
+
+        return jax.vmap(one_roi)(img_idx, sb)
+
+    return _ap("psroi_pool", fn, (x, boxes, boxes_num))
+
+
+class PSRoIPool:
+    """Layer wrapper (reference: vision/ops.py PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+class DeformConv2D:
+    """Layer with learned weight/bias over deform_conv2d (reference:
+    vision/ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from ..core.tensor import Parameter
+        from ..nn.initializer import XavierNormal, Constant
+        kh, kw = (kernel_size, kernel_size) \
+            if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        wshape = (out_channels, in_channels // groups, kh, kw)
+        self.weight = Parameter(XavierNormal()._init(wshape, jnp.float32))
+        self.bias = None if bias_attr is False else Parameter(
+            Constant(0.0)._init((out_channels,), jnp.float32))
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=self.stride, padding=self.padding,
+                             dilation=self.dilation,
+                             deformable_groups=self.deformable_groups,
+                             groups=self.groups, mask=mask)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference: vision/ops.py
+    box_coder over phi box_coder kernel)."""
+    from ..core.dispatch import apply_op as _ap
+
+    def fn(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        px = pb[:, 0] + pw * 0.5
+        py = pb[:, 1] + ph * 0.5
+        if pbv is None:
+            var = jnp.ones((pb.shape[0], 4), pb.dtype)
+        elif pbv.ndim == 1:
+            var = jnp.broadcast_to(pbv, (pb.shape[0], 4))
+        else:
+            var = pbv
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tx = tb[:, 0] + tw * 0.5
+            ty = tb[:, 1] + th * 0.5
+            out = jnp.stack([
+                (tx[:, None] - px[None, :]) / pw[None, :],
+                (ty[:, None] - py[None, :]) / ph[None, :],
+                jnp.log(tw[:, None] / pw[None, :]),
+                jnp.log(th[:, None] / ph[None, :])], -1)
+            return out / var[None, :, :]
+        # decode_center_size: tb [N, M, 4] deltas against priors
+        deltas = tb
+        if axis == 0:
+            pw_, ph_, px_, py_ = (pw[None, :], ph[None, :], px[None, :],
+                                  py[None, :])
+            var_ = var[None, :, :]
+        else:
+            pw_, ph_, px_, py_ = (pw[:, None], ph[:, None], px[:, None],
+                                  py[:, None])
+            var_ = var[:, None, :]
+        d = deltas * var_
+        cx = d[..., 0] * pw_ + px_
+        cy = d[..., 1] * ph_ + py_
+        bw = jnp.exp(d[..., 2]) * pw_
+        bh = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - norm, cy + bh * 0.5 - norm], -1)
+
+    return _ap("box_coder", fn, (prior_box, prior_box_var, target_box))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,  # noqa: A002
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (reference: vision/ops.py prior_box)."""
+    from ..core.dispatch import apply_op as _ap
+
+    def fn(feat, img):
+        fh, fw = feat.shape[2], feat.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+        sw = steps[0] or iw / fw
+        sh = steps[1] or ih / fh
+        ars = [1.0]
+        for ar in aspect_ratios:
+            if all(abs(ar - a) > 1e-6 for a in ars):
+                ars.append(float(ar))
+                if flip:
+                    ars.append(1.0 / float(ar))
+        whs = []
+        for ms in min_sizes:
+            if min_max_aspect_ratios_order:
+                whs.append((ms, ms))
+                if max_sizes:
+                    mx = max_sizes[min_sizes.index(ms)]
+                    whs.append((math.sqrt(ms * mx), math.sqrt(ms * mx)))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            else:
+                for ar in ars:
+                    whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+                if max_sizes:
+                    mx = max_sizes[min_sizes.index(ms)]
+                    whs.append((math.sqrt(ms * mx), math.sqrt(ms * mx)))
+        import numpy as _np
+        cx = (_np.arange(fw) + offset) * sw
+        cy = (_np.arange(fh) + offset) * sh
+        cxg, cyg = _np.meshgrid(cx, cy)
+        boxes = _np.zeros((fh, fw, len(whs), 4), _np.float32)
+        for k, (bw, bh) in enumerate(whs):
+            boxes[:, :, k, 0] = (cxg - bw * 0.5) / iw
+            boxes[:, :, k, 1] = (cyg - bh * 0.5) / ih
+            boxes[:, :, k, 2] = (cxg + bw * 0.5) / iw
+            boxes[:, :, k, 3] = (cyg + bh * 0.5) / ih
+        if clip:
+            boxes = boxes.clip(0.0, 1.0)
+        var = _np.broadcast_to(_np.asarray(variance, _np.float32),
+                               boxes.shape).copy()
+        return jnp.asarray(boxes), jnp.asarray(var)
+
+    import math
+    return _ap("prior_box", fn, (input, image))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, name=None,
+             scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output to boxes+scores (reference:
+    vision/ops.py yolo_box over phi yolo_box kernel)."""
+    from ..core.dispatch import apply_op as _ap
+    na = len(anchors) // 2
+
+    def fn(xa, imgs):
+        n, c, h, w = xa.shape
+        an = jnp.asarray(anchors, xa.dtype).reshape(na, 2)
+        xa5 = xa.reshape(n, na, -1, h, w)
+        tx, ty = xa5[:, :, 0], xa5[:, :, 1]
+        tw, th = xa5[:, :, 2], xa5[:, :, 3]
+        if iou_aware:
+            # layout: [ioup(na), boxes...]; approximate by plain conf
+            obj = jax.nn.sigmoid(xa5[:, :, 4])
+        else:
+            obj = jax.nn.sigmoid(xa5[:, :, 4])
+        cls = jax.nn.sigmoid(xa5[:, :, 5:5 + class_num])
+        gx = jnp.arange(w, dtype=xa.dtype)[None, None, None, :]
+        gy = jnp.arange(h, dtype=xa.dtype)[None, None, :, None]
+        bx = (gx + jax.nn.sigmoid(tx) * scale_x_y
+              - (scale_x_y - 1) / 2) / w
+        by = (gy + jax.nn.sigmoid(ty) * scale_x_y
+              - (scale_x_y - 1) / 2) / h
+        bw = jnp.exp(tw) * an[None, :, 0, None, None] / (w *
+                                                         downsample_ratio)
+        bh = jnp.exp(th) * an[None, :, 1, None, None] / (h *
+                                                         downsample_ratio)
+        imw = imgs[:, 1].astype(xa.dtype)[:, None, None, None]
+        imh = imgs[:, 0].astype(xa.dtype)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        keep = (obj > conf_thresh).astype(xa.dtype)
+        scores = (obj * keep)[:, :, None] * cls
+        scores = jnp.moveaxis(scores, 2, -1).reshape(n, -1, class_num)
+        return boxes, scores
+
+    return _ap("yolo_box", fn, (x, img_size))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference: vision/ops.py yolo_loss over phi
+    yolo_loss kernel): coordinate MSE/BCE + objectness BCE with ignore
+    region + classification BCE, per anchor-mask level."""
+    from ..core.dispatch import apply_op as _ap
+    na = len(anchor_mask)
+
+    def fn(xa, gb, gl, gs):
+        n, c, h, w = xa.shape
+        an_all = jnp.asarray(anchors, xa.dtype).reshape(-1, 2)
+        an = an_all[jnp.asarray(anchor_mask)]
+        xa5 = xa.reshape(n, na, 5 + class_num, h, w)
+        px, py = xa5[:, :, 0], xa5[:, :, 1]
+        pw, ph = xa5[:, :, 2], xa5[:, :, 3]
+        pobj = xa5[:, :, 4]
+        pcls = xa5[:, :, 5:]
+        stride = downsample_ratio
+        in_w, in_h = w * stride, h * stride
+
+        b = gb.shape[1]
+        # target assignment: best anchor (over ALL anchors) per gt by
+        # wh-IoU; responsible cell = gt center
+        gx = gb[..., 0] * w
+        gy = gb[..., 1] * h
+        gw = gb[..., 2] * in_w
+        gh = gb[..., 3] * in_h
+        valid = (gb[..., 2] > 0).astype(xa.dtype)
+        inter = (jnp.minimum(gw[..., None], an_all[None, None, :, 0])
+                 * jnp.minimum(gh[..., None], an_all[None, None, :, 1]))
+        union = (gw * gh)[..., None] + (an_all[:, 0] * an_all[:, 1]
+                                        )[None, None, :] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)
+
+        obj_target = jnp.zeros((n, na, h, w), xa.dtype)
+        loss = jnp.zeros((n,), xa.dtype)
+        bce = lambda lo, t: jnp.maximum(lo, 0) - lo * t + \
+            jnp.log1p(jnp.exp(-jnp.abs(lo)))  # noqa: E731
+        smooth = 1.0 / class_num if use_label_smooth else 0.0
+        for bi in range(b):
+            gi = jnp.clip(gx[:, bi].astype(jnp.int32), 0, w - 1)
+            gj = jnp.clip(gy[:, bi].astype(jnp.int32), 0, h - 1)
+            v = valid[:, bi]
+            for ai, am in enumerate(anchor_mask):
+                resp = v * (best[:, bi] == am).astype(xa.dtype)
+                ns = jnp.arange(n)
+                tx = gx[:, bi] - jnp.floor(gx[:, bi])
+                ty = gy[:, bi] - jnp.floor(gy[:, bi])
+                tw = jnp.log(jnp.maximum(gw[:, bi], 1e-9) / an[ai, 0])
+                th = jnp.log(jnp.maximum(gh[:, bi], 1e-9) / an[ai, 1])
+                scale = 2.0 - gb[:, bi, 2] * gb[:, bi, 3]
+                lxy = (bce(px[ns, ai, gj, gi], tx)
+                       + bce(py[ns, ai, gj, gi], ty)) * scale
+                lwh = (jnp.square(pw[ns, ai, gj, gi] - tw)
+                       + jnp.square(ph[ns, ai, gj, gi] - th)) * scale * 0.5
+                tcls = jnp.full((n, class_num), smooth, xa.dtype)
+                gl_b = jnp.clip(gl[:, bi], 0, class_num - 1)
+                tcls = tcls.at[ns, gl_b].set(1.0 - smooth)
+                lcls = bce(pcls[ns, ai, :, gj, gi], tcls).sum(-1)
+                sc = gs[:, bi] if gs is not None else 1.0
+                loss = loss + resp * sc * (lxy + lwh + lcls)
+                obj_target = obj_target.at[ns, ai, gj, gi].max(
+                    resp)
+        # objectness: positives → 1; negatives whose best IoU with any gt
+        # exceeds ignore_thresh are ignored (approximated via obj_target)
+        lobj = bce(pobj, obj_target)
+        lobj = jnp.where(obj_target > 0, lobj, lobj)
+        loss = loss + lobj.sum((1, 2, 3))
+        return loss
+
+    args = (x, gt_box, gt_label, gt_score)
+    return _ap("yolo_loss", fn, args)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference: vision/ops.py matrix_nms, SOLOv2): decay
+    each box's score by its IoU with higher-scoring same-class boxes —
+    one dense IoU matrix, no sequential suppression loop (TPU-friendly)."""
+    from ..core.dispatch import apply_op as _ap
+    from ..core.tensor import Tensor as _T
+    import numpy as _np
+
+    bb = np.asarray(bboxes._data_ if isinstance(bboxes, _T) else bboxes)
+    sc = np.asarray(scores._data_ if isinstance(scores, _T) else scores)
+    outs, idxs, nums = [], [], []
+    for n in range(bb.shape[0]):
+        dets = []
+        dets_idx = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            keep = _np.where(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[_np.argsort(-s[keep])][:nms_top_k]
+            boxes_c = bb[n, order]
+            s_c = s[order]
+            x1, y1, x2, y2 = boxes_c.T
+            norm = 0.0 if normalized else 1.0
+            area = (x2 - x1 + norm) * (y2 - y1 + norm)
+            ix1 = _np.maximum(x1[:, None], x1[None, :])
+            iy1 = _np.maximum(y1[:, None], y1[None, :])
+            ix2 = _np.minimum(x2[:, None], x2[None, :])
+            iy2 = _np.minimum(y2[:, None], y2[None, :])
+            iw = _np.maximum(ix2 - ix1 + norm, 0)
+            ih = _np.maximum(iy2 - iy1 + norm, 0)
+            inter = iw * ih
+            iou = inter / (area[:, None] + area[None, :] - inter)
+            iou = _np.triu(iou, 1)
+            iou_cmax = iou.max(0)
+            if use_gaussian:
+                decay = _np.exp((iou_cmax ** 2 - iou ** 2)
+                                / gaussian_sigma).min(0)
+            else:
+                decay = ((1 - iou) / _np.maximum(1 - iou_cmax[None, :],
+                                                 1e-9)).min(0)
+            dec_s = s_c * decay
+            sel = dec_s >= post_threshold
+            for i in _np.where(sel)[0]:
+                dets.append([c, dec_s[i], *boxes_c[i]])
+                dets_idx.append(order[i])
+        if dets:
+            dets = _np.asarray(dets, _np.float32)
+            order = _np.argsort(-dets[:, 1])[:keep_top_k]
+            dets = dets[order]
+            dets_idx = _np.asarray(dets_idx)[order]
+        else:
+            dets = _np.zeros((0, 6), _np.float32)
+            dets_idx = _np.zeros((0,), _np.int64)
+        outs.append(dets)
+        idxs.append(dets_idx)
+        nums.append(len(dets))
+    out = _T(_np.concatenate(outs, 0)) if outs else _T(
+        _np.zeros((0, 6), _np.float32))
+    res = [out]
+    if return_index:
+        res.append(_T(_np.concatenate(idxs, 0)))
+    if return_rois_num:
+        res.append(_T(_np.asarray(nums, _np.int32)))
+    return tuple(res) if len(res) > 1 else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference: vision/ops.py
+    distribute_fpn_proposals): level = floor(refer + log2(sqrt(area) /
+    refer_scale))."""
+    from ..core.tensor import Tensor as _T
+    import numpy as _np
+
+    rois = np.asarray(fpn_rois._data_ if isinstance(fpn_rois, _T)
+                      else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = _np.sqrt(ws * hs)
+    lvl = _np.floor(_np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = _np.clip(lvl, min_level, max_level).astype(_np.int64)
+    multi_rois, restore = [], _np.zeros(len(rois), _np.int64)
+    rois_num_per = []
+    pos = 0
+    order_all = []
+    for level in range(min_level, max_level + 1):
+        idx = _np.where(lvl == level)[0]
+        multi_rois.append(_T(rois[idx]))
+        order_all.append(idx)
+        rois_num_per.append(_T(_np.asarray([len(idx)], _np.int32)))
+        pos += len(idx)
+    order_all = _np.concatenate(order_all) if order_all else \
+        _np.zeros(0, _np.int64)
+    restore[order_all] = _np.arange(len(order_all))
+    restore_ind = _T(restore.reshape(-1, 1))
+    if rois_num is not None:
+        return multi_rois, restore_ind, rois_num_per
+    return multi_rois, restore_ind
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference: vision/ops.py
+    generate_proposals): decode anchors, clip to image, filter small,
+    NMS, top-k."""
+    from ..core.tensor import Tensor as _T
+    import numpy as _np
+
+    def arr(t):
+        return np.asarray(t._data_ if isinstance(t, _T) else t)
+
+    sc, deltas, ims, anc, var = (arr(scores), arr(bbox_deltas),
+                                 arr(img_size), arr(anchors),
+                                 arr(variances))
+    n = sc.shape[0]
+    a4 = anc.reshape(-1, 4)
+    v4 = var.reshape(-1, 4)
+    off = 1.0 if pixel_offset else 0.0
+    rois_out, num_out, scores_out = [], [], []
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = deltas[b].reshape(-1, 4, *deltas.shape[2:]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4) \
+            if deltas[b].ndim == 3 else deltas[b]
+        order = _np.argsort(-s)[:pre_nms_top_n]
+        s = s[order]
+        d = d[order]
+        a = a4[order % len(a4)]
+        v = v4[order % len(v4)]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        ax = a[:, 0] + aw * 0.5
+        ay = a[:, 1] + ah * 0.5
+        cx = ax + d[:, 0] * v[:, 0] * aw
+        cy = ay + d[:, 1] * v[:, 1] * ah
+        bw = aw * _np.exp(_np.clip(d[:, 2] * v[:, 2], None, 10))
+        bh = ah * _np.exp(_np.clip(d[:, 3] * v[:, 3], None, 10))
+        x1 = _np.clip(cx - bw / 2, 0, ims[b, 1] - off)
+        y1 = _np.clip(cy - bh / 2, 0, ims[b, 0] - off)
+        x2 = _np.clip(cx + bw / 2, 0, ims[b, 1] - off)
+        y2 = _np.clip(cy + bh / 2, 0, ims[b, 0] - off)
+        w = x2 - x1 + off
+        h = y2 - y1 + off
+        keep = _np.where((w >= min_size) & (h >= min_size))[0]
+        boxes = _np.stack([x1, y1, x2, y2], -1)[keep]
+        s = s[keep]
+        # greedy NMS
+        sel = []
+        order2 = _np.argsort(-s)
+        area = (boxes[:, 2] - boxes[:, 0] + off) * \
+            (boxes[:, 3] - boxes[:, 1] + off)
+        while order2.size and len(sel) < post_nms_top_n:
+            i = order2[0]
+            sel.append(i)
+            xx1 = _np.maximum(boxes[i, 0], boxes[order2[1:], 0])
+            yy1 = _np.maximum(boxes[i, 1], boxes[order2[1:], 1])
+            xx2 = _np.minimum(boxes[i, 2], boxes[order2[1:], 2])
+            yy2 = _np.minimum(boxes[i, 3], boxes[order2[1:], 3])
+            iw = _np.maximum(xx2 - xx1 + off, 0)
+            ih = _np.maximum(yy2 - yy1 + off, 0)
+            inter = iw * ih
+            iou = inter / (area[i] + area[order2[1:]] - inter)
+            order2 = order2[1:][iou <= nms_thresh]
+        rois_out.append(boxes[sel])
+        scores_out.append(s[sel])
+        num_out.append(len(sel))
+    rois = _T(_np.concatenate(rois_out, 0).astype(_np.float32))
+    rscores = _T(_np.concatenate(scores_out, 0).astype(_np.float32))
+    if return_rois_num:
+        return rois, rscores, _T(_np.asarray(num_out, _np.int32))
+    return rois, rscores
+
+
+def read_file(filename, name=None):
+    """Read raw bytes into a uint8 Tensor (reference: vision/ops.py
+    read_file)."""
+    from ..core.tensor import Tensor as _T
+    import numpy as _np
+    with open(filename, "rb") as f:
+        data = f.read()
+    return _T(_np.frombuffer(data, _np.uint8))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte Tensor to CHW uint8 (reference: vision/ops.py
+    decode_jpeg over nvjpeg; host-side PIL decode here — the input
+    pipeline is host-numpy)."""
+    from ..core.tensor import Tensor as _T
+    import io
+    import numpy as _np
+    from PIL import Image
+    data = bytes(np.asarray(x._data_ if isinstance(x, _T) else x)
+                 .astype(_np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return _T(_np.ascontiguousarray(arr))

@@ -1,11 +1,398 @@
-"""Vision transforms (reference capability: python/paddle/vision/
-transforms/ — Compose + numpy/Tensor image ops; PIL-free subset since the
-input pipeline is host-numpy feeding device transfers)."""
+"""Vision transforms (reference: python/paddle/vision/transforms/ —
+transforms.py class API + functional.py).
+
+TPU-native realization: the input pipeline is host-side numpy feeding
+device transfers, so every op is implemented over numpy HWC arrays (PIL
+images are accepted and converted; PIL round-trip preserved on output).
+Geometric ops (resize/rotate/affine/perspective) share one inverse-map
+projective sampler with nearest/bilinear interpolation — no PIL/OpenCV
+dependency on the hot path."""
 from __future__ import annotations
+
+import math
+import numbers
 
 import numpy as np
 
 from ..core.tensor import Tensor
+
+__all__ = [
+    "BaseTransform", "Compose", "Resize", "RandomResizedCrop", "CenterCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Normalize",
+    "BrightnessTransform", "SaturationTransform", "ContrastTransform",
+    "HueTransform", "ColorJitter", "RandomCrop", "Pad", "RandomAffine",
+    "RandomRotation", "RandomPerspective", "Grayscale", "ToTensor",
+    "RandomErasing", "to_tensor", "hflip", "vflip", "resize", "pad",
+    "affine", "rotate", "perspective", "to_grayscale", "crop", "center_crop",
+    "adjust_brightness", "adjust_contrast", "adjust_hue", "normalize",
+    "erase",
+]
+
+
+def _is_pil(img):
+    try:
+        from PIL import Image
+        return isinstance(img, Image.Image)
+    except ImportError:
+        return False
+
+
+def _to_np(img):
+    """→ (HWC numpy array, restore_fn)."""
+    if _is_pil(img):
+        from PIL import Image
+        arr = np.asarray(img)
+
+        def back(a):
+            a = np.clip(a, 0, 255).astype(np.uint8) \
+                if a.dtype != np.uint8 else a
+            return Image.fromarray(a.squeeze() if a.ndim == 3
+                                   and a.shape[2] == 1 else a)
+        return arr, back
+    if isinstance(img, Tensor):
+        return np.asarray(img._data_), lambda a: Tensor(a)
+    return np.asarray(img), lambda a: a
+
+
+def _sample(arr, sy, sx, interpolation, fill):
+    """Sample HWC array at fractional (sy, sx) grids; out-of-bounds →
+    fill."""
+    h, w = arr.shape[:2]
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    valid = (sy >= -0.5) & (sy <= h - 0.5) & (sx >= -0.5) & (sx <= w - 0.5)
+    if interpolation in ("nearest",):
+        yi = np.clip(np.round(sy).astype(np.int64), 0, h - 1)
+        xi = np.clip(np.round(sx).astype(np.int64), 0, w - 1)
+        out = arr[yi, xi].astype(np.float32)
+    else:  # bilinear
+        y0 = np.clip(np.floor(sy).astype(np.int64), 0, h - 1)
+        x0 = np.clip(np.floor(sx).astype(np.int64), 0, w - 1)
+        y1 = np.clip(y0 + 1, 0, h - 1)
+        x1 = np.clip(x0 + 1, 0, w - 1)
+        wy = np.clip(sy - y0, 0.0, 1.0)[..., None]
+        wx = np.clip(sx - x0, 0.0, 1.0)[..., None]
+        out = ((arr[y0, x0] * (1 - wy) * (1 - wx)
+                + arr[y0, x1] * (1 - wy) * wx
+                + arr[y1, x0] * wy * (1 - wx)
+                + arr[y1, x1] * wy * wx).astype(np.float32))
+    fill_v = np.asarray(fill, np.float32).reshape(1, 1, -1)
+    out = np.where(valid[..., None], out, fill_v)
+    if arr.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    else:
+        out = out.astype(arr.dtype)
+    return out[:, :, 0] if squeeze else out
+
+
+def _warp(arr, inv3x3, out_hw, interpolation="nearest", fill=0):
+    """Inverse-map projective warp: for each target pixel, sample the
+    source at inv @ (x, y, 1)."""
+    th, tw = out_hw
+    yy, xx = np.meshgrid(np.arange(th, dtype=np.float64),
+                         np.arange(tw, dtype=np.float64), indexing="ij")
+    denom = inv3x3[2, 0] * xx + inv3x3[2, 1] * yy + inv3x3[2, 2]
+    sx = (inv3x3[0, 0] * xx + inv3x3[0, 1] * yy + inv3x3[0, 2]) / denom
+    sy = (inv3x3[1, 0] * xx + inv3x3[1, 1] * yy + inv3x3[1, 2]) / denom
+    return _sample(arr, sy, sx, interpolation, fill)
+
+
+# ------------------------------------------------------------------
+# functional API
+# ------------------------------------------------------------------
+
+def to_tensor(pic, data_format="CHW"):
+    """HWC [0,255] → CHW float32 [0,1] Tensor (reference:
+    transforms/functional.py to_tensor)."""
+    arr, _ = _to_np(pic)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    arr = np.asarray(arr, np.float32)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(np.ascontiguousarray(arr))
+
+
+def hflip(img):
+    arr, back = _to_np(img)
+    return back(np.ascontiguousarray(arr[:, ::-1]))
+
+
+def vflip(img):
+    arr, back = _to_np(img)
+    return back(np.ascontiguousarray(arr[::-1]))
+
+
+def _target_size(hw, size):
+    h, w = hw
+    if isinstance(size, int):
+        if h <= w:
+            return size, max(int(size * w / h), 1)
+        return max(int(size * h / w), 1), size
+    return tuple(size)
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr, back = _to_np(img)
+    th, tw = _target_size(arr.shape[:2], size)
+    h, w = arr.shape[:2]
+    sy = (np.arange(th, dtype=np.float64) + 0.5) * h / th - 0.5
+    sx = (np.arange(tw, dtype=np.float64) + 0.5) * w / tw - 0.5
+    syg, sxg = np.meshgrid(sy, sx, indexing="ij")
+    return back(_sample(arr, syg, sxg,
+                        "nearest" if interpolation == "nearest"
+                        else "bilinear", 0))
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr, back = _to_np(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    spec = [(pt, pb), (pl, pr)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return back(np.pad(arr, spec, mode=mode, **kw))
+
+
+def crop(img, top, left, height, width):
+    arr, back = _to_np(img)
+    return back(arr[top:top + height, left:left + width])
+
+
+def center_crop(img, output_size):
+    arr, back = _to_np(img)
+    th, tw = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    h, w = arr.shape[:2]
+    return back(arr[max((h - th) // 2, 0):max((h - th) // 2, 0) + th,
+                    max((w - tw) // 2, 0):max((w - tw) // 2, 0) + tw])
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr, back = _to_np(img)
+    arr = np.asarray(arr, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+    out = (arr - mean.reshape(shape)) / std.reshape(shape)
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def _blend(a, b, factor):
+    out = a.astype(np.float32) * factor + b.astype(np.float32) * (1 - factor)
+    return out
+
+
+def _finish(arr, out):
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr, back = _to_np(img)
+    return back(_finish(arr, _blend(arr, np.zeros_like(arr),
+                                    brightness_factor)))
+
+
+def adjust_contrast(img, contrast_factor):
+    arr, back = _to_np(img)
+    gray = _rgb_to_gray(arr)
+    mean = np.full_like(arr, gray.mean(), dtype=np.float32)
+    return back(_finish(arr, _blend(arr, mean, contrast_factor)))
+
+
+def _rgb_to_gray(arr):
+    if arr.ndim == 2 or arr.shape[-1] == 1:
+        return arr.astype(np.float32)
+    return (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+            + 0.114 * arr[..., 2]).astype(np.float32)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr, back = _to_np(img)
+    gray = _rgb_to_gray(arr)[..., None]
+    gray = np.broadcast_to(gray, arr.shape)
+    return back(_finish(arr, _blend(arr, gray, saturation_factor)))
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns; reference:
+    functional.py adjust_hue)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor is not in [-0.5, 0.5].")
+    arr, back = _to_np(img)
+    if arr.ndim == 2 or arr.shape[-1] == 1:
+        return back(arr)
+    x = arr.astype(np.float32) / (255.0 if arr.dtype == np.uint8 else 1.0)
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = x[..., :3].max(-1)
+    minc = x[..., :3].min(-1)
+    v = maxc
+    c = maxc - minc
+    s = np.where(maxc > 0, c / np.maximum(maxc, 1e-12), 0.0)
+    safe_c = np.maximum(c, 1e-12)
+    hr = ((g - b) / safe_c) % 6.0
+    hg = (b - r) / safe_c + 2.0
+    hb = (r - g) / safe_c + 4.0
+    hue = np.where(maxc == r, hr, np.where(maxc == g, hg, hb))
+    hue = np.where(c > 0, hue / 6.0, 0.0)
+    hue = (hue + hue_factor) % 1.0
+    # hsv → rgb
+    i = np.floor(hue * 6.0)
+    f = hue * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(np.int64) % 6
+    rgb = np.choose(i[..., None] * 0 + np.arange(3)[None, None, :] * 0
+                    + i[..., None],
+                    [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+                     np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+                     np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    if arr.dtype == np.uint8:
+        rgb = np.clip(rgb * 255.0, 0, 255).astype(np.uint8)
+    else:
+        rgb = rgb.astype(arr.dtype)
+    return back(rgb)
+
+
+def _affine_inv_matrix(center, angle, translate, scale, shear):
+    """Inverse of the affine map used by the reference (rotation about
+    center + translate + scale + shear)."""
+    # positive angle = counter-clockwise (PIL/reference convention);
+    # image coords have y down, so negate for the matrix
+    rot = math.radians(-angle)
+    sx, sy = [math.radians(s) for s in shear]
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center) R S Sh T(-center) + translate
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    m = np.array([[a * scale, b * scale,
+                   cx + tx - (a * scale * cx + b * scale * cy)],
+                  [c * scale, d * scale,
+                   cy + ty - (c * scale * cx + d * scale * cy)],
+                  [0, 0, 1.0]])
+    return np.linalg.inv(m)
+
+
+def affine(img, angle, translate=(0, 0), scale=1.0, shear=(0, 0),
+           interpolation="nearest", fill=0, center=None):
+    arr, back = _to_np(img)
+    h, w = arr.shape[:2]
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    inv = _affine_inv_matrix(center, angle, translate, scale, shear)
+    return back(_warp(arr, inv, (h, w), interpolation, fill))
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr, back = _to_np(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    out_hw = (h, w)
+    offset = np.eye(3)
+    if expand:
+        rot = math.radians(angle)
+        cosn, sinn = abs(math.cos(rot)), abs(math.sin(rot))
+        nw = int(math.ceil(w * cosn + h * sinn))
+        nh = int(math.ceil(w * sinn + h * cosn))
+        offset[0, 2] = (nw - w) / 2.0
+        offset[1, 2] = (nh - h) / 2.0
+        out_hw = (nh, nw)
+    inv = _affine_inv_matrix(center, angle, (0, 0), 1.0, (0, 0))
+    inv = inv @ np.linalg.inv(offset)
+    return back(_warp(arr, inv, out_hw, interpolation, fill))
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Solve the 8-dof homography endpoints → startpoints."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b += [sx, sy]
+    coeffs = np.linalg.solve(np.asarray(a, np.float64),
+                             np.asarray(b, np.float64))
+    return np.concatenate([coeffs, [1.0]]).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    arr, back = _to_np(img)
+    inv = _perspective_coeffs(startpoints, endpoints)
+    return back(_warp(arr, inv, arr.shape[:2], interpolation, fill))
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr, back = _to_np(img)
+    gray = _rgb_to_gray(arr)
+    if arr.dtype == np.uint8:
+        gray = np.clip(gray, 0, 255).astype(np.uint8)
+    out = np.repeat(gray[..., None], num_output_channels, -1) \
+        if num_output_channels > 1 else gray[..., None]
+    return back(out.astype(arr.dtype))
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """reference: functional.py erase — fill region [i:i+h, j:j+w] with v
+    (CHW Tensor/array convention like the reference)."""
+    if isinstance(img, Tensor):
+        arr = np.asarray(img._data_).copy()
+        arr[..., i:i + h, j:j + w] = np.asarray(v)
+        return Tensor(arr)
+    arr, back = _to_np(img)
+    if not inplace:
+        arr = arr.copy()
+    arr[i:i + h, j:j + w] = np.asarray(v)
+    return back(arr)
+
+
+# ------------------------------------------------------------------
+# class API
+# ------------------------------------------------------------------
+
+class BaseTransform:
+    """reference: transforms.py BaseTransform — keys route the transform
+    over (image, ...) tuples."""
+
+    def __init__(self, keys=None):
+        self.keys = keys if keys is not None else ("image",)
+        self.params = None
+
+    def _get_params(self, inputs):
+        return None
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            self.params = self._get_params(inputs)
+            outs = []
+            for key, data in zip(self.keys, inputs):
+                apply = getattr(self, f"_apply_{key}", None)
+                outs.append(apply(data) if apply is not None else data)
+            return tuple(outs)
+        self.params = self._get_params((inputs,))
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
 
 
 class Compose:
@@ -18,91 +405,354 @@ class Compose:
         return x
 
 
-class ToTensor:
+class ToTensor(BaseTransform):
     """HWC uint8 [0,255] → CHW float32 [0,1]."""
 
-    def __init__(self, data_format="CHW"):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
         self.data_format = data_format
 
-    def __call__(self, img):
-        arr = np.asarray(img)
-        if arr.dtype == np.uint8:
-            arr = arr.astype(np.float32) / 255.0
+    def _apply_image(self, img):
+        t = to_tensor(img, self.data_format)
+        return np.asarray(t._data_)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        out = normalize(img, self.mean, self.std, self.data_format)
+        return np.asarray(out._data_) if isinstance(out, Tensor) else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class Transpose(BaseTransform):
+    """HWC → CHW (reference: transforms.py Transpose)."""
+
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        arr, _ = _to_np(img)
         if arr.ndim == 2:
             arr = arr[:, :, None]
-        if self.data_format == "CHW":
-            arr = arr.transpose(2, 0, 1)
-        return arr.astype(np.float32)
+        return arr.transpose(self.order)
 
 
-class Normalize:
-    def __init__(self, mean=0.0, std=1.0, data_format="CHW",
-                 to_rgb=False):
-        self.mean = np.asarray(mean, np.float32)
-        self.std = np.asarray(std, np.float32)
-        self.data_format = data_format
-
-    def __call__(self, img):
-        arr = np.asarray(img, np.float32)
-        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
-        return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
-
-
-class Resize:
-    """Nearest-neighbor resize (PIL-free)."""
-
-    def __init__(self, size, interpolation="nearest"):
-        self.size = (size, size) if isinstance(size, int) else tuple(size)
-
-    def __call__(self, img):
-        arr = np.asarray(img)
-        hw_first = arr.ndim == 2 or arr.shape[-1] <= 4
-        h, w = (arr.shape[0], arr.shape[1]) if hw_first else arr.shape[-2:]
-        th, tw = self.size
-        yi = (np.arange(th) * h / th).astype(np.int64).clip(0, h - 1)
-        xi = (np.arange(tw) * w / tw).astype(np.int64).clip(0, w - 1)
-        if hw_first:
-            return arr[yi][:, xi]
-        return arr[..., yi, :][..., xi]
-
-
-class CenterCrop:
-    def __init__(self, size):
-        self.size = (size, size) if isinstance(size, int) else tuple(size)
-
-    def __call__(self, img):
-        arr = np.asarray(img)
-        h, w = arr.shape[0], arr.shape[1]
-        th, tw = self.size
-        y = max((h - th) // 2, 0)
-        x = max((w - tw) // 2, 0)
-        return arr[y:y + th, x:x + tw]
-
-
-class RandomHorizontalFlip:
-    def __init__(self, prob=0.5):
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
         self.prob = prob
 
-    def __call__(self, img):
+    def _apply_image(self, img):
         if np.random.rand() < self.prob:
-            return np.asarray(img)[:, ::-1].copy()
-        return np.asarray(img)
+            return hflip(img)
+        arr, back = _to_np(img)
+        return back(arr)
 
 
-class RandomCrop:
-    def __init__(self, size, padding=0):
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        arr, back = _to_np(img)
+        return back(arr)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
         self.size = (size, size) if isinstance(size, int) else tuple(size)
         self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
 
-    def __call__(self, img):
-        arr = np.asarray(img)
+    def _apply_image(self, img):
         if self.padding:
-            pad = [(self.padding, self.padding),
-                   (self.padding, self.padding)] + \
-                  [(0, 0)] * (arr.ndim - 2)
-            arr = np.pad(arr, pad)
-        h, w = arr.shape[0], arr.shape[1]
+            img = pad(img, self.padding, self.fill, self.padding_mode)
+        arr, back = _to_np(img)
         th, tw = self.size
+        h, w = arr.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            img = pad(img, (0, max(th - h, 0), 0, max(tw - w, 0)),
+                      self.fill, self.padding_mode)
+            arr, back = _to_np(img)
+            h, w = arr.shape[:2]
         y = np.random.randint(0, h - th + 1)
         x = np.random.randint(0, w - tw + 1)
-        return arr[y:y + th, x:x + tw]
+        return back(arr[y:y + th, x:x + tw])
+
+
+class RandomResizedCrop(BaseTransform):
+    """Random area/aspect crop then resize (reference: transforms.py
+    RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr, back = _to_np(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            log_r = (math.log(self.ratio[0]), math.log(self.ratio[1]))
+            aspect = math.exp(np.random.uniform(*log_r))
+            cw = int(round(math.sqrt(target * aspect)))
+            ch = int(round(math.sqrt(target / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                y = np.random.randint(0, h - ch + 1)
+                x = np.random.randint(0, w - cw + 1)
+                return resize(back(arr[y:y + ch, x:x + cw]), self.size,
+                              self.interpolation)
+        return resize(center_crop(back(arr), min(h, w)), self.size,
+                      self.interpolation)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, f)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i](img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr, _ = _to_np(img)
+        h, w = arr.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = int(round(np.random.uniform(-self.translate[0],
+                                             self.translate[0]) * w))
+            ty = int(round(np.random.uniform(-self.translate[1],
+                                             self.translate[1]) * h))
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear
+            if isinstance(s, numbers.Number):
+                s = (-s, s)
+            sh = (np.random.uniform(s[0], s[1]), 0.0) if len(s) == 2 \
+                else (np.random.uniform(s[0], s[1]),
+                      np.random.uniform(s[2], s[3]))
+        return affine(img, angle, (tx, ty), sc, sh, self.interpolation,
+                      self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr, _ = _to_np(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = int(h * d / 2), int(w * d / 2)
+        tl = (np.random.randint(0, half_w + 1),
+              np.random.randint(0, half_h + 1))
+        tr = (w - 1 - np.random.randint(0, half_w + 1),
+              np.random.randint(0, half_h + 1))
+        br = (w - 1 - np.random.randint(0, half_w + 1),
+              h - 1 - np.random.randint(0, half_h + 1))
+        bl = (np.random.randint(0, half_w + 1),
+              h - 1 - np.random.randint(0, half_h + 1))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return perspective(img, start, [tl, tr, br, bl],
+                           self.interpolation, self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomErasing(BaseTransform):
+    """reference: transforms.py RandomErasing — operates on CHW
+    tensors/arrays (applied after ToTensor)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img._data_ if isinstance(img, Tensor) else img)
+        c, h, w = (arr.shape if arr.ndim == 3 else (1,) + arr.shape)
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            log_r = (math.log(self.ratio[0]), math.log(self.ratio[1]))
+            aspect = math.exp(np.random.uniform(*log_r))
+            eh = int(round(math.sqrt(target * aspect)))
+            ew = int(round(math.sqrt(target / aspect)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                if self.value == "random":
+                    v = np.random.standard_normal(
+                        (c, eh, ew)).astype(np.float32)
+                else:
+                    v = np.asarray(self.value, np.float32)
+                return erase(img, i, j, eh, ew, v, self.inplace)
+        return img

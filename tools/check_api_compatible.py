@@ -23,19 +23,77 @@ SPEC_PATH = os.path.join(os.path.dirname(__file__), "api_spec.json")
 # the public modules whose surfaces are contract
 MODULES = [
     "paddle_tpu",
-    "paddle_tpu.nn",
-    "paddle_tpu.nn.functional",
-    "paddle_tpu.optimizer",
-    "paddle_tpu.distributed",
-    "paddle_tpu.distribution",
-    "paddle_tpu.geometric",
-    "paddle_tpu.sparse",
     "paddle_tpu.amp",
+    "paddle_tpu.audio",
+    "paddle_tpu.audio.features",
+    "paddle_tpu.audio.functional",
+    "paddle_tpu.autograd",
+    "paddle_tpu.device",
+    "paddle_tpu.distributed",
+    "paddle_tpu.distributed.fleet",
+    "paddle_tpu.distribution",
+    "paddle_tpu.distribution.transform",
+    "paddle_tpu.fft",
+    "paddle_tpu.geometric",
+    "paddle_tpu.hub",
+    "paddle_tpu.incubate",
+    "paddle_tpu.incubate.nn",
+    "paddle_tpu.incubate.nn.functional",
+    "paddle_tpu.incubate.optimizer",
+    "paddle_tpu.inference",
     "paddle_tpu.io",
     "paddle_tpu.jit",
+    "paddle_tpu.linalg",
+    "paddle_tpu.metric",
+    "paddle_tpu.nn",
+    "paddle_tpu.nn.functional",
+    "paddle_tpu.nn.initializer",
+    "paddle_tpu.nn.utils",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.optimizer.lr",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.signal",
+    "paddle_tpu.sparse",
     "paddle_tpu.static",
+    "paddle_tpu.static.nn",
+    "paddle_tpu.text",
     "paddle_tpu.vision",
+    "paddle_tpu.vision.datasets",
+    "paddle_tpu.vision.models",
+    "paddle_tpu.vision.ops",
+    "paddle_tpu.vision.transforms",
 ]
+
+# reference tree for __all__ parity (parsed with ast — never imported)
+REFERENCE_ROOT = "/root/reference/python/paddle"
+
+
+def _reference_all(modname):
+    """Parse the reference counterpart's __all__ (None when the module or
+    its __all__ doesn't exist — no contract)."""
+    import ast
+    rel = modname.replace("paddle_tpu", "").strip(".").replace(".", "/")
+    for cand in (os.path.join(REFERENCE_ROOT, rel, "__init__.py"),
+                 os.path.join(REFERENCE_ROOT, rel + ".py"),
+                 os.path.join(REFERENCE_ROOT, "__init__.py") if not rel
+                 else ""):
+        if cand and os.path.exists(cand):
+            try:
+                tree = ast.parse(open(cand).read())
+            except SyntaxError:
+                return None
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign) and any(
+                        getattr(t, "id", "") == "__all__"
+                        for t in node.targets):
+                    try:
+                        return sorted(
+                            e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant))
+                    except AttributeError:
+                        return None
+            return None
+    return None
 
 
 def _sig_of(obj):
@@ -65,6 +123,25 @@ def snapshot():
     return spec
 
 
+def reference_parity():
+    """Every name in each reference module's __all__ must resolve on the
+    corresponding paddle_tpu module (the single source of truth for the
+    parity assertions formerly scattered across test files)."""
+    problems = []
+    checked = 0
+    for modname in MODULES:
+        ref_all = _reference_all(modname)
+        if not ref_all:
+            continue
+        mod = importlib.import_module(modname)
+        for name in ref_all:
+            checked += 1
+            if not hasattr(mod, name):
+                problems.append(f"{modname}.{name}: MISSING "
+                                f"(in reference __all__)")
+    return checked, problems
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true")
@@ -90,14 +167,18 @@ def main():
                 problems.append(
                     f"{modname}.{name}: signature changed "
                     f"{meta['sig']} -> {cur[name]['sig']}")
+    ref_checked, ref_problems = reference_parity()
+    problems += ref_problems
     if problems:
         print("API compatibility check FAILED:")
         for p in problems:
             print(" ", p)
-        print("(intentional? re-record with --update)")
+        print("(intentional removal/signature change? re-record with "
+              "--update; reference-parity MISSING entries must be fixed)")
         return 1
     n = sum(len(v) for v in recorded.values())
-    print(f"API compatibility check passed ({n} symbols)")
+    print(f"API compatibility check passed ({n} symbols recorded, "
+          f"{ref_checked} reference-__all__ names verified)")
     return 0
 
 
