@@ -334,3 +334,186 @@ class PSEmbedding:
             ids._data_ if isinstance(ids, Tensor) else ids)) + (self.dim,)
         from ...tensor_ops import manipulation
         return manipulation.reshape(emb, shape), emb
+
+
+# ------------------------------------------------------------------
+# multi-server sharding + async communicator (reference:
+# distributed/ps/service/communicator/ async communicator + sharded
+# brpc tables; this is the capability — id-hash sharding across servers,
+# pulls fanned out in parallel, pushes drained by a background thread
+# that overlaps device compute)
+# ------------------------------------------------------------------
+
+class ShardedPSClient:
+    """Client over N servers: sparse rows shard by id % N (reference:
+    sparse tables sharded by feasign across PServer instances), dense
+    tables route by table_id % N.  Per-shard requests run in parallel
+    threads — pull latency is max-of-shards, not sum."""
+
+    def __init__(self, addresses):
+        self._clients = [PSClient(a) for a in addresses]
+        self._n = len(self._clients)
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(max_workers=max(2, self._n))
+
+    @property
+    def num_shards(self):
+        return self._n
+
+    def shard_of(self, id_):
+        return int(id_) % self._n
+
+    def pull_dense(self, table_id):
+        return self._clients[table_id % self._n].pull_dense(table_id)
+
+    def push_dense(self, table_id, grad):
+        self._clients[table_id % self._n].push_dense(table_id, grad)
+
+    def _partition(self, ids):
+        buckets = [[] for _ in range(self._n)]
+        pos = [[] for _ in range(self._n)]
+        for i, id_ in enumerate(ids):
+            s = int(id_) % self._n
+            buckets[s].append(int(id_))
+            pos[s].append(i)
+        return buckets, pos
+
+    def pull_sparse(self, table_id, ids):
+        buckets, pos = self._partition(ids)
+        futs = [self._pool.submit(self._clients[s].pull_sparse, table_id,
+                                  buckets[s])
+                for s in range(self._n) if buckets[s]]
+        shards = [s for s in range(self._n) if buckets[s]]
+        out = [None] * len(ids)
+        for s, f in zip(shards, futs):
+            rows = f.result()
+            for p, row in zip(pos[s], rows):
+                out[p] = row
+        return np.asarray(out, np.float32)
+
+    def push_sparse(self, table_id, ids, grad):
+        grad = np.asarray(grad, np.float32)
+        buckets, pos = self._partition(ids)
+        futs = []
+        for s in range(self._n):
+            if buckets[s]:
+                futs.append(self._pool.submit(
+                    self._clients[s].push_sparse, table_id, buckets[s],
+                    grad[pos[s]]))
+        for f in futs:
+            f.result()
+
+    def save(self):
+        return [c.save() for c in self._clients]
+
+    def stop_server(self):
+        for c in self._clients:
+            c.stop_server()
+
+    def close(self):
+        for c in self._clients:
+            c.close()
+        self._pool.shutdown(wait=False)
+
+
+class Communicator:
+    """Async push channel (reference: ps/service/communicator/
+    communicator.h AsyncCommunicator): gradient pushes enqueue and a
+    background thread drains them, overlapping the device's next
+    forward/backward; flush() (reference barrier/pull_dense sync point)
+    blocks until the queue is empty so the next pull sees every update."""
+
+    def __init__(self, client, send_queue_size=128):
+        import queue
+        self._client = client
+        self._q = queue.Queue(maxsize=send_queue_size)
+        self._exc = None
+        self._running = True
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            kind, args = item
+            try:
+                if kind == "sparse":
+                    self._client.push_sparse(*args)
+                else:
+                    self._client.push_dense(*args)
+            except Exception as e:  # surfaced at the next flush()
+                self._exc = e
+            finally:
+                self._q.task_done()
+
+    def push_sparse_async(self, table_id, ids, grad):
+        self._q.put(("sparse", (table_id, list(ids),
+                                np.asarray(grad, np.float32))))
+
+    def push_dense_async(self, table_id, grad):
+        self._q.put(("dense", (table_id, np.asarray(grad, np.float32))))
+
+    def flush(self):
+        """Barrier: wait until every enqueued push is applied."""
+        self._q.join()
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    def stop(self):
+        if self._running:
+            self._running = False
+            self._q.put(None)
+            self._thread.join(timeout=5)
+
+
+class AsyncPSEmbedding(PSEmbedding):
+    """PSEmbedding whose gradient pushes ride the Communicator (async)
+    and whose next batch's rows can be prefetched while the device works
+    on the current one (reference: communicator geo/async modes +
+    prefetch in distributed lookup tables)."""
+
+    def __init__(self, client, table_id, dim, communicator=None):
+        super().__init__(client, table_id, dim)
+        self.comm = communicator or Communicator(client)
+        from concurrent.futures import ThreadPoolExecutor
+        self._prefetch_pool = ThreadPoolExecutor(max_workers=1)
+        self._prefetched = {}
+
+    def prefetch(self, ids):
+        """Start pulling `ids` on a background thread; the matching
+        __call__ consumes the future instead of a blocking pull."""
+        from ...core.tensor import Tensor
+        ids_np = np.asarray(
+            ids._data_ if isinstance(ids, Tensor) else ids).reshape(-1)
+        key = ids_np.tobytes()
+        self._prefetched[key] = self._prefetch_pool.submit(
+            self.client.pull_sparse, self.table_id, ids_np.tolist())
+
+    def __call__(self, ids):
+        from ...core.tensor import Tensor
+        ids_np = np.asarray(
+            ids._data_ if isinstance(ids, Tensor) else ids).reshape(-1)
+        key = ids_np.tobytes()
+        fut = self._prefetched.pop(key, None)
+        if fut is not None:
+            rows = fut.result()
+        else:
+            rows = self.client.pull_sparse(self.table_id, ids_np.tolist())
+        emb = Tensor(jnp.asarray(rows), stop_gradient=False)
+        comm, table_id = self.comm, self.table_id
+        id_list = ids_np.tolist()
+
+        def push_hook(grad):
+            comm.push_sparse_async(table_id, id_list,
+                                   np.asarray(grad._data_))
+            return grad
+
+        emb.register_hook(push_hook)
+        shape = tuple(np.shape(
+            ids._data_ if isinstance(ids, Tensor) else ids)) + (self.dim,)
+        from ...tensor_ops import manipulation
+        return manipulation.reshape(emb, list(shape))
