@@ -292,6 +292,21 @@ class StaticFunction:
         return sum(len(s.entries) for s in self._cache.values()
                    if isinstance(s, _SigState))
 
+    def compiled_hlo(self, *args, **kwargs):
+        """Optimized (post-XLA) HLO text of the compiled entry matching
+        these args — the input to the communication-budget analyzer
+        (profiler/comm_budget.py).  None if not yet compiled."""
+        state = self._cache.get(self._canon_key(args, kwargs))
+        entry = state.last if state is not None else None
+        if entry is None or entry.jitted is None:
+            return None
+        arg_arrays, arg_struct = _flatten_args(args, kwargs)
+        cap_arrays = [t._data_ for t in entry.captures]
+        host_vals = [p() for p in entry.providers]
+        lowered = entry.jitted.lower(arg_arrays, cap_arrays, host_vals,
+                                     arg_struct)
+        return lowered.compile().as_text()
+
     def hlo_fingerprint(self, *args, **kwargs):
         """sha256 (first 16 hex) of the StableHLO of the compiled entry
         matching these args — the auditable program identity a benchmark

@@ -1,0 +1,178 @@
+"""Per-axis communication budget from compiled HLO.
+
+Reference capability: the reference's cost-model-driven distributed
+passes estimate per-collective communication volume when choosing a
+parallel plan (auto_parallel cost model).  Here the budget is extracted
+from the ACTUAL compiled program: parse the optimized HLO for collective
+ops (all-reduce, all-gather, reduce-scatter, collective-permute,
+all-to-all), attribute each to a mesh axis by matching its
+replica_groups against the axis's device groups, and project step
+communication time with the roofline in `cost_model.collective_cost` —
+multi-chip performance claims become checkable without multi-chip
+hardware (BASELINE configs 3-5 evidence)."""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+# one HLO instruction: `%name = <shape-or-tuple> op-name(...)`, possibly
+# with `replica_groups={{0,1},{2,3}}` or `source_target_pairs=...` attrs
+_INSTR_RE = re.compile(
+    r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\]\S*)\s+"
+    r"(all-reduce-start|all-reduce|all-gather-start|all-gather|"
+    r"reduce-scatter|collective-permute-start|collective-permute|"
+    r"all-to-all)\(")
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+# iota format: replica_groups=[G,S]<=[d0,d1,...](T(perm))?
+_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+
+
+def _shape_bytes(shape_text):
+    """Total bytes of every array in `shape_text` (tuple or single)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _parse_groups(text):
+    return [tuple(sorted(int(v) for v in grp.split(",") if v.strip()))
+            for grp in re.findall(r"\{([^}]*)\}", text)]
+
+
+def _parse_iota_groups(g, s, dims, perm):
+    """iota replica-group list: reshape(iota(prod(dims)), dims),
+    transpose(perm), reshape([g, s]) — rows are the groups."""
+    ids = np.arange(int(np.prod(dims))).reshape(dims)
+    if perm:
+        ids = ids.transpose(perm)
+    return [tuple(sorted(int(v) for v in row))
+            for row in ids.reshape(int(g), int(s))]
+
+
+def mesh_axis_groups(mesh):
+    """axis name -> canonical set of device-id groups that vary only that
+    axis (what a collective over that axis uses as replica_groups)."""
+    jm = getattr(mesh, "jax_mesh", None) or getattr(mesh, "_mesh", mesh)
+    ids = np.vectorize(lambda d: d.id)(np.asarray(jm.devices))
+    axes = list(jm.axis_names)
+    out = {}
+    for i, name in enumerate(axes):
+        moved = np.moveaxis(ids, i, -1).reshape(-1, ids.shape[i])
+        out[name] = frozenset(tuple(sorted(int(v) for v in row))
+                              for row in moved)
+    return out
+
+
+def _attribute_axis(groups, axis_groups):
+    """Match a collective's replica groups to one mesh axis (or a fused
+    combination when the group spans several axes)."""
+    gset = frozenset(groups)
+    for name, ag in axis_groups.items():
+        if gset == ag:
+            return name
+    # fused axes (e.g. dp×sharding grad reduce): the group size tells us
+    # which product of axis extents it spans — report the matching subset
+    if groups:
+        size = len(groups[0])
+        names = [n for n, ag in axis_groups.items()
+                 if next(iter(ag)) and len(next(iter(ag))) > 1]
+        for n1 in names:
+            for n2 in names:
+                if n1 < n2:
+                    s1 = len(next(iter(axis_groups[n1])))
+                    s2 = len(next(iter(axis_groups[n2])))
+                    if s1 * s2 == size:
+                        return f"{n1}+{n2}"
+    return "other"
+
+
+def collective_budget(compiled_hlo_text, mesh=None):
+    """Parse optimized HLO → list of collective records
+    {op, bytes, groups, n_devices, axis} (one per instruction)."""
+    axis_groups = mesh_axis_groups(mesh) if mesh is not None else {}
+    records = []
+    for line in compiled_hlo_text.splitlines():
+        m = _INSTR_RE.search(line)
+        if m is None:
+            continue
+        shape_text, op = m.group(1), m.group(2)
+        op = op.replace("-start", "")
+        nbytes = _shape_bytes(shape_text)
+        gm = _GROUPS_RE.search(line)
+        im = _IOTA_RE.search(line)
+        pm = _PAIRS_RE.search(line)
+        if gm:
+            groups = _parse_groups(gm.group(1))
+        elif im:
+            dims = [int(v) for v in im.group(3).split(",")]
+            perm = ([int(v) for v in im.group(4).split(",")]
+                    if im.group(4) else None)
+            groups = _parse_iota_groups(im.group(1), im.group(2), dims,
+                                        perm)
+        elif pm:
+            pairs = _parse_groups(pm.group(1))
+            # a permute ring: treat the connected ranks as one group
+            groups = [tuple(sorted({r for p in pairs for r in p}))]
+        else:
+            groups = []
+        n_dev = len(groups[0]) if groups else 1
+        records.append({
+            "op": op,
+            "bytes": nbytes,
+            "n_devices": n_dev,
+            "groups": len(groups),
+            "axis": _attribute_axis(groups, axis_groups)
+            if axis_groups else "?",
+        })
+    return records
+
+
+def budget_report(compiled_hlo_text, mesh, device="v5e",
+                  steps_per_second=None):
+    """Aggregate per (axis, op): total bytes/step + roofline-projected
+    time from cost_model.collective_cost."""
+    from ..cost_model import collective_cost
+
+    records = collective_budget(compiled_hlo_text, mesh)
+    agg = {}
+    for r in records:
+        key = (r["axis"], r["op"])
+        a = agg.setdefault(key, {"axis": r["axis"], "op": r["op"],
+                                 "count": 0, "bytes": 0,
+                                 "n_devices": r["n_devices"]})
+        a["count"] += 1
+        a["bytes"] += r["bytes"]
+    rows = []
+    total_time = 0.0
+    for a in sorted(agg.values(), key=lambda x: -x["bytes"]):
+        kind = a["op"].replace("-", "_")
+        if kind == "collective_permute":
+            kind = "p2p"
+        t = collective_cost(a["bytes"], max(a["n_devices"], 2),
+                            kind=kind, device=device)
+        a["projected_seconds"] = t
+        total_time += t
+        rows.append(a)
+    return {"collectives": rows,
+            "projected_comm_seconds_per_step": total_time,
+            "n_instructions": len(records)}

@@ -1,0 +1,113 @@
+"""Communication-budget analyzer: per-axis collective bytes from compiled
+HLO + roofline cross-check against the cost model (VERDICT r2 item 7 —
+multi-chip performance evidence without multi-chip hardware)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.profiler.comm_budget import (
+    _parse_iota_groups, budget_report, collective_budget,
+    mesh_axis_groups,
+)
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    dist.set_mesh(None)
+
+
+def test_iota_group_parsing():
+    # [4,2]<=[8]: rows of reshape(iota(8), (4,2))
+    assert _parse_iota_groups(4, 2, [8], None) == \
+        [(0, 1), (2, 3), (4, 5), (6, 7)]
+    # [2,4]<=[4,2]T(1,0): transpose first -> dp-style groups
+    assert _parse_iota_groups(2, 4, [4, 2], [1, 0]) == \
+        [(0, 2, 4, 6), (1, 3, 5, 7)]
+
+
+def _tp_step():
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": -1, "mp_degree": 2}
+    fleet.init(is_collective=True, strategy=s)
+    from paddle_tpu.models import ParallelLlamaForCausalLM, llama_config
+    paddle.seed(0)
+    m = ParallelLlamaForCausalLM(llama_config("tiny"))
+    fleet.distributed_model(m)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=m.parameters())
+    ids = paddle.to_tensor(np.random.default_rng(0).integers(
+        0, 512, (8, 64)).astype("int32"))
+
+    @paddle.jit.to_static
+    def step():
+        _, loss = m(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step()
+    step()
+    return step, m
+
+
+def test_tp_model_budget_axes_and_roofline():
+    step, model = _tp_step()
+    hlo = step.compiled_hlo()
+    assert hlo is not None
+    mesh = dist.get_mesh()
+    report = budget_report(hlo, mesh, device="v5e")
+    by_axis = {(r["axis"], r["op"]): r for r in report["collectives"]}
+    # TP activations reduce over mp; gradients over dp
+    assert ("mp", "all-reduce") in by_axis, by_axis.keys()
+    assert ("dp", "all-reduce") in by_axis, by_axis.keys()
+    assert all(r["bytes"] > 0 for r in report["collectives"])
+
+    # dp gradient all-reduce volume ~= per-rank PARAM SHARD bytes (fp32)
+    # — TP-split weights reduce only their local shard over dp; the
+    # budget numbers are physical, not symbolic
+    n_param_bytes = sum(
+        int(np.prod(p._data_.sharding.shard_shape(tuple(p.shape)))) * 4
+        for p in model.parameters())
+    dp_bytes = by_axis[("dp", "all-reduce")]["bytes"]
+    assert 0.8 * n_param_bytes <= dp_bytes <= 1.5 * n_param_bytes, (
+        dp_bytes, n_param_bytes)
+
+    # roofline cross-check: every projected time equals the cost model's
+    from paddle_tpu.cost_model import collective_cost
+    total = 0.0
+    for r in report["collectives"]:
+        kind = r["op"].replace("-", "_")
+        if kind == "collective_permute":
+            kind = "p2p"
+        expect = collective_cost(r["bytes"], max(r["n_devices"], 2),
+                                 kind=kind, device="v5e")
+        assert r["projected_seconds"] == pytest.approx(expect)
+        total += expect
+    assert report["projected_comm_seconds_per_step"] == \
+        pytest.approx(total)
+
+
+def test_axis_groups_match_mesh_layout():
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": -1, "mp_degree": 2}
+    fleet.init(is_collective=True, strategy=s)
+    mesh = dist.get_mesh()
+    ag = mesh_axis_groups(mesh)
+    # mp pairs are adjacent device ids (innermost axis)
+    assert (0, 1) in ag["mp"]
+    # dp groups stride over the mp extent
+    assert any(0 in g and len(g) == mesh.get_dim_size("dp")
+               for g in ag["dp"])
+
+
+def test_collective_budget_parses_tuple_shapes():
+    hlo = ('%all-reduce.42 = (f32[128,1]{1,0}, f32[128]{0}) '
+           'all-reduce(%a, %b), channel_id=16, '
+           'replica_groups=[4,2]<=[8], use_global_device_ids=true')
+    recs = collective_budget(hlo)
+    assert len(recs) == 1
+    assert recs[0]["bytes"] == 128 * 4 + 128 * 4
+    assert recs[0]["n_devices"] == 2
