@@ -19,6 +19,7 @@ from ..nn import functional as F
 from ..nn.initializer import Normal, Constant
 from ..nn.initializer import ParamAttr
 from ..tensor_ops import manipulation as MA
+from ..tensor_ops import linalg as LA
 from ..tensor_ops import creation
 
 
@@ -88,9 +89,16 @@ class GPTAttention(Layer):
             out, cache["k"], cache["v"] = IF.masked_multihead_attention(
                 q, k, v, cache["k"], cache["v"], cache["offset"])
         else:
-            out = F.scaled_dot_product_attention(
-                q, k, v, is_causal=True, dropout_p=cfg.attn_dropout,
-                training=self.training)
+            # head-major [B, H, S, D] into the flash kernels: the
+            # relayout fuses into the qkv-projection epilogue instead of
+            # standing as bare transposes around the pallas_call
+            from ..pallas.flash_attention import flash_attention as _fa
+            qh = LA.transpose(q, [0, 2, 1, 3])
+            kh = LA.transpose(k, [0, 2, 1, 3])
+            vh = LA.transpose(v, [0, 2, 1, 3])
+            out = _fa(qh, kh, vh, dropout=cfg.attn_dropout, causal=True,
+                      training=self.training, head_major=True)
+            out = LA.transpose(out, [0, 2, 1, 3])
         out = MA.reshape(out, [b, s, h])
         return self.out_proj(out)
 

@@ -17,6 +17,7 @@ from ..nn import Layer, Linear, Embedding, RMSNorm, LayerList
 from ..nn import functional as F
 from ..nn.initializer import Normal, ParamAttr
 from ..tensor_ops import manipulation as MA
+from ..tensor_ops import linalg as LA
 from ..incubate.nn import functional as IF
 
 
@@ -107,9 +108,15 @@ class LlamaAttention(Layer):
             # K/V stay at num_kv_heads: the flash kernels index the shared
             # kv head natively (q_head // n_rep in the BlockSpecs), so GQA
             # keeps its K/V HBM-traffic win end to end (reference keeps kv
-            # heads distinct in fusion/gpu/masked_multihead_attention.cu)
-            out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
-                                                 training=self.training)
+            # heads distinct in fusion/gpu/masked_multihead_attention.cu).
+            # Head-major layout: the relayout fuses into the projections.
+            from ..pallas.flash_attention import flash_attention as _fa
+            qh = LA.transpose(q, [0, 2, 1, 3])
+            kh = LA.transpose(k, [0, 2, 1, 3])
+            vh = LA.transpose(v, [0, 2, 1, 3])
+            out = _fa(qh, kh, vh, causal=True, training=self.training,
+                      head_major=True)
+            out = LA.transpose(out, [0, 2, 1, 3])
         return self.o_proj(MA.reshape(out, [b, s, h]))
 
 

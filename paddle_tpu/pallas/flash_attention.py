@@ -77,14 +77,17 @@ def _on_tpu():
 # ------------------------------------------------------------------
 
 def _xla_attention(q, k, v, attn_mask=None, causal=False, scale=None,
-                   dropout=0.0, dropout_key=None, segment_ids=None):
+                   dropout=0.0, dropout_key=None, segment_ids=None,
+                   head_major=False):
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    if k.shape[2] != q.shape[2]:  # GQA: broadcast kv heads for the fallback
-        n_rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, n_rep, axis=2)
-        v = jnp.repeat(v, n_rep, axis=2)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    h_axis = 1 if head_major else 2
+    if k.shape[h_axis] != q.shape[h_axis]:   # GQA: broadcast kv heads
+        n_rep = q.shape[h_axis] // k.shape[h_axis]
+        k = jnp.repeat(k, n_rep, axis=h_axis)
+        v = jnp.repeat(v, n_rep, axis=h_axis)
+    eq = "bhqd,bhkd->bhqk" if head_major else "bqhd,bkhd->bhqk"
+    logits = jnp.einsum(eq, q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
         s_q, s_k = logits.shape[-2], logits.shape[-1]
@@ -103,22 +106,32 @@ def _xla_attention(q, k, v, attn_mask=None, causal=False, scale=None,
     if dropout > 0.0 and dropout_key is not None:
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    eq_out = "bhqk,bhkd->bhqd" if head_major else "bhqk,bkhd->bqhd"
+    return jnp.einsum(eq_out, probs.astype(v.dtype), v)
 
 
 # ------------------------------------------------------------------
 # shared kernel helpers
 # ------------------------------------------------------------------
 
-def _to_bh(x):
-    """[B, S, H, D] → [B*H, S, D] (head-major for Mosaic-legal tiling)."""
+def _to_bh(x, head_major=False):
+    """→ [B*H, S, D] (head-major for Mosaic-legal tiling).  From the
+    [B, H, S, D] layout this is a FREE reshape; from [B, S, H, D] it is
+    one XLA transpose each way — models keep attention activations
+    head-major so the relayout fuses into the surrounding projection
+    matmuls instead of standing alone around the pallas_call."""
+    if head_major:
+        b, h, s, d = x.shape
+        return x.reshape(b * h, s, d)
     b, s, h, d = x.shape
     return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
 
-def _from_bh(y, b, h):
-    """[B*H, S, D] → [B, S, H, D]."""
+def _from_bh(y, b, h, head_major=False):
+    """[B*H, S, D] → [B, S, H, D] (or [B, H, S, D] when head_major)."""
     _, s, d = y.shape
+    if head_major:
+        return y.reshape(b, h, s, d)
     return y.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
@@ -311,15 +324,21 @@ def _causal_kv_spec(block_q, block_k, d, q_axis, kv_axis, causal,
 
 
 def _pallas_flash_fwd(q, k, v, mask=None, qseg=None, kseg=None, seed=None,
-                      *, causal, scale, block_q, block_k, dropout=0.0):
-    """q: [B, S, H, D], k/v: [B, S, H_kv, D] → (out [B, S, H, D],
-    lse [B, H, S, 1] fp32).  mask: [B|1, H|1, S, S] additive fp32;
-    qseg/kseg: [B, S, 1]/[B, 1, S] int32; seed: [1,1] uint32."""
+                      *, causal, scale, block_q, block_k, dropout=0.0,
+                      head_major=False):
+    """q: [B, S, H, D] (or [B, H, S, D] when head_major), k/v likewise
+    with H_kv heads → (out in q's layout, lse [B, H, S, 1] fp32).
+    mask: [B|1, H|1, S, S] additive fp32; qseg/kseg: [B, S, 1]/[B, 1, S]
+    int32; seed: [1,1] uint32."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, s, h, d = q.shape
-    h_kv = k.shape[2]
+    if head_major:
+        b, h, s, d = q.shape
+        h_kv = k.shape[1]
+    else:
+        b, s, h, d = q.shape
+        h_kv = k.shape[2]
     n_rep = h // h_kv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -353,8 +372,9 @@ def _pallas_flash_fwd(q, k, v, mask=None, qseg=None, kseg=None, seed=None,
                         pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(_to_bh(q), _to_bh(k), _to_bh(v), *feat_inputs)
-    return _from_bh(out, b, h), lse.reshape(b, h, s, 1)
+    )(_to_bh(q, head_major), _to_bh(k, head_major),
+      _to_bh(v, head_major), *feat_inputs)
+    return _from_bh(out, b, h, head_major), lse.reshape(b, h, s, 1)
 
 
 # ------------------------------------------------------------------
@@ -501,21 +521,26 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _pallas_flash_bwd(q, k, v, out, lse, dout, mask=None, qseg=None,
                       kseg=None, seed=None, *, causal, scale, block_q,
-                      block_k, dropout=0.0):
+                      block_k, dropout=0.0, head_major=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, s, h, d = q.shape
-    h_kv = k.shape[2]
+    if head_major:
+        b, h, s, d = q.shape
+        h_kv = k.shape[1]
+    else:
+        b, s, h, d = q.shape
+        h_kv = k.shape[2]
     n_rep = h // h_kv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     has_mask, has_seg = mask is not None, qseg is not None
     # delta_i = rowsum(dO_i * O_i): cheap elementwise+reduce, XLA fuses it
-    delta = jnp.einsum("bshd,bshd->bhs", dout.astype(jnp.float32),
+    eq = "bhsd,bhsd->bhs" if head_major else "bshd,bshd->bhs"
+    delta = jnp.einsum(eq, dout.astype(jnp.float32),
                        out.astype(jnp.float32)).reshape(b * h, s, 1)
-    q3, do3 = _to_bh(q), _to_bh(dout)
-    k3, v3 = _to_bh(k), _to_bh(v)
+    q3, do3 = _to_bh(q, head_major), _to_bh(dout, head_major)
+    k3, v3 = _to_bh(k, head_major), _to_bh(v, head_major)
     lse3 = lse.reshape(b * h, s, 1)
     num_q = s // block_q
 
@@ -590,37 +615,48 @@ def _pallas_flash_bwd(q, k, v, out, lse, dout, mask=None, qseg=None,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse3, delta, *feat_inputs)
-    return (_from_bh(dq, b, h), _from_bh(dk, b, h_kv),
-            _from_bh(dv, b, h_kv))
+    return (_from_bh(dq, b, h, head_major),
+            _from_bh(dk, b, h_kv, head_major),
+            _from_bh(dv, b, h_kv, head_major))
 
 
 # ------------------------------------------------------------------
 # custom VJP wiring
 # ------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14))
 def _flash_core(q, k, v, mask, qseg, kseg, seed, causal, scale, dropout,
-                block_q, block_k):
+                block_q, block_k, block_q_bwd=None, block_k_bwd=None,
+                head_major=False):
     out, _ = _pallas_flash_fwd(q, k, v, mask, qseg, kseg, seed,
                                causal=causal, scale=scale, dropout=dropout,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               head_major=head_major)
     return out
 
 
 def _flash_fwd_rule(q, k, v, mask, qseg, kseg, seed, causal, scale, dropout,
-                    block_q, block_k):
+                    block_q, block_k, block_q_bwd=None, block_k_bwd=None,
+                    head_major=False):
     out, lse = _pallas_flash_fwd(q, k, v, mask, qseg, kseg, seed,
                                  causal=causal, scale=scale,
                                  dropout=dropout, block_q=block_q,
-                                 block_k=block_k)
+                                 block_k=block_k, head_major=head_major)
     return out, (q, k, v, mask, qseg, kseg, seed, out, lse)
 
 
-def _flash_bwd_rule(causal, scale, dropout, block_q, block_k, res, dout):
+def _flash_bwd_rule(causal, scale, dropout, block_q, block_k,
+                    block_q_bwd, block_k_bwd, head_major, res, dout):
     q, k, v, mask, qseg, kseg, seed, out, lse = res
+    # the dkv/dq kernels prefer different block shapes than the forward
+    # (autotuned separately under flash_attention.bwd)
+    bq = block_q_bwd if block_q_bwd is not None else block_q
+    bk = block_k_bwd if block_k_bwd is not None else block_k
     dq, dk, dv = _pallas_flash_bwd(
         q, k, v, out, lse, dout, mask, qseg, kseg, seed, causal=causal,
-        scale=scale, dropout=dropout, block_q=block_q, block_k=block_k)
+        scale=scale, dropout=dropout, block_q=bq, block_k=bk,
+        head_major=head_major)
     # the mask gradient is NOT computed in-kernel; the public op only
     # routes non-trainable (stop_gradient) masks here — a learned additive
     # bias takes the XLA path, which differentiates it exactly
@@ -635,28 +671,30 @@ def _flash_bwd_rule(causal, scale, dropout, block_q, block_k, res, dout):
 _flash_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def _pick_blocks(s, d):
+def _pick_blocks(s, d, which="fwd"):
     """Block sizes: autotune cache first (validated — a stale non-dividing
     entry would truncate the grid and leave rows unwritten), then shape
-    heuristics."""
+    heuristics.  `which` selects the per-direction cache: the dkv/dq
+    kernels prefer different shapes than the forward, so fwd and bwd are
+    swept and cached separately (falling back to the older joint key)."""
     from .autotune import lookup
-    # key versioned by objective: v1 entries were timed forward-only and
-    # must not short-circuit the fwd+bwd sweep
-    cached = lookup("flash_attention.fwdbwd", (s, d))
-    if cached is not None and len(cached) == 2:
-        bq, bk = int(cached[0]), int(cached[1])
-        if 0 < bq <= s and 0 < bk <= s and s % bq == 0 and s % bk == 0:
-            return bq, bk
+    for key in (f"flash_attention.{which}", "flash_attention.fwdbwd"):
+        cached = lookup(key, (s, d))
+        if cached is not None and len(cached) == 2:
+            bq, bk = int(cached[0]), int(cached[1])
+            if 0 < bq <= s and 0 < bk <= s and s % bq == 0                     and s % bk == 0:
+                return bq, bk
     block_q = 256 if s % 256 == 0 else 128
     block_k = 512 if s % 512 == 0 else block_q
     return min(block_q, s), min(block_k, s)
 
 
 def autotune_blocks(s, d, dtype=jnp.bfloat16, batch=1, heads=1):
-    """Timed sweep over divisor block sizes for (seq, head_dim); caches
-    the winner (reference: phi/kernels/autotune switch_autotune.h).
-    Times forward AND backward together — the training step runs both,
-    and the dkv/dq kernels prefer different shapes than the forward."""
+    """Timed sweeps over divisor block sizes for (seq, head_dim); caches
+    the winners (reference: phi/kernels/autotune switch_autotune.h).
+    Forward and backward are swept SEPARATELY — the dkv/dq kernels
+    prefer different shapes than the forward, and each direction's
+    choice feeds its own cache key."""
     from . import autotune as at
 
     cands = [(bq, bk)
@@ -666,17 +704,27 @@ def autotune_blocks(s, d, dtype=jnp.bfloat16, batch=1, heads=1):
         return _pick_blocks(s, d)
     key = jax.random.PRNGKey(0)
     q = jax.random.normal(key, (batch, s, heads, d), dtype)
+    sc = 1.0 / math.sqrt(d)
 
-    def run(cfg):
-        def fwd(q, k, v):
+    def run_fwd(cfg):
+        out = _flash_core(q, q, q, None, None, None, None, True, sc,
+                          0.0, cfg[0], cfg[1], None, None, False)
+        jax.block_until_ready(out)
+
+    def run_bwd(cfg):
+        # time the whole vjp with the FWD pinned to its chosen blocks;
+        # cfg drives only the backward kernels
+        def f(q_, k_, v_):
             return jnp.sum(_flash_core(
-                q, k, v, None, None, None, None, True,
-                1.0 / math.sqrt(d), 0.0, cfg[0],
-                cfg[1]).astype(jnp.float32))
-        out, grads = jax.value_and_grad(fwd, argnums=(0, 1, 2))(q, q, q)
+                q_, k_, v_, None, None, None, None, True, sc, 0.0,
+                fwd_blocks[0], fwd_blocks[1], cfg[0], cfg[1],
+                False).astype(jnp.float32))
+        grads = jax.grad(f, argnums=(0, 1, 2))(q, q, q)
         jax.block_until_ready(grads)
 
-    return at.sweep("flash_attention.fwdbwd", (s, d), cands, run)
+    fwd_blocks = at.sweep("flash_attention.fwd", (s, d), cands, run_fwd)
+    bwd_blocks = at.sweep("flash_attention.bwd", (s, d), cands, run_bwd)
+    return fwd_blocks, bwd_blocks
 
 
 def _supports_pallas(q, k, v, attn_mask, segment_ids):
@@ -707,7 +755,7 @@ def _supports_pallas(q, k, v, attn_mask, segment_ids):
 
 def flash_attention(query, key, value, attn_mask=None, dropout=0.0,
                     causal=False, training=True, scale=None,
-                    segment_ids=None, name=None):
+                    segment_ids=None, head_major=False, name=None):
     """Public op: Tensor-level flash attention, [B, S, H, D].
 
     K/V may carry fewer heads than Q (GQA) — the Pallas kernels index the
@@ -724,8 +772,20 @@ def flash_attention(query, key, value, attn_mask=None, dropout=0.0,
 
     def fn(q, k, v, m, seg):
         sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-        if _supports_pallas(q, k, v, m, seg) and not mask_trainable:
-            block_q, block_k = _pick_blocks(q.shape[1], q.shape[-1])
+        if head_major:
+            b_, h_, s_, d_ = q.shape
+            shaped_ok = _supports_pallas(
+                jax.ShapeDtypeStruct((b_, s_, h_, d_), q.dtype),
+                jax.ShapeDtypeStruct((b_, s_, k.shape[1], d_), k.dtype),
+                jax.ShapeDtypeStruct((b_, s_, v.shape[1], d_), v.dtype),
+                m, seg)
+        else:
+            shaped_ok = _supports_pallas(q, k, v, m, seg)
+        if shaped_ok and not mask_trainable:
+            seq_len = q.shape[2] if head_major else q.shape[1]
+            block_q, block_k = _pick_blocks(seq_len, q.shape[-1])
+            block_qb, block_kb = _pick_blocks(seq_len, q.shape[-1],
+                                              which="bwd")
             mask_add = None
             if m is not None:
                 mask_add = (jnp.where(m, 0.0, NEG_INF).astype(jnp.float32)
@@ -740,10 +800,11 @@ def flash_attention(query, key, value, attn_mask=None, dropout=0.0,
                     if dropout > 0.0 else None)
             return _flash_core(q, k, v, mask_add, qseg, kseg, seed,
                                causal, sc, float(dropout), block_q,
-                               block_k)
-        return _xla_attention(q, k, v, attn_mask=m, causal=causal, scale=sc,
-                              dropout=dropout, dropout_key=dropout_key,
-                              segment_ids=seg)
+                               block_k, block_qb, block_kb, head_major)
+        return _xla_attention(q, k, v, attn_mask=m, causal=causal,
+                              scale=sc, dropout=dropout,
+                              dropout_key=dropout_key, segment_ids=seg,
+                              head_major=head_major)
 
     mask_t = attn_mask if isinstance(attn_mask, Tensor) else None
     if attn_mask is not None and mask_t is None:

@@ -415,3 +415,54 @@ def test_flash_trainable_mask_gets_gradient():
     (out ** 2).sum().backward()
     assert bias.grad is not None
     assert float(np.abs(np.asarray(bias.grad._data_)).max()) > 0
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_head_major_matches_default_layout(causal):
+    # [B, H, S, D] path (free reshape instead of transposes) must be
+    # numerically identical to the [B, S, H, D] path, fwd and bwd
+    q, k, v = _qkv(b=2, s=256, h=2, d=64, seed=11)
+    sc = 1.0 / np.sqrt(q.shape[-1])
+    qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+
+    out_ref = fa._flash_core(q, k, v, None, None, None, None, causal,
+                             sc, 0.0, 128, 128)
+    out_hm = fa._flash_core(qh, kh, vh, None, None, None, None, causal,
+                            sc, 0.0, 128, 128, None, None, True)
+    np.testing.assert_allclose(np.asarray(jnp.swapaxes(out_hm, 1, 2)),
+                               np.asarray(out_ref), atol=1e-6)
+
+    def f_ref(a, b_, c):
+        return (fa._flash_core(a, b_, c, None, None, None, None, causal,
+                               sc, 0.0, 128, 128) ** 2).sum()
+
+    def f_hm(a, b_, c):
+        return (fa._flash_core(a, b_, c, None, None, None, None, causal,
+                               sc, 0.0, 128, 128, None, None, True)
+                ** 2).sum()
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    g_hm = jax.grad(f_hm, argnums=(0, 1, 2))(qh, kh, vh)
+    for gr, gh in zip(g_ref, g_hm):
+        np.testing.assert_allclose(np.asarray(jnp.swapaxes(gh, 1, 2)),
+                                   np.asarray(gr), atol=1e-5)
+
+
+def test_flash_bwd_blocks_differ_from_fwd():
+    # split fwd/bwd block choices: passing distinct bwd blocks must give
+    # identical numerics (only scheduling differs)
+    q, k, v = _qkv(b=1, s=256, h=2, d=64, seed=12)
+    sc = 1.0 / np.sqrt(q.shape[-1])
+
+    def f(bqb, bkb):
+        def loss(a, b_, c):
+            return (fa._flash_core(a, b_, c, None, None, None, None,
+                                   True, sc, 0.0, 128, 128, bqb, bkb)
+                    ** 2).sum()
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    g_same = f(None, None)
+    g_diff = f(64, 128)
+    for a, b_ in zip(g_same, g_diff):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-5)
