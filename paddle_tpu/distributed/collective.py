@@ -311,10 +311,13 @@ def _pair_group(a, b):
 def send(tensor, dst=0, group=None, sync_op=True):
     """Point-to-point send.  Eager p2p between processes is realized as a
     cached sub-group broadcast (XLA collective-permute in-graph is the fast
-    path — see functional.ppermute)."""
+    path — see functional.ppermute).  The world=1 degenerate path queues
+    per (group, peer) so an unmatched send can't leak into an unrelated
+    recv; `p2p_drained()` asserts the queues are empty."""
     group = group or _get_default_group()
     if group.nranks <= 1:
-        _P2P_BUF.append(_as_array(tensor))
+        _P2P_BUF.setdefault((id(group), dst), []).append(
+            _as_array(tensor))
         return tensor
     return broadcast(tensor, src=_env.get_rank(),
                      group=_pair_group(_env.get_rank(), dst))
@@ -323,14 +326,25 @@ def send(tensor, dst=0, group=None, sync_op=True):
 def recv(tensor, src=0, group=None, sync_op=True):
     group = group or _get_default_group()
     if group.nranks <= 1:
-        if _P2P_BUF:
-            tensor._data_ = _P2P_BUF.pop(0)
+        q = _P2P_BUF.get((id(group), _env.get_rank()))
+        if q:
+            tensor._data_ = q.pop(0)
         return tensor
     return broadcast(tensor, src=src,
                      group=_pair_group(src, _env.get_rank()))
 
 
-_P2P_BUF: list = []
+_P2P_BUF: dict = {}   # (group id, dst rank) -> queued payloads (world=1)
+
+
+def p2p_drained():
+    """True when no world=1 send is waiting for its recv — call between
+    tests/steps to catch unmatched p2p traffic."""
+    return not any(_P2P_BUF.values())
+
+
+def p2p_reset():
+    _P2P_BUF.clear()
 
 
 def barrier(group=None):

@@ -59,3 +59,4 @@ def run_check():
     import jax
     print(f"paddle_tpu is installed successfully! device: "
           f"{jax.devices()[0].platform}")
+from . import download  # noqa: F401,E402

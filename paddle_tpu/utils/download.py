@@ -1,0 +1,79 @@
+"""paddle.utils.download (reference: python/paddle/utils/download.py —
+get_weights_path_from_url over a ~/.cache weights dir).
+
+Zero-egress realization: this environment has no network, so the cache
+directory IS the source of truth — `get_weights_path_from_url` resolves a
+URL to its cache path and returns it when the file is already present
+(placed there by the user/deployment), and raises a clear error instead
+of downloading when it is not.  The cache layout matches the reference
+(`$PADDLE_TPU_HOME/weights/<basename>`), so archives fetched elsewhere
+drop in unchanged."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_WEIGHTS_HOME",
+                   os.path.join(os.environ.get("PADDLE_TPU_HOME",
+                                               "~/.cache/paddle_tpu"),
+                                "weights")))
+
+
+def _md5_ok(path, md5sum):
+    if not md5sum:
+        return True
+    import hashlib
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Resolve `url` to its local cache path (reference:
+    utils/download.py:70).  No network egress: the file must already be
+    in the cache."""
+    path = os.path.join(os.path.expanduser(WEIGHTS_HOME),
+                        os.path.basename(url))
+    if os.path.exists(path):
+        if not _md5_ok(path, md5sum):
+            raise RuntimeError(f"{path} exists but its md5 does not match "
+                               f"{md5sum}; re-place the file")
+        return path
+    raise RuntimeError(
+        f"pretrained weights {os.path.basename(url)!r} not found in the "
+        f"local cache {WEIGHTS_HOME!r} and this environment has no "
+        f"network egress. Download {url} elsewhere and place it at "
+        f"{path} (or set PADDLE_TPU_WEIGHTS_HOME).")
+
+
+get_path_from_url = get_weights_path_from_url
+
+
+def load_pretrained_weights(model, arch):
+    """Load `<WEIGHTS_HOME>/<arch>.pdparams` (or .npz) into `model` —
+    the pretrained=True path of the vision model zoo.  The reference
+    downloads per-arch URLs (e.g. vision/models/squeezenet.py:25
+    model_urls); here the same files are served from the local cache."""
+    home = os.path.expanduser(WEIGHTS_HOME)
+    for ext in (".pdparams", ".npz"):
+        path = os.path.join(home, arch + ext)
+        if os.path.exists(path):
+            if ext == ".npz":
+                import numpy as np
+                data = dict(np.load(path))
+                state = {k: v for k, v in data.items()}
+            else:
+                from .. import load as _load
+                state = _load(path)
+            model.set_state_dict(state)
+            return model
+    raise RuntimeError(
+        f"pretrained=True: no weights for {arch!r} in {home!r} and this "
+        f"environment has no network egress. Export the reference "
+        f"checkpoint to {arch}.pdparams (paddle.save of the state dict) "
+        f"or {arch}.npz and place it there; set PADDLE_TPU_WEIGHTS_HOME "
+        f"to use a different cache.")

@@ -17,3 +17,31 @@ from .extra_models import (  # noqa: F401
     resnext50_64x4d, resnext101_64x4d, resnext152_64x4d,
     wide_resnet50_2, wide_resnet101_2,
 )
+
+
+# pretrained=True story (reference: per-arch model_urls +
+# get_weights_path_from_url, e.g. vision/models/squeezenet.py:25): every
+# lowercase factory is wrapped so pretrained=True loads
+# <WEIGHTS_HOME>/<arch>.pdparams from the local cache — this environment
+# has no egress, so the cache is the source of truth (utils/download.py)
+import functools as _functools
+
+
+def _with_pretrained(fn, arch):
+    @_functools.wraps(fn)
+    def wrapper(pretrained=False, **kwargs):
+        model = fn(pretrained=False, **kwargs)
+        if pretrained:
+            from ...utils.download import load_pretrained_weights
+            load_pretrained_weights(model, arch)
+        return model
+    return wrapper
+
+
+for _name, _fn in list(globals().items()):
+    if (callable(_fn) and _name[:1].islower() and not _name.startswith("_")
+            and "pretrained" in getattr(
+                getattr(_fn, "__wrapped__", _fn), "__code__",
+                type("c", (), {"co_varnames": ()})).co_varnames):
+        globals()[_name] = _with_pretrained(_fn, _name)
+del _functools, _name, _fn

@@ -110,3 +110,20 @@ def test_metrics():
     a = Auc()
     a.update(np.array([0.9, 0.8, 0.3, 0.1]), np.array([1, 1, 0, 0]))
     assert a.accumulate() > 0.9
+
+
+def test_pretrained_weights_local_cache(tmp_path, monkeypatch):
+    # pretrained=True loads <WEIGHTS_HOME>/<arch>.pdparams (zero-egress
+    # cache is the source of truth; VERDICT r2 missing item 6)
+    import paddle_tpu.utils.download as DL
+    monkeypatch.setattr(DL, "WEIGHTS_HOME", str(tmp_path))
+    from paddle_tpu.vision import models as M
+    with pytest.raises(RuntimeError, match="no weights"):
+        M.lenet() if False else M.resnet18(pretrained=True)
+    ref = M.resnet18(num_classes=7)
+    paddle.save(ref.state_dict(), str(tmp_path / "resnet18.pdparams"))
+    m = M.resnet18(pretrained=True, num_classes=7)
+    for (k1, v1), (k2, v2) in zip(sorted(m.state_dict().items()),
+                                  sorted(ref.state_dict().items())):
+        np.testing.assert_allclose(np.asarray(v1._data_),
+                                   np.asarray(v2._data_))
