@@ -100,3 +100,4 @@ def set_code_level(level=100, also_to_stdout=False):
 def set_verbosity(level=0, also_to_stdout=False):
     global _VERBOSITY
     _VERBOSITY = level
+from . import dy2static  # noqa: F401,E402

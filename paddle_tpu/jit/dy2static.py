@@ -1,0 +1,158 @@
+"""paddle.jit.dy2static convert-operator surface (reference:
+python/paddle/jit/dy2static/convert_operators.py — the functions the
+AST/SOT transform rewrites python control flow into).
+
+TPU-native realization: tensor-valued conditions route to the
+control-flow ops in tensor_ops/control.py (one lax.while_loop/lax.cond
+program when gradients are off; tape-recorded guarded python otherwise),
+python-valued conditions run natively — the same dispatch the
+reference's _run_paddle_*/_run_py_* pairs perform."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from ..tensor_ops import control as _control
+
+__all__ = [
+    "convert_while_loop", "convert_ifelse", "convert_logical_and",
+    "convert_logical_or", "convert_logical_not", "convert_len",
+    "convert_shape", "convert_range", "convert_enumerate", "convert_zip",
+    "convert_attr", "indexable", "unpack_by_structure",
+]
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def convert_while_loop(cond, body, getter, setter, return_name_ids=None,
+                       push_pop_names=None):
+    """reference: convert_operators.py convert_while_loop — loop state
+    flows through getter/setter closures."""
+    # the reference's protocol: getter() returns the loop-var tuple,
+    # setter(values) writes them back; cond/body are nullary
+    vars_ = getter()
+    single = not isinstance(vars_, (tuple, list))
+    if single:
+        vars_ = (vars_,)
+    if all(_is_tensor(v) for v in vars_) and vars_:
+        def c(*vs):
+            setter(vs[0] if single else tuple(vs))
+            return cond()
+
+        def b(*vs):
+            setter(vs[0] if single else tuple(vs))
+            body()
+            out = getter()
+            return (out,) if single else tuple(out)
+
+        res = _control.while_loop(c, b, list(vars_))
+        setter(res[0] if single else tuple(res))
+        return getter()
+    # python state: plain while
+    while cond():
+        body()
+    return getter()
+
+
+def convert_ifelse(pred, true_fn, false_fn, get_args, set_args,
+                   return_name_ids=None, push_pop_names=None):
+    """reference: convert_operators.py convert_ifelse."""
+    if _is_tensor(pred):
+        def t():
+            set_args(get_args())
+            return true_fn()
+
+        def f():
+            set_args(get_args())
+            return false_fn()
+        return _control.cond(pred, t, f)
+    return true_fn() if pred else false_fn()
+
+
+def convert_logical_and(x_fn, y_fn):
+    """Short-circuit only when x is a python bool (reference:
+    _run_py_logical_and vs _run_paddle_logical_and)."""
+    x = x_fn()
+    if not _is_tensor(x):
+        return x and y_fn()
+    y = y_fn()
+    if not _is_tensor(y):
+        return y and x
+    from ..tensor_ops.logic import logical_and
+    return logical_and(x, y)
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    if not _is_tensor(x):
+        return x or y_fn()
+    y = y_fn()
+    if not _is_tensor(y):
+        return y or x
+    from ..tensor_ops.logic import logical_or
+    return logical_or(x, y)
+
+
+def convert_logical_not(x):
+    if not _is_tensor(x):
+        return not x
+    from ..tensor_ops.logic import logical_not
+    return logical_not(x)
+
+
+def convert_len(x):
+    if _is_tensor(x):
+        return x.shape[0]
+    return len(x)
+
+
+def convert_shape(x):
+    if _is_tensor(x):
+        return tuple(x.shape)
+    return x.shape
+
+
+def convert_range(*args):
+    args = [int(a.numpy()) if _is_tensor(a) else a for a in args]
+    return range(*args)
+
+
+def convert_enumerate(*args):
+    items = args[0]
+    start = args[1] if len(args) > 1 else 0
+    if _is_tensor(items):
+        items = [items[i] for i in range(items.shape[0])]
+    return enumerate(items, start)
+
+
+def convert_zip(*args):
+    seqs = []
+    for a in args:
+        if _is_tensor(a):
+            seqs.append([a[i] for i in range(a.shape[0])])
+        else:
+            seqs.append(a)
+    return zip(*seqs)
+
+
+def convert_attr(x, attr):
+    if _is_tensor(x) and attr == "size":
+        return x.size
+    return getattr(x, attr)
+
+
+def indexable(x, code=None):
+    if _is_tensor(x):
+        return [x[i] for i in range(x.shape[0])]
+    if hasattr(x, "__len__") and hasattr(x, "__getitem__"):
+        return x
+    return list(x)
+
+
+def unpack_by_structure(target, structure):
+    """reference: convert_operators.py unpack_by_structure."""
+    if structure == 1:
+        return target
+    return [unpack_by_structure(t, s)
+            for t, s in zip(target, structure)] \
+        if isinstance(structure, (list, tuple)) else target

@@ -466,3 +466,57 @@ def test_while_loop_with_grad_still_differentiates():
     acc.backward()
     assert float(acc.numpy()) == 8.0
     assert float(x.grad.numpy()) == 12.0  # d(x^3)/dx = 3x^2
+
+
+def test_dy2static_convert_operators():
+    from paddle_tpu.jit import dy2static as d2s
+
+    # convert_ifelse: tensor pred -> control.cond; python pred -> native
+    x = paddle.to_tensor(np.float32(3.0))
+    with paddle.no_grad():
+        out = d2s.convert_ifelse(x > 0, lambda: x * 2.0, lambda: x - 1.0,
+                                 lambda: (), lambda v: None)
+    assert float(out.numpy()) == 6.0
+    assert d2s.convert_ifelse(False, lambda: 1, lambda: 2,
+                              lambda: (), lambda v: None) == 2
+
+    # convert_while_loop over getter/setter state, tensor condition
+    state = {"i": paddle.to_tensor(np.int32(0)),
+             "acc": paddle.to_tensor(np.float32(1.0))}
+
+    def getter():
+        return (state["i"], state["acc"])
+
+    def setter(vals):
+        state["i"], state["acc"] = vals
+
+    def cond():
+        return state["i"] < 4
+
+    def body():
+        state["acc"] = state["acc"] * 2.0
+        state["i"] = state["i"] + 1
+
+    with paddle.no_grad():
+        d2s.convert_while_loop(cond, body, getter, setter)
+    assert float(state["acc"].numpy()) == 16.0
+
+    # short-circuit logicals: python lhs must NOT evaluate rhs
+    hits = []
+    assert d2s.convert_logical_and(lambda: False,
+                                   lambda: hits.append(1)) is False
+    assert hits == []
+    t = paddle.to_tensor(np.array([True]))
+    f = paddle.to_tensor(np.array([False]))
+    assert not bool(d2s.convert_logical_and(lambda: t, lambda: f).numpy())
+    assert bool(d2s.convert_logical_or(lambda: f, lambda: t).numpy())
+    assert bool(d2s.convert_logical_not(f).numpy())
+
+    # len/shape/range/enumerate/zip/indexable over tensors
+    m = paddle.to_tensor(np.zeros((3, 2), np.float32))
+    assert d2s.convert_len(m) == 3
+    assert d2s.convert_shape(m) == (3, 2)
+    assert list(d2s.convert_range(paddle.to_tensor(np.int32(3)))) == [0, 1, 2]
+    assert [i for i, _ in d2s.convert_enumerate(m)] == [0, 1, 2]
+    assert len(list(d2s.convert_zip(m, m))) == 3
+    assert len(d2s.indexable(m)) == 3
