@@ -65,17 +65,19 @@ def main():
             paddle.jit.InputSpec([None, args.seq], "int32")]
 
     results = {}
-    for label, flash, recompute, train in [
-            ("train+flash", True, False, True),
-            ("train+xla_attn", False, False, True),
-            ("train+flash+remat", True, True, True),
-            ("fwd+flash", True, False, False)]:
+    for label, flash, recompute, train, drop in [
+            ("train+flash", True, False, True, 0.0),
+            ("train+xla_attn", False, False, True, 0.0),
+            ("train+flash+remat", True, True, True, 0.0),
+            ("train+flash+dropout", True, False, True, 0.1),
+            ("fwd+flash", True, False, False, 0.0)]:
         paddle.seed(0)
         with paddle.amp.auto_cast(enable=True, level="O2",
                                   dtype="bfloat16"):
             model = GPTForCausalLM(gpt_config(
                 "gpt2-124m", max_seq_len=args.seq,
-                use_flash_attention=flash, use_recompute=recompute))
+                use_flash_attention=flash, use_recompute=recompute,
+                attn_dropout=drop))
         opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
                                      weight_decay=0.01)
 
@@ -110,6 +112,22 @@ def main():
     if "train+flash" in results and "fwd+flash" in results:
         bwd = results["train+flash"] - results["fwd+flash"]
         print(f"{'bwd+opt (derived)':22s} {bwd * 1000:8.1f} ms/step")
+
+    # auditable record alongside the bench runs
+    import datetime
+    import json
+    import os
+    rec = {"ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+               timespec="seconds"),
+           "kind": "probe", "platform": plat,
+           "batch": args.batch, "seq": args.seq, "steps": args.steps,
+           "ms_per_step": {k: round(v * 1000, 2)
+                           for k, v in results.items()},
+           "jax_version": jax.__version__}
+    path = os.path.join(os.path.dirname(__file__), "TPU_RUNS.jsonl")
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(f"probe record appended to {path}")
 
 
 if __name__ == "__main__":
