@@ -101,3 +101,4 @@ def set_verbosity(level=0, also_to_stdout=False):
     global _VERBOSITY
     _VERBOSITY = level
 from . import dy2static  # noqa: F401,E402
+from .dy2static import ast_transform  # noqa: F401,E402

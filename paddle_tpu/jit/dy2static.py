@@ -156,3 +156,173 @@ def unpack_by_structure(target, structure):
     return [unpack_by_structure(t, s)
             for t, s in zip(target, structure)] \
         if isinstance(structure, (list, tuple)) else target
+
+
+# ------------------------------------------------------------------
+# AST transform (reference: jit/dy2static/transformers/ — rewrite python
+# control flow into convert_* calls).  Scope: `while`/`if` statements
+# without break/continue/return in their bodies, and bool ops.  Anything
+# outside that scope is left as native python, which still executes
+# correctly (eager, or guard-specialized under to_static).
+# ------------------------------------------------------------------
+
+import ast as _ast
+import functools as _functools
+import inspect as _inspect
+import textwrap as _textwrap
+
+
+def _assigned_names(nodes):
+    out = []
+    for n in nodes:
+        for sub in _ast.walk(n):
+            if isinstance(sub, _ast.Name) and isinstance(sub.ctx,
+                                                         _ast.Store):
+                if sub.id not in out:
+                    out.append(sub.id)
+            elif isinstance(sub, (_ast.FunctionDef,
+                                  _ast.AsyncFunctionDef)):
+                break
+    return out
+
+
+def _has_escape(nodes):
+    for n in nodes:
+        for sub in _ast.walk(n):
+            if isinstance(sub, (_ast.Break, _ast.Continue, _ast.Return)):
+                return True
+    return False
+
+
+class _ControlFlowTransformer(_ast.NodeTransformer):
+    """Rewrites
+        while <test>: <body>
+    into the convert_while_loop getter/setter protocol (and `if` into
+    convert_ifelse) so tensor conditions compile through the lax
+    lowering instead of per-iteration host reads."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _fresh(self, base):
+        self._n += 1
+        return f"__d2s_{base}_{self._n}"
+
+    def _state_fns(self, names, tag):
+        get_name, set_name = self._fresh(f"get{tag}"), \
+            self._fresh(f"set{tag}")
+        get_def = _ast.parse(
+            f"def {get_name}():\n"
+            f"    return ({', '.join(names)}{',' if names else ''})\n"
+        ).body[0]
+        set_src = f"def {set_name}(__vals):\n"
+        if names:
+            set_src += f"    nonlocal {', '.join(names)}\n"
+            set_src += f"    ({', '.join(names)}{',' if names else ''}) " \
+                       f"= __vals\n"
+        else:
+            set_src += "    pass\n"
+        set_def = _ast.parse(set_src).body[0]
+        return get_name, set_name, [get_def, set_def]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_escape(node.body):
+            return node
+        names = [n for n in _assigned_names(node.body)
+                 if not n.startswith("__d2s_")]
+        cond_name = self._fresh("cond")
+        body_name = self._fresh("body")
+        get_name, set_name, state_defs = self._state_fns(names, "w")
+        cond_def = _ast.FunctionDef(
+            name=cond_name,
+            args=_ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                kw_defaults=[], defaults=[]),
+            body=([_ast.Nonlocal(names=list(names))] if names else [])
+            + [_ast.Return(value=node.test)],
+            decorator_list=[])
+        body_def = _ast.FunctionDef(
+            name=body_name,
+            args=_ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                kw_defaults=[], defaults=[]),
+            body=([_ast.Nonlocal(names=list(names))] if names else [])
+            + list(node.body),
+            decorator_list=[])
+        call = _ast.Expr(value=_ast.Call(
+            func=_ast.Attribute(value=_ast.Name(id="__d2s__",
+                                                ctx=_ast.Load()),
+                                attr="convert_while_loop",
+                                ctx=_ast.Load()),
+            args=[_ast.Name(id=cond_name, ctx=_ast.Load()),
+                  _ast.Name(id=body_name, ctx=_ast.Load()),
+                  _ast.Name(id=get_name, ctx=_ast.Load()),
+                  _ast.Name(id=set_name, ctx=_ast.Load())],
+            keywords=[]))
+        return [_ast.fix_missing_locations(_ast.copy_location(s, node))
+                for s in state_defs + [cond_def, body_def, call]]
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node
+        names = [n for n in _assigned_names(node.body + node.orelse)
+                 if not n.startswith("__d2s_")]
+        true_name = self._fresh("true")
+        false_name = self._fresh("false")
+        get_name, set_name, state_defs = self._state_fns(names, "i")
+
+        def branch(name, stmts):
+            return _ast.FunctionDef(
+                name=name,
+                args=_ast.arguments(posonlyargs=[], args=[],
+                                    kwonlyargs=[], kw_defaults=[],
+                                    defaults=[]),
+                body=([_ast.Nonlocal(names=list(names))] if names else [])
+                + (list(stmts) if stmts else [_ast.Pass()]),
+                decorator_list=[])
+        call = _ast.Expr(value=_ast.Call(
+            func=_ast.Attribute(value=_ast.Name(id="__d2s__",
+                                                ctx=_ast.Load()),
+                                attr="convert_ifelse", ctx=_ast.Load()),
+            args=[node.test,
+                  _ast.Name(id=true_name, ctx=_ast.Load()),
+                  _ast.Name(id=false_name, ctx=_ast.Load()),
+                  _ast.Name(id=get_name, ctx=_ast.Load()),
+                  _ast.Name(id=set_name, ctx=_ast.Load())],
+            keywords=[]))
+        return [_ast.fix_missing_locations(_ast.copy_location(s, node))
+                for s in state_defs
+                + [branch(true_name, node.body),
+                   branch(false_name, node.orelse), call]]
+
+
+def ast_transform(fn):
+    """Rewrite `fn`'s python control flow into convert_* calls
+    (reference: the dy2static program translator).  Tensor `while`/`if`
+    then compile through the lax lowering; functions whose source is
+    unavailable are returned unchanged."""
+    try:
+        src = _textwrap.dedent(_inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn
+    if fn.__closure__:
+        # free variables can't be rebuilt by exec — fall back untransformed
+        return fn
+    tree = _ast.parse(src)
+    fdef = tree.body[0]
+    fdef.decorator_list = []   # don't re-apply to_static/ast_transform
+    new_tree = _ControlFlowTransformer().visit(tree)
+    _ast.fix_missing_locations(new_tree)
+    try:
+        code = compile(new_tree, filename=f"<dy2static {fn.__name__}>",
+                       mode="exec")
+    except SyntaxError:
+        # e.g. a branch-local first binding can't be nonlocal'd — run the
+        # original (eager / guard-specialized) semantics instead
+        return fn
+    import sys
+    glb = dict(fn.__globals__)
+    glb["__d2s__"] = sys.modules[__name__]
+    loc = {}
+    exec(code, glb, loc)
+    return _functools.wraps(fn)(loc[fdef.name])

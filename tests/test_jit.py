@@ -520,3 +520,69 @@ def test_dy2static_convert_operators():
     assert [i for i, _ in d2s.convert_enumerate(m)] == [0, 1, 2]
     assert len(list(d2s.convert_zip(m, m))) == 3
     assert len(d2s.indexable(m)) == 3
+
+
+def test_ast_transform_tensor_while_single_program():
+    # the dy2static AST transform rewrites a NATIVE python while loop
+    # over tensors into convert_while_loop -> lax.while_loop: one
+    # compiled program across trip counts, no manual while_loop API
+    import paddle_tpu.jit as jit
+
+    def decode(x, n):
+        with paddle.no_grad():
+            i = paddle.to_tensor(np.int32(0))
+            acc = x
+            while i < n:
+                acc = acc * 2.0
+                i = i + 1
+        return acc
+
+    run = paddle.jit.to_static(jit.ast_transform(decode))
+    for trip, expect in [(3, 8.0), (6, 64.0), (1, 2.0)]:
+        out = run(paddle.to_tensor(np.float32(1.0)),
+                  paddle.to_tensor(np.int32(trip)))
+        assert float(out.numpy()) == expect, (trip, float(out.numpy()))
+    assert run.guard_cache_size() == 1
+
+
+def test_ast_transform_if_and_python_fallbacks():
+    import paddle_tpu.jit as jit
+
+    def branchy(x, flag):
+        with paddle.no_grad():
+            if flag > 0:
+                y = x * 3.0
+            else:
+                y = x - 1.0
+        return y
+
+    f = jit.ast_transform(branchy)
+    assert float(f(paddle.to_tensor(np.float32(2.0)),
+                   paddle.to_tensor(np.float32(1.0))).numpy()) == 6.0
+    assert float(f(paddle.to_tensor(np.float32(2.0)),
+                   paddle.to_tensor(np.float32(-1.0))).numpy()) == 1.0
+
+    # python-condition control flow must behave identically
+    def pyflow(n):
+        total = 0
+        i = 0
+        while i < n:
+            if i % 2 == 0:
+                total = total + i
+            i = i + 1
+        return total
+
+    g = jit.ast_transform(pyflow)
+    assert g(6) == pyflow(6) == 6
+
+    # gradients still flow through the untransformed-python path
+    def with_grad(x):
+        if True:
+            y = x * x
+        return y
+
+    h = jit.ast_transform(with_grad)
+    t = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+    out = h(t)
+    out.backward()
+    assert float(t.grad.numpy()) == 6.0
