@@ -98,3 +98,110 @@ def test_nms_categories_filter():
     cats = paddle.to_tensor(np.array([0, 1, 2], np.int64))
     keep = V.nms(boxes, 0.5, scores, cats, categories=[0, 1])
     assert sorted(_np(keep).tolist()) == [0, 1]   # cat-2 box dropped
+
+
+# ---------------- round-3 detection ops ----------------
+import pytest  # noqa: E402,F811
+from paddle_tpu.vision import ops as O  # noqa: E402
+
+def test_psroi_pool_position_sensitivity():
+    # C = oc*ph*pw; each output bin pools its own channel group
+    x = paddle.to_tensor(np.arange(1 * 8 * 4 * 4, dtype=np.float32)
+                         .reshape(1, 8, 4, 4))
+    boxes = paddle.to_tensor(np.array([[0., 0., 3., 3.]], np.float32))
+    bn = paddle.to_tensor(np.array([1], np.int32))
+    out = O.psroi_pool(x, boxes, bn, 2)
+    assert tuple(out.shape) == (1, 2, 2, 2)
+    # bin (0,0) pools channels [0 (oc0) and 4 (oc1)] over rows 0-1
+    v = np.asarray(out._data_)
+    assert np.isfinite(v).all()
+    with pytest.raises(ValueError):
+        O.psroi_pool(paddle.to_tensor(np.zeros((1, 7, 4, 4), np.float32)),
+                     boxes, bn, 2)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    pb = paddle.to_tensor(np.array([[0., 0., 10., 10.],
+                                    [4., 4., 20., 24.]], np.float32))
+    tb = paddle.to_tensor(np.array([[1., 2., 9., 8.]], np.float32))
+    enc = O.box_coder(pb, None, tb, code_type="encode_center_size")
+    dec = O.box_coder(pb, None, enc, code_type="decode_center_size",
+                      axis=0)
+    # decoding target 0's deltas against each prior recovers the target
+    np.testing.assert_allclose(np.asarray(dec._data_)[0, 0],
+                               np.asarray(tb._data_)[0], atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dec._data_)[0, 1],
+                               np.asarray(tb._data_)[0], atol=1e-4)
+
+
+def test_yolo_box_decodes_center_cells():
+    na, nc, h = 3, 2, 4
+    x = paddle.to_tensor(np.zeros((1, na * (5 + nc), h, h), np.float32))
+    imsz = paddle.to_tensor(np.array([[64, 64]], np.int32))
+    boxes, scores = O.yolo_box(x, imsz, [8, 8, 16, 16, 32, 32], nc, 0.0)
+    assert tuple(boxes.shape) == (1, na * h * h, 4)
+    assert tuple(scores.shape) == (1, na * h * h, nc)
+    b = np.asarray(boxes._data_)
+    # zero logits -> sigmoid 0.5 -> box centers at cell centers, clipped
+    assert (b[..., 2] >= b[..., 0]).all() and (b[..., 3] >= b[..., 1]).all()
+
+
+def test_matrix_nms_decays_overlaps():
+    bb = paddle.to_tensor(np.array([[[0, 0, 10, 10], [0, 0, 10.5, 10.5],
+                                     [30, 30, 40, 40]]], np.float32))
+    sc = paddle.to_tensor(np.array([[[0.0, 0.0, 0.0],
+                                     [0.9, 0.85, 0.8]]], np.float32))
+    out, num = O.matrix_nms(bb, sc, score_threshold=0.1,
+                            post_threshold=0.0, nms_top_k=10, keep_top_k=10,
+                            background_label=0)
+    v = np.asarray(out._data_)
+    assert int(np.asarray(num._data_)[0]) == 3
+    # the heavily-overlapping runner-up is decayed below its raw score
+    raw = sorted([0.9, 0.85, 0.8], reverse=True)
+    assert v[0, 1] == pytest.approx(0.9, abs=1e-6)
+    assert v[1, 1] < raw[1]
+
+
+def test_distribute_fpn_and_restore_index():
+    rois = np.array([[0, 0, 16, 16], [0, 0, 230, 230], [0, 0, 60, 60]],
+                    np.float32)
+    multi, restore = O.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224)
+    flat = np.concatenate([np.asarray(m._data_) for m in multi
+                           if m.shape[0] > 0], 0)
+    ri = np.asarray(restore._data_).reshape(-1)
+    np.testing.assert_allclose(flat[ri], rois)
+
+
+def test_generate_proposals_filters_and_ranks():
+    rng = np.random.default_rng(5)
+    scores = paddle.to_tensor(rng.random((1, 2, 4, 4)).astype(np.float32))
+    deltas = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    anchors = paddle.to_tensor(
+        np.tile(np.array([[0, 0, 15, 15]], np.float32),
+                (4 * 4 * 2, 1)).reshape(4, 4, 2, 4))
+    var = paddle.to_tensor(np.ones((4, 4, 2, 4), np.float32))
+    rois, rscores, rn = O.generate_proposals(
+        scores, deltas, paddle.to_tensor(np.array([[32., 32.]],
+                                                  np.float32)),
+        anchors, var, nms_thresh=0.5, post_nms_top_n=5,
+        return_rois_num=True)
+    n = int(np.asarray(rn._data_)[0])
+    assert 1 <= n <= 5
+    s = np.asarray(rscores._data_)
+    assert (np.diff(s) <= 1e-6).all()  # ranked by score
+
+
+def test_read_file_decode_jpeg_roundtrip(tmp_path):
+    from PIL import Image
+    img = (np.random.default_rng(0).random((20, 24, 3)) * 255
+           ).astype(np.uint8)
+    p = str(tmp_path / "img.jpg")
+    Image.fromarray(img).save(p, quality=95)
+    raw = O.read_file(p)
+    assert raw._data_.dtype == np.uint8
+    dec = O.decode_jpeg(raw, mode="rgb")
+    assert tuple(dec.shape) == (3, 20, 24)
+    # lossy but close
+    assert np.abs(np.asarray(dec._data_).transpose(1, 2, 0).astype(int)
+                  - img.astype(int)).mean() < 16

@@ -1,0 +1,99 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/ —
+functional + class API numerics; round-3 full-parity surface)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.vision.transforms as T
+
+
+@pytest.fixture()
+def img():
+    return (np.random.RandomState(0).rand(32, 48, 3) * 255).astype(
+        np.uint8)
+
+
+def test_flips_resize_pad_crop(img):
+    assert np.array_equal(T.hflip(T.hflip(img)), img)
+    assert np.array_equal(T.vflip(T.vflip(img)), img)
+    assert T.resize(img, (16, 24)).shape == (16, 24, 3)
+    assert T.resize(img, 16).shape == (16, 24, 3)  # short-side semantics
+    assert T.pad(img, 2).shape == (36, 52, 3)
+    assert T.pad(img, (1, 2, 3, 4)).shape == (32 + 2 + 4, 48 + 1 + 3, 3)
+    assert T.crop(img, 4, 6, 10, 12).shape == (10, 12, 3)
+    assert T.center_crop(img, 16).shape == (16, 16, 3)
+
+
+def test_rotate_matches_np_rot90(img):
+    sq = img[:32, :32]
+    np.testing.assert_array_equal(T.rotate(sq, 90.0), np.rot90(sq, 1))
+    np.testing.assert_array_equal(T.rotate(sq, -90.0), np.rot90(sq, -1))
+    np.testing.assert_array_equal(T.rotate(img, 0.0), img)
+    assert T.rotate(img, 45.0, expand=True).shape[0] > img.shape[0]
+
+
+def test_affine_perspective_identity(img):
+    np.testing.assert_array_equal(T.affine(img, 0.0), img)
+    h, w = img.shape[:2]
+    pts = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+    np.testing.assert_array_equal(T.perspective(img, pts, pts), img)
+
+
+def test_color_ops(img):
+    assert np.array_equal(T.adjust_brightness(img, 1.0), img)
+    assert np.abs(T.adjust_contrast(img, 1.0).astype(int)
+                  - img.astype(int)).max() <= 1
+    assert np.abs(T.adjust_hue(img, 0.0).astype(int)
+                  - img.astype(int)).max() <= 2
+    assert not np.array_equal(T.adjust_hue(img, 0.25), img)
+    g = T.to_grayscale(img)
+    assert g.shape == (32, 48, 1)
+    assert T.to_grayscale(img, 3).shape == (32, 48, 3)
+
+
+def test_to_tensor_normalize_erase(img):
+    t = T.to_tensor(img)
+    assert tuple(t.shape) == (3, 32, 48)
+    assert float(np.asarray(t._data_).max()) <= 1.0
+    n = T.normalize(img.astype(np.float32).transpose(2, 0, 1),
+                    [127.5] * 3, [127.5] * 3)
+    assert abs(np.asarray(n._data_).mean()) < 1.0
+    e = T.erase(img, 2, 3, 4, 5, np.zeros((4, 5, 3), np.float32))
+    assert (np.asarray(e)[2:6, 3:8] == 0).all()
+
+
+def test_class_transforms_compose(img):
+    out = T.Compose([T.Resize(24), T.CenterCrop(20), T.ToTensor()])(img)
+    assert out.shape == (3, 20, 20)
+    assert T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img).shape == img.shape
+    assert T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                          shear=5)(img).shape == img.shape
+    assert T.RandomResizedCrop(16)(img).shape == (16, 16, 3)
+    assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+    assert T.RandomErasing(prob=1.0)(
+        np.random.rand(3, 32, 32).astype(np.float32)).shape == (3, 32, 32)
+    assert T.Transpose()(img).shape == (3, 32, 48)
+    assert T.Grayscale(3)(img).shape == (32, 48, 3)
+    assert T.Pad(2)(img).shape == (36, 52, 3)
+    np.testing.assert_array_equal(
+        T.RandomHorizontalFlip(prob=0.0)(img), img)
+    np.testing.assert_array_equal(
+        T.RandomVerticalFlip(prob=1.0)(img), img[::-1])
+    assert T.RandomCrop(16)(img).shape == (16, 16, 3)
+    assert T.RandomRotation(0.0)(img).shape == img.shape
+
+
+def test_pil_roundtrip(img):
+    from PIL import Image
+    pim = Image.fromarray(img)
+    assert isinstance(T.resize(pim, (16, 24)), Image.Image)
+    assert isinstance(T.rotate(pim, 45.0), Image.Image)
+    assert isinstance(T.hflip(pim), Image.Image)
+    out = T.Compose([T.Resize(24), T.ToTensor()])(pim)
+    assert out.shape[0] == 3
+
+
+def test_base_transform_keys_tuple(img):
+    # tuple inputs route through keys (reference BaseTransform protocol)
+    tr = T.Resize((16, 24), keys=("image", "label"))
+    out_img, label = tr((img, 7))
+    assert out_img.shape == (16, 24, 3) and label == 7
