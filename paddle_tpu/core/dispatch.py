@@ -19,6 +19,12 @@ from .autograd import GradNode
 
 _DECOMP = None
 
+# Structural ops whose inputs are loop/branch state plus hoisted captures —
+# AMP casting them at the boundary would silently down/up-cast parameters
+# and integer loop state; the ops INSIDE the loop body do their own AMP
+# casting when traced (tensor_ops/control.py).
+_AMP_SKIP = frozenset({"while_loop", "cond"})
+
 
 def _amp_cast(name, arrays):
     """bf16 autocast hook (reference: eager_amp_auto_cast.h insertion point)."""
@@ -96,7 +102,7 @@ def apply_op(name, fn, args, static=None, nondiff=False):
                     for i, j in tensor_paths)
     arrays = [t._data for t in tensors]
 
-    if _state.STATE.amp_level in ("O1", "O2"):
+    if _state.STATE.amp_level in ("O1", "O2") and name not in _AMP_SKIP:
         arrays = _amp_cast(name, arrays)
 
     # `pure` must not close over the input Tensors (or their arrays): under

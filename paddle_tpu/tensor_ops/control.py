@@ -1,28 +1,50 @@
 """Control-flow ops (reference: python/paddle/static/nn/control_flow.py —
-cond/while_loop as program ops).
+cond/while_loop as program ops; VJP through them via the control-flow op
+VJP interface, paddle/fluid/pir/dialect/operator/ir/control_flow_op.cc).
 
-TPU-native realization, two regimes:
+TPU-native realization, three regimes:
 
 - **Gradients disabled** (inference, decode loops, convergence loops):
   `while_loop` lowers to ONE `jax.lax.while_loop` and `cond` to ONE
   `jax.lax.cond` — a tensor-dependent trip count executes as a single
   compiled program under `to_static` (no per-trip-count respecialization,
-  no host round-trip per iteration).  This is the analog of the
-  reference's while/conditional_block program ops executed by
-  InterpreterCore (reference: python/paddle/static/nn/control_flow.py:218
-  While, :1069 cond).
+  no host round-trip per iteration).
 
-- **Gradients enabled**: the taken path must be materialized on the tape
-  for reverse mode (JAX has no vjp through `lax.while_loop` either), so
-  the loop runs as a python loop whose iterations are tape-recorded; the
-  predicate read goes through Tensor.__bool__, which the two-phase tracer
-  records as an in-graph GUARD — each taken branch compiles to its own
-  entry and re-dispatches on the branch bit (the SOT analog).  The guard
-  cache is bounded (see jit/tracer.py rediscovery cap).
+- **Gradients enabled** (the reference's While/If VJP capability): the
+  loop is recorded as ONE tape op via the dispatch funnel.
+  * `cond` lowers to `jax.lax.cond`, which XLA differentiates natively;
+    tensors the arms close over are discovered and hoisted to explicit
+    op inputs so gradients flow to captured parameters.
+  * `while_loop` gets a `jax.custom_vjp`: forward is a counting
+    `lax.while_loop`; backward walks iterations in reverse,
+    recomputing the i-th state from the initial state (checkpoint-at-
+    entry, O(n^2) compute, O(state) memory — no trip-count bound
+    needed).  With an explicit `maxiter=` bound it instead lowers to a
+    bounded `lax.scan` with a predicate mask, which JAX differentiates
+    natively (O(maxiter) memory, O(maxiter) backward — the efficient
+    path when a bound is known).
+  Both compile with the enclosing `to_static` program into a single
+  XLA executable; gradients match eager python-loop unrolling.
+
+- **Python fallback**: bodies that read host values, use framework RNG
+  (dropout — per-iteration keys cannot be replayed consistently by a
+  traced body), mutate tensors they close over, or return mismatched
+  structures run as a tape-recorded python loop whose predicate reads
+  go through the to_static guard machinery (the SOT analog).
+
+The differentiable compiled paths engage under an active jit trace (or
+with an explicit `maxiter=`); plain eager mode keeps the python tape
+loop — it executes only the taken branch/iterations and avoids per-call
+retracing.  Caveat shared with every traced regime (incl. the no-grad
+lax paths): python-container side effects in a body/arm (appending
+tensors to lists, etc.) execute under abstract tracing and would leak
+tracer-backed values into host state — keep bodies functional.
 """
 from __future__ import annotations
 
+import numpy as np
 import jax
+import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..core import state as _state
@@ -30,12 +52,114 @@ from ..core import state as _state
 _UNMATCHED = object()
 
 
+class _FallbackToPython(Exception):
+    """Discovery saw something a compiled loop body cannot express."""
+
+
+class _LoopProbe:
+    """Abstract-eval tracer installed while discovering what a loop body
+    touches: which pre-existing tensors it reads (captures to hoist as op
+    inputs), whether it mutates external state, reads host values, or
+    draws RNG — the latter three force the python fallback."""
+
+    def __init__(self):
+        self.created = set()          # id(Tensor) made during discovery
+        self.cap_ids = set()
+        self.captured = []            # pre-existing Tensors read, in order
+        self.writes = []              # (tensor, pre-write _data_) for undo
+        self.wrote_external = False
+        self.rng_counter = 0
+
+    def on_create(self, t):
+        self.created.add(id(t))
+
+    def on_read(self, t):
+        i = id(t)
+        if i not in self.created and i not in self.cap_ids:
+            self.cap_ids.add(i)
+            self.captured.append(t)
+
+    def on_write(self, t):
+        self.writes.append((t, t._data_))
+        if id(t) not in self.created:
+            self.wrote_external = True
+
+    def host_read(self, t, bool_read=False):
+        raise _FallbackToPython("host read inside loop body")
+
+    def host_input(self, provider):
+        raise _FallbackToPython("host input (lr/step counter) inside body")
+
+    def rng_base(self):
+        raise _FallbackToPython("RNG draw inside loop body")
+
+
+def _discover(run, example_arrays):
+    """Abstract-eval `run` (list[arrays] -> list[arrays]) under a probe.
+    Returns (probe, out_shapes, ok)."""
+    prev = _state.STATE.tracer
+    probe = _LoopProbe()
+    rng_c = _state.STATE.rng_counter
+    _state.STATE.tracer = probe
+    ok, out_shapes = True, None
+    try:
+        with _state.no_grad():
+            out_shapes = jax.eval_shape(run, list(example_arrays))
+    except _FallbackToPython:
+        ok = False
+    except Exception:
+        ok = False
+    finally:
+        _state.STATE.tracer = prev
+        _state.STATE.rng_counter = rng_c
+        for t, old in reversed(probe.writes):
+            t._data_ = old
+    if probe.wrote_external:
+        ok = False
+    return probe, out_shapes, ok
+
+
+class _Swapped:
+    """Temporarily point captured Tensors' storage at traced arrays so the
+    loop body's closure reads flow through the op's explicit inputs (the
+    analog of the reference While op's external-input block args)."""
+
+    def __init__(self, caps, arrays):
+        self.caps, self.arrays = caps, arrays
+
+    def __enter__(self):
+        self.saved = [t._data_ for t in self.caps]
+        for t, a in zip(self.caps, self.arrays):
+            t._data_ = a
+
+    def __exit__(self, *exc):
+        for t, s in zip(self.caps, self.saved):
+            t._data_ = s
+        return False
+
+
+def _is_float_dtype(d):
+    return (jnp.issubdtype(d, jnp.floating)
+            or jnp.issubdtype(d, jnp.complexfloating))
+
+
+def _zero_cotangent(x):
+    if _is_float_dtype(x.dtype):
+        return jnp.zeros(x.shape, x.dtype)
+    return np.zeros(np.shape(x), jax.dtypes.float0)
+
+
 def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
     if (isinstance(pred, Tensor) and true_fn is not None
-            and false_fn is not None and not _state.STATE.grad_enabled):
-        out = _lax_cond(pred, true_fn, false_fn)
-        if out is not _UNMATCHED:
-            return out
+            and false_fn is not None):
+        if not _state.STATE.grad_enabled:
+            out = _lax_cond(pred, true_fn, false_fn)
+            if out is not _UNMATCHED:
+                return out
+        elif _state.STATE.tracer is not None:
+            out = _diff_cond(pred, true_fn, false_fn)
+            if out is not _UNMATCHED:
+                return out
     if bool(pred):
         return true_fn() if true_fn is not None else None
     return false_fn() if false_fn is not None else None
@@ -72,14 +196,75 @@ def _lax_cond(pred, true_fn, false_fn):
     return jax.tree.unflatten(box["tree"], leaves)
 
 
-def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+def _diff_cond(pred, true_fn, false_fn):
+    """Differentiable branch: ONE tape op whose pure function is lax.cond
+    (natively reverse-differentiable in XLA); closed-over tensors from
+    BOTH arms are hoisted to explicit inputs so parameter gradients flow
+    through whichever branch executes."""
+    box = {}
+
+    def _arm_leaves(fn):
+        def run(_):
+            with _state.no_grad():
+                out = fn()
+            leaves, tree = jax.tree.flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            if not leaves or not all(isinstance(x, Tensor) for x in leaves):
+                raise _FallbackToPython("cond arms must return Tensors")
+            box.setdefault("tree", tree)
+            if tree != box["tree"]:
+                raise _FallbackToPython("arm structures differ")
+            return [x._data_ for x in leaves]
+        return run
+
+    probe_t, shapes_t, ok_t = _discover(_arm_leaves(true_fn), [])
+    probe_f, shapes_f, ok_f = _discover(_arm_leaves(false_fn), [])
+    if not (ok_t and ok_f) or shapes_t is None or shapes_f is None:
+        return _UNMATCHED
+    avals_t = [(s.shape, s.dtype) for s in shapes_t]
+    avals_f = [(s.shape, s.dtype) for s in shapes_f]
+    if avals_t != avals_f:
+        return _UNMATCHED
+    caps = list(probe_t.captured)
+    seen = set(map(id, caps))
+    caps += [t for t in probe_f.captured if id(t) not in seen]
+
+    def pure(p, *cap_arrays):
+        def mk(fn):
+            def f(cs):
+                with _Swapped(caps, cs), _state.no_grad():
+                    out = fn()
+                leaves, _ = jax.tree.flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                return tuple(x._data_ for x in leaves)
+            return f
+        return jax.lax.cond(p.reshape(()).astype(jnp.bool_),
+                            mk(true_fn), mk(false_fn),
+                            tuple(cap_arrays))
+
+    from ..core.dispatch import apply_op
+    try:
+        out = apply_op("cond", pure, (pred,) + tuple(caps))
+    except Exception:
+        return _UNMATCHED
+    leaves = [out] if isinstance(out, Tensor) else list(out)
+    return jax.tree.unflatten(box["tree"], leaves)
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None,
+               maxiter=None):
     vars_ = list(loop_vars)
-    if (vars_ and all(isinstance(v, Tensor) for v in vars_)
-            and not _state.STATE.grad_enabled):
-        out = _lax_while(cond_fn, body, vars_)
-        if out is not _UNMATCHED:
-            return out
-    # tape-recorded python loop (reverse mode needs the unrolled tape)
+    if vars_ and all(isinstance(v, Tensor) for v in vars_):
+        if not _state.STATE.grad_enabled:
+            out = _lax_while(cond_fn, body, vars_)
+            if out is not _UNMATCHED:
+                return out
+        elif maxiter is not None or _state.STATE.tracer is not None:
+            out = _diff_while(cond_fn, body, vars_, maxiter)
+            if out is not _UNMATCHED:
+                return out
+    # tape-recorded python loop (fallback: host reads, RNG, external
+    # mutation, non-Tensor state)
     while bool(cond_fn(*vars_)):
         out = body(*vars_)
         vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
@@ -111,3 +296,153 @@ def _lax_while(cond_fn, body, vars_):
     except Exception:
         return _UNMATCHED
     return [Tensor(a) for a in res]
+
+
+def _diff_while(cond_fn, body, vars_, maxiter=None):
+    """Differentiable data-dependent loop as ONE tape op.
+
+    Reference capability: the While op's VJP (control_flow_op.cc) — the
+    reference replays the recorded block per iteration; here backward is
+    a compiled reverse sweep.  Without a bound: jax.custom_vjp whose
+    backward recomputes state_i from the initial state (O(n^2) FLOPs,
+    O(state) memory, fully compiled).  With `maxiter`: bounded lax.scan
+    + predicate mask, natively differentiated (residuals saved per
+    iteration — O(maxiter) memory, O(maxiter) backward)."""
+    n_loop = len(vars_)
+
+    def _disc_run(arrays):
+        ts = [Tensor(a) for a in arrays]
+        r = cond_fn(*ts)
+        if not isinstance(r, Tensor):
+            raise _FallbackToPython("predicate must be a Tensor")
+        out = body(*ts)
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        if len(out) != n_loop or not all(isinstance(x, Tensor) for x in out):
+            raise _FallbackToPython("body must return the loop structure")
+        return [x._data_ for x in out]
+
+    init_arrays = [v._data_ for v in vars_]
+    probe, out_shapes, ok = _discover(_disc_run, init_arrays)
+    if not ok or out_shapes is None:
+        return _UNMATCHED
+    for s, a in zip(out_shapes, init_arrays):
+        if tuple(s.shape) != tuple(np.shape(a)):
+            return _UNMATCHED     # shape-changing loops can't compile
+        if s.dtype != a.dtype:
+            return _UNMATCHED     # dtype-promoting body: silent downcast
+                                  # would diverge from eager unrolling
+    caps = list(probe.captured)
+    in_dtypes = [a.dtype for a in init_arrays]
+    in_shapes = [tuple(np.shape(a)) for a in init_arrays]
+
+    def _body_arr(loop_arrays, cap_arrays):
+        with _Swapped(caps, cap_arrays), _state.no_grad():
+            out = body(*[Tensor(a) for a in loop_arrays])
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return tuple(x._data_.astype(d).reshape(sh)
+                     for x, d, sh in zip(out, in_dtypes, in_shapes))
+
+    def _cond_arr(loop_arrays, cap_arrays):
+        with _Swapped(caps, cap_arrays), _state.no_grad():
+            r = cond_fn(*[Tensor(a) for a in loop_arrays])
+        r = r._data_ if isinstance(r, Tensor) else jnp.asarray(r)
+        return r.reshape(()).astype(jnp.bool_)
+
+    float_loop = [i for i, d in enumerate(in_dtypes) if _is_float_dtype(d)]
+    float_cap = [i for i, t in enumerate(caps)
+                 if _is_float_dtype(t._data_.dtype)]
+
+    if maxiter is not None:
+        bound = int(maxiter)
+
+        def pure(*xs):
+            loop_xs, cap_xs = xs[:n_loop], xs[n_loop:]
+
+            def step(carry, _):
+                # body evaluation is gated by lax.cond, not a post-hoc
+                # select: evaluating the body past logical termination
+                # can overflow (exp/square of a terminal state), and a
+                # zero-cotangent times an Inf residual is NaN — cond
+                # keeps dead iterations out of both forward and vjp.
+                pred = _cond_arr(carry, cap_xs)
+                nxt = jax.lax.cond(
+                    pred, lambda c: _body_arr(c, cap_xs), lambda c: c,
+                    carry)
+                return nxt, None
+
+            final, _ = jax.lax.scan(step, tuple(loop_xs), None,
+                                    length=bound)
+            return final
+    else:
+        def _fwd_run(loop_xs, cap_xs):
+            def c(carry):
+                return _cond_arr(carry[0], cap_xs)
+
+            def b(carry):
+                return (_body_arr(carry[0], cap_xs), carry[1] + 1)
+
+            final, n = jax.lax.while_loop(
+                c, b, (tuple(loop_xs), jnp.zeros((), jnp.int32)))
+            return final, n
+
+        @jax.custom_vjp
+        def _while_op(loop_xs, cap_xs):
+            return _fwd_run(loop_xs, cap_xs)[0]
+
+        def _op_fwd(loop_xs, cap_xs):
+            final, n = _fwd_run(loop_xs, cap_xs)
+            return final, (tuple(loop_xs), tuple(cap_xs), n)
+
+        def _op_bwd(res, g):
+            loop0, cap_xs, n = res
+            g_loop = [_zero_cotangent(x) for x in loop0]
+            g_cap = [_zero_cotangent(x) for x in cap_xs]
+            if float_loop:
+                gF = tuple(g[i] for i in float_loop)
+                gC = tuple(jnp.zeros_like(cap_xs[i]) for i in float_cap)
+
+                def recompute(k):
+                    return jax.lax.fori_loop(
+                        0, k, lambda j, xs: _body_arr(xs, cap_xs), loop0)
+
+                def step(carry):
+                    i, gF, gC = carry
+                    xs_i = recompute(i)
+
+                    def f(Fs, Cs):
+                        xs = list(xs_i)
+                        for k2, idx in enumerate(float_loop):
+                            xs[idx] = Fs[k2]
+                        cs = list(cap_xs)
+                        for k2, idx in enumerate(float_cap):
+                            cs[idx] = Cs[k2]
+                        out = _body_arr(tuple(xs), tuple(cs))
+                        return tuple(out[idx] for idx in float_loop)
+
+                    _, vjp = jax.vjp(
+                        f, tuple(xs_i[idx] for idx in float_loop),
+                        tuple(cap_xs[idx] for idx in float_cap))
+                    gF2, gC2 = vjp(gF)
+                    gC = tuple(a + b for a, b in zip(gC, gC2))
+                    return (i - 1, gF2, gC)
+
+                _, gFf, gCf = jax.lax.while_loop(
+                    lambda cy: cy[0] >= 0, step, (n - 1, gF, gC))
+                for k2, idx in enumerate(float_loop):
+                    g_loop[idx] = gFf[k2]
+                for k2, idx in enumerate(float_cap):
+                    g_cap[idx] = gCf[k2]
+            return (tuple(g_loop), tuple(g_cap))
+
+        _while_op.defvjp(_op_fwd, _op_bwd)
+
+        def pure(*xs):
+            loop_xs, cap_xs = xs[:n_loop], xs[n_loop:]
+            return tuple(_while_op(tuple(loop_xs), tuple(cap_xs)))
+
+    from ..core.dispatch import apply_op
+    try:
+        out = apply_op("while_loop", pure, tuple(vars_) + tuple(caps))
+    except Exception:
+        return _UNMATCHED
+    return [out] if isinstance(out, Tensor) else list(out)

@@ -586,3 +586,115 @@ def test_ast_transform_if_and_python_fallbacks():
     out = h(t)
     out.backward()
     assert float(t.grad.numpy()) == 6.0
+
+
+def test_while_loop_grad_compiles_single_program():
+    # VERDICT r3 item 2: a data-dependent while differentiates as ONE
+    # compiled program (custom-VJP lax.while_loop, checkpointed reverse) —
+    # grads match eager python-loop unrolling, and different trip counts
+    # reuse one compiled entry (no guard growth, no python re-trace).
+    from paddle_tpu.tensor_ops.control import while_loop
+
+    wp = paddle.to_tensor(np.float32(1.2), stop_gradient=False)
+
+    @paddle.jit.to_static
+    def step(x):
+        i0 = paddle.to_tensor(np.int32(0))
+        _, s = while_loop(lambda i, s: s.sum() < 20.0,
+                          lambda i, s: (i + 1, s * wp), [i0, x])
+        loss = (s * s).sum()
+        loss.backward()
+        return loss
+
+    for scale in (1.0, 3.0, 0.5):      # three different trip counts
+        wp.grad = None
+        xa = paddle.to_tensor(np.array([0.3 * scale, 0.4], np.float32),
+                              stop_gradient=False)
+        loss = step(xa)
+        # eager unrolled reference
+        wr = paddle.to_tensor(np.float32(1.2), stop_gradient=False)
+        xr = paddle.to_tensor(np.array([0.3 * scale, 0.4], np.float32),
+                              stop_gradient=False)
+        sr = xr
+        while float(sr.sum()) < 20.0:
+            sr = sr * wr
+        lr = (sr * sr).sum()
+        lr.backward()
+        np.testing.assert_allclose(float(loss), float(lr), rtol=1e-5)
+        np.testing.assert_allclose(wp.grad.numpy(), wr.grad.numpy(),
+                                   rtol=1e-4)
+    assert step.guard_cache_size() == 1   # one entry for all trip counts
+
+
+def test_while_loop_grad_eager_captured_param():
+    # eager: gradient flows to a parameter the body closes over (capture
+    # hoisting), matching manual unrolling
+    from paddle_tpu.tensor_ops.control import while_loop
+    w = paddle.to_tensor(np.float32(1.5), stop_gradient=False)
+    x = paddle.to_tensor(np.array([0.5, 0.7], np.float32),
+                         stop_gradient=False)
+    i0 = paddle.to_tensor(np.int32(0))
+    i, s = while_loop(lambda i, s: s.sum() < 10.0,
+                      lambda i, s: (i + 1, s * w), [i0, x])
+    loss = (s * s).sum()
+    loss.backward()
+    n = int(i)
+    assert n > 1
+    # d/dw sum((x*w^n)^2) = 2n/w * sum(x^2 w^{2n})
+    sx = np.array([0.5, 0.7]) * 1.5 ** n
+    np.testing.assert_allclose(float(loss), float((sx * sx).sum()),
+                               rtol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(),
+                               2 * n / 1.5 * (sx * sx).sum(), rtol=1e-4)
+    np.testing.assert_allclose(x.grad.numpy(),
+                               2 * sx * 1.5 ** n, rtol=1e-4)
+
+
+def test_while_loop_grad_maxiter_scan_path():
+    # bounded scan+mask path: natively differentiated, same grads
+    from paddle_tpu.tensor_ops.control import while_loop
+    w = paddle.to_tensor(np.float32(1.5), stop_gradient=False)
+    x = paddle.to_tensor(np.array([0.5, 0.7], np.float32),
+                         stop_gradient=False)
+    i0 = paddle.to_tensor(np.int32(0))
+    i, s = while_loop(lambda i, s: s.sum() < 10.0,
+                      lambda i, s: (i + 1, s * w), [i0, x], maxiter=16)
+    loss = (s * s).sum()
+    loss.backward()
+    n = int(i)
+    sx = np.array([0.5, 0.7]) * 1.5 ** n
+    np.testing.assert_allclose(w.grad.numpy(),
+                               2 * n / 1.5 * (sx * sx).sum(), rtol=1e-4)
+
+
+def test_cond_grad_both_branches_captured():
+    # differentiable cond: grads flow to tensors captured by either arm
+    from paddle_tpu.tensor_ops.control import cond
+    w = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+    y = cond(paddle.to_tensor(np.array(True)),
+             lambda: w * 3.0, lambda: w * 5.0)
+    y.backward()
+    assert float(w.grad) == 3.0
+    w.grad = None
+    y = cond(paddle.to_tensor(np.array(False)),
+             lambda: w * 3.0, lambda: w * 5.0)
+    y.backward()
+    assert float(w.grad) == 5.0
+
+
+def test_while_loop_grad_falls_back_on_host_read():
+    # a body that reads a host value cannot compile; the python tape
+    # loop must still produce correct grads
+    from paddle_tpu.tensor_ops.control import while_loop
+    x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+    acc0 = paddle.to_tensor(np.float32(1.0), stop_gradient=False)
+    i0 = paddle.to_tensor(np.int32(0))
+
+    def body(i, acc):
+        float(acc)                      # host read -> fallback
+        return i + 1, acc * x
+
+    _, acc = while_loop(lambda i, a: i < 3, body, [i0, acc0])
+    acc.backward()
+    assert float(acc.numpy()) == 8.0
+    assert float(x.grad.numpy()) == 12.0
