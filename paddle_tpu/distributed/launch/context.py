@@ -24,9 +24,20 @@ def parse_args(argv=None):
         description="Launch distributed training "
                     "(reference: paddle.distributed.launch)")
     p.add_argument("--master", default=None,
-                   help="coordinator ip:port (defaults to local free port)")
-    p.add_argument("--nnodes", type=int, default=1)
-    p.add_argument("--node_rank", type=int, default=0)
+                   help="coordinator ip:port (defaults to local free port);"
+                        " with --nnodes it selects TCPStore rendezvous"
+                        " (multi-pod elastic mode)")
+    p.add_argument("--nnodes", default="1",
+                   help="node count N, or elastic range MIN:MAX")
+    p.add_argument("--node_rank", type=int, default=0,
+                   help="in elastic mode only designates the store host"
+                        " (rank 0); worker ranks come from rendezvous")
+    p.add_argument("--pod_id", default=None,
+                   help="stable pod identity for rendezvous ordering"
+                        " (default: ip-pid)")
+    p.add_argument("--elastic_quiet", type=float, default=1.0,
+                   help="seconds membership must be stable before an"
+                        " elastic rendezvous commits below MAX nodes")
     p.add_argument("--nproc_per_node", type=int, default=1)
     p.add_argument("--log_dir", default=None)
     p.add_argument("--job_id", default="default")
@@ -44,16 +55,29 @@ class Context:
         self.args = args or parse_args(argv)
         self.node_ip = os.environ.get("POD_IP", "127.0.0.1")
 
+    def nnodes_range(self):
+        """(min, max) node count; `--nnodes 2` → (2, 2), `1:4` → (1, 4)."""
+        spec = str(self.args.nnodes)
+        if ":" in spec:
+            lo, hi = spec.split(":", 1)
+            return int(lo), int(hi)
+        n = int(spec)
+        return n, n
+
     def world_size(self):
-        return self.args.nnodes * self.args.nproc_per_node
+        return self.nnodes_range()[0] * self.args.nproc_per_node
 
     def global_rank(self, local_rank):
         return self.args.node_rank * self.args.nproc_per_node + local_rank
 
-    def proc_env(self, local_rank, master):
-        """The PADDLE_TRAINER_* contract + JAX multi-controller vars."""
-        rank = self.global_rank(local_rank)
-        world = self.world_size()
+    def proc_env(self, local_rank, master, rank=None, world=None):
+        """The PADDLE_TRAINER_* contract + JAX multi-controller vars.
+        `rank`/`world` override the static node_rank arithmetic when a
+        rendezvous assigned them (elastic mode)."""
+        if rank is None:
+            rank = self.global_rank(local_rank)
+        if world is None:
+            world = self.world_size()
         env = dict(os.environ)
         env.update({
             "PADDLE_TRAINER_ID": str(rank),

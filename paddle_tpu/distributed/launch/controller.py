@@ -32,17 +32,19 @@ class CollectiveController:
             master = f"127.0.0.1:{free_port()}"
         self.master = master
 
-    def _spawn_one(self, local_rank):
+    def _spawn_one(self, local_rank, rank=None, world=None):
         args = self.ctx.args
-        env = self.ctx.proc_env(local_rank, self.master)
+        env = self.ctx.proc_env(local_rank, self.master,
+                                rank=rank, world=world)
         cmd = [sys.executable, args.training_script,
                *args.training_script_args]
         stdout = stderr = None
         if args.log_dir:
             os.makedirs(args.log_dir, exist_ok=True)
-            rank = self.ctx.global_rank(local_rank)
+            r = rank if rank is not None \
+                else self.ctx.global_rank(local_rank)
             log = open(os.path.join(args.log_dir,
-                                    f"worker.{rank}.log"), "ab")
+                                    f"worker.{r}.log"), "ab")
             stdout = stderr = log
         return subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr)
 
@@ -93,6 +95,93 @@ class CollectiveController:
                     pass
 
 
+class ElasticCollectiveController(CollectiveController):
+    """Multi-pod controller: TCPStore rendezvous assigns pod/worker ranks,
+    a watcher restarts the pod's workers when membership changes (scale-
+    out request from a joiner, or a member pod's heartbeat expiring), and
+    each rebuild re-runs rendezvous so ranks/world stay contiguous.
+
+    Reference capability: launch controllers with HTTPMaster/ETCDMaster
+    rendezvous (launch/controllers/master.py:73,186), the pod/job model
+    (launch/job/pod.py), the watcher (controllers/watcher.py), and
+    elastic scale-in/out (fleet/elastic/manager.py:487,510)."""
+
+    def __init__(self, ctx: Context):
+        from .master import KVMaster
+        self.ctx = ctx
+        self.procs = []
+        args = ctx.args
+        self.master = args.master
+        self.min_nodes, self.max_nodes = ctx.nnodes_range()
+        pod_id = args.pod_id or f"{ctx.node_ip}-{os.getpid()}"
+        self.kv = KVMaster(args.master, pod_id,
+                           np=args.nproc_per_node,
+                           is_host=(args.node_rank == 0),
+                           job_id=args.job_id,
+                           ttl=max(3.0, args.elastic_timeout / 5.0),
+                           timeout=float(args.elastic_timeout * 10))
+
+    def run(self):
+        from . import master as M
+        args = self.ctx.args
+        restarts = 0
+        self.kv.start_heartbeat()
+        try:
+            while True:
+                r, pods, my_idx = self.kv.rendezvous(
+                    self.min_nodes, self.max_nodes,
+                    quiet=args.elastic_quiet)
+                offset = sum(p["np"] for p in pods[:my_idx])
+                world = sum(p["np"] for p in pods)
+                self.procs = [
+                    self._spawn_one(i, rank=offset + i, world=world)
+                    for i in range(args.nproc_per_node)]
+                status, codes = self._watch_elastic()
+                if status == "done":
+                    return 0
+                if status == M.RESTART or \
+                        any(c == ELASTIC_EXIT_CODE for c in codes
+                            if c is not None):
+                    if restarts >= args.max_restart:
+                        return 1
+                    restarts += 1
+                    self._terminate()
+                    for p in self.procs:
+                        p.wait()
+                    continue
+                return max(c for c in codes if c is not None)
+        finally:
+            self.kv.stop()
+
+    def _watch_elastic(self):
+        """Poll workers + membership; returns ("done"|RESTART|"failed",
+        exit codes)."""
+        from . import master as M
+        codes = [None] * len(self.procs)
+        while True:
+            for i, p in enumerate(self.procs):
+                if codes[i] is None:
+                    codes[i] = p.poll()
+            live = [c for c in codes if c is not None]
+            if len(live) == len(codes):
+                if all(c == 0 for c in codes):
+                    return "done", codes
+                return "failed", codes
+            if any(c not in (None, 0) for c in codes):
+                self._terminate()
+                for i, p in enumerate(self.procs):
+                    if codes[i] is None:
+                        codes[i] = p.wait()
+                if any(c == ELASTIC_EXIT_CODE for c in codes):
+                    return M.RESTART, codes
+                return "failed", codes
+            if self.kv.watch() == M.RESTART:
+                return M.RESTART, codes
+            time.sleep(0.25)
+
+
 def launch(argv=None):
     ctx = Context(argv=argv)
+    if ctx.args.master is not None:
+        return ElasticCollectiveController(ctx).run()
     return CollectiveController(ctx).run()

@@ -1,0 +1,15 @@
+"""Worker for multi-pod launch/elastic tests: records (world, rank), then
+either exits cleanly or parks (sleeps) so a scale event must restart it."""
+import os
+import sys
+import time
+
+outdir = sys.argv[1]
+park_world = sys.argv[2]          # park when PADDLE_TRAINERS_NUM == this
+
+rank = os.environ["PADDLE_TRAINER_ID"]
+world = os.environ["PADDLE_TRAINERS_NUM"]
+with open(os.path.join(outdir, f"w{world}.r{rank}"), "w") as f:
+    f.write(os.environ.get("PADDLE_MASTER", ""))
+if world == park_world:
+    time.sleep(120)               # killed by the controller on rebuild
