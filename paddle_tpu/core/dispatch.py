@@ -18,6 +18,7 @@ from .autograd import GradNode
 
 
 _DECOMP = None
+_PROF = None
 
 # Structural ops whose inputs are loop/branch state plus hoisted captures —
 # AMP casting them at the boundary would silently down/up-cast parameters
@@ -105,6 +106,17 @@ def apply_op(name, fn, args, static=None, nondiff=False):
     if _state.STATE.amp_level in ("O1", "O2") and name not in _AMP_SKIP:
         arrays = _amp_cast(name, arrays)
 
+    # per-op profiling spans (reference: RecordEvent instrumentation in
+    # the generated ad_funcs + CUPTI kernel timing) — lazily bound, one
+    # cheap check when no profiler records
+    global _PROF
+    if _PROF is None:
+        from ..profiler import profiler as _PROF
+    prof_on = _PROF.op_profiling_active()
+    if prof_on:
+        import time as _time
+        _t0 = _time.perf_counter_ns()
+
     # `pure` must not close over the input Tensors (or their arrays): under
     # saved_tensors_hooks the node keeps `pure` for backward re-linearization,
     # and a closure pinning the original device arrays would defeat offload
@@ -147,6 +159,11 @@ def apply_op(name, fn, args, static=None, nondiff=False):
 
     single = not isinstance(out, (tuple, list))
     outs = (out,) if single else tuple(out)
+
+    if prof_on:
+        _PROF.record_op_span(
+            name, _t0, _time.perf_counter_ns(), outs,
+            tuple(tuple(getattr(a, "shape", ())) for a in arrays), static)
 
     fc = _state.STATE.flops_counter
     if fc is not None:

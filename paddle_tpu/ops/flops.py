@@ -142,6 +142,20 @@ def attach_all():
         _attach(name, fn)
 
 
+def flops_of(name, shapes, static):
+    """Analytic FLOPs for one op call, or None when no estimator fits."""
+    op = OPS.get(name)
+    est = op.flops if op is not None else None
+    if est is None:
+        est = _ESTIMATORS.get(name)
+    if est is None:
+        return None
+    try:
+        return int(est(shapes, **static))
+    except Exception:
+        return None
+
+
 class FlopsCounter:
     """Accumulates per-op forward FLOPs through the dispatch funnel.
 
@@ -159,19 +173,11 @@ class FlopsCounter:
         self.uncounted = set()
 
     def add(self, name, shapes, static):
-        op = OPS.get(name)
-        est = op.flops if op is not None else None
-        if est is None:
-            # ops invoked through bare apply_op (flash_attention, the
-            # fused pack) have no registry entry — fall back to the
-            # estimator table directly so their FLOPs still count
-            est = _ESTIMATORS.get(name)
-        if est is None:
-            self.uncounted.add(name)
-            return
-        try:
-            f = int(est(shapes, **static))
-        except Exception:
+        # ops invoked through bare apply_op (flash_attention, the fused
+        # pack) have no registry entry — flops_of falls back to the
+        # estimator table directly so their FLOPs still count
+        f = flops_of(name, shapes, static)
+        if f is None:
             self.uncounted.add(name)
             return
         self.by_op[name] = self.by_op.get(name, 0) + f

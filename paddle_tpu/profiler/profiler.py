@@ -67,12 +67,13 @@ class _HostEventBuffer:
         self._events = []
         self._lock = threading.Lock()
 
-    def add(self, name, ts_us, dur_us, tid, event_type):
+    def add(self, name, ts_us, dur_us, tid, event_type, args=None):
+        ev = {"name": name, "ph": "X", "ts": ts_us, "dur": dur_us,
+              "pid": os.getpid(), "tid": tid, "cat": event_type}
+        if args:
+            ev["args"] = args
         with self._lock:
-            self._events.append(
-                {"name": name, "ph": "X", "ts": ts_us, "dur": dur_us,
-                 "pid": os.getpid(), "tid": tid,
-                 "cat": event_type})
+            self._events.append(ev)
 
     def drain(self):
         with self._lock:
@@ -82,6 +83,46 @@ class _HostEventBuffer:
 
 _HOST_BUFFER = _HostEventBuffer()
 _ACTIVE = []
+
+
+def op_profiling_active():
+    """True while a (non-timer-only) profiler records — the dispatch
+    funnel then times each eager op (the host_tracer per-op
+    instrumentation analog, reference: RecordEvent in the generated
+    ad_funcs)."""
+    return any(not p.timer_only for p in _ACTIVE)
+
+
+def record_op_span(name, t0_ns, t1_ns, outs, shapes, static):
+    """Record one eager op dispatch: host span + analytic FLOPs, and —
+    when a device target is being profiled — the device-complete time
+    measured by blocking on the op's outputs (the CUPTI/gpu_timer
+    analog: per-op device durations, at the cost of breaking async
+    dispatch while profiling)."""
+    import jax
+
+    if outs and isinstance(outs[0], jax.core.Tracer):
+        return                        # symbolic: timing is meaningless
+    sync = any(not p.timer_only and (
+        ProfilerTarget.TPU in p.targets or ProfilerTarget.GPU in p.targets)
+        for p in _ACTIVE)
+    dev_dur_us = None
+    if sync:
+        try:
+            jax.block_until_ready(outs)
+            dev_dur_us = (time.perf_counter_ns() - t0_ns) / 1e3
+        except Exception:
+            dev_dur_us = None
+    from ..ops.flops import flops_of
+    f = flops_of(name, shapes, static)
+    args = {}
+    if f is not None:
+        args["flops"] = f
+    if dev_dur_us is not None:
+        args["device_dur"] = dev_dur_us
+    _HOST_BUFFER.add(name, t0_ns / 1e3, (t1_ns - t0_ns) / 1e3,
+                     threading.get_ident() % 2 ** 31, "Operator",
+                     args=args)
 
 
 class RecordEvent:
@@ -220,7 +261,8 @@ class Profiler:
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
         from .profiler_statistic import summary as _summary
-        return _summary(self, time_unit=time_unit)
+        return _summary(self, time_unit=time_unit, sorted_by=sorted_by,
+                        op_detail=op_detail)
 
     @property
     def events(self):

@@ -1,6 +1,12 @@
 """Profiler summary tables (reference capability:
-python/paddle/profiler/profiler_statistic.py — aggregated per-name tables
-sorted by total/avg time)."""
+python/paddle/profiler/profiler_statistic.py — Overview / Operator /
+UserDefined summaries with per-name call counts, CPU+device time
+total/avg/max/min, ratio columns, sorted by a SortedKeys criterion).
+
+The data comes from the host span buffer the dispatch funnel fills while
+a profiler records (cat="Operator", with analytic FLOPs and optional
+device-complete durations) plus user RecordEvent spans and ProfileStep
+step spans."""
 from __future__ import annotations
 
 from enum import Enum
@@ -15,28 +21,144 @@ class SortedKeys(Enum):
     GPUAvg = 5
 
 
-def summary(prof, time_unit="ms", sorted_by=SortedKeys.CPUTotal):
-    """Aggregate host spans per event name into a text table."""
-    scale = {"s": 1e-6, "ms": 1e-3, "us": 1.0}[time_unit]
-    agg = {}
-    for ev in prof.events:
-        a = agg.setdefault(ev["name"], {"total": 0.0, "count": 0,
-                                        "max": 0.0,
-                                        "min": float("inf")})
-        dur = ev.get("dur", 0.0)
-        a["total"] += dur
-        a["count"] += 1
-        a["max"] = max(a["max"], dur)
-        a["min"] = min(a["min"], dur)
+class _Agg:
+    __slots__ = ("calls", "total", "mx", "mn", "dev_total", "dev_mx",
+                 "dev_mn", "dev_calls", "flops")
 
-    rows = sorted(agg.items(), key=lambda kv: -kv[1]["total"])
-    header = (f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
-              f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}")
-    lines = [header, "-" * len(header)]
-    for name, a in rows:
-        lines.append(
-            f"{name[:39]:<40}{a['count']:>8}"
-            f"{a['total'] * scale:>14.3f}"
-            f"{a['total'] / max(a['count'], 1) * scale:>12.3f}"
-            f"{a['max'] * scale:>12.3f}")
+    def __init__(self):
+        self.calls = 0
+        self.total = 0.0
+        self.mx = 0.0
+        self.mn = float("inf")
+        self.dev_total = 0.0
+        self.dev_mx = 0.0
+        self.dev_mn = float("inf")
+        self.dev_calls = 0
+        self.flops = 0
+
+    def add(self, dur, dev_dur=None, flops=None):
+        self.calls += 1
+        self.total += dur
+        self.mx = max(self.mx, dur)
+        self.mn = min(self.mn, dur)
+        if dev_dur is not None:
+            self.dev_calls += 1
+            self.dev_total += dev_dur
+            self.dev_mx = max(self.dev_mx, dev_dur)
+            self.dev_mn = min(self.dev_mn, dev_dur)
+        if flops:
+            self.flops += flops
+
+
+def _collect(events):
+    """Split events into (ops, user, steps) per-name aggregates."""
+    ops, user, steps = {}, {}, _Agg()
+    for ev in events:
+        dur = ev.get("dur", 0.0)
+        cat = ev.get("cat", "")
+        args = ev.get("args") or {}
+        if cat == "Operator":
+            ops.setdefault(ev["name"], _Agg()).add(
+                dur, args.get("device_dur"), args.get("flops"))
+        elif cat == "ProfileStep" or ev["name"].startswith("ProfileStep"):
+            steps.add(dur)
+        else:
+            user.setdefault(ev["name"], _Agg()).add(dur)
+    return ops, user, steps
+
+
+_SORT = {
+    SortedKeys.CPUTotal: lambda a: -a.total,
+    SortedKeys.CPUAvg: lambda a: -(a.total / max(a.calls, 1)),
+    SortedKeys.CPUMax: lambda a: -a.mx,
+    SortedKeys.CPUMin: lambda a: -(a.mn if a.calls else 0.0),
+    SortedKeys.GPUTotal: lambda a: -a.dev_total,
+    SortedKeys.GPUAvg: lambda a: -(a.dev_total / max(a.dev_calls, 1)),
+}
+
+
+def _fmt(us, scale):
+    return f"{us * scale:.3f}"
+
+
+def _table(title, rows, header, widths):
+    total_w = sum(widths)
+    out = ["", f"{('-' * 20)}{title}{('-' * 20)}".center(total_w), ""]
+    out.append("".join(h.ljust(w) if i == 0 else h.rjust(w)
+                       for i, (h, w) in enumerate(zip(header, widths))))
+    out.append("-" * total_w)
+    for row in rows:
+        out.append("".join(
+            str(c)[:widths[0] - 1].ljust(w) if i == 0
+            else str(c).rjust(w)
+            for i, (c, w) in enumerate(zip(row, widths))))
+    return out
+
+
+def summary(prof, time_unit="ms", sorted_by=SortedKeys.CPUTotal,
+            op_detail=True):
+    """Reference-style multi-section report: Overview, Operator Summary
+    (calls / CPU total,avg,max,min / ratio / device time / GFLOPs),
+    UserDefined Summary."""
+    scale = {"s": 1e-6, "ms": 1e-3, "us": 1.0}[time_unit]
+    sorted_by = sorted_by or SortedKeys.CPUTotal
+    ops, user, steps = _collect(prof.events)
+    lines = [f"Time unit: {time_unit}"]
+
+    # ---- Overview ----
+    op_total = sum(a.total for a in ops.values())
+    dev_total = sum(a.dev_total for a in ops.values())
+    user_total = sum(a.total for a in user.values())
+    rows = []
+    if steps.calls:
+        rows.append(("ProfileStep", steps.calls, _fmt(steps.total, scale),
+                     _fmt(steps.total / max(steps.calls, 1), scale)))
+    rows.append(("Operator", sum(a.calls for a in ops.values()),
+                 _fmt(op_total, scale),
+                 _fmt(op_total / max(sum(a.calls for a in ops.values()), 1),
+                      scale)))
+    if user:
+        rows.append(("UserDefined", sum(a.calls for a in user.values()),
+                     _fmt(user_total, scale),
+                     _fmt(user_total /
+                          max(sum(a.calls for a in user.values()), 1),
+                          scale)))
+    lines += _table("Overview Summary", rows,
+                    ("Event Type", "Calls", "Total", "Avg"),
+                    (24, 10, 14, 12))
+
+    # ---- Operator Summary ----
+    if op_detail and ops:
+        key = _SORT[sorted_by]
+        rows = []
+        for name, a in sorted(ops.items(), key=lambda kv: key(kv[1])):
+            ratio = 100.0 * a.total / op_total if op_total else 0.0
+            rows.append((
+                name, a.calls, _fmt(a.total, scale),
+                _fmt(a.total / max(a.calls, 1), scale),
+                _fmt(a.mx, scale),
+                _fmt(a.mn if a.calls else 0.0, scale),
+                f"{ratio:.2f}",
+                _fmt(a.dev_total, scale) if a.dev_calls else "-",
+                (_fmt(a.dev_total / a.dev_calls, scale)
+                 if a.dev_calls else "-"),
+                f"{a.flops / 1e9:.3f}" if a.flops else "-",
+            ))
+        lines += _table(
+            "Operator Summary", rows,
+            ("Name", "Calls", "CPU Total", "Avg", "Max", "Min",
+             "Ratio(%)", "Dev Total", "Dev Avg", "GFLOPs"),
+            (26, 7, 11, 9, 9, 9, 9, 11, 9, 10))
+
+    # ---- UserDefined Summary ----
+    if user:
+        rows = []
+        for name, a in sorted(user.items(), key=lambda kv: -kv[1].total):
+            rows.append((name, a.calls, _fmt(a.total, scale),
+                         _fmt(a.total / max(a.calls, 1), scale),
+                         _fmt(a.mx, scale),
+                         _fmt(a.mn if a.calls else 0.0, scale)))
+        lines += _table("UserDefined Summary", rows,
+                        ("Name", "Calls", "Total", "Avg", "Max", "Min"),
+                        (28, 8, 12, 10, 10, 10))
     return "\n".join(lines)
