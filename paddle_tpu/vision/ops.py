@@ -652,11 +652,14 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
             iou = inter / (area[:, None] + area[None, :] - inter)
             iou = _np.triu(iou, 1)
             iou_cmax = iou.max(0)
+            # decay_j = min_i f(iou_ij) / f(iou_cmax_i): the compensation
+            # term is the suppressor row i's own max-IoU with boxes above
+            # IT, so iou_cmax broadcasts along rows
             if use_gaussian:
-                decay = _np.exp((iou_cmax ** 2 - iou ** 2)
+                decay = _np.exp((iou_cmax[:, None] ** 2 - iou ** 2)
                                 / gaussian_sigma).min(0)
             else:
-                decay = ((1 - iou) / _np.maximum(1 - iou_cmax[None, :],
+                decay = ((1 - iou) / _np.maximum(1 - iou_cmax[:, None],
                                                  1e-9)).min(0)
             dec_s = s_c * decay
             sel = dec_s >= post_threshold
