@@ -230,16 +230,23 @@ def main():
         timing = {"t1_s": round(t1, 6), "tN_s": round(tN, 6), "N": steps,
                   "slope_s_per_step": round(slope, 6), "method": "slope"}
     else:
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = train_step(x, y)
-        jax.block_until_ready(loss._data_)
-        dt = time.perf_counter() - t0
+        # min-of-k: single-sample wall clock of a 3-step tiny run varies
+        # ±15% with transient host load (benchmarks/CPU_SMOKE_VARIANCE.md)
+        # — the fastest of three loops is the stable regression canary
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = train_step(x, y)
+            jax.block_until_ready(loss._data_)
+            times.append(time.perf_counter() - t0)
+        dt = min(times)
         # force a value read BEFORE reporting: async dispatch errors (e.g.
         # resource exhaustion) must fail the bench, not surface after JSON
         final_loss = float(loss)
         tokens_per_sec = batch * seq * steps / dt
-        timing = {"total_s": round(dt, 6), "N": steps, "method": "wall"}
+        timing = {"loops_s": [round(t, 6) for t in times], "N": steps,
+                  "method": "best_of_3"}
     # analytic FLOPs from registry metadata: one counted eager forward
     # (profiler-computed, not a per-model hand formula)
     from paddle_tpu.profiler import count_flops
@@ -271,6 +278,9 @@ def main():
                  "mfu": base.get("mfu")}}
     entry = base.get(plat_key)
     prev = entry.get("tokens_per_sec") if isinstance(entry, dict) else None
+    if not on_tpu and isinstance(entry, dict) and \
+            entry.get("method") != timing["method"]:
+        prev = None   # estimator changed: re-seed the cpu baseline
     vs_baseline = tokens_per_sec / prev if prev else 1.0
 
     # Every successful TPU measurement appends a raw, auditable record —
@@ -313,7 +323,8 @@ def main():
             _log(f"could not append run record: {e}")
 
     if not prev or tokens_per_sec > prev:
-        base[plat_key] = {"tokens_per_sec": tokens_per_sec, "mfu": mfu}
+        base[plat_key] = {"tokens_per_sec": tokens_per_sec, "mfu": mfu,
+                          "method": timing["method"]}
         if on_tpu:
             base[plat_key]["runs_log"] = "benchmarks/TPU_RUNS.jsonl"
             base[plat_key]["run_ts"] = run_ts
