@@ -281,6 +281,9 @@ def main():
     if not on_tpu and isinstance(entry, dict) and \
             entry.get("method") != timing["method"]:
         prev = None   # estimator changed: re-seed the cpu baseline
+        _log(f"cpu timing estimator changed "
+             f"({entry.get('method')!r} -> {timing['method']!r}); "
+             f"re-seeding the cpu baseline (vs_baseline will read 1.0)")
     vs_baseline = tokens_per_sec / prev if prev else 1.0
 
     # Every successful TPU measurement appends a raw, auditable record —

@@ -97,7 +97,11 @@ class MoELayer(Layer):
             topk = gate.get("top_k", 2 if gtype == "gshard" else 1)
             cls = {"gshard": GShardGate, "switch": SwitchGate,
                    "naive": NaiveGate}[gtype]
-            kwargs = {} if gtype == "naive" else {}
+            kwargs = {}
+            if gtype != "naive" and "capacity" in gate:
+                # (train_factor, eval_factor) — lower it to force
+                # token dropping (reference: gshard_gate capacity arg)
+                kwargs["capacity"] = gate["capacity"]
             self.gate = cls(d_model, self.num_expert, 1, topk=topk,
                             **kwargs)
         elif isinstance(gate, BaseGate):

@@ -126,7 +126,8 @@ class ParallelGPTMLP(Layer):
 
 class ParallelGPTBlock(Layer):
     def __init__(self, config: GPTConfig, sequence_parallel=False,
-                 use_ring_attention=False, use_moe=False, num_experts=8):
+                 use_ring_attention=False, use_moe=False, num_experts=8,
+                 moe_capacity=None):
         super().__init__()
         self.sequence_parallel = sequence_parallel
         self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
@@ -135,10 +136,15 @@ class ParallelGPTBlock(Layer):
         if use_moe:
             # expert-parallel FFN (incubate MoE): experts sharded over mp
             from ..incubate.distributed.models.moe import MoELayer
+            gate = {"type": "gshard", "top_k": 2}
+            if moe_capacity is not None:
+                # (train, eval) capacity factors; small values force the
+                # token-drop path (reference: gshard capacity semantics)
+                gate["capacity"] = moe_capacity
             self.mlp = MoELayer(d_model=config.hidden_size,
                                 num_expert=num_experts,
                                 d_hidden=config.intermediate_size,
-                                gate={"type": "gshard", "top_k": 2})
+                                gate=gate)
         else:
             self.mlp = ParallelGPTMLP(config)
         self.dropout = Dropout(config.dropout)
@@ -154,7 +160,8 @@ class ParallelGPTBlock(Layer):
 
 class ParallelGPTModel(Layer):
     def __init__(self, config: GPTConfig, sequence_parallel=False,
-                 use_ring_attention=False, moe_every=0, num_experts=8):
+                 use_ring_attention=False, moe_every=0, num_experts=8,
+                 moe_capacity=None):
         super().__init__()
         self.config = config
         emb_init = ParamAttr(initializer=Normal(0.0, config.initializer_range))
@@ -169,7 +176,7 @@ class ParallelGPTModel(Layer):
             ParallelGPTBlock(
                 config, sequence_parallel, use_ring_attention,
                 use_moe=(moe_every > 0 and (i + 1) % moe_every == 0),
-                num_experts=num_experts)
+                num_experts=num_experts, moe_capacity=moe_capacity)
             for i in range(config.num_layers)])
         self.ln_f = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_eps)
@@ -194,12 +201,13 @@ class ParallelGPTForCausalLM(Layer):
     """
 
     def __init__(self, config: GPTConfig, sequence_parallel=False,
-                 use_ring_attention=False, moe_every=0, num_experts=8):
+                 use_ring_attention=False, moe_every=0, num_experts=8,
+                 moe_capacity=None):
         super().__init__()
         self.config = config
         self.gpt = ParallelGPTModel(config, sequence_parallel,
                                     use_ring_attention, moe_every,
-                                    num_experts)
+                                    num_experts, moe_capacity)
         self.loss_fn = ParallelCrossEntropy()
 
     def forward(self, input_ids, labels=None, position_ids=None):

@@ -12,6 +12,11 @@ import os
 import jax  # noqa: E402 (already imported by sitecustomize under axon)
 
 jax.config.update("jax_platforms", "cpu")
+# ...and export the same at the env level so every subprocess the tests
+# spawn (launch/elastic/rpc/ps workers) inherits CPU and can never contend
+# for the single tunneled TPU claim with a concurrently-running bench.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

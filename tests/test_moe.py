@@ -130,3 +130,129 @@ def test_moe_with_per_expert_layers():
     x = paddle.randn([8, 8])
     y = moe(x)
     assert tuple(y.shape) == (8, 8)
+
+
+def test_switch_capacity_drops_tokens():
+    """Token-drop-at-capacity numerics (VERDICT r3 #7; reference:
+    moe gate capacity path): with capacity forced below demand, each
+    expert holds at most `cap` tokens, dropped tokens produce exactly
+    zero output, and tokens kept under the tight capacity match the
+    ample-capacity run bit-for-bit (switch combine weights are not
+    renormalized across drops)."""
+    paddle.seed(0)
+    n, e, d = 16, 2, 8
+    x = paddle.randn([n, d])
+
+    ample = MoELayer(d_model=d, num_expert=e, d_hidden=16,
+                     gate={"type": "switch", "top_k": 1,
+                           "capacity": (8.0, 8.0)})
+    ample.eval()
+    y_full = ample(x)
+    c_full, d_full, _ = ample.gate.dispatch_info(x, train=False)
+    assert (d_full.numpy().reshape(n, -1).sum(-1) == 1).all(), \
+        "ample capacity must dispatch every token"
+
+    tight = MoELayer(d_model=d, num_expert=e, d_hidden=16,
+                     gate={"type": "switch", "top_k": 1,
+                           "capacity": (0.25, 0.25)})  # cap = 2 slots
+    tight.eval()
+    # same parameters so the runs are comparable
+    tight.set_state_dict(ample.state_dict())
+    y_tight = tight(x)
+    c_t, d_t, _ = tight.gate.dispatch_info(x, train=False)
+
+    cap = 2  # int(max(1, 0.25 * 16 / 2))
+    per_expert = d_t.numpy().sum(axis=(0, 2))
+    assert (per_expert <= cap).all(), f"capacity violated: {per_expert}"
+    kept = d_t.numpy().reshape(n, -1).sum(-1) > 0
+    assert kept.sum() < n, "tight capacity must actually drop tokens"
+    # dropped tokens: output exactly zero (zero combine row)
+    np.testing.assert_array_equal(y_tight.numpy()[~kept], 0.0)
+    # kept tokens: identical to the ample-capacity run
+    np.testing.assert_allclose(y_tight.numpy()[kept],
+                               y_full.numpy()[kept], rtol=1e-6, atol=1e-7)
+
+
+def test_gshard_capacity_renormalizes_combine():
+    """GShard top-2: when the 2nd expert's slots fill up, the kept
+    token's combine weight renormalizes to its 1st expert (w1+w2 still
+    sums to 1 over surviving routes)."""
+    paddle.seed(3)
+    n, e, d = 32, 4, 8
+    g = GShardGate(d, e, 1, random_routing=False, capacity=(0.25, 0.25))
+    x = paddle.randn([n, d])
+    combine, dispatch, _ = g.dispatch_info(x, train=False)
+    dsp = dispatch.numpy()
+    cap = int(max(1, 0.25 * n / e * 2))
+    assert (dsp.sum(axis=(0, 2)) <= cap).all()
+    routes = dsp.reshape(n, -1).sum(-1)
+    assert (routes < 2).any(), "expect some tokens to lose a route"
+    w = combine.numpy().reshape(n, -1).sum(-1)
+    kept = routes > 0
+    np.testing.assert_allclose(w[kept], 1.0, atol=1e-5)
+    np.testing.assert_array_equal(w[~kept], 0.0)
+
+
+def test_moe_aux_loss_gradient_matches_numeric():
+    """Aux-loss gradient flows into the gate projection and matches a
+    central finite difference (OpTest pattern, SURVEY §4)."""
+    paddle.seed(0)
+    n, e, d = 12, 3, 6
+    g = SwitchGate(d, e, 1)
+    g.eval()  # no logit jitter: deterministic loss surface
+    x = paddle.randn([n, d])
+
+    def aux_of(gate):
+        _, _, aux = gate.dispatch_info(x, train=False)
+        return aux
+
+    aux = aux_of(g)
+    aux.backward()
+    gw = g.gate.weight.grad.numpy().copy()
+    assert np.isfinite(gw).all() and np.abs(gw).max() > 0
+
+    w0 = g.gate.weight.numpy().copy()
+    eps = 1e-3
+    for (i, j) in [(0, 0), (2, 1), (d - 1, e - 1)]:
+        for sgn in (1.0, -1.0):
+            w = w0.copy()
+            w[i, j] += sgn * eps
+            g.gate.weight.set_value(w)
+            if sgn > 0:
+                f_plus = float(aux_of(g))
+            else:
+                f_minus = float(aux_of(g))
+        g.gate.weight.set_value(w0)
+        num = (f_plus - f_minus) / (2 * eps)
+        np.testing.assert_allclose(gw[i, j], num, rtol=5e-2, atol=1e-4)
+
+
+def test_moe_ep_dp_hybrid_matches_replicated():
+    """EP×DP interaction (VERDICT r3 #7): experts sharded over mp while
+    the batch is data-parallel over dp — numerics must match the
+    single-device replicated run."""
+    paddle.seed(2)
+    moe = MoELayer(d_model=8, num_expert=4, d_hidden=16,
+                   gate={"type": "switch", "top_k": 1,
+                         "capacity": (0.5, 0.5)})  # forces drops too
+    moe.eval()
+    x = paddle.randn([16, 8])
+    ref = moe(x)
+    ref_loss = (ref ** 2).mean()
+    ref_loss.backward()
+    ref_g = moe._stacked.w1.grad.numpy().copy()
+    for p in moe.parameters():
+        p.clear_grad()
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
+                        "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(strategy=s)
+    fleet.distributed_model(moe)
+    out = moe(x)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                               rtol=2e-5, atol=1e-6)
+    loss = (out ** 2).mean()
+    loss.backward()
+    np.testing.assert_allclose(moe._stacked.w1.grad.numpy(), ref_g,
+                               rtol=1e-4, atol=1e-6)
