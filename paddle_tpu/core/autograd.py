@@ -274,10 +274,21 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
         if g is not None:
             g_t = g if isinstance(g, Tensor) else Tensor(g)
             if t.grad is None:
-                t.grad = g_t
+                # rewrap unless differentiable (create_graph): .grad must
+                # own its buffer slot — a caller-visible cotangent stored
+                # directly would be mutated by later in-place
+                # accumulation/zeroing
+                t.grad = g_t if not g_t.stop_gradient \
+                    else Tensor(g_t._data_)
+            elif not g_t.stop_gradient or not t.grad.stop_gradient:
+                # keep the accumulation differentiable / don't mutate a
+                # grad a retained higher-order graph may reference
+                t.grad = t.grad + g_t
             else:
-                t.grad = t.grad + g_t if isinstance(g, Tensor) else \
-                    Tensor(t.grad._data + g)
+                # in-place accumulate (reference eager accumulation node):
+                # the grad object's identity stays stable across steps,
+                # which compiled segments rely on for capture-by-identity
+                t.grad._data = t.grad._data + g_t._data_
         if t._grad_node is not None:
             stack.extend(t._grad_node.inputs)
     return None

@@ -151,8 +151,20 @@ class Tensor:
     def backward(self, grad_tensor=None, retain_graph=False):
         run_backward([self], [grad_tensor], retain_graph=retain_graph)
 
-    def clear_grad(self):
-        self.grad = None
+    def clear_grad(self, set_to_zero=True):
+        """Reference semantics (Tensor.clear_gradient, default
+        set_to_zero=True): zero the gradient IN PLACE so the grad
+        tensor's identity is stable across steps — compiled/piecewise
+        train steps capture grads by object identity, and a dropped
+        object would force an eager fallback (jit/sot.py)."""
+        g = self.grad
+        if g is not None and set_to_zero and g.stop_gradient:
+            # plain holder: zero in place, keeping the object stable
+            g._data = jnp.zeros_like(g._data_)
+        else:
+            # differentiable grad (create_graph): a retained higher-order
+            # graph may reference it — drop the binding, don't mutate
+            self.grad = None
 
     clear_gradient = clear_grad
 

@@ -1,0 +1,281 @@
+"""Piecewise (sub-graph) compilation on graph breaks — the SOT analog.
+
+Reference capability: paddle's SOT intercepts bytecode via an eval-frame
+hook (reference: paddle/fluid/pybind/jit.cc:65) and an opcode simulator
+(python/paddle/jit/sot/opcode_translator/) so a host-side interaction in
+the middle of a function splits it into multiple compiled sub-graphs with
+the interposing python executed eagerly, instead of dropping the whole
+function to eager.
+
+TPU-native realization: instead of simulating bytecode, the break point
+is re-planned at the AST level.  When the bind trace hits an escaping
+host read (float()/item()/numpy() of a traced value), the discovery
+pass has already recorded the source line of every such read (the frame
+of the traced function is walked at read time, so reads inside callees
+attribute to the calling statement).  `build_piecewise` then splits the
+function's TOP-LEVEL statements into maximal runs that contain no
+breaking line — each run becomes a nested function over a locals dict,
+compiled with the existing StaticFunction machinery (guards, mutation
+capture, donation, per-signature caches) — while the breaking statements
+themselves execute eagerly between the compiled segments.  Python
+effects (print/log of a loss value) therefore fire on EVERY call, and
+the matmuls on either side stay compiled.
+
+Granularity is the top-level statement: a host read nested inside a
+compound statement (loop/with/if) makes that whole statement eager, and
+a function whose source is unavailable (lambda, exec) or that returns
+from a non-terminal position stays on the whole-function eager fallback.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+
+
+class _PWReturn(Exception):
+    """Early `return` executed inside an eager piece."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+class _EnvNS(dict):
+    """Execution namespace that falls back to the traced function's LIVE
+    module globals.  Eager pieces exec with this as their single
+    namespace (globals == locals), so nested scopes (genexps, lambdas)
+    resolve enclosing locals via LOAD_GLOBAL, and module-global reads see
+    later mutations instead of a stale snapshot."""
+
+    def __init__(self, base):
+        super().__init__()
+        self._pw_base = base
+
+    def __missing__(self, key):
+        return self._pw_base[key]   # raises KeyError -> NameError in exec
+
+
+class _RewriteEagerReturn(ast.NodeTransformer):
+    """`return X` inside an eager piece -> `raise _PWReturn(X)`."""
+
+    def visit_Return(self, node):
+        val = node.value or ast.Constant(value=None)
+        return ast.copy_location(
+            ast.Raise(exc=ast.Call(func=ast.Name("__pw_return_exc__",
+                                                 ctx=ast.Load()),
+                                   args=[val], keywords=[]),
+                      cause=None), node)
+
+    def visit_FunctionDef(self, node):
+        return node  # don't descend into nested defs
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+class _RewriteSegReturn(ast.NodeTransformer):
+    """`return X` inside a compiled segment -> tagged tuple return."""
+
+    def visit_Return(self, node):
+        val = node.value or ast.Constant(value=None)
+        return ast.copy_location(
+            ast.Return(value=ast.Tuple(
+                elts=[ast.Constant(value="__pw_return__"), val],
+                ctx=ast.Load())), node)
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _names_loaded(stmts):
+    """Names a statement run reads (incl. aug-assign targets, which read
+    their current value before writing)."""
+    loads = set()
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                loads.add(node.id)
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name):
+                loads.add(node.target.id)
+    return loads
+
+
+def _names_stored(stmts):
+    stored = set()
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                stored.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                stored.add(node.name)
+    return stored
+
+
+def _param_names(fdef):
+    a = fdef.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _unsplittable(fdef):
+    """Constructs the piecewise protocol can't represent: generators /
+    coroutines (resumable frames) and `global`/`nonlocal` declarations
+    (pieces execute in derived namespaces, so rebinding the enclosing
+    scope would be silently lost)."""
+    for node in ast.walk(fdef):
+        if isinstance(node, (ast.Yield, ast.YieldFrom, ast.Await,
+                             ast.Global, ast.Nonlocal)):
+            return True
+    return False
+
+
+def build_piecewise(fn, break_lines_abs, warmups=1):
+    """Split `fn` at the given absolute source lines into compiled
+    segments + eager break statements.  Returns a driver callable with
+    eager-identical semantics, or None when the function can't be split
+    (no source, breaks unresolvable, generator/coroutine)."""
+    from .tracer import StaticFunction
+
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    if not tree.body or not isinstance(tree.body[0], ast.FunctionDef):
+        return None
+    fdef = tree.body[0]
+    if _unsplittable(fdef):
+        return None
+
+    # absolute file line -> line in the parsed (dedented) source.  Both
+    # co_firstlineno and the parsed source start at the first decorator
+    # (or the `def` when undecorated), so the offset is uniform.
+    first = fn.__code__.co_firstlineno
+    break_rel = {ln - first + 1 for ln in break_lines_abs}
+
+    breaking = []
+    for stmt in fdef.body:
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        breaking.append(any(stmt.lineno <= ln <= end for ln in break_rel))
+    if not any(breaking) or all(breaking):
+        return None
+
+    pieces = []          # ("compiled"|"eager", [stmts])
+    for stmt, brk in zip(fdef.body, breaking):
+        kind = "eager" if brk else "compiled"
+        if pieces and pieces[-1][0] == kind:
+            pieces[-1][1].append(stmt)
+        else:
+            pieces.append((kind, [stmt]))
+
+    # shared definition namespace: LIVE module globals underneath (module-
+    # level mutations between calls stay visible), closure cells and the
+    # return-protocol exception on top
+    glb = _EnvNS(fn.__globals__)
+    glb["__pw_return_exc__"] = _PWReturn
+    if fn.__closure__:
+        glb.update({name: cell.cell_contents for name, cell in
+                    zip(fn.__code__.co_freevars, fn.__closure__)})
+
+    params = _param_names(fdef)
+    available = set(params)
+    compiled_pieces = 0
+    runners = []         # (kind, loads, stores, callable/code)
+    for kind, stmts in pieces:
+        loads = sorted(_names_loaded(stmts) & available)
+        stores = sorted(_names_stored(stmts))
+        if kind == "compiled":
+            seg_name = f"__pw_seg_{len(runners)}__"
+            body = [_RewriteSegReturn().visit(s) for s in stmts]
+            lines = [f"def {seg_name}(__pw_env__):"]
+            for n in loads:
+                lines.append(f"    if {n!r} in __pw_env__: "
+                             f"{n} = __pw_env__[{n!r}]")
+            for s in body:
+                lines.append(textwrap.indent(ast.unparse(s), "    "))
+            lines.append(
+                "    return ('__pw_env__', {__k: __v for __k, __v in "
+                "locals().items() if not __k.startswith('__pw')})")
+            try:
+                exec(compile("\n".join(lines), f"<piecewise {fn.__name__}>",
+                             "exec"), glb)
+            except SyntaxError:
+                return None
+            seg = StaticFunction(glb[seg_name])
+            seg._no_piecewise = True   # a segment never re-splits itself
+            runners.append(("compiled", loads, stores, seg))
+            compiled_pieces += 1
+        else:
+            body = [_RewriteEagerReturn().visit(s) for s in stmts]
+            mod = ast.Module(body=body, type_ignores=[])
+            ast.fix_missing_locations(mod)
+            code = compile(mod, f"<piecewise-eager {fn.__name__}>", "exec")
+            runners.append(("eager", loads, stores, code))
+        available |= set(stores)
+    if compiled_pieces == 0:
+        return None
+
+    sig = inspect.signature(fn)
+
+    def _seg_env(env, loads):
+        """python floats crossing into a compiled segment are promoted to
+        0-d tensors: a host-read value (e.g. a logged loss) that flows
+        back into compiled code would otherwise bake into the signature
+        and force a recompile per distinct value."""
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        out = {}
+        for k in loads:
+            if k in env:
+                v = env[k]
+                if type(v) is float:
+                    v = Tensor(jnp.asarray(v, jnp.float32))
+                out[k] = v
+        return out
+
+    def driver(*args, **kwargs):
+        bound = sig.bind(*args, **kwargs)
+        bound.apply_defaults()
+        env = dict(bound.arguments)
+        try:
+            for kind, loads, stores, run in runners:
+                if kind == "compiled":
+                    out = run(_seg_env(env, loads))
+                    tag, val = out
+                    if tag == "__pw_return__":
+                        return val
+                    env.update(val)
+                else:
+                    # single namespace (globals == locals): nested scopes
+                    # in the eager statements (genexps, lambdas) resolve
+                    # the function's locals via LOAD_GLOBAL
+                    ns = _EnvNS(fn.__globals__)
+                    ns["__pw_return_exc__"] = _PWReturn
+                    if fn.__closure__:
+                        ns.update(zip(fn.__code__.co_freevars,
+                                      (c.cell_contents
+                                       for c in fn.__closure__)))
+                    ns.update(env)
+                    exec(run, ns)
+                    for n in stores:
+                        if n in ns:
+                            env[n] = ns[n]
+        except _PWReturn as r:
+            return r.value
+        return None
+
+    driver.__name__ = f"{fn.__name__}__piecewise"
+    driver.__wrapped__ = fn
+    driver._segments = [r for k, _, _, r in runners if k == "compiled"]
+    driver._n_pieces = len(runners)
+    return driver

@@ -50,12 +50,13 @@ class GraphBreak(Exception):
 class _DiscoveryTracer:
     """Records captures + host providers during the eager first call."""
 
-    def __init__(self):
+    def __init__(self, fn_code=None):
         self.created = set()          # id(Tensor) made during trace
         self.captured = {}            # id(Tensor) -> Tensor (ordered via list)
         self.capture_list = []
         self.providers = []           # host-value providers, call order
-        self.host_reads = []          # (is_bool_read, recorded value)
+        self.host_reads = []          # (is_bool_read, value, lineno-in-fn)
+        self.fn_code = fn_code        # code object of the traced function
         self.rng_counter = 0
         self._rng_provider_registered = False
         self._rng_base_val = None
@@ -76,9 +77,21 @@ class _DiscoveryTracer:
 
     def host_read(self, t, bool_read=False):
         """A host read during discovery: record the value so the bind trace
-        can replay the same control-flow path (and guard it)."""
+        can replay the same control-flow path (and guard it), plus the
+        source line WITHIN the traced function where the read happened —
+        the split points for piecewise compilation (jit/sot.py) if this
+        read later escapes at bind time."""
         val = np.asarray(t._data)     # property read → capture bookkeeping
-        self.host_reads.append((bool_read, val.copy()))
+        lineno = None
+        if self.fn_code is not None:
+            import sys
+            f = sys._getframe(1)
+            while f is not None:
+                if f.f_code is self.fn_code:
+                    lineno = f.f_lineno
+                    break
+                f = f.f_back
+        self.host_reads.append((bool_read, val.copy(), lineno))
         return val
 
     def host_input(self, provider):
@@ -143,7 +156,7 @@ class _BindTracer:
         arr = t._data_
         if self.read_idx >= len(self.host_reads):
             raise GraphBreak("host-read sequence diverged from discovery")
-        rec_bool, rec_val = self.host_reads[self.read_idx]
+        rec_bool, rec_val = self.host_reads[self.read_idx][:2]
         self.read_idx += 1
         if bool_read:
             # every discovery bool read must yield exactly one guard output
@@ -156,10 +169,13 @@ class _BindTracer:
                     else np.asarray(arr))
         if not isinstance(arr, jax.core.Tracer):
             return np.asarray(arr)
-        raise GraphBreak(
+        gb = GraphBreak(
             "host read of a traced value (float()/item()/numpy()) — the "
             "value escapes into python, which a compiled program cannot "
             "replay; falling back to eager for this signature")
+        gb.splittable = True   # the recorded read lines ARE the cause —
+        # piecewise sub-graph compilation (jit/sot.py) can remove it
+        raise gb
 
     def host_input(self, provider):
         v = self.host_tracers[self.host_idx]
@@ -233,7 +249,7 @@ class _CompiledEntry:
         self.mut_targets = []     # Tensors whose data is replaced after call
         self.grad_targets = []    # Tensors whose .grad is materialized
         self.out_struct = None
-        self.host_reads = []      # discovery-recorded (is_bool, value)
+        self.host_reads = []      # discovery-recorded (is_bool, value, line)
         self.guard_bools = ()     # the branch bits this entry specializes on
         self.pure = None          # the traced body (shared by both jits)
         self.jitted_donate = None  # donating variant, built after 1st run
@@ -244,13 +260,15 @@ class _SigState:
     """Per-input-signature compile state: guard-keyed entries (SOT's
     guard-keyed compile cache analog) + eager fallback bookkeeping."""
 
-    __slots__ = ("entries", "last", "eager_only", "rediscoveries")
+    __slots__ = ("entries", "last", "eager_only", "rediscoveries",
+                 "piecewise")
 
     def __init__(self):
         self.entries = {}         # guard tuple -> _CompiledEntry
         self.last = None
         self.eager_only = False
         self.rediscoveries = 0
+        self.piecewise = None     # sub-graph driver after a graph break
 
 
 class StaticFunction:
@@ -370,6 +388,9 @@ class StaticFunction:
         if state is _WARMUP:
             _monitor.incr("jit.cache_miss")
             return self._discover(key, args, kwargs)
+        if state.piecewise is not None:
+            _monitor.incr("jit.piecewise_call")
+            return state.piecewise(*args, **kwargs)
         if state.eager_only:
             _monitor.incr("jit.eager_fallback")
             return self._fn(*args, **kwargs)
@@ -379,7 +400,8 @@ class StaticFunction:
     # ---------------- phase 1: discovery (eager) ----------------
     def _discover(self, key, args, kwargs):
         entry = _CompiledEntry()
-        tracer = _DiscoveryTracer()
+        tracer = _DiscoveryTracer(
+            fn_code=getattr(self._fn, "__code__", None))
         _state.STATE.tracer = tracer
         try:
             out = self._fn(*args, **kwargs)
@@ -388,8 +410,8 @@ class StaticFunction:
         entry.captures = tracer.capture_list
         entry.providers = tracer.providers
         entry.host_reads = tracer.host_reads
-        entry.guard_bools = tuple(bool(v) for b, v in tracer.host_reads
-                                  if b)
+        entry.guard_bools = tuple(bool(rec[1]) for rec in tracer.host_reads
+                                  if rec[0])
         self._build(entry, args, kwargs)
         state = self._cache.get(key)
         if not isinstance(state, _SigState):
@@ -434,8 +456,13 @@ class StaticFunction:
                 grad_arrays = []
                 for t in entry.captures:
                     g = t.grad
-                    if g is not None and isinstance(g._data_,
-                                                    jax.core.Tracer):
+                    # grads accumulated IN PLACE into a pre-existing grad
+                    # tensor are already mut_targets — collecting them
+                    # here too would null the object and break the
+                    # stable-identity contract piecewise segments rely on
+                    if (g is not None and isinstance(g._data_,
+                                                     jax.core.Tracer)
+                            and id(g) not in tracer.mutated):
                         entry.grad_targets.append(t)
                         grad_arrays.append(g._data_)
                 for t in entry.grad_targets:
@@ -544,8 +571,31 @@ class StaticFunction:
                         and entry.mut_targets):
                     self._build_donating(entry)
         except GraphBreak as e:
-            # the program cannot represent this function — eager fallback
-            # for this signature from now on (SOT piecewise-fallback analog)
+            # the program cannot represent the whole function.  First try
+            # a piecewise split (SOT sub-graph analog, jit/sot.py): compile
+            # the statement runs around the escaping host reads and run
+            # the breaking statements eagerly between them.
+            pw = None
+            if (getattr(e, "splittable", False)
+                    and not getattr(self, "_no_piecewise", False)):
+                lines = sorted({rec[2] for rec in entry.host_reads
+                                if not rec[0] and len(rec) > 2 and rec[2]})
+                if lines:
+                    from .sot import build_piecewise
+                    try:
+                        pw = build_piecewise(self._fn, lines)
+                    except Exception:
+                        pw = None
+            if pw is not None:
+                state.piecewise = pw
+                warnings.warn(
+                    f"to_static graph break ({e}); split "
+                    f"{getattr(self._fn, '__name__', '?')} into "
+                    f"{pw._n_pieces} pieces "
+                    f"({len(pw._segments)} compiled sub-graphs) for this "
+                    f"input signature")
+                return pw(*args, **kwargs)
+            # unsplittable — eager fallback for this signature from now on
             state.eager_only = True
             warnings.warn(f"to_static graph break ({e}); running "
                           f"{getattr(self._fn, '__name__', '?')} eagerly "

@@ -181,9 +181,9 @@ class Optimizer:
                       wd_mask):
         raise NotImplementedError
 
-    def clear_grad(self, set_to_zero=False):
+    def clear_grad(self, set_to_zero=True):
         for p in self._parameter_list:
-            p.clear_grad()
+            p.clear_grad(set_to_zero)
 
     clear_gradients = clear_grad
 

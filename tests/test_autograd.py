@@ -61,9 +61,18 @@ def test_backward_accumulates():
 
 
 def test_clear_grad():
+    # reference default (set_to_zero=True): zero IN PLACE, same object —
+    # stable grad identity is what compiled train steps capture against
     x = paddle.to_tensor([2.0], stop_gradient=False)
     (x * x).backward()
+    g_obj = x.grad
     x.clear_grad()
+    assert x.grad is g_obj
+    assert float(x.grad.numpy()[0]) == 0.0
+    (x * x).backward()           # accumulates into the same object
+    assert x.grad is g_obj
+    assert float(x.grad.numpy()[0]) == pytest.approx(4.0)
+    x.clear_grad(set_to_zero=False)
     assert x.grad is None
 
 

@@ -698,3 +698,139 @@ def test_while_loop_grad_falls_back_on_host_read():
     acc.backward()
     assert float(acc.numpy()) == 8.0
     assert float(x.grad.numpy()) == 12.0
+
+
+def test_piecewise_subgraph_compile_on_host_read():
+    """SOT analog (jit/sot.py): a mid-body float() read splits the
+    function into compiled sub-graphs — the matmuls on BOTH sides of the
+    read stay compiled, and the python side effect fires on every call
+    (reference: pybind/jit.cc eval-frame hook + sot/opcode_translator)."""
+    logged = []
+    paddle.seed(11)
+    model1 = nn.Linear(4, 4)
+    model2 = nn.Linear(4, 2)
+
+    @paddle.jit.to_static
+    def step(x):
+        h = paddle.tanh(model1(x))
+        logged.append(float(h.sum()))     # host read + python effect
+        out = model2(h)
+        return out.sum()
+
+    x = paddle.ones([2, 4])
+    with paddle.no_grad():
+        h = paddle.tanh(model1(x))
+        ref = float(model2(h).sum())
+        ref_h = float(h.sum())
+
+    import warnings as _w
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        results = [float(step(x)) for _ in range(5)]
+        assert any("compiled sub-graphs" in str(w.message) for w in rec)
+    for r in results:
+        assert abs(r - ref) < 1e-4
+    # the python effect fired on EVERY call, compiled ones included
+    assert len(logged) == 5
+    assert all(abs(v - ref_h) < 1e-4 for v in logged)
+    # both sub-graphs really compiled (guard-keyed entries exist)
+    state = step._cache[step._canon_key((x,), {})]
+    assert state.piecewise is not None
+    segs = state.piecewise._segments
+    assert len(segs) == 2
+    assert all(s.guard_cache_size() >= 1 for s in segs)
+
+
+def test_piecewise_train_step_matches_eager():
+    """A training step with a mid-body host read (loss logging) still
+    trains correctly through the piecewise path: parameter mutations and
+    optimizer state cross the segment boundary."""
+    def build():
+        paddle.seed(3)
+        model = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 3))
+        opt = paddle.optimizer.AdamW(0.01, parameters=model.parameters())
+        return model, opt
+
+    np.random.seed(1)
+    xs = [np.random.randn(5, 6).astype(np.float32) for _ in range(6)]
+    ys = [np.random.randint(0, 3, (5,)) for _ in range(6)]
+    loss_fn = nn.CrossEntropyLoss()
+
+    # eager
+    model_e, opt_e = build()
+    eager_losses = []
+    for x, y in zip(xs, ys):
+        loss = loss_fn(model_e(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+        eager_losses.append(float(loss))
+
+    # piecewise-compiled: the float() read forces a split after backward
+    model_c, opt_c = build()
+    seen = []
+
+    @paddle.jit.to_static
+    def pstep(x, y):
+        loss = loss_fn(model_c(x), y)
+        loss.backward()
+        seen.append(float(loss))          # graph-breaking host read
+        opt_c.step()
+        opt_c.clear_grad()
+        return loss
+
+    pw_losses = [float(pstep(paddle.to_tensor(x), paddle.to_tensor(y)))
+                 for x, y in zip(xs, ys)]
+    np.testing.assert_allclose(eager_losses[:2], pw_losses[:2], rtol=1e-5)
+    np.testing.assert_allclose(eager_losses, pw_losses, rtol=5e-2)
+    np.testing.assert_allclose(model_e[0].weight.numpy(),
+                               model_c[0].weight.numpy(), atol=5e-3)
+    assert len(seen) == 6
+    state = pstep._cache[pstep._canon_key(
+        (paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0])), {})]
+    assert state.piecewise is not None
+    # BOTH sub-graphs compiled — in particular the optimizer segment,
+    # which relies on stable grad-object identity across steps
+    # (in-place clear_grad/accumulation, core/tensor.py clear_grad)
+    for seg in state.piecewise._segments:
+        assert seg.guard_cache_size() >= 1, seg.__name__
+        assert not any(s.eager_only for s in seg._cache.values()
+                       if hasattr(s, "eager_only")), seg.__name__
+
+
+def test_piecewise_eager_piece_nested_scope_and_live_globals():
+    """Eager pieces execute in a single namespace, so genexps/lambdas in
+    the breaking statement see the function's locals, and module-global
+    reads are live (not a snapshot taken at split time)."""
+    import sys
+    mod = sys.modules[__name__]
+    mod._pw_live_flag = 1.0
+    logged = []
+    paddle.seed(5)
+    lin = nn.Linear(3, 3)
+
+    @paddle.jit.to_static
+    def f(x):
+        h = lin(x)
+        parts = [h.sum(), (h * 2).sum()]
+        scale = 0.5
+        # genexp closes over `scale` and `parts`; reads a live global
+        logged.append(sum(float(p) * scale for p in parts)
+                      + _pw_live_flag)
+        return h * 2.0
+
+    x = paddle.ones([2, 3])
+    with paddle.no_grad():
+        h = lin(x)
+        s = (float(h.sum()) + 2 * float(h.sum())) * 0.5
+    outs = [f(x) for _ in range(4)]          # spans the piecewise switch
+    for o in outs:
+        np.testing.assert_allclose(o.numpy(), (h * 2.0).numpy(),
+                                   rtol=1e-5)
+    assert all(abs(v - (s + 1.0)) < 1e-4 for v in logged[:4])
+    mod._pw_live_flag = 10.0                 # mutate the module global
+    f(x)
+    assert abs(logged[-1] - (s + 10.0)) < 1e-4
+    state = f._cache[f._canon_key((x,), {})]
+    assert state.piecewise is not None
+    del mod._pw_live_flag
