@@ -49,13 +49,39 @@ def _other_jax_processes():
     return procs
 
 
+_PROBE_CMD = ("import jax; d=jax.devices(); import sys; "
+              "sys.exit(0 if d and d[0].platform in ('tpu', 'axon') "
+              "else 1)")
+
+
+def _probe_once(timeout):
+    """One subprocess TPU claim probe (the claim is released when the
+    subprocess exits).  A silent CPU fallback must NOT count — the
+    platform check keeps a dead relay from being recorded as hardware.
+    Returns (ok, detail) where detail explains a failure."""
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE_CMD],
+                           timeout=timeout, capture_output=True)
+        if r.returncode == 0:
+            return True, ""
+        return False, (f"rc={r.returncode}; stderr tail: "
+                       f"{r.stderr.decode(errors='replace').strip()[-500:]!r}")
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr or b"").decode(errors="replace").strip()[-500:]
+        return False, (f"timed out after {timeout:.0f}s (claim never "
+                       f"granted); stderr tail: {tail!r}")
+    except OSError as e:
+        return False, f"failed to launch: {e}"
+
+
 def _relay_up():
-    """Preflight: the axon claim rides a local TCP relay to the pool
-    (PALLAS_AXON_POOL_IPS).  If nothing accepts on the relay ports the
-    claim can never be granted.  A transiently-dead relay at driver
-    capture time must not erase the round's hardware evidence, so poll
-    for a window (BENCH_RELAY_WAIT seconds, default 5 min) before
-    surrendering to the CPU smoke."""
+    """Preflight: the axon claim rides a local relay to the pool
+    (PALLAS_AXON_POOL_IPS).  Loopback-mode relays (AXON_LOOPBACK_RELAY=1)
+    expose NO TCP listener on the historical relay ports, so a port scan
+    alone cannot decide — a successful claim probe is authoritative.  A
+    transiently-dead relay at driver capture time must not erase the
+    round's hardware evidence, so poll for a window (BENCH_RELAY_WAIT
+    seconds, default 5 min) before surrendering to the CPU smoke."""
     import socket
     pool = os.environ.get("PALLAS_AXON_POOL_IPS", "")
     if not pool:
@@ -67,23 +93,33 @@ def _relay_up():
     attempt = 0
     while True:
         attempt += 1
+        ports_ok = False
         for port in ports:
             try:
                 with socket.create_connection((host, port), timeout=3):
-                    if attempt > 1:
-                        _log(f"relay came up on attempt {attempt}")
-                    return True
+                    ports_ok = True
+                    break
             except OSError:
                 continue
+        if ports_ok:
+            if attempt > 1:
+                _log(f"relay came up on attempt {attempt}")
+            return "ports"
+        ok, _detail = _probe_once(90)
+        if ok:
+            if attempt > 1:
+                _log(f"relay came up on attempt {attempt}")
+            return "probe"   # claim already granted once — skip re-probe
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             break
-        _log(f"axon relay down (no listener on {host} ports {ports}); "
-             f"retrying for another {remaining:.0f}s ...")
+        _log(f"axon relay down (no port listener on {host} {ports} and "
+             f"claim probe failed); retrying for another "
+             f"{remaining:.0f}s ...")
         time.sleep(min(15.0, max(remaining, 0.1)))
     _log(f"axon relay tunnel is DOWN after {wait:.0f}s of polling: no "
-         f"listener on {host} ports {ports} — the TPU claim cannot be "
-         f"granted (relay process dead).  Falling back to CPU smoke.")
+         f"listener on {host} ports {ports} and no claim granted — "
+         f"falling back to CPU smoke.")
     return False
 
 
@@ -91,32 +127,24 @@ def _tpu_reachable():
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         _log("JAX_PLATFORMS=cpu set — skipping TPU probe")
         return False
-    if not _relay_up():
+    relay = _relay_up()
+    if not relay:
         return False
+    if relay == "probe":
+        _log("TPU probe succeeded (via relay preflight)")
+        return True
     for attempt in range(1, _PROBE_RETRIES + 1):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; d=jax.devices(); "
-                 "import sys; sys.exit(0 if d else 1)"],
-                timeout=_PROBE_TIMEOUT, capture_output=True)
-            if r.returncode == 0:
-                _log(f"TPU probe succeeded (attempt {attempt})")
-                return True
-            tail = r.stderr.decode(errors="replace").strip()[-500:]
-            _log(f"TPU probe attempt {attempt}/{_PROBE_RETRIES} exited "
-                 f"rc={r.returncode}; stderr tail: {tail!r}")
-        except subprocess.TimeoutExpired as e:
-            tail = (e.stderr or b"").decode(errors="replace").strip()[-500:]
-            _log(f"TPU probe attempt {attempt}/{_PROBE_RETRIES} timed out "
-                 f"after {_PROBE_TIMEOUT:.0f}s (claim never granted); "
-                 f"stderr tail: {tail!r}")
+        ok, detail = _probe_once(_PROBE_TIMEOUT)
+        if ok:
+            _log(f"TPU probe succeeded (attempt {attempt})")
+            return True
+        _log(f"TPU probe attempt {attempt}/{_PROBE_RETRIES} failed: "
+             f"{detail}")
+        if "timed out" in detail:
             others = _other_jax_processes()
             if others:
                 _log(f"possible claim holders (other python procs): "
                      f"{others}")
-        except OSError as e:
-            _log(f"TPU probe attempt {attempt} failed to launch: {e}")
         if attempt < _PROBE_RETRIES:
             backoff = 30 * attempt
             _log(f"backing off {backoff}s before retry")
