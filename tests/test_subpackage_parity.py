@@ -289,21 +289,30 @@ def test_dataset_folder(tmp_path):
     assert len(flat) == 4
 
 
-def test_model_zoo_families_forward():
+_ZOO_LIGHT = ["alexnet", "squeezenet1_0"]   # fast-lane representatives
+_ZOO_HEAVY = ["vgg11", "densenet121", "inception_v3",
+              "shufflenet_v2_x1_0", "mobilenet_v2", "mobilenet_v3_small",
+              "mobilenet_v3_large", "resnext50_32x4d", "wide_resnet50_2"]
+
+
+@pytest.mark.parametrize("name", _ZOO_LIGHT + _ZOO_HEAVY)
+def test_model_zoo_families_forward(name):
     """Every model family in the reference zoo instantiates and runs a
-    forward pass (tiny input; GoogLeNet returns (out, aux1, aux2))."""
+    forward pass (tiny input).  Heavy families run in the slow lane
+    (conftest _SLOW_TESTS); two light ones keep the family smoke fast."""
     from paddle_tpu.vision import models as M
     x = paddle.to_tensor(np.random.default_rng(0)
                          .normal(size=(1, 3, 64, 64)).astype(np.float32))
-    ctors = [M.vgg11, M.alexnet, M.squeezenet1_0, M.densenet121,
-             M.inception_v3, M.shufflenet_v2_x1_0, M.mobilenet_v2,
-             M.mobilenet_v3_small, M.mobilenet_v3_large,
-             M.resnext50_32x4d, M.wide_resnet50_2]
-    for ctor in ctors:
-        paddle.seed(0)
-        net = ctor(num_classes=7)
-        net.eval()
-        assert net(x).shape == [1, 7], ctor.__name__
+    paddle.seed(0)
+    net = getattr(M, name)(num_classes=7)
+    net.eval()
+    assert net(x).shape == [1, 7], name
+
+
+def test_googlenet_aux_heads():
+    from paddle_tpu.vision import models as M
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .normal(size=(1, 3, 64, 64)).astype(np.float32))
     out, aux1, aux2 = M.googlenet(num_classes=7)(x)
     assert out.shape == [1, 7] and aux1.shape == [1, 7]
 

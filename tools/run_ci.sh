@@ -14,8 +14,8 @@ python -m compileall -q paddle_tpu
 echo "== API compatibility gate =="
 python tools/check_api_compatible.py
 
-echo "== unit tests =="
-python -m pytest tests/ -q
+echo "== unit tests (full, incl. slow) =="
+PADDLE_TPU_RUN_SLOW=1 python -m pytest tests/ -q
 
 echo "== driver hooks compile =="
 python - <<'EOF'
