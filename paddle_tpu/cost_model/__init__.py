@@ -78,6 +78,12 @@ def transformer_step_cost(n_params, n_layers, hidden, batch, seq,
     flops = 6.0 * n_params * tokens
     n_dev = dp * mp * pp * sharding
     t_compute = flops / (spec.peak_flops_bf16 * n_dev)
+    # 1F1B pipeline bubble: with m micro-batches the schedule spans
+    # (m + pp - 1) slots of which m do useful work per stage
+    # (reference: auto_parallel/static/tuner/parallel_tuner.py pp cost)
+    if pp > 1:
+        m = max(int(grad_accum), 1)
+        t_compute *= (m + pp - 1) / m
 
     # memory per device: params+grads+opt (ZeRO over sharding·dp), acts
     state_bytes = n_params * (dtype_bytes + dtype_bytes + 8)

@@ -95,12 +95,18 @@ class Engine:
         self.history = {"loss": []}
 
     def plan(self, global_batch=None, seq_len=None, n_devices=None,
-             device=None):
+             device=None, mode="predict", max_trials=3):
         """Cost-based parallel planning (the reference's
-        rule_based_tuner/parallel_tuner step, static/tuner/): enumerate
-        dp×mp×pp×sharding factorizations of the device count, prune by HBM
-        capacity, rank with the roofline cost model, and install the best
-        config as the fleet strategy.  Call before prepare()/fit().
+        rule_based_tuner/parallel_tuner step, static/tuner/
+        parallel_tuner.py:36): enumerate dp×mp×pp×sharding factorizations
+        of the device count — INCLUDING pipeline configs when the model
+        can execute them — prune by HBM capacity, rank with the roofline
+        cost model, and install the best config as the fleet strategy.
+        Call before prepare()/fit().
+
+        mode="trial" confirms the roofline's top `max_trials` candidates
+        by profiled tiny-shape trial steps in subprocesses (reference:
+        static/tuner/optimization_tuner.py:194) before choosing.
 
         Returns the winning config dict (also stored on the strategy)."""
         import jax
@@ -123,20 +129,38 @@ class Engine:
         # from the standard 12·L·h² transformer budget.
         from collections import Counter
 
-        params = list(self._model.parameters())
-        n_params = float(sum(int(np.prod(p.shape)) for p in params))
+        params = (list(self._model.parameters())
+                  if self._model is not None else [])
+        n_params = float(sum(int(np.prod(p.shape)) for p in params)) \
+            or 1.3e9
         dim_counts = Counter(int(d) for p in params for d in p.shape
                              if int(d) > 1)
         hidden = dim_counts.most_common(1)[0][0] if dim_counts else 1024
-        n_layers = max(int(round(n_params / (12.0 * hidden * hidden))), 1)
+        # prefer the model's declared depth (pp pruning needs exact
+        # stage divisibility); fall back to the 12·L·h² estimate
+        model_cfg = getattr(self._model, "config", None)
+        n_layers = getattr(model_cfg, "num_layers", None) or \
+            max(int(round(n_params / (12.0 * hidden * hidden))), 1)
+        # pipeline plans are in the space when the model can execute a
+        # pipeline schedule (PipelineLayer.train_batch) or when planning
+        # without a concrete model; a plain layer stays single-program
+        from ..fleet.meta_parallel.pp_layers import PipelineLayer
+        pipeline_capable = (self._model is None
+                            or isinstance(self._model, PipelineLayer)
+                            or hasattr(self._model, "train_batch"))
         cfg = TunerConfig(
             n_devices=n_dev, device=device, n_params=n_params,
             n_layers=n_layers, hidden=hidden,
             global_batch=global_batch or 8 * n_dev,
             seq_len=seq_len or 1024,
-            pp_candidates=[1],  # engine path is single-program SPMD
+            pp_candidates=[] if pipeline_capable else [1],
         )
-        best = AutoTuner(cfg).tune(mode="predict")
+        tuner = AutoTuner(cfg)
+        if mode == "trial":
+            best = tuner.tune_by_spmd_trial(n_devices=n_dev,
+                                            max_trials=max_trials)
+        else:
+            best = tuner.tune(mode="predict")
         if best is None:
             best = {"dp": n_dev, "mp": 1, "pp": 1, "sharding": 1}
         # write through to the inner DistributedStrategy: Strategy only
@@ -163,13 +187,37 @@ class Engine:
             fleet.init(strategy=inner
                        if getattr(self, "_planned", None) else None)
         mesh = get_mesh()
-        fleet_base._commit_params(self._model, mesh)
+        from ..fleet.meta_parallel.pp_layers import PipelineLayer
+        if isinstance(self._model, PipelineLayer) and \
+                getattr(self, "_planned", {}).get("pp", 1) > 1:
+            # pipeline plan: re-stage to the planned pp degree if the
+            # model was built before the mesh existed, then wrap into
+            # the schedule executor (the loss lives inside the pipe
+            # model).  Re-staging rebuilds layers — plan before loading
+            # pretrained weights.
+            from .. import fleet
+            m = self._model
+            pp_deg = mesh.get_dim_size("pp") if "pp" in mesh.dim_names \
+                else 1
+            if m._num_stages != pp_deg:
+                m = PipelineLayer(
+                    m._descs, num_stages=None,
+                    seg_method=m._seg_method, loss_fn=m._loss_fn,
+                    num_virtual_pipeline_stages=m._num_chunks)
+            self._model = fleet.distributed_model(m)
+            if self._optimizer is not None:
+                self._optimizer._parameter_list = \
+                    list(self._model.parameters())
+        else:
+            fleet_base._commit_params(self._model, mesh)
         if self._optimizer is not None:
             shard_optimizer(self._optimizer)
         self._prepared = True
         return self
 
     def _step(self, x, y):
+        if hasattr(self._model, "train_batch"):
+            return self._model.train_batch((x, y), self._optimizer)
         out = self._model(x)
         loss = self._loss(out, y)
         loss.backward()

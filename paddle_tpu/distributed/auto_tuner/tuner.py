@@ -111,35 +111,80 @@ class AutoTuner:
             self.history.append((cand, tput))
             if tput > best_tput:
                 best, best_tput = cand, tput
+        if best_tput <= 0:
+            # every trial failed: fall back to the roofline winner — the
+            # trials exist to CONFIRM the model's ranking, not to replace
+            # it with a worst-case default
+            return cands[0] if cands else None
         return best
+
+    @staticmethod
+    def _launch_trial(cand, argv, extra_env=None, timeout=600):
+        """Run one trial subprocess: candidate via PADDLE_AUTO_TUNER_CONFIG
+        (json env), metric parsed from an ``AUTO_TUNER_METRIC: <v>`` line.
+        Failed/silent trials score -1 and never win."""
+        import json
+        import os
+        import re
+        import subprocess
+
+        env = dict(os.environ)
+        env["PADDLE_AUTO_TUNER_CONFIG"] = json.dumps(
+            {k: v for k, v in cand.items() if not k.startswith("_")})
+        env.update(extra_env or {})
+        p = subprocess.run(argv, env=env, capture_output=True,
+                           timeout=timeout)
+        m = re.search(rb"AUTO_TUNER_METRIC:\s*([0-9.eE+-]+)",
+                      p.stdout + p.stderr)
+        return float(m.group(1)) if m and p.returncode == 0 else -1.0
 
     def tune_by_launch(self, script, script_args=(), max_trials=3,
                        nproc_per_node=1, timeout=600):
         """End-to-end trial loop (reference: auto_tuner/tuner.py:19 main
         loop): launch `script` through paddle_tpu.distributed.launch once
-        per candidate, passing the candidate via PADDLE_AUTO_TUNER_CONFIG
-        (json env); the trial reports its metric by printing
-        ``AUTO_TUNER_METRIC: <tokens_per_sec>``.  Failed/silent trials
-        score -1 and never win."""
-        import json
-        import os
-        import re
-        import subprocess
+        per candidate."""
         import sys
 
         def trial_fn(cand):
-            env = dict(os.environ)
-            env["PADDLE_AUTO_TUNER_CONFIG"] = json.dumps(
-                {k: v for k, v in cand.items() if not k.startswith("_")})
-            p = subprocess.run(
+            return self._launch_trial(
+                cand,
                 [sys.executable, "-m", "paddle_tpu.distributed.launch",
                  "--nproc_per_node", str(nproc_per_node),
                  script, *script_args],
-                env=env, capture_output=True, timeout=timeout)
-            m = re.search(rb"AUTO_TUNER_METRIC:\s*([0-9.eE+-]+)",
-                          p.stdout + p.stderr)
-            return (float(m.group(1))
-                    if m and p.returncode == 0 else -1.0)
+                timeout=timeout)
+
+        return self.tune(trial_fn=trial_fn, max_trials=max_trials)
+
+    def tune_by_spmd_trial(self, n_devices=None, max_trials=3,
+                           timeout=900, hidden=64, layers=None, seq=64):
+        """Confirm the roofline's top candidates by PROFILED tiny-shape
+        trials (reference: static/tuner/optimization_tuner.py:194): each
+        candidate's real dp/mp/pp/sharding machinery runs a compiled
+        train step over a virtual device mesh in a subprocess; measured
+        step time picks the winner."""
+        import sys
+
+        n_dev = n_devices or self.cfg.n_devices
+        # one FIXED depth for every candidate — per-candidate depths
+        # would compare different models.  Any pp candidate divides
+        # n_dev, and n_dev divides this depth, so all schedules stage
+        # evenly.
+        if layers is None:
+            layers = n_dev
+        elif layers % n_dev:
+            layers = (layers // n_dev + 1) * n_dev
+
+        def trial_fn(cand):
+            return self._launch_trial(
+                cand,
+                [sys.executable, "-m",
+                 "paddle_tpu.distributed.auto_tuner.spmd_trial"],
+                extra_env={"PADDLE_TRIAL_DEVICES": str(n_dev),
+                           "PADDLE_TRIAL_HIDDEN": str(hidden),
+                           "PADDLE_TRIAL_LAYERS": str(layers),
+                           "PADDLE_TRIAL_SEQ": str(seq),
+                           "JAX_PLATFORMS": "cpu"},
+                timeout=timeout)
 
         return self.tune(trial_fn=trial_fn, max_trials=max_trials)
 

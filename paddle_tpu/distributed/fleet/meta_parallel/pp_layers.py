@@ -126,6 +126,7 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self._mesh = mesh
         self._descs = list(layers)
+        self._seg_method = seg_method   # kept for post-plan re-staging
 
         built = []
         self._shared_layers = {}

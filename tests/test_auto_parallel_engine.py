@@ -63,3 +63,40 @@ def test_engine_plan_then_fit_decreases_loss():
     assert all(np.isfinite(losses))
     assert min(losses[1:]) < losses[0]
     dist.set_mesh(None)
+
+
+def _deep_pipe_model():
+    """Deep-narrow pipe-capable GPT: many layers, small hidden — the
+    regime where per-layer TP collectives lose to a pipeline schedule."""
+    from paddle_tpu.models import GPTForCausalLMPipe
+    from paddle_tpu.models.gpt import GPTConfig
+    dist.set_mesh(None)
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=48,
+                    num_heads=4, max_seq_len=64, use_flash_attention=False)
+    return GPTForCausalLMPipe(cfg)
+
+
+def test_engine_plan_searches_pipeline_configs():
+    """VERDICT r03 #8: pp candidates are in the plan space (reference:
+    static/tuner/parallel_tuner.py:36) and a deep model on 8 devices
+    plans pp>1 by roofline."""
+    model = _deep_pipe_model()
+    eng = ap.Engine(model=model, loss=nn.CrossEntropyLoss())
+    planned = eng.plan(global_batch=2, seq_len=2048, n_devices=8,
+                       device="v5e")
+    assert planned["pp"] > 1, planned
+    assert planned["dp"] * planned["mp"] * planned["pp"] * \
+        planned["sharding"] == 8
+
+
+def test_engine_plan_trial_confirms_pp():
+    """VERDICT r03 #8 'trial-confirmed': the top roofline candidates are
+    validated by real tiny-shape SPMD trial steps in subprocesses
+    (reference: static/tuner/optimization_tuner.py:194) and the measured
+    winner still has pp>1."""
+    model = _deep_pipe_model()
+    eng = ap.Engine(model=model, loss=nn.CrossEntropyLoss())
+    planned = eng.plan(global_batch=2, seq_len=2048, n_devices=8,
+                       device="v5e", mode="trial", max_trials=2)
+    assert planned["pp"] > 1, planned
