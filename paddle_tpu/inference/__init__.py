@@ -30,7 +30,25 @@ class PlaceType:
 
 
 class Config:
-    """reference: paddle_infer.Config(model_file, params_file)."""
+    """reference: paddle_infer.Config(model_file, params_file).
+
+    Knob contract (which settings are HONORED vs recorded-only):
+
+    ========================  =========================================
+    knob                      effect here
+    ========================  =========================================
+    enable_mkldnn_int8 /      HONORED — weights quantized to per-channel
+    enable_int8               int8 at load (or the bundle's baked int8
+                              used as-is); dequant is jit-fused
+    enable_tpu/…use_gpu/      HONORED as placement intent; the actual
+    disable_gpu               device is whatever JAX/PJRT exposes
+    enable_memory_optim       recorded only — XLA plans buffers itself
+    switch_ir_optim           recorded only — XLA always optimizes
+    enable_mkldnn             recorded only — no CPU-library switch
+    set_cpu_math_library_…    recorded only — XLA:CPU threads are
+                              process-global
+    ========================  =========================================
+    """
 
     def __init__(self, model_path=None, params_path=None):
         if model_path is not None and model_path.endswith(".pdmodel"):
@@ -61,6 +79,13 @@ class Config:
 
     def enable_mkldnn(self):
         pass
+
+    def enable_mkldnn_int8(self, quantized_ops=None):
+        """reference: analysis_config enable_mkldnn_int8 — here the
+        TPU-neutral weight-only int8 predict switch."""
+        self.precision = PrecisionType.Int8
+
+    enable_int8 = enable_mkldnn_int8
 
 
 class _IOHandle:
@@ -93,6 +118,20 @@ class Predictor:
         if config.prefix is None:
             raise ValueError("Config needs a model path prefix")
         prog, feed_names, fetch_names = load_inference_model(config.prefix)
+        if config.precision == PrecisionType.Int8 and \
+                not prog._param_scales:
+            # bundle is float: quantize at load (weight-only int8)
+            from ..quantization import quantize_per_channel
+            scales = []
+            for k in sorted(prog._params):
+                a = np.asarray(prog._params[k])
+                if a.ndim >= 2 and a.dtype.kind == "f":
+                    q, s = quantize_per_channel(a)
+                    prog._params[k] = q
+                    scales.append(s)
+                else:
+                    scales.append(None)
+            prog._param_scales = scales
         self._program = prog
         self._feed_names = feed_names
         self._fetch_names = fetch_names
@@ -119,7 +158,7 @@ class Predictor:
             for n, a in zip(self._feed_names, inputs):
                 self._inputs[n].copy_from_cpu(a)
         args = [self._inputs[n].copy_to_cpu() for n in self._feed_names]
-        outs = self._program._exported.call(self._params, *args)
+        outs = self._program._exported_call(self._params, args)
         for n, o in zip(self._fetch_names, outs):
             self._outputs[n]._value = np.asarray(o)
         return [np.asarray(o) for o in outs]

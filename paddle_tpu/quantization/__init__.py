@@ -86,6 +86,29 @@ class FakeQuanterWithAbsMaxObserver(BaseObserver):
                         (x,))
 
 
+def quantize_per_channel(w, axis=-1, bits=8):
+    """Symmetric absmax int8 per-output-channel quantization of a weight
+    array → (int8 values, float32 scale broadcastable against them).
+    The storage/transfer format of the weight-only int8 predict path
+    (reference capability: analysis_predictor int8 —
+    paddle/fluid/inference/api/analysis_predictor.h:94; mkldnn_int8 /
+    TensorRT Int8 configs)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    a = np.asarray(w, np.float32)
+    red = tuple(i for i in range(a.ndim) if i != (axis % a.ndim))
+    scale = np.abs(a).max(axis=red, keepdims=True) / qmax
+    scale = np.maximum(scale, 1e-12)
+    q = np.clip(np.round(a / scale), -qmax, qmax).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def dequantize(q, scale, dtype=jnp.float32):
+    """int8 → float dequant.  Inside a jitted predict program XLA fuses
+    this into the consuming matmul/gather, so weights live in HBM (and
+    cross the host↔device link) at 1/4 the bytes."""
+    return jnp.asarray(q, dtype) * jnp.asarray(scale, dtype)
+
+
 class QuantConfig:
     """reference: quantization/config.py QuantConfig(activation, weight)."""
 

@@ -1,0 +1,89 @@
+"""End-to-end 'book' smokes: train to a loss threshold, save an
+inference bundle, reload it, and predict — the reference's
+test/book/test_fit_a_line.py / test_recognize_digits.py pattern
+(train → save_inference_model → load_inference_model → infer)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import static, inference
+from paddle_tpu.static import InputSpec
+
+
+def test_fit_a_line(tmp_path):
+    """Linear regression trains below threshold and round-trips through
+    the saved inference bundle (reference: test/book/test_fit_a_line.py)."""
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(13, 1)).astype(np.float32)
+    x_all = rng.normal(size=(256, 13)).astype(np.float32)
+    y_all = x_all @ w_true + 0.01 * rng.normal(
+        size=(256, 1)).astype(np.float32)
+
+    paddle.seed(0)
+    net = nn.Linear(13, 1)
+    opt = paddle.optimizer.SGD(0.05, parameters=net.parameters())
+    loss_fn = nn.MSELoss()
+
+    last = None
+    for epoch in range(60):
+        for i in range(0, 256, 32):
+            x = paddle.to_tensor(x_all[i:i + 32])
+            y = paddle.to_tensor(y_all[i:i + 32])
+            loss = loss_fn(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            last = float(loss)
+        if last < 0.05:
+            break
+    assert last < 0.05, f"fit_a_line did not converge: loss={last}"
+
+    prefix = str(tmp_path / "fit_a_line")
+    static.save_inference_model(
+        prefix, [InputSpec([None, 13], "float32", "x")], None, layer=net)
+    prog, feeds, fetches = static.load_inference_model(prefix)
+    exe = static.Executor()
+    out = exe.run(prog, feed={"x": x_all[:8]}, fetch_list=fetches)[0]
+    np.testing.assert_allclose(out, net(paddle.to_tensor(x_all[:8])).numpy(),
+                               rtol=1e-4, atol=1e-5)
+    # predictions track the generating line
+    assert float(np.mean((out - y_all[:8]) ** 2)) < 0.1
+
+
+def test_recognize_digits_mlp(tmp_path):
+    """Tiny MLP classifier trains to accuracy threshold; the Predictor
+    serves the saved bundle (reference: test/book/test_recognize_digits.py)."""
+    rng = np.random.default_rng(1)
+    n, d, k = 512, 16, 4
+    centers = rng.normal(size=(k, d)).astype(np.float32) * 2.0
+    labels = rng.integers(0, k, size=n)
+    feats = centers[labels] + 0.3 * rng.normal(size=(n, d)).astype(
+        np.float32)
+
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(d, 32), nn.ReLU(), nn.Linear(32, k))
+    opt = paddle.optimizer.Adam(5e-3, parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+
+    for epoch in range(30):
+        for i in range(0, n, 64):
+            x = paddle.to_tensor(feats[i:i + 64])
+            y = paddle.to_tensor(labels[i:i + 64].astype(np.int64))
+            loss = ce(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        with paddle.no_grad():
+            pred = np.argmax(net(paddle.to_tensor(feats)).numpy(), axis=1)
+        acc = float((pred == labels).mean())
+        if acc > 0.9:
+            break
+    assert acc > 0.9, f"classifier stuck at acc={acc}"
+
+    prefix = str(tmp_path / "digits")
+    static.save_inference_model(
+        prefix, [InputSpec([None, d], "float32", "x")], None, layer=net)
+    pred = inference.create_predictor(inference.Config(prefix))
+    out = pred.run([feats[:32]])[0]
+    served_acc = float((np.argmax(out, 1) == labels[:32]).mean())
+    assert served_acc > 0.85, served_acc

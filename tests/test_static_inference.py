@@ -210,3 +210,62 @@ def test_static_create_parameter_name_mismatch_errors():
         static.create_parameter([3, 3], "float32", name="w_mm")
         with pytest.raises(ValueError):
             static.create_parameter([4, 4], "float32", name="w_mm")
+
+
+def test_int8_baked_export_ptq_gpt_block(tmp_path):
+    """VERDICT r03 #9: PTQ scales baked into the export — a PTQ'd GPT-2
+    block saved with quantize="int8" ships int8 weights (4x smaller
+    params artifact) and predicts within tolerance of the PTQ model
+    (reference int8 predict: analysis_predictor.h:94)."""
+    import os
+    from paddle_tpu.models.gpt import GPTConfig, GPTBlock
+    from paddle_tpu.quantization import PTQ, QuantConfig
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=32, use_flash_attention=False)
+    block = GPTBlock(cfg)
+    block.eval()
+    x = np.random.default_rng(0).standard_normal((2, 16, 64)) \
+        .astype(np.float32)
+
+    ptq = PTQ(QuantConfig(activation=None, weight=None))
+    block = ptq.quantize(block)
+    with paddle.no_grad():
+        block(paddle.to_tensor(x))          # calibration pass
+    block = ptq.convert(block)
+    with paddle.no_grad():
+        ref = block(paddle.to_tensor(x)).numpy()
+
+    spec = [InputSpec([2, 16, 64], "float32", "x")]
+    p_f32 = str(tmp_path / "blk_f32")
+    p_int8 = str(tmp_path / "blk_int8")
+    static.save_inference_model(p_f32, spec, None, layer=block)
+    static.save_inference_model(p_int8, spec, None, layer=block,
+                                quantize="int8")
+    sz_f32 = os.path.getsize(p_f32 + ".pdiparams")
+    sz_int8 = os.path.getsize(p_int8 + ".pdiparams")
+    assert sz_int8 < 0.45 * sz_f32, (sz_int8, sz_f32)
+
+    pred = inference.create_predictor(inference.Config(p_int8))
+    out = pred.run([x])[0]
+    # int8-grid weights round-trip nearly exactly; activations flow f32
+    err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert err < 0.02, err
+
+
+def test_int8_quantize_at_load_via_config(tmp_path):
+    """A float bundle + Config.enable_int8(): weights quantized at load,
+    predictions stay close to the float model."""
+    net = _small_net(5)
+    x = np.random.default_rng(3).standard_normal((2, 8)).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "served_q")
+    static.save_inference_model(
+        prefix, [InputSpec([2, 8], "float32", "x")], None, layer=net)
+    config = inference.Config(prefix + ".pdmodel")
+    config.enable_int8()
+    pred = inference.create_predictor(config)
+    out = pred.run([x])[0]
+    err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert err < 0.05, err
