@@ -1,0 +1,38 @@
+#!/bin/bash
+# Claim-watcher: probe the single tunneled TPU chip every INTERVAL
+# seconds; the moment a claim is granted, run the real bench (which
+# appends an auditable record to benchmarks/TPU_RUNS.jsonl).  Exits as
+# soon as a NEW record lands, or after DEADLINE_S.  The axon relay
+# grants the one chip per process with a sticky lease, so after a
+# killed holder the claim can stay wedged for a while — polling is the
+# only recovery (VERDICT r03 next-round item 1).
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${TPU_WATCH_INTERVAL:-180}"
+DEADLINE_S="${TPU_WATCH_DEADLINE:-14400}"
+RUNS=benchmarks/TPU_RUNS.jsonl
+START_LINES=$( [ -f "$RUNS" ] && wc -l < "$RUNS" || echo 0 )
+START_TS=$(date +%s)
+
+while :; do
+  NOW=$(date +%s)
+  if [ $((NOW - START_TS)) -ge "$DEADLINE_S" ]; then
+    echo "[tpu_watch] deadline reached without a TPU run" >&2
+    exit 1
+  fi
+  if timeout 120 python -c \
+      "import jax,sys; sys.exit(0 if jax.devices()[0].platform in ('tpu','axon') else 1)" \
+      >/dev/null 2>&1; then
+    echo "[tpu_watch] claim granted at $(date -u +%T) — running bench" >&2
+    BENCH_RELAY_WAIT=30 BENCH_TPU_PROBE_TIMEOUT=120 \
+      timeout 2400 python bench.py >> benchmarks/tpu_watch_bench.out \
+      2>> benchmarks/tpu_watch_bench.err
+    CUR_LINES=$( [ -f "$RUNS" ] && wc -l < "$RUNS" || echo 0 )
+    if [ "$CUR_LINES" -gt "$START_LINES" ]; then
+      echo "[tpu_watch] TPU run recorded ($CUR_LINES lines)" >&2
+      exit 0
+    fi
+    echo "[tpu_watch] bench ran but no TPU record — claim lost mid-run; retrying" >&2
+  fi
+  sleep "$INTERVAL"
+done

@@ -78,6 +78,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def scale(self, loss):
         if not self._enable or self._scale == 1.0:
@@ -85,8 +86,11 @@ class GradScaler:
         return loss * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        # once-per-step guard: an explicit unscale_ (e.g. before a
+        # cross-rank grad sync or clipping) must not re-divide in step()
+        if not self._enable or self._unscaled:
             return
+        self._unscaled = True
         import jax.numpy as jnp
         inv = 1.0 / self._scale
         found_inf = False
@@ -115,6 +119,7 @@ class GradScaler:
         if not self._found_inf:
             optimizer.step()
         self.update()
+        self._unscaled = False
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)

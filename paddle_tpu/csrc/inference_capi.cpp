@@ -1,0 +1,230 @@
+/* C inference API implementation — embeds CPython and drives
+ * paddle_tpu.inference.capi (see pd_inference_c.h for the contract;
+ * reference capability: paddle/fluid/inference/capi_exp/pd_*.cc).
+ *
+ * Marshalling crosses the C↔Python boundary as raw float32 byte blobs +
+ * shape tuples, so no numpy C headers are needed on the C side.
+ */
+#include "pd_inference_c.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_err;
+PyThreadState* g_main_tstate = nullptr;
+
+void set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  g_err = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_err = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+bool ensure_python() {
+  if (Py_IsInitialized()) return true;
+  Py_InitializeEx(0);
+  if (!Py_IsInitialized()) {
+    g_err = "Py_InitializeEx failed";
+    return false;
+  }
+  /* release the GIL so PD_* calls can take it via PyGILState_Ensure
+   * from whichever host thread invokes them */
+  g_main_tstate = PyEval_SaveThread();
+  return true;
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+PyObject* capi_attr(const char* name) {
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference.capi");
+  if (!mod) {
+    set_err_from_python();
+    return nullptr;
+  }
+  PyObject* fn = PyObject_GetAttrString(mod, name);
+  Py_DECREF(mod);
+  if (!fn) set_err_from_python();
+  return fn;
+}
+
+}  // namespace
+
+struct PD_Config {
+  std::string prefix;
+  bool int8 = false;
+};
+
+struct PD_Predictor {
+  PyObject* pyobj = nullptr;  // paddle_tpu.inference.Predictor
+  int n_inputs = 0;
+  int n_outputs = 0;
+  std::vector<std::vector<float>> out_data;
+  std::vector<std::vector<int64_t>> out_shape;
+};
+
+extern "C" {
+
+PD_Config* PD_ConfigCreate(void) { return new PD_Config(); }
+
+void PD_ConfigSetModel(PD_Config* c, const char* model_prefix) {
+  if (c && model_prefix) c->prefix = model_prefix;
+}
+
+void PD_ConfigEnableInt8(PD_Config* c) {
+  if (c) c->int8 = true;
+}
+
+void PD_ConfigDestroy(PD_Config* c) { delete c; }
+
+PD_Predictor* PD_PredictorCreate(PD_Config* c) {
+  if (!c) {
+    g_err = "null config";
+    return nullptr;
+  }
+  std::string prefix = c->prefix;
+  bool int8 = c->int8;
+  delete c;
+  if (!ensure_python()) return nullptr;
+  Gil gil;
+  PyObject* fn = capi_attr("_create");
+  if (!fn) return nullptr;
+  PyObject* r =
+      PyObject_CallFunction(fn, "si", prefix.c_str(), int8 ? 1 : 0);
+  Py_DECREF(fn);
+  if (!r) {
+    set_err_from_python();
+    return nullptr;
+  }
+  PyObject* nin = PyObject_CallMethod(r, "get_input_names", nullptr);
+  PyObject* nout = PyObject_CallMethod(r, "get_output_names", nullptr);
+  if (!nin || !nout) {
+    set_err_from_python();
+    Py_XDECREF(nin);
+    Py_XDECREF(nout);
+    Py_DECREF(r);
+    return nullptr;
+  }
+  PD_Predictor* p = new PD_Predictor();
+  p->pyobj = r;
+  p->n_inputs = (int)PyList_Size(nin);
+  p->n_outputs = (int)PyList_Size(nout);
+  Py_DECREF(nin);
+  Py_DECREF(nout);
+  return p;
+}
+
+int PD_PredictorGetInputNum(PD_Predictor* p) {
+  return p ? p->n_inputs : -1;
+}
+
+int PD_PredictorGetOutputNum(PD_Predictor* p) {
+  return p ? p->n_outputs : -1;
+}
+
+int PD_PredictorRunFloat(PD_Predictor* p, int n_inputs,
+                         const float* const* data,
+                         const int64_t* const* shape, const int* ndim) {
+  if (!p || !p->pyobj) {
+    g_err = "null predictor";
+    return -1;
+  }
+  Gil gil;
+  PyObject* inputs = PyList_New(n_inputs);
+  for (int i = 0; i < n_inputs; ++i) {
+    int64_t numel = 1;
+    PyObject* dims = PyList_New(ndim[i]);
+    for (int d = 0; d < ndim[i]; ++d) {
+      numel *= shape[i][d];
+      PyList_SET_ITEM(dims, d, PyLong_FromLongLong(shape[i][d]));
+    }
+    PyObject* blob = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(data[i]),
+        (Py_ssize_t)(numel * sizeof(float)));
+    PyObject* pair = PyTuple_Pack(2, blob, dims);
+    Py_DECREF(blob);
+    Py_DECREF(dims);
+    PyList_SET_ITEM(inputs, i, pair);
+  }
+  PyObject* fn = capi_attr("_run");
+  if (!fn) {
+    Py_DECREF(inputs);
+    return -1;
+  }
+  PyObject* r = PyObject_CallFunctionObjArgs(fn, p->pyobj, inputs, nullptr);
+  Py_DECREF(fn);
+  Py_DECREF(inputs);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  /* r: list of (bytes, [dims]) */
+  Py_ssize_t n_out = PyList_Size(r);
+  p->out_data.assign((size_t)n_out, {});
+  p->out_shape.assign((size_t)n_out, {});
+  for (Py_ssize_t i = 0; i < n_out; ++i) {
+    PyObject* pair = PyList_GetItem(r, i);
+    PyObject* blob = PyTuple_GetItem(pair, 0);
+    PyObject* dims = PyTuple_GetItem(pair, 1);
+    char* buf = nullptr;
+    Py_ssize_t len = 0;
+    PyBytes_AsStringAndSize(blob, &buf, &len);
+    p->out_data[i].resize((size_t)len / sizeof(float));
+    std::memcpy(p->out_data[i].data(), buf, (size_t)len);
+    Py_ssize_t nd = PyList_Size(dims);
+    for (Py_ssize_t d = 0; d < nd; ++d)
+      p->out_shape[i].push_back(
+          PyLong_AsLongLong(PyList_GetItem(dims, d)));
+  }
+  p->n_outputs = (int)n_out;
+  Py_DECREF(r);
+  if (PyErr_Occurred()) {
+    set_err_from_python();
+    return -1;
+  }
+  return 0;
+}
+
+int PD_PredictorGetOutputFloat(PD_Predictor* p, int idx,
+                               const float** data, const int64_t** shape,
+                               int* ndim) {
+  if (!p || idx < 0 || (size_t)idx >= p->out_data.size()) {
+    g_err = "bad output index (run first?)";
+    return -1;
+  }
+  *data = p->out_data[(size_t)idx].data();
+  *shape = p->out_shape[(size_t)idx].data();
+  *ndim = (int)p->out_shape[(size_t)idx].size();
+  return 0;
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (!p) return;
+  if (p->pyobj && Py_IsInitialized()) {
+    Gil gil;
+    Py_DECREF(p->pyobj);
+  }
+  delete p;
+}
+
+const char* PD_GetLastError(void) { return g_err.c_str(); }
+
+}  // extern "C"

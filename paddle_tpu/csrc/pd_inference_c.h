@@ -1,0 +1,60 @@
+/* C inference API (reference capability: the C API in
+ * paddle/fluid/inference/capi_exp/pd_inference_api.h — Config/Predictor
+ * lifecycle + run from a C host application).
+ *
+ * TPU-native realization: the predictor executes a StableHLO bundle via
+ * JAX, so the C library embeds CPython and drives
+ * paddle_tpu.inference.Predictor.  The host process must export
+ * PYTHONPATH pointing at the paddle_tpu checkout (and, on machines
+ * without a TPU, JAX_PLATFORMS=cpu) before the first PD_* call.
+ *
+ * Float32 IO only — the reference's per-dtype CopyFromCpu variants
+ * collapse to one function here; other dtypes go through the Python
+ * Predictor directly.
+ */
+#ifndef PD_INFERENCE_C_H
+#define PD_INFERENCE_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+
+/* ---- config (reference: PD_ConfigCreate / PD_ConfigSetModel) ---- */
+PD_Config* PD_ConfigCreate(void);
+void PD_ConfigSetModel(PD_Config* c, const char* model_prefix);
+/* weight-only int8 predict path (reference: PD_ConfigEnableMkldnnInt8) */
+void PD_ConfigEnableInt8(PD_Config* c);
+void PD_ConfigDestroy(PD_Config* c);
+
+/* ---- predictor (reference: PD_PredictorCreate / PD_PredictorRun) ---- */
+/* Takes ownership of `c`.  NULL on failure — see PD_GetLastError().   */
+PD_Predictor* PD_PredictorCreate(PD_Config* c);
+int PD_PredictorGetInputNum(PD_Predictor* p);
+int PD_PredictorGetOutputNum(PD_Predictor* p);
+
+/* Run with float32 inputs.  data[i] points at a dense row-major buffer
+ * of shape shape[i][0..ndim[i]-1].  Returns 0 on success.             */
+int PD_PredictorRunFloat(PD_Predictor* p, int n_inputs,
+                         const float* const* data,
+                         const int64_t* const* shape, const int* ndim);
+
+/* Read output `idx` of the last run.  The returned buffers stay valid
+ * until the next PD_PredictorRunFloat or PD_PredictorDestroy.         */
+int PD_PredictorGetOutputFloat(PD_Predictor* p, int idx,
+                               const float** data, const int64_t** shape,
+                               int* ndim);
+
+void PD_PredictorDestroy(PD_Predictor* p);
+
+/* Last error message for a failed call (empty string if none). */
+const char* PD_GetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PD_INFERENCE_C_H */

@@ -92,6 +92,185 @@ class SparseTable:
             else:
                 row -= self.lr * g
 
+    def apply_delta(self, ids, deltas):
+        """row += delta — the geo-SGD merge op (reference: geo mode sends
+        parameter diffs, not gradients; the_one_ps.py geo strategy)."""
+        deltas = np.asarray(deltas, np.float32)
+        for key, d in zip(ids, deltas):
+            key = int(key)
+            row = self.rows.get(key)
+            if row is None:
+                row = self._init()
+                self.rows[key] = row
+            row += d
+
+    def all_rows(self):
+        """Materialize every live row (checkpoint/save path)."""
+        return dict(self.rows)
+
+
+class SSDSparseTable(SparseTable):
+    """Two-tier sparse table: hot rows in an LRU RAM cache, cold rows in
+    a log-structured disk file — host tables larger than RAM.
+
+    Reference capability: the SSD/hierarchical table tier —
+    paddle/fluid/distributed/ps/table/ssd_sparse_table.{h,cc} (rocksdb
+    cold tier under memory_sparse_table) and the HeterPS pull path that
+    stages cold rows upward (paddle/fluid/framework/fleet/
+    ps_gpu_wrapper.h:114).  rocksdb is not in this image, so the cold
+    store is an append-only record file with an in-RAM {id → offset}
+    index and threshold-triggered compaction: same capability, stdlib
+    machinery.  Updates hit the cache; eviction appends the fresh record
+    and abandons the old one (`_dead_bytes`); compaction rewrites live
+    records when dead bytes exceed live bytes.
+    """
+
+    def __init__(self, dim, lr=0.1, optimizer="sgd", initializer=None,
+                 seed=0, cache_rows=4096, path=None):
+        super().__init__(dim, lr=lr, optimizer=optimizer,
+                         initializer=initializer, seed=seed)
+        import collections
+        import os
+        import tempfile
+        self.rows = collections.OrderedDict()   # hot tier (LRU)
+        self._accum = collections.OrderedDict()
+        self.cache_rows = int(cache_rows)
+        self._with_accum = (optimizer == "adagrad")
+        self._planes = 2 if self._with_accum else 1
+        self._rec_bytes = self._planes * dim * 4
+        if path is None:
+            fd, self.path = tempfile.mkstemp(
+                prefix="paddle_tpu_ssd_table_", suffix=".bin")
+            self._file = os.fdopen(fd, "r+b")
+        else:
+            self.path = path
+            self._file = open(path, "a+b")
+        self._index: dict[int, int] = {}  # id → record offset (cold tier)
+        self._end = self._file.seek(0, 2)
+        self._dead_bytes = 0
+        self._dirty: set[int] = set()  # hot rows mutated since load/spill
+
+    # -- cold-tier record IO ------------------------------------------
+    def _write_record(self, key, row, acc):
+        rec = (np.concatenate([row, acc]) if self._with_accum
+               else row).astype(np.float32)
+        off = self._end
+        self._file.seek(off)
+        self._file.write(rec.tobytes())
+        self._end = off + self._rec_bytes
+        if key in self._index:
+            self._dead_bytes += self._rec_bytes
+        self._index[key] = off
+
+    def _read_record(self, off):
+        self._file.seek(off)
+        rec = np.frombuffer(self._file.read(self._rec_bytes),
+                            np.float32).copy()
+        if self._with_accum:
+            return rec[:self.dim], rec[self.dim:]
+        return rec, None
+
+    def _evict_to_fit(self):
+        while len(self.rows) > self.cache_rows:
+            key, row = self.rows.popitem(last=False)
+            acc = self._accum.pop(key, None)
+            # clean eviction of a row that already has a cold copy costs
+            # zero IO — only mutated (or never-spilled) rows are written
+            if key in self._dirty or key not in self._index:
+                if acc is None and self._with_accum:
+                    acc = np.zeros(self.dim, np.float32)
+                self._write_record(key, row, acc)
+            self._dirty.discard(key)
+        live = self._end - self._dead_bytes
+        if self._dead_bytes > max(live, 1 << 16):
+            self.compact()
+
+    def compact(self):
+        """Rewrite live records into a sidecar file, then swap it in —
+        memory stays O(one record), since the cold tier may exceed RAM."""
+        import os
+        tmp_path = self.path + ".compact"
+        new_index = {}
+        off = 0
+        with open(tmp_path, "w+b") as out:
+            for key, old in self._index.items():
+                self._file.seek(old)
+                out.write(self._file.read(self._rec_bytes))
+                new_index[key] = off
+                off += self._rec_bytes
+        self._file.close()
+        os.replace(tmp_path, self.path)
+        self._file = open(self.path, "r+b")
+        self._index, self._end, self._dead_bytes = new_index, off, 0
+
+    # -- hot-tier access ----------------------------------------------
+    def _fetch(self, key, create=True):
+        row = self.rows.get(key)
+        if row is not None:
+            self.rows.move_to_end(key)
+            return row
+        off = self._index.get(key)
+        if off is not None:
+            row, acc = self._read_record(off)
+            self.rows[key] = row
+            if self._with_accum:
+                self._accum[key] = acc
+            return row
+        if not create:
+            return None
+        row = self._init()
+        self.rows[key] = row
+        if self._with_accum:
+            self._accum[key] = np.zeros(self.dim, np.float32)
+        return row
+
+    def pull(self, ids):
+        out = np.empty((len(ids), self.dim), np.float32)
+        for i, key in enumerate(ids):
+            out[i] = self._fetch(int(key))
+        self._evict_to_fit()
+        return out
+
+    def push(self, ids, grads):
+        grads = np.asarray(grads, np.float32)
+        for key, g in zip(ids, grads):
+            key = int(key)
+            row = self._fetch(key)
+            if self._with_accum:
+                acc = self._accum[key]
+                acc += g * g
+                row -= self.lr * g / (np.sqrt(acc) + 1e-8)
+            else:
+                row -= self.lr * g
+            self._dirty.add(key)
+        self._evict_to_fit()
+
+    def apply_delta(self, ids, deltas):
+        deltas = np.asarray(deltas, np.float32)
+        for key, d in zip(ids, deltas):
+            self._fetch(int(key))
+            self.rows[int(key)] += d
+            self._dirty.add(int(key))
+        self._evict_to_fit()
+
+    @property
+    def num_cold_rows(self):
+        return sum(1 for k in self._index if k not in self.rows)
+
+    def all_rows(self):
+        out = {}
+        for key, off in self._index.items():
+            row, _ = self._read_record(off)
+            out[key] = row
+        out.update(self.rows)   # hot tier is authoritative
+        return out
+
+    def close(self):
+        try:
+            self._file.close()
+        except OSError:
+            pass
+
 
 # ------------------------------------------------------------------
 # server / client (reference: brpc_ps_server / brpc_ps_client)
@@ -114,6 +293,9 @@ class PSServer:
 
     def add_sparse_table(self, table_id, dim, **kw):
         self.tables[table_id] = SparseTable(dim, **kw)
+
+    def add_ssd_sparse_table(self, table_id, dim, **kw):
+        self.tables[table_id] = SSDSparseTable(dim, **kw)
 
     def start(self):
         self._accept_thread = threading.Thread(target=self._accept_loop,
@@ -155,7 +337,8 @@ class PSServer:
                 try:
                     with self._lock:
                         if op in ("pull_dense", "push_dense",
-                                  "pull_sparse", "push_sparse") and \
+                                  "pull_sparse", "push_sparse",
+                                  "push_sparse_delta") and \
                                 table is None:
                             resp = {"ok": False,
                                     "error": f"no table "
@@ -171,9 +354,13 @@ class PSServer:
                         elif op == "push_sparse":
                             table.push(req["ids"], req["grad"])
                             resp = {"ok": True}
+                        elif op == "push_sparse_delta":
+                            table.apply_delta(req["ids"], req["delta"])
+                            resp = {"ok": True}
                         elif op == "save":
                             resp = {"ok": True, "state": {
-                                tid: (t.rows if isinstance(t, SparseTable)
+                                tid: (t.all_rows()
+                                      if isinstance(t, SparseTable)
                                       else t.value)
                                 for tid, t in self.tables.items()}}
                         else:
@@ -230,6 +417,11 @@ class PSClient:
                    ids=[int(i) for i in ids],
                    grad=np.asarray(grad, np.float32))
 
+    def push_sparse_delta(self, table_id, ids, delta):
+        self._call(op="push_sparse_delta", table_id=table_id,
+                   ids=[int(i) for i in ids],
+                   delta=np.asarray(delta, np.float32))
+
     def save(self):
         return self._call(op="save")["state"]
 
@@ -273,6 +465,8 @@ class TheOnePSRuntime:
             kind = spec.pop("type")
             if kind == "sparse":
                 self._server.add_sparse_table(int(tid), **spec)
+            elif kind == "ssd_sparse":
+                self._server.add_ssd_sparse_table(int(tid), **spec)
             else:
                 self._server.add_dense_table(int(tid),
                                              tuple(spec.pop("shape")),
@@ -403,6 +597,18 @@ class ShardedPSClient:
         for f in futs:
             f.result()
 
+    def push_sparse_delta(self, table_id, ids, delta):
+        delta = np.asarray(delta, np.float32)
+        buckets, pos = self._partition(ids)
+        futs = []
+        for s in range(self._n):
+            if buckets[s]:
+                futs.append(self._pool.submit(
+                    self._clients[s].push_sparse_delta, table_id,
+                    buckets[s], delta[pos[s]]))
+        for f in futs:
+            f.result()
+
     def save(self):
         return [c.save() for c in self._clients]
 
@@ -517,3 +723,75 @@ class AsyncPSEmbedding(PSEmbedding):
             ids._data_ if isinstance(ids, Tensor) else ids)) + (self.dim,)
         from ...tensor_ops import manipulation
         return manipulation.reshape(emb, list(shape))
+
+
+# ------------------------------------------------------------------
+# geo-SGD (reference: the_one_ps.py geo strategy + communicator.h
+# GeoCommunicator — workers train a LOCAL parameter copy and exchange
+# parameter DIFFS with the server every geo_step steps, not per-step
+# gradients; stale-tolerant async mode for sparse recommender training)
+# ------------------------------------------------------------------
+
+class GeoSGDCommunicator:
+    """Worker-side geo-SGD driver for one sparse table.
+
+    Training applies SGD to a local row copy; `base` remembers the row
+    value at the last server sync.  Every `geo_step` pushes, the
+    accumulated local movement (local − base) for every touched id is
+    sent as a delta (server: row += delta) and the local copy refreshes
+    from the server, folding in the other trainers' deltas.  Matching
+    the reference semantics, updates between syncs cost zero RPCs.
+    """
+
+    def __init__(self, client, table_id, dim, lr=0.1, geo_step=10,
+                 initializer=None, seed=0):
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+        self.geo_step = int(geo_step)
+        self.local = SparseTable(dim, lr=lr, optimizer="sgd",
+                                 initializer=initializer, seed=seed)
+        self._base: dict[int, np.ndarray] = {}
+        self._dirty: set[int] = set()
+        self._pushes = 0
+
+    def _ensure_local(self, ids):
+        missing = [int(i) for i in ids if int(i) not in self._base]
+        if missing:
+            rows = np.asarray(
+                self.client.pull_sparse(self.table_id, missing),
+                np.float32)
+            for key, row in zip(missing, rows):
+                self._base[key] = row.copy()
+                self.local.rows[key] = row.copy()
+
+    def pull(self, ids):
+        """Rows come from the LOCAL copy — no RPC unless unseen."""
+        self._ensure_local(ids)
+        return self.local.pull([int(i) for i in ids])
+
+    def push(self, ids, grads):
+        """Apply the gradient locally; sync with the server only every
+        geo_step-th push."""
+        ids = [int(i) for i in ids]
+        self._ensure_local(ids)
+        self.local.push(ids, grads)
+        self._dirty.update(ids)
+        self._pushes += 1
+        if self._pushes % self.geo_step == 0:
+            self.sync()
+
+    def sync(self):
+        """Push accumulated deltas; refresh local/base from the server."""
+        if not self._dirty:
+            return
+        ids = sorted(self._dirty)
+        delta = np.stack([self.local.rows[k] - self._base[k]
+                          for k in ids])
+        self.client.push_sparse_delta(self.table_id, ids, delta)
+        fresh = np.asarray(self.client.pull_sparse(self.table_id, ids),
+                           np.float32)
+        for key, row in zip(ids, fresh):
+            self._base[key] = row.copy()
+            self.local.rows[key] = row.copy()
+        self._dirty.clear()

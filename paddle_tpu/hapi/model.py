@@ -29,16 +29,58 @@ class Model:
         self._loss = None
         self._metrics = []
         self.stop_training = False
+        self._amp_level = "O0"
+        self._amp_dtype = "bfloat16"
+        self._amp_lists = (None, None)
+        self._scaler = None
+        self._nranks = 1
+        self._rank = 0
 
     # ---- configuration ----
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None, jit=False):
-        self._optimizer = optimizer
         self._loss = loss
         metrics = metrics or []
         if isinstance(metrics, Metric):
             metrics = [metrics]
         self._metrics = metrics
+
+        # AMP-aware prepare (reference: hapi/model.py _check_amp_configs
+        # — accepts "O1"/"O2" or a dict of auto_cast + GradScaler knobs)
+        scaler_kw = {}
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            else:
+                cfg = dict(amp_configs)
+                self._amp_level = cfg.pop("level", "O1")
+                self._amp_dtype = cfg.pop("dtype", "bfloat16")
+                self._amp_lists = (cfg.pop("custom_white_list", None),
+                                   cfg.pop("custom_black_list", None))
+                scaler_kw = cfg
+            if self._amp_level not in ("O0", "O1", "O2"):
+                raise ValueError(
+                    f"amp level must be O0/O1/O2, got {self._amp_level!r}")
+        from .. import amp as amp_pkg
+        if self._amp_level == "O2" and optimizer is not None:
+            # cast params to the amp dtype; optimizer keeps f32 masters
+            self.network, optimizer = amp_pkg.decorate(
+                self.network, optimizer, level="O2",
+                dtype=self._amp_dtype)
+        if self._amp_level != "O0" and (
+                self._amp_dtype in ("float16", "fp16") or scaler_kw):
+            # bf16 needs no loss scaling — the scaler only materializes
+            # for fp16 or when scaling knobs are passed explicitly
+            self._scaler = amp_pkg.GradScaler(**scaler_kw)
+
+        # distributed-aware prepare (reference: DynamicGraphAdapter wraps
+        # in DataParallel when nranks>1; here each launched worker holds
+        # its data shard and grads all-reduce across processes)
+        from ..distributed import env as dist_env
+        self._nranks = dist_env.get_world_size()
+        self._rank = dist_env.get_rank()
+
+        self._optimizer = optimizer
         self._jit = jit
         self._train_fn = self._train_step
         if jit:
@@ -52,11 +94,49 @@ class Model:
             raise RuntimeError("prepare(loss=...) before fit/evaluate")
         return self._loss(outputs, labels)
 
+    def _autocast(self):
+        from .. import amp as amp_pkg
+        return amp_pkg.auto_cast(enable=self._amp_level != "O0",
+                                 level=self._amp_level,
+                                 dtype=self._amp_dtype,
+                                 custom_white_list=self._amp_lists[0],
+                                 custom_black_list=self._amp_lists[1])
+
+    def _sync_grads(self):
+        """Cross-process DP gradient all-reduce (mean) — the EagerReducer
+        analog for the launched-workers path."""
+        from .. import distributed as dist
+        for p in self._optimizer._all_params():
+            if p.grad is not None:
+                dist.all_reduce(p.grad)
+                p.grad._data = p.grad._data / self._nranks
+
     def _train_step(self, x, y):
-        out = self.network(x)
-        loss = self._compute_loss(out, y)
-        loss.backward()
-        self._optimizer.step()
+        with self._autocast():
+            out = self.network(x)
+            loss = self._compute_loss(out, y)
+        if self._scaler is not None:
+            self._scaler.scale(loss).backward()
+            if self._nranks > 1:
+                self._scaler.unscale_(self._optimizer)
+                self._sync_grads()
+                # inf/nan is a GLOBAL decision: a rank skipping the step
+                # while another applies the (now all-reduced, possibly
+                # inf-contaminated) update would diverge the replicas
+                from .. import distributed as dist
+                from ..core.tensor import Tensor
+                import jax.numpy as jnp
+                flag = Tensor(jnp.asarray(
+                    [1.0 if self._scaler._found_inf else 0.0]))
+                dist.all_reduce(flag)
+                self._scaler._found_inf = bool(
+                    float(np.asarray(flag._data_)[0]) > 0)
+            self._scaler.step(self._optimizer)  # step() runs update()
+        else:
+            loss.backward()
+            if self._nranks > 1:
+                self._sync_grads()
+            self._optimizer.step()
         self._optimizer.clear_grad()
         return loss, out
 
@@ -72,7 +152,7 @@ class Model:
         self.network.eval()
         x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
         y = labels[0] if isinstance(labels, (list, tuple)) else labels
-        with no_grad():
+        with no_grad(), self._autocast():
             out = self.network(x)
             loss = self._compute_loss(out, y)
         return [float(np.asarray(loss._data_))], out
@@ -81,13 +161,21 @@ class Model:
         from ..core.state import no_grad
         self.network.eval()
         x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
-        with no_grad():
+        with no_grad(), self._autocast():
             return self.network(x)
 
     # ---- loops ----
     def _as_loader(self, data, batch_size, shuffle):
         if data is None or isinstance(data, DataLoader):
             return data
+        if self._nranks > 1:
+            # each launched worker reads only its shard (reference:
+            # hapi fit builds a DistributedBatchSampler when nranks>1)
+            from ..io import DistributedBatchSampler
+            sampler = DistributedBatchSampler(
+                data, batch_size=batch_size, num_replicas=self._nranks,
+                rank=self._rank, shuffle=shuffle)
+            return DataLoader(data, batch_sampler=sampler)
         return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,

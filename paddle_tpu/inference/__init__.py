@@ -120,18 +120,12 @@ class Predictor:
         prog, feed_names, fetch_names = load_inference_model(config.prefix)
         if config.precision == PrecisionType.Int8 and \
                 not prog._param_scales:
-            # bundle is float: quantize at load (weight-only int8)
-            from ..quantization import quantize_per_channel
-            scales = []
-            for k in sorted(prog._params):
-                a = np.asarray(prog._params[k])
-                if a.ndim >= 2 and a.dtype.kind == "f":
-                    q, s = quantize_per_channel(a)
-                    prog._params[k] = q
-                    scales.append(s)
-                else:
-                    scales.append(None)
-            prog._param_scales = scales
+            # bundle is float: quantize at load (weight-only int8) —
+            # same bake rule as save-time (quantization.bake_int8)
+            from ..quantization import bake_int8
+            by_key = bake_int8(prog._params)
+            prog._param_scales = [by_key.get(k)
+                                  for k in sorted(prog._params)]
         self._program = prog
         self._feed_names = feed_names
         self._fetch_names = fetch_names

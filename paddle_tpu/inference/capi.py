@@ -1,0 +1,68 @@
+"""Python side of the C inference API (reference:
+paddle/fluid/inference/capi_exp/ — the C surface is
+csrc/pd_inference_c.h; csrc/inference_capi.cpp embeds CPython and calls
+the `_create`/`_run` helpers here).
+
+`build_c_api()` compiles `libpaddle_inference_c.so` with g++, linking
+libpython so a plain C host application can load models and predict.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+import numpy as np
+
+from . import Config, Predictor
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "csrc")
+
+
+def _create(prefix, int8):
+    cfg = Config(prefix)
+    if int8:
+        cfg.enable_int8()
+    return Predictor(cfg)
+
+
+def _run(pred, inputs):
+    """inputs: list of (float32 bytes, [dims]); returns the same shape
+    of outputs.  Raw blobs keep numpy headers out of the C side."""
+    arrs = [np.frombuffer(blob, np.float32).reshape(dims)
+            for blob, dims in inputs]
+    outs = pred.run(arrs)
+    return [(np.ascontiguousarray(o, np.float32).tobytes(),
+             [int(d) for d in o.shape]) for o in outs]
+
+
+def build_c_api(output_dir=None, verbose=False):
+    """Compile libpaddle_inference_c.so; returns its path.
+
+    Rebuilds only when the source is newer than the artifact."""
+    out_dir = output_dir or os.path.join(_CSRC, "build")
+    os.makedirs(out_dir, exist_ok=True)
+    so = os.path.join(out_dir, "libpaddle_inference_c.so")
+    src = os.path.join(_CSRC, "inference_capi.cpp")
+    hdr = os.path.join(_CSRC, "pd_inference_c.h")
+    if os.path.exists(so) and os.path.getmtime(so) >= max(
+            os.path.getmtime(src), os.path.getmtime(hdr)):
+        return so
+    ldver = sysconfig.get_config_var("LDVERSION")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+           f"-I{sysconfig.get_paths()['include']}", f"-I{_CSRC}",
+           src, "-o", so,
+           f"-L{libdir}", f"-Wl,-rpath,{libdir}",
+           f"-lpython{ldver}", "-ldl", "-lm", "-lpthread"]
+    if verbose:
+        print("[capi]", " ".join(cmd))
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if r.returncode != 0:
+        raise RuntimeError(f"C API build failed:\n{r.stderr[-4000:]}")
+    return so
+
+
+def header_path():
+    return os.path.join(_CSRC, "pd_inference_c.h")

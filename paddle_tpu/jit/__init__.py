@@ -36,7 +36,9 @@ class TranslatedLayer:
         from ..core.tensor import Tensor
         args = [np.asarray(x._data_) if isinstance(x, Tensor)
                 else np.asarray(x) for x in xs]
-        outs = self._program._exported.call(self._params, *args)
+        # _exported_call (not _exported.call): int8-baked bundles keep
+        # int8 params + scales, and the dequant is jit-fused there
+        outs = self._program._exported_call(self._params, args)
         outs = [Tensor(o) for o in outs]
         return outs[0] if len(outs) == 1 else tuple(outs)
 

@@ -494,12 +494,14 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     pad = _conv_padding(padding, 2, weight.shape[2:], dilation)
     dn = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else \
          ("NHWC", "OIHW", "NHWC")
+    # no preferred_element_type=f32 here: the TPU MXU accumulates bf16
+    # in f32 regardless, the output is cast back to x.dtype anyway, and
+    # a widened conv output makes the VJP transpose bind conv(bf16 x,
+    # f32 cotangent) — which lax rejects (mixed-dtype conv)
     out = jax.lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, feature_group_count=groups,
-        dimension_numbers=dn,
-        preferred_element_type=jnp.float32 if x.dtype in
-        (jnp.bfloat16, jnp.float16) else None)
+        dimension_numbers=dn)
     out = out.astype(x.dtype)
     if bias is not None:
         shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]

@@ -102,6 +102,30 @@ def quantize_per_channel(w, axis=-1, bits=8):
     return q, scale.astype(np.float32)
 
 
+def weight_quant_axis(a):
+    """Output-channel axis for per-channel weight quantization: paddle
+    Linear weights are [in_features, out_features] (→ axis -1); conv
+    kernels are OIHW/OIDHW with the output channel leading (→ axis 0)."""
+    return -1 if np.asarray(a).ndim == 2 else 0
+
+
+def bake_int8(params):
+    """Quantize every eligible param (ndim≥2, floating) in `params`
+    in-place to int8 along its output-channel axis; returns
+    {key: scale} for the quantized entries.  The ONE eligibility+axis
+    rule shared by static.save_inference_model(quantize='int8') and
+    inference.Config.enable_int8, so save-time and load-time bakes can
+    never diverge."""
+    scales = {}
+    for k in sorted(params):
+        a = np.asarray(params[k])
+        if a.ndim >= 2 and a.dtype.kind == "f":
+            q, s = quantize_per_channel(a, axis=weight_quant_axis(a))
+            params[k] = q
+            scales[k] = s
+    return scales
+
+
 def dequantize(q, scale, dtype=jnp.float32):
     """int8 → float dequant.  Inside a jitted predict program XLA fuses
     this into the consuming matmul/gather, so weights live in HBM (and

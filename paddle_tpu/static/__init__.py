@@ -97,6 +97,22 @@ class Program:
         (reference: params persist in the startup program scope)."""
         self._name_uid.clear()
 
+    def ir_text(self):
+        """The program's IR as text (reference: Program.to_string /
+        debug dumps): StableHLO MLIR for exported programs; a
+        structural summary for callables not yet traced."""
+        if self._exported is not None:
+            try:
+                return str(self._exported.mlir_module())
+            except Exception as e:  # jax.export internals may change
+                return f"<stablehlo unavailable: {type(e).__name__}: {e}>"
+        specs = ", ".join(f"{s.name}:{s.dtype}{list(s.shape)}"
+                          for s in self._input_specs)
+        return (f"program(fn={getattr(self._fn, '__name__', self._fn)!r}, "
+                f"inputs=[{specs}], params={sorted(self._params)})\n"
+                f"# IR materializes at first jit trace; save with "
+                f"save_inference_model for the StableHLO dump\n")
+
     @property
     def num_blocks(self):
         return 1
@@ -135,10 +151,19 @@ class program_guard:
 
 class CompiledProgram:
     """reference: static.CompiledProgram — compilation is implicit (XLA),
-    kept for API parity."""
+    kept for API parity.  BuildStrategy.debug_graphviz_path is honored:
+    when set, the program's IR is dumped there at wrap time (StableHLO
+    MLIR text for exported/deserialized programs; the callable +
+    input-spec summary for not-yet-traced ones, whose IR only exists
+    after jit tracing on first run)."""
 
     def __init__(self, program, build_strategy=None):
         self.program = program
+        self.build_strategy = build_strategy
+        path = getattr(build_strategy, "debug_graphviz_path", "")
+        if path:
+            with open(path, "w") as f:
+                f.write(program.ir_text())
 
 
 class _Var:
@@ -368,13 +393,8 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     exp, params_np = _export_layer(target, specs)
     quantized = {}
     if quantize == "int8":
-        from ..quantization import quantize_per_channel
-        for k, v in params_np.items():
-            a = np.asarray(v)
-            if a.ndim >= 2 and a.dtype.kind == "f":
-                q, scale = quantize_per_channel(a)
-                params_np[k] = q
-                quantized[k] = scale
+        from ..quantization import bake_int8
+        quantized = bake_int8(params_np)
     elif quantize is not None:
         raise ValueError(f"unsupported quantize={quantize!r} "
                          "(only 'int8')")

@@ -21,8 +21,25 @@ Variable = Tensor
 
 
 class BuildStrategy:
-    """Config bag (reference: BuildStrategy) — XLA owns fusion decisions,
-    so the knobs are recorded but the compiler is authoritative."""
+    """Config bag (reference: BuildStrategy).
+
+    Knob contract (see docs/KNOBS.md for the full honored/recorded table):
+
+    ========================  ========================================
+    knob                      effect here
+    ========================  ========================================
+    enable_inplace            recorded only — XLA buffer-donates
+                              mutated captures itself (jit/tracer.py)
+    fuse_elewise_add_act_ops  recorded only — XLA fuses elementwise
+    fuse_bn_act_ops           recorded only — same
+    memory_optimize           recorded only — XLA plans buffers
+    build_cinn_pass           recorded only — XLA IS the tensor
+                              compiler on this backend
+    debug_graphviz_path       HONORED — CompiledProgram dumps the
+                              program IR (StableHLO MLIR text for
+                              exported programs) when set
+    ========================  ========================================
+    """
 
     def __init__(self):
         self.enable_inplace = True
@@ -34,6 +51,10 @@ class BuildStrategy:
 
 
 class ExecutionStrategy:
+    """Config bag (reference: ExecutionStrategy).  All three knobs are
+    recorded only: XLA:CPU/TPU own their thread pools and scopes do not
+    exist in the functional runtime (docs/KNOBS.md)."""
+
     def __init__(self):
         self.num_threads = 1
         self.num_iteration_per_drop_scope = 10

@@ -1,0 +1,54 @@
+/* C host-application smoke for the inference C API (reference analog:
+ * test/cpp/inference/api C predictor smokes).  Loads a saved StableHLO
+ * bundle, feeds ones(2,8), prints "OK <numel> v0 v1 ..." on one line. */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "pd_inference_c.h"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_prefix> [int8]\n", argv[0]);
+    return 2;
+  }
+  PD_Config* cfg = PD_ConfigCreate();
+  PD_ConfigSetModel(cfg, argv[1]);
+  if (argc > 2 && atoi(argv[2])) PD_ConfigEnableInt8(cfg);
+
+  PD_Predictor* pred = PD_PredictorCreate(cfg);
+  if (!pred) {
+    fprintf(stderr, "create failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  if (PD_PredictorGetInputNum(pred) != 1) {
+    fprintf(stderr, "expected 1 input, got %d\n",
+            PD_PredictorGetInputNum(pred));
+    return 1;
+  }
+
+  float input[16];
+  for (int i = 0; i < 16; ++i) input[i] = 1.0f;
+  const int64_t dims[2] = {2, 8};
+  const float* datas[1] = {input};
+  const int64_t* shapes[1] = {dims};
+  const int ndims[1] = {2};
+  if (PD_PredictorRunFloat(pred, 1, datas, shapes, ndims) != 0) {
+    fprintf(stderr, "run failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+
+  const float* out = NULL;
+  const int64_t* oshape = NULL;
+  int ondim = 0;
+  if (PD_PredictorGetOutputFloat(pred, 0, &out, &oshape, &ondim) != 0) {
+    fprintf(stderr, "get output failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  int64_t numel = 1;
+  for (int d = 0; d < ondim; ++d) numel *= oshape[d];
+  printf("OK %lld", (long long)numel);
+  for (int64_t i = 0; i < numel; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  PD_PredictorDestroy(pred);
+  return 0;
+}

@@ -75,6 +75,8 @@ _SLOW_TESTS = {
     "test_moe.py::test_moe_ep_dp_hybrid_matches_replicated",       # 12
     "test_nn_extra.py::test_ctc_loss_matches_torch",               # 12
     "test_auto_parallel_engine.py::test_engine_plan_trial_confirms_pp",  # 90
+    "test_inference_capi.py::test_c_api_predicts_from_c_host",  # embeds py
+    "test_hapi_vision.py::test_hapi_distributed_fit_two_procs",  # 2 procs
 }
 
 

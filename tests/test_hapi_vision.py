@@ -127,3 +127,69 @@ def test_pretrained_weights_local_cache(tmp_path, monkeypatch):
                                   sorted(ref.state_dict().items())):
         np.testing.assert_allclose(np.asarray(v1._data_),
                                    np.asarray(v2._data_))
+
+
+def test_model_prepare_amp_o1_and_o2():
+    """AMP-aware prepare (reference: hapi/model.py _check_amp_configs):
+    O1 autocasts the forward; O2 casts params and keeps f32 masters."""
+    paddle.seed(0)
+    data = FakeData(num_samples=16, image_shape=(1, 28, 28))
+    net = LeNet(num_classes=10)
+    model = Model(net)
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=_ce, amp_configs="O1")
+    assert model._amp_level == "O1" and model._scaler is None  # bf16
+    hist = model.fit(data, batch_size=8, epochs=1, verbose=0)
+    assert np.isfinite(hist["loss"][-1])
+
+    net2 = LeNet(num_classes=10)
+    model2 = Model(net2)
+    opt2 = paddle.optimizer.Adam(1e-3, parameters=net2.parameters())
+    model2.prepare(optimizer=opt2, loss=_ce,
+                   amp_configs={"level": "O2", "dtype": "bfloat16"})
+    # O2: params now live in bf16 (decorate), masters in the optimizer
+    assert str(net2.features[0].weight.dtype).endswith("bfloat16")
+    hist2 = model2.fit(data, batch_size=8, epochs=1, verbose=0)
+    assert np.isfinite(hist2["loss"][-1])
+
+
+def test_model_prepare_amp_fp16_scaler_roundtrip():
+    """fp16 amp_configs materialize a GradScaler; scaled train step still
+    converges and scale stays finite."""
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    model = Model(net)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    model.prepare(optimizer=opt,
+                  loss=lambda o, y: ((o - y) ** 2).mean(),
+                  amp_configs={"level": "O1", "dtype": "bfloat16",
+                               "init_loss_scaling": 128.0})
+    assert model._scaler is not None
+    x = np.random.default_rng(0).standard_normal((64, 4)).astype("float32")
+    y = (x[:, :1] * 3.0).astype("float32")
+    losses = []
+    for _ in range(40):
+        loss = model.train_batch(paddle.to_tensor(x), paddle.to_tensor(y))
+        losses.append(loss[0])
+    assert losses[-1] < 0.1 * losses[0]
+    assert np.isfinite(model._scaler.get_loss_scaling())
+
+
+def test_model_prepare_bad_amp_level_raises():
+    model = Model(nn.Linear(2, 2))
+    with pytest.raises(ValueError):
+        model.prepare(amp_configs="O3")
+
+
+def test_hapi_distributed_fit_two_procs(tmp_path):
+    """2-rank hapi fit: sharded loader + cross-process grad averaging
+    (reference: hapi DynamicGraphAdapter nranks>1 path)."""
+    from paddle_tpu.distributed.launch.context import Context, parse_args
+    from paddle_tpu.distributed.launch.controller import (
+        CollectiveController)
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_hapi_dist_worker.py")
+    args = parse_args(["--nproc_per_node", "2", worker, str(tmp_path)])
+    code = CollectiveController(Context(args=args)).run()
+    assert code == 0
+    assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()

@@ -188,3 +188,148 @@ def test_async_ps_embedding_trains():
     client.close()
     for rt in rts:
         rt.stop()
+
+
+# ------------------------------------------------------------------
+# SSD tier + geo-SGD (reference: ps/table/ssd_sparse_table.{h,cc},
+# framework/fleet/ps_gpu_wrapper.h:114, the_one_ps.py geo strategy)
+# ------------------------------------------------------------------
+
+def test_ssd_table_spills_and_rereads(tmp_path):
+    from paddle_tpu.distributed.ps import SSDSparseTable
+    t = SSDSparseTable(4, lr=1.0, cache_rows=8,
+                       path=str(tmp_path / "cold.bin"))
+    ids = list(range(32))
+    first = t.pull(ids)           # 32 rows through an 8-row cache
+    assert len(t.rows) <= 8 and t.num_cold_rows >= 24
+    again = t.pull(ids)           # cold rows page back in unchanged
+    np.testing.assert_allclose(again, first)
+    t.push(ids, np.ones((32, 4), np.float32))
+    np.testing.assert_allclose(t.pull(ids), first - 1.0, rtol=1e-6)
+    state = t.all_rows()
+    assert len(state) == 32
+    np.testing.assert_allclose(state[0], first[0] - 1.0, rtol=1e-6)
+    t.close()
+
+
+def test_ssd_table_adagrad_accumulator_survives_eviction(tmp_path):
+    from paddle_tpu.distributed.ps import SSDSparseTable, SparseTable
+    ssd = SSDSparseTable(3, lr=0.5, optimizer="adagrad", cache_rows=2,
+                         path=str(tmp_path / "cold.bin"), seed=7)
+    ram = SparseTable(3, lr=0.5, optimizer="adagrad", seed=7)
+    ids = [1, 2, 3, 4, 5]
+    # seed both tables with identical initial rows
+    ram_rows = ram.pull(ids)
+    for k, r in zip(ids, ssd.pull(ids)):
+        ram.rows[k] = np.array(ram.rows[k])
+    np.testing.assert_allclose(ssd.pull(ids), ram_rows)
+    rng = np.random.default_rng(0)
+    for _ in range(5):            # repeated pushes evict + reload accums
+        g = rng.standard_normal((5, 3)).astype(np.float32)
+        ssd.push(ids, g)
+        ram.push(ids, g)
+    np.testing.assert_allclose(ssd.pull(ids), ram.pull(ids), rtol=1e-5)
+    ssd.close()
+
+
+def test_ssd_table_compaction_preserves_state(tmp_path):
+    from paddle_tpu.distributed.ps import SSDSparseTable
+    t = SSDSparseTable(4, lr=1.0, cache_rows=4,
+                       path=str(tmp_path / "cold.bin"))
+    ids = list(range(16))
+    base = t.pull(ids)
+    for _ in range(6):            # churn: many abandoned records
+        t.push(ids, np.ones((16, 4), np.float32))
+    t.compact()
+    assert t._dead_bytes == 0 and t._end == len(t._index) * t._rec_bytes
+    np.testing.assert_allclose(t.pull(ids), base - 6.0, rtol=1e-6)
+    t.close()
+
+
+def test_ssd_table_over_the_wire(tmp_path):
+    cfg = {"tables": {0: {"type": "ssd_sparse", "dim": 4, "lr": 1.0,
+                          "cache_rows": 4,
+                          "path": str(tmp_path / "srv_cold.bin")}}}
+    rt = TheOnePSRuntime("server", cfg)
+    rt.init_server()
+    client = PSClient(rt.server_address)
+    ids = list(range(12))
+    rows = client.pull_sparse(0, ids)
+    client.push_sparse(0, ids, np.ones((12, 4), np.float32))
+    np.testing.assert_allclose(client.pull_sparse(0, ids), rows - 1.0,
+                               rtol=1e-6)
+    state = client.save()
+    assert len(state[0]) == 12    # save sees cold rows too
+    client.stop_server()
+    client.close()
+    rt.stop()
+
+
+def test_geo_sgd_two_workers_merge_deltas():
+    from paddle_tpu.distributed.ps import GeoSGDCommunicator
+    cfg = {"tables": {0: {"type": "sparse", "dim": 2, "lr": 1.0}}}
+    rt = TheOnePSRuntime("server", cfg)
+    rt.init_server()
+    c1, c2 = PSClient(rt.server_address), PSClient(rt.server_address)
+    g1 = GeoSGDCommunicator(c1, 0, 2, lr=1.0, geo_step=3)
+    g2 = GeoSGDCommunicator(c2, 0, 2, lr=1.0, geo_step=3)
+    base = g1.pull([7])
+    _ = g2.pull([7])              # both workers share the server row
+    for _ in range(3):            # 3 pushes → one sync each
+        g1.push([7], np.full((1, 2), 1.0, np.float32))
+        g2.push([7], np.full((1, 2), 2.0, np.float32))
+    # between-sync pushes were local-only; after both synced, the server
+    # row carries BOTH workers' movement: -3*1 + -3*2 = -9
+    probe = PSClient(rt.server_address)
+    np.testing.assert_allclose(probe.pull_sparse(0, [7]), base - 9.0,
+                               rtol=1e-6)
+    # a fresh sync folds the other worker's delta into each local copy
+    g1.sync(); g2.sync()
+    g1._dirty.add(7); g1.sync()
+    np.testing.assert_allclose(g1.pull([7]), base - 9.0, rtol=1e-6)
+    for c in (probe, c2):
+        c.close()
+    c1.stop_server()
+    c1.close()
+    rt.stop()
+
+
+def test_geo_sgd_local_pushes_cost_zero_rpcs():
+    from paddle_tpu.distributed.ps import GeoSGDCommunicator
+    cfg = {"tables": {0: {"type": "sparse", "dim": 2, "lr": 1.0}}}
+    rt = TheOnePSRuntime("server", cfg)
+    rt.init_server()
+    client = PSClient(rt.server_address)
+    geo = GeoSGDCommunicator(client, 0, 2, lr=1.0, geo_step=100)
+    geo.pull([1])
+    calls = {"n": 0}
+    orig = client._call
+    client._call = lambda **kw: (calls.__setitem__("n", calls["n"] + 1),
+                                 orig(**kw))[1]
+    for _ in range(10):           # all below geo_step: purely local
+        geo.push([1], np.ones((1, 2), np.float32))
+        geo.pull([1])
+    assert calls["n"] == 0
+    geo.sync()
+    assert calls["n"] == 2        # one delta push + one refresh pull
+    client._call = orig
+    client.stop_server()
+    client.close()
+    rt.stop()
+
+
+def test_ssd_table_default_path_and_clean_eviction(tmp_path):
+    from paddle_tpu.distributed.ps import SSDSparseTable
+    # default path=None must yield a live, usable temp-backed table
+    t = SSDSparseTable(4, lr=1.0, cache_rows=4)
+    first = t.pull(list(range(12)))
+    np.testing.assert_allclose(t.pull(list(range(12))), first)
+    # read-mostly workload: clean evictions re-use the existing cold
+    # record — the file must NOT grow across repeated pulls
+    end_before = t._end
+    for _ in range(5):
+        t.pull(list(range(12)))
+    assert t._end == end_before
+    import os
+    t.close()
+    os.unlink(t.path)
