@@ -180,6 +180,16 @@ def main():
         cfg = gpt_config("gpt2-124m", max_seq_len=1024,
                          use_flash_attention=True)
         batch, seq, steps, warmup = 8, 1024, 8, 3
+        # adopt the hardware-tuned batch when the sweep has run
+        # (benchmarks/mfu_sweep.py writes TUNED.json; records for every
+        # candidate live in benchmarks/TPU_RUNS.jsonl)
+        try:
+            tuned = json.load(open(os.path.join(
+                os.path.dirname(__file__), "benchmarks", "TUNED.json")))
+            batch = int(tuned["gpt2_124m"]["batch"])
+            _log(f"using tuned batch {batch}")
+        except (OSError, KeyError, ValueError):
+            pass
         # pick flash-attention block sizes by timed sweep before the
         # measured run (cached per shape across rounds)
         try:

@@ -585,29 +585,25 @@ class ShardedPSClient:
                 out[p] = row
         return np.asarray(out, np.float32)
 
-    def push_sparse(self, table_id, ids, grad):
-        grad = np.asarray(grad, np.float32)
+    def _push_fanout(self, method, table_id, ids, rows):
+        """Shard-parallel row push: bucket by id, one future per shard,
+        join — shared by the gradient and geo-delta paths."""
+        rows = np.asarray(rows, np.float32)
         buckets, pos = self._partition(ids)
         futs = []
         for s in range(self._n):
             if buckets[s]:
                 futs.append(self._pool.submit(
-                    self._clients[s].push_sparse, table_id, buckets[s],
-                    grad[pos[s]]))
+                    getattr(self._clients[s], method), table_id,
+                    buckets[s], rows[pos[s]]))
         for f in futs:
             f.result()
 
+    def push_sparse(self, table_id, ids, grad):
+        self._push_fanout("push_sparse", table_id, ids, grad)
+
     def push_sparse_delta(self, table_id, ids, delta):
-        delta = np.asarray(delta, np.float32)
-        buckets, pos = self._partition(ids)
-        futs = []
-        for s in range(self._n):
-            if buckets[s]:
-                futs.append(self._pool.submit(
-                    self._clients[s].push_sparse_delta, table_id,
-                    buckets[s], delta[pos[s]]))
-        for f in futs:
-            f.result()
+        self._push_fanout("push_sparse_delta", table_id, ids, delta)
 
     def save(self):
         return [c.save() for c in self._clients]

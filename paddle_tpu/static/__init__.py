@@ -61,13 +61,123 @@ class Program:
         self._param_scales = None  # per-param int8 scales (sorted order)
         self._qrun = None          # jitted dequant-fused caller
         self._name_uid = {}     # auto-name counters for static.nn params
+        self._jaxpr = None      # built IR (ClosedJaxpr) — see build()
+        self._out_tree = None
+        self._compiled = None   # jitted executable over _jaxpr
+        self._use_compiled = False  # build() opts Executor.run into it
 
     def clone(self, for_test=False):
         p = Program(self._fn, list(self._input_specs))
         p._exported = self._exported
         p._params = dict(self._params)
         p._param_scales = self._param_scales
+        p._jaxpr = self._jaxpr
+        p._out_tree = self._out_tree
+        p._compiled = self._compiled
+        p._use_compiled = self._use_compiled
         return p
+
+    # ---- program IR (reference: ProgramDesc blocks/ops; here the IR is
+    # a jaxpr — SURVEY §7: PIR's role is played by jaxpr/StableHLO) ----
+
+    def build(self):
+        """Trace the callable into the program IR (a ClosedJaxpr).
+
+        The reference builds ProgramDesc incrementally under
+        program_guard; here the whole callable traces in one pass (the
+        two-phase tracer handles the dynamic path — this is the static
+        path for introspection, pruning, and the compiled Executor).
+        Parameters the callable closes over become jaxpr CONSTANTS —
+        build() freezes them (inference semantics); a program whose
+        weights mutate between runs belongs on the eager path.
+
+        Requires fully-static input_specs: a dynamic dim would bake the
+        trace shape into reductions/normalizations and return silently
+        wrong numbers for other batch sizes."""
+        self._ensure_ir()
+        self._use_compiled = True
+        return self
+
+    def _ensure_ir(self):
+        if self._jaxpr is not None:
+            return
+        if self._fn is None:
+            raise ValueError("Program has no function bound")
+        if not self._input_specs:
+            raise ValueError("build() needs input_specs (static.data)")
+        for s in self._input_specs:
+            if any(d is None or d < 0 for d in (s.shape or [])):
+                raise ValueError(
+                    f"build() needs concrete shapes; input {s.name!r} has "
+                    f"dynamic dims {list(s.shape)} — give static.data a "
+                    "full shape, or use the dynamic path (to_static / "
+                    "eager Executor.run)")
+        import jax
+        import jax.numpy as jnp
+        from ..core.dtype import convert_dtype
+        jnp_asarray = jnp.asarray
+
+        def as_arrays(*arrays):
+            args = [Tensor(a) for a in arrays]
+            self._reset_uids()
+            with program_guard(self), _state.no_grad():
+                outs = self._fn(*args)
+            if isinstance(outs, Tensor):
+                outs = (outs,)
+            return tuple(o._data_ if isinstance(o, Tensor)
+                         else jnp_asarray(o) for o in outs)
+
+        avals = [jax.ShapeDtypeStruct(tuple(s.shape),
+                                      convert_dtype(s.dtype))
+                 for s in self._input_specs]
+        self._jaxpr = jax.make_jaxpr(as_arrays)(*avals)
+        self._compiled = None
+
+    def global_block(self):
+        """The single block of ops (reference: Program.global_block —
+        framework.Block with .ops).  Traces the IR if needed but does
+        NOT switch execution onto the compiled path — inspection must
+        not change run semantics; call build() for that."""
+        self._ensure_ir()
+        return Block(self._jaxpr.jaxpr)
+
+    def block(self, idx):
+        if idx != 0:
+            raise IndexError("single-block program (jaxpr IR)")
+        return self.global_block()
+
+    def _prune(self, fetch_indices):
+        """Dead-code-eliminate to the given output subset (reference:
+        Program._prune_with_input used by save_inference_model).
+        Returns a NEW built program computing only those outputs."""
+        self._ensure_ir()
+        from jax._src.interpreters import partial_eval as pe
+        n_out = len(self._jaxpr.jaxpr.outvars)
+        used = [i in set(fetch_indices) for i in range(n_out)]
+        new_jaxpr, used_consts, used_ins = pe.dce_jaxpr_consts(
+            self._jaxpr.jaxpr, used, instantiate=True)
+        from jax.extend.core import ClosedJaxpr
+        consts = [c for c, u in zip(self._jaxpr.consts, used_consts) if u]
+        pruned = Program(None, list(self._input_specs))
+        pruned._jaxpr = ClosedJaxpr(new_jaxpr, consts)
+        pruned._use_compiled = True   # no callable: IR is all it has
+        pruned._params = dict(self._params)
+        return pruned
+
+    def _jaxpr_call(self, args):
+        """Execute the built IR through ONE cached compiled executable —
+        the StandaloneExecutor/PJRT-launcher analog (reference:
+        new executor InterpreterCore caching per program)."""
+        import jax
+        if self._compiled is None:
+            closed = self._jaxpr
+
+            def run(*xs):
+                return jax.core.eval_jaxpr(closed.jaxpr, closed.consts,
+                                           *xs)
+
+            self._compiled = jax.jit(run)
+        return self._compiled(*args)
 
     def _exported_call(self, params, args):
         """Run the deserialized program.  `params` is the list aligned
@@ -106,6 +216,8 @@ class Program:
                 return str(self._exported.mlir_module())
             except Exception as e:  # jax.export internals may change
                 return f"<stablehlo unavailable: {type(e).__name__}: {e}>"
+        if self._jaxpr is not None:
+            return self._jaxpr.pretty_print()
         specs = ", ".join(f"{s.name}:{s.dtype}{list(s.shape)}"
                           for s in self._input_specs)
         return (f"program(fn={getattr(self._fn, '__name__', self._fn)!r}, "
@@ -121,6 +233,72 @@ class Program:
         src = "exported-stablehlo" if self._exported is not None else \
             getattr(self._fn, "__name__", None)
         return f"Program({src})"
+
+
+class OpDesc:
+    """One op of a built program (reference: framework.OpDesc views over
+    ProgramDesc protos; here a read-only view over a jaxpr eqn)."""
+
+    def __init__(self, eqn, names):
+        self._eqn = eqn
+        self._names = names
+
+    @property
+    def type(self):
+        return self._eqn.primitive.name
+
+    def _name(self, v):
+        if hasattr(v, "val"):          # Literal
+            return repr(v.val)
+        return self._names.get(id(v), "?")
+
+    def input_arg_names(self):
+        return [self._name(v) for v in self._eqn.invars]
+
+    def output_arg_names(self):
+        return [self._name(v) for v in self._eqn.outvars]
+
+    def attrs(self):
+        return dict(self._eqn.params)
+
+    def __repr__(self):
+        return (f"{self.type}({', '.join(self.input_arg_names())}) -> "
+                f"{', '.join(self.output_arg_names())}")
+
+
+def _var_seq_name(i):
+    name = ""
+    while True:
+        name = chr(ord("a") + i % 26) + name
+        i = i // 26 - 1
+        if i < 0:
+            return name
+
+
+class Block:
+    """The op list + var table of a built program (reference:
+    framework.Block).  Vars get stable sequential names (a, b, ...,
+    matching jaxpr pretty-print style) keyed by first appearance."""
+
+    def __init__(self, jaxpr):
+        self._jaxpr = jaxpr
+        self._names = {}
+        order = list(jaxpr.constvars) + list(jaxpr.invars)
+        for e in jaxpr.eqns:
+            order.extend(v for v in e.outvars)
+        for v in order:
+            if id(v) not in self._names:
+                self._names[id(v)] = _var_seq_name(len(self._names))
+
+    @property
+    def ops(self):
+        return [OpDesc(e, self._names) for e in self._jaxpr.eqns]
+
+    def var_names(self):
+        return list(self._names.values())
+
+    def __repr__(self):
+        return f"Block({len(self._jaxpr.eqns)} ops)"
 
 
 _default_program = Program()
@@ -255,6 +433,15 @@ class Executor:
             params = [program._params[k] for k in
                       sorted(program._params)]
             outs = program._exported_call(params, args)
+        elif program._use_compiled and program._jaxpr is not None:
+            # explicitly-BUILT program: ONE compiled executable, params
+            # baked as constants (inference semantics).  Training-style
+            # programs whose params mutate between runs stay on the
+            # eager path below — build() is opt-in; inspection via
+            # global_block() alone never flips this switch.
+            args = [np.asarray(feed[s.name]) for s in
+                    program._input_specs]
+            outs = program._jaxpr_call(args)
         else:
             if program._fn is None:
                 raise ValueError("Program has no function bound; build it "

@@ -338,3 +338,71 @@ def test_int8_conv_weights_quantize_per_output_channel(tmp_path):
     from paddle_tpu.inference import Predictor, Config
     out = Predictor(Config(prefix)).run([x])[0]
     np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.1)
+
+
+def test_program_build_ir_introspection_and_prune():
+    """Built-program IR (reference: ProgramDesc blocks/ops,
+    Program._prune): ops are inspectable, DCE prunes to the fetch
+    subset, and the Executor runs the ONE compiled executable."""
+    net = _small_net(seed=7)
+
+    def fn(x):
+        h = net(x)
+        return h, (h * h).sum()   # second output adds mul+reduce ops
+
+    prog = static.Program(fn, [static.data("x", [2, 8])])
+    prog.build()
+    blk = prog.global_block()
+    types = [o.type for o in blk.ops]
+    assert "dot_general" in types and "reduce_sum" in types
+    op0 = blk.ops[0]
+    assert op0.input_arg_names() and op0.output_arg_names()
+    assert "dot_general" in repr(op0)
+    assert len(blk.var_names()) >= len(blk.ops)
+
+    # prune to output 0 (h): the elementwise-square + reduce must go
+    pruned = prog._prune([0])
+    ptypes = [o.type for o in pruned.global_block().ops]
+    assert "reduce_sum" not in ptypes
+    assert "dot_general" in ptypes
+
+    exe = static.Executor()
+    x = np.random.default_rng(2).standard_normal((2, 8)).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    outs = exe.run(prog, feed={"x": x})
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5)
+    np.testing.assert_allclose(outs[1], (ref * ref).sum(), rtol=1e-5)
+    (ph,) = exe.run(pruned, feed={"x": x})
+    np.testing.assert_allclose(ph, ref, rtol=1e-5)
+
+    # the built IR is the ir_text for built programs (jaxpr pretty print)
+    assert "dot_general" in prog.ir_text()
+    # clone preserves the built IR
+    assert "dot_general" in [o.type
+                             for o in prog.clone().global_block().ops]
+
+
+def test_program_build_rejects_dynamic_dims_and_inspect_is_pure():
+    """build() must refuse dynamic dims (a batch-1-baked trace would
+    return silently wrong reductions), and global_block() inspection
+    must NOT flip Executor.run onto the constant-baked compiled path."""
+    import pytest as _pytest
+    prog = static.Program(lambda x: (x * x).mean(),
+                          [static.data("x", [-1, 8])])
+    with _pytest.raises(ValueError, match="dynamic dims"):
+        prog.build()
+
+    # inspection purity: mutate weights between runs; output must track
+    from paddle_tpu import nn
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    p2 = static.Program(lambda x: net(x), [static.data("x", [2, 8])])
+    exe = static.Executor()
+    x = np.ones((2, 8), np.float32)
+    before = exe.run(p2, feed={"x": x})[0]
+    assert len(p2.global_block().ops) > 0       # traces IR for viewing
+    net.weight.set_value(np.zeros((8, 4), np.float32))
+    net.bias.set_value(np.zeros(4, np.float32))
+    after = exe.run(p2, feed={"x": x})[0]       # still eager → fresh
+    np.testing.assert_allclose(after, 0.0, atol=1e-6)
+    assert np.abs(before).sum() > 0
