@@ -93,8 +93,12 @@ print(json.dumps({{"batch": batch, "slope": slope,
                   "tokens_per_sec": batch * seq / slope,
                   "t1": t1, "tN": tN, "loss": final_loss}}))
 """
-    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                       text=True, timeout=2000)
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=2000)
+    except subprocess.TimeoutExpired:
+        _log(f"batch {batch} TIMED OUT — skipping")
+        return None
     if r.returncode != 0:
         _log(f"batch {batch} FAILED: {r.stderr[-400:]}")
         return None
@@ -115,9 +119,6 @@ def main():
     runs_path = os.path.join(here, "TPU_RUNS.jsonl")
     from paddle_tpu.cost_model import device_peak_flops
     peak = device_peak_flops(jax.devices()[0].platform)
-    # FLOPs/token measured once by bench.py; re-derive from the model
-    # registry here to keep records self-contained
-    flops_per_token = None
     results = []
     for b in BATCHES:
         _log(f"measuring batch {b} ...")

@@ -125,6 +125,13 @@ class ElasticCollectiveController(CollectiveController):
         from . import master as M
         args = self.ctx.args
         restarts = 0
+        # fault-tolerance level (reference: manager.py:178, env
+        # PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL — reference spelling):
+        # 0 = only the explicit ELASTIC_EXIT_CODE relaunches; >0 = ANY
+        # worker failure relaunches (up to max_restart) instead of
+        # failing the job
+        level = int(os.environ.get(
+            "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "0"))
         self.kv.start_heartbeat()
         try:
             while True:
@@ -140,14 +147,15 @@ class ElasticCollectiveController(CollectiveController):
                 if status == "done":
                     return 0
                 if status == M.RESTART or \
+                        (level > 0 and status == "failed") or \
                         any(c == ELASTIC_EXIT_CODE for c in codes
                             if c is not None):
-                    if restarts >= args.max_restart:
-                        return 1
-                    restarts += 1
                     self._terminate()
                     for p in self.procs:
                         p.wait()
+                    if restarts >= args.max_restart:
+                        return 1   # workers reaped, not orphaned
+                    restarts += 1
                     continue
                 return max(c for c in codes if c is not None)
         finally:

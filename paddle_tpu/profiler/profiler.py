@@ -315,3 +315,39 @@ def export_protobuf(prof_or_dir, path=None):
 def load_profiler_result(path):
     with open(path) as f:
         return json.load(f)
+
+
+def merge_chrome_traces(paths, out_path):
+    """Merge per-host chrome traces into one timeline (reference
+    capability: tools/CrossStackProfiler/ multi-node trace merge).
+
+    Each input's pids are offset into a disjoint host band (host i →
+    pid + (i+1)*1_000_000) and a process_name metadata row labels the
+    band with the source file, so rows from different hosts never
+    collide in chrome://tracing / Perfetto."""
+    merged = []
+    band_width = 1 << 23      # > kernel.pid_max default (4194304)
+    for i, p in enumerate(paths):
+        with open(p) as f:
+            trace = json.load(f)
+        events = trace if isinstance(trace, list) else \
+            trace.get("traceEvents", []) or []
+        band = (i + 1) * band_width
+        seen_pids = set()
+        for e in events:
+            e = dict(e)
+            pid = e.get("pid", 0)
+            e["pid"] = band + (pid % band_width
+                               if isinstance(pid, int) else 0)
+            seen_pids.add(e["pid"])
+            merged.append(e)
+        for pid in sorted(seen_pids):
+            merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": f"host{i}:"
+                                            f"{os.path.basename(p)}"}})
+    d = os.path.dirname(out_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+    return out_path

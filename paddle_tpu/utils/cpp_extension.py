@@ -94,3 +94,48 @@ def setup(name=None, ext_modules=None, **kwargs):
 def get_include():
     return os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "csrc")
+
+
+def register_c_kernel(op_name, library, symbol, nondiff=True):
+    """Kernel-registration C ABI (reference capability: the PHI C-API
+    kernel registry — paddle/phi/capi/include/kernel_registry.h lets a
+    shared library register kernels the dispatcher then routes to).
+
+    `symbol` must follow the host-kernel ABI
+        void symbol(const float* x, float* y, int64_t n)
+    (unary elementwise over float32).  The kernel becomes a dispatchable
+    framework op: it runs on the HOST via jax.pure_callback — the TPU
+    analog of a reference CPU kernel — so it composes with jit and
+    sharding (XLA inserts the host transfer) but is non-differentiable
+    unless a VJP op is registered separately.
+
+    `library` is a ctypes.CDLL (e.g. from load()) or a .so path.
+    Returns the python op callable (also importable wherever the
+    registry op is exposed)."""
+    import numpy as np
+
+    lib = library if not isinstance(library, str) else ctypes.CDLL(library)
+    cfn = getattr(lib, symbol)
+    cfn.restype = None
+    cfn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_float), ctypes.c_longlong]
+
+    def host_kernel(x):
+        x = np.ascontiguousarray(x, np.float32)
+        y = np.empty_like(x)
+        cfn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), x.size)
+        return y
+
+    from ..core.dispatch import defop
+
+    @defop(op_name, nondiff=nondiff)
+    def c_kernel_op(x):
+        import jax
+        import jax.numpy as jnp
+        return jax.pure_callback(
+            host_kernel,
+            jax.ShapeDtypeStruct(x.shape, jnp.float32), x,
+            vmap_method="sequential")
+
+    return c_kernel_op

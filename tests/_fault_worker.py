@@ -1,0 +1,14 @@
+"""Crash-once worker: first attempt exits 3; after the flag file
+exists, exits 0 — exercises the fault-tolerance-level relaunch."""
+import os
+import sys
+
+outdir = sys.argv[1]
+flag = os.path.join(outdir, "crashed_once")
+rank = os.environ["PADDLE_TRAINER_ID"]
+if not os.path.exists(flag):
+    with open(flag, "w") as f:
+        f.write("x")
+    sys.exit(3)
+with open(os.path.join(outdir, f"ok.{rank}"), "w") as f:
+    f.write("recovered")

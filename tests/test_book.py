@@ -87,3 +87,66 @@ def test_recognize_digits_mlp(tmp_path):
     out = pred.run([feats[:32]])[0]
     served_acc = float((np.argmax(out, 1) == labels[:32]).mean())
     assert served_acc > 0.85, served_acc
+
+
+def test_word2vec_book(tmp_path):
+    """Skip-gram word2vec on a synthetic corpus: embeddings train until
+    same-cluster words are nearer than cross-cluster words, then the
+    embedding table round-trips through the saved bundle (reference:
+    test/book/test_word2vec_book.py — N-gram embedding model trained to
+    a cost threshold, then infer from the saved model)."""
+    rng = np.random.default_rng(2)
+    vocab, dim = 32, 16
+    # two topic clusters: words co-occur only within their cluster
+    cluster = np.arange(vocab) % 2
+    pairs = []
+    for _ in range(4000):
+        c = rng.integers(0, 2)
+        members = np.where(cluster == c)[0]
+        w, ctx = rng.choice(members, 2, replace=False)
+        pairs.append((w, ctx))
+    pairs = np.array(pairs, np.int64)
+
+    paddle.seed(2)
+
+    class SkipGram(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(vocab, dim)
+            self.out = nn.Linear(dim, vocab)
+
+        def forward(self, w):
+            return self.out(self.emb(w))
+
+    net = SkipGram()
+    opt = paddle.optimizer.Adam(5e-3, parameters=net.parameters())
+    ce = nn.CrossEntropyLoss()
+    last = None
+    for epoch in range(12):
+        perm = rng.permutation(len(pairs))
+        for i in range(0, len(pairs), 256):
+            b = pairs[perm[i:i + 256]]
+            loss = ce(net(paddle.to_tensor(b[:, 0])),
+                      paddle.to_tensor(b[:, 1]))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            last = float(loss)
+        if last < 3.0:   # uniform over 32 words would be ln(32)=3.47
+            break
+    assert last < 3.0, f"word2vec did not converge: loss={last}"
+
+    emb = net.emb.weight.numpy()
+    emb = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+    sims = emb @ emb.T
+    same = sims[cluster[:, None] == cluster[None, :]].mean()
+    cross = sims[cluster[:, None] != cluster[None, :]].mean()
+    assert same > cross + 0.1, (same, cross)
+
+    prefix = str(tmp_path / "word2vec")
+    static.save_inference_model(
+        prefix, [InputSpec([None], "int64", "w")], None, layer=net)
+    pred = inference.create_predictor(inference.Config(prefix))
+    out = pred.run([pairs[:16, 0]])[0]
+    ref = net(paddle.to_tensor(pairs[:16, 0])).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)

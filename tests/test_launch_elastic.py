@@ -133,3 +133,41 @@ def test_rendezvous_assigns_contiguous_ranks_multi_proc(tmp_path):
     assert codes == {"a": 0, "b": 0}
     for r in range(3):
         assert (tmp_path / f"w3.r{r}").exists(), r
+
+
+FAULT_WORKER = os.path.join(os.path.dirname(__file__), "_fault_worker.py")
+
+
+def test_fault_tolerance_level_relaunches_crashed_worker(tmp_path,
+                                                         monkeypatch):
+    """PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL>0 (reference: elastic
+    manager.py:178, spelling as in the reference): a worker crashing
+    with an ordinary nonzero code is relaunched instead of failing the
+    job; level 0 keeps the fail-fast behavior."""
+    # level 1: crash-once worker recovers on the relaunch
+    monkeypatch.setenv("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1")
+    ep = f"127.0.0.1:{free_port()}"
+    args = parse_args([
+        "--master", ep, "--nnodes", "1", "--node_rank", "0",
+        "--pod_id", "p0", "--job_id", "ft", "--nproc_per_node", "1",
+        "--elastic_quiet", "0.2", "--elastic_timeout", "15",
+        "--max_restart", "3",
+        FAULT_WORKER, str(tmp_path)])
+    rc = ElasticCollectiveController(Context(args=args)).run()
+    assert rc == 0
+    assert (tmp_path / "ok.0").exists()
+
+    # level 0: same crash is terminal
+    monkeypatch.setenv("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "0")
+    out2 = tmp_path / "lvl0"
+    out2.mkdir()
+    ep2 = f"127.0.0.1:{free_port()}"
+    args2 = parse_args([
+        "--master", ep2, "--nnodes", "1", "--node_rank", "0",
+        "--pod_id", "p0", "--job_id", "ft0", "--nproc_per_node", "1",
+        "--elastic_quiet", "0.2", "--elastic_timeout", "15",
+        "--max_restart", "3",
+        FAULT_WORKER, str(out2)])
+    rc2 = ElasticCollectiveController(Context(args=args2)).run()
+    assert rc2 == 3
+    assert not (out2 / "ok.0").exists()

@@ -143,3 +143,24 @@ def test_op_profiling_off_outside_profiler():
     prof = Profiler(targets=[ProfilerTarget.CPU], timer_only=True)
     with prof:
         assert not op_profiling_active()   # timer_only skips op spans
+
+
+def test_merge_chrome_traces_cross_host(tmp_path):
+    """CrossStackProfiler analog: per-host traces merge into one
+    timeline with disjoint pid bands."""
+    import json
+    from paddle_tpu.profiler import merge_chrome_traces
+    for i in range(2):
+        with open(tmp_path / f"host{i}.json", "w") as f:
+            json.dump({"traceEvents": [
+                {"name": f"op{i}", "ph": "X", "ts": 10 * i, "dur": 5,
+                 "pid": 7, "tid": 1}]}, f)
+    out = merge_chrome_traces(
+        [str(tmp_path / "host0.json"), str(tmp_path / "host1.json")],
+        str(tmp_path / "merged.json"))
+    merged = json.load(open(out))["traceEvents"]
+    evs = [e for e in merged if e.get("ph") == "X"]
+    metas = [e for e in merged if e.get("ph") == "M"]
+    assert len(evs) == 2 and len(metas) == 2
+    assert evs[0]["pid"] != evs[1]["pid"]       # disjoint host bands
+    assert any("host1" in m["args"]["name"] for m in metas)
