@@ -71,11 +71,17 @@ class TransformerCost:
 
 def transformer_step_cost(n_params, n_layers, hidden, batch, seq,
                           dp=1, mp=1, pp=1, sharding=1, device="v5e",
-                          dtype_bytes=2, grad_accum=1):
-    """Roofline step-time for one training step (fwd+bwd ≈ 6·P·T flops)."""
+                          dtype_bytes=2, grad_accum=1, recompute=False):
+    """Roofline step-time for one training step (fwd+bwd ≈ 6·P·T flops).
+
+    recompute=True models layer-boundary activation checkpointing: one
+    stored activation per layer instead of ~8, at the cost of an extra
+    forward in the backward pass (flops ×4/3)."""
     spec = DEVICE_SPECS[device]
     tokens = batch * seq
     flops = 6.0 * n_params * tokens
+    if recompute:
+        flops *= 4.0 / 3.0
     n_dev = dp * mp * pp * sharding
     t_compute = flops / (spec.peak_flops_bf16 * n_dev)
     # 1F1B pipeline bubble: with m micro-batches the schedule spans
@@ -88,8 +94,9 @@ def transformer_step_cost(n_params, n_layers, hidden, batch, seq,
     # memory per device: params+grads+opt (ZeRO over sharding·dp), acts
     state_bytes = n_params * (dtype_bytes + dtype_bytes + 8)
     state_per_dev = state_bytes / (mp * pp * max(sharding, 1))
-    act_bytes = (dtype_bytes * batch * seq * hidden * n_layers * 8
-                 / (dp * mp * pp * grad_accum))
+    act_factor = 1 if recompute else 8
+    act_bytes = (dtype_bytes * batch * seq * hidden * n_layers
+                 * act_factor / (dp * mp * pp * grad_accum))
     hbm = state_per_dev + act_bytes
 
     # comms: dp grad all-reduce + mp per-layer collectives

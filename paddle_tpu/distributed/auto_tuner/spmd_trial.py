@@ -41,6 +41,8 @@ def main():
     pp = int(cand.get("pp", 1))
     sh = int(cand.get("sharding", 1))
     mb = int(cand.get("micro_batch", 1))
+    use_rc = bool(cand.get("use_recompute", False))
+    amp = str(cand.get("amp", "O0"))
 
     hidden = int(os.environ.get("PADDLE_TRIAL_HIDDEN", "64"))
     # depth is FIXED by the caller (divisible by n_devices, hence by any
@@ -63,7 +65,7 @@ def main():
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=256, hidden_size=hidden, num_layers=layers,
                     num_heads=4, max_seq_len=seq,
-                    use_flash_attention=False)
+                    use_flash_attention=False, use_recompute=use_rc)
     batch = max(2 * dp * sh, 2 * mb)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32)
@@ -81,7 +83,9 @@ def main():
         y = paddle.to_tensor(ids[:, 1:])
 
         def step():
-            return model.train_batch((x, y), opt)
+            with paddle.amp.auto_cast(enable=(amp != "O0"), level=amp,
+                                      dtype="bfloat16"):
+                return model.train_batch((x, y), opt)
     else:
         from paddle_tpu.models import ParallelGPTForCausalLM
         strategy.sharding = sh > 1
@@ -106,7 +110,9 @@ def main():
 
         @paddle.jit.to_static
         def train_step(x, y):
-            _, loss = model(x, labels=y)
+            with paddle.amp.auto_cast(enable=(amp != "O0"), level=amp,
+                                      dtype="bfloat16"):
+                _, loss = model(x, labels=y)
             loss.backward()
             opt.step()
             opt.clear_grad()

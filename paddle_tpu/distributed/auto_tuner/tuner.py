@@ -35,6 +35,10 @@ class TunerConfig:
     pp_candidates: list = field(default_factory=list)
     sharding_candidates: list = field(default_factory=list)
     micro_batch_candidates: list = field(default_factory=list)
+    # optimization dimensions (reference: static/tuner/
+    # optimization_tuner.py — trials toggle recompute/amp passes)
+    recompute_candidates: list = field(default_factory=lambda: [False])
+    amp_candidates: list = field(default_factory=lambda: ["O0"])
     max_mp: int = 8          # mp beyond one host rides DCN — prune
     hbm_headroom: float = 0.9
 
@@ -58,12 +62,15 @@ class AutoTuner:
         pps = self.cfg.pp_candidates or _divisors(n)
         shs = self.cfg.sharding_candidates or _divisors(n)
         mbs = self.cfg.micro_batch_candidates or [1, 2, 4, 8]
-        for dp, mp, pp, sh, mb in itertools.product(dps, mps, pps, shs,
-                                                    mbs):
+        rcs = self.cfg.recompute_candidates or [False]
+        amps = self.cfg.amp_candidates or ["O0"]
+        for dp, mp, pp, sh, mb, rc, amp in itertools.product(
+                dps, mps, pps, shs, mbs, rcs, amps):
             if dp * mp * pp * sh != n:
                 continue
             cand = {"dp": dp, "mp": mp, "pp": pp, "sharding": sh,
-                    "micro_batch": mb}
+                    "micro_batch": mb, "use_recompute": bool(rc),
+                    "amp": amp}
             if self.prune(cand):
                 continue
             yield cand
@@ -85,7 +92,11 @@ class AutoTuner:
             c.n_params, c.n_layers, c.hidden, c.global_batch, c.seq_len,
             dp=cand["dp"], mp=cand["mp"], pp=cand["pp"],
             sharding=cand["sharding"], device=c.device,
-            grad_accum=per_dp // cand["micro_batch"])
+            grad_accum=per_dp // cand["micro_batch"],
+            recompute=cand.get("use_recompute", False),
+            # amp O0 keeps fp32 activations/grads; O1/O2 run bf16 —
+            # the byte width the roofline's act/comm terms see
+            dtype_bytes=4 if cand.get("amp", "O0") == "O0" else 2)
         cand["_est"] = est
         hbm = DEVICE_SPECS[c.device].hbm_bytes * c.hbm_headroom
         return est.hbm_per_device > hbm

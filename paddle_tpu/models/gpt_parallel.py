@@ -188,7 +188,11 @@ class ParallelGPTModel(Layer):
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(_constrain_act(x, seq_axis="sep"))
         for block in self.h:
-            x = block(x)
+            if self.config.use_recompute and not x.stop_gradient:
+                from ..distributed.fleet.utils import recompute
+                x = recompute(block, x)
+            else:
+                x = block(x)
         return self.ln_f(x)
 
 

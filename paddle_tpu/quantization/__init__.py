@@ -294,14 +294,18 @@ class Int8DynamicLinear(Layer):
         super().__init__()
         w = np.asarray(linear.weight._data_)
         q, s = quantize_per_channel(w, axis=weight_quant_axis(w))
-        self._qw = jnp.asarray(q)
-        self._w_scale = jnp.asarray(s.reshape(-1), jnp.float32)
+        # buffers, not plain attrs: the int8 weight and its scale must
+        # survive state_dict round-trips like any other layer state
+        self.register_buffer("qweight", Tensor(jnp.asarray(q),
+                                               stop_gradient=True))
+        self.register_buffer("w_scale", Tensor(
+            jnp.asarray(s.reshape(-1), jnp.float32), stop_gradient=True))
         self.bias = linear.bias
         self.in_features = linear.in_features
         self.out_features = linear.out_features
 
     def forward(self, x):
-        qw, w_scale = self._qw, self._w_scale
+        qw, w_scale = self.qweight._data_, self.w_scale._data_
 
         def kernel(xa, *rest):
             out = int8_dynamic_matmul(xa, qw, w_scale)

@@ -78,3 +78,28 @@ def test_current_trial_config_roundtrip():
     finally:
         del os.environ["PADDLE_AUTO_TUNER_CONFIG"]
     assert current_trial_config({"dp": 1}) == {"dp": 1}
+
+
+def test_optimization_dimensions_in_search_space():
+    """Optimization-tuner analog (reference: static/tuner/
+    optimization_tuner.py — trials toggle recompute/amp): the search
+    space carries use_recompute/amp, and recompute shrinks the roofline
+    activation estimate so memory-infeasible points become feasible."""
+    tuner = AutoTuner(_small_cfg(
+        recompute_candidates=[False, True], amp_candidates=["O0", "O2"]))
+    cands = list(tuner.candidates())
+    assert {c["use_recompute"] for c in cands} == {False, True}
+    assert {c["amp"] for c in cands} == {"O0", "O2"}
+
+    from paddle_tpu.cost_model import transformer_step_cost
+    plain = transformer_step_cost(1.3e9, 24, 2048, 64, 1024)
+    rc = transformer_step_cost(1.3e9, 24, 2048, 64, 1024, recompute=True)
+    assert rc.hbm_per_device < plain.hbm_per_device   # fewer acts stored
+    assert rc.step_time_s >= plain.step_time_s        # extra forward
+
+    def trial(cand):   # favor the recompute+amp corner artificially
+        return 100.0 if cand["use_recompute"] and cand["amp"] == "O2" \
+            else 10.0
+
+    best = tuner.tune(trial_fn=trial, max_trials=100)
+    assert best["use_recompute"] and best["amp"] == "O2"

@@ -258,3 +258,28 @@ def test_quantize_dynamic_root_and_bad_types():
     with pytest.raises(ValueError, match="Linear subclasses only"):
         quantize_dynamic(nn.Sequential(nn.Conv2D(1, 2, 3)),
                          layer_types=(nn.Conv2D,))
+
+
+def test_quantize_dynamic_state_dict_round_trip():
+    """int8 weight + scale are buffers: state_dict carries them and a
+    reload reproduces identical outputs."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.quantization import quantize_dynamic
+
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(16, 8))
+    quantize_dynamic(net)
+    x = np.random.default_rng(1).standard_normal((2, 16)).astype("float32")
+    ref = net(paddle.to_tensor(x)).numpy()
+    state = net.state_dict()
+    assert any("qweight" in k for k in state)
+    assert any("w_scale" in k for k in state)
+
+    paddle.seed(99)                  # different init
+    net2 = nn.Sequential(nn.Linear(16, 8))
+    quantize_dynamic(net2)
+    net2.set_state_dict(state)
+    np.testing.assert_allclose(net2(paddle.to_tensor(x)).numpy(), ref,
+                               rtol=1e-6)
