@@ -82,8 +82,10 @@ def transformer_step_cost(n_params, n_layers, hidden, batch, seq,
     flops = 6.0 * n_params * tokens
     if recompute:
         flops *= 4.0 / 3.0
+    # fp32 (dtype_bytes=4) runs the MXU at ~half its bf16 rate
+    peak = spec.peak_flops_bf16 * (0.5 if dtype_bytes >= 4 else 1.0)
     n_dev = dp * mp * pp * sharding
-    t_compute = flops / (spec.peak_flops_bf16 * n_dev)
+    t_compute = flops / (peak * n_dev)
     # 1F1B pipeline bubble: with m micro-batches the schedule spans
     # (m + pp - 1) slots of which m do useful work per stage
     # (reference: auto_parallel/static/tuner/parallel_tuner.py pp cost)
@@ -109,7 +111,7 @@ def transformer_step_cost(n_params, n_layers, hidden, batch, seq,
 
     step = max(t_compute, t_dp + t_mp + t_pp) + 0.1 * min(t_compute,
                                                           t_dp + t_mp)
-    mfu = flops / (step * spec.peak_flops_bf16 * n_dev)
+    mfu = flops / (step * peak * n_dev)
     bound = "compute" if t_compute >= (t_dp + t_mp + t_pp) else "comm"
     return TransformerCost(step, mfu, hbm, bound)
 

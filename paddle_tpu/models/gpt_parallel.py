@@ -130,6 +130,7 @@ class ParallelGPTBlock(Layer):
                  moe_capacity=None):
         super().__init__()
         self.sequence_parallel = sequence_parallel
+        self.use_recompute = config.use_recompute
         self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
         self.attn = ParallelGPTAttention(config, use_ring_attention)
         self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
@@ -150,6 +151,15 @@ class ParallelGPTBlock(Layer):
         self.dropout = Dropout(config.dropout)
 
     def forward(self, x):
+        # recompute lives ON the block (not the caller) so every user —
+        # ParallelGPTModel's loop AND the pipeline's stage scan — gets
+        # activation checkpointing from config.use_recompute alone
+        if self.use_recompute and not x.stop_gradient:
+            from ..distributed.fleet.utils import recompute
+            return recompute(self._block_fwd, x)
+        return self._block_fwd(x)
+
+    def _block_fwd(self, x):
         x = x + self.dropout(self.attn(self.ln_1(x)))
         x = x + self.dropout(self.mlp(self.ln_2(x)))
         # between blocks: keep activations seq-sharded (Megatron-SP over mp
@@ -188,11 +198,7 @@ class ParallelGPTModel(Layer):
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(_constrain_act(x, seq_axis="sep"))
         for block in self.h:
-            if self.config.use_recompute and not x.stop_gradient:
-                from ..distributed.fleet.utils import recompute
-                x = recompute(block, x)
-            else:
-                x = block(x)
+            x = block(x)    # block self-recomputes per config
         return self.ln_f(x)
 
 

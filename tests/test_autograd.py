@@ -252,3 +252,119 @@ def test_saved_tensors_hooks_create_graph_refreshes_per_pass():
     (g2,) = paddle.grad([y], [x], retain_graph=True, create_graph=True)
     assert len(calls) == 2 * n1, "unpack must re-fire on every pass"
     assert not np.allclose(g1.numpy(), g2.numpy())
+
+
+# ---- round-4 tranche: numeric-grad coverage across op families most
+# at risk of wrapper bugs (reductions with axes, norms, pooling, conv,
+# losses, gathers, manipulation) — reference OpTest.check_grad breadth
+def _F():
+    import paddle_tpu.nn.functional as F_
+    return F_
+
+
+@pytest.mark.parametrize("op", [
+    lambda x: paddle.sum(paddle.prod(x * 0.1 + 1.0, axis=1)),
+    lambda x: paddle.sum(paddle.cumsum(x, axis=1) * 0.3),
+    lambda x: paddle.sum(paddle.max(x, axis=1)),
+    lambda x: paddle.sum(paddle.min(x, axis=0)),
+    lambda x: paddle.var(x) + paddle.std(x),
+    lambda x: paddle.sum(paddle.pow(x * x + 0.5, 1.5)),
+    lambda x: paddle.sum(paddle.rsqrt(x * x + 1.0)),
+    lambda x: paddle.sum(paddle.erf(x)),
+    lambda x: paddle.sum(paddle.atan2(x, x * x + 1.0)),
+    lambda x: paddle.sum(_F().softplus(x) + _F().silu(x)),
+    lambda x: paddle.sum(_F().mish(x)),
+    lambda x: paddle.sum(_F().elu(x, alpha=0.7)),
+    lambda x: paddle.sum(_F().hardswish(x)),
+    lambda x: paddle.sum(paddle.concat([x, x * 2.0], axis=0)[1:, :]),
+    lambda x: paddle.sum(paddle.stack([x, x * x], axis=0)[1]),
+    lambda x: paddle.sum(paddle.split(x, 2, axis=1)[1]),
+    lambda x: paddle.sum(paddle.where(x > 0, x * 2.0, x * 0.5)),
+    lambda x: paddle.sum(paddle.transpose(x, [1, 0]) @ x),
+    lambda x: paddle.sum(paddle.nn.functional.pad(
+        x.reshape([1, 1, 4, 4]), [1, 1, 1, 1]) ** 2),
+    lambda x: paddle.sum(paddle.einsum("ij,jk->ik", x, x)),
+    lambda x: paddle.sum(paddle.norm(x, p=2, axis=1)),
+    lambda x: paddle.sum(paddle.tril(x) + paddle.triu(x)),
+    lambda x: paddle.sum(paddle.flip(x, axis=[1]) * x),
+    lambda x: paddle.sum(paddle.roll(x, shifts=1, axis=1) * x),
+    lambda x: paddle.logsumexp(x, axis=1).sum(),
+])
+def test_numeric_grad_match_tranche2(op):
+    x_np = np.random.default_rng(7).standard_normal((4, 4)).astype(
+        np.float32)
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    loss = op(x)
+    loss.backward()
+    ag = np.asarray(x.grad._data_)
+    ng = numeric_grad(op, paddle.to_tensor(x_np))
+    np.testing.assert_allclose(ag, ng, rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("make", [
+    ("conv2d", lambda F_, x: F_.conv2d(
+        x.reshape([1, 1, 4, 4]),
+        paddle.to_tensor(np.ones((2, 1, 3, 3), np.float32) * 0.2),
+        padding=1).sum()),
+    ("avg_pool", lambda F_, x: F_.avg_pool2d(
+        x.reshape([1, 1, 4, 4]), kernel_size=2).sum()),
+    ("max_pool", lambda F_, x: F_.max_pool2d(
+        x.reshape([1, 1, 4, 4]), kernel_size=2).sum()),
+    ("layer_norm", lambda F_, x: F_.layer_norm(
+        x, normalized_shape=[4],
+        weight=paddle.to_tensor(np.ones(4, np.float32)),
+        bias=paddle.to_tensor(np.zeros(4, np.float32))).sum()),
+    ("log_softmax_nll", lambda F_, x: F_.nll_loss(
+        F_.log_softmax(x, axis=1),
+        paddle.to_tensor(np.array([0, 1, 2, 3], np.int64)))),
+    ("smooth_l1", lambda F_, x: F_.smooth_l1_loss(
+        x, paddle.to_tensor(np.zeros((4, 4), np.float32)))),
+    ("kl_div", lambda F_, x: F_.kl_div(
+        F_.log_softmax(x, axis=1),
+        paddle.to_tensor(np.full((4, 4), 0.25, np.float32)))),
+], ids=lambda m: m[0] if isinstance(m, tuple) else str(m))
+def test_numeric_grad_match_nn_ops(make):
+    import paddle_tpu.nn.functional as F_
+    _, fn = make
+    x_np = np.random.default_rng(11).standard_normal((4, 4)).astype(
+        np.float32)
+
+    def op(t):
+        return fn(F_, t)
+
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    loss = op(x)
+    loss.backward()
+    ag = np.asarray(x.grad._data_)
+    ng = numeric_grad(op, paddle.to_tensor(x_np))
+    np.testing.assert_allclose(ag, ng, rtol=3e-2, atol=3e-2)
+
+
+def test_amp_backward_through_conv_linear_chain():
+    """Regression (round-4 conv VJP crash): backward through a
+    conv→pool→linear→ce chain must work when forward ran under AMP O1
+    and backward runs OUTSIDE the autocast context, for both widened-
+    and same-dtype ops; grads stay close to the fp32 grads."""
+    import paddle_tpu.nn.functional as F_
+    from paddle_tpu import nn
+    paddle.seed(0)
+    conv = nn.Conv2D(1, 4, 3, padding=1)
+    lin = nn.Linear(4 * 2 * 2, 3)
+    x_np = np.random.default_rng(5).standard_normal(
+        (2, 1, 4, 4)).astype(np.float32)
+    y = paddle.to_tensor(np.array([0, 2], np.int64))
+
+    def run(amp):
+        for p in list(conv.parameters()) + list(lin.parameters()):
+            p.clear_grad()
+        with paddle.amp.auto_cast(enable=amp, level="O1",
+                                  dtype="bfloat16"):
+            h = F_.max_pool2d(F_.relu(conv(paddle.to_tensor(x_np))), 2)
+            loss = F_.cross_entropy(lin(h.flatten(1)), y)
+        loss.backward()     # outside autocast — the crash site
+        return np.asarray(conv.weight.grad._data_, np.float32)
+
+    g_amp = run(True)
+    g_f32 = run(False)
+    assert np.isfinite(g_amp).all()
+    np.testing.assert_allclose(g_amp, g_f32, rtol=0.2, atol=0.05)
