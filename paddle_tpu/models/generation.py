@@ -37,16 +37,45 @@ def _advance(caches, n):
         c["offset"] = off
 
 
-def _sample(logits_last, temperature, top_k):
-    """[B, V] → [B] next tokens."""
+def _seen_mask(ids, vocab):
+    """[B, S] ids → [B, V] bool mask of tokens that have appeared."""
+    from ..nn import functional as F
+    return F.one_hot(ids, num_classes=vocab).sum(axis=1) > 0
+
+
+def _sample(logits_last, temperature, top_k, top_p=None,
+            repetition_penalty=None, seen=None):
+    """[B, V] → [B] next tokens.  Logit processors apply in the HF
+    order: repetition penalty (also for greedy) → temperature → top-k
+    → top-p (nucleus) → sample.  `seen` is the fixed-shape [B, V]
+    already-emitted mask (so every decode step stays the same
+    static-shape program)."""
     from ..tensor_ops import random as R, search as S
     from ..nn import functional as F
+    if repetition_penalty is not None and repetition_penalty != 1.0 \
+            and seen is not None:
+        pos = logits_last > 0
+        penalized = S.where(pos, logits_last / repetition_penalty,
+                            logits_last * repetition_penalty)
+        logits_last = S.where(seen, penalized, logits_last)
     if temperature == 0.0:
         return S.argmax(logits_last, axis=-1)
     logits_last = logits_last / temperature
     if top_k is not None:
         vals, _ = S.topk(logits_last, top_k)
         minv = vals[:, -1:]
+        logits_last = MA.masked_fill(logits_last, logits_last < minv,
+                                     float("-inf"))
+    if top_p is not None and top_p < 1.0:
+        vocab = logits_last.shape[-1]
+        sorted_logits, _ = S.topk(logits_last, vocab)   # desc full sort
+        probs = F.softmax(sorted_logits, axis=-1)
+        cum = probs.cumsum(axis=-1)
+        # keep the smallest prefix whose mass reaches top_p (the first
+        # token always survives: its EXCLUSIVE prefix mass is 0)
+        keep = (cum - probs) < top_p
+        minv = MA.masked_fill(sorted_logits, ~keep,
+                              float("inf")).min(axis=-1, keepdim=True)
         logits_last = MA.masked_fill(logits_last, logits_last < minv,
                                      float("-inf"))
     probs = F.softmax(logits_last, axis=-1)
@@ -73,7 +102,8 @@ class _EosTracker:
 
 
 def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
-             top_k=None, use_cache=True, eos_token_id=None):
+             top_k=None, top_p=None, repetition_penalty=None,
+             use_cache=True, eos_token_id=None):
     """Autoregressive decoding.  Returns [B, S + n_generated] token ids.
 
     use_cache=True runs the masked-MHA KV-cache path (every step is one
@@ -81,6 +111,11 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
     forward per token (the O(S²)-per-step fallback, kept for parity
     checks).  With eos_token_id, decoding stops early once EVERY
     sequence in the batch has emitted it."""
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if repetition_penalty is not None and repetition_penalty <= 0.0:
+        raise ValueError(
+            f"repetition_penalty must be > 0, got {repetition_penalty}")
     cfg = model.config
     b, s = input_ids.shape
     max_len = min(cfg.max_seq_len, s + max_new_tokens)
@@ -92,9 +127,16 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
         if not use_cache:
             tracker = _EosTracker(b, eos_token_id)
             ids = input_ids
+            use_pen = repetition_penalty is not None and \
+                repetition_penalty != 1.0
+            seen = _seen_mask(ids, cfg.vocab_size) if use_pen else None
             for _ in range(n_new):
                 logits = model(ids)
-                nxt = _sample(logits[:, -1, :], temperature, top_k)
+                nxt = _sample(logits[:, -1, :], temperature, top_k,
+                              top_p, repetition_penalty, seen=seen)
+                if use_pen:
+                    seen = seen | _seen_mask(MA.reshape(nxt, [b, 1]),
+                                             cfg.vocab_size)
                 ids = MA.concat([ids, MA.reshape(nxt, [b, 1])], axis=1)
                 if tracker.update(nxt):
                     break
@@ -109,14 +151,23 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
         logits = model(input_ids, caches=caches)      # prefill
         _advance(caches, s)
         pieces = [input_ids]
-        nxt = _sample(logits[:, -1, :], temperature, top_k)
+        use_pen = repetition_penalty is not None and \
+            repetition_penalty != 1.0
+        # fixed-shape [B, V] mask updated per token: the decode step
+        # stays the same static program regardless of prefix length
+        seen = _seen_mask(input_ids, cfg.vocab_size) if use_pen else None
+        nxt = _sample(logits[:, -1, :], temperature, top_k, top_p,
+                      repetition_penalty, seen=seen)
         for _ in range(n_new - 1):
             tok = MA.reshape(nxt, [b, 1])
             pieces.append(tok)
             if tracker.update(nxt):
                 return MA.concat(pieces, axis=1)
+            if use_pen:
+                seen = seen | _seen_mask(tok, cfg.vocab_size)
             logits = model(tok, caches=caches)
             _advance(caches, 1)
-            nxt = _sample(logits[:, -1, :], temperature, top_k)
+            nxt = _sample(logits[:, -1, :], temperature, top_k, top_p,
+                          repetition_penalty, seen=seen)
         pieces.append(MA.reshape(nxt, [b, 1]))
         return MA.concat(pieces, axis=1)

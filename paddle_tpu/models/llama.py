@@ -199,11 +199,13 @@ class LlamaForCausalLM(Layer):
         return logits
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_k=None, use_cache=True, eos_token_id=None):
+                 top_k=None, top_p=None, repetition_penalty=None,
+                 use_cache=True, eos_token_id=None):
         """KV-cache incremental decoding (models/generation.py)."""
         from .generation import generate
         return generate(self, input_ids, max_new_tokens=max_new_tokens,
                         temperature=temperature, top_k=top_k,
+                        top_p=top_p, repetition_penalty=repetition_penalty,
                         use_cache=use_cache, eos_token_id=eos_token_id)
 
     def num_params(self):

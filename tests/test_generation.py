@@ -141,3 +141,94 @@ def test_cache_overflow_raises():
     with pytest.raises(ValueError, match="overflow"):
         IF.masked_multihead_attention(q, q, q, ck, cv,
                                       paddle.to_tensor(np.int32(2)))
+
+
+def test_top_p_tight_equals_greedy():
+    """top_p→0 keeps only the argmax token, so sampling at any
+    temperature reduces to greedy decoding."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.random.default_rng(0).integers(
+        0, 64, (2, 4)).astype("int32"))
+    greedy = m.generate(ids, max_new_tokens=6, temperature=0.0)
+    nucleus = m.generate(ids, max_new_tokens=6, temperature=0.8,
+                       top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(greedy._data_),
+                                  np.asarray(nucleus._data_))
+
+
+def test_repetition_penalty_breaks_loops():
+    """A strong repetition penalty must change greedy output whenever
+    unpenalized greedy repeats a token, and the penalized decode should
+    repeat less."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+    paddle.seed(1)
+    cfg = GPTConfig(vocab_size=32, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=40, use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.zeros((1, 2), np.int32))
+    plain = np.asarray(m.generate(ids, max_new_tokens=12,
+                                temperature=0.0)._data_)[0]
+    pen = np.asarray(m.generate(
+        ids, max_new_tokens=12, temperature=0.0,
+        repetition_penalty=1e6)._data_)[0]
+
+    def repeats(seq):
+        new = seq[2:]
+        return len(new) - len(set(new.tolist()))
+
+    # with an effectively-infinite penalty every generated token is new
+    # until the vocab is exhausted
+    assert repeats(pen) == 0
+    assert repeats(pen) <= repeats(plain)
+
+
+def test_cached_and_full_forward_agree_with_processors():
+    """use_cache True/False must produce identical ids under the same
+    processors (parity of the processor wiring in both loops)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+    paddle.seed(2)
+    cfg = GPTConfig(vocab_size=48, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=24, use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.random.default_rng(4).integers(
+        0, 48, (2, 3)).astype("int32"))
+    a = m.generate(ids, max_new_tokens=6, temperature=0.0,
+                 repetition_penalty=1.3, use_cache=True)
+    b = m.generate(ids, max_new_tokens=6, temperature=0.0,
+                 repetition_penalty=1.3, use_cache=False)
+    np.testing.assert_array_equal(np.asarray(a._data_),
+                                  np.asarray(b._data_))
+
+
+def test_generate_rejects_pathological_knobs():
+    import numpy as np
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=16, hidden_size=16, num_layers=1,
+                    num_heads=1, max_seq_len=8, use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.zeros((1, 2), np.int32))
+    with pytest.raises(ValueError, match="top_p"):
+        m.generate(ids, max_new_tokens=2, temperature=0.5, top_p=0.0)
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        m.generate(ids, max_new_tokens=2, repetition_penalty=0.0)
