@@ -171,3 +171,75 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
                           repetition_penalty, seen=seen)
         pieces.append(MA.reshape(nxt, [b, 1]))
         return MA.concat(pieces, axis=1)
+
+
+def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
+                eos_token_id=None, length_penalty=1.0):
+    """Beam-search decoding over the full-forward path (correctness
+    first; the sampling paths own the fixed-shape KV-cache fast lane).
+
+    Standard log-prob beams: expand each batch row to `num_beams`
+    hypotheses, score token extensions with cumulative log-probs, keep
+    the top beams per row each step, and return the best finished (or
+    longest) hypothesis per row, length-normalized by
+    `len**length_penalty`.  Returns [B, S + n] ids."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    from ..nn import functional as F
+
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    b, s = input_ids.shape
+    cfg = model.config
+    n_new = min(cfg.max_seq_len, s + max_new_tokens) - s
+    if n_new <= 0:
+        return input_ids
+    k = int(num_beams)
+
+    ids = np.asarray(input_ids._data_)
+    beams = np.repeat(ids, k, axis=0)                  # [B*K, S]
+    scores = np.full((b, k), -np.inf, np.float64)
+    scores[:, 0] = 0.0                                 # first beam only
+    done = np.zeros((b, k), bool)
+    lens = np.zeros((b, k), np.int64)   # per-hypothesis generated length
+
+    with no_grad():
+        for _ in range(n_new):
+            logits = model(Tensor(beams))
+            logp = np.asarray(F.log_softmax(
+                logits[:, -1, :], axis=-1)._data_, np.float64)
+            vocab = logp.shape[-1]
+            logp = logp.reshape(b, k, vocab)
+            # finished beams only extend with a frozen score
+            cand = scores[:, :, None] + np.where(done[:, :, None],
+                                                 -np.inf, logp)
+            if eos_token_id is not None:
+                # a finished beam keeps exactly one continuation (pad
+                # with eos at frozen score) so it stays selectable
+                cand[:, :, eos_token_id] = np.where(
+                    done, scores, cand[:, :, eos_token_id])
+            flat = cand.reshape(b, k * vocab)
+            top = np.argsort(-flat, axis=1)[:, :k]     # [B, K]
+            new_scores = np.take_along_axis(flat, top, axis=1)
+            src_beam = top // vocab
+            tok = (top % vocab).astype(beams.dtype)
+
+            picked = beams.reshape(b, k, -1)[np.arange(b)[:, None],
+                                             src_beam]
+            beams = np.concatenate([picked, tok[:, :, None]],
+                                   axis=2).reshape(b * k, -1)
+            done = np.take_along_axis(done, src_beam, axis=1)
+            lens = np.take_along_axis(lens, src_beam, axis=1)
+            lens = lens + (~done)       # finished beams stop growing
+            if eos_token_id is not None:
+                done = done | (tok == eos_token_id)
+            scores = new_scores
+            if done.all():
+                break
+
+    # pick the best beam per row, normalized by each HYPOTHESIS's own
+    # generated length (early-finished beams are shorter)
+    norm = scores / np.maximum(lens, 1) ** length_penalty
+    best = norm.argmax(axis=1)
+    out = beams.reshape(b, k, -1)[np.arange(b), best]
+    return Tensor(out)
