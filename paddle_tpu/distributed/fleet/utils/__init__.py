@@ -10,6 +10,11 @@ training (SURVEY §7: jax.checkpoint for rematerialisation).
 """
 from __future__ import annotations
 
+from .fs import (LocalFS, HDFSClient, DistributedInfer,  # noqa: F401
+                 ExecuteError, FSFileExistsError, FSFileNotExistsError)
+
+__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient"]
+
 import jax
 
 from ....core import state as _state

@@ -1,0 +1,180 @@
+"""Filesystem clients (reference capability:
+python/paddle/distributed/fleet/utils/fs.py — LocalFS and HDFSClient
+with a common ls_dir/is_file/mkdirs/delete/... surface used by fleet
+checkpoint/dataset tooling).
+
+LocalFS is fully native (os/shutil).  HDFSClient requires a hadoop
+client binary which is not in this image, so it is a gated stub whose
+constructor works (so configs can be built) but whose operations raise
+with a pointer to LocalFS — checkpoint/dataset flows here use local or
+mounted paths (the TPU-native storage story is GCS-style mounts, not
+HDFS).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class LocalFS:
+    """reference: fleet/utils/fs.py LocalFS."""
+
+    def ls_dir(self, fs_path):
+        """Returns (dirs, files) directly under fs_path."""
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if self.is_exist(dst_path):
+            if not overwrite:
+                raise FSFileExistsError(dst_path)
+            self.delete(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        with open(fs_path, "a"):
+            pass
+
+    def upload(self, local_path, fs_path):
+        """Local "upload" is a copy (reference parity)."""
+        self._copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._copy(fs_path, local_path)
+
+    @staticmethod
+    def _copy(src, dst):
+        if os.path.isdir(src):
+            shutil.copytree(src, dst)
+        else:
+            shutil.copy(src, dst)
+
+    def need_upload_download(self):
+        return False
+
+
+class HDFSClient:
+    """reference: fleet/utils/fs.py HDFSClient (shells out to a hadoop
+    client).  No hadoop binary exists in this image — construction
+    succeeds so configs remain portable, every operation raises."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60,
+                 sleep_inter=1000):
+        self._hadoop_home = hadoop_home
+        self._configs = configs or {}
+
+    def _unavailable(self, op):
+        raise ExecuteError(
+            f"HDFSClient.{op}: no hadoop client in this environment — "
+            "use LocalFS (or a mounted path) for fleet checkpoint/"
+            "dataset IO on TPU")
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def _op(*a, **k):
+            self._unavailable(name)
+
+        return _op
+
+    def need_upload_download(self):
+        return True
+
+
+class DistributedInfer:
+    """PS-mode inference helper (reference capability:
+    fleet/utils/ps_util.py DistributedInfer — swap the training
+    program's distributed lookup tables for local pulls so a trained
+    PS model can infer on one worker).
+
+    TPU-native realization: sparse rows live on PS servers
+    (`paddle_tpu.distributed.ps`); `get_dist_infer_program()` returns
+    the program unchanged (dense compute is already local) and
+    `init_distributed_infer_env` pulls the referenced sparse tables
+    into a local cache via the PS client so PSEmbedding lookups resolve
+    without live servers."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        from ....static import default_main_program
+        self.origin_main_program = (main_program
+                                    or default_main_program())
+        self._local_rows = {}
+
+    def get_dist_infer_program(self):
+        return self.origin_main_program
+
+    def init_distributed_infer_env(self, exe=None, loss=None,
+                                   role_maker=None, dirname=None,
+                                   client=None, table_ids=()):
+        """Pull every row of the given PS tables into a local cache —
+        from a live client, or from `dirname`, a pickle of
+        `PSClient.save()`'s state (write it with
+        `pickle.dump(client.save(), open(path, "wb"))`)."""
+        if client is not None:
+            state = client.save()          # ONE transfer covers all tables
+        elif dirname is not None:
+            import pickle
+            with open(dirname, "rb") as f:
+                state = pickle.load(f)
+        else:
+            raise ValueError(
+                "init_distributed_infer_env needs client= (live pull) "
+                "or dirname= (pickled PSClient.save() state)")
+        states = state if isinstance(state, list) else [state]
+        for tid in table_ids:
+            rows = {}
+            for shard in states:
+                rows.update(shard.get(tid, {}))
+            self._local_rows[tid] = rows
+        return self._local_rows
+
+    def local_rows(self, table_id):
+        return self._local_rows.get(table_id, {})

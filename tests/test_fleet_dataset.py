@@ -124,3 +124,61 @@ def test_logger_rank_stamped(capsys):
         log_every_n("info", "repeated message", n=100)
     err = capsys.readouterr().err
     assert err.count("repeated message") == 1
+
+
+def test_fleet_utils_fs(tmp_path):
+    """LocalFS surface (reference: fleet/utils/fs.py) + gated HDFS."""
+    import pytest
+    from paddle_tpu.distributed.fleet.utils import (
+        LocalFS, HDFSClient, ExecuteError, FSFileExistsError)
+    fs = LocalFS()
+    d = tmp_path / "a"
+    fs.mkdirs(str(d))
+    fs.touch(str(d / "x.txt"))
+    (d / "sub").mkdir()
+    dirs, files = fs.ls_dir(str(d))
+    assert dirs == ["sub"] and files == ["x.txt"]
+    assert fs.is_file(str(d / "x.txt")) and fs.is_dir(str(d / "sub"))
+    fs.mv(str(d / "x.txt"), str(d / "y.txt"))
+    assert fs.is_exist(str(d / "y.txt"))
+    with pytest.raises(FSFileExistsError):
+        fs.touch(str(d / "y.txt"), exist_ok=False)
+    fs.upload(str(d / "y.txt"), str(tmp_path / "copy.txt"))
+    assert fs.is_file(str(tmp_path / "copy.txt"))
+    fs.delete(str(d))
+    assert not fs.is_exist(str(d))
+    assert not fs.need_upload_download()
+
+    h = HDFSClient()          # constructible, ops gated
+    with pytest.raises(ExecuteError, match="no hadoop"):
+        h.ls_dir("/tmp")
+
+
+def test_distributed_infer_pulls_ps_tables():
+    import numpy as np
+    from paddle_tpu.distributed.ps import TheOnePSRuntime, PSClient
+    from paddle_tpu.distributed.fleet.utils import DistributedInfer
+    cfg = {"tables": {0: {"type": "sparse", "dim": 3, "lr": 1.0}}}
+    rt = TheOnePSRuntime("server", cfg)
+    rt.init_server()
+    client = PSClient(rt.server_address)
+    try:
+        rows = client.pull_sparse(0, [4, 9])
+        di = DistributedInfer()
+        di.init_distributed_infer_env(client=client, table_ids=[0])
+        local = di.local_rows(0)
+        np.testing.assert_allclose(local[4], rows[0])
+        np.testing.assert_allclose(local[9], rows[1])
+        # dirname path: pickled save-state loads without live servers
+        import pickle, tempfile, os
+        with tempfile.NamedTemporaryFile(suffix=".pkl",
+                                         delete=False) as f:
+            pickle.dump(client.save(), f)
+        di2 = DistributedInfer()
+        di2.init_distributed_infer_env(dirname=f.name, table_ids=[0])
+        np.testing.assert_allclose(di2.local_rows(0)[4], rows[0])
+        os.unlink(f.name)
+    finally:
+        client.stop_server()
+        client.close()
+        rt.stop()

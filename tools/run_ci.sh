@@ -7,6 +7,8 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export PALLAS_AXON_POOL_IPS=
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+# `python tools/foo.py` puts tools/ (not the repo root) on sys.path[0]
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
 echo "== byte-compile check =="
 python -m compileall -q paddle_tpu
