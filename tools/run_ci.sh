@@ -19,6 +19,9 @@ python tools/check_api_compatible.py
 echo "== unit tests (full, incl. slow) =="
 PADDLE_TPU_RUN_SLOW=1 python -m pytest tests/ -q
 
+echo "== TPU run-log audit =="
+python tools/validate_tpu_runs.py
+
 echo "== driver hooks compile =="
 python - <<'EOF'
 import jax
