@@ -1,0 +1,111 @@
+"""Property-based op tests (hypothesis): random shapes/values against
+numpy semantics — the breadth dimension of the reference's 1310-file
+OpTest suite (test/legacy_test/op_test.py check_output), compressed
+into generative properties.
+
+Kept CPU-cheap: scalar-free shapes ≤4 dims × ≤6 extent, float32,
+bounded magnitudes (|x| ≤ 1e3) so numpy and XLA agree within float32
+tolerance without special-casing overflow.
+"""
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+import paddle_tpu as paddle
+
+# derandomize: CI must be reproducible — the same examples every run
+_SETTINGS = dict(max_examples=25, deadline=None, derandomize=True)
+
+
+def _shapes_broadcastable():
+    """(shape_a, shape_b) that numpy-broadcast together."""
+    base = st.lists(st.integers(1, 6), min_size=1, max_size=4)
+
+    def mk(dims):
+        def drop(d):
+            return st.sampled_from([d, 1])
+        return st.tuples(
+            st.tuples(*[drop(d) for d in dims]),
+            st.tuples(*[drop(d) for d in dims]))
+    return base.flatmap(mk)
+
+
+def _array(shape, seed):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape) * 3).astype(np.float32)
+
+
+_BINOPS = {
+    "add": (np.add, lambda a, b: a + b),
+    "sub": (np.subtract, lambda a, b: a - b),
+    "mul": (np.multiply, lambda a, b: a * b),
+    "max": (np.maximum, lambda a, b: paddle.maximum(a, b)),
+    "min": (np.minimum, lambda a, b: paddle.minimum(a, b)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_BINOPS))
+@given(shapes=_shapes_broadcastable(), seed=st.integers(0, 2**16))
+@settings(**_SETTINGS)
+def test_binary_broadcast_matches_numpy(name, shapes, seed):
+    np_fn, pd_fn = _BINOPS[name]
+    a = _array(shapes[0], seed)
+    b = _array(shapes[1], seed + 1)
+    ref = np_fn(a, b)
+    out = pd_fn(paddle.to_tensor(a), paddle.to_tensor(b))
+    assert tuple(out.shape) == ref.shape
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+@given(shape=st.lists(st.integers(1, 6), min_size=1, max_size=4),
+       seed=st.integers(0, 2**16), keepdim=st.booleans(),
+       data=st.data())
+@settings(**_SETTINGS)
+def test_reductions_match_numpy(shape, seed, keepdim, data):
+    a = _array(tuple(shape), seed)
+    axis = data.draw(st.one_of(
+        st.none(), st.integers(-len(shape), len(shape) - 1)))
+    t = paddle.to_tensor(a)
+    for pd_red, np_red in ((paddle.sum, np.sum), (paddle.mean, np.mean),
+                           (paddle.max, np.max), (paddle.min, np.min)):
+        out = pd_red(t, axis=axis, keepdim=keepdim)
+        ref = np_red(a, axis=axis, keepdims=keepdim)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+
+@given(shape=st.lists(st.integers(1, 5), min_size=2, max_size=4),
+       seed=st.integers(0, 2**16), data=st.data())
+@settings(**_SETTINGS)
+def test_manipulation_round_trips(shape, seed, data):
+    a = _array(tuple(shape), seed)
+    t = paddle.to_tensor(a)
+    # transpose twice with a random permutation is identity
+    perm = data.draw(st.permutations(range(len(shape))))
+    inv = np.argsort(perm).tolist()
+    back = paddle.transpose(paddle.transpose(t, list(perm)), inv)
+    np.testing.assert_array_equal(back.numpy(), a)
+    # reshape to flat and back is identity
+    flat = paddle.reshape(t, [-1])
+    np.testing.assert_array_equal(
+        paddle.reshape(flat, list(shape)).numpy(), a)
+    # split along a random axis then concat restores
+    axis = data.draw(st.integers(0, len(shape) - 1))
+    parts = paddle.split(t, shape[axis], axis=axis)
+    np.testing.assert_array_equal(
+        paddle.concat(parts, axis=axis).numpy(), a)
+
+
+@given(shape=st.lists(st.integers(1, 6), min_size=1, max_size=3),
+       seed=st.integers(0, 2**16))
+@settings(**_SETTINGS)
+def test_elementwise_grads_sum_rule(shape, seed):
+    """d/dx sum(f(x)) computed by the tape equals f'(x) elementwise for
+    a composite with known derivative — a generative autograd check."""
+    a = _array(tuple(shape), seed) * 0.3
+    x = paddle.to_tensor(a, stop_gradient=False)
+    y = (paddle.tanh(x) * x).sum()
+    y.backward()
+    expect = np.tanh(a) + a * (1 - np.tanh(a) ** 2)
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), expect,
+                               rtol=1e-4, atol=1e-5)
