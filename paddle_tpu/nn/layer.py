@@ -194,6 +194,12 @@ class Layer:
         for k, v in state_dict.items():
             if k in own:
                 arr = v._data if isinstance(v, Tensor) else np.asarray(v)
+                if hasattr(arr, "copy") and not isinstance(arr, np.ndarray):
+                    # defensive copy at the RESTORE boundary: params may
+                    # feed a buffer-donating compiled step, which would
+                    # delete the caller's loaded arrays out from under
+                    # them ("Array has been deleted" on dict reuse)
+                    arr = arr.copy()
                 own[k].set_value(arr)
             else:
                 unexpected.append(k)

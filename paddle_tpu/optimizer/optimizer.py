@@ -216,7 +216,14 @@ class Optimizer:
                 key = f"{name}.{i}"
                 if key in state:
                     v = state[key]
-                    vals[i] = v if isinstance(v, Tensor) else Tensor(v)
+                    arr = v._data_ if isinstance(v, Tensor) else v
+                    # copy on adoption: donating compiled steps rewrite
+                    # accumulators in place — the caller's checkpoint
+                    # dict must stay restorable (same contract as
+                    # Layer.set_state_dict)
+                    if hasattr(arr, "copy"):
+                        arr = arr.copy()
+                    vals[i] = Tensor(arr)
         if "LR_Scheduler" in state and isinstance(self._learning_rate,
                                                   LRScheduler):
             self._learning_rate.set_state_dict(state["LR_Scheduler"])

@@ -83,6 +83,7 @@ _SLOW_TESTS = {
     "test_generation.py::test_beam_search_length_penalty_and_validation",
     "test_generation.py::test_cached_and_full_forward_agree_with_processors",
     "test_generation.py::test_top_p_tight_equals_greedy",          # 14
+    "test_subpackage_parity.py::test_model_zoo_families_forward[squeezenet1_0]",  # 13; alexnet stays as the fast zoo representative
 }
 
 

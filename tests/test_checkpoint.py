@@ -104,3 +104,40 @@ def test_non_tensor_leaves_restored(tmp_path):
     assert fresh["lr"] == 0.125
     assert fresh["flag"] is True
     np.testing.assert_allclose(fresh["model"]["w"].numpy(), 1.0)
+
+
+def test_loaded_state_survives_donating_compiled_step(tmp_path):
+    """Regression: set_state_dict(loaded) must COPY — a later
+    buffer-donating compiled step used to delete the caller's loaded
+    arrays out from under them ('Array has been deleted')."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    net = nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 8), np.float32))
+
+    @paddle.jit.to_static
+    def step(x, y):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(3):          # ensures the donating variant is live
+        step(x, y)
+    path = str(tmp_path / "m.pdparams")
+    paddle.save(net.state_dict(), path)
+    loaded = paddle.load(path)
+    net.set_state_dict(loaded)
+    for _ in range(3):          # donation happens against the new data
+        step(x, y)
+    # the caller's dict must still be alive and usable
+    net2 = nn.Linear(8, 8)
+    net2.set_state_dict(loaded)
+    out = net2(x)
+    assert np.isfinite(np.asarray(out._data_)).all()
