@@ -183,31 +183,85 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
 
 
 def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
-    """Layer-by-layer parameter summary (reference: paddle.summary →
-    hapi/model_summary.py)."""
+    """Layer-by-layer summary (reference: paddle.summary →
+    hapi/model_summary.py).  With `input_size` (or `input`) a dummy
+    forward runs under no_grad with per-layer post-hooks, so each row
+    also carries the layer's OUTPUT SHAPE — the reference table."""
     import builtins
     import numpy as _np
+
+    out_shapes = {}
+    if input_size is not None or input is not None:
+        from .core import state as _state
+        if input is not None:
+            inputs = input if isinstance(input, (list, tuple)) \
+                else [input]
+        else:
+            multi = not isinstance(input_size[0], int)
+            shapes = list(input_size) if multi else [input_size]
+            if isinstance(dtypes, str) or dtypes is None:
+                dts = [dtypes or "float32"] * len(shapes)
+            else:
+                dts = list(dtypes)
+            # -1/None dims mean "dynamic" (reference convention):
+            # substitute 1 for the dummy forward
+            inputs = [to_tensor(_np.zeros(
+                tuple(1 if (d is None or d < 0) else d for d in shp),
+                dt)) for shp, dt in zip(shapes, dts)]
+        handles = []
+        for name, layer in net.named_sublayers(include_self=True):
+            name = name or "(root)"
+            def mk(nm):
+                def hook(lyr, inp, out):
+                    o = out[0] if isinstance(out, (tuple, list)) else out
+                    if hasattr(o, "shape"):
+                        out_shapes[nm] = list(o.shape)
+                return hook
+            handles.append(layer.register_forward_post_hook(mk(name)))
+        # eval() for the dummy forward: training-mode side effects
+        # (batch-norm running stats, dropout) must not leak from a
+        # summary call; restore each layer's ORIGINAL mode after
+        was_training = [(l, l.training) for _, l in
+                        net.named_sublayers(include_self=True)]
+        net.eval()
+        try:
+            with _state.no_grad():
+                net(*inputs)
+        finally:
+            for h in handles:
+                h.remove()
+            for l, t in was_training:
+                l.training = t
 
     rows = []
     own = builtins.sum(int(_np.prod(p.shape)) for p in
                        net.parameters(include_sublayers=False))
     if own:
-        rows.append(("(root)", type(net).__name__, own))
+        rows.append(("(root)", type(net).__name__, None, own))
+    if rows and rows[0][0] == "(root)":
+        rows[0] = ("(root)", type(net).__name__,
+                   out_shapes.get("(root)"), own)
     for name, layer in net.named_sublayers():
         n = builtins.sum(int(_np.prod(p.shape)) for p in
                          layer.parameters(include_sublayers=False))
-        if n == 0:
+        if n == 0 and name not in out_shapes:
             continue
-        rows.append((name, type(layer).__name__, n))
+        rows.append((name, type(layer).__name__,
+                     out_shapes.get(name), n))
     # totals from the full parameter set — rows are a breakdown, not the
     # source of truth (sublayer iteration can miss root-owned params)
     total = builtins.sum(int(_np.prod(p.shape)) for p in net.parameters())
     trainable = builtins.sum(
         int(_np.prod(p.shape)) for p in net.parameters()
         if not p.stop_gradient)
-    header = f"{'Layer':34s}{'Type':22s}{'Params':>14s}"
+    with_shapes = len(out_shapes) > 0
+    header = (f"{'Layer':30s}{'Type':18s}"
+              + (f"{'Output Shape':22s}" if with_shapes else "")
+              + f"{'Params':>12s}")
     lines = [header, "-" * len(header)]
-    lines += [f"{n[:33]:34s}{t[:21]:22s}{c:>14,}" for n, t, c in rows]
+    for n, t, shp, c in rows:
+        shape_col = (f"{str(shp or ''):22s}" if with_shapes else "")
+        lines.append(f"{n[:29]:30s}{t[:17]:18s}{shape_col}{c:>12,}")
     lines += ["-" * len(header),
               f"Total params: {total:,}",
               f"Trainable params: {trainable:,}",

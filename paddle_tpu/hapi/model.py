@@ -291,15 +291,9 @@ class Model:
         return self.network.parameters(*args, **kwargs)
 
     def summary(self, input_size=None, dtype=None):
-        n_params = sum(int(np.prod(p.shape))
-                       for p in self.network.parameters())
-        lines = [f"{type(self.network).__name__}: "
-                 f"{n_params:,} parameters"]
-        for name, sub in self.network.named_sublayers():
-            sub_n = sum(int(np.prod(p.shape)) for p in sub.parameters(
-                include_sublayers=False))
-            if sub_n:
-                lines.append(f"  {name} ({type(sub).__name__}): {sub_n:,}")
-        text = "\n".join(lines)
-        print(text)
-        return {"total_params": n_params}
+        """reference: Model.summary → hapi/model_summary.py; delegates
+        to paddle.summary (per-layer table, output shapes when
+        input_size is given)."""
+        from .. import summary as _summary
+        return _summary(self.network, input_size=input_size,
+                        dtypes=dtype)

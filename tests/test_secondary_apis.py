@@ -118,3 +118,20 @@ def test_stft_istft_roundtrip():
                         window=paddle.to_tensor(win), length=512)
     np.testing.assert_allclose(np.asarray(back._data_), x, rtol=1e-3,
                                atol=1e-3)
+
+
+def test_summary_with_output_shapes():
+    """paddle.summary(input_size=...) runs a hooked dummy forward and
+    reports per-layer output shapes (reference: hapi/model_summary.py)."""
+    import io
+    from contextlib import redirect_stdout
+    from paddle_tpu.vision.models import LeNet
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        info = paddle.summary(LeNet(num_classes=10),
+                              input_size=(1, 1, 28, 28))
+    text = buf.getvalue()
+    assert info["total_params"] == 61610
+    assert "Output Shape" in text
+    assert "[1, 6, 28, 28]" in text       # first conv activation
+    assert "[1, 10]" in text              # head output
