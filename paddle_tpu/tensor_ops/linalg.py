@@ -164,8 +164,10 @@ def qr(x, mode="reduced", name=None):
 
 @defop("svd")
 def svd(x, full_matrices=False, name=None):
-    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
-    return u, s, jnp.swapaxes(vh, -1, -2)
+    """reference: paddle.linalg.svd returns (U, S, VH) where VH is the
+    conjugate transpose of V (tensor/linalg.py svd docstring) — same
+    contract as numpy; x == u @ diag(s) @ vh."""
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
 
 
 @defop("eig", nondiff=True)

@@ -199,3 +199,18 @@ def test_summary_and_flops():
     assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
     fl = paddle.flops(net, (4, 8))
     assert fl >= 2 * 4 * 8 * 16  # at least the first matmul
+
+
+def test_svd_returns_vh_reference_contract():
+    """paddle.linalg.svd returns (U, S, VH) with x == U @ diag(S) @ VH
+    (reference tensor/linalg.py: 'VH is the conjugate transpose of V');
+    a previous implementation returned V and broke reconstruction."""
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    u, s, vh = paddle.linalg.svd(paddle.to_tensor(a),
+                                 full_matrices=False)
+    assert tuple(u.shape) == (3, 3) and tuple(vh.shape) == (3, 4)
+    np.testing.assert_allclose(
+        u.numpy() @ np.diag(s.numpy()) @ vh.numpy(), a, atol=1e-4)
+    nu, ns, nvh = np.linalg.svd(a, full_matrices=False)
+    np.testing.assert_allclose(np.abs(s.numpy()), np.abs(ns), rtol=1e-5,
+                               atol=1e-5)  # rank-2: s[2] is numeric 0
