@@ -114,29 +114,52 @@ class Uniform(Distribution):
 
 
 class Categorical(Distribution):
+    """reference: distribution/categorical.py — `logits` are NONNEGATIVE
+    category weights: sample/probs/log_prob normalize by the SUM
+    (`_prob = logits / logits.sum(-1)`, categorical.py:122), while
+    entropy/kl use softmax(logits) (categorical.py:226,266) — the
+    reference's exact (asymmetric) contract, replicated."""
+
     def __init__(self, logits=None, probs=None, name=None):
         if logits is None and probs is None:
             raise ValueError("need logits or probs")
         if logits is None:
-            logits = jnp.log(jnp.clip(_arr(probs), 1e-30, None))
+            logits = _arr(probs)
         self.logits = _arr(logits).astype(jnp.float32)
+        total = jnp.sum(self.logits, axis=-1, keepdims=True)
+        # weights contract: nonnegative, positive sum — a zero/negative
+        # input would silently propagate NaN through every method
+        import numpy as _np
+        if isinstance(self.logits, jax.core.Tracer):
+            tv = None   # under jit: validation needs concrete values
+        else:
+            tv = _np.asarray(total)
+        if tv is not None and (_np.any(tv <= 0) or bool(_np.any(
+                _np.asarray(self.logits) < 0))):
+            raise ValueError(
+                "Categorical expects nonnegative weights with a "
+                "positive sum per distribution (reference semantics: "
+                "probs = logits / logits.sum()); got sum(s) "
+                f"{tv.ravel()[:4].tolist()}")
+        self._prob = self.logits / total
         super().__init__(self.logits.shape[:-1])
-
-    @property
-    def probs(self):
-        return Tensor(jax.nn.softmax(self.logits, axis=-1))
 
     def sample(self, shape=()):
         key = _state.next_rng_key()
         return Tensor(jax.random.categorical(
-            key, self.logits, shape=tuple(shape) + self._batch_shape))
+            key, jnp.log(jnp.clip(self._prob, 1e-30, None)),
+            shape=tuple(shape) + self._batch_shape))
+
+    def probs(self, value):
+        """Probability of the given category index (reference:
+        categorical.py probs(value) — a METHOD, weight-normalized)."""
+        v = _arr(value).astype(jnp.int32)
+        p = jnp.broadcast_to(self._prob, v.shape + self._prob.shape[-1:])
+        return Tensor(jnp.take_along_axis(p, v[..., None], axis=-1)[..., 0])
 
     def log_prob(self, value):
-        v = _arr(value).astype(jnp.int32)
-        logp = jax.nn.log_softmax(self.logits, axis=-1)
-        logp = jnp.broadcast_to(logp, v.shape + logp.shape[-1:])
-        return Tensor(jnp.take_along_axis(
-            logp, v[..., None], axis=-1)[..., 0])
+        return Tensor(jnp.log(jnp.clip(self.probs(value)._data_,
+                                       1e-30, None)))
 
     def entropy(self):
         logp = jax.nn.log_softmax(self.logits, axis=-1)

@@ -15,7 +15,7 @@ ALL_FAMILIES = [
     lambda: D.Normal(0., 1.),
     lambda: D.Uniform(0., 1.),
     lambda: D.Bernoulli(0.3),
-    lambda: D.Categorical(logits=np.zeros(4, np.float32)),
+    lambda: D.Categorical(logits=np.ones(4, np.float32)),
     lambda: D.Beta(2., 3.),
     lambda: D.Exponential(1.5),
     lambda: D.Gamma(2., 3.),
@@ -215,3 +215,11 @@ def test_kl_cauchy_lognormal_expfamily():
     expect = np.log(1.5) + (1.0 + 0.25) / (2 * 2.25) - 0.5
     np.testing.assert_allclose(float(np.asarray(kl._data_)), expect,
                                rtol=1e-5)
+
+
+def test_categorical_rejects_degenerate_weights():
+    import pytest
+    with pytest.raises(ValueError, match="nonnegative weights"):
+        D.Categorical(logits=np.zeros(4, np.float32))
+    with pytest.raises(ValueError, match="nonnegative weights"):
+        D.Categorical(logits=np.array([0.5, -0.1], np.float32))

@@ -56,15 +56,20 @@ def test_normal_sample_logprob_kl():
 
 def test_categorical_and_bernoulli():
     paddle.seed(0)
-    c = distribution.Categorical(logits=np.log([[0.2, 0.8]]))
+    # reference semantics: logits are nonnegative WEIGHTS, normalized
+    # by their sum (categorical.py:122), not softmaxed
+    c = distribution.Categorical(logits=np.array([[1.0, 4.0]], np.float32))
     s = c.sample([2000])
     frac = (s.numpy() == 1).mean()
     assert 0.74 < frac < 0.86
     lp = c.log_prob(paddle.to_tensor([1]))
     np.testing.assert_allclose(float(lp), np.log(0.8), rtol=1e-5)
-    ent = c.entropy()
-    ref = -(0.2 * np.log(0.2) + 0.8 * np.log(0.8))
-    np.testing.assert_allclose(float(ent), ref, rtol=1e-5)
+    np.testing.assert_allclose(float(c.probs(paddle.to_tensor([0]))),
+                               0.2, rtol=1e-5)
+    ent = c.entropy()   # entropy stays softmax-based (reference :266)
+    p0 = np.exp(1.0) / (np.exp(1.0) + np.exp(4.0))
+    ref = -(p0 * np.log(p0) + (1 - p0) * np.log(1 - p0))
+    np.testing.assert_allclose(float(ent), ref, rtol=1e-4)
 
     b = distribution.Bernoulli(0.3)
     np.testing.assert_allclose(float(b.log_prob(paddle.to_tensor(1.0))),
