@@ -208,6 +208,19 @@ class Tensor:
         self.set_value(other)
         return self
 
+    def to_sparse_coo(self, sparse_dim):
+        """Dense → COO (reference: tensor_patch_methods.py:940 — the
+        leading `sparse_dim` dims become sparse indices, trailing dims
+        stay dense)."""
+        from jax.experimental import sparse as jsparse
+        from ..sparse import SparseCooTensor
+        nd = len(self._data_.shape)
+        if not 0 < sparse_dim <= nd:
+            raise ValueError(f"sparse_dim must be in [1, {nd}], got "
+                             f"{sparse_dim}")
+        return SparseCooTensor(jsparse.BCOO.fromdense(
+            self._data_, n_dense=nd - sparse_dim))
+
     # ---------------- device / dtype movement ----------------
     def astype(self, dtype):
         from ..tensor_ops import manipulation

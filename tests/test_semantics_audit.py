@@ -101,3 +101,22 @@ def test_stats_and_search():
     np.testing.assert_array_equal(
         np.asarray(h.numpy()),
         np.histogram([1, 2, 1, 4], bins=4, range=(0, 4))[0])
+
+
+def test_to_sparse_coo_round_trip():
+    """reference: Tensor.to_sparse_coo (tensor_patch_methods.py:940) —
+    leading sparse dims, trailing dense dims preserved."""
+    import paddle_tpu.sparse as S
+    dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+    sp = paddle.to_tensor(dense).to_sparse_coo(2)
+    np.testing.assert_array_equal(np.asarray(sp.to_dense().numpy()),
+                                  dense)
+    y = S.matmul(sp, paddle.to_tensor(np.eye(3, dtype=np.float32)))
+    arr = np.asarray(y.to_dense().numpy()
+                     if hasattr(y, "to_dense") else y.numpy())
+    np.testing.assert_array_equal(arr, dense)
+    x3 = np.zeros((2, 2, 2), np.float32)
+    x3[1] = 7.0
+    sp2 = paddle.to_tensor(x3).to_sparse_coo(1)
+    np.testing.assert_array_equal(np.asarray(sp2.to_dense().numpy()),
+                                  x3)
