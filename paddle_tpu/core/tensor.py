@@ -134,6 +134,18 @@ class Tensor:
     def __int__(self):
         return int(self.item())
 
+    def __index__(self):
+        # 0-d integer tensors are valid python indices (list/range/slice),
+        # matching the reference Tensor's scalar conversion contract; under
+        # to_static tracing this is a host read, so a compiled region using
+        # a traced int as a container index graph-breaks to eager instead
+        # of crashing
+        if self.ndim != 0 or not np.issubdtype(
+                np.dtype(self._data.dtype), np.integer):
+            raise TypeError("only 0-d integer tensors can be used as an "
+                            "index")
+        return int(self.item())
+
     def __bool__(self):
         # branch conditions: under to_static these become guarded program
         # outputs, so data-dependent python `if`s compile (SOT analog)

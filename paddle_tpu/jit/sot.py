@@ -21,14 +21,22 @@ themselves execute eagerly between the compiled segments.  Python
 effects (print/log of a loss value) therefore fire on EVERY call, and
 the matmuls on either side stay compiled.
 
-Granularity is the top-level statement: a host read nested inside a
-compound statement (loop/with/if) makes that whole statement eager, and
-a function whose source is unavailable (lambda, exec) or that returns
-from a non-terminal position stays on the whole-function eager fallback.
+Granularity is sub-statement: a host read nested inside a compound
+statement (for/while/if/with) no longer drops the whole statement to
+eager — the compound's header (iteration protocol, test, context enter)
+executes eagerly, while maximal non-breaking statement runs INSIDE its
+body are compiled as their own segments, recursively (reference analog:
+the opcode simulator's sub-statement graphs,
+python/paddle/jit/sot/opcode_translator/).  `break`/`continue` that bind
+to an enclosing loop stay eager (a compiled segment cannot jump out of
+the python loop that drives it).  A function whose source is unavailable
+(lambda, exec) or that is a generator/coroutine stays on the
+whole-function eager fallback.
 """
 from __future__ import annotations
 
 import ast
+import copy
 import inspect
 import textwrap
 
@@ -139,13 +147,200 @@ def _unsplittable(fdef):
     return False
 
 
+def _outward_loop_ctl(stmts):
+    """True when a break/continue in `stmts` binds to a loop that ENCLOSES
+    them — compiling such a run would detach the jump from the python loop
+    that drives it.  Nested loops (and defs, where bare break is illegal)
+    own their jumps, so the walk does not descend into them."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While,
+                             ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _worth_compiling(run):
+    """Only runs with some actual compute (a call or an operator) earn a
+    segment; pure bookkeeping stays eager where it costs nothing."""
+    return any(isinstance(n, (ast.Call, ast.BinOp, ast.UnaryOp,
+                              ast.Compare, ast.Subscript))
+               for s in run for n in ast.walk(s))
+
+
+# spliced in place of a compiled run inside a compound body; executes at
+# module level in the eager piece's namespace, so locals() IS that
+# namespace and writes through it persist
+_CALLSITE = (
+    "__pw_tag__, __pw_out__ = {call}(locals())\n"
+    "if __pw_tag__ == '__pw_return__':\n"
+    "    raise __pw_return_exc__(__pw_out__)\n"
+    "locals().update(__pw_out__)"
+)
+
+# distinct values a single int input may contribute to a segment's static
+# signatures before it promotes to a traced 0-d tensor (ends a loop
+# counter's compile-per-value storm at one extra retrace); counted per
+# name, so a never-varying int — a fixed slice bound or container index —
+# never promotes no matter how many tensor-shape signatures accumulate
+_INT_PROMOTE_AFTER = 8
+
+
+def _emit_segment(glb, seg_name, loads, stmts, filename):
+    """Codegen one compiled segment over a locals-dict env: load preamble,
+    tagged-return protocol, '__pw'-filtered env return.  Shared by the
+    top-level and inner (compound-body) splitters.  Returns the wrapped
+    StaticFunction, or None when codegen fails."""
+    from .tracer import StaticFunction
+
+    body = [_RewriteSegReturn().visit(copy.deepcopy(s)) for s in stmts]
+    lines = [f"def {seg_name}(__pw_env__):"]
+    for n in loads:
+        lines.append(f"    if {n!r} in __pw_env__: "
+                     f"{n} = __pw_env__[{n!r}]")
+    for s in body:
+        lines.append(textwrap.indent(ast.unparse(s), "    "))
+    lines.append(
+        "    return ('__pw_env__', {__k: __v for __k, __v in "
+        "locals().items() if not __k.startswith('__pw')})")
+    try:
+        exec(compile("\n".join(lines), filename, "exec"), glb)
+    except SyntaxError:
+        return None
+    seg = StaticFunction(glb[seg_name])
+    seg._no_piecewise = True   # a segment never re-splits itself
+    return seg
+
+
+def _pick_env(src, loads, seg=None):
+    """Build a segment's env dict from a namespace.  Python floats promote
+    to 0-d tensors unconditionally: a host-read value (a logged loss)
+    flowing back into compiled code would otherwise bake into the
+    signature and recompile per distinct value.  An int promotes only
+    after that NAME has contributed _INT_PROMOTE_AFTER distinct values —
+    the compile-per-value storm of a loop counter used in compute.  An
+    int that was actually shape-like or container-index-like then
+    host-reads under tracing (Tensor.__index__) and graph-breaks that
+    segment to eager for the promoted signature — the correct
+    degradation."""
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    seen = None
+    if seg is not None:
+        seen = getattr(seg, "_pw_int_seen", None)
+        if seen is None:
+            seen = seg._pw_int_seen = {}
+    env = {}
+    for k in loads:
+        if k in src:
+            v = src[k]
+            if type(v) is float:
+                v = Tensor(jnp.asarray(v, jnp.float32))
+            elif seen is not None and type(v) is int:
+                vals = seen.setdefault(k, set())
+                if len(vals) < _INT_PROMOTE_AFTER:
+                    vals.add(v)
+                if len(vals) >= _INT_PROMOTE_AFTER:
+                    v = Tensor(jnp.asarray(v, jnp.int32))
+            env[k] = v
+    return env
+
+
+class _InnerCtx:
+    """Shared state for one build_piecewise pass over compound bodies."""
+
+    __slots__ = ("break_rel", "glb", "fn_name", "maybe_local", "segments",
+                 "counter")
+
+    def __init__(self, break_rel, glb, fn_name, maybe_local):
+        self.break_rel = break_rel
+        self.glb = glb
+        self.fn_name = fn_name
+        # params + every name stored anywhere in the function body: the
+        # superset of names that can be locals at runtime.  A name absent
+        # from the namespace at call time is simply not passed, and the
+        # segment resolves it as a global/closure via the glb chain.
+        self.maybe_local = maybe_local
+        self.segments = []
+        self.counter = 0
+
+
+def _make_inner_segment(ctx, run):
+    """Define a compiled segment for `run` (statements from inside a
+    compound body) plus its promoting call helper in ctx.glb.  Returns the
+    helper's name, or None when codegen fails."""
+    k = ctx.counter
+    ctx.counter += 1
+    loads = sorted(_names_loaded(run) & ctx.maybe_local)
+    seg = _emit_segment(ctx.glb, f"__pw_iseg_{k}__", loads, run,
+                        f"<piecewise-inner {ctx.fn_name}>")
+    if seg is None:
+        return None
+    ctx.segments.append(seg)
+
+    def _call(ns, _seg=seg, _loads=tuple(loads)):
+        return _seg(_pick_env(ns, _loads, _seg))
+
+    call_name = f"__pw_icall_{k}__"
+    ctx.glb[call_name] = _call
+    return call_name
+
+
+def _transform_stmts(ctx, stmts):
+    """Replace maximal non-breaking runs in a compound body with compiled
+    segment call sites; recurse into nested breaking compounds."""
+    out, run = [], []
+
+    def flush():
+        if not run:
+            return
+        if _worth_compiling(run):
+            name = _make_inner_segment(ctx, list(run))
+            if name is not None:
+                site = ast.parse(_CALLSITE.format(call=name)).body
+                for s in site:
+                    ast.copy_location(s, run[0])
+                out.extend(site)
+                run.clear()
+                return
+        out.extend(run)
+        run.clear()
+
+    for s in stmts:
+        end = getattr(s, "end_lineno", s.lineno)
+        brk = any(s.lineno <= ln <= end for ln in ctx.break_rel)
+        if not brk and not _outward_loop_ctl([s]):
+            run.append(s)
+            continue
+        flush()
+        if brk and isinstance(s, (ast.For, ast.While, ast.If, ast.With)):
+            out.append(_split_compound(ctx, s))
+        else:
+            out.append(s)
+    flush()
+    return out
+
+
+def _split_compound(ctx, stmt):
+    """Split INSIDE a breaking compound statement: the header stays eager,
+    non-breaking runs in its bodies compile."""
+    for field in ("body", "orelse"):
+        body = getattr(stmt, field, None)
+        if body:
+            setattr(stmt, field, _transform_stmts(ctx, body))
+    return stmt
+
+
 def build_piecewise(fn, break_lines_abs, warmups=1):
     """Split `fn` at the given absolute source lines into compiled
     segments + eager break statements.  Returns a driver callable with
     eager-identical semantics, or None when the function can't be split
     (no source, breaks unresolvable, generator/coroutine)."""
-    from .tracer import StaticFunction
-
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(src)
@@ -167,7 +362,7 @@ def build_piecewise(fn, break_lines_abs, warmups=1):
     for stmt in fdef.body:
         end = getattr(stmt, "end_lineno", stmt.lineno)
         breaking.append(any(stmt.lineno <= ln <= end for ln in break_rel))
-    if not any(breaking) or all(breaking):
+    if not any(breaking):
         return None
 
     pieces = []          # ("compiled"|"eager", [stmts])
@@ -189,59 +384,37 @@ def build_piecewise(fn, break_lines_abs, warmups=1):
 
     params = _param_names(fdef)
     available = set(params)
+    ctx = _InnerCtx(break_rel, glb, fn.__name__,
+                    set(params) | _names_stored([fdef]))
     compiled_pieces = 0
     runners = []         # (kind, loads, stores, callable/code)
     for kind, stmts in pieces:
         loads = sorted(_names_loaded(stmts) & available)
         stores = sorted(_names_stored(stmts))
         if kind == "compiled":
-            seg_name = f"__pw_seg_{len(runners)}__"
-            body = [_RewriteSegReturn().visit(s) for s in stmts]
-            lines = [f"def {seg_name}(__pw_env__):"]
-            for n in loads:
-                lines.append(f"    if {n!r} in __pw_env__: "
-                             f"{n} = __pw_env__[{n!r}]")
-            for s in body:
-                lines.append(textwrap.indent(ast.unparse(s), "    "))
-            lines.append(
-                "    return ('__pw_env__', {__k: __v for __k, __v in "
-                "locals().items() if not __k.startswith('__pw')})")
-            try:
-                exec(compile("\n".join(lines), f"<piecewise {fn.__name__}>",
-                             "exec"), glb)
-            except SyntaxError:
+            seg = _emit_segment(glb, f"__pw_seg_{len(runners)}__", loads,
+                                stmts, f"<piecewise {fn.__name__}>")
+            if seg is None:
                 return None
-            seg = StaticFunction(glb[seg_name])
-            seg._no_piecewise = True   # a segment never re-splits itself
             runners.append(("compiled", loads, stores, seg))
             compiled_pieces += 1
         else:
-            body = [_RewriteEagerReturn().visit(s) for s in stmts]
+            # every stmt in an eager piece contains a break line; a
+            # breaking COMPOUND splits further inside its body
+            split = [_split_compound(ctx, s)
+                     if isinstance(s, (ast.For, ast.While, ast.If,
+                                       ast.With)) else s
+                     for s in stmts]
+            body = [_RewriteEagerReturn().visit(s) for s in split]
             mod = ast.Module(body=body, type_ignores=[])
             ast.fix_missing_locations(mod)
             code = compile(mod, f"<piecewise-eager {fn.__name__}>", "exec")
             runners.append(("eager", loads, stores, code))
         available |= set(stores)
-    if compiled_pieces == 0:
+    if compiled_pieces == 0 and not ctx.segments:
         return None
 
     sig = inspect.signature(fn)
-
-    def _seg_env(env, loads):
-        """python floats crossing into a compiled segment are promoted to
-        0-d tensors: a host-read value (e.g. a logged loss) that flows
-        back into compiled code would otherwise bake into the signature
-        and force a recompile per distinct value."""
-        from ..core.tensor import Tensor
-        import jax.numpy as jnp
-        out = {}
-        for k in loads:
-            if k in env:
-                v = env[k]
-                if type(v) is float:
-                    v = Tensor(jnp.asarray(v, jnp.float32))
-                out[k] = v
-        return out
 
     def driver(*args, **kwargs):
         bound = sig.bind(*args, **kwargs)
@@ -250,7 +423,7 @@ def build_piecewise(fn, break_lines_abs, warmups=1):
         try:
             for kind, loads, stores, run in runners:
                 if kind == "compiled":
-                    out = run(_seg_env(env, loads))
+                    out = run(_pick_env(env, loads, run))
                     tag, val = out
                     if tag == "__pw_return__":
                         return val
@@ -258,9 +431,10 @@ def build_piecewise(fn, break_lines_abs, warmups=1):
                 else:
                     # single namespace (globals == locals): nested scopes
                     # in the eager statements (genexps, lambdas) resolve
-                    # the function's locals via LOAD_GLOBAL
-                    ns = _EnvNS(fn.__globals__)
-                    ns["__pw_return_exc__"] = _PWReturn
+                    # the function's locals via LOAD_GLOBAL.  Based on glb
+                    # so inner-segment call helpers resolve; closure cells
+                    # re-read live per call (glb's copies are snapshots).
+                    ns = _EnvNS(glb)
                     if fn.__closure__:
                         ns.update(zip(fn.__code__.co_freevars,
                                       (c.cell_contents
@@ -276,6 +450,8 @@ def build_piecewise(fn, break_lines_abs, warmups=1):
 
     driver.__name__ = f"{fn.__name__}__piecewise"
     driver.__wrapped__ = fn
-    driver._segments = [r for k, _, _, r in runners if k == "compiled"]
+    driver._segments = ([r for k, _, _, r in runners if k == "compiled"]
+                        + ctx.segments)
+    driver._inner_segments = list(ctx.segments)
     driver._n_pieces = len(runners)
     return driver
