@@ -226,16 +226,18 @@ def _pick_env(src, loads, seg=None):
     the compile-per-value storm of a loop counter used in compute.  An
     int that was actually shape-like or container-index-like then
     host-reads under tracing (Tensor.__index__) and graph-breaks that
-    segment to eager for the promoted signature — the correct
-    degradation."""
+    segment to eager for the promoted signature; a use promotion cannot
+    serve at all (dict key, set member) raises instead, which
+    _call_segment converts into a permanent promotion opt-out."""
     from ..core.tensor import Tensor
     import jax.numpy as jnp
     seen = None
-    if seg is not None:
+    if seg is not None and not getattr(seg, "_pw_no_promote", False):
         seen = getattr(seg, "_pw_int_seen", None)
         if seen is None:
             seen = seg._pw_int_seen = {}
     env = {}
+    promoted = False
     for k in loads:
         if k in src:
             v = src[k]
@@ -247,8 +249,29 @@ def _pick_env(src, loads, seg=None):
                     vals.add(v)
                 if len(vals) >= _INT_PROMOTE_AFTER:
                     v = Tensor(jnp.asarray(v, jnp.int32))
+                    promoted = True
             env[k] = v
-    return env
+    return env, promoted
+
+
+def _call_segment(seg, src, loads):
+    """Invoke a segment with scalar promotion.  If a call with promoted
+    ints raises (a dict lookup or set test on the promoted value — uses
+    Tensor.__index__ cannot cover), promotion is disabled for this
+    segment permanently and the call retries with raw ints — restoring
+    the pre-promotion per-value-compile behavior instead of crashing.
+    (python effects inside COMPILED segments fire on eager warm-up runs
+    only — already the compiled-region contract — so the one retry does
+    not change any guaranteed effect semantics.)"""
+    env, promoted = _pick_env(src, loads, seg)
+    if not promoted:
+        return seg(env)
+    try:
+        return seg(env)
+    except Exception:
+        seg._pw_no_promote = True
+        env, _ = _pick_env(src, loads, None)
+        return seg(env)
 
 
 class _InnerCtx:
@@ -284,7 +307,7 @@ def _make_inner_segment(ctx, run):
     ctx.segments.append(seg)
 
     def _call(ns, _seg=seg, _loads=tuple(loads)):
-        return _seg(_pick_env(ns, _loads, _seg))
+        return _call_segment(_seg, ns, _loads)
 
     call_name = f"__pw_icall_{k}__"
     ctx.glb[call_name] = _call
@@ -423,7 +446,7 @@ def build_piecewise(fn, break_lines_abs, warmups=1):
         try:
             for kind, loads, stores, run in runners:
                 if kind == "compiled":
-                    out = run(_pick_env(env, loads, run))
+                    out = _call_segment(run, env, loads)
                     tag, val = out
                     if tag == "__pw_return__":
                         return val

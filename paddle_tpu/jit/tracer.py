@@ -236,6 +236,42 @@ def _signature(args, kwargs):
 
 _WARMUP = object()
 
+_DONATED_FAILURE_MSG = (
+    "compiled step failed after buffer donation; parameters/optimizer "
+    "state backing this step are invalid — reload them from a checkpoint, "
+    "or set FLAGS_jit_donate_buffers=False to trade memory for failure "
+    "recovery")
+
+
+def _donation_unsafe(cap_arrays, mut_idx):
+    """Donation is unsound when a to-be-donated buffer is aliased by
+    another capture: two mut targets sharing one array would donate it
+    twice; a const capture aliasing it would read a deleted buffer."""
+    buf = [id(a) for a in cap_arrays]
+    mut_set = set(mut_idx)
+    mut_buf = {buf[i] for i in mut_idx}
+    return (len(mut_buf) != len(mut_idx)
+            or any(buf[i] in mut_buf for i in range(len(buf))
+                   if i not in mut_set))
+
+
+def _apply_entry_results(entry, out_arrays, mut_arrays, grad_arrays):
+    """Write a compiled step's results back into the live tensors
+    (mutations in place, escaped grads) and rebuild the python outputs
+    from the recorded structure.  Shared by the dynamic compiled path and
+    the static-graph training executor (static._TrainExecutor)."""
+    for t, arr in zip(entry.mut_targets, mut_arrays):
+        t._data_ = arr
+    for t, arr in zip(entry.grad_targets, grad_arrays):
+        if t.grad is None:
+            t.grad = Tensor(arr)
+        else:
+            t.grad._data_ = arr
+    out_tree, out_spec = entry.out_struct
+    arrays = iter(out_arrays)
+    leaves = [Tensor(next(arrays)) if s is None else s for s in out_spec]
+    return jax.tree.unflatten(out_tree, leaves)
+
 
 class _CompiledEntry:
     __slots__ = ("captures", "providers", "jitted", "mut_targets",
@@ -538,9 +574,7 @@ class StaticFunction:
             # by another capture (two mut_targets sharing one array would
             # donate it twice; a const capture aliasing it would read a
             # deleted buffer) — fall back to the copying path for this call
-            mut_buf_ids = {id(a) for a in mut_caps}
-            if (len(mut_buf_ids) != len(mut_caps)
-                    or any(id(a) in mut_buf_ids for a in const_caps)):
+            if _donation_unsafe(cap_arrays, entry.mut_idx):
                 use_donate = False
         try:
             if use_donate:
@@ -556,12 +590,7 @@ class StaticFunction:
                     # non-donating path, inputs cannot be preserved here
                     if any(getattr(a, "is_deleted", lambda: False)()
                            for a in mut_caps):
-                        raise RuntimeError(
-                            "compiled step failed after buffer donation; "
-                            "parameters/optimizer state backing this step "
-                            "are invalid — reload them from a checkpoint, "
-                            "or set FLAGS_jit_donate_buffers=False to "
-                            "trade memory for failure recovery") from e
+                        raise RuntimeError(_DONATED_FAILURE_MSG) from e
                     raise
             else:
                 out_arrays, mut_arrays, grad_arrays, guard_arrays = \
@@ -639,19 +668,8 @@ class StaticFunction:
             # re-specialize on the new branch (runs eagerly this call)
             return self._discover(key, args, kwargs)
 
-        # apply mutations
-        for t, arr in zip(entry.mut_targets, mut_arrays):
-            t._data_ = arr
-        for t, arr in zip(entry.grad_targets, grad_arrays):
-            if t.grad is None:
-                t.grad = Tensor(arr)
-            else:
-                t.grad._data_ = arr
-        # rebuild outputs
-        out_tree, out_spec = entry.out_struct
-        arrays = iter(out_arrays)
-        leaves = [Tensor(next(arrays)) if s is None else s for s in out_spec]
-        return jax.tree.unflatten(out_tree, leaves)
+        return _apply_entry_results(entry, out_arrays, mut_arrays,
+                                    grad_arrays)
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

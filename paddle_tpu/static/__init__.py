@@ -65,6 +65,7 @@ class Program:
         self._out_tree = None
         self._compiled = None   # jitted executable over _jaxpr
         self._use_compiled = False  # build() opts Executor.run into it
+        self._train = None      # _TrainExecutor after build(for_training=True)
 
     def clone(self, for_test=False):
         p = Program(self._fn, list(self._input_specs))
@@ -80,20 +81,38 @@ class Program:
     # ---- program IR (reference: ProgramDesc blocks/ops; here the IR is
     # a jaxpr — SURVEY §7: PIR's role is played by jaxpr/StableHLO) ----
 
-    def build(self):
+    def build(self, for_training=False):
         """Trace the callable into the program IR (a ClosedJaxpr).
 
         The reference builds ProgramDesc incrementally under
         program_guard; here the whole callable traces in one pass (the
         two-phase tracer handles the dynamic path — this is the static
         path for introspection, pruning, and the compiled Executor).
-        Parameters the callable closes over become jaxpr CONSTANTS —
-        build() freezes them (inference semantics); a program whose
-        weights mutate between runs belongs on the eager path.
+
+        Inference build (default): parameters the callable closes over
+        become jaxpr CONSTANTS — frozen.  `for_training=True` instead
+        captures forward+backward+optimizer as ONE jaxpr whose params and
+        optimizer state are donated INVARS, executed by a single cached
+        executable with in-place write-back — the StandaloneExecutor-for-
+        training analog (reference: new_executor/standalone_executor.cc:160
+        runs forward+backward+optimizer jobs).  The training IR
+        materializes at the second Executor.run (step 1 runs eagerly so
+        lazy optimizer state exists before capture).
 
         Requires fully-static input_specs: a dynamic dim would bake the
         trace shape into reductions/normalizations and return silently
         wrong numbers for other batch sizes."""
+        if for_training:
+            if self._fn is None:
+                raise ValueError("Program has no function bound")
+            self._train = _TrainExecutor(self)
+            return self
+        # (re)build for inference: a previous training build no longer
+        # owns execution, and its fwd+bwd+opt IR must not masquerade as
+        # the inference program
+        if self._train is not None:
+            self._train = None
+            self._jaxpr = None
         self._ensure_ir()
         self._use_compiled = True
         return self
@@ -301,6 +320,150 @@ class Block:
         return f"Block({len(self._jaxpr.eqns)} ops)"
 
 
+class _TrainExecutor:
+    """Static-graph TRAINING through the built IR — the StandaloneExecutor
+    analog for training (reference:
+    fluid/framework/new_executor/standalone_executor.cc:160 runs
+    forward+backward+optimizer jobs from one built program).
+
+    Unlike the inference build (params frozen as jaxpr constants), the
+    whole train step — forward, tape backward, optimizer update — is
+    captured as ONE jaxpr whose parameters/optimizer state are INVARS.
+    Every subsequent step executes that jaxpr through a single cached
+    compiled executable, with the mutated buffers donated to XLA (in-place
+    update, no old+new copies) and written back into the live tensors.
+
+    Step protocol mirrors the dynamic tracer's phases: step 1 runs eagerly
+    (lazy optimizer state materializes before capture), step 2 runs
+    eagerly under discovery and builds the IR, step 3+ execute the IR."""
+
+    def __init__(self, program):
+        self._program = program
+        self._phase = 0
+        self._entry = None
+        self._arg_struct = None
+        self._arg_sig = None
+        self._jitted = None
+        self._flat_tree = None   # structure of the jaxpr's flat outputs
+        self._donate = ()
+
+    def _feed_tensors(self, feed):
+        return tuple(Tensor(np.asarray(feed[s.name]))
+                     for s in self._program._input_specs)
+
+    def _run_eager(self, args):
+        program = self._program
+        program._reset_uids()
+        with program_guard(program):
+            return program._fn(*args)
+
+    def step(self, feed):
+        import jax
+        import warnings
+        from ..jit import tracer as _tracer
+
+        program = self._program
+        args = self._feed_tensors(feed)
+        if self._phase == -1:        # unbuildable (host reads): eager
+            return self._run_eager(args)
+        if self._phase == 0:
+            self._phase = 1
+            return self._run_eager(args)
+        if self._phase == 1:
+            # discovery: run eagerly once more, recording captures
+            # (params, moments), mutations, and escaped grads
+            sf = _tracer.StaticFunction(program._fn)
+            key = sf._canon_key(args, {})
+            sf._cache[key] = _tracer._WARMUP   # phase 0 was the warm-up
+            program._reset_uids()
+            with program_guard(program):
+                out = sf._discover(key, args, {})
+            entry = sf._cache[key].last
+            arg_arrays, arg_struct = _tracer._flatten_args(args, {})
+            cap_arrays = [t._data_ for t in entry.captures]
+            host_vals = [p() for p in entry.providers]
+
+            def as_arrays(a, c, h):
+                return entry.pure(a, c, h, arg_struct)
+
+            try:
+                with program_guard(program):   # static.nn params scope
+                    program._reset_uids()
+                    closed, out_shape = jax.make_jaxpr(
+                        as_arrays, return_shape=True)(
+                            arg_arrays, cap_arrays, host_vals)
+            except _tracer.GraphBreak as e:
+                # a host interaction (print(float(loss)) etc.) the built
+                # program cannot replay: stay eager permanently — the
+                # dynamic path (jit.to_static) offers piecewise
+                # compilation for such steps
+                self._phase = -1
+                warnings.warn(
+                    f"static train program cannot be built ({e}); running "
+                    "every step eagerly — use jit.to_static for piecewise "
+                    "compilation of steps with host reads")
+                return out
+            program._jaxpr = closed        # the inspectable training IR
+            program._compiled = None
+            self._flat_tree = jax.tree.structure(out_shape)
+
+            # donate the mutated captures (params/moments/grads) unless a
+            # data-dependent guard exists (a mismatched step must keep its
+            # inputs) or a to-be-donated buffer is aliased by another
+            # capture (double-donate / read-after-free)
+            mut_ids = {id(t) for t in entry.mut_targets}
+            mut_idx = [i for i, t in enumerate(entry.captures)
+                       if id(t) in mut_ids]
+            n_args = len(arg_arrays)
+            if not entry.guard_bools and \
+                    not _tracer._donation_unsafe(cap_arrays, mut_idx):
+                self._donate = tuple(n_args + i for i in mut_idx)
+
+            def run(*xs):
+                return jax.core.eval_jaxpr(closed.jaxpr, closed.consts,
+                                           *xs)
+
+            self._jitted = jax.jit(run, donate_argnums=self._donate)
+            self._entry = entry
+            self._arg_struct = arg_struct
+            self._arg_sig = _tracer._signature(args, {})
+            self._phase = 2
+            return out
+        # phase 2+: run the built executable
+        entry = self._entry
+        arg_arrays, arg_struct = _tracer._flatten_args(args, {})
+        if _tracer._signature(args, {}) != self._arg_sig:
+            raise ValueError(
+                "static training program was built for a different input "
+                "signature; feed the shapes/dtypes it was built with, or "
+                "use the dynamic path (jit.to_static) for multi-signature "
+                "training")
+        cap_arrays = [t._data_ for t in entry.captures]
+        host_vals = [p() for p in entry.providers]
+        try:
+            flat = self._jitted(*arg_arrays, *cap_arrays, *host_vals)
+        except Exception as e:
+            # the donated param/moment buffers may already be gone —
+            # same failure contract as the dynamic donating path
+            if self._donate and any(
+                    getattr(a, "is_deleted", lambda: False)()
+                    for a in cap_arrays):
+                raise RuntimeError(_tracer._DONATED_FAILURE_MSG) from e
+            raise
+        out_arrays, mut_arrays, grad_arrays, guard_arrays = \
+            jax.tree.unflatten(self._flat_tree, flat)
+        # guard check BEFORE applying mutations (mirrors the dynamic
+        # tracer): a mismatch means the program followed the wrong branch
+        actual = tuple(bool(np.asarray(g)) for g in guard_arrays)
+        if actual != entry.guard_bools:
+            warnings.warn(
+                "static train program followed a different data-dependent "
+                "branch this step; re-running the step eagerly")
+            return self._run_eager(args)
+        return _tracer._apply_entry_results(entry, out_arrays, mut_arrays,
+                                            grad_arrays)
+
+
 _default_program = Program()
 
 
@@ -433,6 +596,10 @@ class Executor:
             params = [program._params[k] for k in
                       sorted(program._params)]
             outs = program._exported_call(params, args)
+        elif program._train is not None:
+            # build(for_training=True): forward+backward+optimizer as one
+            # built jaxpr with donated param invars (_TrainExecutor)
+            outs = program._train.step(feed)
         elif program._use_compiled and program._jaxpr is not None:
             # explicitly-BUILT program: ONE compiled executable, params
             # baked as constants (inference semantics).  Training-style

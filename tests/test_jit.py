@@ -1082,3 +1082,33 @@ def test_tensor_index_dunder():
         [1, 2, 3][paddle.to_tensor(np.float32(1.0))]
     with pytest.raises(TypeError):
         [1, 2, 3][paddle.ones([2], dtype="int32")]
+
+
+def test_piecewise_int_promotion_dict_key_retries_unpromoted():
+    """A loop counter used as a DICT key inside a compiled segment (a use
+    Tensor.__index__ cannot serve): when the storm guard promotes it, the
+    failed call must permanently disable promotion for that segment and
+    retry with raw ints — correct results, no KeyError escape."""
+    logged = []
+    paddle.seed(23)
+    model = nn.Linear(4, 4)
+    table = {i: float(i + 1) for i in range(12)}
+
+    @paddle.jit.to_static
+    def run(x):
+        out = paddle.zeros([])
+        for i in range(12):
+            logged.append(float(out))      # break every iteration
+            out = out + model(x).sum() * table[i]
+        return out
+
+    x = paddle.ones([2, 4])
+    with paddle.no_grad():
+        ref = sum(float(model(x).sum()) * table[i] for i in range(12))
+
+    for _ in range(4):
+        val = float(run(x))
+        assert abs(val - ref) / max(abs(ref), 1.0) < 1e-4
+    state = run._cache[run._canon_key((x,), {})]
+    segs = state.piecewise._inner_segments
+    assert any(getattr(s, "_pw_no_promote", False) for s in segs)
