@@ -256,22 +256,49 @@ def _pick_env(src, loads, seg=None):
 
 def _call_segment(seg, src, loads):
     """Invoke a segment with scalar promotion.  If a call with promoted
-    ints raises (a dict lookup or set test on the promoted value — uses
-    Tensor.__index__ cannot cover), promotion is disabled for this
-    segment permanently and the call retries with raw ints — restoring
-    the pre-promotion per-value-compile behavior instead of crashing.
-    (python effects inside COMPILED segments fire on eager warm-up runs
-    only — already the compiled-region contract — so the one retry does
-    not change any guaranteed effect semantics.)"""
+    ints raises a host-container error (a dict lookup or set test on the
+    promoted value — uses Tensor.__index__ cannot cover), promotion is
+    disabled for this segment permanently and the call retries with raw
+    ints — restoring the pre-promotion per-value-compile behavior
+    instead of crashing.  Segments with visible in-place effects never
+    promote (_effectful_run at build time), so the retry cannot
+    double-apply a mutation; RuntimeError (e.g. the donated-buffer
+    failure) is never swallowed."""
     env, promoted = _pick_env(src, loads, seg)
     if not promoted:
         return seg(env)
     try:
         return seg(env)
-    except Exception:
+    except (TypeError, KeyError, IndexError, ValueError):
         seg._pw_no_promote = True
         env, _ = _pick_env(src, loads, None)
         return seg(env)
+
+
+def _effectful_run(stmts):
+    """True when a statement run shows in-place/externally-visible effect
+    patterns — trailing-underscore mutator methods (add_, scatter_),
+    set_value, subscript/attribute assignment, container mutators
+    (append/extend/update/...).  Such segments are excluded from int
+    promotion: a failed promoted attempt could not be retried without
+    double-applying the effect."""
+    mutators = {"append", "extend", "insert", "add", "update", "pop",
+                "remove", "clear", "setdefault", "set_value"}
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                if any(isinstance(t, (ast.Subscript, ast.Attribute))
+                       for t in targets):
+                    return True
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+                if name in mutators or (name.endswith("_")
+                                        and not name.endswith("__")):
+                    return True
+    return False
 
 
 class _InnerCtx:
@@ -304,6 +331,8 @@ def _make_inner_segment(ctx, run):
                         f"<piecewise-inner {ctx.fn_name}>")
     if seg is None:
         return None
+    if _effectful_run(run):
+        seg._pw_no_promote = True
     ctx.segments.append(seg)
 
     def _call(ns, _seg=seg, _loads=tuple(loads)):

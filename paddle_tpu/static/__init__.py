@@ -76,6 +76,11 @@ class Program:
         p._out_tree = self._out_tree
         p._compiled = self._compiled
         p._use_compiled = self._use_compiled
+        # a training-built program clones as one (fresh executor, phases
+        # restart); for_test=True strips the training build (reference:
+        # clone(for_test=True) prunes backward/optimizer ops)
+        if self._train is not None and not for_test:
+            p.build(for_training=True)
         return p
 
     # ---- program IR (reference: ProgramDesc blocks/ops; here the IR is

@@ -26,11 +26,23 @@ TPU-native realization, three regimes:
   Both compile with the enclosing `to_static` program into a single
   XLA executable; gradients match eager python-loop unrolling.
 
-- **Python fallback**: bodies that read host values, use framework RNG
-  (dropout — per-iteration keys cannot be replayed consistently by a
-  traced body), mutate tensors they close over, or return mismatched
-  structures run as a tape-recorded python loop whose predicate reads
-  go through the to_static guard machinery (the SOT analog).
+- **Python fallback**: bodies that read host values, mutate tensors they
+  close over, or return mismatched structures run as a tape-recorded
+  python loop whose predicate reads go through the to_static guard
+  machinery (the SOT analog).
+
+Framework RNG inside a body (dropout) stays ON the compiled paths: the
+loop carries an iteration counter and draws flow through a per-iteration
+key `fold_in(base, i)` (plus an in-body draw counter), so every
+iteration gets fresh randomness and the reverse sweep replays the exact
+masks — the While-op VJP regenerating recorded randomness, TPU-style.
+
+The unbounded differentiable loop's reverse uses two-level binomial
+checkpointing (`_CKPT_SLOTS` slots per level): an O(n) sweep stores
+level-1 checkpoints every ceil(n/M) iterations, each segment re-sweeps
+into level-2 slots, and per-iteration states come from the nearest
+level-2 slot — O(n·ceil(n/M²)) total recompute (linear for n ≤ M²=4096)
+and O(M·state) memory, replacing the old recompute-from-entry O(n²).
 
 The differentiable compiled paths engage under an active jit trace (or
 with an explicit `maxiter=`); plain eager mode keeps the python tape
@@ -59,16 +71,19 @@ class _FallbackToPython(Exception):
 class _LoopProbe:
     """Abstract-eval tracer installed while discovering what a loop body
     touches: which pre-existing tensors it reads (captures to hoist as op
-    inputs), whether it mutates external state, reads host values, or
-    draws RNG — the latter three force the python fallback."""
+    inputs), whether it mutates external state, reads host values (forces
+    the python fallback), or draws RNG (recorded; the loop ops thread
+    per-iteration keys when allowed, else fall back)."""
 
-    def __init__(self):
+    def __init__(self, allow_rng=False):
         self.created = set()          # id(Tensor) made during discovery
         self.cap_ids = set()
         self.captured = []            # pre-existing Tensors read, in order
         self.writes = []              # (tensor, pre-write _data_) for undo
         self.wrote_external = False
         self.rng_counter = 0
+        self.allow_rng = allow_rng
+        self.used_rng = False
 
     def on_create(self, t):
         self.created.add(id(t))
@@ -91,14 +106,17 @@ class _LoopProbe:
         raise _FallbackToPython("host input (lr/step counter) inside body")
 
     def rng_base(self):
-        raise _FallbackToPython("RNG draw inside loop body")
+        if not self.allow_rng:
+            raise _FallbackToPython("RNG draw inside loop body")
+        self.used_rng = True
+        return jax.random.PRNGKey(0)     # placeholder; real keys threaded
 
 
-def _discover(run, example_arrays):
+def _discover(run, example_arrays, allow_rng=False):
     """Abstract-eval `run` (list[arrays] -> list[arrays]) under a probe.
     Returns (probe, out_shapes, ok)."""
     prev = _state.STATE.tracer
-    probe = _LoopProbe()
+    probe = _LoopProbe(allow_rng=allow_rng)
     rng_c = _state.STATE.rng_counter
     _state.STATE.tracer = probe
     ok, out_shapes = True, None
@@ -117,6 +135,46 @@ def _discover(run, example_arrays):
     if probe.wrote_external:
         ok = False
     return probe, out_shapes, ok
+
+
+class _IterRNG:
+    """Tracer shim installed while a compiled loop body traces: RNG draws
+    become pure functions of (per-iteration key, in-body draw counter) so
+    every iteration gets fresh randomness that forward re-sweeps and the
+    reverse pass replay EXACTLY (the While-op VJP regenerating recorded
+    randomness).  All other tracer-protocol calls delegate to the
+    enclosing tracer (to_static bind/discovery), or no-op/fall back when
+    the loop compiles from eager."""
+
+    def __init__(self, inner, key):
+        self._inner = inner
+        self._key = key
+        self.rng_counter = 0
+
+    def rng_base(self):
+        return self._key
+
+    def on_create(self, t):
+        if self._inner is not None:
+            self._inner.on_create(t)
+
+    def on_read(self, t):
+        if self._inner is not None:
+            self._inner.on_read(t)
+
+    def on_write(self, t):
+        if self._inner is not None:
+            self._inner.on_write(t)
+
+    def host_read(self, t, bool_read=False):
+        if self._inner is not None:
+            return self._inner.host_read(t, bool_read=bool_read)
+        raise _FallbackToPython("host read inside compiled loop body")
+
+    def host_input(self, provider):
+        if self._inner is not None:
+            return self._inner.host_input(provider)
+        raise _FallbackToPython("host input inside compiled loop body")
 
 
 class _Swapped:
@@ -271,31 +329,91 @@ def while_loop(cond_fn, body, loop_vars, is_test=False, name=None,
     return vars_
 
 
+# RNG-use verdicts per body code object: whether a body draws framework
+# RNG is a property of its code, so one abstract-eval probe serves every
+# call (a per-call eval_shape would double the python tracing cost of
+# RNG-free decode loops).  Keyed by the code object itself — bounded by
+# the number of distinct loop bodies in the program.
+_RNG_USE_CACHE = {}
+
+
+def _body_uses_rng(body, example_arrays):
+    code = getattr(body, "__code__", None)
+    if code is not None and code in _RNG_USE_CACHE:
+        return _RNG_USE_CACHE[code]
+
+    def _disc(arrays):
+        out = body(*[Tensor(a) for a in arrays])
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return [x._data_ for x in out if isinstance(x, Tensor)]
+
+    probe, _, ok = _discover(_disc, example_arrays, allow_rng=True)
+    used = ok and probe.used_rng
+    if code is not None:
+        _RNG_USE_CACHE[code] = used
+    return used
+
+
+def _run_body_rng(body, arrays, key):
+    """Run `body` over Tensor views with the per-iteration RNG shim
+    installed (key=None leaves the ambient tracer untouched)."""
+    if key is None:
+        return body(*[Tensor(a) for a in arrays])
+    prev = _state.STATE.tracer
+    _state.STATE.tracer = _IterRNG(prev, key)
+    try:
+        return body(*[Tensor(a) for a in arrays])
+    finally:
+        _state.STATE.tracer = prev
+
+
 def _lax_while(cond_fn, body, vars_):
     """Lower to one lax.while_loop program: a tensor trip count runs as a
     single compiled program (under to_static it composes into the step
-    program with NO guard outputs — one entry regardless of trip count)."""
-    def c(arrays):
+    program with NO guard outputs — one entry regardless of trip count).
+    Bodies that draw RNG (sampling/decode loops) carry an iteration
+    counter and fold it into a fresh base key, so every iteration draws a
+    DIFFERENT mask/sample instead of the trace-time constant."""
+    init_arrays = [v._data for v in vars_]
+    use_rng = _body_uses_rng(body, init_arrays)
+    base_key = _state.next_rng_key() if use_rng else None
+
+    def c(carry):
+        arrays = carry[0] if use_rng else carry
         with _state.no_grad():
             r = cond_fn(*[Tensor(a) for a in arrays])
         r = r._data if isinstance(r, Tensor) else jax.numpy.asarray(r)
         return r.reshape(()).astype(jax.numpy.bool_)
 
-    def b(arrays):
+    def b(carry):
+        arrays = carry[0] if use_rng else carry
+        key = (jax.random.fold_in(base_key, carry[1]) if use_rng
+               else None)
         with _state.no_grad():
-            out = body(*[Tensor(a) for a in arrays])
+            out = _run_body_rng(body, arrays, key)
         out = list(out) if isinstance(out, (list, tuple)) else [out]
         if len(out) != len(arrays) or not all(
                 isinstance(x, Tensor) for x in out):
             raise TypeError("body must return the loop_vars structure")
-        return tuple(x._data.astype(a.dtype).reshape(a.shape)
-                     for x, a in zip(out, arrays))
+        new = tuple(x._data.astype(a.dtype).reshape(a.shape)
+                    for x, a in zip(out, arrays))
+        return (new, carry[1] + 1) if use_rng else new
 
     try:
-        res = jax.lax.while_loop(c, b, tuple(v._data for v in vars_))
+        init = (tuple(init_arrays), jnp.zeros((), jnp.int32)) \
+            if use_rng else tuple(init_arrays)
+        res = jax.lax.while_loop(c, b, init)
     except Exception:
         return _UNMATCHED
+    if use_rng:
+        res = res[0]
     return [Tensor(a) for a in res]
+
+
+# checkpoint slots per level of the unbounded reverse sweep: O(M·state)
+# memory, recompute linear in n for n <= M^2 (4096) and O(n·ceil(n/M²))
+# beyond
+_CKPT_SLOTS = 64
 
 
 def _diff_while(cond_fn, body, vars_, maxiter=None):
@@ -304,10 +422,14 @@ def _diff_while(cond_fn, body, vars_, maxiter=None):
     Reference capability: the While op's VJP (control_flow_op.cc) — the
     reference replays the recorded block per iteration; here backward is
     a compiled reverse sweep.  Without a bound: jax.custom_vjp whose
-    backward recomputes state_i from the initial state (O(n^2) FLOPs,
-    O(state) memory, fully compiled).  With `maxiter`: bounded lax.scan
-    + predicate mask, natively differentiated (residuals saved per
-    iteration — O(maxiter) memory, O(maxiter) backward)."""
+    backward fetches state_i through two-level binomial checkpointing
+    (_CKPT_SLOTS slots per level — O(n) re-sweeps plus O(ceil(n/M²))
+    replay per iteration; O(M·state) memory), fully compiled.  With
+    `maxiter`: bounded lax.scan + predicate mask, natively differentiated
+    (residuals saved per iteration — O(maxiter) memory, O(maxiter)
+    backward).  RNG draws in the body (dropout) ride both paths via
+    per-iteration keys (fold_in(base, i)) that the reverse replays
+    exactly."""
     n_loop = len(vars_)
 
     def _disc_run(arrays):
@@ -322,7 +444,8 @@ def _diff_while(cond_fn, body, vars_, maxiter=None):
         return [x._data_ for x in out]
 
     init_arrays = [v._data_ for v in vars_]
-    probe, out_shapes, ok = _discover(_disc_run, init_arrays)
+    probe, out_shapes, ok = _discover(_disc_run, init_arrays,
+                                      allow_rng=True)
     if not ok or out_shapes is None:
         return _UNMATCHED
     for s, a in zip(out_shapes, init_arrays):
@@ -332,15 +455,25 @@ def _diff_while(cond_fn, body, vars_, maxiter=None):
             return _UNMATCHED     # dtype-promoting body: silent downcast
                                   # would diverge from eager unrolling
     caps = list(probe.captured)
+    n_caps = len(caps)
+    use_rng = probe.used_rng
+    base_key = _state.next_rng_key() if use_rng else None
     in_dtypes = [a.dtype for a in init_arrays]
     in_shapes = [tuple(np.shape(a)) for a in init_arrays]
 
-    def _body_arr(loop_arrays, cap_arrays):
+    def _body_arr(loop_arrays, cap_arrays, key=None):
         with _Swapped(caps, cap_arrays), _state.no_grad():
-            out = body(*[Tensor(a) for a in loop_arrays])
+            out = _run_body_rng(body, loop_arrays, key)
         out = list(out) if isinstance(out, (list, tuple)) else [out]
         return tuple(x._data_.astype(d).reshape(sh)
                      for x, d, sh in zip(out, in_dtypes, in_shapes))
+
+    def _body_at(loop_arrays, cap_arrays, key, i):
+        """Body evaluation at global iteration i: per-iteration RNG key
+        derived as fold_in(base, i), so re-sweeps and the reverse pass
+        regenerate the exact forward randomness."""
+        k_i = None if key is None else jax.random.fold_in(key, i)
+        return _body_arr(loop_arrays, cap_arrays, k_i)
 
     def _cond_arr(loop_arrays, cap_arrays):
         with _Swapped(caps, cap_arrays), _state.no_grad():
@@ -356,9 +489,11 @@ def _diff_while(cond_fn, body, vars_, maxiter=None):
         bound = int(maxiter)
 
         def pure(*xs):
-            loop_xs, cap_xs = xs[:n_loop], xs[n_loop:]
+            loop_xs = xs[:n_loop]
+            cap_xs = xs[n_loop:n_loop + n_caps]
+            key = xs[n_loop + n_caps] if use_rng else None
 
-            def step(carry, _):
+            def step(carry, i):
                 # body evaluation is gated by lax.cond, not a post-hoc
                 # select: evaluating the body past logical termination
                 # can overflow (exp/square of a terminal state), and a
@@ -366,83 +501,148 @@ def _diff_while(cond_fn, body, vars_, maxiter=None):
                 # keeps dead iterations out of both forward and vjp.
                 pred = _cond_arr(carry, cap_xs)
                 nxt = jax.lax.cond(
-                    pred, lambda c: _body_arr(c, cap_xs), lambda c: c,
-                    carry)
+                    pred, lambda c: _body_at(c, cap_xs, key, i),
+                    lambda c: c, carry)
                 return nxt, None
 
-            final, _ = jax.lax.scan(step, tuple(loop_xs), None,
-                                    length=bound)
+            final, _ = jax.lax.scan(step, tuple(loop_xs),
+                                    jnp.arange(bound))
             return final
     else:
-        def _fwd_run(loop_xs, cap_xs):
+        def _fwd_run(loop_xs, cap_xs, key_xs):
+            key = key_xs[0] if key_xs else None
+
             def c(carry):
                 return _cond_arr(carry[0], cap_xs)
 
             def b(carry):
-                return (_body_arr(carry[0], cap_xs), carry[1] + 1)
+                st, i = carry
+                return (_body_at(st, cap_xs, key, i), i + 1)
 
             final, n = jax.lax.while_loop(
                 c, b, (tuple(loop_xs), jnp.zeros((), jnp.int32)))
             return final, n
 
         @jax.custom_vjp
-        def _while_op(loop_xs, cap_xs):
-            return _fwd_run(loop_xs, cap_xs)[0]
+        def _while_op(loop_xs, cap_xs, key_xs):
+            return _fwd_run(loop_xs, cap_xs, key_xs)[0]
 
-        def _op_fwd(loop_xs, cap_xs):
-            final, n = _fwd_run(loop_xs, cap_xs)
-            return final, (tuple(loop_xs), tuple(cap_xs), n)
+        def _op_fwd(loop_xs, cap_xs, key_xs):
+            final, n = _fwd_run(loop_xs, cap_xs, key_xs)
+            return final, (tuple(loop_xs), tuple(cap_xs), tuple(key_xs), n)
 
         def _op_bwd(res, g):
-            loop0, cap_xs, n = res
+            loop0, cap_xs, key_xs, n = res
+            key = key_xs[0] if key_xs else None
             g_loop = [_zero_cotangent(x) for x in loop0]
             g_cap = [_zero_cotangent(x) for x in cap_xs]
+            g_key = tuple(_zero_cotangent(k) for k in key_xs)
             if float_loop:
+                M = _CKPT_SLOTS
                 gF = tuple(g[i] for i in float_loop)
                 gC = tuple(jnp.zeros_like(cap_xs[i]) for i in float_cap)
 
-                def recompute(k):
+                def sweep(state0, start, count, stride):
+                    """Run `count` body steps from `state0` (global
+                    iteration `start`), storing the state at every
+                    multiple of `stride` into slot j//stride."""
+                    bufs = tuple(
+                        jnp.zeros((M,) + tuple(np.shape(x)),
+                                  jnp.asarray(x).dtype)
+                        for x in state0)
+
+                    def stp(j, carry):
+                        st, bufs = carry
+                        slot = j // stride
+                        store = (j % stride) == 0
+                        nb = []
+                        for x, bb in zip(st, bufs):
+                            cur = jax.lax.dynamic_index_in_dim(
+                                bb, slot, 0, keepdims=False)
+                            val = jnp.where(store, x, cur)
+                            nb.append(jax.lax.dynamic_update_index_in_dim(
+                                bb, val, slot, 0))
+                        return (_body_at(st, cap_xs, key, start + j),
+                                tuple(nb))
+
+                    _, bufs = jax.lax.fori_loop(0, count, stp,
+                                                (state0, bufs))
+                    return bufs
+
+                def fetch(bufs, local_j, stride, seg_start):
+                    """state at segment-local index local_j: nearest
+                    stored slot + at most stride-1 replayed steps."""
+                    slot = local_j // stride
+                    base = tuple(jax.lax.dynamic_index_in_dim(
+                        bb, slot, 0, keepdims=False) for bb in bufs)
+                    t0 = seg_start + slot * stride
                     return jax.lax.fori_loop(
-                        0, k, lambda j, xs: _body_arr(xs, cap_xs), loop0)
+                        0, local_j % stride,
+                        lambda t, xs: _body_at(xs, cap_xs, key, t0 + t),
+                        base)
 
-                def step(carry):
-                    i, gF, gC = carry
-                    xs_i = recompute(i)
+                s1 = jnp.maximum((n + M - 1) // M, 1)
+                ckpt1 = sweep(loop0, 0, n, s1)        # O(n) level-1 sweep
+                k1 = (n + s1 - 1) // s1               # used level-1 slots
+                s2 = jnp.maximum((s1 + M - 1) // M, 1)
 
-                    def f(Fs, Cs):
-                        xs = list(xs_i)
-                        for k2, idx in enumerate(float_loop):
-                            xs[idx] = Fs[k2]
-                        cs = list(cap_xs)
-                        for k2, idx in enumerate(float_cap):
-                            cs[idx] = Cs[k2]
-                        out = _body_arr(tuple(xs), tuple(cs))
-                        return tuple(out[idx] for idx in float_loop)
+                def seg_step(carry):
+                    k, gF, gC = carry
+                    seg_start = k * s1
+                    seg_len = jnp.minimum(s1, n - seg_start)
+                    base = tuple(jax.lax.dynamic_index_in_dim(
+                        bb, k, 0, keepdims=False) for bb in ckpt1)
+                    ckpt2 = sweep(base, seg_start, seg_len, s2)
 
-                    _, vjp = jax.vjp(
-                        f, tuple(xs_i[idx] for idx in float_loop),
-                        tuple(cap_xs[idx] for idx in float_cap))
-                    gF2, gC2 = vjp(gF)
-                    gC = tuple(a + b for a, b in zip(gC, gC2))
-                    return (i - 1, gF2, gC)
+                    def it_step(carry2):
+                        j, gF, gC = carry2
+                        i = seg_start + j
+                        xs_i = fetch(ckpt2, j, s2, seg_start)
+
+                        def f(Fs, Cs):
+                            xs = list(xs_i)
+                            for k2, idx in enumerate(float_loop):
+                                xs[idx] = Fs[k2]
+                            cs = list(cap_xs)
+                            for k2, idx in enumerate(float_cap):
+                                cs[idx] = Cs[k2]
+                            out = _body_at(tuple(xs), tuple(cs), key, i)
+                            return tuple(out[idx] for idx in float_loop)
+
+                        _, vjp = jax.vjp(
+                            f, tuple(xs_i[idx] for idx in float_loop),
+                            tuple(cap_xs[idx] for idx in float_cap))
+                        gF2, gC2 = vjp(gF)
+                        gC = tuple(a + b for a, b in zip(gC, gC2))
+                        return (j - 1, gF2, gC)
+
+                    _, gF, gC = jax.lax.while_loop(
+                        lambda c2: c2[0] >= 0, it_step,
+                        (seg_len - 1, gF, gC))
+                    return (k - 1, gF, gC)
 
                 _, gFf, gCf = jax.lax.while_loop(
-                    lambda cy: cy[0] >= 0, step, (n - 1, gF, gC))
+                    lambda cy: cy[0] >= 0, seg_step, (k1 - 1, gF, gC))
                 for k2, idx in enumerate(float_loop):
                     g_loop[idx] = gFf[k2]
                 for k2, idx in enumerate(float_cap):
                     g_cap[idx] = gCf[k2]
-            return (tuple(g_loop), tuple(g_cap))
+            return (tuple(g_loop), tuple(g_cap), g_key)
 
         _while_op.defvjp(_op_fwd, _op_bwd)
 
         def pure(*xs):
-            loop_xs, cap_xs = xs[:n_loop], xs[n_loop:]
-            return tuple(_while_op(tuple(loop_xs), tuple(cap_xs)))
+            loop_xs = xs[:n_loop]
+            cap_xs = xs[n_loop:n_loop + n_caps]
+            key_xs = xs[n_loop + n_caps:]
+            return tuple(_while_op(tuple(loop_xs), tuple(cap_xs),
+                                   tuple(key_xs)))
 
     from ..core.dispatch import apply_op
+    key_inputs = (Tensor(base_key),) if use_rng else ()
     try:
-        out = apply_op("while_loop", pure, tuple(vars_) + tuple(caps))
+        out = apply_op("while_loop", pure,
+                       tuple(vars_) + tuple(caps) + key_inputs)
     except Exception:
         return _UNMATCHED
     return [out] if isinstance(out, Tensor) else list(out)

@@ -1112,3 +1112,134 @@ def test_piecewise_int_promotion_dict_key_retries_unpromoted():
     state = run._cache[run._canon_key((x,), {})]
     segs = state.piecewise._inner_segments
     assert any(getattr(s, "_pw_no_promote", False) for s in segs)
+
+
+def test_while_loop_unbounded_grad_subquadratic_recompute():
+    """VERDICT r04 item 5: grad through a 1000-iteration UNBOUNDED loop
+    with sub-quadratic recompute.  The two-level checkpointed reverse
+    (control._CKPT_SLOTS=64) does O(n) sweeps + O(1) replay per iteration
+    at n=1000; body-evaluation count is measured with a runtime callback
+    — quadratic recompute would be ~500k evals, the checkpointed sweep
+    stays within a few multiples of n."""
+    import jax as _jax
+    from paddle_tpu.tensor_ops.control import while_loop
+
+    evals = []
+    w = paddle.to_tensor(np.float32(1.001), stop_gradient=False)
+
+    def body(i, s):
+        _jax.debug.callback(lambda: evals.append(1))
+        return i + 1, s * w
+
+    @paddle.jit.to_static
+    def run(x):
+        i0 = paddle.to_tensor(np.int32(0))
+        _, s = while_loop(lambda i, s: i < 1000, body,
+                          [i0, x])
+        loss = s.sum()
+        loss.backward()
+        return loss
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    expect = float(np.sum(np.array([1.0, 2.0]) * 1.001 ** 1000))
+    # calls: eager warm-up, eager discovery, then the COMPILED program
+    for call in range(3):
+        w.grad = None
+        evals.clear()
+        loss = run(x)
+        _jax.effects_barrier()
+        np.testing.assert_allclose(float(loss), expect, rtol=1e-4)
+        # d loss / dw = n/w * sum(x * w^n)
+        np.testing.assert_allclose(float(w.grad.numpy()),
+                                   1000 / 1.001 * expect, rtol=1e-4)
+    # the compiled call's measured budget: forward n + level-1 sweep n +
+    # per-segment sweeps n + one vjp per iteration n = 4n.  Quadratic
+    # recompute would be ~500,000.
+    n_evals = len(evals)
+    assert n_evals == 4000, n_evals
+
+
+def test_while_loop_dropout_in_body_compiled_grad():
+    """RNG inside a compiled loop body: per-iteration keys (fold_in of a
+    base key and the carried iteration index) give fresh masks each
+    iteration, and the reverse sweep replays them EXACTLY.  With x=ones,
+    acc = sum_i mask_i*2*x so d(acc.sum)/dx == acc elementwise — any
+    replay divergence breaks the identity."""
+    from paddle_tpu.tensor_ops.control import while_loop
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(42)
+    x = paddle.ones([64])
+    x.stop_gradient = False
+    i0 = paddle.to_tensor(np.int32(0))
+
+    def body(i, acc):
+        return i + 1, acc + F.dropout(x, 0.5, training=True)
+
+    calls = {"cond": 0}
+
+    def cond_fn(i, acc):
+        calls["cond"] += 1
+        return i < 20
+
+    _, acc = while_loop(cond_fn, body, [i0, paddle.zeros([64])],
+                        maxiter=32)
+    loss = acc.sum()
+    loss.backward()
+    # compiled (scan) path: cond evaluated under trace, not 20x in python
+    assert calls["cond"] <= 4, calls["cond"]
+    accv = acc.numpy()
+    # masks DIFFER per iteration: element sums take many distinct values
+    # (a single shared mask would give only {0, 40})
+    assert len(np.unique(accv)) > 3, np.unique(accv)
+    # exact replay: gradient == accumulated mask sum == acc (x is ones)
+    np.testing.assert_allclose(x.grad.numpy(), accv, rtol=1e-5)
+
+
+def test_while_loop_dropout_unbounded_to_static():
+    """Dropout in an UNBOUNDED differentiable loop under to_static: the
+    checkpointed reverse regenerates the forward masks from the carried
+    iteration index (replay identity, as above)."""
+    from paddle_tpu.tensor_ops.control import while_loop
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(7)
+    x = paddle.ones([32])
+    x.stop_gradient = False
+
+    @paddle.jit.to_static
+    def run(x0):
+        i0 = paddle.to_tensor(np.int32(0))
+        _, acc = while_loop(
+            lambda i, a: i < 150,
+            lambda i, a: (i + 1, a + F.dropout(x0, 0.5, training=True)),
+            [i0, paddle.zeros([32])])
+        loss = acc.sum()
+        loss.backward()
+        return acc
+
+    acc = run(x)
+    accv = acc.numpy()
+    assert len(np.unique(accv)) > 3
+    np.testing.assert_allclose(x.grad.numpy(), accv, rtol=1e-5)
+
+
+def test_lax_while_rng_differs_per_iteration_no_grad():
+    """No-grad sampling loops (decode): each iteration draws a DIFFERENT
+    random value instead of the trace-time constant."""
+    from paddle_tpu.tensor_ops.control import while_loop
+
+    paddle.seed(123)
+    i0 = paddle.to_tensor(np.int32(0))
+    buf0 = paddle.zeros([8])
+
+    def body(i, buf):
+        u = paddle.rand([])      # one draw per iteration
+        return i + 1, paddle.scatter(
+            buf, paddle.to_tensor(np.array([0], np.int64)) * 0 + i,
+            u.reshape([1]), overwrite=True)
+
+    with paddle.no_grad():
+        _, buf = while_loop(lambda i, b: i < 8, body, [i0, buf0])
+    vals = buf.numpy()
+    assert len(np.unique(vals)) == 8, vals
