@@ -36,6 +36,54 @@ def _server_main(q):
     srv.run()
 
 
+def bench_tables():
+    """Storage-tier capacity benchmark (VERDICT r04 item 7): RAM
+    SparseTable vs SSDSparseTable (4096-row hot cache + WAL + record log)
+    at working sets far beyond the cache — rows/sec for pull and push,
+    plus the on-disk footprint.  Reference analog: memory_sparse_table
+    vs ssd_sparse_table capacity trade (ps/table/ssd_sparse_table.h)."""
+    import tempfile
+    from paddle_tpu.distributed.ps import SparseTable, SSDSparseTable
+
+    rng = np.random.default_rng(1)
+    out = {}
+    for n_rows in (50_000, 200_000):
+        for kind in ("ram", "ssd"):
+            if kind == "ram":
+                t = SparseTable(DIM, lr=0.1)
+            else:
+                d = tempfile.mkdtemp(prefix="ps_tier_bench_")
+                t = SSDSparseTable(DIM, lr=0.1, cache_rows=4096,
+                                   path=os.path.join(d, "t.bin"))
+            # populate the working set (off the clock)
+            for lo in range(0, n_rows, BATCH):
+                t.pull(list(range(lo, min(lo + BATCH, n_rows))))
+            loops = 6
+            batches = [rng.integers(0, n_rows, BATCH).tolist()
+                       for _ in range(loops)]
+            grads = rng.standard_normal((BATCH, DIM)).astype(np.float32)
+            t0 = time.perf_counter()
+            for ids in batches:
+                t.pull(ids)
+            pull_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for ids in batches:
+                t.push(ids, grads)
+            push_s = time.perf_counter() - t0
+            rec = {
+                "pull_rows_per_sec": round(BATCH * loops / pull_s),
+                "push_rows_per_sec": round(BATCH * loops / push_s),
+            }
+            if kind == "ssd":
+                t.flush()
+                rec["log_bytes"] = os.path.getsize(t.path)
+                rec["cache_rows"] = t.cache_rows
+                rec["cold_rows"] = t.num_cold_rows
+                t.close()
+            out[f"{kind}_{n_rows}"] = rec
+    return out
+
+
 def main():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
@@ -73,6 +121,7 @@ def main():
         "push_rows_per_sec": round(BATCH * LOOPS / push_s),
         "pull_MBps": round(BATCH * LOOPS * DIM * 4 / pull_s / 1e6, 1),
         "push_MBps": round(BATCH * LOOPS * DIM * 4 / push_s / 1e6, 1),
+        "tiers": bench_tables(),
     }
     out = os.path.join(os.path.dirname(__file__), "PS_THROUGHPUT.json")
     with open(out, "w") as f:

@@ -109,6 +109,15 @@ class SparseTable:
         return dict(self.rows)
 
 
+_REC_MAGIC = b"PTS2"
+_REC_HDR = __import__("struct").Struct("<4sqI")  # magic, key i64, crc32
+# one-time superblock at the head of log and WAL files: geometry guard —
+# reopening with a different dim/optimizer must ERROR, not mis-scan (a
+# crc mismatch from wrong record framing would silently truncate to zero)
+_SB_MAGIC = b"PTSH"
+_SB = __import__("struct").Struct("<4sIII")      # magic, version, planes, dim
+
+
 class SSDSparseTable(SparseTable):
     """Two-tier sparse table: hot rows in an LRU RAM cache, cold rows in
     a log-structured disk file — host tables larger than RAM.
@@ -118,15 +127,25 @@ class SSDSparseTable(SparseTable):
     cold tier under memory_sparse_table) and the HeterPS pull path that
     stages cold rows upward (paddle/fluid/framework/fleet/
     ps_gpu_wrapper.h:114).  rocksdb is not in this image, so the cold
-    store is an append-only record file with an in-RAM {id → offset}
+    store is an append-only record log with an in-RAM {id → offset}
     index and threshold-triggered compaction: same capability, stdlib
     machinery.  Updates hit the cache; eviction appends the fresh record
     and abandons the old one (`_dead_bytes`); compaction rewrites live
     records when dead bytes exceed live bytes.
+
+    Crash story (the rocksdb-WAL analog): every record carries a
+    [magic, key, crc32] header, so reopening an existing path rebuilds
+    the index by scanning the log (later records win) and TRUNCATES a
+    torn tail at the first bad magic/crc.  Hot-tier mutations
+    write-ahead the full post-update row to `<path>.wal` before the
+    push/apply_delta returns; recovery replays the WAL over the
+    rebuilt index, so acknowledged updates survive a killed process.
+    flush() spills dirty rows, fsyncs the log, and truncates the WAL
+    (also triggered automatically when the WAL outgrows the live log).
     """
 
     def __init__(self, dim, lr=0.1, optimizer="sgd", initializer=None,
-                 seed=0, cache_rows=4096, path=None):
+                 seed=0, cache_rows=4096, path=None, wal=True):
         super().__init__(dim, lr=lr, optimizer=optimizer,
                          initializer=initializer, seed=seed)
         import collections
@@ -138,6 +157,7 @@ class SSDSparseTable(SparseTable):
         self._with_accum = (optimizer == "adagrad")
         self._planes = 2 if self._with_accum else 1
         self._rec_bytes = self._planes * dim * 4
+        self._rec_total = _REC_HDR.size + self._rec_bytes
         if path is None:
             fd, self.path = tempfile.mkstemp(
                 prefix="paddle_tpu_ssd_table_", suffix=".bin")
@@ -146,29 +166,187 @@ class SSDSparseTable(SparseTable):
             self.path = path
             self._file = open(path, "a+b")
         self._index: dict[int, int] = {}  # id → record offset (cold tier)
-        self._end = self._file.seek(0, 2)
+        self._end = 0
         self._dead_bytes = 0
         self._dirty: set[int] = set()  # hot rows mutated since load/spill
+        self._recover_log()
+        self._wal_path = self.path + ".wal"
+        self._wal = None
+        self._wal_bytes = 0
+        if wal:
+            self._replay_wal()
+            self._wal = open(self._wal_path, "ab")
+            if self._wal.tell() == 0:
+                self._wal.write(_SB.pack(_SB_MAGIC, 1, self._planes,
+                                         self.dim))
+                self._wal.flush()
+            self._wal_bytes = self._wal.tell()
+        elif os.path.exists(self._wal_path) and \
+                os.path.getsize(self._wal_path) > _SB.size:
+            # a leftover WAL holds acknowledged-but-unflushed updates;
+            # silently skipping it would drop them now AND replay the
+            # stale entries over newer state at a later wal=True open
+            raise ValueError(
+                f"a write-ahead log with pending updates exists at "
+                f"{self._wal_path}; open with wal=True to recover it, "
+                f"or delete it to discard those updates")
 
     # -- cold-tier record IO ------------------------------------------
+    def _pack_record(self, key, row, acc):
+        import zlib
+        payload = (np.concatenate([row, acc]) if self._with_accum
+                   else np.asarray(row)).astype(np.float32).tobytes()
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        return _REC_HDR.pack(_REC_MAGIC, int(key), crc) + payload
+
     def _write_record(self, key, row, acc):
-        rec = (np.concatenate([row, acc]) if self._with_accum
-               else row).astype(np.float32)
         off = self._end
         self._file.seek(off)
-        self._file.write(rec.tobytes())
-        self._end = off + self._rec_bytes
+        self._file.write(self._pack_record(key, row, acc))
+        self._end = off + self._rec_total
         if key in self._index:
-            self._dead_bytes += self._rec_bytes
+            self._dead_bytes += self._rec_total
         self._index[key] = off
 
     def _read_record(self, off):
-        self._file.seek(off)
+        self._file.seek(off + _REC_HDR.size)
         rec = np.frombuffer(self._file.read(self._rec_bytes),
                             np.float32).copy()
         if self._with_accum:
             return rec[:self.dim], rec[self.dim:]
         return rec, None
+
+    def _check_superblock(self, f, what):
+        """Validate (or write, when the file is empty) the geometry
+        superblock.  Returns the scan start offset."""
+        f.seek(0, 2)
+        if f.tell() == 0:
+            f.seek(0)
+            f.write(_SB.pack(_SB_MAGIC, 1, self._planes, self.dim))
+            f.flush()
+            return _SB.size
+        f.seek(0)
+        head = f.read(_SB.size)
+        try:
+            magic, version, planes, dim = _SB.unpack(head)
+        except Exception:
+            magic = None
+        if magic != _SB_MAGIC:
+            raise ValueError(
+                f"{what} at {self.path!r} is not a PTSH table file")
+        if planes != self._planes or dim != self.dim:
+            raise ValueError(
+                f"{what} geometry mismatch: file has dim={dim} "
+                f"planes={planes}, table configured dim={self.dim} "
+                f"planes={self._planes} (optimizer={self.optimizer!r}) — "
+                f"reopen with the original configuration")
+        return _SB.size
+
+    def _scan_log(self, f, on_record, start):
+        """Walk [header|payload] records from `start`; returns the offset
+        of the first torn/invalid record (= valid length)."""
+        import zlib
+        f.seek(0, 2)
+        end = f.tell()
+        off = start
+        while off + self._rec_total <= end:
+            f.seek(off)
+            hdr = f.read(_REC_HDR.size)
+            try:
+                magic, key, crc = _REC_HDR.unpack(hdr)
+            except Exception:
+                break
+            if magic != _REC_MAGIC:
+                break
+            payload = f.read(self._rec_bytes)
+            if len(payload) < self._rec_bytes or \
+                    (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                break
+            on_record(key, off, payload)
+            off += self._rec_total
+        return off
+
+    def _recover_log(self):
+        """Rebuild the {id → offset} index by scanning the log (later
+        records win, counting superseded ones as dead bytes) and truncate
+        a torn tail — reopening after a crash loses nothing that reached
+        the log."""
+        start = self._check_superblock(self._file, "sparse-table log")
+
+        def seen(key, off, _payload):
+            if key in self._index:
+                self._dead_bytes += self._rec_total
+            self._index[key] = off
+
+        valid = self._scan_log(self._file, seen, start)
+        self._end = valid
+        self._file.truncate(valid)
+
+    def _replay_wal(self):
+        """Apply write-ahead entries (full post-update row states) over
+        the rebuilt index, then truncate the WAL's own torn tail — a new
+        process appending after garbage would make its acknowledged
+        updates unrecoverable (the scan stops at the tear)."""
+        import os
+        if not os.path.exists(self._wal_path):
+            return
+        with open(self._wal_path, "r+b") as w:
+            start = self._check_superblock(w, "write-ahead log")
+
+            def apply(key, _off, payload):
+                rec = np.frombuffer(payload, np.float32).copy()
+                if self._with_accum:
+                    self.rows[key] = rec[:self.dim]
+                    self._accum[key] = rec[self.dim:]
+                else:
+                    self.rows[key] = rec
+                self.rows.move_to_end(key)
+                self._dirty.add(key)
+
+            valid = self._scan_log(w, apply, start)
+            w.truncate(valid)
+        self._evict_to_fit()
+
+    def _wal_append(self, key, row, acc):
+        if self._wal is None:
+            return
+        self._wal.write(self._pack_record(key, row, acc))
+        self._wal_bytes += self._rec_total
+        live = max(self._end - self._dead_bytes, 1 << 16)
+        if self._wal_bytes > max(live, 1 << 20):
+            self.flush()
+
+    def _wal_sync(self):
+        """Flush WAL bytes to the OS before a push/apply batch returns:
+        the OS page cache survives a killed process (the ack contract),
+        while python's userspace buffer does not.  fsync (machine-crash
+        durability) is deliberately left to flush()."""
+        if self._wal is not None:
+            self._wal.flush()
+
+    def flush(self):
+        """Spill every dirty hot row to the log, fsync it, and truncate
+        the WAL — the durable-checkpoint op (rocksdb Flush analog)."""
+        import os
+        for key in list(self._dirty):
+            row = self.rows.get(key)
+            if row is None:
+                self._dirty.discard(key)
+                continue
+            acc = self._accum.get(key)
+            if acc is None and self._with_accum:
+                acc = np.zeros(self.dim, np.float32)
+            self._write_record(key, row, acc)
+            self._dirty.discard(key)
+        self._file.flush()
+        try:
+            os.fsync(self._file.fileno())
+        except OSError:
+            pass
+        if self._wal is not None:
+            self._wal.truncate(_SB.size)   # keep the geometry superblock
+            self._wal.flush()
+            self._wal_bytes = _SB.size
 
     def _evict_to_fit(self):
         while len(self.rows) > self.cache_rows:
@@ -191,13 +369,14 @@ class SSDSparseTable(SparseTable):
         import os
         tmp_path = self.path + ".compact"
         new_index = {}
-        off = 0
+        off = _SB.size
         with open(tmp_path, "w+b") as out:
+            out.write(_SB.pack(_SB_MAGIC, 1, self._planes, self.dim))
             for key, old in self._index.items():
                 self._file.seek(old)
-                out.write(self._file.read(self._rec_bytes))
+                out.write(self._file.read(self._rec_total))
                 new_index[key] = off
-                off += self._rec_bytes
+                off += self._rec_total
         self._file.close()
         os.replace(tmp_path, self.path)
         self._file = open(self.path, "r+b")
@@ -222,12 +401,18 @@ class SSDSparseTable(SparseTable):
         self.rows[key] = row
         if self._with_accum:
             self._accum[key] = np.zeros(self.dim, np.float32)
+        # creation is a visible state change: flush() must persist rows a
+        # worker pulled and trained against, and recovery must not redraw
+        # them from a differently-positioned RNG stream
+        self._dirty.add(key)
+        self._wal_append(key, row, self._accum.get(key))
         return row
 
     def pull(self, ids):
         out = np.empty((len(ids), self.dim), np.float32)
         for i, key in enumerate(ids):
             out[i] = self._fetch(int(key))
+        self._wal_sync()    # row creations above are WAL'd
         self._evict_to_fit()
         return out
 
@@ -241,16 +426,24 @@ class SSDSparseTable(SparseTable):
                 acc += g * g
                 row -= self.lr * g / (np.sqrt(acc) + 1e-8)
             else:
+                acc = None
                 row -= self.lr * g
             self._dirty.add(key)
+            self._wal_append(key, row, acc)
+        self._wal_sync()
         self._evict_to_fit()
 
     def apply_delta(self, ids, deltas):
         deltas = np.asarray(deltas, np.float32)
         for key, d in zip(ids, deltas):
-            self._fetch(int(key))
-            self.rows[int(key)] += d
-            self._dirty.add(int(key))
+            key = int(key)
+            self._fetch(key)
+            self.rows[key] += d
+            self._dirty.add(key)
+            self._wal_append(key, self.rows[key],
+                             self._accum.get(key) if self._with_accum
+                             else None)
+        self._wal_sync()
         self._evict_to_fit()
 
     @property
@@ -267,9 +460,15 @@ class SSDSparseTable(SparseTable):
 
     def close(self):
         try:
-            self._file.close()
-        except OSError:
+            self.flush()
+        except (OSError, ValueError):
             pass
+        for f in (self._file, self._wal):
+            try:
+                if f is not None:
+                    f.close()
+            except OSError:
+                pass
 
 
 # ------------------------------------------------------------------

@@ -148,10 +148,12 @@ class _IterRNG:
 
     def __init__(self, inner, key):
         self._inner = inner
-        self._key = key
+        self._key = key          # a key array, or a thunk resolved lazily
         self.rng_counter = 0
 
     def rng_base(self):
+        if callable(self._key):
+            self._key = self._key()
         return self._key
 
     def on_create(self, t):
@@ -329,34 +331,10 @@ def while_loop(cond_fn, body, loop_vars, is_test=False, name=None,
     return vars_
 
 
-# RNG-use verdicts per body code object: whether a body draws framework
-# RNG is a property of its code, so one abstract-eval probe serves every
-# call (a per-call eval_shape would double the python tracing cost of
-# RNG-free decode loops).  Keyed by the code object itself — bounded by
-# the number of distinct loop bodies in the program.
-_RNG_USE_CACHE = {}
-
-
-def _body_uses_rng(body, example_arrays):
-    code = getattr(body, "__code__", None)
-    if code is not None and code in _RNG_USE_CACHE:
-        return _RNG_USE_CACHE[code]
-
-    def _disc(arrays):
-        out = body(*[Tensor(a) for a in arrays])
-        out = list(out) if isinstance(out, (list, tuple)) else [out]
-        return [x._data_ for x in out if isinstance(x, Tensor)]
-
-    probe, _, ok = _discover(_disc, example_arrays, allow_rng=True)
-    used = ok and probe.used_rng
-    if code is not None:
-        _RNG_USE_CACHE[code] = used
-    return used
-
-
 def _run_body_rng(body, arrays, key):
     """Run `body` over Tensor views with the per-iteration RNG shim
-    installed (key=None leaves the ambient tracer untouched)."""
+    installed (key=None leaves the ambient tracer untouched).  `key` may
+    be a thunk, resolved only if the body actually draws."""
     if key is None:
         return body(*[Tensor(a) for a in arrays])
     prev = _state.STATE.tracer
@@ -371,42 +349,55 @@ def _lax_while(cond_fn, body, vars_):
     """Lower to one lax.while_loop program: a tensor trip count runs as a
     single compiled program (under to_static it composes into the step
     program with NO guard outputs — one entry regardless of trip count).
-    Bodies that draw RNG (sampling/decode loops) carry an iteration
-    counter and fold it into a fresh base key, so every iteration draws a
-    DIFFERENT mask/sample instead of the trace-time constant."""
+    The loop always carries an iteration counter; if the body draws
+    framework RNG (sampling/decode loops) a base key materializes lazily
+    — at the first draw, through the ENCLOSING tracer context — and each
+    iteration folds the counter in, so every iteration draws a DIFFERENT
+    mask/sample instead of the trace-time constant.  RNG-free bodies
+    never draw the base key (the global RNG stream is untouched) and pay
+    only the spare counter."""
     init_arrays = [v._data for v in vars_]
-    use_rng = _body_uses_rng(body, init_arrays)
-    base_key = _state.next_rng_key() if use_rng else None
+    outer_tracer = _state.STATE.tracer
+    base_box = []
+
+    def _base_key():
+        if not base_box:
+            saved = _state.STATE.tracer
+            _state.STATE.tracer = outer_tracer
+            try:
+                base_box.append(_state.next_rng_key())
+            finally:
+                _state.STATE.tracer = saved
+        return base_box[0]
 
     def c(carry):
-        arrays = carry[0] if use_rng else carry
+        arrays = carry[0]
         with _state.no_grad():
             r = cond_fn(*[Tensor(a) for a in arrays])
         r = r._data if isinstance(r, Tensor) else jax.numpy.asarray(r)
         return r.reshape(()).astype(jax.numpy.bool_)
 
     def b(carry):
-        arrays = carry[0] if use_rng else carry
-        key = (jax.random.fold_in(base_key, carry[1]) if use_rng
-               else None)
+        arrays, i = carry
+
+        def key_thunk():
+            return jax.random.fold_in(_base_key(), i)
+
         with _state.no_grad():
-            out = _run_body_rng(body, arrays, key)
+            out = _run_body_rng(body, arrays, key_thunk)
         out = list(out) if isinstance(out, (list, tuple)) else [out]
         if len(out) != len(arrays) or not all(
                 isinstance(x, Tensor) for x in out):
             raise TypeError("body must return the loop_vars structure")
         new = tuple(x._data.astype(a.dtype).reshape(a.shape)
                     for x, a in zip(out, arrays))
-        return (new, carry[1] + 1) if use_rng else new
+        return (new, i + 1)
 
     try:
-        init = (tuple(init_arrays), jnp.zeros((), jnp.int32)) \
-            if use_rng else tuple(init_arrays)
-        res = jax.lax.while_loop(c, b, init)
+        res, _ = jax.lax.while_loop(
+            c, b, (tuple(init_arrays), jnp.zeros((), jnp.int32)))
     except Exception:
         return _UNMATCHED
-    if use_rng:
-        res = res[0]
     return [Tensor(a) for a in res]
 
 

@@ -84,6 +84,23 @@ _SLOW_TESTS = {
     "test_generation.py::test_cached_and_full_forward_agree_with_processors",
     "test_generation.py::test_top_p_tight_equals_greedy",          # 14
     "test_subpackage_parity.py::test_model_zoo_families_forward[squeezenet1_0]",  # 13; alexnet stays as the fast zoo representative
+    # r05 re-fit (VERDICT r04 weak #3: the lane outgrew its ~520s budget):
+    # each move keeps at least one fast test per subsystem — hapi keeps
+    # fit/predict + weights-cache, llama keeps gqa/eager, generation keeps
+    # sampled + eos, int8 keeps the dynamic-quant tests, property keeps
+    # reductions, book keeps recognize_digits, collectives stay covered by
+    # test_distributed + the tcp_store rendezvous
+    "test_hapi_vision.py::test_model_prepare_amp_o1_and_o2",       # 24
+    "test_llama.py::test_parallel_llama_matches_serial",           # 24
+    "test_multiproc.py::test_two_process_collectives",             # 20
+    "test_generation.py::test_generation_respects_max_seq_len",    # 17
+    "test_generation.py::test_repetition_penalty_breaks_loops",    # 15
+    "test_static_inference.py::test_int8_baked_export_ptq_gpt_block",  # 15
+    "test_hapi_vision.py::test_early_stopping",                    # 15
+    "test_property_ops.py::test_elementwise_grads_sum_rule",       # 14
+    "test_property_ops.py::test_manipulation_round_trips",         # 11
+    "test_book.py::test_word2vec_book",                            # 13
+    "test_nn.py::test_grid_sample",                                # 12
 }
 
 

@@ -241,7 +241,9 @@ def test_ssd_table_compaction_preserves_state(tmp_path):
     for _ in range(6):            # churn: many abandoned records
         t.push(ids, np.ones((16, 4), np.float32))
     t.compact()
-    assert t._dead_bytes == 0 and t._end == len(t._index) * t._rec_bytes
+    from paddle_tpu.distributed.ps import _SB
+    assert t._dead_bytes == 0 and \
+        t._end == _SB.size + len(t._index) * t._rec_total
     np.testing.assert_allclose(t.pull(ids), base - 6.0, rtol=1e-6)
     t.close()
 
@@ -333,3 +335,141 @@ def test_ssd_table_default_path_and_clean_eviction(tmp_path):
     import os
     t.close()
     os.unlink(t.path)
+
+
+def test_ssd_table_reopen_rebuilds_index(tmp_path):
+    """The cold log is self-describing ([magic,key,crc] headers): a fresh
+    process reopening the path rebuilds the {id -> offset} index by
+    scanning, later records winning (reference: rocksdb recovery in
+    ssd_sparse_table.cc)."""
+    from paddle_tpu.distributed.ps import SSDSparseTable
+    path = str(tmp_path / "t.bin")
+    t = SSDSparseTable(4, lr=1.0, cache_rows=4, path=path,
+                       initializer=lambda: np.zeros(4, np.float32))
+    ids = list(range(12))
+    t.pull(ids)
+    t.push(ids, np.ones((12, 4), np.float32))     # rows -> -1
+    t.flush()
+    t.close()
+
+    t2 = SSDSparseTable(4, lr=1.0, cache_rows=4, path=path,
+                        initializer=lambda: np.zeros(4, np.float32))
+    np.testing.assert_allclose(t2.pull(ids), -np.ones((12, 4)))
+    t2.close()
+
+
+def test_ssd_table_truncates_torn_tail(tmp_path):
+    """A crash mid-record-write leaves a torn tail; recovery must stop at
+    the first bad magic/crc and truncate, keeping every complete
+    record."""
+    from paddle_tpu.distributed.ps import SSDSparseTable
+    path = str(tmp_path / "t.bin")
+    t = SSDSparseTable(4, lr=1.0, cache_rows=2, path=path, wal=False,
+                       initializer=lambda: np.zeros(4, np.float32))
+    ids = list(range(6))
+    t.pull(ids)
+    t.push(ids, np.ones((6, 4), np.float32))
+    t.flush()
+    t.close()
+    # simulate the torn write: append half a record of garbage
+    with open(path, "ab") as f:
+        f.write(b"PTS2" + b"\x00" * 10)
+
+    t2 = SSDSparseTable(4, lr=1.0, cache_rows=2, path=path, wal=False,
+                        initializer=lambda: np.zeros(4, np.float32))
+    np.testing.assert_allclose(t2.pull(ids), -np.ones((6, 4)))
+    from paddle_tpu.distributed.ps import _SB
+    assert (t2._end - _SB.size) % t2._rec_total == 0
+    t2.close()
+
+
+def test_ssd_table_kill_during_push_recovers_acked(tmp_path):
+    """VERDICT r04 item 7: SIGKILL a worker mid-push-storm; every push it
+    ACKNOWLEDGED (reported on stdout) must survive via WAL replay.  Row k
+    is pushed +1 per acknowledged round with lr=1, so after recovery
+    row k == -(acked rounds)."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    path = str(tmp_path / "t.bin")
+    code = f"""
+import sys
+import numpy as np
+from paddle_tpu.distributed.ps import SSDSparseTable
+t = SSDSparseTable(4, lr=1.0, cache_rows=8, path={path!r},
+                   initializer=lambda: np.zeros(4, np.float32))
+ids = list(range(32))
+t.pull(ids)
+for round_i in range(10000):
+    t.push(ids, np.ones((32, 4), np.float32))
+    print(round_i + 1, flush=True)     # ack AFTER the push returned
+"""
+    env = dict(__import__("os").environ,
+               JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE, env=env, text=True)
+    acked = 0
+    deadline = time.time() + 120
+    while acked < 25 and time.time() < deadline:
+        line = p.stdout.readline()
+        if line.strip().isdigit():
+            acked = int(line.strip())
+    p.send_signal(signal.SIGKILL)
+    p.wait()
+    # drain anything acked between the last read and the kill
+    for line in p.stdout.read().splitlines():
+        if line.strip().isdigit():
+            acked = max(acked, int(line.strip()))
+    assert acked >= 25
+
+    from paddle_tpu.distributed.ps import SSDSparseTable
+    t = SSDSparseTable(4, lr=1.0, cache_rows=8, path=path,
+                       initializer=lambda: np.zeros(4, np.float32))
+    rows = t.pull(list(range(32)))
+    # every acknowledged round recovered; at most one un-acked round
+    # (in flight at the kill) beyond
+    assert np.all(rows <= -acked + 1e-5), rows.max()
+    assert np.all(rows >= -(acked + 1) - 1e-5), rows.min()
+    t.close()
+
+
+def test_ssd_table_geometry_mismatch_errors(tmp_path):
+    """Reopening with a different dim/optimizer must ERROR (superblock
+    guard), not silently truncate the log to zero."""
+    import pytest
+    from paddle_tpu.distributed.ps import SSDSparseTable
+    path = str(tmp_path / "t.bin")
+    t = SSDSparseTable(4, lr=1.0, cache_rows=2, path=path)
+    t.pull([1, 2, 3])
+    t.flush()
+    t.close()
+    with pytest.raises(ValueError, match="geometry mismatch"):
+        SSDSparseTable(8, lr=1.0, cache_rows=2, path=path)
+    with pytest.raises(ValueError, match="geometry mismatch"):
+        SSDSparseTable(4, lr=1.0, optimizer="adagrad", cache_rows=2,
+                       path=path)
+
+
+def test_ssd_table_wal_false_with_pending_wal_errors(tmp_path):
+    """wal=False on a path whose WAL holds unflushed acknowledged updates
+    must refuse: skipping replay would drop them now and replay stale
+    entries over newer state later."""
+    import pytest
+    from paddle_tpu.distributed.ps import SSDSparseTable
+    path = str(tmp_path / "t.bin")
+    t = SSDSparseTable(4, lr=1.0, cache_rows=8, path=path,
+                       initializer=lambda: np.zeros(4, np.float32))
+    t.pull([1, 2])
+    t.push([1, 2], np.ones((2, 4), np.float32))
+    # simulate crash: close file handles WITHOUT flush
+    t._file.close()
+    t._wal.close()
+    with pytest.raises(ValueError, match="write-ahead log"):
+        SSDSparseTable(4, lr=1.0, cache_rows=8, path=path, wal=False)
+    # wal=True recovers it
+    t2 = SSDSparseTable(4, lr=1.0, cache_rows=8, path=path,
+                        initializer=lambda: np.zeros(4, np.float32))
+    np.testing.assert_allclose(t2.pull([1, 2]), -np.ones((2, 4)))
+    t2.close()
