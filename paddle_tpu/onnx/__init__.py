@@ -1,16 +1,21 @@
 """ONNX export surface (reference: python/paddle/onnx/export.py — a shim
 delegating to the external `paddle2onnx` converter).
 
-Documented decision: this image has no `onnx` package and no
-paddle2onnx analog, and the TPU-native serialized interchange format is
-**StableHLO** (an MLIR dialect with stability guarantees — the role ONNX
-plays for the reference).  `paddle.onnx.export` therefore exports the
-traced program as a portable StableHLO bundle (`<path>.pdmodel` +
-`<path>.pdiparams`, loadable by `paddle_tpu.inference.Predictor` on any
-machine with XLA) and
-raises a clear error if a literal `.onnx` protobuf is demanded.  If an
-`onnx` package is present at runtime, a minimal converter could be
-registered via `register_converter` — the hook is the public seam.
+Two real formats:
+
+- `<path>.onnx` — ACTUAL ONNX protobuf, emitted natively (emit.py): the
+  public schema subset is transcribed in onnx_subset.proto (field
+  numbers match upstream), compiled with protoc, and the layer's traced
+  jaxpr maps primitive-by-primitive onto ONNX ops (Einsum for
+  dot_general, Conv, elementwise, reductions, Gather for embedding
+  lookups, ...).  No `onnx` wheel is needed to WRITE files; any
+  conforming ONNX runtime can read them.
+- any other path — a portable StableHLO bundle (`<path>.pdmodel` +
+  `<path>.pdiparams`, loadable by `paddle_tpu.inference.Predictor`),
+  the TPU-native interchange format.
+
+`register_converter` overrides the built-in emitter (e.g. to use a real
+paddle2onnx-class converter when one is installed).
 """
 from __future__ import annotations
 
@@ -18,28 +23,25 @@ _CONVERTER = None
 
 
 def register_converter(fn):
-    """Install an actual ONNX converter: fn(layer, path, input_spec)."""
+    """Install a replacement ONNX converter: fn(layer, path, input_spec)."""
     global _CONVERTER
     _CONVERTER = fn
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
+def export(layer, path, input_spec=None, opset_version=17, **configs):
     """Export `layer` for interchange (reference: onnx/export.py:export).
 
-    Produces `<path>.pdmodel` (serialized StableHLO) + `<path>.pdiparams`,
-    loadable by `paddle_tpu.inference.Predictor`.  A registered converter
-    (see `register_converter`) is used instead when present."""
+    `.onnx` paths get real ONNX protobuf via the native emitter; other
+    paths get a StableHLO bundle.  A registered converter (see
+    `register_converter`) takes precedence."""
     if _CONVERTER is not None:
         return _CONVERTER(layer, path, input_spec=input_spec,
                           opset_version=opset_version, **configs)
-    if str(path).endswith(".onnx"):
-        raise NotImplementedError(
-            "No ONNX converter is registered (the `onnx` package is not "
-            "available). This framework's portable interchange format is "
-            "StableHLO — pass a path without the .onnx suffix to export "
-            "a StableHLO bundle, or register_converter() an ONNX "
-            "backend.")
-    from ..static import save_inference_model
     if input_spec is None:
         raise ValueError("input_spec is required")
+    if str(path).endswith(".onnx"):
+        from .emit import export_onnx
+        return export_onnx(layer, path, input_spec,
+                           opset_version=opset_version)
+    from ..static import save_inference_model
     return save_inference_model(str(path), input_spec, [], layer=layer)

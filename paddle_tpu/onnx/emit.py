@@ -1,0 +1,454 @@
+"""Native ONNX protobuf emission (reference: python/paddle/onnx/export.py,
+which shims out to the external paddle2onnx converter).
+
+No `onnx` wheel exists in this image, but ONNX is just protobuf: the
+public schema subset is transcribed in `onnx_subset.proto` (field numbers
+match upstream exactly) and compiled with protoc, so the bytes written
+here parse with any conforming ONNX implementation.
+
+The exporter traces the layer's inference function to a jaxpr (the same
+IR the static Program builds on) and maps primitives to ONNX ops —
+`dot_general` becomes `Einsum` (covering linear layers and attention's
+batched matmuls), `conv_general_dilated` becomes `Conv`, elementwise and
+reduction primitives map one-to-one, and composite layers (softmax,
+layernorm, gelu) export as their decompositions.  Parameters become
+named graph initializers.
+"""
+from __future__ import annotations
+
+import string
+
+import numpy as np
+
+from . import onnx_subset_pb2 as pb
+
+_DTYPE = {
+    "float32": pb.TensorProto.FLOAT,
+    "float64": pb.TensorProto.DOUBLE,
+    "float16": pb.TensorProto.FLOAT16,
+    "bfloat16": pb.TensorProto.BFLOAT16,
+    "int64": pb.TensorProto.INT64,
+    "int32": pb.TensorProto.INT32,
+    "int8": pb.TensorProto.INT8,
+    "uint8": pb.TensorProto.UINT8,
+    "bool": pb.TensorProto.BOOL,
+}
+
+
+class UnsupportedOp(NotImplementedError):
+    pass
+
+
+def _tensor_proto(name, arr):
+    arr = np.asarray(arr)
+    t = pb.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    dt = _DTYPE.get(str(arr.dtype))
+    if dt is None:
+        raise UnsupportedOp(f"dtype {arr.dtype} has no ONNX mapping")
+    t.data_type = dt
+    if str(arr.dtype) == "bfloat16":
+        # bfloat16 raw_data is the 2-byte truncation of float32
+        arr = arr.astype(np.float32)
+        raw = arr.tobytes()
+        t.raw_data = b"".join(raw[i + 2:i + 4]
+                              for i in range(0, len(raw), 4))
+    else:
+        t.raw_data = np.ascontiguousarray(arr).tobytes()
+    return t
+
+
+class _Emitter:
+    def __init__(self, graph_name):
+        self.g = pb.GraphProto()
+        self.g.name = graph_name
+        self._n = 0
+        self._names = {}        # id(jaxpr var) -> onnx value name
+
+    def fresh(self, hint="v"):
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def name_of(self, v):
+        """ONNX value name for a jaxpr atom; literals become
+        initializers."""
+        if hasattr(v, "val"):          # Literal
+            n = self.fresh("const")
+            self.g.initializer.append(_tensor_proto(n, v.val))
+            return n
+        key = id(v)
+        if key not in self._names:
+            self._names[key] = self.fresh("t")
+        return self._names[key]
+
+    def bind(self, v, name):
+        self._names[id(v)] = name
+
+    def const(self, arr, hint="const"):
+        n = self.fresh(hint)
+        self.g.initializer.append(_tensor_proto(n, np.asarray(arr)))
+        return n
+
+    def node(self, op_type, inputs, n_out=1, outputs=None, **attrs):
+        node = self.g.node.add()
+        node.op_type = op_type
+        node.name = self.fresh(op_type)
+        node.input.extend(inputs)
+        outs = outputs or [self.fresh(op_type.lower())
+                           for _ in range(n_out)]
+        node.output.extend(outs)
+        for k, v in attrs.items():
+            a = node.attribute.add()
+            a.name = k
+            if isinstance(v, str):
+                a.type = pb.AttributeProto.STRING
+                a.s = v.encode()
+            elif isinstance(v, float):
+                a.type = pb.AttributeProto.FLOAT
+                a.f = v
+            elif isinstance(v, (bool, int, np.integer)):
+                a.type = pb.AttributeProto.INT
+                a.i = int(v)
+            elif isinstance(v, (list, tuple)) and all(
+                    isinstance(x, (int, np.integer)) for x in v):
+                a.type = pb.AttributeProto.INTS
+                a.ints.extend(int(x) for x in v)
+            elif isinstance(v, (list, tuple)):
+                a.type = pb.AttributeProto.FLOATS
+                a.floats.extend(float(x) for x in v)
+            else:
+                raise UnsupportedOp(f"attribute {k}={v!r}")
+        return outs if (n_out > 1 or outputs) else outs[0]
+
+
+_ELEMENTWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow", "neg": "Neg",
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+    "sqrt": "Sqrt", "abs": "Abs", "erf": "Erf", "sign": "Sign",
+    "floor": "Floor", "ceil": "Ceil", "round": "Round", "sin": "Sin",
+    "cos": "Cos", "not": "Not", "and": "And", "or": "Or",
+}
+
+_COMPARE = {"eq": "Equal", "lt": "Less", "le": "LessOrEqual",
+            "gt": "Greater", "ge": "GreaterOrEqual"}
+
+# reductions whose axes moved from attribute to input at opset 13/18 —
+# at opset 17, ReduceSum takes axes as an input, the others as attribute
+_REDUCE_ATTR = {"reduce_max": "ReduceMax", "reduce_min": "ReduceMin",
+                "reduce_prod": "ReduceProd"}
+
+
+def _einsum_equation(dnums, lhs_ndim, rhs_ndim):
+    """dot_general dimension_numbers -> an einsum equation string."""
+    (lc, rc), (lb, rb) = dnums
+    letters = iter(string.ascii_lowercase)
+    lhs = [None] * lhs_ndim
+    rhs = [None] * rhs_ndim
+    for i, j in zip(lb, rb):
+        ch = next(letters)
+        lhs[i] = rhs[j] = ch
+    for i, j in zip(lc, rc):
+        ch = next(letters)
+        lhs[i] = rhs[j] = ch
+    for i in range(lhs_ndim):
+        if lhs[i] is None:
+            lhs[i] = next(letters)
+    for j in range(rhs_ndim):
+        if rhs[j] is None:
+            rhs[j] = next(letters)
+    out = ([lhs[i] for i in lb]
+           + [lhs[i] for i in range(lhs_ndim)
+              if i not in lb and i not in lc]
+           + [rhs[j] for j in range(rhs_ndim)
+              if j not in rb and j not in rc])
+    return f"{''.join(lhs)},{''.join(rhs)}->{''.join(out)}"
+
+
+def _emit_eqn(em, eqn):
+    p = eqn.primitive.name
+    ins = [em.name_of(v) for v in eqn.invars]
+    params = eqn.params
+
+    def out(name):
+        em.bind(eqn.outvars[0], name)
+
+    if p in _ELEMENTWISE:
+        out(em.node(_ELEMENTWISE[p], ins))
+    elif p == "rem":
+        # lax.rem is C fmod (sign follows the dividend) = ONNX Mod
+        # fmod=1; the default fmod=0 is python-style AND int-only
+        out(em.node("Mod", ins, fmod=1))
+    elif p in _COMPARE:
+        out(em.node(_COMPARE[p], ins))
+    elif p == "square":
+        out(em.node("Mul", [ins[0], ins[0]]))
+    elif p == "expm1":
+        one = em.const(np.ones((), eqn.invars[0].aval.dtype))
+        out(em.node("Sub", [em.node("Exp", ins), one]))
+    elif p == "log1p":
+        one = em.const(np.ones((), eqn.invars[0].aval.dtype))
+        out(em.node("Log", [em.node("Add", [ins[0], one])]))
+    elif p == "integer_pow":
+        y = em.const(np.array(params["y"], np.float32))
+        out(em.node("Pow", [ins[0], y]))
+    elif p == "rsqrt":
+        out(em.node("Reciprocal", [em.node("Sqrt", ins)]))
+    elif p == "is_finite":
+        inf = em.node("IsInf", ins)
+        nan = em.node("IsNaN", ins)
+        out(em.node("Not", [em.node("Or", [inf, nan])]))
+    elif p == "dot_general":
+        eq = _einsum_equation(params["dimension_numbers"],
+                              eqn.invars[0].aval.ndim,
+                              eqn.invars[1].aval.ndim)
+        out(em.node("Einsum", ins, equation=eq))
+    elif p == "conv_general_dilated":
+        dn = params["dimension_numbers"]
+        if (dn.lhs_spec[:2] != (0, 1) or dn.rhs_spec[:2] != (0, 1)
+                or dn.out_spec[:2] != (0, 1)):
+            raise UnsupportedOp(
+                f"conv layout {dn} (only NC-major supported)")
+        if any(d != 1 for d in params["lhs_dilation"]):
+            raise UnsupportedOp(
+                "input-dilated (transposed) conv has no plain Conv "
+                "mapping")
+        if params.get("batch_group_count", 1) != 1:
+            raise UnsupportedOp("batch_group_count != 1")
+        pads = params["padding"]
+        out(em.node(
+            "Conv", ins,
+            strides=list(params["window_strides"]),
+            pads=[lo for lo, _ in pads] + [hi for _, hi in pads],
+            dilations=list(params["rhs_dilation"]),
+            group=int(params["feature_group_count"])))
+    elif p == "reshape":
+        shape = em.const(np.array(params["new_sizes"], np.int64),
+                         "shape")
+        out(em.node("Reshape", [ins[0], shape]))
+    elif p == "transpose":
+        out(em.node("Transpose", ins,
+                    perm=list(params["permutation"])))
+    elif p == "broadcast_in_dim":
+        tgt = params["shape"]
+        bdims = params["broadcast_dimensions"]
+        interim = [1] * len(tgt)
+        for src_ax, dst_ax in enumerate(bdims):
+            interim[dst_ax] = eqn.invars[0].aval.shape[src_ax]
+        shaped = ins[0]
+        if tuple(interim) != tuple(eqn.invars[0].aval.shape):
+            shape = em.const(np.array(interim, np.int64), "shape")
+            shaped = em.node("Reshape", [ins[0], shape])
+        tgt_c = em.const(np.array(tgt, np.int64), "shape")
+        out(em.node("Expand", [shaped, tgt_c]))
+    elif p == "reduce_sum":
+        axes = em.const(np.array(params["axes"], np.int64), "axes")
+        out(em.node("ReduceSum", [ins[0], axes], keepdims=0))
+    elif p in _REDUCE_ATTR:
+        out(em.node(_REDUCE_ATTR[p], ins,
+                    axes=list(params["axes"]), keepdims=0))
+    elif p in ("argmax", "argmin"):
+        axes = params["axes"]
+        if len(axes) != 1:
+            raise UnsupportedOp(f"{p} over {axes}")
+        r = em.node("ArgMax" if p == "argmax" else "ArgMin", ins,
+                    axis=int(axes[0]), keepdims=0)
+        out(em.node("Cast", [r],
+                    to=_DTYPE[str(np.dtype(params["index_dtype"]))]))
+    elif p == "select_n":
+        if len(ins) != 3:
+            raise UnsupportedOp("select_n with >2 cases")
+        # select_n(c, x, y) picks x when c==0 — ONNX Where picks X when
+        # the condition is TRUE, so the cases swap
+        out(em.node("Where", [ins[0], ins[2], ins[1]]))
+    elif p == "convert_element_type":
+        dt = _DTYPE.get(str(np.dtype(params["new_dtype"])))
+        if dt is None:
+            raise UnsupportedOp(f"cast to {params['new_dtype']}")
+        out(em.node("Cast", ins, to=dt))
+    elif p in ("stop_gradient", "copy"):
+        out(em.node("Identity", ins))
+    elif p == "concatenate":
+        out(em.node("Concat", ins, axis=int(params["dimension"])))
+    elif p == "slice":
+        starts = em.const(np.array(params["start_indices"], np.int64))
+        ends = em.const(np.array(params["limit_indices"], np.int64))
+        axes = em.const(np.arange(len(params["start_indices"]),
+                                  dtype=np.int64))
+        strides = params["strides"] or \
+            [1] * len(params["start_indices"])
+        steps = em.const(np.array(strides, np.int64))
+        out(em.node("Slice", [ins[0], starts, ends, axes, steps]))
+    elif p == "rev":
+        # Slice with negative steps reverses the listed axes
+        dims = list(params["dimensions"])
+        starts = em.const(np.array([-1] * len(dims), np.int64))
+        ends = em.const(np.array([np.iinfo(np.int64).min + 1]
+                                 * len(dims), np.int64))
+        axes = em.const(np.array(dims, np.int64))
+        steps = em.const(np.array([-1] * len(dims), np.int64))
+        out(em.node("Slice", [ins[0], starts, ends, axes, steps]))
+    elif p == "pad":
+        lo_hi = params["padding_config"]
+        if any(interior for _, _, interior in lo_hi):
+            raise UnsupportedOp("interior (dilated) pad")
+        pads = em.const(np.array([lo for lo, _, _ in lo_hi]
+                                 + [hi for _, hi, _ in lo_hi], np.int64))
+        out(em.node("Pad", [ins[0], pads, ins[1]], mode="constant"))
+    elif p == "iota":
+        # static shape: bake the index ramp as an initializer
+        shape, dim = params["shape"], params["dimension"]
+        vec = np.arange(shape[dim], dtype=params["dtype"])
+        full = np.broadcast_to(
+            np.expand_dims(vec, tuple(i for i in range(len(shape))
+                                      if i != dim)), shape)
+        out(em.const(np.ascontiguousarray(full), "iota"))
+    elif p == "gather":
+        _emit_gather(em, eqn, ins, out)
+    elif p == "squeeze":
+        shape = em.const(
+            np.array(eqn.outvars[0].aval.shape, np.int64), "shape")
+        out(em.node("Reshape", [ins[0], shape]))
+    elif p in ("pjit", "jit", "closed_call", "custom_jvp_call",
+               "custom_vjp_call", "custom_jvp_call_jaxpr",
+               "remat", "checkpoint"):
+        inner = params.get("jaxpr") or params.get("call_jaxpr")
+        if inner is None:
+            raise UnsupportedOp(f"{p} without an inlinable jaxpr")
+        _inline(em, inner, eqn.invars, eqn.outvars)
+    else:
+        raise UnsupportedOp(
+            f"jaxpr primitive {p!r} has no ONNX mapping yet "
+            f"(params: {sorted(params)})")
+
+
+def _emit_gather(em, eqn, ins, out):
+    """Narrow gather support: the jnp.take/embedding-lookup pattern
+    (gather along one leading axis with full trailing slices) maps to
+    ONNX Gather."""
+    d = eqn.params["dimension_numbers"]
+    operand = eqn.invars[0].aval
+    slice_sizes = eqn.params["slice_sizes"]
+    collapsed = tuple(d.collapsed_slice_dims)
+    if (len(d.start_index_map) == 1
+            and collapsed == (d.start_index_map[0],)
+            and all(slice_sizes[i] == operand.shape[i]
+                    for i in range(operand.ndim) if i not in collapsed)
+            and slice_sizes[collapsed[0]] == 1):
+        axis = d.start_index_map[0]
+        idx = ins[1]
+        # jaxpr gather indices carry a trailing index-vector dim of 1
+        idx_aval = eqn.invars[1].aval
+        if idx_aval.ndim and idx_aval.shape[-1] == 1:
+            shape = em.const(
+                np.array(idx_aval.shape[:-1], np.int64), "shape")
+            idx = em.node("Reshape", [idx, shape])
+        out(em.node("Gather", [ins[0], idx], axis=axis))
+    else:
+        raise UnsupportedOp(
+            f"general gather {d} (only take-along-leading-axis exports)")
+
+
+def _inline(em, closed, invars, outvars):
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    consts = getattr(closed, "consts", [])
+    for cv, c in zip(jaxpr.constvars, consts):
+        em.bind(cv, em.const(np.asarray(c), "const"))
+    for iv, outer in zip(jaxpr.invars, invars):
+        em.bind(iv, em.name_of(outer))
+    for eqn in jaxpr.eqns:
+        _emit_eqn(em, eqn)
+    for ov, outer in zip(jaxpr.outvars, outvars):
+        em.bind(outer, em.name_of(ov))
+
+
+def export_onnx(layer, path, input_spec, opset_version=17):
+    """Serialize `layer`'s inference computation as a real `.onnx` file.
+
+    Returns the path written.  Raises UnsupportedOp when the traced
+    program contains a primitive outside the exported subset."""
+    import jax
+
+    from ..core.tensor import Tensor
+    from ..core import state as _state
+
+    if opset_version < 13:
+        raise ValueError(
+            f"opset_version={opset_version} is below what the emitted "
+            "ops require (Einsum needs >=12, axes-as-input ReduceSum "
+            ">=13) — pass opset_version>=13")
+
+    if hasattr(layer, "eval"):
+        layer.eval()
+    named = sorted(layer.state_dict().items()) \
+        if hasattr(layer, "state_dict") else []
+    param_tensors = [t for _, t in named]
+
+    def pure(params, *xs):
+        saved = [t._data_ for t in param_tensors]
+        for t, a in zip(param_tensors, params):
+            t._data_ = a
+        try:
+            with _state.no_grad():
+                o = layer(*[Tensor(x) for x in xs])
+        finally:
+            for t, a in zip(param_tensors, saved):
+                t._data_ = a
+        return tuple(x._data_ for x in
+                     (o if isinstance(o, (tuple, list)) else (o,)))
+
+    from ..core.dtype import convert_dtype
+    x_structs = [jax.ShapeDtypeStruct(tuple(s.shape),
+                                      convert_dtype(s.dtype))
+                 for s in input_spec]
+    p_arrays = [np.asarray(t._data_) for t in param_tensors]
+    closed = jax.make_jaxpr(pure)(p_arrays, *x_structs)
+
+    em = _Emitter(getattr(layer, "__class__", type(layer)).__name__)
+    jaxpr = closed.jaxpr
+    # params (the leading invars) become named initializers
+    n_params = len(p_arrays)
+    for (pname, _), var, arr in zip(named, jaxpr.invars, p_arrays):
+        em.bind(var, pname)
+        em.g.initializer.append(_tensor_proto(pname, arr))
+    for cv, c in zip(jaxpr.constvars, closed.consts):
+        em.bind(cv, em.const(np.asarray(c)))
+    # graph inputs
+    for spec, var in zip(input_spec, jaxpr.invars[n_params:]):
+        vi = em.g.input.add()
+        vi.name = spec.name or em.fresh("x")
+        em.bind(var, vi.name)
+        tt = vi.type.tensor_type
+        tt.elem_type = _DTYPE[str(np.dtype(convert_dtype(spec.dtype)))]
+        for axis, dshape in enumerate(spec.shape):
+            d = tt.shape.dim.add()
+            if dshape is None or (isinstance(dshape, int) and dshape < 0):
+                # unique per dim: identical dim_param names would assert
+                # equal runtime values across independent dynamic dims
+                d.dim_param = f"dyn_{vi.name}_{axis}"
+            else:
+                d.dim_value = int(dshape)
+    for eqn in jaxpr.eqns:
+        _emit_eqn(em, eqn)
+    for i, ov in enumerate(jaxpr.outvars):
+        vi = em.g.output.add()
+        vi.name = em.name_of(ov)
+        tt = vi.type.tensor_type
+        tt.elem_type = _DTYPE.get(str(ov.aval.dtype), 0)
+        for dshape in ov.aval.shape:
+            tt.shape.dim.add().dim_value = int(dshape)
+
+    model = pb.ModelProto()
+    model.ir_version = 8
+    model.producer_name = "paddle_tpu"
+    op = model.opset_import.add()
+    op.domain = ""
+    op.version = opset_version
+    model.graph.CopyFrom(em.g)
+    path = str(path)
+    if not path.endswith(".onnx"):
+        path += ".onnx"
+    with open(path, "wb") as f:
+        f.write(model.SerializeToString())
+    return path

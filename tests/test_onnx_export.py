@@ -1,0 +1,254 @@
+"""Native ONNX protobuf emission (VERDICT r04 item 9; reference:
+python/paddle/onnx/export.py).
+
+No `onnx` wheel exists in this image, so verification is two-fold:
+parse-back through the transcribed schema (structural round-trip of
+real protobuf bytes), and NUMERICAL execution of the emitted graph by a
+mini-evaluator that interprets only what the file says (op types,
+attributes, initializers) — wrong einsum equations, perms, pads, or
+axes fail the comparison against the layer's own forward."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.onnx import onnx_subset_pb2 as pb
+
+
+def _attr(node, name, default=None):
+    for a in node.attribute:
+        if a.name == name:
+            if a.type == pb.AttributeProto.INT:
+                return a.i
+            if a.type == pb.AttributeProto.FLOAT:
+                return a.f
+            if a.type == pb.AttributeProto.STRING:
+                return a.s.decode()
+            if a.type == pb.AttributeProto.INTS:
+                return list(a.ints)
+            if a.type == pb.AttributeProto.FLOATS:
+                return list(a.floats)
+    return default
+
+
+_NP_DTYPE = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
+             11: np.float64, 10: np.float16, 3: np.int8, 2: np.uint8}
+
+
+def _init_value(t):
+    arr = np.frombuffer(t.raw_data, _NP_DTYPE[t.data_type])
+    return arr.reshape(list(t.dims)).copy()
+
+
+def _run_graph(g, feeds):
+    """Execute a GraphProto with numpy (jax only for erf/conv)."""
+    import jax
+    import jax.numpy as jnp
+
+    env = dict(feeds)
+    for t in g.initializer:
+        env[t.name] = _init_value(t)
+
+    def f(n, i=0):
+        return env[n.input[i]]
+
+    for n in g.node:
+        op = n.op_type
+        if op == "Einsum":
+            r = np.einsum(_attr(n, "equation"), f(n), f(n, 1))
+        elif op in ("Add", "Sub", "Mul", "Div", "Pow"):
+            fn = {"Add": np.add, "Sub": np.subtract,
+                  "Mul": np.multiply, "Div": np.divide,
+                  "Pow": np.power}[op]
+            r = fn(f(n), f(n, 1))
+        elif op in ("Equal", "Less", "LessOrEqual", "Greater",
+                    "GreaterOrEqual"):
+            fn = {"Equal": np.equal, "Less": np.less,
+                  "LessOrEqual": np.less_equal, "Greater": np.greater,
+                  "GreaterOrEqual": np.greater_equal}[op]
+            r = fn(f(n), f(n, 1))
+        elif op in ("Max", "Min"):
+            fn = np.maximum if op == "Max" else np.minimum
+            r = f(n)
+            for i in range(1, len(n.input)):
+                r = fn(r, f(n, i))
+        elif op in ("Neg", "Exp", "Log", "Tanh", "Sqrt", "Abs",
+                    "Reciprocal", "Sigmoid", "Erf"):
+            x = f(n)
+            r = {"Neg": lambda v: -v, "Exp": np.exp, "Log": np.log,
+                 "Tanh": np.tanh, "Sqrt": np.sqrt, "Abs": np.abs,
+                 "Reciprocal": lambda v: 1.0 / v,
+                 "Sigmoid": lambda v: 1 / (1 + np.exp(-v)),
+                 "Erf": lambda v: np.asarray(
+                     jax.scipy.special.erf(jnp.asarray(v)))}[op](x)
+        elif op == "ReduceSum":
+            r = np.sum(f(n), axis=tuple(f(n, 1).tolist()),
+                       keepdims=bool(_attr(n, "keepdims", 1)))
+        elif op in ("ReduceMax", "ReduceMin", "ReduceProd"):
+            fn = {"ReduceMax": np.max, "ReduceMin": np.min,
+                  "ReduceProd": np.prod}[op]
+            r = fn(f(n), axis=tuple(_attr(n, "axes")),
+                   keepdims=bool(_attr(n, "keepdims", 1)))
+        elif op == "Reshape":
+            r = f(n).reshape(f(n, 1).tolist())
+        elif op == "Expand":
+            r = np.broadcast_to(f(n), f(n, 1).tolist()).copy()
+        elif op == "Transpose":
+            r = np.transpose(f(n), _attr(n, "perm"))
+        elif op == "Identity":
+            r = f(n)
+        elif op == "Cast":
+            r = f(n).astype(_NP_DTYPE[_attr(n, "to")])
+        elif op == "Where":
+            r = np.where(f(n), f(n, 1), f(n, 2))
+        elif op == "Concat":
+            r = np.concatenate([f(n, i) for i in range(len(n.input))],
+                               axis=_attr(n, "axis"))
+        elif op == "Gather":
+            r = np.take(f(n), f(n, 1), axis=_attr(n, "axis", 0))
+        elif op == "Slice":
+            starts, ends = f(n, 1).tolist(), f(n, 2).tolist()
+            axes, steps = f(n, 3).tolist(), f(n, 4).tolist()
+            sl = [slice(None)] * f(n).ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                sl[ax] = slice(s, e if abs(e) < 2**62 else None, st)
+            r = f(n)[tuple(sl)]
+        elif op == "Conv":
+            pads = _attr(n, "pads")
+            k = len(pads) // 2
+            r = np.asarray(jax.lax.conv_general_dilated(
+                jnp.asarray(f(n)), jnp.asarray(f(n, 1)),
+                window_strides=_attr(n, "strides"),
+                padding=list(zip(pads[:k], pads[k:])),
+                rhs_dilation=_attr(n, "dilations"),
+                feature_group_count=_attr(n, "group", 1)))
+            if len(n.input) > 2:
+                b = f(n, 2).reshape((1, -1) + (1,) * k)
+                r = r + b
+        elif op == "Pad":
+            pads = f(n, 1).tolist()
+            k = len(pads) // 2
+            cval = f(n, 2) if len(n.input) > 2 else 0.0
+            r = np.pad(f(n), list(zip(pads[:k], pads[k:])),
+                       constant_values=float(np.asarray(cval)))
+        else:
+            raise AssertionError(f"evaluator has no {op}")
+        for o in n.output:
+            env[o] = r
+    return [env[o.name] for o in g.output]
+
+
+def _export_and_run(layer, spec, feeds, path):
+    p = paddle.onnx.export(layer, path, input_spec=spec)
+    m = pb.ModelProto()
+    with open(p, "rb") as fh:
+        m.ParseFromString(fh.read())
+    assert m.ir_version == 8 and m.opset_import[0].version == 17
+    return m, _run_graph(m.graph, feeds)
+
+
+def test_onnx_mlp_round_trip(tmp_path):
+    paddle.seed(0)
+    mlp = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                        nn.Softmax(axis=-1))
+    spec = [paddle.jit.InputSpec([2, 8], "float32", name="x")]
+    x = np.random.default_rng(0).standard_normal((2, 8)).astype(np.float32)
+
+    m, outs = _export_and_run(mlp, spec, {"x": x},
+                              str(tmp_path / "mlp.onnx"))
+    ref = mlp(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+    # weights are NAMED initializers carrying the exact values
+    inits = {t.name: t for t in m.graph.initializer}
+    assert "0.weight" in inits and "2.bias" in inits
+    np.testing.assert_array_equal(
+        _init_value(inits["0.weight"]),
+        mlp[0].weight.numpy())
+    assert any(n.op_type == "Einsum" for n in m.graph.node)
+
+
+def test_onnx_conv_bn_round_trip(tmp_path):
+    paddle.seed(1)
+    model = nn.Sequential(nn.Conv2D(3, 8, 3, stride=2, padding=1),
+                          nn.BatchNorm2D(8), nn.ReLU())
+    model.eval()
+    spec = [paddle.jit.InputSpec([1, 3, 8, 8], "float32", name="img")]
+    x = np.random.default_rng(1).standard_normal(
+        (1, 3, 8, 8)).astype(np.float32)
+
+    m, outs = _export_and_run(model, spec, {"img": x},
+                              str(tmp_path / "conv.onnx"))
+    ref = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-4, atol=1e-5)
+    conv = next(n for n in m.graph.node if n.op_type == "Conv")
+    assert _attr(conv, "strides") == [2, 2]
+    assert _attr(conv, "pads") == [1, 1, 1, 1]
+
+
+def test_onnx_embedding_attention_round_trip(tmp_path):
+    paddle.seed(2)
+
+    class Tiny(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(32, 16)
+            self.norm = nn.LayerNorm(16)
+            self.attn = nn.MultiHeadAttention(16, 4)
+            self.head = nn.Linear(16, 8)
+
+        def forward(self, ids):
+            h = self.norm(self.emb(ids))
+            h = self.attn(h, h, h)
+            return self.head(h.mean(axis=1))
+
+    model = Tiny()
+    model.eval()
+    spec = [paddle.jit.InputSpec([2, 6], "int32", name="ids")]
+    ids = np.random.default_rng(2).integers(0, 32, (2, 6), dtype=np.int32)
+
+    m, outs = _export_and_run(model, spec, {"ids": ids},
+                              str(tmp_path / "attn.onnx"))
+    ref = model(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-4, atol=1e-5)
+    ops = {n.op_type for n in m.graph.node}
+    assert "Gather" in ops          # embedding lookup
+    assert "Einsum" in ops          # attention matmuls
+
+
+def test_onnx_unsupported_primitive_errors(tmp_path):
+    from paddle_tpu.onnx.emit import UnsupportedOp
+
+    class Weird(nn.Layer):
+        def forward(self, x):
+            return paddle.cumsum(x, axis=0)
+
+    with pytest.raises((UnsupportedOp, NotImplementedError)):
+        paddle.onnx.export(
+            Weird(), str(tmp_path / "w.onnx"),
+            input_spec=[paddle.jit.InputSpec([4, 4], "float32",
+                                             name="x")])
+
+
+def test_onnx_gpt_block_exports(tmp_path):
+    """A full transformer LM (embeddings, layernorm, causal-masked
+    attention, gelu MLP, softmax-free logits head) exports to one valid
+    ONNX graph and executes correctly under the mini-evaluator."""
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+
+    paddle.seed(5)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=16,
+                    use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    spec = [paddle.jit.InputSpec([1, 8], "int32", name="ids")]
+    ids = np.random.default_rng(5).integers(0, 64, (1, 8),
+                                            dtype=np.int32)
+    m, outs = _export_and_run(model, spec, {"ids": ids},
+                              str(tmp_path / "gpt.onnx"))
+    ref = model(paddle.to_tensor(ids))
+    ref = (ref[0] if isinstance(ref, (tuple, list)) else ref).numpy()
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-3, atol=1e-4)
+    ops = {n.op_type for n in m.graph.node}
+    assert {"Einsum", "Gather", "Where", "Tanh"} <= ops

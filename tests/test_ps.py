@@ -98,11 +98,17 @@ def test_onnx_export_stablehlo(tmp_path):
     np.testing.assert_allclose(out, np.asarray(ref._data_), atol=1e-5)
 
 
-def test_onnx_strict_suffix_raises(tmp_path):
+def test_onnx_suffix_emits_real_protobuf(tmp_path):
+    """.onnx paths now produce ACTUAL ONNX protobuf via the native
+    emitter (tests/test_onnx_export.py covers numerics)."""
     from paddle_tpu import nn
-    with pytest.raises(NotImplementedError):
-        paddle.onnx.export(nn.Linear(2, 2), str(tmp_path / "m.onnx"),
-                           input_spec=[None])
+    p = paddle.onnx.export(
+        nn.Linear(2, 2), str(tmp_path / "m.onnx"),
+        input_spec=[paddle.jit.InputSpec([1, 2], "float32", name="x")])
+    from paddle_tpu.onnx import onnx_subset_pb2 as pb
+    m = pb.ModelProto()
+    m.ParseFromString(open(p, "rb").read())
+    assert m.graph.node and m.graph.initializer
 
 
 @pytest.fixture()
