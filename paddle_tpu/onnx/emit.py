@@ -373,11 +373,21 @@ def export_onnx(layer, path, input_spec, opset_version=17):
     from ..core.tensor import Tensor
     from ..core import state as _state
 
-    if opset_version < 13:
+    if not 13 <= opset_version <= 17:
         raise ValueError(
-            f"opset_version={opset_version} is below what the emitted "
-            "ops require (Einsum needs >=12, axes-as-input ReduceSum "
-            ">=13) — pass opset_version>=13")
+            f"opset_version={opset_version} outside the emitted-op "
+            "window: Einsum/axes-as-input ReduceSum need >=13, and at "
+            ">=18 the other reductions moved axes from attribute to "
+            "input — pass 13..17")
+    for s in input_spec:
+        if any(d is None or (isinstance(d, int) and d < 0)
+               for d in (s.shape or [])):
+            raise UnsupportedOp(
+                f"input {getattr(s, 'name', '?')!r} has dynamic dims "
+                f"{list(s.shape)} — ONNX emission traces concrete "
+                "shapes (shape initializers would bake a probe size); "
+                "export a StableHLO bundle (non-.onnx path) for "
+                "batch-polymorphic interchange")
 
     if hasattr(layer, "eval"):
         layer.eval()
@@ -421,14 +431,8 @@ def export_onnx(layer, path, input_spec, opset_version=17):
         em.bind(var, vi.name)
         tt = vi.type.tensor_type
         tt.elem_type = _DTYPE[str(np.dtype(convert_dtype(spec.dtype)))]
-        for axis, dshape in enumerate(spec.shape):
-            d = tt.shape.dim.add()
-            if dshape is None or (isinstance(dshape, int) and dshape < 0):
-                # unique per dim: identical dim_param names would assert
-                # equal runtime values across independent dynamic dims
-                d.dim_param = f"dyn_{vi.name}_{axis}"
-            else:
-                d.dim_value = int(dshape)
+        for dshape in spec.shape:
+            tt.shape.dim.add().dim_value = int(dshape)
     for eqn in jaxpr.eqns:
         _emit_eqn(em, eqn)
     for i, ov in enumerate(jaxpr.outvars):

@@ -314,6 +314,9 @@ def test_geo_sgd_local_pushes_cost_zero_rpcs():
     orig = client._call
     client._call = lambda **kw: (calls.__setitem__("n", calls["n"] + 1),
                                  orig(**kw))[1]
+    origb = client._call_binary
+    client._call_binary = lambda *a, **kw: (
+        calls.__setitem__("n", calls["n"] + 1), origb(*a, **kw))[1]
     for _ in range(10):           # all below geo_step: purely local
         geo.push([1], np.ones((1, 2), np.float32))
         geo.pull([1])
