@@ -1,0 +1,64 @@
+"""Static-graph training through the built IR + native ONNX export.
+
+Two round-5 features end to end:
+
+1. `Program.build(for_training=True)` — the StandaloneExecutor-for-
+   training analog: forward+backward+optimizer captured as ONE jaxpr
+   whose params/optimizer state are donated invars, executed by a single
+   cached executable (reference:
+   fluid/framework/new_executor/standalone_executor.cc).
+2. `paddle.onnx.export(..., "model.onnx")` — real ONNX protobuf from the
+   traced inference computation, no `onnx` wheel required.
+
+Run: JAX_PLATFORMS=cpu python examples/static_training_and_onnx.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+def main():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    def train_step(x, y):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    prog = static.Program(train_step, [
+        static.data("x", [8, 16], "float32"),
+        static.data("y", [8], "int64"),
+    ]).build(for_training=True)
+    exe = static.Executor()
+
+    rng = np.random.default_rng(0)
+    feed = {"x": rng.standard_normal((8, 16)).astype(np.float32),
+            "y": rng.integers(0, 4, (8,)).astype(np.int64)}
+    for step in range(10):
+        loss = exe.run(prog, feed=feed)[0]
+        # steps 1-2 run eagerly (warm-up + discovery); step 3+ execute
+        # the built jaxpr program with donated parameter buffers
+        print(f"step {step}: loss={float(loss):.4f}")
+    print("training IR ops:", len(prog.global_block().ops))
+
+    model.eval()
+    path = paddle.onnx.export(
+        model, "/tmp/example_model.onnx",
+        input_spec=[paddle.jit.InputSpec([1, 16], "float32", name="x")])
+    from paddle_tpu.onnx import onnx_subset_pb2 as pb
+    m = pb.ModelProto()
+    with open(path, "rb") as f:
+        m.ParseFromString(f.read())
+    print(f"exported {path}: {len(m.graph.node)} nodes, "
+          f"{len(m.graph.initializer)} initializers, "
+          f"opset {m.opset_import[0].version}")
+
+
+if __name__ == "__main__":
+    main()
