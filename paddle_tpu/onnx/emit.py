@@ -304,6 +304,30 @@ def _emit_eqn(em, eqn):
             np.expand_dims(vec, tuple(i for i in range(len(shape))
                                       if i != dim)), shape)
         out(em.const(np.ascontiguousarray(full), "iota"))
+    elif p in ("cumsum", "cumprod", "cummax", "cummin"):
+        if p != "cumsum":
+            raise UnsupportedOp(f"{p} has no ONNX op")
+        axis = em.const(np.array(params["axis"], np.int64))
+        out(em.node("CumSum", [ins[0], axis],
+                    exclusive=0,
+                    reverse=int(bool(params.get("reverse", False)))))
+    elif p == "sort":
+        if params.get("num_keys", 1) != 1:
+            raise UnsupportedOp(
+                "multi-key (lexicographic) sort has no TopK mapping")
+        dim = int(params["dimension"])
+        k_size = eqn.invars[0].aval.shape[dim]
+        kk = em.const(np.array([k_size], np.int64))
+        # TopK(largest=0, sorted=1) over the full axis = ascending sort
+        # with indices; payload operands (argsort's iota) re-order via
+        # GatherElements.  ONNX has no stable sort, so equal-key order
+        # may differ from lax.sort(is_stable=True).
+        vals, idx = em.node("TopK", [ins[0], kk], n_out=2,
+                            axis=dim, largest=0, sorted=1)
+        em.bind(eqn.outvars[0], vals)
+        for ov, payload in zip(eqn.outvars[1:], ins[1:]):
+            em.bind(ov, em.node("GatherElements", [payload, idx],
+                                axis=dim))
     elif p == "gather":
         _emit_gather(em, eqn, ins, out)
     elif p == "squeeze":

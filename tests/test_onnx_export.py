@@ -104,6 +104,24 @@ def _run_graph(g, feeds):
         elif op == "Concat":
             r = np.concatenate([f(n, i) for i in range(len(n.input))],
                                axis=_attr(n, "axis"))
+        elif op == "TopK":
+            x, k = f(n), int(f(n, 1)[0])
+            ax = _attr(n, "axis", -1)
+            assert _attr(n, "largest", 1) == 0 and k == x.shape[ax]
+            idx = np.argsort(x, axis=ax, kind="stable")
+            r = (np.take_along_axis(x, idx, axis=ax), idx.astype(np.int64))
+            for o, rr in zip(n.output, r):
+                env[o] = rr
+            continue
+        elif op == "GatherElements":
+            r = np.take_along_axis(f(n), f(n, 1), axis=_attr(n, "axis", 0))
+        elif op == "CumSum":
+            ax = int(f(n, 1))
+            x = f(n)
+            if _attr(n, "reverse", 0):
+                r = np.flip(np.cumsum(np.flip(x, ax), axis=ax), ax)
+            else:
+                r = np.cumsum(x, axis=ax)
         elif op == "Gather":
             r = np.take(f(n), f(n, 1), axis=_attr(n, "axis", 0))
         elif op == "Slice":
@@ -215,18 +233,73 @@ def test_onnx_embedding_attention_round_trip(tmp_path):
     assert "Einsum" in ops          # attention matmuls
 
 
+def test_onnx_cumsum_round_trip(tmp_path):
+    class C(nn.Layer):
+        def forward(self, x):
+            return paddle.cumsum(x, axis=1)
+
+    model = C()
+    spec = [paddle.jit.InputSpec([2, 5], "float32", name="x")]
+    x = np.random.default_rng(3).standard_normal((2, 5)).astype(np.float32)
+    m, outs = _export_and_run(model, spec, {"x": x},
+                              str(tmp_path / "c.onnx"))
+    np.testing.assert_allclose(outs[0], np.cumsum(x, axis=1), rtol=1e-6)
+
+
+def test_onnx_sort_argsort_round_trip(tmp_path):
+    class S(nn.Layer):
+        def forward(self, x):
+            return paddle.sort(x, axis=1), paddle.argsort(x, axis=1)
+
+    model = S()
+    spec = [paddle.jit.InputSpec([3, 7], "float32", name="x")]
+    x = np.random.default_rng(4).standard_normal((3, 7)).astype(np.float32)
+    m, outs = _export_and_run(model, spec, {"x": x},
+                              str(tmp_path / "s.onnx"))
+    np.testing.assert_allclose(outs[0], np.sort(x, axis=1), rtol=1e-6)
+    np.testing.assert_array_equal(outs[1], np.argsort(x, axis=1))
+    assert any(n.op_type == "TopK" for n in m.graph.node)
+
+
 def test_onnx_unsupported_primitive_errors(tmp_path):
     from paddle_tpu.onnx.emit import UnsupportedOp
 
     class Weird(nn.Layer):
         def forward(self, x):
-            return paddle.cumsum(x, axis=0)
+            return paddle.linalg.svd(x)[0]
 
     with pytest.raises((UnsupportedOp, NotImplementedError)):
         paddle.onnx.export(
             Weird(), str(tmp_path / "w.onnx"),
             input_spec=[paddle.jit.InputSpec([4, 4], "float32",
                                              name="x")])
+
+
+def test_onnx_einsum_equation_matches_dot_general():
+    """Property check: for random dot_general dimension_numbers, the
+    emitted einsum equation reproduces lax.dot_general exactly —
+    batch dims lead, then lhs free dims, then rhs free dims."""
+    import jax
+    import numpy as np
+    from paddle_tpu.onnx.emit import _einsum_equation
+
+    rng = np.random.default_rng(0)
+    cases = [
+        # (lhs_shape, rhs_shape, ((lc, rc), (lb, rb)))
+        ((3, 4), (4, 5), (((1,), (0,)), ((), ()))),
+        ((2, 3, 4), (2, 4, 5), (((2,), (1,)), ((0,), (0,)))),
+        ((2, 6, 3, 4), (2, 6, 4, 5), (((3,), (2,)), ((0, 1), (0, 1)))),
+        ((7, 2, 4), (4, 7, 5), (((2,), (0,)), ((0,), (1,)))),
+        ((5, 4, 3), (3, 4, 6), (((1, 2), (1, 0)), ((), ()))),
+    ]
+    for lhs_shape, rhs_shape, dnums in cases:
+        a = rng.standard_normal(lhs_shape).astype(np.float32)
+        b = rng.standard_normal(rhs_shape).astype(np.float32)
+        ref = np.asarray(jax.lax.dot_general(a, b, dnums))
+        eq = _einsum_equation(dnums, a.ndim, b.ndim)
+        np.testing.assert_allclose(np.einsum(eq, a, b), ref,
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"{dnums} -> {eq}")
 
 
 def test_onnx_gpt_block_exports(tmp_path):
