@@ -15,9 +15,14 @@ Two real formats:
   the TPU-native interchange format.
 
 `register_converter` overrides the built-in emitter (e.g. to use a real
-paddle2onnx-class converter when one is installed).
+paddle2onnx-class converter when one is installed).  The IMPORT
+direction exists too: `load_onnx(path)` parses a .onnx file into a
+jit-compiled JAX callable (load.py) — foreign ONNX models in the
+supported op subset compile onto the TPU through XLA.
 """
 from __future__ import annotations
+
+from .load import load_onnx  # noqa: F401
 
 _CONVERTER = None
 

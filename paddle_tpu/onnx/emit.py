@@ -184,6 +184,9 @@ def _emit_eqn(em, eqn):
         out(em.node(_COMPARE[p], ins))
     elif p == "square":
         out(em.node("Mul", [ins[0], ins[0]]))
+    elif p == "erfc":
+        one = em.const(np.ones((), eqn.invars[0].aval.dtype))
+        out(em.node("Sub", [one, em.node("Erf", ins)]))
     elif p == "expm1":
         one = em.const(np.ones((), eqn.invars[0].aval.dtype))
         out(em.node("Sub", [em.node("Exp", ins), one]))

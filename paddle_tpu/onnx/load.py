@@ -1,0 +1,306 @@
+"""ONNX import: parse a .onnx file into a jit-compiled JAX callable.
+
+The inverse of emit.py — together they make ONNX a real interchange
+format for this framework in BOTH directions: models exported here run
+on any conforming runtime, and foreign ONNX models (the op subset
+below) compile onto the TPU through XLA.  The reference ships only the
+export direction in-tree (python/paddle/onnx/export.py via paddle2onnx).
+
+Supported ops mirror the emitter's output set; anything else raises
+UnsupportedOp naming the node type.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import onnx_subset_pb2 as pb
+from .emit import UnsupportedOp
+
+_NP_DTYPE = {
+    pb.TensorProto.FLOAT: np.float32,
+    pb.TensorProto.DOUBLE: np.float64,
+    pb.TensorProto.FLOAT16: np.float16,
+    pb.TensorProto.INT64: np.int64,
+    pb.TensorProto.INT32: np.int32,
+    pb.TensorProto.INT8: np.int8,
+    pb.TensorProto.UINT8: np.uint8,
+    pb.TensorProto.BOOL: np.bool_,
+}
+
+
+def _cast_dtype(code):
+    if code == pb.TensorProto.BFLOAT16:
+        import jax.numpy as jnp
+        return jnp.bfloat16
+    dt = _NP_DTYPE.get(code)
+    if dt is None:
+        raise UnsupportedOp(f"Cast to ONNX dtype {code}")
+    return dt
+
+
+def _tensor_value(t):
+    if t.data_type == pb.TensorProto.BFLOAT16:
+        import jax.numpy as jnp
+        raw = np.frombuffer(t.raw_data, np.uint16)
+        as32 = (raw.astype(np.uint32) << 16).view(np.float32)
+        return jnp.asarray(as32.reshape(list(t.dims)), jnp.bfloat16)
+    dt = _NP_DTYPE.get(t.data_type)
+    if dt is None:
+        raise UnsupportedOp(f"initializer dtype {t.data_type}")
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, dt)
+    elif t.float_data:
+        arr = np.asarray(t.float_data, dt)
+    elif t.int64_data:
+        arr = np.asarray(t.int64_data, dt)
+    elif t.int32_data:
+        arr = np.asarray(t.int32_data, dt)
+    else:
+        arr = np.zeros(0, dt)
+    return arr.reshape(list(t.dims)).copy()
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == pb.AttributeProto.INT:
+            out[a.name] = a.i
+        elif a.type == pb.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == pb.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == pb.AttributeProto.INTS:
+            out[a.name] = list(a.ints)
+        elif a.type == pb.AttributeProto.FLOATS:
+            out[a.name] = list(a.floats)
+        elif a.type == pb.AttributeProto.TENSOR:
+            out[a.name] = _tensor_value(a.t)
+    return out
+
+
+def _static_ints(env, name, what):
+    """Shape-like inputs (Reshape shape, Slice starts, ...) must be
+    constants — XLA needs static shapes."""
+    v = env.get(name)
+    if v is None or hasattr(v, "aval") and not isinstance(
+            v, (np.ndarray, list, tuple)):
+        # traced value: only constants (initializers) are accepted
+        if not isinstance(v, np.ndarray):
+            raise UnsupportedOp(
+                f"{what} must be a constant initializer, got a "
+                "computed value")
+    return [int(x) for x in np.asarray(v).reshape(-1)]
+
+
+def _run_node(jnp, lax, node, env):
+    op = node.op_type
+    a = _attrs(node)
+
+    def has(i):
+        # optional inputs are omitted either by truncation or by an
+        # empty-string placeholder (the standard ONNX convention)
+        return i < len(node.input) and node.input[i] != ""
+
+    def x(i=0):
+        return env[node.input[i]]
+
+    n_in = len(node.input)
+    if op == "Einsum":
+        r = jnp.einsum(a["equation"], x(), x(1))
+    elif op in ("Add", "Sub", "Mul", "Div", "Pow", "Mod"):
+        fn = {"Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+              "Div": jnp.divide, "Pow": jnp.power,
+              "Mod": (jnp.fmod if a.get("fmod") else jnp.mod)}[op]
+        r = fn(x(), x(1))
+    elif op in ("Max", "Min"):
+        fn = jnp.maximum if op == "Max" else jnp.minimum
+        r = x()
+        for i in range(1, n_in):
+            r = fn(r, x(i))
+    elif op in ("Equal", "Less", "LessOrEqual", "Greater",
+                "GreaterOrEqual"):
+        fn = {"Equal": jnp.equal, "Less": jnp.less,
+              "LessOrEqual": jnp.less_equal, "Greater": jnp.greater,
+              "GreaterOrEqual": jnp.greater_equal}[op]
+        r = fn(x(), x(1))
+    elif op in ("Neg", "Exp", "Log", "Tanh", "Sqrt", "Abs", "Sign",
+                "Floor", "Ceil", "Round", "Sin", "Cos", "Not",
+                "Reciprocal", "Sigmoid", "Erf", "Relu", "IsNaN",
+                "IsInf"):
+        import jax
+        fn = {"Neg": jnp.negative, "Exp": jnp.exp, "Log": jnp.log,
+              "Tanh": jnp.tanh, "Sqrt": jnp.sqrt, "Abs": jnp.abs,
+              "Sign": jnp.sign, "Floor": jnp.floor, "Ceil": jnp.ceil,
+              "Round": jnp.round, "Sin": jnp.sin, "Cos": jnp.cos,
+              "Not": jnp.logical_not,
+              "Reciprocal": lambda v: 1.0 / v,
+              "Sigmoid": jax.nn.sigmoid,
+              "Erf": jax.scipy.special.erf,
+              "Relu": jax.nn.relu,
+              "IsNaN": jnp.isnan, "IsInf": jnp.isinf}[op]
+        r = fn(x())
+    elif op in ("And", "Or"):
+        fn = jnp.logical_and if op == "And" else jnp.logical_or
+        r = fn(x(), x(1))
+    elif op in ("ReduceSum", "ReduceMax", "ReduceMin", "ReduceProd",
+                "ReduceMean"):
+        fn = {"ReduceSum": jnp.sum, "ReduceMax": jnp.max,
+              "ReduceMin": jnp.min, "ReduceProd": jnp.prod,
+              "ReduceMean": jnp.mean}[op]
+        # axes: an input (ReduceSum >=13; the others >=18) or an
+        # attribute (older opsets); absent = reduce every axis
+        if has(1):
+            axes = tuple(_static_ints(env, node.input[1],
+                                      f"{op} axes"))
+        elif a.get("axes") is not None:
+            axes = tuple(a["axes"])
+        else:
+            axes = tuple(range(np.ndim(x())))
+        r = fn(x(), axis=axes, keepdims=bool(a.get("keepdims", 1)))
+    elif op in ("ArgMax", "ArgMin"):
+        fn = jnp.argmax if op == "ArgMax" else jnp.argmin
+        r = fn(x(), axis=a.get("axis", 0))
+        if a.get("keepdims", 1):
+            r = jnp.expand_dims(r, a.get("axis", 0))
+    elif op == "Reshape":
+        r = jnp.reshape(x(), _static_ints(env, node.input[1],
+                                          "Reshape shape"))
+    elif op == "Expand":
+        r = jnp.broadcast_to(
+            x(), _static_ints(env, node.input[1], "Expand shape"))
+    elif op == "Transpose":
+        r = jnp.transpose(x(), a.get("perm"))
+    elif op == "Identity":
+        r = x()
+    elif op == "Cast":
+        r = x().astype(_cast_dtype(a["to"]))
+    elif op == "Where":
+        r = jnp.where(x(), x(1), x(2))
+    elif op == "Concat":
+        r = jnp.concatenate([x(i) for i in range(n_in)],
+                            axis=a["axis"])
+    elif op == "Gather":
+        r = jnp.take(x(), x(1), axis=a.get("axis", 0))
+    elif op == "GatherElements":
+        r = jnp.take_along_axis(x(), x(1), axis=a.get("axis", 0))
+    elif op == "TopK":
+        k = _static_ints(env, node.input[1], "TopK k")[0]
+        ax = a.get("axis", -1)
+        val = x()
+        if not a.get("largest", 1):
+            val = -val
+        moved = jnp.moveaxis(val, ax, -1)
+        tv, ti = lax.top_k(moved, k)
+        tv = jnp.moveaxis(tv, -1, ax)
+        ti = jnp.moveaxis(ti, -1, ax)
+        if not a.get("largest", 1):
+            tv = -tv
+        env[node.output[0]] = tv
+        env[node.output[1]] = ti.astype(np.int64)
+        return
+    elif op == "CumSum":
+        ax = _static_ints(env, node.input[1], "CumSum axis")[0]
+        v = x()
+        if a.get("reverse"):
+            r = jnp.flip(jnp.cumsum(jnp.flip(v, ax), axis=ax), ax)
+        else:
+            r = jnp.cumsum(v, axis=ax)
+        if a.get("exclusive"):
+            raise UnsupportedOp("exclusive CumSum")
+    elif op == "Slice":
+        starts = _static_ints(env, node.input[1], "Slice starts")
+        ends = _static_ints(env, node.input[2], "Slice ends")
+        axes = (_static_ints(env, node.input[3], "Slice axes")
+                if has(3) else list(range(len(starts))))
+        steps = (_static_ints(env, node.input[4], "Slice steps")
+                 if has(4) else [1] * len(starts))
+        sl = [slice(None)] * np.ndim(x())
+        for s, e, ax, st in zip(starts, ends, axes, steps):
+            sl[ax] = slice(s, e if abs(e) < 2 ** 62 else None, st)
+        r = x()[tuple(sl)]
+    elif op == "Conv":
+        k = np.ndim(x()) - 2
+        strides = a.get("strides") or [1] * k
+        dils = a.get("dilations") or [1] * k
+        auto = a.get("auto_pad", "NOTSET")
+        if auto in ("NOTSET", "VALID", ""):
+            pads = a.get("pads") or [0] * (2 * k)
+            pairs = list(zip(pads[:k], pads[k:]))
+        elif auto in ("SAME_UPPER", "SAME_LOWER"):
+            pairs = []
+            for ax in range(k):
+                in_sz = x().shape[2 + ax]
+                ksz = (x(1).shape[2 + ax] - 1) * dils[ax] + 1
+                out_sz = -(-in_sz // strides[ax])   # ceil
+                total = max((out_sz - 1) * strides[ax] + ksz - in_sz, 0)
+                lo = total // 2
+                hi = total - lo
+                pairs.append((hi, lo) if auto == "SAME_LOWER"
+                             else (lo, hi))
+        else:
+            raise UnsupportedOp(f"Conv auto_pad={auto!r}")
+        r = lax.conv_general_dilated(
+            x(), x(1),
+            window_strides=strides,
+            padding=pairs,
+            rhs_dilation=dils,
+            feature_group_count=a.get("group", 1))
+        if has(2):
+            r = r + x(2).reshape((1, -1) + (1,) * k)
+    elif op == "Pad":
+        pads = _static_ints(env, node.input[1], "Pad pads")
+        k = len(pads) // 2
+        cval = env[node.input[2]] if has(2) else 0.0
+        ndim = np.ndim(x())
+        axes = (_static_ints(env, node.input[3], "Pad axes")
+                if has(3) else list(range(k)))
+        widths = [(0, 0)] * ndim
+        for lo, hi, ax in zip(pads[:k], pads[k:], axes):
+            widths[ax % ndim] = (lo, hi)
+        r = jnp.pad(x(), widths, constant_values=cval)
+    elif op == "MatMul":
+        r = jnp.matmul(x(), x(1))
+    elif op == "Gemm":
+        va = x().T if a.get("transA") else x()
+        vb = x(1).T if a.get("transB") else x(1)
+        r = a.get("alpha", 1.0) * (va @ vb)
+        if n_in > 2:
+            r = r + a.get("beta", 1.0) * x(2)
+    elif op == "Softmax":
+        import jax
+        r = jax.nn.softmax(x(), axis=a.get("axis", -1))
+    else:
+        raise UnsupportedOp(f"ONNX op {op!r} has no importer mapping")
+    env[node.output[0]] = r
+
+
+def load_onnx(path):
+    """Parse a .onnx file into `(fn, input_names, output_names)` where
+    `fn(*arrays)` is a jit-compiled callable over the graph.
+    Initializers close over as constants; shape-like inputs (Reshape
+    shapes, Slice bounds) must be initializers (XLA is static-shape)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    model = pb.ModelProto()
+    with open(path, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+    consts = {t.name: _tensor_value(t) for t in g.initializer}
+    input_names = [vi.name for vi in g.input if vi.name not in consts]
+    output_names = [vi.name for vi in g.output]
+
+    def run(*arrays):
+        if len(arrays) != len(input_names):
+            raise ValueError(
+                f"expected {len(input_names)} inputs "
+                f"{input_names}, got {len(arrays)}")
+        env = dict(consts)
+        for name, arr in zip(input_names, arrays):
+            env[name] = jnp.asarray(arr)
+        for node in g.node:
+            _run_node(jnp, lax, node, env)
+        return [env[n] for n in output_names]
+
+    return jax.jit(run), input_names, output_names

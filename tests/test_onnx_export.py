@@ -325,3 +325,134 @@ def test_onnx_gpt_block_exports(tmp_path):
     np.testing.assert_allclose(outs[0], ref, rtol=1e-3, atol=1e-4)
     ops = {n.op_type for n in m.graph.node}
     assert {"Einsum", "Gather", "Where", "Tanh"} <= ops
+
+
+def test_onnx_load_round_trips_through_file(tmp_path):
+    """Full interchange loop: export a model to real .onnx bytes, load
+    it back with load_onnx into a jitted JAX callable, and match the
+    original layer — the import direction the reference lacks in-tree."""
+    from paddle_tpu.onnx import load_onnx
+
+    paddle.seed(8)
+    mlp = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4),
+                        nn.Softmax(axis=-1))
+    spec = [paddle.jit.InputSpec([2, 8], "float32", name="x")]
+    p = paddle.onnx.export(mlp, str(tmp_path / "m.onnx"),
+                           input_spec=spec)
+    fn, in_names, out_names = load_onnx(p)
+    assert in_names == ["x"]
+    x = np.random.default_rng(8).standard_normal((2, 8)).astype(np.float32)
+    got = np.asarray(fn(x)[0])
+    ref = mlp(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_load_runs_foreign_graph(tmp_path):
+    """A hand-built ONNX file (as another toolchain would produce, with
+    Gemm/Relu/Softmax — ops our EMITTER never writes) imports and
+    computes correctly: the importer is not coupled to our exporter."""
+    from paddle_tpu.onnx import load_onnx
+
+    rng = np.random.default_rng(9)
+    w = rng.standard_normal((6, 3)).astype(np.float32)
+    b = rng.standard_normal((3,)).astype(np.float32)
+
+    m = pb.ModelProto()
+    m.ir_version = 8
+    m.opset_import.add().version = 17
+    g = m.graph
+    g.name = "foreign"
+    vi = g.input.add()
+    vi.name = "inp"
+    tt = vi.type.tensor_type
+    tt.elem_type = pb.TensorProto.FLOAT
+    for d in (4, 6):
+        tt.shape.dim.add().dim_value = d
+    for name, arr in (("W", w), ("B", b)):
+        t = g.initializer.add()
+        t.name = name
+        t.dims.extend(arr.shape)
+        t.data_type = pb.TensorProto.FLOAT
+        t.raw_data = arr.tobytes()
+    n1 = g.node.add()
+    n1.op_type = "Gemm"
+    n1.input.extend(["inp", "W", "B"])
+    n1.output.append("h")
+    n2 = g.node.add()
+    n2.op_type = "Relu"
+    n2.input.append("h")
+    n2.output.append("r")
+    n3 = g.node.add()
+    n3.op_type = "Softmax"
+    n3.input.append("r")
+    n3.output.append("out")
+    at = n3.attribute.add()
+    at.name = "axis"
+    at.type = pb.AttributeProto.INT
+    at.i = -1
+    g.output.add().name = "out"
+    path = str(tmp_path / "foreign.onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+
+    fn, in_names, out_names = load_onnx(path)
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    got = np.asarray(fn(x)[0])
+    h = np.maximum(x @ w + b, 0)
+    e = np.exp(h - h.max(-1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_load_foreign_conventions(tmp_path):
+    """Foreign-graph conventions: SAME_UPPER auto_pad, axes-less
+    ReduceSum (reduce all), and empty-string optional inputs."""
+    from paddle_tpu.onnx import load_onnx
+    import jax
+
+    rng = np.random.default_rng(10)
+    img = rng.standard_normal((1, 2, 5, 5)).astype(np.float32)
+    ker = rng.standard_normal((3, 2, 3, 3)).astype(np.float32)
+
+    m = pb.ModelProto()
+    m.ir_version = 8
+    m.opset_import.add().version = 17
+    g = m.graph
+    g.name = "conv_same"
+    vi = g.input.add()
+    vi.name = "img"
+    tt = vi.type.tensor_type
+    tt.elem_type = pb.TensorProto.FLOAT
+    for d in (1, 2, 5, 5):
+        tt.shape.dim.add().dim_value = d
+    t = g.initializer.add()
+    t.name = "K"
+    t.dims.extend(ker.shape)
+    t.data_type = pb.TensorProto.FLOAT
+    t.raw_data = ker.tobytes()
+    n1 = g.node.add()
+    n1.op_type = "Conv"
+    n1.input.extend(["img", "K"])
+    n1.output.append("c")
+    at = n1.attribute.add()
+    at.name = "auto_pad"
+    at.type = pb.AttributeProto.STRING
+    at.s = b"SAME_UPPER"
+    n2 = g.node.add()
+    n2.op_type = "ReduceSum"        # no axes input: reduce everything
+    n2.input.append("c")
+    n2.output.append("out")
+    kd = n2.attribute.add()
+    kd.name = "keepdims"
+    kd.type = pb.AttributeProto.INT
+    kd.i = 0
+    g.output.add().name = "out"
+    path = str(tmp_path / "same.onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+
+    fn, _, _ = load_onnx(path)
+    got = float(np.asarray(fn(img)[0]))
+    ref = float(np.sum(np.asarray(jax.lax.conv_general_dilated(
+        img, ker, window_strides=[1, 1], padding="SAME"))))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
