@@ -307,6 +307,38 @@ def _emit_eqn(em, eqn):
             np.expand_dims(vec, tuple(i for i in range(len(shape))
                                       if i != dim)), shape)
         out(em.const(np.ascontiguousarray(full), "iota"))
+    elif p in ("reduce_window_max", "reduce_window_sum"):
+        wd = params["window_dimensions"]
+        ws = params["window_strides"]
+        pad = params["padding"]
+        bd = params.get("base_dilation") or (1,) * len(wd)
+        wdil = params.get("window_dilation") or (1,) * len(wd)
+        k = len(wd) - 2
+        if (k < 1 or wd[0] != 1 or wd[1] != 1 or ws[0] != 1
+                or ws[1] != 1 or pad[0] != (0, 0) or pad[1] != (0, 0)
+                or any(d != 1 for d in bd)):
+            raise UnsupportedOp(
+                f"{p} over non-NC-leading window {wd} (only spatial "
+                "pooling exports)")
+        spatial = dict(
+            kernel_shape=list(wd[2:]),
+            strides=list(ws[2:]),
+            pads=[lo for lo, _ in pad[2:]] + [hi for _, hi in pad[2:]])
+        if any(d != 1 for d in wdil[2:]):
+            spatial["dilations"] = list(wdil[2:])
+        if p == "reduce_window_max":
+            out(em.node("MaxPool", ins, **spatial))
+        else:
+            # sum pool ≡ AveragePool(count_include_pad=1) × window size
+            # exactly (padding contributes zeros, divisor is the full
+            # window) — the traced graph's own div then rescales
+            if "dilations" in spatial:
+                raise UnsupportedOp("dilated sum-pooling")
+            avg = em.node("AveragePool", ins, count_include_pad=1,
+                          **spatial)
+            wsize = em.const(np.asarray(
+                float(np.prod(wd[2:])), eqn.invars[0].aval.dtype))
+            out(em.node("Mul", [avg, wsize]))
     elif p in ("cumsum", "cumprod", "cummax", "cummin"):
         if p != "cumsum":
             raise UnsupportedOp(f"{p} has no ONNX op")

@@ -106,7 +106,7 @@ def _run_node(jnp, lax, node, env):
 
     n_in = len(node.input)
     if op == "Einsum":
-        r = jnp.einsum(a["equation"], x(), x(1))
+        r = jnp.einsum(a["equation"], *[x(i) for i in range(n_in)])
     elif op in ("Add", "Sub", "Mul", "Div", "Pow", "Mod"):
         fn = {"Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
               "Div": jnp.divide, "Pow": jnp.power,
@@ -163,11 +163,19 @@ def _run_node(jnp, lax, node, env):
         if a.get("keepdims", 1):
             r = jnp.expand_dims(r, a.get("axis", 0))
     elif op == "Reshape":
-        r = jnp.reshape(x(), _static_ints(env, node.input[1],
-                                          "Reshape shape"))
+        shape = _static_ints(env, node.input[1], "Reshape shape")
+        # ONNX: a 0 in the shape copies the corresponding input dim
+        # (unless allowzero)
+        if not a.get("allowzero"):
+            shape = [x().shape[i] if d == 0 else d
+                     for i, d in enumerate(shape)]
+        r = jnp.reshape(x(), shape)
     elif op == "Expand":
-        r = jnp.broadcast_to(
-            x(), _static_ints(env, node.input[1], "Expand shape"))
+        # ONNX Expand is bidirectional broadcast: the target may have
+        # 1s (or lower rank) where the input is larger
+        tgt = _static_ints(env, node.input[1], "Expand shape")
+        r = jnp.broadcast_to(x(), np.broadcast_shapes(x().shape,
+                                                      tuple(tgt)))
     elif op == "Transpose":
         r = jnp.transpose(x(), a.get("perm"))
     elif op == "Identity":
@@ -251,6 +259,8 @@ def _run_node(jnp, lax, node, env):
         pads = _static_ints(env, node.input[1], "Pad pads")
         k = len(pads) // 2
         cval = env[node.input[2]] if has(2) else 0.0
+        if a.get("mode", "constant") != "constant":
+            raise UnsupportedOp(f"Pad mode={a.get('mode')!r}")
         ndim = np.ndim(x())
         axes = (_static_ints(env, node.input[3], "Pad axes")
                 if has(3) else list(range(k)))
@@ -258,13 +268,49 @@ def _run_node(jnp, lax, node, env):
         for lo, hi, ax in zip(pads[:k], pads[k:], axes):
             widths[ax % ndim] = (lo, hi)
         r = jnp.pad(x(), widths, constant_values=cval)
+    elif op in ("MaxPool", "AveragePool"):
+        ks = a["kernel_shape"]
+        k = len(ks)
+        nd = np.ndim(x())
+        strides = a.get("strides") or [1] * k
+        if a.get("auto_pad", "NOTSET") not in ("NOTSET", "VALID", ""):
+            raise UnsupportedOp(f"{op} auto_pad={a.get('auto_pad')!r}")
+        if a.get("ceil_mode"):
+            raise UnsupportedOp(
+                f"{op} ceil_mode=1 (reduce_window is floor-mode)")
+        pads = a.get("pads") or [0] * (2 * k)
+        pairs = [(0, 0)] * (nd - k) + list(zip(pads[:k], pads[k:]))
+        window = (1,) * (nd - k) + tuple(ks)
+        stride = (1,) * (nd - k) + tuple(strides)
+        if op == "MaxPool":
+            if a.get("dilations") and any(
+                    d != 1 for d in a["dilations"]):
+                dil = (1,) * (nd - k) + tuple(a["dilations"])
+            else:
+                dil = (1,) * nd
+            r = lax.reduce_window(
+                x(), -jnp.inf, lax.max, window, stride, pairs,
+                window_dilation=dil)
+        else:
+            s = lax.reduce_window(x(), 0.0, lax.add, window, stride,
+                                  pairs)
+            if a.get("count_include_pad"):
+                r = s / float(np.prod(ks))
+            else:
+                ones = jnp.ones(x().shape, x().dtype)
+                cnt = lax.reduce_window(ones, 0.0, lax.add, window,
+                                        stride, pairs)
+                r = s / cnt
+    elif op == "GlobalAveragePool":
+        spatial = tuple(range(2, np.ndim(x())))
+        r = jnp.mean(x(), axis=spatial, keepdims=True)
     elif op == "MatMul":
         r = jnp.matmul(x(), x(1))
     elif op == "Gemm":
         va = x().T if a.get("transA") else x()
         vb = x(1).T if a.get("transB") else x(1)
         r = a.get("alpha", 1.0) * (va @ vb)
-        if n_in > 2:
+        if has(2):
             r = r + a.get("beta", 1.0) * x(2)
     elif op == "Softmax":
         import jax

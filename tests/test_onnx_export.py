@@ -456,3 +456,52 @@ def test_onnx_load_foreign_conventions(tmp_path):
     ref = float(np.sum(np.asarray(jax.lax.conv_general_dilated(
         img, ker, window_strides=[1, 1], padding="SAME"))))
     np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_onnx_pooling_round_trip(tmp_path):
+    """Pooling (reduce_window) exports as MaxPool / AveragePool x window
+    and reimports exactly — verified through load_onnx (numerics ride
+    the FILE, not the exporter's memory)."""
+    from paddle_tpu.onnx import load_onnx
+
+    paddle.seed(11)
+    model = nn.Sequential(
+        nn.Conv2D(3, 6, 3, padding=1), nn.ReLU(),
+        nn.MaxPool2D(2, 2),
+        nn.AvgPool2D(3, stride=2, padding=1),
+        nn.AdaptiveAvgPool2D(1), nn.Flatten(), nn.Linear(6, 4))
+    model.eval()
+    spec = [paddle.jit.InputSpec([2, 3, 16, 16], "float32", name="img")]
+    x = np.random.default_rng(11).standard_normal(
+        (2, 3, 16, 16)).astype(np.float32)
+    p = paddle.onnx.export(model, str(tmp_path / "pool.onnx"),
+                           input_spec=spec)
+    m = pb.ModelProto()
+    with open(p, "rb") as fh:
+        m.ParseFromString(fh.read())
+    ops = {n.op_type for n in m.graph.node}
+    assert "MaxPool" in ops and "AveragePool" in ops
+    fn, _, _ = load_onnx(p)
+    got = np.asarray(fn(x)[0])
+    ref = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_alexnet_exports_and_reimports(tmp_path):
+    """A real vision-zoo model (alexnet) exports to ONNX and reimports
+    with matching numerics — the model-family interchange story."""
+    from paddle_tpu.vision.models import alexnet
+    from paddle_tpu.onnx import load_onnx
+
+    paddle.seed(12)
+    model = alexnet(num_classes=10)
+    model.eval()
+    spec = [paddle.jit.InputSpec([1, 3, 64, 64], "float32", name="img")]
+    x = np.random.default_rng(12).standard_normal(
+        (1, 3, 64, 64)).astype(np.float32)
+    p = paddle.onnx.export(model, str(tmp_path / "alexnet.onnx"),
+                           input_spec=spec)
+    fn, _, _ = load_onnx(p)
+    got = np.asarray(fn(x)[0])
+    ref = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
