@@ -117,6 +117,28 @@ class Predictor:
         from ..static import load_inference_model
         if config.prefix is None:
             raise ValueError("Config needs a model path prefix")
+        self._onnx_fn = None
+        if str(config.prefix).endswith(".onnx"):
+            # serve a real ONNX file (ours or foreign) through the
+            # importer: the graph compiles onto the target device via XLA
+            if config.precision == PrecisionType.Int8:
+                raise ValueError(
+                    "int8 predict applies to StableHLO bundles; ONNX "
+                    "inputs run at their stored precision")
+            from ..onnx import load_onnx
+            fn, in_names, out_names = load_onnx(config.prefix)
+            self._onnx_fn = fn
+            self._program = None
+            self._feed_names = in_names
+            self._fetch_names = out_names
+            self._inputs = {
+                n: _IOHandle(n, fn.input_specs[n][0],
+                             np.dtype(fn.input_specs[n][1]).name
+                             if fn.input_specs[n][1] else None)
+                for n in in_names}
+            self._outputs = {n: _IOHandle(n) for n in out_names}
+            self._params = []
+            return
         prog, feed_names, fetch_names = load_inference_model(config.prefix)
         if config.precision == PrecisionType.Int8 and \
                 not prog._param_scales:
@@ -152,7 +174,10 @@ class Predictor:
             for n, a in zip(self._feed_names, inputs):
                 self._inputs[n].copy_from_cpu(a)
         args = [self._inputs[n].copy_to_cpu() for n in self._feed_names]
-        outs = self._program._exported_call(self._params, args)
+        if self._onnx_fn is not None:
+            outs = self._onnx_fn(*args)
+        else:
+            outs = self._program._exported_call(self._params, args)
         for n, o in zip(self._fetch_names, outs):
             self._outputs[n]._value = np.asarray(o)
         return [np.asarray(o) for o in outs]

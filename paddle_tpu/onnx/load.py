@@ -278,6 +278,8 @@ def _run_node(jnp, lax, node, env):
         if a.get("ceil_mode"):
             raise UnsupportedOp(
                 f"{op} ceil_mode=1 (reduce_window is floor-mode)")
+        if len(node.output) > 1:
+            raise UnsupportedOp(f"{op} Indices output")
         pads = a.get("pads") or [0] * (2 * k)
         pairs = [(0, 0)] * (nd - k) + list(zip(pads[:k], pads[k:]))
         window = (1,) * (nd - k) + tuple(ks)
@@ -288,10 +290,16 @@ def _run_node(jnp, lax, node, env):
                 dil = (1,) * (nd - k) + tuple(a["dilations"])
             else:
                 dil = (1,) * nd
+            dt = np.dtype(x().dtype)
+            lowest = (-jnp.inf if np.issubdtype(dt, np.floating)
+                      else np.iinfo(dt).min)
             r = lax.reduce_window(
-                x(), -jnp.inf, lax.max, window, stride, pairs,
+                x(), lowest, lax.max, window, stride, pairs,
                 window_dilation=dil)
         else:
+            if a.get("dilations") and any(
+                    d != 1 for d in a["dilations"]):
+                raise UnsupportedOp("dilated AveragePool")
             s = lax.reduce_window(x(), 0.0, lax.add, window, stride,
                                   pairs)
             if a.get("count_include_pad"):
@@ -320,9 +328,31 @@ def _run_node(jnp, lax, node, env):
     env[node.output[0]] = r
 
 
+class OnnxModule:
+    """Jit-compiled callable over a loaded graph, carrying the IO specs
+    parsed from the file (`input_specs`: name → (shape with None for
+    dynamic dims, numpy dtype))."""
+
+    def __init__(self, fn, input_specs, output_names):
+        self._fn = fn
+        self.input_specs = input_specs
+        self.output_names = output_names
+
+    def __call__(self, *arrays):
+        return self._fn(*arrays)
+
+
+def _io_spec(vi):
+    tt = vi.type.tensor_type
+    shape = [d.dim_value if d.WhichOneof("value") == "dim_value"
+             else None for d in tt.shape.dim]
+    return shape, _NP_DTYPE.get(tt.elem_type)
+
+
 def load_onnx(path):
-    """Parse a .onnx file into `(fn, input_names, output_names)` where
-    `fn(*arrays)` is a jit-compiled callable over the graph.
+    """Parse a .onnx file into `(module, input_names, output_names)`
+    where `module(*arrays)` is a jit-compiled callable over the graph
+    (module.input_specs carries the file's declared shapes/dtypes).
     Initializers close over as constants; shape-like inputs (Reshape
     shapes, Slice bounds) must be initializers (XLA is static-shape)."""
     import jax
@@ -334,8 +364,10 @@ def load_onnx(path):
         model.ParseFromString(f.read())
     g = model.graph
     consts = {t.name: _tensor_value(t) for t in g.initializer}
-    input_names = [vi.name for vi in g.input if vi.name not in consts]
+    graph_inputs = [vi for vi in g.input if vi.name not in consts]
+    input_names = [vi.name for vi in graph_inputs]
     output_names = [vi.name for vi in g.output]
+    input_specs = {vi.name: _io_spec(vi) for vi in graph_inputs}
 
     def run(*arrays):
         if len(arrays) != len(input_names):
@@ -349,4 +381,5 @@ def load_onnx(path):
             _run_node(jnp, lax, node, env)
         return [env[n] for n in output_names]
 
-    return jax.jit(run), input_names, output_names
+    return (OnnxModule(jax.jit(run), input_specs, output_names),
+            input_names, output_names)

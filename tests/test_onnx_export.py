@@ -505,3 +505,23 @@ def test_onnx_alexnet_exports_and_reimports(tmp_path):
     got = np.asarray(fn(x)[0])
     ref = model(paddle.to_tensor(x)).numpy()
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_predictor_serves_onnx_file(tmp_path):
+    """The inference Predictor serves .onnx files directly (reference:
+    analysis_predictor consumes the exported interchange format)."""
+    from paddle_tpu.inference import Config, Predictor
+
+    paddle.seed(13)
+    m = nn.Sequential(nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 3))
+    m.eval()
+    p = paddle.onnx.export(
+        m, str(tmp_path / "served.onnx"),
+        input_spec=[paddle.jit.InputSpec([2, 6], "float32", name="x")])
+    pred = Predictor(Config(p))
+    assert pred.get_input_names() == ["x"]
+    assert pred.get_input_handle("x").shape() == [2, 6]
+    x = np.random.default_rng(13).standard_normal((2, 6)).astype(np.float32)
+    out = pred.run([x])[0]
+    np.testing.assert_allclose(out, m(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-6)
