@@ -103,7 +103,8 @@ _SLOW_TESTS = {
     "test_nn.py::test_grid_sample",                                # 12
     "test_tcp_store.py::test_master_rendezvous_across_processes",  # 17; 7 other tcp_store tests stay fast
     "test_pipeline.py::test_pipeline_train_batch_matches_grad_accumulation",  # 13; hetero + schedule tests keep pp fast coverage
-    "test_onnx_export.py::test_onnx_alexnet_exports_and_reimports",  # 13; pooling/gpt round-trips stay fast
+    "test_onnx_export.py::test_onnx_zoo_exports_and_reimports[alexnet]",  # 13; pooling/gpt round-trips stay fast
+    "test_onnx_export.py::test_onnx_zoo_exports_and_reimports[resnet18]",
 }
 
 

@@ -487,19 +487,21 @@ def test_onnx_pooling_round_trip(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
 
 
-def test_onnx_alexnet_exports_and_reimports(tmp_path):
-    """A real vision-zoo model (alexnet) exports to ONNX and reimports
-    with matching numerics — the model-family interchange story."""
-    from paddle_tpu.vision.models import alexnet
+@pytest.mark.parametrize("family", ["alexnet", "resnet18"])
+def test_onnx_zoo_exports_and_reimports(tmp_path, family):
+    """Real vision-zoo models (conv/BN/pool/residual stacks) export to
+    ONNX and reimport with matching numerics — the model-family
+    interchange story."""
+    import paddle_tpu.vision.models as zoo
     from paddle_tpu.onnx import load_onnx
 
     paddle.seed(12)
-    model = alexnet(num_classes=10)
+    model = getattr(zoo, family)(num_classes=10)
     model.eval()
     spec = [paddle.jit.InputSpec([1, 3, 64, 64], "float32", name="img")]
     x = np.random.default_rng(12).standard_normal(
         (1, 3, 64, 64)).astype(np.float32)
-    p = paddle.onnx.export(model, str(tmp_path / "alexnet.onnx"),
+    p = paddle.onnx.export(model, str(tmp_path / f"{family}.onnx"),
                            input_spec=spec)
     fn, _, _ = load_onnx(p)
     got = np.asarray(fn(x)[0])
