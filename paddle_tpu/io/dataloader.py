@@ -127,17 +127,31 @@ class DataLoader:
         ctx = mp.get_context("fork")
 
         def worker(worker_id):
-            from . import worker_info as _wi
-            _wi._WORKER_INFO = _wi.WorkerInfo(
-                id=worker_id, num_workers=nw, dataset=self.dataset)
-            if self.worker_init_fn is not None:
-                self.worker_init_fn(worker_id)
             try:
+                from . import worker_info as _wi
+                _wi._WORKER_INFO = _wi.WorkerInfo(
+                    id=worker_id, num_workers=nw, dataset=self.dataset)
+                if self.worker_init_fn is not None:
+                    self.worker_init_fn(worker_id)
                 for i, indices in all_batches[worker_id::nw]:
                     batch = self._fetch_numpy(indices)
                     out_q.put((i, batch), timeout=0)
             except (QueueClosed, KeyboardInterrupt):
                 pass
+            except Exception as e:
+                # surface the real failure in the TRAINER process — a
+                # bare worker exit(1) with the traceback lost to stderr
+                # is undebuggable (oversized batch vs slot_size is the
+                # classic case)
+                try:
+                    # truncate: an error message larger than the slot
+                    # would fail the put and drop the report entirely
+                    msg = f"worker {worker_id}: {type(e).__name__}: {e}"
+                    out_q.put(("__worker_error__", msg[:4096]),
+                              timeout=5.0)
+                except Exception:
+                    pass
+                raise
 
         procs = [ctx.Process(target=worker, args=(w,), daemon=True)
                  for w in range(nw)]
@@ -162,6 +176,9 @@ class DataLoader:
                                 f"before delivering batch {want}; "
                                 f"see stderr")
                         continue
+                    if i == "__worker_error__":
+                        raise RuntimeError(
+                            f"DataLoader worker failed: {batch}")
                     pending[i] = batch
                 yield self.collate_fn(pending.pop(want))
         finally:

@@ -138,3 +138,22 @@ def test_register_c_kernel_dispatches_and_jits(tmp_path):
         y = step(x)
     np.testing.assert_allclose(y.numpy(), (2 * x.numpy() + 1) * 3.0,
                                rtol=1e-6)
+
+
+def test_dataloader_worker_error_surfaces_in_trainer():
+    """A worker failure (the classic: batch exceeds the shm slot) must
+    raise a CLEAR error in the trainer process naming the cause, not a
+    bare 'worker exited (code 1)' with the traceback lost to stderr."""
+    class Big(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.zeros((1 << 16,), np.float32)   # 256 KiB/sample
+
+    dl = DataLoader(Big(), batch_size=4, num_workers=2,
+                    use_shared_memory=True)
+    dl.shm_slot_size = 1 << 16       # 64 KiB slots: batches cannot fit
+    with pytest.raises(RuntimeError, match="slot_size"):
+        for _ in dl:
+            pass
