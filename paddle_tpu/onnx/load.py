@@ -323,6 +323,114 @@ def _run_node(jnp, lax, node, env):
     elif op == "Softmax":
         import jax
         r = jax.nn.softmax(x(), axis=a.get("axis", -1))
+    elif op == "Constant":
+        if "value" not in a:
+            raise UnsupportedOp("Constant without a tensor value")
+        # numpy, not jnp: Constant outputs feed shape-like inputs
+        # (Reshape/Split/Range) which _static_ints must see as static
+        env[node.output[0]] = np.asarray(a["value"])
+        return
+    elif op == "ConstantOfShape":
+        shape = _static_ints(env, node.input[0], "ConstantOfShape shape")
+        fill = a.get("value")
+        r = jnp.full(shape, np.asarray(fill).reshape(())
+                     if fill is not None else np.float32(0))
+    elif op == "Shape":
+        # static-shape backend: the shape is a compile-time constant
+        shp = list(x().shape)
+        nd = len(shp)
+        start = a.get("start", 0)
+        end = a.get("end", nd)
+        start = start + nd if start < 0 else start
+        end = end + nd if end < 0 else end
+        env[node.output[0]] = np.asarray(shp[start:end], np.int64)
+        return
+    elif op == "Range":
+        vals = [_static_ints(env, node.input[i], "Range")[0]
+                for i in range(3)]
+        r = jnp.arange(vals[0], vals[1], vals[2])
+    elif op == "Flatten":
+        ax = a.get("axis", 1)
+        if ax < 0:                    # ONNX: negative axis means axis+ndim
+            ax += np.ndim(x())
+        lead = int(np.prod(x().shape[:ax])) if ax else 1
+        r = jnp.reshape(x(), (lead, -1))
+    elif op == "Squeeze":
+        if has(1):
+            axes = _static_ints(env, node.input[1], "Squeeze axes")
+        else:
+            axes = a.get("axes") or [i for i, d in
+                                     enumerate(x().shape) if d == 1]
+        r = jnp.squeeze(x(), axis=tuple(ax % np.ndim(x())
+                                        for ax in axes))
+    elif op == "Unsqueeze":
+        axes = (_static_ints(env, node.input[1], "Unsqueeze axes")
+                if has(1) else a["axes"])
+        r = x()
+        nd = np.ndim(r) + len(axes)
+        for ax in sorted(ax % nd for ax in axes):
+            r = jnp.expand_dims(r, ax)
+    elif op == "Clip":
+        r = jnp.clip(x(),
+                     x(1) if has(1) else a.get("min"),
+                     x(2) if has(2) else a.get("max"))
+    elif op == "LeakyRelu":
+        import jax
+        r = jax.nn.leaky_relu(x(), a.get("alpha", 0.01))
+    elif op == "Elu":
+        import jax
+        r = jax.nn.elu(x(), a.get("alpha", 1.0))
+    elif op == "Gelu":
+        import jax
+        approx = a.get("approximate", "none") == "tanh"
+        r = jax.nn.gelu(x(), approximate=approx)
+    elif op == "Split":
+        ax = a.get("axis", 0)
+        if has(1):
+            sizes = _static_ints(env, node.input[1], "Split sizes")
+        elif a.get("split"):
+            sizes = a["split"]
+        else:
+            n_out = len(node.output)
+            d = x().shape[ax]
+            if d % n_out:
+                raise UnsupportedOp(f"Split {d} into {n_out} unequal")
+            sizes = [d // n_out] * n_out
+        offs = np.cumsum([0] + list(sizes))
+        for o, lo, hi in zip(node.output, offs[:-1], offs[1:]):
+            sl = [slice(None)] * np.ndim(x())
+            sl[ax] = slice(int(lo), int(hi))
+            env[o] = x()[tuple(sl)]
+        return
+    elif op == "BatchNormalization":
+        if a.get("training_mode"):
+            raise UnsupportedOp("BatchNormalization training_mode=1")
+        if any(o for o in node.output[1:]):   # empty placeholders OK
+            raise UnsupportedOp(
+                "BatchNormalization running-stat outputs")
+        eps = a.get("epsilon", 1e-5)
+        nd = np.ndim(x())
+        form = (1, -1) + (1,) * (nd - 2)
+        scale, bias = x(1).reshape(form), x(2).reshape(form)
+        mean, var = x(3).reshape(form), x(4).reshape(form)
+        r = (x() - mean) / jnp.sqrt(var + eps) * scale + bias
+    elif op == "LayerNormalization":
+        ax = a.get("axis", -1)
+        eps = a.get("epsilon", 1e-5)
+        nd = np.ndim(x())
+        axes = tuple(range(ax % nd, nd))
+        mean = jnp.mean(x(), axis=axes, keepdims=True)
+        var = jnp.mean((x() - mean) ** 2, axis=axes, keepdims=True)
+        inv = 1.0 / jnp.sqrt(var + eps)
+        r = (x() - mean) * inv * x(1)
+        if has(2):
+            r = r + x(2)
+        env[node.output[0]] = r
+        if len(node.output) > 1 and node.output[1]:
+            env[node.output[1]] = mean
+        if len(node.output) > 2 and node.output[2]:
+            env[node.output[2]] = inv
+        return
     else:
         raise UnsupportedOp(f"ONNX op {op!r} has no importer mapping")
     env[node.output[0]] = r

@@ -527,3 +527,126 @@ def test_predictor_serves_onnx_file(tmp_path):
     out = pred.run([x])[0]
     np.testing.assert_allclose(out, m(paddle.to_tensor(x)).numpy(),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_load_mainstream_exporter_ops(tmp_path):
+    """Ops mainstream exporters emit that OUR emitter never writes:
+    fused BatchNormalization + LayerNormalization, Constant, Flatten,
+    Clip, LeakyRelu, Split, Squeeze/Unsqueeze — hand-built graph,
+    numerics checked against a numpy reference."""
+    from paddle_tpu.onnx import load_onnx
+
+    rng = np.random.default_rng(20)
+    x = rng.standard_normal((2, 4, 3, 3)).astype(np.float32)
+    scale = rng.standard_normal(4).astype(np.float32)
+    bias = rng.standard_normal(4).astype(np.float32)
+    mean = rng.standard_normal(4).astype(np.float32)
+    var = np.abs(rng.standard_normal(4)).astype(np.float32) + 0.5
+    ln_g = rng.standard_normal(3).astype(np.float32)
+
+    m = pb.ModelProto()
+    m.ir_version = 8
+    m.opset_import.add().version = 17
+    g = m.graph
+    g.name = "mainstream"
+    vi = g.input.add()
+    vi.name = "x"
+    tt = vi.type.tensor_type
+    tt.elem_type = pb.TensorProto.FLOAT
+    for d in (2, 4, 3, 3):
+        tt.shape.dim.add().dim_value = d
+    for name, arr in (("S", scale), ("B", bias), ("M", mean),
+                      ("V", var), ("G", ln_g)):
+        t = g.initializer.add()
+        t.name = name
+        t.dims.extend(arr.shape)
+        t.data_type = pb.TensorProto.FLOAT
+        t.raw_data = arr.tobytes()
+
+    def node(op, ins, outs, **attrs):
+        n = g.node.add()
+        n.op_type = op
+        n.input.extend(ins)
+        n.output.extend(outs)
+        for k, v in attrs.items():
+            at = n.attribute.add()
+            at.name = k
+            if isinstance(v, float):
+                at.type = pb.AttributeProto.FLOAT
+                at.f = v
+            elif isinstance(v, list):
+                at.type = pb.AttributeProto.INTS
+                at.ints.extend(v)
+            else:
+                at.type = pb.AttributeProto.INT
+                at.i = v
+        return n
+
+    node("BatchNormalization", ["x", "S", "B", "M", "V"], ["bn"],
+         epsilon=1e-5)
+    node("LeakyRelu", ["bn"], ["lr"], alpha=0.1)
+    node("Clip", ["lr"], ["cl"])          # attr-less clip = identity
+    node("LayerNormalization", ["cl", "G"], ["ln"], axis=-1)
+    node("Split", ["ln"], ["s0", "s1"], axis=1)
+    node("Flatten", ["s0"], ["fl"], axis=1)
+    node("Unsqueeze", ["fl"], ["uq"], axes=[0])
+    node("Squeeze", ["uq"], ["out"], axes=[0])
+    g.output.add().name = "out"
+    path = str(tmp_path / "mainstream.onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+
+    fn, _, _ = load_onnx(path)
+    got = np.asarray(fn(x)[0])
+
+    form = (1, -1, 1, 1)
+    bn = ((x - mean.reshape(form)) / np.sqrt(var.reshape(form) + 1e-5)
+          * scale.reshape(form) + bias.reshape(form))
+    lr = np.where(bn > 0, bn, 0.1 * bn)
+    mu = lr.mean(-1, keepdims=True)
+    sd = np.sqrt(((lr - mu) ** 2).mean(-1, keepdims=True) + 1e-5)
+    ln = (lr - mu) / sd * ln_g
+    s0 = ln[:, :2]
+    ref = s0.reshape(2, -1)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_load_constant_feeds_shape_input(tmp_path):
+    """The PyTorch-exporter pattern: a Constant node (not an
+    initializer) feeding Reshape's shape input must be treated as
+    static."""
+    from paddle_tpu.onnx import load_onnx
+
+    m = pb.ModelProto()
+    m.ir_version = 8
+    m.opset_import.add().version = 17
+    g = m.graph
+    g.name = "const_shape"
+    vi = g.input.add()
+    vi.name = "x"
+    tt = vi.type.tensor_type
+    tt.elem_type = pb.TensorProto.FLOAT
+    for d in (2, 3, 4):
+        tt.shape.dim.add().dim_value = d
+    n1 = g.node.add()
+    n1.op_type = "Constant"
+    n1.output.append("shp")
+    at = n1.attribute.add()
+    at.name = "value"
+    at.type = pb.AttributeProto.TENSOR
+    at.t.dims.append(2)
+    at.t.data_type = pb.TensorProto.INT64
+    at.t.raw_data = np.asarray([2, -1], np.int64).tobytes()
+    n2 = g.node.add()
+    n2.op_type = "Reshape"
+    n2.input.extend(["x", "shp"])
+    n2.output.append("out")
+    g.output.add().name = "out"
+    path = str(tmp_path / "cs.onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+
+    fn, _, _ = load_onnx(path)
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    np.testing.assert_array_equal(np.asarray(fn(x)[0]),
+                                  x.reshape(2, -1))
