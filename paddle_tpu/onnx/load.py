@@ -78,23 +78,92 @@ def _attrs(node):
     return out
 
 
-def _static_ints(env, name, what):
+def _require_static(env, name, what):
     """Shape-like inputs (Reshape shape, Slice starts, ...) must be
-    constants — XLA needs static shapes."""
+    compile-time constants — XLA needs static shapes.  Anything that is
+    not a jax TRACER qualifies: initializers (numpy), Constant/Shape
+    outputs, and chains of shape arithmetic over them (Gather/Concat/
+    Unsqueeze of concrete values stay concrete inside the trace — the
+    torch x.view(x.size(0), -1) export pattern)."""
+    import jax
     v = env.get(name)
-    if v is None or hasattr(v, "aval") and not isinstance(
-            v, (np.ndarray, list, tuple)):
-        # traced value: only constants (initializers) are accepted
-        if not isinstance(v, np.ndarray):
-            raise UnsupportedOp(
-                f"{what} must be a constant initializer, got a "
-                "computed value")
-    return [int(x) for x in np.asarray(v).reshape(-1)]
+    if v is None or isinstance(v, jax.core.Tracer):
+        raise UnsupportedOp(
+            f"{what} must be a compile-time constant, got a value "
+            "computed from graph inputs")
+    return v
+
+
+def _static_ints(env, name, what):
+    return [int(x) for x in
+            np.asarray(_require_static(env, name, what)).reshape(-1)]
+
+
+_FOLD_OPS = {"Gather", "Concat", "Unsqueeze", "Squeeze", "Add", "Sub",
+             "Mul", "Div", "Cast", "Identity"}
+
+
+def _try_fold(op, a, node, env):
+    """Constant-fold shape-math ops whose inputs are all compile-time
+    constants with NUMPY, so their outputs stay static.  Under jax's
+    omnistaging every jnp op inside the trace produces a tracer — even
+    over concrete values — which would break the exporter shape chains
+    (Shape → Gather → Unsqueeze → Concat → Reshape, torch's
+    x.view(x.size(0), -1) pattern)."""
+    import jax
+    ins = []
+    for nm in node.input:
+        if nm == "":
+            ins.append(None)
+            continue
+        v = env.get(nm)
+        if v is None or isinstance(v, jax.core.Tracer):
+            return False
+        ins.append(np.asarray(v))
+    if op == "Gather":
+        r = np.take(ins[0], ins[1], axis=a.get("axis", 0))
+    elif op == "Concat":
+        r = np.concatenate(ins, axis=a.get("axis", 0))
+    elif op in ("Unsqueeze", "Squeeze"):
+        axes = (ins[1].reshape(-1).tolist()
+                if len(ins) > 1 and ins[1] is not None
+                else a.get("axes"))
+        r = ins[0]
+        if op == "Unsqueeze":
+            nd = r.ndim + len(axes)
+            for ax in sorted(ax % nd for ax in axes):
+                r = np.expand_dims(r, ax)
+        else:
+            if axes is None:
+                axes = [i for i, d in enumerate(r.shape) if d == 1]
+            r = np.squeeze(r, axis=tuple(ax % r.ndim for ax in axes))
+    elif op in ("Add", "Sub", "Mul"):
+        fn = {"Add": np.add, "Sub": np.subtract,
+              "Mul": np.multiply}[op]
+        r = fn(ins[0], ins[1])
+    elif op == "Div":
+        both_int = (np.issubdtype(ins[0].dtype, np.integer)
+                    and np.issubdtype(ins[1].dtype, np.integer))
+        r = (np.floor_divide(ins[0], ins[1]) if both_int
+             else np.divide(ins[0], ins[1]))
+    elif op == "Cast":
+        dt = _NP_DTYPE.get(a.get("to"))
+        if dt is None:
+            return False
+        r = ins[0].astype(dt)
+    elif op == "Identity":
+        r = ins[0]
+    else:
+        return False
+    env[node.output[0]] = r
+    return True
 
 
 def _run_node(jnp, lax, node, env):
     op = node.op_type
     a = _attrs(node)
+    if op in _FOLD_OPS and _try_fold(op, a, node, env):
+        return
 
     def has(i):
         # optional inputs are omitted either by truncation or by an
@@ -346,8 +415,9 @@ def _run_node(jnp, lax, node, env):
         env[node.output[0]] = np.asarray(shp[start:end], np.int64)
         return
     elif op == "Range":
-        vals = [_static_ints(env, node.input[i], "Range")[0]
-                for i in range(3)]
+        vals = [np.asarray(_require_static(env, node.input[i],
+                                           "Range bounds")).reshape(())
+                .item() for i in range(3)]
         r = jnp.arange(vals[0], vals[1], vals[2])
     elif op == "Flatten":
         ax = a.get("axis", 1)

@@ -650,3 +650,120 @@ def test_onnx_load_constant_feeds_shape_input(tmp_path):
     x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
     np.testing.assert_array_equal(np.asarray(fn(x)[0]),
                                   x.reshape(2, -1))
+
+
+def test_onnx_load_real_pytorch_export(tmp_path):
+    """TRUE cross-toolchain interop: PyTorch's own ONNX exporter (its
+    C++ proto writer) produces the file; our importer runs it.  Also
+    independently validates the schema transcription — torch writes the
+    REAL upstream field numbers, so any mismatch in onnx_subset.proto
+    would mis-parse here.  (The tiny sys.modules shim only replaces the
+    onnx CHECKER torch imports; the bytes are torch's own.)"""
+    import sys
+    import types
+
+    torch = pytest.importorskip("torch")
+    tnn = torch.nn
+
+    from paddle_tpu.onnx import onnx_subset_pb2 as opb
+    from paddle_tpu.onnx import load_onnx
+
+    saved = {k: sys.modules.get(k)
+             for k in ("onnx", "onnx.checker", "onnx.shape_inference")}
+    onnx_stub = types.ModuleType("onnx")
+    onnx_stub.__version__ = "1.16.0"
+    onnx_stub.ModelProto = opb.ModelProto
+    onnx_stub.TensorProto = opb.TensorProto
+    onnx_stub.load_from_string = opb.ModelProto.FromString
+    onnx_stub.load_model_from_string = opb.ModelProto.FromString
+    checker = types.ModuleType("onnx.checker")
+    checker.check_model = lambda *a, **k: None
+    onnx_stub.checker = checker
+    shape_inference = types.ModuleType("onnx.shape_inference")
+    shape_inference.infer_shapes = lambda m, *a, **k: m
+    onnx_stub.shape_inference = shape_inference
+    sys.modules["onnx"] = onnx_stub
+    sys.modules["onnx.checker"] = checker
+    sys.modules["onnx.shape_inference"] = shape_inference
+    try:
+        torch.manual_seed(0)
+        m = tnn.Sequential(
+            tnn.Conv2d(3, 8, 3, padding=1), tnn.BatchNorm2d(8),
+            tnn.ReLU(), tnn.MaxPool2d(2),
+            tnn.Flatten(), tnn.Linear(8 * 4 * 4, 5))
+        m.eval()
+        x = torch.randn(1, 3, 8, 8)
+        path = str(tmp_path / "torch_model.onnx")
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            torch.onnx.export(m, (x,), path, opset_version=17,
+                              input_names=["img"],
+                              output_names=["logits"], dynamo=False)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                sys.modules.pop(k, None)
+            else:
+                sys.modules[k] = v
+
+    fn, in_names, _ = load_onnx(path)
+    assert in_names == ["img"]
+    got = np.asarray(fn(x.numpy())[0])
+    ref = m(x).detach().numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_load_shape_arithmetic_chain(tmp_path):
+    """The dynamic-flatten pattern mainstream exporters emit
+    (Shape -> Gather -> Unsqueeze -> Concat -> Reshape): every value in
+    the chain is compile-time constant, so the importer must treat the
+    computed shape as static."""
+    from paddle_tpu.onnx import load_onnx
+
+    m = pb.ModelProto()
+    m.ir_version = 8
+    m.opset_import.add().version = 17
+    g = m.graph
+    g.name = "shape_chain"
+    vi = g.input.add()
+    vi.name = "x"
+    tt = vi.type.tensor_type
+    tt.elem_type = pb.TensorProto.FLOAT
+    for d in (2, 3, 4):
+        tt.shape.dim.add().dim_value = d
+    for name, arr in (("zero", np.asarray(0, np.int64)),
+                      ("ax0", np.asarray([0], np.int64)),
+                      ("minus1", np.asarray([-1], np.int64))):
+        t = g.initializer.add()
+        t.name = name
+        t.dims.extend(arr.shape)
+        t.data_type = pb.TensorProto.INT64
+        t.raw_data = arr.tobytes()
+
+    def node(op, ins, outs, **attrs):
+        n = g.node.add()
+        n.op_type = op
+        n.input.extend(ins)
+        n.output.extend(outs)
+        for k, v in attrs.items():
+            at = n.attribute.add()
+            at.name = k
+            at.type = pb.AttributeProto.INT
+            at.i = v
+        return n
+
+    node("Shape", ["x"], ["shp"])
+    node("Gather", ["shp", "zero"], ["b"], axis=0)
+    node("Unsqueeze", ["b", "ax0"], ["b1"])
+    node("Concat", ["b1", "minus1"], ["tgt"], axis=0)
+    node("Reshape", ["x", "tgt"], ["out"])
+    g.output.add().name = "out"
+    path = str(tmp_path / "chain.onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+
+    fn, _, _ = load_onnx(path)
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    np.testing.assert_array_equal(np.asarray(fn(x)[0]),
+                                  x.reshape(2, -1))
