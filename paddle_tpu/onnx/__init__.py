@@ -22,7 +22,8 @@ supported op subset compile onto the TPU through XLA.
 """
 from __future__ import annotations
 
-from .load import load_onnx  # noqa: F401
+from .load import (  # noqa: F401
+    load_onnx, load_onnx_layer, ONNXLayer)
 
 _CONVERTER = None
 

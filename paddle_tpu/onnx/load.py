@@ -109,15 +109,18 @@ def _try_fold(op, a, node, env):
     omnistaging every jnp op inside the trace produces a tracer — even
     over concrete values — which would break the exporter shape chains
     (Shape → Gather → Unsqueeze → Concat → Reshape, torch's
-    x.view(x.size(0), -1) pattern)."""
-    import jax
+    x.view(x.size(0), -1) pattern).  Only HOST numpy values qualify —
+    initializers and Constant/Shape outputs — never device arrays:
+    under no_grad, activations are concrete jax Arrays, and folding
+    them would execute the data graph on the host node by node (and
+    give Div different dtypes per mode)."""
     ins = []
     for nm in node.input:
         if nm == "":
             ins.append(None)
             continue
         v = env.get(nm)
-        if v is None or isinstance(v, jax.core.Tracer):
+        if not isinstance(v, (np.ndarray, np.generic)):
             return False
         ins.append(np.asarray(v))
     if op == "Gather":
@@ -144,8 +147,11 @@ def _try_fold(op, a, node, env):
     elif op == "Div":
         both_int = (np.issubdtype(ins[0].dtype, np.integer)
                     and np.issubdtype(ins[1].dtype, np.integer))
-        r = (np.floor_divide(ins[0], ins[1]) if both_int
-             else np.divide(ins[0], ins[1]))
+        if both_int:   # ONNX/C integer division truncates toward zero
+            r = np.trunc(np.true_divide(ins[0], ins[1])).astype(
+                np.result_type(ins[0], ins[1]))
+        else:
+            r = np.divide(ins[0], ins[1])
     elif op == "Cast":
         dt = _NP_DTYPE.get(a.get("to"))
         if dt is None:
@@ -155,7 +161,7 @@ def _try_fold(op, a, node, env):
         r = ins[0]
     else:
         return False
-    env[node.output[0]] = r
+    env[node.output[0]] = np.asarray(r)   # scalars stay host-static
     return True
 
 
@@ -527,6 +533,21 @@ def _io_spec(vi):
     return shape, _NP_DTYPE.get(tt.elem_type)
 
 
+def _parse_graph(path):
+    """Parse a model file into (graph, consts, input_names,
+    output_names, input_specs) — shared by load_onnx and the trainable
+    layer import."""
+    model = pb.ModelProto()
+    with open(path, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+    consts = {t.name: _tensor_value(t) for t in g.initializer}
+    graph_inputs = [vi for vi in g.input if vi.name not in consts]
+    return (g, consts, [vi.name for vi in graph_inputs],
+            [vi.name for vi in g.output],
+            {vi.name: _io_spec(vi) for vi in graph_inputs})
+
+
 def load_onnx(path):
     """Parse a .onnx file into `(module, input_names, output_names)`
     where `module(*arrays)` is a jit-compiled callable over the graph
@@ -537,15 +558,8 @@ def load_onnx(path):
     import jax.numpy as jnp
     from jax import lax
 
-    model = pb.ModelProto()
-    with open(path, "rb") as f:
-        model.ParseFromString(f.read())
-    g = model.graph
-    consts = {t.name: _tensor_value(t) for t in g.initializer}
-    graph_inputs = [vi for vi in g.input if vi.name not in consts]
-    input_names = [vi.name for vi in graph_inputs]
-    output_names = [vi.name for vi in g.output]
-    input_specs = {vi.name: _io_spec(vi) for vi in graph_inputs}
+    g, consts, input_names, output_names, input_specs = \
+        _parse_graph(path)
 
     def run(*arrays):
         if len(arrays) != len(input_names):
@@ -561,3 +575,97 @@ def load_onnx(path):
 
     return (OnnxModule(jax.jit(run), input_specs, output_names),
             input_names, output_names)
+
+
+_LAYER_CLS = None
+
+
+def _layer_cls():
+    """The nn.Layer subclass is built lazily (nn imports would cycle at
+    module import time) and registered module-level so instances pickle
+    and isinstance checks work."""
+    global _LAYER_CLS
+    if _LAYER_CLS is not None:
+        return _LAYER_CLS
+
+    import jax.numpy as jnp
+    from jax import lax
+    from ..nn import Layer
+    from ..core.tensor import Tensor, Parameter
+    from ..core.dispatch import apply_op
+
+    class ONNXLayerImpl(Layer):
+        """An imported ONNX graph as a TRAINABLE layer: float-array
+        initializers become Parameters (gradients flow through the tape
+        to them), int/scalar initializers stay constants so the
+        exporter shape chains remain static.  Import a torch/whatever
+        export and FINE-TUNE it on the TPU — a capability the
+        reference's paddle2onnx shim (export-only) has no analog for."""
+
+        def __init__(self, path, trainable=True):
+            super().__init__()
+            g, consts, input_names, output_names, _specs = \
+                _parse_graph(path)
+            self._onnx_graph = g
+            self._onnx_consts = consts
+            self._onnx_inputs = input_names
+            self._onnx_outputs = output_names
+            # trainables: float tensors (incl. bfloat16 — its numpy
+            # dtype kind is 'V', so test via jnp) with data
+            self._onnx_param_names = sorted(
+                n for n, v in consts.items()
+                if trainable
+                and jnp.issubdtype(np.asarray(v).dtype, jnp.floating)
+                and np.asarray(v).ndim >= 1)
+            self._onnx_params = []
+            used = set()
+            for n in self._onnx_param_names:
+                safe = "p_" + n.replace(".", "_").replace("/", "_")
+                while safe in used:          # sanitization collisions
+                    safe += "_"
+                used.add(safe)
+                p = Parameter(np.asarray(consts[n]))
+                self.add_parameter(safe, p)
+                self._onnx_params.append(p)
+
+        def forward(self, *xs):
+            if len(xs) != len(self._onnx_inputs):
+                raise ValueError(
+                    f"expected {len(self._onnx_inputs)} inputs "
+                    f"{self._onnx_inputs}, got {len(xs)}")
+            g = self._onnx_graph
+            consts = self._onnx_consts
+            param_names = self._onnx_param_names
+            input_names = self._onnx_inputs
+            output_names = self._onnx_outputs
+            n_par = len(param_names)
+
+            def pure(*arrays):
+                par = arrays[:n_par]
+                ins = arrays[n_par:]
+                env = dict(consts)
+                for n, v in zip(param_names, par):
+                    env[n] = v
+                for n, v in zip(input_names, ins):
+                    env[n] = jnp.asarray(v)
+                for node in g.node:
+                    _run_node(jnp, lax, node, env)
+                return tuple(env[n] for n in output_names)
+
+            out = apply_op("onnx_layer", pure,
+                           tuple(self._onnx_params) + tuple(xs))
+            if isinstance(out, Tensor):
+                return out
+            return out[0] if len(out) == 1 else out
+
+    _LAYER_CLS = ONNXLayerImpl
+    return ONNXLayerImpl
+
+
+def load_onnx_layer(path, trainable=True):
+    """Import a .onnx file as a trainable nn.Layer (see ONNXLayerImpl)."""
+    return _layer_cls()(path, trainable=trainable)
+
+
+# kept as a factory alias for API symmetry with load_onnx
+ONNXLayer = load_onnx_layer

@@ -767,3 +767,42 @@ def test_onnx_load_shape_arithmetic_chain(tmp_path):
     x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
     np.testing.assert_array_equal(np.asarray(fn(x)[0]),
                                   x.reshape(2, -1))
+
+
+def test_onnx_layer_fine_tunes_imported_model(tmp_path):
+    """ONNXLayer: an imported graph whose float initializers are live
+    Parameters — fine-tuning a (here: our own exported) model drops the
+    loss and moves the weights, with the int shape chain left static."""
+    from paddle_tpu.onnx import ONNXLayer
+
+    paddle.seed(31)
+    src_model = nn.Sequential(nn.Linear(6, 12), nn.Tanh(),
+                              nn.Linear(12, 3))
+    p = paddle.onnx.export(
+        src_model, str(tmp_path / "ft.onnx"),
+        input_spec=[paddle.jit.InputSpec([8, 6], "float32", name="x")])
+
+    layer = ONNXLayer(p)
+    params = layer.parameters()
+    assert len(params) == 4           # 2 weights + 2 biases
+    w0 = params[0].numpy().copy()
+    opt = paddle.optimizer.SGD(0.05, parameters=params)
+    rng = np.random.default_rng(31)
+    x = paddle.to_tensor(rng.standard_normal((8, 6)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 3, (8,)).astype(np.int64))
+    loss_fn = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(12):
+        loss = loss_fn(layer(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert all(b < a for a, b in zip(losses, losses[1:])), losses
+    assert losses[-1] < 0.8 * losses[0], losses
+    assert not np.allclose(params[0].numpy(), w0)
+    # the import still matches the source model BEFORE training drift:
+    fresh = ONNXLayer(p)
+    out = fresh(x).numpy()
+    ref = src_model(x).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
