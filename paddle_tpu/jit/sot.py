@@ -22,8 +22,8 @@ effects (print/log of a loss value) therefore fire on EVERY call, and
 the matmuls on either side stay compiled.
 
 Granularity is sub-statement: a host read nested inside a compound
-statement (for/while/if/with) no longer drops the whole statement to
-eager — the compound's header (iteration protocol, test, context enter)
+statement (for/while/if/with/try — including except handlers and
+finally) no longer drops the whole statement to eager — the compound's header (iteration protocol, test, context enter)
 executes eagerly, while maximal non-breaking statement runs INSIDE its
 body are compiled as their own segments, recursively (reference analog:
 the opcode simulator's sub-statement graphs,
@@ -122,6 +122,8 @@ def _names_stored(stmts):
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                    ast.ClassDef)):
                 stored.add(node.name)
+            elif isinstance(node, ast.ExceptHandler) and node.name:
+                stored.add(node.name)   # `except E as e` binds a string
     return stored
 
 
@@ -343,9 +345,12 @@ def _make_inner_segment(ctx, run):
     return call_name
 
 
-def _transform_stmts(ctx, stmts):
+def _transform_stmts(ctx, stmts, max_run=None):
     """Replace maximal non-breaking runs in a compound body with compiled
-    segment call sites; recurse into nested breaking compounds."""
+    segment call sites; recurse into nested breaking compounds.
+    `max_run=1` compiles per STATEMENT — used inside try statements so a
+    raise mid-run cannot discard earlier statements' assignments that an
+    eager except handler would observe."""
     out, run = [], []
 
     def flush():
@@ -368,9 +373,12 @@ def _transform_stmts(ctx, stmts):
         brk = any(s.lineno <= ln <= end for ln in ctx.break_rel)
         if not brk and not _outward_loop_ctl([s]):
             run.append(s)
+            if max_run is not None and len(run) >= max_run:
+                flush()
             continue
         flush()
-        if brk and isinstance(s, (ast.For, ast.While, ast.If, ast.With)):
+        if brk and isinstance(s, (ast.For, ast.While, ast.If, ast.With,
+                                  ast.Try)):
             out.append(_split_compound(ctx, s))
         else:
             out.append(s)
@@ -380,11 +388,22 @@ def _transform_stmts(ctx, stmts):
 
 def _split_compound(ctx, stmt):
     """Split INSIDE a breaking compound statement: the header stays eager,
-    non-breaking runs in its bodies compile."""
-    for field in ("body", "orelse"):
+    non-breaking runs in its bodies compile.  Inside a `try` every
+    segment holds ONE statement: a raise mid-segment discards that
+    segment's writes, so multi-statement runs could hide assignments an
+    eager except/finally would observe — per-statement segments keep
+    the handler-visible state identical to eager while the heavy calls
+    still compile."""
+    per_stmt = 1 if isinstance(stmt, ast.Try) else None
+    for field in ("body", "orelse", "finalbody"):
         body = getattr(stmt, field, None)
         if body:
-            setattr(stmt, field, _transform_stmts(ctx, body))
+            setattr(stmt, field,
+                    _transform_stmts(ctx, body, max_run=per_stmt))
+    for handler in getattr(stmt, "handlers", []) or []:
+        if handler.body:
+            handler.body = _transform_stmts(ctx, handler.body,
+                                            max_run=per_stmt)
     return stmt
 
 
@@ -455,7 +474,7 @@ def build_piecewise(fn, break_lines_abs, warmups=1):
             # breaking COMPOUND splits further inside its body
             split = [_split_compound(ctx, s)
                      if isinstance(s, (ast.For, ast.While, ast.If,
-                                       ast.With)) else s
+                                       ast.With, ast.Try)) else s
                      for s in stmts]
             body = [_RewriteEagerReturn().visit(s) for s in split]
             mod = ast.Module(body=body, type_ignores=[])

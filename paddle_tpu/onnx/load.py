@@ -148,8 +148,11 @@ def _try_fold(op, a, node, env):
         both_int = (np.issubdtype(ins[0].dtype, np.integer)
                     and np.issubdtype(ins[1].dtype, np.integer))
         if both_int:   # ONNX/C integer division truncates toward zero
-            r = np.trunc(np.true_divide(ins[0], ins[1])).astype(
-                np.result_type(ins[0], ins[1]))
+            q = np.floor_divide(ins[0], ins[1])
+            rem = ins[0] - q * ins[1]
+            # floor -> trunc: +1 where signs differ and remainder exists
+            # (exact for the full int64 range, no float round-trip)
+            r = q + ((rem != 0) & ((ins[0] < 0) != (ins[1] < 0)))
         else:
             r = np.divide(ins[0], ins[1])
     elif op == "Cast":
@@ -580,10 +583,18 @@ def load_onnx(path):
 _LAYER_CLS = None
 
 
+def __getattr__(name):
+    # PEP 562: let pickle (and user code) resolve the lazily-built class
+    # by module attribute
+    if name == "ONNXLayerImpl":
+        return _layer_cls()
+    raise AttributeError(name)
+
+
 def _layer_cls():
-    """The nn.Layer subclass is built lazily (nn imports would cycle at
-    module import time) and registered module-level so instances pickle
-    and isinstance checks work."""
+    """The nn.Layer subclass is built lazily (an eager nn import would
+    cycle at module import time); __qualname__/__module__ point at this
+    module's PEP-562 attribute so instances pickle."""
     global _LAYER_CLS
     if _LAYER_CLS is not None:
         return _LAYER_CLS
@@ -606,6 +617,8 @@ def _layer_cls():
             super().__init__()
             g, consts, input_names, output_names, _specs = \
                 _parse_graph(path)
+            self._onnx_path = path
+            self._onnx_trainable = trainable
             self._onnx_graph = g
             self._onnx_consts = consts
             self._onnx_inputs = input_names
@@ -627,6 +640,20 @@ def _layer_cls():
                 p = Parameter(np.asarray(consts[n]))
                 self.add_parameter(safe, p)
                 self._onnx_params.append(p)
+
+        def __getstate__(self):
+            # proto objects don't pickle; rebuild from the file and
+            # carry the LIVE weights (fine-tuned state survives)
+            return {"path": self._onnx_path,
+                    "trainable": self._onnx_trainable,
+                    "params": [np.asarray(p._data_)
+                               for p in self._onnx_params]}
+
+        def __setstate__(self, state):
+            self.__init__(state["path"],
+                          trainable=state["trainable"])
+            for p, arr in zip(self._onnx_params, state["params"]):
+                p.set_value(arr)
 
         def forward(self, *xs):
             if len(xs) != len(self._onnx_inputs):
@@ -658,6 +685,8 @@ def _layer_cls():
                 return out
             return out[0] if len(out) == 1 else out
 
+    ONNXLayerImpl.__module__ = __name__
+    ONNXLayerImpl.__qualname__ = "ONNXLayerImpl"
     _LAYER_CLS = ONNXLayerImpl
     return ONNXLayerImpl
 

@@ -1274,3 +1274,34 @@ def test_piecewise_generator_callee_degrades_correctly():
     assert all(abs(v - ref) / max(abs(ref), 1.0) < 1e-4 for v in vals)
     # the generator's python effect fired on every call
     assert len(logged) == 12
+
+
+def test_piecewise_split_inside_try_body():
+    """A host read inside a try body: the per-iteration compute around
+    it still compiles (inner segments), and an exception raised by a
+    compiled segment unwinds into the EAGER handler."""
+    logged = []
+    paddle.seed(33)
+    model = nn.Linear(4, 4)
+
+    @paddle.jit.to_static
+    def run(x):
+        total = paddle.zeros([])
+        try:
+            h = paddle.tanh(model(x))
+            logged.append(float(h.sum()))     # break inside try body
+            total = total + (h * 2).sum()
+        except ValueError:
+            total = total - 1.0
+        return total
+
+    x = paddle.ones([2, 4])
+    with paddle.no_grad():
+        h = paddle.tanh(model(x))
+        ref = float((h * 2).sum())
+    vals = [float(run(x)) for _ in range(3)]
+    assert all(abs(v - ref) < 1e-4 for v in vals)
+    assert len(logged) == 3
+    state = run._cache[run._canon_key((x,), {})]
+    assert state.piecewise is not None
+    assert state.piecewise._inner_segments

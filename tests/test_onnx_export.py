@@ -806,3 +806,22 @@ def test_onnx_layer_fine_tunes_imported_model(tmp_path):
     out = fresh(x).numpy()
     ref = src_model(x).numpy()
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_layer_pickles_with_live_weights(tmp_path):
+    """ONNXLayer pickles by (path, live weights): a fine-tuned layer
+    survives serialization with its trained state."""
+    import pickle
+
+    paddle.seed(37)
+    src = nn.Sequential(nn.Linear(4, 4))
+    p = paddle.onnx.export(
+        src, str(tmp_path / "pk.onnx"),
+        input_spec=[paddle.jit.InputSpec([2, 4], "float32", name="x")])
+    from paddle_tpu.onnx import load_onnx_layer
+    layer = load_onnx_layer(p)
+    layer.parameters()[0].set_value(
+        layer.parameters()[0].numpy() + 1.0)   # "fine-tuned" state
+    layer2 = pickle.loads(pickle.dumps(layer))
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(layer2(x).numpy(), layer(x).numpy())
