@@ -59,6 +59,21 @@ def main():
           f"{len(m.graph.initializer)} initializers, "
           f"opset {m.opset_import[0].version}")
 
+    # ...and back: the file imports as a TRAINABLE layer (float
+    # initializers become live Parameters) — fine-tune an ONNX model
+    from paddle_tpu.onnx import load_onnx_layer
+    ft = load_onnx_layer(path)
+    ft_opt = paddle.optimizer.SGD(0.05, parameters=ft.parameters())
+    x = paddle.to_tensor(feed["x"])
+    y = paddle.to_tensor(feed["y"])
+    for step in range(5):
+        loss = loss_fn(ft(x), y)
+        loss.backward()
+        ft_opt.step()
+        ft_opt.clear_grad()
+    print(f"fine-tuned the imported model: loss={float(loss):.4f} "
+          f"({len(ft.parameters())} live parameters)")
+
 
 if __name__ == "__main__":
     main()
