@@ -346,6 +346,14 @@ def _emit_eqn(em, eqn):
         out(em.node("CumSum", [ins[0], axis],
                     exclusive=0,
                     reverse=int(bool(params.get("reverse", False)))))
+    elif p == "split":
+        sizes = em.const(np.array(params["sizes"], np.int64))
+        outs = em.node("Split", [ins[0], sizes],
+                       n_out=len(eqn.outvars),
+                       axis=int(params["axis"]))
+        outs = outs if isinstance(outs, list) else [outs]
+        for ov, name in zip(eqn.outvars, outs):
+            em.bind(ov, name)
     elif p == "sort":
         if params.get("num_keys", 1) != 1:
             raise UnsupportedOp(

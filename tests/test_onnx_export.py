@@ -825,3 +825,29 @@ def test_onnx_layer_pickles_with_live_weights(tmp_path):
     layer2 = pickle.loads(pickle.dumps(layer))
     x = paddle.to_tensor(np.ones((2, 4), np.float32))
     np.testing.assert_allclose(layer2(x).numpy(), layer(x).numpy())
+
+
+def test_onnx_llama_round_trip(tmp_path):
+    """LLaMA (GQA attention, rotary embeddings via the split primitive,
+    RMSNorm, SiLU) exports to ONNX and reimports with matching
+    numerics."""
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.onnx import load_onnx
+
+    paddle.seed(41)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, intermediate_size=64,
+                      max_seq_len=16, use_flash_attention=False)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    p = paddle.onnx.export(
+        m, str(tmp_path / "llama.onnx"),
+        input_spec=[paddle.jit.InputSpec([1, 8], "int32", name="ids")])
+    fn, _, _ = load_onnx(p)
+    ids = np.random.default_rng(41).integers(0, 64, (1, 8),
+                                             dtype=np.int32)
+    out = m(paddle.to_tensor(ids))
+    ref = (out[0] if isinstance(out, (tuple, list)) else out).numpy()
+    np.testing.assert_allclose(np.asarray(fn(ids)[0]), ref,
+                               rtol=1e-3, atol=1e-4)
