@@ -213,15 +213,46 @@ def _emit_eqn(em, eqn):
                 or dn.out_spec[:2] != (0, 1)):
             raise UnsupportedOp(
                 f"conv layout {dn} (only NC-major supported)")
-        if any(d != 1 for d in params["lhs_dilation"]):
-            raise UnsupportedOp(
-                "input-dilated (transposed) conv has no plain Conv "
-                "mapping")
         if params.get("batch_group_count", 1) != 1:
             raise UnsupportedOp("batch_group_count != 1")
+        data = ins[0]
+        ld = params["lhs_dilation"]
+        if any(d != 1 for d in ld):
+            # input-dilated (transposed) conv: zero-stuff the input
+            # spatially — Reshape [N,C,D,1,...] → Pad the size-1 axes to
+            # the dilation factor → Reshape back → Slice the trailing
+            # zeros — then run a plain Conv.  Static shapes make every
+            # step a constant-shape op any ONNX runtime executes.
+            xshape = eqn.invars[0].aval.shape
+            n, c = xshape[0], xshape[1]
+            spatial = list(xshape[2:])
+            k = len(spatial)
+            interp = [v for d in spatial for v in (d, 1)]
+            r = em.node("Reshape", [data, em.const(
+                np.array([n, c] + interp, np.int64), "shape")])
+            rank = 2 + 2 * k
+            pad_vec = [0] * rank * 2
+            for i, s in enumerate(ld):
+                pad_vec[rank + 3 + 2 * i] = s - 1   # end-pad axis 3+2i
+            zero = em.const(np.zeros((), eqn.invars[0].aval.dtype))
+            r = em.node("Pad", [r, em.const(
+                np.array(pad_vec, np.int64)), zero], mode="constant")
+            stuffed = [d * s for d, s in zip(spatial, ld)]
+            r = em.node("Reshape", [r, em.const(
+                np.array([n, c] + stuffed, np.int64), "shape")])
+            want = [(d - 1) * s + 1 for d, s in zip(spatial, ld)]
+            r = em.node("Slice", [
+                r,
+                em.const(np.zeros(k, np.int64)),
+                em.const(np.array(want, np.int64)),
+                em.const(np.arange(2, 2 + k, dtype=np.int64)),
+                em.const(np.ones(k, np.int64))])
+            data = r
         pads = params["padding"]
+        if any(lo < 0 or hi < 0 for lo, hi in pads):
+            raise UnsupportedOp(f"negative conv padding {pads}")
         out(em.node(
-            "Conv", ins,
+            "Conv", [data, ins[1]],
             strides=list(params["window_strides"]),
             pads=[lo for lo, _ in pads] + [hi for _, hi in pads],
             dilations=list(params["rhs_dilation"]),

@@ -851,3 +851,25 @@ def test_onnx_llama_round_trip(tmp_path):
     ref = (out[0] if isinstance(out, (tuple, list)) else out).numpy()
     np.testing.assert_allclose(np.asarray(fn(ids)[0]), ref,
                                rtol=1e-3, atol=1e-4)
+
+
+def test_onnx_conv_transpose_round_trip(tmp_path):
+    """Transposed conv (decoder/segmentation models) exports via the
+    zero-stuffing decomposition (Reshape/Pad/Slice + plain Conv) and
+    reimports exactly."""
+    from paddle_tpu.onnx import load_onnx
+
+    paddle.seed(43)
+    model = nn.Sequential(nn.Conv2DTranspose(4, 2, 3, stride=2,
+                                             padding=1), nn.ReLU())
+    model.eval()
+    spec = [paddle.jit.InputSpec([1, 4, 5, 5], "float32", name="x")]
+    x = np.random.default_rng(43).standard_normal(
+        (1, 4, 5, 5)).astype(np.float32)
+    p = paddle.onnx.export(model, str(tmp_path / "ct.onnx"),
+                           input_spec=spec)
+    fn, _, _ = load_onnx(p)
+    got = np.asarray(fn(x)[0])
+    ref = model(paddle.to_tensor(x)).numpy()
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
