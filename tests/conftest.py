@@ -105,6 +105,7 @@ _SLOW_TESTS = {
     "test_pipeline.py::test_pipeline_train_batch_matches_grad_accumulation",  # 13; hetero + schedule tests keep pp fast coverage
     "test_onnx_export.py::test_onnx_zoo_exports_and_reimports[alexnet]",  # 13; pooling/gpt round-trips stay fast
     "test_onnx_export.py::test_onnx_zoo_exports_and_reimports[resnet18]",
+    "test_onnx_export.py::test_onnx_zoo_exports_and_reimports[mobilenet_v2]",
 }
 
 

@@ -487,7 +487,7 @@ def test_onnx_pooling_round_trip(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("family", ["alexnet", "resnet18"])
+@pytest.mark.parametrize("family", ["alexnet", "resnet18", "mobilenet_v2"])
 def test_onnx_zoo_exports_and_reimports(tmp_path, family):
     """Real vision-zoo models (conv/BN/pool/residual stacks) export to
     ONNX and reimport with matching numerics — the model-family
