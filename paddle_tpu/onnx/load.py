@@ -168,6 +168,90 @@ def _try_fold(op, a, node, env):
     return True
 
 
+def _resize(jnp, a, node, env, x, has):
+    """ONNX Resize with EXACT coordinate semantics: output sizes are
+    static, so per-axis source indices (nearest) or neighbor pairs +
+    lerp weights (linear) precompute with numpy for the declared
+    coordinate_transformation_mode — no approximately-right fallback."""
+    mode = a.get("mode", "nearest")
+    coord = a.get("coordinate_transformation_mode", "half_pixel")
+    nearest_mode = a.get("nearest_mode", "round_prefer_floor")
+    if a.get("antialias"):
+        raise UnsupportedOp("Resize antialias=1")
+    if a.get("exclude_outside"):
+        raise UnsupportedOp("Resize exclude_outside=1")
+    data = x()
+    in_shape = data.shape
+    nd = len(in_shape)
+    # sizes (input 3) or scales (input 2); when scales drive the op the
+    # DECLARED scale enters the coordinate formula (the out/in ratio
+    # differs whenever in*scale is non-integer)
+    declared_scales = None
+    if has(3):
+        sizes = _static_ints(env, node.input[3], "Resize sizes")
+    elif has(2):
+        declared_scales = np.asarray(
+            _require_static(env, node.input[2], "Resize scales"),
+            np.float64).reshape(-1)
+        sizes = [int(np.floor(d * s))
+                 for d, s in zip(in_shape, declared_scales)]
+    else:
+        raise UnsupportedOp("Resize without sizes or scales")
+    if len(sizes) != nd:
+        raise UnsupportedOp(f"Resize rank mismatch {sizes} vs {in_shape}")
+
+    def src_coords(out_sz, in_sz, ax):
+        i = np.arange(out_sz, dtype=np.float64)
+        scale = (declared_scales[ax] if declared_scales is not None
+                 else out_sz / in_sz)
+        if coord == "half_pixel":
+            return (i + 0.5) / scale - 0.5
+        if coord == "asymmetric":
+            return i / scale
+        if coord == "align_corners":
+            if out_sz == 1:
+                return np.zeros(out_sz)
+            return i * (in_sz - 1) / (out_sz - 1)
+        raise UnsupportedOp(
+            f"Resize coordinate_transformation_mode={coord!r}")
+
+    r = data
+    for ax in range(nd):
+        out_sz, in_sz = sizes[ax], in_shape[ax]
+        if out_sz == in_sz:
+            continue
+        xc = src_coords(out_sz, in_sz, ax)
+        if mode == "nearest":
+            if nearest_mode == "floor":
+                idx = np.floor(xc)
+            elif nearest_mode == "ceil":
+                idx = np.ceil(xc)
+            elif nearest_mode == "round_prefer_floor":
+                idx = np.ceil(xc - 0.5)
+            elif nearest_mode == "round_prefer_ceil":
+                idx = np.floor(xc + 0.5)
+            else:
+                raise UnsupportedOp(
+                    f"Resize nearest_mode={nearest_mode!r}")
+            idx = np.clip(idx, 0, in_sz - 1).astype(np.int64)
+            r = jnp.take(r, idx, axis=ax)
+        elif mode == "linear":
+            lo = np.clip(np.floor(xc), 0, in_sz - 1).astype(np.int64)
+            hi = np.clip(lo + 1, 0, in_sz - 1)
+            w = np.clip(xc - lo, 0.0, 1.0)
+            shape = [1] * r.ndim
+            shape[ax] = out_sz
+            # weights follow the data dtype: output dtype must equal
+            # input dtype per the Resize contract (no f32 promotion)
+            wv = jnp.asarray(w.reshape(shape), r.dtype)
+            one = jnp.asarray(1.0, r.dtype)
+            r = (jnp.take(r, lo, axis=ax) * (one - wv)
+                 + jnp.take(r, hi, axis=ax) * wv)
+        else:
+            raise UnsupportedOp(f"Resize mode={mode!r}")
+    return r
+
+
 def _run_node(jnp, lax, node, env):
     op = node.op_type
     a = _attrs(node)
@@ -390,6 +474,8 @@ def _run_node(jnp, lax, node, env):
     elif op == "GlobalAveragePool":
         spatial = tuple(range(2, np.ndim(x())))
         r = jnp.mean(x(), axis=spatial, keepdims=True)
+    elif op == "Resize":
+        r = _resize(jnp, a, node, env, x, has)
     elif op == "MatMul":
         r = jnp.matmul(x(), x(1))
     elif op == "Gemm":

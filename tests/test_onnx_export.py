@@ -873,3 +873,58 @@ def test_onnx_conv_transpose_round_trip(tmp_path):
     ref = model(paddle.to_tensor(x)).numpy()
     assert got.shape == ref.shape
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_load_resize_modes(tmp_path):
+    """Resize (foreign upsampling) with exact coordinate semantics:
+    nearest/asymmetric doubles pixels; linear/half_pixel matches the
+    reference interpolation formula."""
+    from paddle_tpu.onnx import load_onnx
+
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+
+    def build(mode, coord, out_hw):
+        m = pb.ModelProto()
+        m.ir_version = 8
+        m.opset_import.add().version = 17
+        g = m.graph
+        g.name = "resize"
+        vi = g.input.add()
+        vi.name = "x"
+        tt = vi.type.tensor_type
+        tt.elem_type = pb.TensorProto.FLOAT
+        for d in (1, 1, 4, 4):
+            tt.shape.dim.add().dim_value = d
+        t = g.initializer.add()
+        t.name = "sizes"
+        t.dims.append(4)
+        t.data_type = pb.TensorProto.INT64
+        t.raw_data = np.asarray([1, 1, *out_hw], np.int64).tobytes()
+        n = g.node.add()
+        n.op_type = "Resize"
+        n.input.extend(["x", "", "", "sizes"])
+        n.output.append("y")
+        for k, v in (("mode", mode),
+                     ("coordinate_transformation_mode", coord)):
+            at = n.attribute.add()
+            at.name = k
+            at.type = pb.AttributeProto.STRING
+            at.s = v.encode()
+        g.output.add().name = "y"
+        path = str(tmp_path / f"{mode}_{coord}.onnx")
+        with open(path, "wb") as f:
+            f.write(m.SerializeToString())
+        return path
+
+    # nearest/asymmetric 2x: each pixel duplicates
+    fn, _, _ = load_onnx(build("nearest", "asymmetric", (8, 8)))
+    got = np.asarray(fn(x)[0])
+    np.testing.assert_array_equal(got, x.repeat(2, 2).repeat(2, 3))
+
+    # linear/align_corners: endpoints preserved, midpoints averaged
+    fn, _, _ = load_onnx(build("linear", "align_corners", (7, 7)))
+    got = np.asarray(fn(x)[0])
+    assert got[0, 0, 0, 0] == x[0, 0, 0, 0]
+    assert got[0, 0, -1, -1] == x[0, 0, -1, -1]
+    np.testing.assert_allclose(got[0, 0, 0, 1],
+                               (x[0, 0, 0, 0] + x[0, 0, 0, 1]) / 2)
