@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Eager op-dispatch overhead microbench: tier-1 op cache on vs off.
+
+Measures ops/sec over a representative eager op loop — a 3-layer MLP
+forward chain (matmul, add, relu, ... , sum) over grad-tracked tensors,
+plus the full fwd+bwd train-style step — with the tier-1 executable
+cache (core/op_cache.py, FLAGS_eager_op_cache) enabled and disabled in
+the same process.  The uncached mode pays JAX eager dispatch plus a
+fresh jax.vjp trace per op; the cached mode replays one jitted
+executable per op signature.
+
+Prints ONE JSON line and (unless --no-write) records the full result at
+benchmarks/EAGER_OVERHEAD.json next to the other bench artifacts.
+`--smoke` shrinks the iteration counts for CI (tools/run_ci.sh), which
+then validates the JSON schema via tools/check_bench_result.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# ops per fwd() call: 3 x (matmul, add, relu) + sum
+_OPS_PER_FWD = 10
+
+
+def _build(paddle):
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((32, 64)).astype(np.float32),
+                         stop_gradient=False)
+    ws = [paddle.to_tensor(
+        (rng.standard_normal((64, 64)) * 0.05).astype(np.float32),
+        stop_gradient=False) for _ in range(3)]
+    bs = [paddle.to_tensor(np.zeros(64, np.float32), stop_gradient=False)
+          for _ in range(3)]
+    F = paddle.nn.functional
+
+    def fwd():
+        h = x
+        for w, b in zip(ws, bs):
+            h = F.relu(paddle.add(paddle.matmul(h, w), b))
+        return h.sum()
+
+    def step():
+        loss = fwd()
+        loss.backward()
+        for p in ws + bs + [x]:
+            p.clear_grad()
+        return loss
+
+    return fwd, step
+
+
+def _time_loop(fn, iters, jax):
+    fn()                       # warm (compiles on the cached pass)
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out._data_)
+    return time.perf_counter() - t0, float(out)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny iteration counts for CI")
+    ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "EAGER_OVERHEAD.json"))
+    ap.add_argument("--no-write", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.core import op_cache
+    from paddle_tpu.utils import cache_stats
+
+    iters = args.iters or (40 if args.smoke else 200)
+    paddle.seed(0)
+    fwd, step = _build(paddle)
+
+    results = {}
+    losses = {}
+    stats = None
+    for mode, label in ((True, "cached"), (False, "uncached")):
+        op_cache.clear()
+        paddle.set_flags({"FLAGS_eager_op_cache": mode})
+        dt_fwd, _ = _time_loop(fwd, iters, jax)
+        dt_step, loss = _time_loop(step, max(iters // 4, 5), jax)
+        results[label] = {
+            "fwd_ops_per_sec": round(iters * _OPS_PER_FWD / dt_fwd, 1),
+            "step_ops_per_sec": round(
+                max(iters // 4, 5) * _OPS_PER_FWD / dt_step, 1),
+        }
+        losses[label] = loss
+        if mode:
+            stats = cache_stats()   # snapshot before clear() wipes tier 1
+    paddle.set_flags({"FLAGS_eager_op_cache": True})
+
+    if not np.allclose(losses["cached"], losses["uncached"],
+                       rtol=1e-5, atol=1e-6):
+        print(f"PARITY FAILURE: cached loss {losses['cached']} != "
+              f"uncached {losses['uncached']}", file=sys.stderr)
+        return 1
+
+    speedup_fwd = (results["cached"]["fwd_ops_per_sec"]
+                   / results["uncached"]["fwd_ops_per_sec"])
+    speedup_step = (results["cached"]["step_ops_per_sec"]
+                    / results["uncached"]["step_ops_per_sec"])
+    rec = {
+        "metric": "eager_op_dispatch_ops_per_sec",
+        "value": results["cached"]["fwd_ops_per_sec"],
+        "unit": "ops/sec",
+        "speedup_vs_uncached": round(speedup_fwd, 3),
+        "step_speedup_vs_uncached": round(speedup_step, 3),
+        "cached": results["cached"],
+        "uncached": results["uncached"],
+        "loss": round(losses["cached"], 6),
+        "iters": iters,
+        "ops_per_fwd": _OPS_PER_FWD,
+        "smoke": bool(args.smoke),
+        "platform": jax.devices()[0].platform,
+        "tier1": {k: stats["tier1"][k]
+                  for k in ("hits", "misses", "evictions", "bypasses",
+                            "entries", "bytes")},
+    }
+    if not args.no_write:
+        try:
+            with open(args.out, "w") as f:
+                json.dump(rec, f, indent=1)
+        except OSError as e:
+            print(f"[eager_overhead] could not write {args.out}: {e}",
+                  file=sys.stderr)
+    print(json.dumps({k: rec[k] for k in
+                      ("metric", "value", "unit", "speedup_vs_uncached",
+                       "step_speedup_vs_uncached", "smoke")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

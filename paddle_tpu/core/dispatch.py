@@ -19,6 +19,7 @@ from .autograd import GradNode
 
 _DECOMP = None
 _PROF = None
+_OPC = None
 
 # Structural ops whose inputs are loop/branch state plus hoisted captures —
 # AMP casting them at the boundary would silently down/up-cast parameters
@@ -142,7 +143,22 @@ def apply_op(name, fn, args, static=None, nondiff=False):
     hooks = getattr(_state.STATE, "saved_tensor_hooks", None) \
         if need_grad else None
 
-    if hooks is not None:
+    # tiered executable cache (core/op_cache.py): repeated eager calls of
+    # the same op signature execute one cached jitted program instead of
+    # re-tracing/re-dispatching — the analogue of the reference's memoized
+    # KernelFactory::SelectKernelOrThrowError result.  cache_hit stays
+    # None on every bypass path (byte-for-byte today's behavior).
+    global _OPC
+    if _OPC is None:
+        from . import op_cache as _OPC
+    cache_hit = None
+    cached = None
+    if hooks is None:
+        cached = _OPC.tier1_execute(name, fn, pure, arrays, template,
+                                    static, need_grad)
+    if cached is not None:
+        out, vjp_fn, cache_hit = cached
+    elif hooks is not None:
         # saved_tensors_hooks active: do NOT linearize now — jax.vjp's
         # closure would pin every residual, defeating offload/quantize
         # hooks.  pack() the op inputs (as the op sees them, i.e. after
@@ -156,6 +172,7 @@ def apply_op(name, fn, args, static=None, nondiff=False):
         out, vjp_fn = jax.vjp(pure, *arrays)
     else:
         out = pure(*arrays)
+        vjp_fn = None
 
     single = not isinstance(out, (tuple, list))
     outs = (out,) if single else tuple(out)
@@ -163,7 +180,8 @@ def apply_op(name, fn, args, static=None, nondiff=False):
     if prof_on:
         _PROF.record_op_span(
             name, _t0, _time.perf_counter_ns(), outs,
-            tuple(tuple(getattr(a, "shape", ())) for a in arrays), static)
+            tuple(tuple(getattr(a, "shape", ())) for a in arrays), static,
+            cache_hit=cache_hit)
 
     fc = _state.STATE.flops_counter
     if fc is not None:

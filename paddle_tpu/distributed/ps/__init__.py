@@ -774,8 +774,11 @@ class PSClient:
     def pull_sparse(self, table_id, ids):
         raw = self._call_binary(_OP_PULL_SPARSE, table_id, ids)
         dim = _PULL_DIM.unpack_from(raw)[0]
+        # .copy(): frombuffer over the response frame is a read-only view
+        # (callers mutating pulled rows in place would raise), and the
+        # copy releases the full response buffer immediately
         return np.frombuffer(raw[_PULL_DIM.size:],
-                             np.float32).reshape(len(ids), dim)
+                             np.float32).reshape(len(ids), dim).copy()
 
     def push_sparse(self, table_id, ids, grad):
         self._call_binary(

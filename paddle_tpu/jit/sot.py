@@ -48,6 +48,37 @@ class _PWReturn(Exception):
         self.value = value
 
 
+class ScalarPromotionError(TypeError):
+    """A promoted scalar (0-d Tensor standing in for a python int) hit a
+    use promotion cannot serve — hashing for a dict key / set membership
+    test.  Raised ONLY by _PromotedScalar.__hash__, so _call_segment's
+    raw-int retry triggers on exactly this failure: an exception raised
+    by user code inside the segment (print/queue.put/RNG helpers, a
+    genuine ValueError) no longer causes a second execution."""
+
+
+_PROMOTED_CLS = None
+
+
+def _promoted_scalar_cls():
+    """Tensor subclass used for int promotion (lazy: sot must stay
+    importable without the core package loaded)."""
+    global _PROMOTED_CLS
+    if _PROMOTED_CLS is None:
+        from ..core.tensor import Tensor
+
+        class _PromotedScalar(Tensor):
+            __slots__ = ()
+
+            def __hash__(self):
+                raise ScalarPromotionError(
+                    "promoted scalar used as a dict key / set member; "
+                    "retrying the segment with the raw int")
+
+        _PROMOTED_CLS = _PromotedScalar
+    return _PROMOTED_CLS
+
+
 class _EnvNS(dict):
     """Execution namespace that falls back to the traced function's LIVE
     module globals.  Eager pieces exec with this as their single
@@ -250,28 +281,42 @@ def _pick_env(src, loads, seg=None):
                 if len(vals) < _INT_PROMOTE_AFTER:
                     vals.add(v)
                 if len(vals) >= _INT_PROMOTE_AFTER:
-                    v = Tensor(jnp.asarray(v, jnp.int32))
-                    promoted = True
+                    import jax
+                    if jax.config.jax_enable_x64:
+                        v = _promoted_scalar_cls()(
+                            jnp.asarray(v, jnp.int64))
+                        promoted = True
+                    elif abs(v) < 2 ** 31:
+                        v = _promoted_scalar_cls()(
+                            jnp.asarray(v, jnp.int32))
+                        promoted = True
+                    # else: int32 can't hold it and x64 is off — keep the
+                    # raw int (per-value compile) instead of silently
+                    # wrapping large ids/timestamps inside the segment
             env[k] = v
     return env, promoted
 
 
 def _call_segment(seg, src, loads):
-    """Invoke a segment with scalar promotion.  If a call with promoted
-    ints raises a host-container error (a dict lookup or set test on the
-    promoted value — uses Tensor.__index__ cannot cover), promotion is
-    disabled for this segment permanently and the call retries with raw
-    ints — restoring the pre-promotion per-value-compile behavior
-    instead of crashing.  Segments with visible in-place effects never
-    promote (_effectful_run at build time), so the retry cannot
-    double-apply a mutation; RuntimeError (e.g. the donated-buffer
-    failure) is never swallowed."""
+    """Invoke a segment with scalar promotion.  If a promoted int hits a
+    use promotion cannot serve — hashing for a dict key or set member,
+    which Tensor.__index__ cannot cover — the promoted stand-in raises
+    the ScalarPromotionError sentinel; promotion is then disabled for
+    this segment permanently and the call retries with raw ints,
+    restoring the pre-promotion per-value-compile behavior instead of
+    crashing.  ONLY the sentinel triggers the retry: a TypeError/
+    KeyError/ValueError raised by user code inside the segment
+    propagates, so effectful calls the _effectful_run heuristic cannot
+    see (print, queue.put, RNG draws behind helpers) are never
+    double-executed on a failure of their own.  (Statements preceding a
+    genuine sentinel raise within the same segment do re-run — segments
+    with syntactically visible in-place effects never promote at all.)"""
     env, promoted = _pick_env(src, loads, seg)
     if not promoted:
         return seg(env)
     try:
         return seg(env)
-    except (TypeError, KeyError, IndexError, ValueError):
+    except ScalarPromotionError:
         seg._pw_no_promote = True
         env, _ = _pick_env(src, loads, None)
         return seg(env)
@@ -412,6 +457,11 @@ def build_piecewise(fn, break_lines_abs, warmups=1):
     segments + eager break statements.  Returns a driver callable with
     eager-identical semantics, or None when the function can't be split
     (no source, breaks unresolvable, generator/coroutine)."""
+    try:
+        from ..core.op_cache import ensure_compile_cache
+        ensure_compile_cache()   # segments compile like any other program
+    except Exception:
+        pass
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(src)

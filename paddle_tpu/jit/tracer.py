@@ -524,6 +524,8 @@ class StaticFunction:
                         t.grad = None
 
         entry.pure = pure
+        from ..core.op_cache import ensure_compile_cache
+        ensure_compile_cache()   # tier-2 persistent XLA compilation cache
         entry.jitted = jax.jit(pure, static_argnums=(3,))
 
     def _build_donating(self, entry):
@@ -552,6 +554,8 @@ class StaticFunction:
                     ci += 1
             return pure(arg_arrays, caps, host_vals, arg_struct)
 
+        from ..core.op_cache import ensure_compile_cache
+        ensure_compile_cache()
         entry.jitted_donate = jax.jit(pure_donated, static_argnums=(4,),
                                       donate_argnums=(1,))
 

@@ -133,6 +133,11 @@ def _try_fold(op, a, node, env):
                 else a.get("axes"))
         r = ins[0]
         if op == "Unsqueeze":
+            if axes is None:
+                # malformed / older-opset node with neither an axes input
+                # nor attribute: decline to fold so the node falls through
+                # to _run_node's UnsupportedOp path instead of len(None)
+                return False
             nd = r.ndim + len(axes)
             for ax in sorted(ax % nd for ax in axes):
                 r = np.expand_dims(r, ax)
@@ -529,8 +534,13 @@ def _run_node(jnp, lax, node, env):
         r = jnp.squeeze(x(), axis=tuple(ax % np.ndim(x())
                                         for ax in axes))
     elif op == "Unsqueeze":
-        axes = (_static_ints(env, node.input[1], "Unsqueeze axes")
-                if has(1) else a["axes"])
+        if has(1):
+            axes = _static_ints(env, node.input[1], "Unsqueeze axes")
+        elif "axes" in a:
+            axes = a["axes"]
+        else:
+            raise UnsupportedOp(
+                "Unsqueeze with neither an axes input nor attribute")
         r = x()
         nd = np.ndim(r) + len(axes)
         for ax in sorted(ax % nd for ax in axes):
@@ -662,6 +672,8 @@ def load_onnx(path):
             _run_node(jnp, lax, node, env)
         return [env[n] for n in output_names]
 
+    from ..core.op_cache import ensure_compile_cache
+    ensure_compile_cache()   # tier-2 persistent XLA compilation cache
     return (OnnxModule(jax.jit(run), input_specs, output_names),
             input_names, output_names)
 

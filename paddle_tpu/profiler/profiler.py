@@ -93,7 +93,8 @@ def op_profiling_active():
     return any(not p.timer_only for p in _ACTIVE)
 
 
-def record_op_span(name, t0_ns, t1_ns, outs, shapes, static):
+def record_op_span(name, t0_ns, t1_ns, outs, shapes, static,
+                   cache_hit=None):
     """Record one eager op dispatch: host span + analytic FLOPs, and —
     when a device target is being profiled — the device-complete time
     measured by blocking on the op's outputs (the CUPTI/gpu_timer
@@ -120,6 +121,10 @@ def record_op_span(name, t0_ns, t1_ns, outs, shapes, static):
         args["flops"] = f
     if dev_dur_us is not None:
         args["device_dur"] = dev_dur_us
+    if cache_hit is not None:
+        # tier-1 op-cache annotation (core/op_cache.py): True = this
+        # dispatch replayed a cached jitted executable
+        args["cache_hit"] = bool(cache_hit)
     _HOST_BUFFER.add(name, t0_ns / 1e3, (t1_ns - t0_ns) / 1e3,
                      threading.get_ident() % 2 ** 31, "Operator",
                      args=args)

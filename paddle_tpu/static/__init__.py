@@ -79,8 +79,15 @@ class Program:
         # a training-built program clones as one (fresh executor, phases
         # restart); for_test=True strips the training build (reference:
         # clone(for_test=True) prunes backward/optimizer ops)
-        if self._train is not None and not for_test:
-            p.build(for_training=True)
+        if self._train is not None:
+            if for_test:
+                # the fwd+bwd+opt IR phase 1 wrote into _jaxpr must not
+                # masquerade as a compiled-inference program on the clone
+                p._jaxpr = None
+                p._compiled = None
+                p._use_compiled = False
+            else:
+                p.build(for_training=True)
         return p
 
     # ---- program IR (reference: ProgramDesc blocks/ops; here the IR is
@@ -110,6 +117,13 @@ class Program:
         if for_training:
             if self._fn is None:
                 raise ValueError("Program has no function bound")
+            # clear a prior inference build: its params-frozen jaxpr and
+            # compiled-path opt-in must not survive into (or be cloned
+            # out of) the training build — phase 1 rebuilds _jaxpr as the
+            # fwd+bwd+opt training IR
+            self._use_compiled = False
+            self._jaxpr = None
+            self._compiled = None
             self._train = _TrainExecutor(self)
             return self
         # (re)build for inference: a previous training build no longer
@@ -194,6 +208,8 @@ class Program:
         new executor InterpreterCore caching per program)."""
         import jax
         if self._compiled is None:
+            from ..core.op_cache import ensure_compile_cache
+            ensure_compile_cache()   # tier-2 persistent compilation cache
             closed = self._jaxpr
 
             def run(*xs):
@@ -213,6 +229,8 @@ class Program:
             return self._exported.call(params, *args)
         if self._qrun is None:
             import jax
+            from ..core.op_cache import ensure_compile_cache
+            ensure_compile_cache()
             from ..quantization import dequantize
             exp = self._exported
             scales = list(self._param_scales)
@@ -428,6 +446,8 @@ class _TrainExecutor:
                 return jax.core.eval_jaxpr(closed.jaxpr, closed.consts,
                                            *xs)
 
+            from ..core.op_cache import ensure_compile_cache
+            ensure_compile_cache()   # tier-2 persistent compilation cache
             self._jitted = jax.jit(run, donate_argnums=self._donate)
             self._entry = entry
             self._arg_struct = arg_struct

@@ -27,6 +27,17 @@ _FLAGS: dict[str, Any] = {
     # after donation such holds read a deleted buffer.  Captures aliasing
     # each other within one step are detected and skip donation.
     "FLAGS_jit_donate_buffers": True,
+    # tiered executable cache (core/op_cache.py).  Tier 1: jitted eager
+    # op dispatch — repeated same-signature eager op calls replay one
+    # cached XLA program instead of re-trace/re-dispatch; the LRU is
+    # bounded by FLAGS_eager_op_cache_size entries.  Tier 2: when
+    # FLAGS_compile_cache_dir names a directory, JAX's persistent
+    # compilation cache is enabled there, so re-runs skip XLA recompiles
+    # across processes (applies to to_static, static programs, sot
+    # segments, onnx modules, bench.py and tier-1 misses alike).
+    "FLAGS_eager_op_cache": True,
+    "FLAGS_eager_op_cache_size": 4096,
+    "FLAGS_compile_cache_dir": "",
 }
 
 

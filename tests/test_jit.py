@@ -1305,3 +1305,76 @@ def test_piecewise_split_inside_try_body():
     state = run._cache[run._canon_key((x,), {})]
     assert state.piecewise is not None
     assert state.piecewise._inner_segments
+
+
+def test_promoted_scalar_hash_raises_sentinel():
+    """ADVICE (medium): a promoted int used as a dict key / set member
+    raises the ScalarPromotionError sentinel — the ONLY exception that
+    triggers _call_segment's raw-int retry."""
+    import jax.numpy as jnp
+    from paddle_tpu.jit import sot
+
+    t = sot._promoted_scalar_cls()(jnp.asarray(3, jnp.int32))
+    with pytest.raises(sot.ScalarPromotionError):
+        {1: "a"}[t]
+    with pytest.raises(sot.ScalarPromotionError):
+        t in {1, 2}
+
+
+def test_call_segment_retry_only_on_sentinel():
+    """A user-code exception from a promoted segment call must propagate
+    (no retry — print/queue.put/RNG effects would double-execute); the
+    sentinel still retries with raw ints."""
+    import types
+    from paddle_tpu.jit import sot
+
+    def make_seg(exc):
+        calls = []
+
+        class Seg:
+            _pw_no_promote = False
+
+            def __call__(self, env):
+                calls.append(dict(env))
+                if len(calls) == 1:
+                    raise exc
+                return ("__pw_env__", env)
+
+        seg = Seg()
+        seg._pw_int_seen = {"k": set(range(sot._INT_PROMOTE_AFTER))}
+        return seg, calls
+
+    src = {"k": 99}
+    # user-code KeyError: exactly one execution, propagates
+    seg, calls = make_seg(KeyError("user dict"))
+    with pytest.raises(KeyError):
+        sot._call_segment(seg, src, ("k",))
+    assert len(calls) == 1
+    # sentinel: retried once with the RAW int, promotion disabled forever
+    seg, calls = make_seg(sot.ScalarPromotionError("hash"))
+    tag, env = sot._call_segment(seg, src, ("k",))
+    assert len(calls) == 2
+    assert type(calls[1]["k"]) is int and calls[1]["k"] == 99
+    assert seg._pw_no_promote is True
+
+
+def test_int_promotion_skips_out_of_int32_range():
+    """ADVICE (low): without x64, a promoted int >= 2**31 would silently
+    wrap in int32 — such values stay raw (per-value compile)."""
+    import types
+    import jax
+    from paddle_tpu.jit import sot
+    from paddle_tpu.core.tensor import Tensor
+
+    seg = types.SimpleNamespace()
+    for i in range(sot._INT_PROMOTE_AFTER):
+        sot._pick_env({"k": i}, ("k",), seg)
+    env, promoted = sot._pick_env({"k": 2 ** 31 + 7}, ("k",), seg)
+    if jax.config.jax_enable_x64:
+        assert promoted and isinstance(env["k"], Tensor)
+    else:
+        assert not promoted and env["k"] == 2 ** 31 + 7
+    env, promoted = sot._pick_env({"k": 5}, ("k",), seg)
+    assert promoted and isinstance(env["k"], Tensor)
+    assert str(env["k"].dtype).endswith(
+        "int64" if jax.config.jax_enable_x64 else "int32")

@@ -928,3 +928,20 @@ def test_onnx_load_resize_modes(tmp_path):
     assert got[0, 0, -1, -1] == x[0, 0, -1, -1]
     np.testing.assert_allclose(got[0, 0, 0, 1],
                                (x[0, 0, 0, 0] + x[0, 0, 0, 1]) / 2)
+
+
+def test_fold_unsqueeze_without_axes_declines_cleanly():
+    """ADVICE (low): _try_fold for Unsqueeze with neither an axes input
+    nor attribute must return False (falling through to the
+    UnsupportedOp path) instead of crashing with TypeError(len(None))."""
+    from types import SimpleNamespace
+    from paddle_tpu.onnx.load import _try_fold
+
+    node = SimpleNamespace(input=["c"], output=["o"])
+    env = {"c": np.ones((2,), np.float32)}
+    assert _try_fold("Unsqueeze", {}, node, env) is False
+    assert "o" not in env
+    # with axes present the fold still works
+    node2 = SimpleNamespace(input=["c"], output=["o2"])
+    assert _try_fold("Unsqueeze", {"axes": [0]}, node2, env) is True
+    assert env["o2"].shape == (1, 2)

@@ -164,3 +164,34 @@ def test_merge_chrome_traces_cross_host(tmp_path):
     assert len(evs) == 2 and len(metas) == 2
     assert evs[0]["pid"] != evs[1]["pid"]       # disjoint host bands
     assert any("host1" in m["args"]["name"] for m in metas)
+
+
+def test_op_spans_carry_cache_hit_annotation():
+    """ISSUE 1 tier-3 observability: op spans recorded while the tier-1
+    executable cache serves a dispatch are annotated cache_hit=True."""
+    import numpy as np
+    from paddle_tpu.core import op_cache
+
+    op_cache.clear()
+    paddle.set_flags({"FLAGS_eager_op_cache": True})
+    a = paddle.to_tensor(np.ones((16, 16), np.float32))
+    paddle.matmul(a, a)   # outside the profiler: populates the cache
+    prof = Profiler(targets=[ProfilerTarget.CPU])
+    with prof:
+        for _ in range(2):
+            paddle.matmul(a, a)
+    spans = [e for e in prof.events
+             if e.get("cat") == "Operator" and e.get("name") == "matmul"]
+    assert spans, "no matmul op spans recorded"
+    assert all(e["args"].get("cache_hit") is True for e in spans)
+    # and with the cache off, the annotation reports the bypass honestly
+    paddle.set_flags({"FLAGS_eager_op_cache": False})
+    prof2 = Profiler(targets=[ProfilerTarget.CPU])
+    with prof2:
+        paddle.matmul(a, a)
+    paddle.set_flags({"FLAGS_eager_op_cache": True})
+    spans2 = [e for e in prof2.events
+              if e.get("cat") == "Operator" and e.get("name") == "matmul"]
+    assert spans2 and all("cache_hit" not in e.get("args", {})
+                          for e in spans2)
+    op_cache.clear()

@@ -165,3 +165,38 @@ def test_static_build_switches_training_to_inference():
     out2 = exe.run(infer, feed=feed)[0]
     np.testing.assert_allclose(out1, out2)
     np.testing.assert_allclose(model.weight.numpy(), w_before)
+
+
+def test_inference_build_then_training_build_clone_for_test():
+    """ADVICE (low): a Program first build() (inference) and later
+    build(for_training=True) must not leak the stale inference
+    _use_compiled/_jaxpr into clone(for_test=True) — the clone previously
+    executed the TRAINING jaxpr down the compiled-inference path and
+    died with an arity error."""
+    model = nn.Linear(4, 2)
+
+    def step(x):
+        loss = model(x).sum()
+        loss.backward()      # no-op under the no_grad inference trace
+        return loss
+
+    feed = {"x": np.ones((2, 4), np.float32)}
+    exe = static.Executor()
+    prog = static.Program(step, [static.data("x", [2, 4], "float32")])
+    prog.build()                       # inference build first
+    assert prog._use_compiled and prog._jaxpr is not None
+    prog.build(for_training=True)      # then re-build for training
+    assert prog._use_compiled is False and prog._jaxpr is None
+    for _ in range(3):                 # phases: eager, discovery, IR
+        exe.run(prog, feed=feed)
+        model.weight.clear_grad()
+        model.bias.clear_grad()
+
+    test_prog = prog.clone(for_test=True)
+    assert test_prog._train is None and not test_prog._use_compiled
+    w_before = model.weight.numpy().copy()
+    out1 = exe.run(test_prog, feed=feed)[0]
+    out2 = exe.run(test_prog, feed=feed)[0]
+    np.testing.assert_allclose(out1, out2)
+    # inference clone must not mutate weights
+    np.testing.assert_allclose(model.weight.numpy(), w_before)

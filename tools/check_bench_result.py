@@ -7,13 +7,76 @@ a threshold.
 
 Usage: python tools/check_bench_result.py BENCH_rN.json [--threshold 0.9]
 Compares `value` against the recorded per-platform best in
-BENCH_BASELINE.json (written by bench.py)."""
+BENCH_BASELINE.json (written by bench.py).
+
+An `eager_op_dispatch_*` result (benchmarks/eager_overhead.py) is
+validated against its JSON schema instead of the throughput baseline —
+the microbench's comparison is self-contained (cached vs uncached in
+one process)."""
 from __future__ import annotations
 
 import argparse
 import json
 import os
 import sys
+
+
+_EAGER_SCHEMA = {
+    # key -> accepted types; every key is required
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "speedup_vs_uncached": (int, float),
+    "step_speedup_vs_uncached": (int, float),
+    "cached": dict,
+    "uncached": dict,
+    "loss": (int, float),
+    "iters": int,
+    "ops_per_fwd": int,
+    "smoke": bool,
+    "platform": str,
+    "tier1": dict,
+}
+_EAGER_TIER1_KEYS = ("hits", "misses", "evictions", "bypasses",
+                     "entries", "bytes")
+
+
+def check_eager_overhead(run):
+    """Schema gate for benchmarks/eager_overhead.py output."""
+    errors = []
+    for key, types in _EAGER_SCHEMA.items():
+        if key not in run:
+            errors.append(f"missing key {key!r}")
+        elif not isinstance(run[key], types):
+            errors.append(f"{key!r} has type {type(run[key]).__name__}, "
+                          f"expected {types}")
+    if not errors:
+        for side in ("cached", "uncached"):
+            for k in ("fwd_ops_per_sec", "step_ops_per_sec"):
+                v = run[side].get(k)
+                if not isinstance(v, (int, float)) or v <= 0:
+                    errors.append(f"{side}.{k} must be a positive number, "
+                                  f"got {v!r}")
+        for k in _EAGER_TIER1_KEYS:
+            if not isinstance(run["tier1"].get(k), int):
+                errors.append(f"tier1.{k} missing or not an int")
+        if not errors:
+            if run["value"] <= 0:
+                errors.append("value must be positive")
+            if run["speedup_vs_uncached"] <= 0:
+                errors.append("speedup_vs_uncached must be positive")
+            if run["tier1"]["hits"] <= 0:
+                errors.append("tier1.hits is zero — the cached pass "
+                              "never hit its own cache")
+    if errors:
+        print("eager_overhead schema check FAILED:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(f"eager_overhead schema OK: {run['value']:.1f} ops/sec, "
+          f"{run['speedup_vs_uncached']:.2f}x vs uncached, "
+          f"tier1 hits={run['tier1']['hits']}")
+    return 0
 
 
 def main():
@@ -29,6 +92,8 @@ def main():
         run = json.load(f)
     if "parsed" in run:          # driver-recorded BENCH_rN.json wrapper
         run = run["parsed"]
+    if str(run.get("metric", "")).startswith("eager_op_dispatch"):
+        return check_eager_overhead(run)
     value = float(run["value"])
     platform = "cpu" if "cpu" in run.get("metric", "") else "tpu"
 

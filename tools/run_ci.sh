@@ -19,6 +19,10 @@ python tools/check_api_compatible.py
 echo "== unit tests (full, incl. slow) =="
 PADDLE_TPU_RUN_SLOW=1 python -m pytest tests/ -q
 
+echo "== eager op-dispatch cache microbench (smoke) =="
+python benchmarks/eager_overhead.py --smoke --out /tmp/eager_overhead_ci.json
+python tools/check_bench_result.py /tmp/eager_overhead_ci.json
+
 echo "== TPU run-log audit =="
 python tools/validate_tpu_runs.py
 
