@@ -38,6 +38,7 @@ from .context_parallel import (  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import (  # noqa: F401
     save_state_dict, load_state_dict, DistributedSaver,
+    CheckpointManager, save_checkpoint, restore_latest,
 )
 from . import launch  # noqa: F401
 from . import spawn as spawn_mod  # noqa: F401

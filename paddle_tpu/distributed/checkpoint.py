@@ -20,6 +20,11 @@ import numpy as np
 import jax
 
 from ..core.tensor import Tensor
+from ..framework.checkpoint_manager import (  # noqa: F401 — re-exported
+    CheckpointManager, CheckpointError, read_manifest, scan_steps,
+    step_dir_name, verify_checkpoint, write_manifest,
+)
+from ..utils.log import get_logger
 
 
 def _ocp():
@@ -81,7 +86,11 @@ def _restore_into(obj, restored, prefix=""):
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, async_save=False):
     """Sharded save: every host writes only the shards it owns
-    (reference analog: DistributedSaver.save, dist_saver.py:53)."""
+    (reference analog: DistributedSaver.save, dist_saver.py:53).  After
+    orbax finishes, a size+crc32 manifest is committed into the tree via
+    tmp+os.replace — the validity marker ``restore_latest`` and
+    ``verify_checkpoint`` check, so a host preempted mid-save leaves a
+    detectably-torn directory rather than a plausible-looking one."""
     ocp = _ocp()
     flat = _flatten_state(state_dict)
     arrays = {k: (v._data_ if isinstance(v, Tensor) else np.asarray(v))
@@ -90,6 +99,8 @@ def save_state_dict(state_dict, path, process_group=None,
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, arrays, force=True)
     ckptr.wait_until_finished()
+    if jax.process_index() == coordinator_rank:
+        write_manifest(path)
     return path
 
 
@@ -115,6 +126,53 @@ def load_state_dict(state_dict, path, process_group=None,
             targets[k] = jax.ShapeDtypeStruct(a.shape, a.dtype)
     restored = ckptr.restore(path, targets)
     return _restore_into(state_dict, restored)
+
+
+def save_checkpoint(state_dict, root, step, max_to_keep=None,
+                    process_group=None, coordinator_rank=0):
+    """Step-numbered sharded checkpoint under ``root/ckpt-<step>`` with
+    the manifest commit protocol plus last-N retention (never deleting
+    the last valid checkpoint) — the multi-host twin of
+    ``CheckpointManager.save``."""
+    root = os.path.abspath(root)
+    os.makedirs(root, exist_ok=True)
+    path = os.path.join(root, step_dir_name(step))
+    save_state_dict(state_dict, path, process_group=process_group,
+                    coordinator_rank=coordinator_rank)
+    if max_to_keep and jax.process_index() == coordinator_rank:
+        import shutil
+        kept = 0
+        for _step, p in scan_steps(root):      # newest-first
+            if verify_checkpoint(p):
+                kept += 1
+                if kept > max_to_keep:
+                    shutil.rmtree(p, ignore_errors=True)
+            elif kept >= 1:
+                shutil.rmtree(p, ignore_errors=True)
+    return path
+
+
+def restore_latest(state_dict, root, process_group=None,
+                   coordinator_rank=0):
+    """Load the newest VALID step-numbered checkpoint under ``root`` into
+    ``state_dict`` in place; torn/corrupt directories (no manifest, or a
+    size/crc mismatch) are skipped with a warning.  Returns the restored
+    step, or None when nothing valid exists."""
+    log = get_logger()
+    for step, path in scan_steps(os.path.abspath(root)):
+        if not verify_checkpoint(path):
+            log.warning("distributed checkpoint %s is torn/corrupt; "
+                        "skipping", path)
+            continue
+        try:
+            load_state_dict(state_dict, path, process_group=process_group,
+                            coordinator_rank=coordinator_rank)
+        except Exception as e:
+            log.warning("distributed checkpoint %s failed to load (%s); "
+                        "skipping", path, e)
+            continue
+        return step
+    return None
 
 
 class DistributedSaver:

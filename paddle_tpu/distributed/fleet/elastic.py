@@ -15,11 +15,77 @@ launcher's restart loop (launch/controller.py ELASTIC_EXIT_CODE) composes.
 from __future__ import annotations
 
 import os
+import signal
+import sys
 import threading
 import time
 
 ELASTIC_EXIT_CODE = 101
 ELASTIC_TIMEOUT = 60
+
+
+class PreemptionHandler:
+    """Cooperative preemption: catch SIGTERM (the preemptible-TPU-pod
+    eviction notice) and let the training loop checkpoint at the next
+    step boundary, then exit with ELASTIC_EXIT_CODE so the launch
+    controller's restart loop relaunches into auto-resume
+    (docs/FAULT_TOLERANCE.md).
+
+    Usage::
+
+        handler = PreemptionHandler().install()
+        for step in ...:
+            train_step()
+            manager.save(state, step)          # or: only when preempted
+            if handler.preempted():
+                manager.wait()
+                handler.exit_for_relaunch()
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev = {}
+        self._installed = False
+
+    def install(self):
+        try:
+            for s in self.signals:
+                self._prev[s] = signal.signal(s, self._on_signal)
+            self._installed = True
+        except ValueError:
+            # not the main thread — stay disarmed rather than crash; the
+            # loop then simply never sees preempted()==True
+            self._prev.clear()
+        return self
+
+    def _on_signal(self, signum, frame):
+        self._event.set()
+
+    def preempted(self):
+        return self._event.is_set()
+
+    def uninstall(self):
+        if self._installed:
+            for s, prev in self._prev.items():
+                try:
+                    signal.signal(s, prev)
+                except (ValueError, TypeError):
+                    pass
+            self._prev.clear()
+            self._installed = False
+
+    def exit_for_relaunch(self):
+        """Exit with ELASTIC_EXIT_CODE — the cooperative relaunch request
+        launch/controller.py's restart loop honors."""
+        sys.exit(ELASTIC_EXIT_CODE)
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
 
 
 class FileStore:
@@ -34,9 +100,14 @@ class FileStore:
         self.heartbeat(node_id)
 
     def heartbeat(self, node_id):
+        # tmp + os.replace (the pallas/autotune.py idiom): a concurrent
+        # alive_nodes() read must never see a partially written timestamp
+        # and declare a live node dead
         path = os.path.join(self.root, f"node.{node_id}")
-        with open(path, "w") as f:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             f.write(str(time.time()))
+        os.replace(tmp, path)
 
     def deregister(self, node_id):
         try:
@@ -48,7 +119,7 @@ class FileStore:
         now = time.time()
         out = []
         for name in os.listdir(self.root):
-            if not name.startswith("node."):
+            if not name.startswith("node.") or ".tmp." in name:
                 continue
             p = os.path.join(self.root, name)
             try:

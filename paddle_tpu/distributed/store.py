@@ -75,8 +75,30 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore: cannot bind port {port}")
             port = lib.ts_server_port(self._server)
         self.port = port
-        self._fd = lib.ts_connect(host.encode(), port,
-                                  int(timeout * 1000))
+        # connect with exponential backoff + jitter (utils/retry.py):
+        # short per-attempt timeouts with jittered gaps de-sync a fleet
+        # of workers all dialing a restarting master at once
+        from ..utils.retry import retry_call
+        deadline = time.time() + timeout
+        per_try_ms = max(200, int(timeout * 1000 / 5))
+
+        def _connect():
+            remaining = int((deadline - time.time()) * 1000)
+            if remaining <= 0:
+                raise ConnectionError("deadline exceeded")
+            fd = lib.ts_connect(host.encode(), port,
+                                min(per_try_ms, remaining))
+            if fd < 0:
+                raise ConnectionError("connect failed")
+            return fd
+
+        try:
+            self._fd = retry_call(_connect, tries=64,
+                                  retry_on=(ConnectionError,),
+                                  base=0.05, max_delay=1.0,
+                                  deadline=deadline)
+        except ConnectionError:
+            self._fd = -1
         if self._fd < 0:
             raise RuntimeError(
                 f"TCPStore: cannot connect to {host}:{port} "
@@ -91,18 +113,21 @@ class TCPStore:
             raise RuntimeError(f"TCPStore.set({key!r}) failed")
 
     def get(self, key, default=None):
-        buf = ctypes.create_string_buffer(1 << 16)
-        r = _lib().ts_get(self._fd, key.encode(), len(key.encode()),
-                          buf, len(buf))
-        if r == -1:
-            return default
-        if r == -2:
-            raise RuntimeError("TCPStore: connection lost")
-        if r > len(buf):
-            buf = ctypes.create_string_buffer(int(r))
+        # loop until the buffer fits (as list_prefix does): the value can
+        # grow between the size probe and the re-fetch, and a single
+        # retry would silently truncate it
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
             r = _lib().ts_get(self._fd, key.encode(), len(key.encode()),
-                              buf, len(buf))
-        return buf.raw[:r]
+                              buf, cap)
+            if r == -1:
+                return default
+            if r == -2:
+                raise RuntimeError("TCPStore: connection lost")
+            if r <= cap:
+                return buf.raw[:r]
+            cap = int(r)
 
     def wait(self, key, timeout=60.0):
         buf = ctypes.create_string_buffer(1 << 16)
@@ -219,8 +244,13 @@ class Master:
                               timeout=timeout)
 
     def sync_endpoints(self, my_endpoint):
+        from ..utils.retry import backoff_delays
         self.store.set(f"ep/{self.rank}", my_endpoint)
         deadline = time.time() + self.timeout
+        # jittered exponential backoff (utils/retry.py): N nodes polling
+        # in 0.2s lockstep hammer the master exactly together; backoff
+        # spreads the polls and caps the idle latency at 1s
+        delays = backoff_delays(base=0.05, max_delay=1.0, jitter=0.25)
         while True:
             # check ranks 0..n-1 directly: a stale key from a previous
             # incarnation must not satisfy the count while a rank is absent
@@ -232,7 +262,7 @@ class Master:
                 missing = [k for k in wanted if k not in eps]
                 raise TimeoutError(
                     f"rendezvous: missing {missing} after {self.timeout}s")
-            time.sleep(0.2)
+            time.sleep(next(delays))
 
     def close(self):
         self.store.close()

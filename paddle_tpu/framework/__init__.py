@@ -1,2 +1,5 @@
 from .io import save, load  # noqa: F401
+from .checkpoint_manager import (  # noqa: F401
+    CheckpointManager, CheckpointError, verify_checkpoint,
+)
 from ..core.state import seed, get_default_dtype, set_default_dtype  # noqa: F401

@@ -1,0 +1,315 @@
+"""Crash-consistent checkpoint management with auto-resume.
+
+Reference capability: the reference's fleet elastic stack assumes
+checkpoints survive crashes but saves them with bare writes; this module
+supplies the missing commit protocol (the append-log CRC framing of
+`distributed/ps/__init__.py`, generalized to whole checkpoint
+directories) so the ELASTIC_EXIT_CODE relaunch loop in
+`launch/controller.py` can actually resume.
+
+Layout (docs/FAULT_TOLERANCE.md)::
+
+    <root>/ckpt-00000012/
+        state.pkl          payload file(s)
+        manifest.json      {"version", "step", "files": {name: {size, crc32}}}
+
+Protocol: payload files are written first (each itself tmp+os.replace'd),
+then ``manifest.json`` is written to a temp name and ``os.replace``'d into
+place — **the manifest is the commit point**.  A directory without a
+valid manifest, or whose files fail the size/crc32 check, is a torn
+checkpoint: ``restore_latest`` skips it (logged), garbage-collects it,
+and falls back to the next-newest valid one.  Retention keeps the newest
+``max_to_keep`` *valid* checkpoints and never deletes the last valid one.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+import zlib
+
+from ..utils.log import get_logger
+from ..utils import monitor as _monitor
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+_STEP_RE = re.compile(r"^ckpt-(\d+)$")
+
+
+class CheckpointError(RuntimeError):
+    pass
+
+
+def step_dir_name(step):
+    return f"ckpt-{int(step):08d}"
+
+
+def _crc32_file(path, chunk=1 << 20):
+    crc, size = 0, 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+            size += len(block)
+    return crc & 0xFFFFFFFF, size
+
+
+def write_manifest(dirpath, step=None, meta=None, files=None,
+                   manifest_path=None):
+    """Commit ``dirpath``: record size + crc32 of every payload file and
+    os.replace the manifest into place.  ``manifest_path`` may point the
+    manifest OUTSIDE the directory (sidecar marker) for formats that
+    refuse foreign files in their tree (orbax)."""
+    if files is None:
+        files = []
+        for base, _dirs, names in os.walk(dirpath):
+            for name in names:
+                p = os.path.join(base, name)
+                rel = os.path.relpath(p, dirpath)
+                if rel == MANIFEST_NAME or name.endswith(".tmp") \
+                        or ".tmp." in name:
+                    continue
+                files.append(rel)
+    entries = {}
+    for rel in sorted(files):
+        crc, size = _crc32_file(os.path.join(dirpath, rel))
+        entries[rel] = {"size": size, "crc32": crc}
+    manifest = {"version": MANIFEST_VERSION, "files": entries}
+    if step is not None:
+        manifest["step"] = int(step)
+    if meta:
+        manifest["meta"] = meta
+    target = manifest_path or os.path.join(dirpath, MANIFEST_NAME)
+    tmp = target + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, target)
+    return manifest
+
+
+def read_manifest(dirpath, manifest_path=None):
+    """The parsed manifest, or None when absent/undecodable."""
+    target = manifest_path or os.path.join(dirpath, MANIFEST_NAME)
+    try:
+        with open(target) as f:
+            m = json.load(f)
+        return m if isinstance(m, dict) and "files" in m else None
+    except (OSError, ValueError):
+        return None
+
+
+def verify_checkpoint(dirpath, manifest_path=None):
+    """True iff the manifest exists and every recorded file matches its
+    recorded size and crc32 — i.e. the checkpoint was fully committed and
+    has not rotted since."""
+    manifest = read_manifest(dirpath, manifest_path=manifest_path)
+    if manifest is None:
+        return False
+    for rel, want in manifest["files"].items():
+        p = os.path.join(dirpath, rel)
+        try:
+            if os.path.getsize(p) != want["size"]:
+                return False
+            crc, _size = _crc32_file(p)
+            if crc != want["crc32"]:
+                return False
+        except OSError:
+            return False
+    return True
+
+
+def scan_steps(root):
+    """[(step, dirpath)] newest-first for every ckpt-N directory under
+    root (valid or not — callers verify)."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for name in names:
+        m = _STEP_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, name)))
+    out.sort(key=lambda x: x[0], reverse=True)
+    return out
+
+
+def _rmtree_quiet(path):
+    try:
+        shutil.rmtree(path)
+    except OSError:
+        pass
+
+
+class CheckpointManager:
+    """Atomic step-numbered checkpoints with latest-valid restore.
+
+    ``save_fn(state, dirpath)`` serializes ``state`` into payload files
+    under ``dirpath``; ``load_fn(dirpath)`` inverts it.  The defaults use
+    :mod:`paddle_tpu.framework.io` (host-materialized pickle, itself
+    tmp+replace atomic) — the orbax path in
+    ``paddle_tpu.distributed.checkpoint`` plugs in its own pair.
+
+    ``async_save=True`` runs the serialization + commit on a background
+    thread; a failure there re-raises at the next ``save()`` / ``wait()``
+    instead of vanishing with the thread.
+    """
+
+    def __init__(self, root, max_to_keep=5, async_save=False,
+                 save_fn=None, load_fn=None):
+        self.root = str(root)
+        self.max_to_keep = max_to_keep  # None/0 = keep everything
+        self.async_save = async_save
+        self._save_fn = save_fn or _default_save_fn
+        self._load_fn = load_fn or _default_load_fn
+        self._log = get_logger()
+        self._lock = threading.Lock()   # serializes save/GC within process
+        self._thread = None
+        self._error = None
+        os.makedirs(self.root, exist_ok=True)
+
+    # ---- save ----
+    def save(self, state, step=None, meta=None):
+        """Checkpoint ``state`` under step number ``step`` (default: one
+        past the newest existing step).  Returns the committed directory
+        path, or None when async (resolve via ``wait()``)."""
+        self._reraise()
+        if step is None:
+            steps = scan_steps(self.root)
+            step = (steps[0][0] + 1) if steps else 0
+        step = int(step)
+        if self.async_save:
+            self.wait()       # one in-flight save at a time
+            self._reraise()
+            self._thread = threading.Thread(
+                target=self._save_guarded, args=(state, step, meta),
+                daemon=True, name=f"ckpt-save-{step}")
+            self._thread.start()
+            return None
+        return self._save_impl(state, step, meta)
+
+    def _save_guarded(self, state, step, meta):
+        try:
+            self._save_impl(state, step, meta)
+        except BaseException as e:  # noqa: BLE001 — surfaced at wait()
+            self._error = e
+
+    def _save_impl(self, state, step, meta):
+        with self._lock:
+            final = os.path.join(self.root, step_dir_name(step))
+            if os.path.exists(final):
+                # re-save of an existing step: a torn leftover or an
+                # explicit overwrite — clear it so the commit below is
+                # unambiguous
+                _rmtree_quiet(final)
+            os.makedirs(final, exist_ok=True)
+            try:
+                self._save_fn(state, final)
+                write_manifest(final, step=step, meta=meta)
+            except BaseException:
+                # keep the torn dir out of scans' way only if we survive
+                # (an injected os._exit never reaches here — that IS the
+                # torn-checkpoint case restore_latest must handle)
+                _rmtree_quiet(final)
+                raise
+            _monitor.incr("ckpt.saves")
+            self._retain()
+            return final
+
+    def wait(self):
+        """Block until the in-flight async save (if any) finishes; then
+        re-raise its error, if it failed."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        self._reraise()
+
+    def _reraise(self):
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise CheckpointError(
+                f"async checkpoint save failed: {e}") from e
+
+    # ---- restore ----
+    def restore_latest(self, gc_invalid=True):
+        """(state, step) from the newest VALID checkpoint, or None when no
+        valid checkpoint exists.  Torn/corrupt directories are skipped
+        (logged) and, with ``gc_invalid``, deleted."""
+        self.wait()
+        for step, path in scan_steps(self.root):
+            if not verify_checkpoint(path):
+                self._log.warning(
+                    "checkpoint %s is torn/corrupt; skipping%s", path,
+                    " and removing" if gc_invalid else "")
+                _monitor.incr("ckpt.torn_skipped")
+                if gc_invalid:
+                    with self._lock:
+                        _rmtree_quiet(path)
+                continue
+            try:
+                state = self._load_fn(path)
+            except Exception as e:
+                self._log.warning(
+                    "checkpoint %s failed to load (%s); skipping", path, e)
+                _monitor.incr("ckpt.torn_skipped")
+                continue
+            _monitor.incr("ckpt.restores")
+            return state, step
+        return None
+
+    def restore(self, step):
+        """State from the checkpoint at exactly ``step`` (validated)."""
+        path = os.path.join(self.root, step_dir_name(step))
+        if not verify_checkpoint(path):
+            raise CheckpointError(
+                f"checkpoint step {step} at {path} is missing or invalid")
+        return self._load_fn(path)
+
+    def latest_step(self):
+        for step, path in scan_steps(self.root):
+            if verify_checkpoint(path):
+                return step
+        return None
+
+    def all_steps(self, valid_only=True):
+        steps = [(s, p) for s, p in scan_steps(self.root)
+                 if not valid_only or verify_checkpoint(p)]
+        return sorted(s for s, _p in steps)
+
+    # ---- retention ----
+    def _retain(self):
+        """Keep the newest ``max_to_keep`` valid checkpoints.  Invalid
+        (torn) directories older than the newest valid one are GC'd too —
+        but the last valid checkpoint is never deleted, no matter what."""
+        if not self.max_to_keep or self.max_to_keep < 1:
+            return
+        entries = [(s, p, verify_checkpoint(p))
+                   for s, p in scan_steps(self.root)]   # newest-first
+        kept_valid = 0
+        for _step, path, valid in entries:
+            if valid:
+                kept_valid += 1
+                if kept_valid > self.max_to_keep:
+                    _rmtree_quiet(path)
+                    _monitor.incr("ckpt.retention_deleted")
+            elif kept_valid >= 1:
+                # torn dir older than a valid checkpoint: dead weight
+                _rmtree_quiet(path)
+                _monitor.incr("ckpt.torn_gcd")
+
+
+def _default_save_fn(state, dirpath):
+    from .io import save
+    save(state, os.path.join(dirpath, "state.pkl"))
+
+
+def _default_load_fn(dirpath):
+    from .io import load
+    return load(os.path.join(dirpath, "state.pkl"))

@@ -45,11 +45,30 @@ def _from_host(obj):
 
 
 def save(obj, path, protocol=4, **configs):
+    """Atomic save: pickle to ``path + .tmp.<pid>`` and ``os.replace``
+    into place, so a crash mid-write leaves either the old file or
+    nothing — never a torn pickle (the commit protocol
+    framework/checkpoint_manager.py builds on).  Payload bytes route
+    through the ``ckpt_write`` fault-injection point (no-op unless
+    FLAGS_fault_inject arms it)."""
+    from ..utils import fault_injection
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_host(obj), f, protocol=protocol)
+    data = pickle.dumps(_to_host(obj), protocol=protocol)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            fault_injection.write_bytes(f, data, filename=path)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load(path, **configs):

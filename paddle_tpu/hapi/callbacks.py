@@ -89,20 +89,55 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    """reference: callbacks.py ModelCheckpoint — periodic save."""
+    """reference: callbacks.py ModelCheckpoint — periodic save.
 
-    def __init__(self, save_freq=1, save_dir=None):
+    Epoch checkpoints go through the crash-consistent
+    ``framework.CheckpointManager`` (``save_dir/ckpt-N/`` with a manifest
+    commit point), so ``Model.fit(resume=...)`` can restore the latest
+    VALID one after a crash or preemption, and ``max_to_keep`` bounds the
+    disk footprint instead of growing it without bound.  ``final.pdparams``
+    is still written at train end for compatibility."""
+
+    def __init__(self, save_freq=1, save_dir=None, max_to_keep=None,
+                 async_save=False):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.max_to_keep = max_to_keep
+        self.async_save = async_save
+        self._manager = None
+
+    @property
+    def manager(self):
+        if self._manager is None and self.save_dir:
+            from ..framework.checkpoint_manager import CheckpointManager
+            self._manager = CheckpointManager(
+                self.save_dir, max_to_keep=self.max_to_keep,
+                async_save=self.async_save)
+        return self._manager
+
+    def _state(self, next_epoch):
+        state = {"model": self.model.network.state_dict(),
+                 "next_epoch": int(next_epoch)}
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is not None:
+            state["optimizer"] = opt.state_dict()
+        return state
+
+    def save_now(self, next_epoch):
+        """Checkpoint immediately (fit's preemption path calls this at
+        the step boundary after SIGTERM)."""
+        if self.manager is not None:
+            self.manager.save(self._state(next_epoch))
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and (epoch + 1) % self.save_freq == 0:
-            path = os.path.join(self.save_dir, str(epoch))
-            self.model.save(path)
+            self.save_now(next_epoch=epoch + 1)
 
     def on_train_end(self, logs=None):
         if self.save_dir:
+            if self._manager is not None:
+                self._manager.wait()
             self.model.save(os.path.join(self.save_dir, "final"))
 
 
@@ -169,12 +204,14 @@ class LRScheduler(Callback):
 
 
 def config_callbacks(callbacks, model, epochs=None, steps=None,
-                     verbose=2, save_freq=1, save_dir=None, metrics=None):
+                     verbose=2, save_freq=1, save_dir=None, metrics=None,
+                     max_to_keep=None):
     cbs = list(callbacks or [])
     if not any(isinstance(c, ProgBarLogger) for c in cbs):
         cbs.insert(0, ProgBarLogger(verbose=verbose))
     if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbs):
-        cbs.append(ModelCheckpoint(save_freq, save_dir))
+        cbs.append(ModelCheckpoint(save_freq, save_dir,
+                                   max_to_keep=max_to_keep))
     cl = CallbackList(cbs, model=model,
                       params={"epochs": epochs, "steps": steps,
                               "verbose": verbose, "metrics": metrics or []})

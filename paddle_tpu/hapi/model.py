@@ -181,7 +181,16 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+            callbacks=None, accumulate_grad_batches=1, num_iters=None,
+            resume=None, max_to_keep=None):
+        """``resume=True`` (with ``save_dir``) or ``resume=<dir>`` restores
+        model + optimizer + epoch from the latest VALID checkpoint written
+        by ModelCheckpoint and continues from there; torn checkpoints are
+        skipped transparently.  While checkpointing is active a SIGTERM
+        (preemption notice) triggers a save at the next step boundary and
+        exit(ELASTIC_EXIT_CODE) so the launch controller relaunches into
+        auto-resume (docs/FAULT_TOLERANCE.md)."""
+        from .callbacks import ModelCheckpoint
         loader = self._as_loader(train_data, batch_size, shuffle)
         eval_loader = self._as_loader(eval_data, batch_size, False)
         try:
@@ -191,39 +200,89 @@ class Model:
         cbs = config_callbacks(callbacks, self, epochs=epochs, steps=steps,
                                verbose=verbose, save_freq=save_freq,
                                save_dir=save_dir,
-                               metrics=[m.name() for m in self._metrics])
+                               metrics=[m.name() for m in self._metrics],
+                               max_to_keep=max_to_keep)
+        ckpt_cb = next((c for c in cbs.callbacks
+                        if isinstance(c, ModelCheckpoint)), None)
+
+        initial_epoch = 0
+        if resume:
+            initial_epoch = self._resume_from(resume, save_dir, ckpt_cb)
+
+        handler = None
+        if ckpt_cb is not None and ckpt_cb.save_dir:
+            from ..distributed.fleet.elastic import PreemptionHandler
+            handler = PreemptionHandler().install()
+
         self.stop_training = False
         cbs.call("on_train_begin")
         history = {"loss": []}
         it = 0
-        for epoch in range(epochs):
-            cbs.call("on_epoch_begin", epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(loader):
-                x, y = self._split_batch(batch)
-                cbs.call("on_train_batch_begin", step)
-                loss = self.train_batch(x, y)
-                logs = {"loss": loss[0]}
+        logs = {}
+        try:
+            for epoch in range(initial_epoch, epochs):
+                cbs.call("on_epoch_begin", epoch)
                 for m in self._metrics:
-                    out = self.predict_batch(x)
-                    m.update(*m.compute(out, y))
-                    logs[m.name()] = m.accumulate()
-                cbs.call("on_train_batch_end", step, logs)
-                it += 1
-                if num_iters and it >= num_iters:
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(loader):
+                    x, y = self._split_batch(batch)
+                    cbs.call("on_train_batch_begin", step)
+                    loss = self.train_batch(x, y)
+                    logs = {"loss": loss[0]}
+                    for m in self._metrics:
+                        out = self.predict_batch(x)
+                        m.update(*m.compute(out, y))
+                        logs[m.name()] = m.accumulate()
+                    cbs.call("on_train_batch_end", step, logs)
+                    if handler is not None and handler.preempted():
+                        # save at the step boundary, then request relaunch
+                        # — the restarted process redoes this epoch from
+                        # its start with the mid-epoch weights
+                        ckpt_cb.save_now(next_epoch=epoch)
+                        ckpt_cb.manager.wait()
+                        handler.uninstall()
+                        handler.exit_for_relaunch()
+                    it += 1
+                    if num_iters and it >= num_iters:
+                        break
+                history["loss"].append(logs.get("loss"))
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_loader, verbose=0,
+                                              _callbacks=cbs)
+                    logs.update({f"eval_{k}": v
+                                 for k, v in eval_logs.items()})
+                cbs.call("on_epoch_end", epoch, logs)
+                if self.stop_training or (num_iters and it >= num_iters):
                     break
-            history["loss"].append(logs.get("loss"))
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, verbose=0,
-                                          _callbacks=cbs)
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
-            cbs.call("on_epoch_end", epoch, logs)
-            if self.stop_training or (num_iters and it >= num_iters):
-                break
+        finally:
+            if handler is not None:
+                handler.uninstall()
         cbs.call("on_train_end", logs)
         return history
+
+    def _resume_from(self, resume, save_dir, ckpt_cb):
+        """Restore model/optimizer/epoch from the latest valid checkpoint;
+        returns the epoch to continue from (0 when nothing to restore)."""
+        resume_dir = resume if isinstance(resume, (str, os.PathLike)) \
+            else (save_dir or (ckpt_cb.save_dir if ckpt_cb else None))
+        if not resume_dir:
+            raise ValueError(
+                "fit(resume=True) needs save_dir (or resume=<dir>)")
+        if ckpt_cb is not None and \
+                str(resume_dir) == str(ckpt_cb.save_dir):
+            mgr = ckpt_cb.manager
+        else:
+            from ..framework.checkpoint_manager import CheckpointManager
+            mgr = CheckpointManager(resume_dir)
+        restored = mgr.restore_latest()
+        if restored is None:
+            return 0
+        state, _step = restored
+        self.network.set_state_dict(state["model"])
+        if self._optimizer is not None and state.get("optimizer"):
+            self._optimizer.set_state_dict(state["optimizer"])
+        return int(state.get("next_epoch", 0))
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None,

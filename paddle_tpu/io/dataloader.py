@@ -187,6 +187,10 @@ class DataLoader:
                 p.join(timeout=2)
                 if p.exitcode is None:
                     p.terminate()
+                    # reap after terminate: an unjoined killed child stays
+                    # a zombie for the life of the trainer, leaking a pid
+                    # per worker per epoch
+                    p.join(timeout=2)
             out_q.release()
 
     def _fetch_numpy(self, indices):

@@ -1,0 +1,154 @@
+"""Flag-driven fault injection for robustness drills.
+
+``FLAGS_fault_inject`` holds a spec string; tests and subprocess drills
+use it to prove that torn checkpoints are skipped and preempted runs
+resume at the right step (docs/FAULT_TOLERANCE.md).  Grammar::
+
+    spec       := point_spec (";" point_spec)*
+    point_spec := POINT ":" param ("," param)*
+    param      := KEY "=" VALUE
+
+e.g. ``"ckpt_write:after_bytes=128"`` truncates the next checkpoint
+payload write after 128 bytes and hard-exits (a torn write), and
+``"step:crash_at=3"`` kills the training process when the loop reports
+step 3.  Unknown points/keys and unparseable values raise
+:class:`FaultSpecError` — a malformed spec must never silently inject
+nothing.
+
+With the flag unset every helper returns on a single falsy check, so the
+save/step paths pay zero overhead in production.
+"""
+from __future__ import annotations
+
+import os
+import re
+import signal
+
+from .flags import flag
+
+#: points the framework actually consults, with their typed params.
+#: ``mode`` selects crash semantics: "exit" hard-kills the process via
+#: os._exit (subprocess drills), "raise" raises InjectedFault in-process
+#: (unit tests, async-save error propagation).
+KNOWN_POINTS = {
+    "ckpt_write": {"after_bytes": int, "mode": str, "file": str,
+                   "exit": int},
+    "step": {"crash_at": int, "sigterm_at": int, "exit": int},
+}
+
+_IDENT = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+#: exit code distinct from ELASTIC_EXIT_CODE: an injected crash must look
+#: like a hard fault, not a cooperative relaunch request.
+DEFAULT_EXIT_CODE = 23
+
+
+class FaultSpecError(ValueError):
+    """Malformed FLAGS_fault_inject value."""
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed injection point in ``mode=raise``."""
+
+
+def parse(spec):
+    """``spec`` string → {point: {key: typed value}}.  Raises
+    FaultSpecError on anything it does not fully understand."""
+    out = {}
+    if not spec or not spec.strip():
+        return out
+    for item in spec.split(";"):
+        item = item.strip()
+        if not item:
+            raise FaultSpecError(
+                f"FLAGS_fault_inject: empty point spec in {spec!r}")
+        name, sep, rest = item.partition(":")
+        name = name.strip()
+        if not _IDENT.match(name):
+            raise FaultSpecError(
+                f"FLAGS_fault_inject: bad point name {name!r} in {item!r}")
+        if name not in KNOWN_POINTS:
+            raise FaultSpecError(
+                f"FLAGS_fault_inject: unknown point {name!r} "
+                f"(known: {sorted(KNOWN_POINTS)})")
+        if not sep or not rest.strip():
+            raise FaultSpecError(
+                f"FLAGS_fault_inject: point {name!r} needs "
+                f"'key=value' params (got {item!r})")
+        params = {}
+        for param in rest.split(","):
+            key, psep, value = param.partition("=")
+            key, value = key.strip(), value.strip()
+            if not psep or not _IDENT.match(key) or not value:
+                raise FaultSpecError(
+                    f"FLAGS_fault_inject: bad param {param!r} for point "
+                    f"{name!r} (want key=value)")
+            want = KNOWN_POINTS[name].get(key)
+            if want is None:
+                raise FaultSpecError(
+                    f"FLAGS_fault_inject: unknown key {key!r} for point "
+                    f"{name!r} (known: {sorted(KNOWN_POINTS[name])})")
+            try:
+                params[key] = want(value)
+            except ValueError:
+                raise FaultSpecError(
+                    f"FLAGS_fault_inject: {name}:{key} wants "
+                    f"{want.__name__}, got {value!r}") from None
+        out[name] = params
+    return out
+
+
+_PARSED = ("", {})  # (raw string, parsed) — re-parsed only when raw changes
+
+
+def active(name):
+    """Params dict for ``name`` if that point is armed, else None.  One
+    dict lookup + string compare when the flag is unset."""
+    raw = flag("FLAGS_fault_inject", "") or ""
+    if not raw:
+        return None
+    global _PARSED
+    if _PARSED[0] != raw:
+        _PARSED = (raw, parse(raw))
+    return _PARSED[1].get(name)
+
+
+def _crash(params):
+    os._exit(int(params.get("exit", DEFAULT_EXIT_CODE)))
+
+
+def write_bytes(f, data, filename=None):
+    """Write ``data`` to open binary file ``f`` — the single choke point
+    checkpoint writers route payload bytes through.  When the
+    ``ckpt_write`` point is armed (optionally filtered to paths containing
+    ``file=<substr>``), writes only ``after_bytes`` bytes, fsyncs the torn
+    prefix to disk, then crashes (``mode=exit``, default) or raises
+    InjectedFault (``mode=raise``)."""
+    params = active("ckpt_write")
+    if params is not None and "after_bytes" in params:
+        substr = params.get("file")
+        if substr is None or substr in (filename or getattr(f, "name", "")):
+            n = max(0, params["after_bytes"])
+            f.write(data[:n])
+            f.flush()
+            os.fsync(f.fileno())
+            if params.get("mode", "exit") == "raise":
+                raise InjectedFault(
+                    f"ckpt_write: injected torn write after {n} bytes "
+                    f"of {filename or getattr(f, 'name', '?')}")
+            _crash(params)
+    f.write(data)
+
+
+def check_step(step):
+    """Training loops call this once per step.  ``crash_at=N`` hard-exits
+    at step N (simulated hard fault); ``sigterm_at=N`` delivers SIGTERM to
+    the current process (simulated preemption notice) so the installed
+    PreemptionHandler path is exercised end to end."""
+    params = active("step")
+    if params is None:
+        return
+    if params.get("crash_at") == step:
+        _crash(params)
+    if params.get("sigterm_at") == step:
+        os.kill(os.getpid(), signal.SIGTERM)

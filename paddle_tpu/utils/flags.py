@@ -38,6 +38,10 @@ _FLAGS: dict[str, Any] = {
     "FLAGS_eager_op_cache": True,
     "FLAGS_eager_op_cache_size": 4096,
     "FLAGS_compile_cache_dir": "",
+    # fault-injection spec for robustness drills (utils/fault_injection.py;
+    # grammar in docs/FAULT_TOLERANCE.md).  Empty = disabled: the save and
+    # step paths then pay a single falsy check, nothing more.
+    "FLAGS_fault_inject": "",
 }
 
 

@@ -35,12 +35,26 @@ class _RankFormatter(logging.Formatter):
         return super().format(record)
 
 
+class _DynamicStderrHandler(logging.StreamHandler):
+    """StreamHandler that resolves sys.stderr at EMIT time, not handler
+    creation: the process-global logger is created lazily by whichever
+    subsystem logs first, and binding the stream then would strand later
+    output on a stale redirected/captured stderr."""
+
+    def __init__(self):
+        logging.Handler.__init__(self)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+
 def get_logger(name="paddle_tpu"):
     logger = _LOGGERS.get(name)
     if logger is None:
         logger = logging.getLogger(name)
         if not logger.handlers:
-            h = logging.StreamHandler(sys.stderr)
+            h = _DynamicStderrHandler()
             h.setFormatter(_RankFormatter(
                 "%(asctime)s [rank %(rank)s] %(levelname)s "
                 "%(name)s: %(message)s"))

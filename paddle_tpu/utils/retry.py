@@ -1,0 +1,63 @@
+"""Exponential backoff with jitter — the one retry policy shared by every
+transient-failure loop (TCPStore connect, rendezvous endpoint polls,
+checkpoint GC races).
+
+Reference capability: the reference scatters ad-hoc `time.sleep` retry
+loops through launch/controllers and fleet; here a single helper keeps
+the policy (cap, jitter to de-sync thundering herds) uniform.
+"""
+from __future__ import annotations
+
+import random
+import time
+
+
+def backoff_delays(base=0.05, factor=2.0, max_delay=2.0, jitter=0.5,
+                   tries=None):
+    """Yield sleep durations: ``base * factor**n`` capped at ``max_delay``,
+    each multiplied by ``1 ± uniform(0, jitter)`` so a fleet of workers
+    retrying the same endpoint spreads out instead of stampeding.
+    Infinite when ``tries`` is None (callers bound by deadline)."""
+    n = 0
+    while tries is None or n < tries:
+        d = min(float(max_delay), float(base) * float(factor) ** n)
+        if jitter:
+            d *= 1.0 + random.uniform(-jitter, jitter)
+        yield max(d, 0.0)
+        n += 1
+
+
+def retry_call(fn, *args, tries=5, retry_on=(OSError,), base=0.05,
+               factor=2.0, max_delay=2.0, jitter=0.5, deadline=None,
+               sleep=time.sleep, on_retry=None, **kwargs):
+    """Call ``fn(*args, **kwargs)``, retrying on ``retry_on`` exceptions
+    with exponential backoff.  Gives up (re-raising the last exception)
+    after ``tries`` attempts or once ``deadline`` (absolute time.time())
+    passes — whichever comes first."""
+    delays = backoff_delays(base=base, factor=factor, max_delay=max_delay,
+                            jitter=jitter)
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            attempt += 1
+            if attempt >= tries:
+                raise
+            if deadline is not None and time.time() >= deadline:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(next(delays))
+
+
+def retry(**cfg):
+    """Decorator form of :func:`retry_call`."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(fn, *args, **cfg, **kwargs)
+        return wrapper
+    return deco

@@ -1,0 +1,82 @@
+"""Fault-tolerant training drill worker (docs/FAULT_TOLERANCE.md).
+
+Trains a tiny model for TOTAL_STEPS with a per-step CheckpointManager
+save, auto-resuming from the latest valid checkpoint.  Fault-injection
+flags drive the drills:
+
+- ``FLAGS_fault_inject=ckpt_write:after_bytes=N,file=ckpt-XXXXXXXX``
+  hard-kills the process mid-write of that step's payload, leaving a
+  torn checkpoint the rerun must skip.
+- ``FLAGS_fault_inject=step:sigterm_at=N`` delivers SIGTERM at step N —
+  the PreemptionHandler saves at the step boundary and exits with
+  ELASTIC_EXIT_CODE so the launch controller relaunches into resume.
+
+Each incarnation appends its starting step to ``incarnations.log`` so the
+test can assert the resume point; the completed run writes ``losses.json``.
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.framework.checkpoint_manager import CheckpointManager  # noqa: E402
+from paddle_tpu.distributed.fleet.elastic import PreemptionHandler  # noqa: E402
+from paddle_tpu.utils import fault_injection  # noqa: E402
+
+TOTAL_STEPS = 6
+
+
+def main():
+    outdir = sys.argv[1]
+    ckpt_root = os.path.join(outdir, "ckpts")
+    mgr = CheckpointManager(ckpt_root, max_to_keep=3)
+    handler = PreemptionHandler().install()
+
+    paddle.seed(7)
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+
+    start_step, losses = 0, []
+    restored = mgr.restore_latest()
+    if restored is not None:
+        state, _step = restored
+        model.set_state_dict(state["model"])
+        opt.set_state_dict(state["optimizer"])
+        start_step = int(state["step"]) + 1
+        losses = list(state["losses"])
+
+    with open(os.path.join(outdir, "incarnations.log"), "a") as f:
+        f.write(f"{start_step}\n")
+
+    for step in range(start_step, TOTAL_STEPS):
+        fault_injection.check_step(step)
+        rng = np.random.default_rng(step)        # data keyed by step only
+        x = paddle.to_tensor(rng.standard_normal((4, 4)).astype("float32"))
+        y = paddle.to_tensor(rng.standard_normal((4, 2)).astype("float32"))
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(round(float(loss.numpy()), 6))
+
+        mgr.save({"model": model.state_dict(),
+                  "optimizer": opt.state_dict(),
+                  "step": step, "losses": losses}, step=step)
+
+        if handler.preempted():
+            mgr.wait()
+            handler.exit_for_relaunch()
+
+    with open(os.path.join(outdir, "losses.json"), "w") as f:
+        json.dump(losses, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,418 @@
+"""Fault-tolerant runtime tests: atomic saves, manifest-committed
+checkpoints with latest-valid restore, retention, async-save error
+propagation, fault-injection spec validation, retry backoff, and the
+subprocess drills (torn-write crash + SIGTERM preemption → relaunch →
+resume) from docs/FAULT_TOLERANCE.md."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.checkpoint_manager import (
+    CheckpointManager, CheckpointError, step_dir_name, verify_checkpoint,
+)
+from paddle_tpu.utils import fault_injection
+from paddle_tpu.utils.fault_injection import FaultSpecError, InjectedFault
+from paddle_tpu.utils.retry import backoff_delays, retry_call
+
+CKPT_WORKER = os.path.join(os.path.dirname(__file__), "_ckpt_worker.py")
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(CKPT_WORKER)))
+
+
+def _worker_pythonpath():
+    pp = os.environ.get("PYTHONPATH", "")
+    return _REPO_ROOT + (os.pathsep + pp if pp else "")
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_flag():
+    yield
+    paddle.set_flags({"FLAGS_fault_inject": ""})
+
+
+def _state(v=1.0):
+    return {"w": paddle.to_tensor(np.full((4, 4), v, np.float32)),
+            "step": int(v)}
+
+
+# ---- atomic paddle.save ----
+
+def test_save_is_atomic_under_injected_torn_write(tmp_path):
+    path = str(tmp_path / "m.pdparams")
+    paddle.save(_state(1.0), path)
+    paddle.set_flags(
+        {"FLAGS_fault_inject": "ckpt_write:after_bytes=16,mode=raise"})
+    with pytest.raises(InjectedFault):
+        paddle.save(_state(2.0), path)
+    paddle.set_flags({"FLAGS_fault_inject": ""})
+    # the old file survives intact, and no tmp litter remains
+    loaded = paddle.load(path)
+    np.testing.assert_allclose(loaded["w"].numpy(), 1.0)
+    assert [n for n in os.listdir(tmp_path) if ".tmp." in n] == []
+
+
+# ---- CheckpointManager ----
+
+def test_manager_save_restore_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), step=0)
+    mgr.save(_state(2.0), step=1)
+    state, step = mgr.restore_latest()
+    assert step == 1
+    np.testing.assert_allclose(state["w"].numpy(), 2.0)
+    assert mgr.all_steps() == [0, 1]
+    # auto step numbering continues past the newest
+    mgr.save(_state(3.0))
+    assert mgr.latest_step() == 2
+
+
+def test_restore_latest_skips_and_gcs_torn_checkpoint(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), step=0)
+    mgr.save(_state(2.0), step=1)
+    torn = tmp_path / step_dir_name(1) / "manifest.json"
+    torn.unlink()                     # never committed
+    state, step = mgr.restore_latest()
+    assert step == 0
+    np.testing.assert_allclose(state["w"].numpy(), 1.0)
+    assert not (tmp_path / step_dir_name(1)).exists()  # GC'd
+
+
+def test_crc_mismatch_detected_as_corrupt(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), step=0)
+    mgr.save(_state(2.0), step=1)
+    payload = tmp_path / step_dir_name(1) / "state.pkl"
+    raw = bytearray(payload.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF        # same size, flipped byte
+    payload.write_bytes(bytes(raw))
+    assert not verify_checkpoint(str(tmp_path / step_dir_name(1)))
+    state, step = mgr.restore_latest()
+    assert step == 0
+
+
+def test_retention_keeps_last_n(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    for s in range(5):
+        mgr.save(_state(float(s)), step=s)
+    assert mgr.all_steps(valid_only=False) == [3, 4]
+
+
+def test_retention_never_deletes_last_valid(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=1)
+    mgr.save(_state(1.0), step=10)
+    # two NEWER torn dirs (no manifest — e.g. in-progress or crashed saves)
+    for s in (11, 12):
+        d = tmp_path / step_dir_name(s)
+        d.mkdir()
+        (d / "state.pkl").write_bytes(b"garbage")
+    mgr._retain()
+    assert (tmp_path / step_dir_name(10)).exists()
+    state, step = mgr.restore_latest()   # torn ones skipped + GC'd
+    assert step == 10
+    assert mgr.all_steps(valid_only=False) == [10]
+
+
+def test_failed_save_leaves_previous_checkpoint_restorable(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=1)
+    mgr.save(_state(1.0), step=0)
+    paddle.set_flags(
+        {"FLAGS_fault_inject": "ckpt_write:after_bytes=8,mode=raise"})
+    with pytest.raises(InjectedFault):
+        mgr.save(_state(2.0), step=1)
+    paddle.set_flags({"FLAGS_fault_inject": ""})
+    state, step = mgr.restore_latest()
+    assert step == 0
+    np.testing.assert_allclose(state["w"].numpy(), 1.0)
+
+
+def test_async_save_error_reraises_at_wait_and_next_save(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    mgr.save(_state(1.0), step=0)
+    mgr.wait()
+    paddle.set_flags(
+        {"FLAGS_fault_inject": "ckpt_write:after_bytes=8,mode=raise"})
+    mgr.save(_state(2.0), step=1)     # fails on the background thread
+    with pytest.raises(CheckpointError):
+        mgr.wait()
+    paddle.set_flags({"FLAGS_fault_inject": ""})
+    # the error is consumed once; the manager keeps working after
+    mgr.save(_state(3.0), step=2)
+    mgr.wait()
+    _state_r, step = mgr.restore_latest()
+    assert step == 2
+
+
+def test_async_save_error_surfaces_at_next_save(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    paddle.set_flags(
+        {"FLAGS_fault_inject": "ckpt_write:after_bytes=8,mode=raise"})
+    mgr.save(_state(1.0), step=0)
+    t = mgr._thread
+    t.join()                          # let the failure land
+    paddle.set_flags({"FLAGS_fault_inject": ""})
+    with pytest.raises(CheckpointError):
+        mgr.save(_state(2.0), step=1)
+
+
+# ---- orbax (distributed) checkpoints ----
+
+def test_distributed_restore_latest_skips_torn(tmp_path):
+    import paddle_tpu.distributed as dist
+    w = paddle.to_tensor(np.full((2, 2), 5.0, np.float32))
+    dist.save_checkpoint({"w": w}, str(tmp_path), step=0)
+    dist.save_checkpoint({"w": w * 2}, str(tmp_path), step=1)
+    os.remove(tmp_path / step_dir_name(1) / "manifest.json")
+    target = {"w": paddle.to_tensor(np.zeros((2, 2), np.float32))}
+    step = dist.restore_latest(target, str(tmp_path))
+    assert step == 0
+    np.testing.assert_allclose(target["w"].numpy(), 5.0)
+
+
+def test_distributed_retention(tmp_path):
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.checkpoint import scan_steps
+    w = paddle.to_tensor(np.ones((2, 2), np.float32))
+    for s in range(4):
+        dist.save_checkpoint({"w": w}, str(tmp_path), step=s, max_to_keep=2)
+    assert sorted(s for s, _ in scan_steps(str(tmp_path))) == [2, 3]
+
+
+# ---- fault-injection spec validation ----
+
+@pytest.mark.parametrize("bad", [
+    "bogus_point:after_bytes=1",          # unknown point
+    "ckpt_write",                         # params missing
+    "ckpt_write:",                        # empty params
+    "ckpt_write:after_bytes",             # no '='
+    "ckpt_write:after_bytes=xyz",         # type mismatch
+    "ckpt_write:nope=1",                  # unknown key
+    "step:crash_at=1;;",                  # empty point spec
+    ":after_bytes=1",                     # empty point name
+])
+def test_fault_spec_rejects_malformed(bad):
+    with pytest.raises(FaultSpecError):
+        fault_injection.parse(bad)
+
+
+def test_fault_spec_malformed_flag_raises_not_silently_ignores(tmp_path):
+    paddle.set_flags({"FLAGS_fault_inject": "ckpt_write:after_bytes"})
+    with pytest.raises(FaultSpecError):
+        paddle.save(_state(1.0), str(tmp_path / "x.pdparams"))
+
+
+def test_fault_spec_parse_ok():
+    spec = fault_injection.parse(
+        "ckpt_write:after_bytes=128,mode=raise;step:crash_at=3")
+    assert spec["ckpt_write"] == {"after_bytes": 128, "mode": "raise"}
+    assert spec["step"] == {"crash_at": 3}
+    assert fault_injection.parse("") == {}
+
+
+# ---- retry helper ----
+
+def test_retry_call_succeeds_after_transient_failures():
+    calls = {"n": 0}
+    slept = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, tries=5, base=0.001, jitter=0.5,
+                      sleep=slept.append) == "ok"
+    assert calls["n"] == 3 and len(slept) == 2
+
+
+def test_retry_call_gives_up_after_tries():
+    def always():
+        raise OSError("nope")
+    with pytest.raises(OSError):
+        retry_call(always, tries=3, base=0.001, sleep=lambda _d: None)
+
+
+def test_backoff_delays_capped_and_jittered():
+    ds = list(backoff_delays(base=0.1, factor=2.0, max_delay=0.5,
+                             jitter=0.5, tries=8))
+    assert len(ds) == 8
+    assert all(d >= 0.0 for d in ds)
+    assert all(d <= 0.5 * 1.5 + 1e-9 for d in ds)
+
+
+# ---- FileStore heartbeat atomicity ----
+
+def test_filestore_heartbeat_atomic(tmp_path):
+    import threading
+    from paddle_tpu.distributed.fleet.elastic import FileStore
+    store = FileStore(str(tmp_path / "hb"), ttl=5)
+    store.register("0")
+    misses, stop = [], threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            if "0" not in store.alive_nodes():
+                misses.append(1)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    for _ in range(300):
+        store.heartbeat("0")
+    stop.set()
+    t.join()
+    assert not misses                  # a live node never looked dead
+    assert [n for n in os.listdir(tmp_path / "hb") if ".tmp." in n] == []
+
+
+# ---- hapi resume ----
+
+def _fit_model():
+    from paddle_tpu import nn, Model
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(0.05, parameters=net.parameters()),
+        loss=lambda out, y: ((out - y) ** 2).mean())
+    return model
+
+
+def _fit_data():
+    from paddle_tpu.io import TensorDataset
+    rng = np.random.default_rng(0)
+    return TensorDataset([rng.standard_normal((16, 4)).astype("float32"),
+                          rng.standard_normal((16, 2)).astype("float32")])
+
+
+def test_hapi_fit_resume_and_max_to_keep(tmp_path):
+    data = _fit_data()
+    save_dir = str(tmp_path / "ck")
+    model = _fit_model()
+    model.fit(data, batch_size=8, epochs=3, verbose=0,
+              save_dir=save_dir, max_to_keep=2)
+    ref = model.network.weight.numpy().copy()
+    mgr = CheckpointManager(save_dir)
+    assert len(mgr.all_steps()) == 2          # retention bounded the dir
+
+    model2 = _fit_model()
+    hist = model2.fit(data, batch_size=8, epochs=3, verbose=0,
+                      save_dir=save_dir, max_to_keep=2, resume=True)
+    # all 3 epochs were already done: nothing re-trained, weights restored
+    assert hist["loss"] == []
+    np.testing.assert_allclose(model2.network.weight.numpy(), ref)
+
+    model3 = _fit_model()
+    model3.fit(data, batch_size=8, epochs=5, verbose=0,
+               save_dir=save_dir, max_to_keep=2, resume=True)
+    # resumed at epoch 3 and trained 2 more; optimizer state came along
+    assert CheckpointManager(save_dir).latest_step() is not None
+    assert not np.allclose(model3.network.weight.numpy(), ref)
+
+
+def test_hapi_fit_resume_skips_torn_checkpoint(tmp_path):
+    data = _fit_data()
+    save_dir = str(tmp_path / "ck")
+    model = _fit_model()
+    model.fit(data, batch_size=8, epochs=2, verbose=0, save_dir=save_dir)
+    mgr = CheckpointManager(save_dir)
+    newest = mgr.latest_step()
+    os.remove(os.path.join(save_dir, step_dir_name(newest),
+                           "manifest.json"))
+    model2 = _fit_model()
+    model2.fit(data, batch_size=8, epochs=2, verbose=0,
+               save_dir=save_dir, resume=True)
+    # resumed from the older VALID epoch checkpoint → epoch 1 re-ran
+    assert CheckpointManager(save_dir).latest_step() is not None
+
+
+# ---- subprocess drills ----
+
+def _run_worker(outdir, flags=""):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_worker_pythonpath())
+    env.pop("FLAGS_fault_inject", None)
+    if flags:
+        env["FLAGS_fault_inject"] = flags
+    return subprocess.run([sys.executable, CKPT_WORKER, str(outdir)],
+                          env=env, capture_output=True, text=True,
+                          timeout=240)
+
+
+def _incarnations(outdir):
+    with open(os.path.join(outdir, "incarnations.log")) as f:
+        return [int(line) for line in f.read().split()]
+
+
+def test_drill_torn_write_crash_then_resume(tmp_path):
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    r = _run_worker(clean)
+    assert r.returncode == 0, r.stderr
+
+    d = tmp_path / "torn"
+    d.mkdir()
+    # crash mid-write of step 3's payload: kills the process with the
+    # torn prefix fsync'd to disk
+    r = _run_worker(d, flags="ckpt_write:after_bytes=50,"
+                             f"file={step_dir_name(3)}")
+    assert r.returncode == fault_injection.DEFAULT_EXIT_CODE, r.stderr
+    torn_dir = d / "ckpts" / step_dir_name(3)
+    assert torn_dir.exists()
+    assert not verify_checkpoint(str(torn_dir))
+
+    # rerun without injection: resumes from step 2's checkpoint at step 3
+    r = _run_worker(d)
+    assert r.returncode == 0, r.stderr
+    assert _incarnations(d) == [0, 3]
+    # the torn dir was skipped (logged), GC'd, then legitimately
+    # re-written — valid this time — when the resumed run redid step 3
+    assert "torn/corrupt" in r.stderr
+    assert verify_checkpoint(str(torn_dir))
+    with open(d / "losses.json") as f:
+        resumed = json.load(f)
+    with open(clean / "losses.json") as f:
+        ref = json.load(f)
+    assert resumed == ref and len(ref) == 6
+
+
+def test_drill_sigterm_preemption_relaunch_resumes(tmp_path):
+    from paddle_tpu.distributed.launch.context import Context, parse_args
+    from paddle_tpu.distributed.launch.controller import (
+        CollectiveController)
+
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    r = _run_worker(clean)
+    assert r.returncode == 0, r.stderr
+
+    d = tmp_path / "preempt"
+    d.mkdir()
+    old = {k: os.environ.get(k)
+           for k in ("FLAGS_fault_inject", "PYTHONPATH")}
+    os.environ["FLAGS_fault_inject"] = "step:sigterm_at=3"
+    os.environ["PYTHONPATH"] = _worker_pythonpath()
+    try:
+        args = parse_args(["--nproc_per_node", "1", "--max_restart", "2",
+                           CKPT_WORKER, str(d)])
+        code = CollectiveController(Context(args=args)).run()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert code == 0
+    # first incarnation ran steps 0-3 (checkpointing step 3 at the
+    # boundary before exiting with ELASTIC_EXIT_CODE), relaunch resumed
+    # at step 4
+    assert _incarnations(d) == [0, 4]
+    with open(d / "losses.json") as f:
+        resumed = json.load(f)
+    with open(clean / "losses.json") as f:
+        ref = json.load(f)
+    assert resumed == ref and len(ref) == 6

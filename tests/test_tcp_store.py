@@ -67,6 +67,16 @@ def test_list_prefix_and_large_values(store):
     assert out["a/1"] == b"x" * 100_000
 
 
+def test_get_value_larger_than_buffer_and_growth(store):
+    # get() must loop until its buffer fits: a value can exceed the
+    # initial 64 KiB buffer — and GROW again between the size probe and
+    # the refetch (simulated by growing it right before each get)
+    store.set("big", b"x" * 100_000)
+    assert store.get("big") == b"x" * 100_000
+    store.set("big", b"y" * 300_000)
+    assert store.get("big") == b"y" * 300_000
+
+
 def test_second_client_sees_writes(store):
     c2 = TCPStore(port=store.port)
     store.set("shared", b"1")

@@ -19,6 +19,30 @@ python tools/check_api_compatible.py
 echo "== unit tests (full, incl. slow) =="
 PADDLE_TPU_RUN_SLOW=1 python -m pytest tests/ -q
 
+echo "== fault-tolerance drills (torn-write + preemption resume) =="
+python -m pytest tests/test_fault_tolerance.py -q
+
+echo "== fault-injection spec validation =="
+python - <<'EOF'
+from paddle_tpu.utils import fault_injection as fi
+
+# well-formed specs parse to typed params
+spec = fi.parse("ckpt_write:after_bytes=128,mode=raise;step:crash_at=3")
+assert spec["ckpt_write"]["after_bytes"] == 128
+assert spec["step"]["crash_at"] == 3
+
+# malformed specs must be rejected loudly, never silently inject nothing
+for bad in ("bogus:after_bytes=1", "ckpt_write", "ckpt_write:after_bytes",
+            "ckpt_write:after_bytes=xyz", "step:nope=1"):
+    try:
+        fi.parse(bad)
+    except fi.FaultSpecError:
+        pass
+    else:
+        raise SystemExit(f"spec {bad!r} was not rejected")
+print("fault-injection spec validation OK")
+EOF
+
 echo "== eager op-dispatch cache microbench (smoke) =="
 python benchmarks/eager_overhead.py --smoke --out /tmp/eager_overhead_ci.json
 python tools/check_bench_result.py /tmp/eager_overhead_ci.json
