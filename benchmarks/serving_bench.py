@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Continuous-batching serving benchmark: engine vs sequential generate.
+
+Measures end-to-end tokens/sec for N greedy requests served two ways in
+the same process:
+
+- **sequential** — the pre-serving baseline: one blocking
+  `model.generate()` per request, batch 1, requests queue behind each
+  other (what `inference.Predictor.run()` amounts to);
+- **serving** — `paddle_tpu.serving.Engine`: all N requests submitted
+  concurrently, admitted into `num_slots` KV slots, decoded as ONE
+  batched static-shape step per iteration with finished slots refilled
+  mid-flight (Orca-style continuous batching).
+
+Both sides pay the same per-request prefill; the win comes from decode
+steps amortized across slots.  Prints ONE JSON line and (unless
+--no-write) records the full result at benchmarks/SERVING_BENCH.json.
+`--smoke` shrinks the workload for CI (tools/run_ci.sh), which then
+validates the JSON schema via tools/check_bench_result.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _build_model(paddle):
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=2, hidden_size=128, num_heads=4,
+        vocab_size=512, max_seq_len=128))
+    model.eval()
+    return model
+
+
+def _prompts(num_requests, rng):
+    # mixed lengths: slots hold sequences of different ages from step 1
+    lens = [int(rng.integers(4, 12)) for _ in range(num_requests)]
+    return [rng.integers(0, 512, (n,)).astype("int32") for n in lens]
+
+
+def _run_sequential(paddle, model, prompts, max_new):
+    outs = []
+    t0 = time.perf_counter()
+    for p in prompts:
+        ids = model.generate(paddle.to_tensor(p[None, :]),
+                             max_new_tokens=max_new, temperature=0.0)
+        outs.append(np.asarray(ids._data_)[0, p.size:])
+    wall = time.perf_counter() - t0
+    tokens = sum(o.size for o in outs)
+    return outs, tokens, wall
+
+
+def _run_serving(model, prompts, max_new, num_slots):
+    from paddle_tpu.serving import Engine, ServingConfig
+    eng = Engine(model, ServingConfig(num_slots=num_slots,
+                                      max_queue=len(prompts))).start()
+    try:
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        outs = [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+        snap = eng.stats()
+    finally:
+        eng.shutdown()
+    tokens = sum(o.output_ids.size for o in outs)
+    return outs, tokens, wall, snap
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-new-tokens", type=int, default=32)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI scale: 6 requests x 12 tokens")
+    ap.add_argument("--out", default=None,
+                    help="result path (default benchmarks/"
+                         "SERVING_BENCH.json)")
+    ap.add_argument("--no-write", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.requests, args.max_new_tokens = 6, 12
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import paddle_tpu as paddle
+
+    model = _build_model(paddle)
+    rng = np.random.default_rng(42)
+    prompts = _prompts(args.requests, rng)
+
+    # warm both lanes so neither measurement pays first-compile
+    _run_sequential(paddle, model, prompts[:1], 2)
+    _run_serving(model, prompts[:1], 2, args.slots)
+
+    seq_out, seq_tokens, seq_wall = _run_sequential(
+        paddle, model, prompts, args.max_new_tokens)
+    srv_out, srv_tokens, srv_wall, snap = _run_serving(
+        model, prompts, args.max_new_tokens, args.slots)
+
+    # greedy serving output must MATCH the sequential baseline
+    mismatches = sum(
+        0 if np.array_equal(o.output_ids, ref) else 1
+        for o, ref in zip(srv_out, seq_out))
+
+    seq_tps = seq_tokens / seq_wall
+    srv_tps = srv_tokens / srv_wall
+    rec = {
+        "metric": "serving_continuous_batching_cpu",
+        "value": srv_tps,
+        "unit": "tokens_per_sec",
+        "speedup_vs_sequential": srv_tps / seq_tps,
+        "sequential": {"tokens_per_sec": seq_tps, "wall_s": seq_wall,
+                       "tokens": seq_tokens},
+        "serving": {"tokens_per_sec": srv_tps, "wall_s": srv_wall,
+                    "tokens": srv_tokens},
+        "ttft_ms_avg": snap["ttft_ms_avg"],
+        "per_token_ms_avg": snap["per_token_ms_avg"],
+        "slot_occupancy": snap["slot_occupancy"],
+        "num_requests": args.requests,
+        "num_slots": args.slots,
+        "max_new_tokens": args.max_new_tokens,
+        "greedy_mismatches": mismatches,
+        "smoke": bool(args.smoke),
+        "platform": jax.devices()[0].platform,
+    }
+
+    out_path = args.out or os.path.join(os.path.dirname(__file__),
+                                        "SERVING_BENCH.json")
+    if not args.no_write:
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+        print(f"wrote {out_path}", file=sys.stderr)
+    print(json.dumps({k: rec[k] for k in
+                      ("metric", "value", "speedup_vs_sequential",
+                       "ttft_ms_avg", "slot_occupancy",
+                       "greedy_mismatches")}))
+    return 0 if mismatches == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -137,6 +137,7 @@ from . import metric  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from .static import enable_static, disable_static  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import serving  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import fft  # noqa: F401,E402

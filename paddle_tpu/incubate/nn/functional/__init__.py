@@ -108,12 +108,20 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                                     dtype=jnp.float32) / d))
         pos = (position_ids._data if isinstance(position_ids, Tensor)
                else jnp.arange(s, dtype=jnp.float32))
-        freqs = jnp.outer(pos, inv)                       # [s, d/2]
-        emb = jnp.concatenate([freqs, freqs], axis=-1)    # [s, d]
-        if pos.ndim == 1 and emb.shape[0] == s:
-            cos2d, sin2d = jnp.cos(emb), jnp.sin(emb)
-        cos_a = jnp.cos(emb)[None, :, None, :]
-        sin_a = jnp.sin(emb)[None, :, None, :]
+        if pos.ndim == 2:
+            # [B, S] per-row positions (serving slot caches: every row
+            # decodes at its own age) — tables broadcast per row
+            freqs = pos[..., None].astype(jnp.float32) * inv  # [B,S,d/2]
+            emb = jnp.concatenate([freqs, freqs], axis=-1)    # [B,S,d]
+            cos_a = jnp.cos(emb)[:, :, None, :]
+            sin_a = jnp.sin(emb)[:, :, None, :]
+        else:
+            freqs = jnp.outer(pos, inv)                       # [s, d/2]
+            emb = jnp.concatenate([freqs, freqs], axis=-1)    # [s, d]
+            if pos.ndim == 1 and emb.shape[0] == s:
+                cos2d, sin2d = jnp.cos(emb), jnp.sin(emb)
+            cos_a = jnp.cos(emb)[None, :, None, :]
+            sin_a = jnp.sin(emb)[None, :, None, :]
     else:
         cos_a = cos._data if isinstance(cos, Tensor) else jnp.asarray(cos)
         sin_a = sin._data if isinstance(sin, Tensor) else jnp.asarray(sin)
@@ -176,11 +184,13 @@ def masked_multihead_attention(q, k, v, cache_k, cache_v, offset,
 
     q/k/v: [B, S, H, D] new tokens (S=1 in steady-state decode, larger at
     prefill); cache_k/cache_v: [B, S_max, H, D]; offset: int32 scalar —
-    tokens already in the cache.  Writes the new K/V at offset..offset+S,
-    attends causally over positions <= offset+i, and returns
-    (out, cache_k', cache_v').  Static shapes throughout: one compiled
-    program serves every decode step (the TPU analog of the reference's
-    persistent decode kernel).
+    tokens already in the cache — or an int32 [B] vector of PER-ROW
+    offsets (the serving engine's slot-based caches, where sequences of
+    different ages share one decode step).  Writes the new K/V at
+    offset..offset+S per row, attends causally over positions
+    <= offset+i, and returns (out, cache_k', cache_v').  Static shapes
+    throughout: one compiled program serves every decode step (the TPU
+    analog of the reference's persistent decode kernel).
 
     GQA is native: when K/V carry fewer heads than Q (cache holds
     num_kv_heads — never the repeated copies), Q's heads are grouped onto
@@ -196,11 +206,13 @@ def masked_multihead_attention(q, k, v, cache_k, cache_v, offset,
     s_cap = cache_k.shape[1]
     off_concrete = None
     try:
-        off_concrete = int(offset if isinstance(offset, int)
-                           else offset.item())
+        import numpy as _np
+        raw = offset._data_ if isinstance(offset, Tensor) else offset
+        if not isinstance(raw, jax.core.Tracer):
+            off_concrete = _np.asarray(raw)
     except Exception:
         pass   # traced offset: caller owns the bound
-    if off_concrete is not None and off_concrete + s_new > s_cap:
+    if off_concrete is not None and (off_concrete + s_new > s_cap).any():
         raise ValueError(
             f"KV cache overflow: offset {off_concrete} + {s_new} new "
             f"tokens > cache capacity {s_cap}")
@@ -211,25 +223,36 @@ def masked_multihead_attention(q, k, v, cache_k, cache_v, offset,
         sc = scale if scale is not None else 1.0 / _math.sqrt(d)
         off = off.astype(jnp.int32) if hasattr(off, "astype") else \
             jnp.int32(off)
-        ck = jax.lax.dynamic_update_slice(ck, ka.astype(ck.dtype),
-                                          (0, off, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, va.astype(cv.dtype),
-                                          (0, off, 0, 0))
-        q_pos = off + jnp.arange(s)[:, None]          # [s, 1]
-        k_pos = jnp.arange(s_max)[None, :]            # [1, s_max]
-        mask = k_pos <= q_pos                         # causal over cache
+        if off.ndim == 1:
+            # per-row offsets: each slot writes its new K/V at its own
+            # age and masks its own causal horizon (serving slot caches)
+            upd = jax.vmap(lambda c, u, o: jax.lax.dynamic_update_slice(
+                c, u, (o, 0, 0)))
+            ck = upd(ck, ka.astype(ck.dtype), off)
+            cv = upd(cv, va.astype(cv.dtype), off)
+            q_pos = off[:, None, None] + jnp.arange(s)[None, :, None]
+            k_pos = jnp.arange(s_max)[None, None, :]
+            mask = k_pos <= q_pos                     # [b, s, s_max]
+        else:
+            ck = jax.lax.dynamic_update_slice(ck, ka.astype(ck.dtype),
+                                              (0, off, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, va.astype(cv.dtype),
+                                              (0, off, 0, 0))
+            q_pos = off + jnp.arange(s)[:, None]      # [s, 1]
+            k_pos = jnp.arange(s_max)[None, :]        # [1, s_max]
+            mask = (k_pos <= q_pos)[None]             # [1, s, s_max]
         qf = qa.astype(jnp.float32)
         kf = ck.astype(jnp.float32)
         if h_q == h_kv:
             logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * sc
-            logits = jnp.where(mask[None, None], logits, -1e30)
+            logits = jnp.where(mask[:, None], logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cv.dtype), cv)
         else:                                         # grouped-query
             rep = h_q // h_kv
             qg = qf.reshape(b, s, h_kv, rep, d)
             logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, kf) * sc
-            logits = jnp.where(mask[None, None, None], logits, -1e30)
+            logits = jnp.where(mask[:, None, None], logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
             out = jnp.einsum("bhrqk,bkhd->bqhrd", probs.astype(cv.dtype),
                              cv).reshape(b, s, h_q, d)

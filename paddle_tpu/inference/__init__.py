@@ -53,6 +53,17 @@ class Config:
     def __init__(self, model_path=None, params_path=None):
         if model_path is not None and model_path.endswith(".pdmodel"):
             model_path = model_path[:-len(".pdmodel")]
+        if model_path is not None:
+            # fail at construction, not at Predictor build time: a bad
+            # path should name itself, not surface as a load error later
+            import os
+            bundle = model_path if str(model_path).endswith(".onnx") \
+                else model_path + ".pdmodel"
+            if not os.path.exists(bundle):
+                raise FileNotFoundError(
+                    f"Config model_path {model_path!r}: {bundle!r} does "
+                    "not exist (expected a <prefix>.pdmodel StableHLO "
+                    "bundle or an .onnx file)")
         self.prefix = model_path
         self.precision = PrecisionType.Float32
         self._device = None
@@ -226,6 +237,11 @@ class PredictorPool:
         self._preds = [Predictor(config) for _ in range(max(1, size))]
 
     def retrieve(self, idx):
+        if not 0 <= idx < len(self._preds):
+            raise IndexError(
+                f"PredictorPool.retrieve({idx}): pool holds "
+                f"{len(self._preds)} predictor(s); valid indices are "
+                f"0..{len(self._preds) - 1}")
         return self._preds[idx]
 
 

@@ -43,14 +43,15 @@ def _seen_mask(ids, vocab):
     return F.one_hot(ids, num_classes=vocab).sum(axis=1) > 0
 
 
-def _sample(logits_last, temperature, top_k, top_p=None,
-            repetition_penalty=None, seen=None):
-    """[B, V] → [B] next tokens.  Logit processors apply in the HF
-    order: repetition penalty (also for greedy) → temperature → top-k
-    → top-p (nucleus) → sample.  `seen` is the fixed-shape [B, V]
-    already-emitted mask (so every decode step stays the same
-    static-shape program)."""
-    from ..tensor_ops import random as R, search as S
+def apply_logit_processors(logits_last, temperature=1.0, top_k=None,
+                           top_p=None, repetition_penalty=None, seen=None):
+    """[B, V] → [B, V] processed logits, HF order: repetition penalty
+    (also for greedy) → temperature → top-k → top-p (nucleus).  `seen`
+    is the fixed-shape [B, V] already-emitted mask (so every decode step
+    stays the same static-shape program).  top_k >= vocab is a no-op
+    (clamped), top_p=1.0 is a no-op.  Shared by generate() and the
+    serving engine's per-slot sampling."""
+    from ..tensor_ops import search as S
     from ..nn import functional as F
     if repetition_penalty is not None and repetition_penalty != 1.0 \
             and seen is not None:
@@ -59,10 +60,11 @@ def _sample(logits_last, temperature, top_k, top_p=None,
                             logits_last * repetition_penalty)
         logits_last = S.where(seen, penalized, logits_last)
     if temperature == 0.0:
-        return S.argmax(logits_last, axis=-1)
+        return logits_last          # greedy: argmax is scale-invariant
     logits_last = logits_last / temperature
     if top_k is not None:
-        vals, _ = S.topk(logits_last, top_k)
+        k = min(int(top_k), logits_last.shape[-1])
+        vals, _ = S.topk(logits_last, k)
         minv = vals[:, -1:]
         logits_last = MA.masked_fill(logits_last, logits_last < minv,
                                      float("-inf"))
@@ -78,8 +80,25 @@ def _sample(logits_last, temperature, top_k, top_p=None,
                               float("inf")).min(axis=-1, keepdim=True)
         logits_last = MA.masked_fill(logits_last, logits_last < minv,
                                      float("-inf"))
+    return logits_last
+
+
+def sample_next_token(logits_last, temperature=0.0, top_k=None, top_p=None,
+                      repetition_penalty=None, seen=None):
+    """[B, V] → [B] next tokens: apply_logit_processors then argmax
+    (temperature=0) or multinomial sampling."""
+    from ..tensor_ops import random as R, search as S
+    from ..nn import functional as F
+    logits_last = apply_logit_processors(
+        logits_last, temperature=temperature, top_k=top_k, top_p=top_p,
+        repetition_penalty=repetition_penalty, seen=seen)
+    if temperature == 0.0:
+        return S.argmax(logits_last, axis=-1)
     probs = F.softmax(logits_last, axis=-1)
     return MA.reshape(R.multinomial(probs, 1), [-1])
+
+
+_sample = sample_next_token
 
 
 class _EosTracker:
@@ -99,6 +118,18 @@ class _EosTracker:
         import numpy as np
         self.done |= np.asarray(nxt._data_) == self.eos
         return bool(self.done.all())
+
+    def force(self, nxt):
+        """Rows already finished BEFORE this step keep emitting eos —
+        not live samples — so an unevenly-finishing batch never grows
+        garbage suffixes past each row's eos."""
+        if self.done is None or not self.done.any():
+            return nxt
+        import numpy as np
+        from ..core.tensor import Tensor
+        arr = np.array(np.asarray(nxt._data_))
+        arr[self.done] = self.eos
+        return Tensor(arr)
 
 
 def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
@@ -134,6 +165,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
                 logits = model(ids)
                 nxt = _sample(logits[:, -1, :], temperature, top_k,
                               top_p, repetition_penalty, seen=seen)
+                nxt = tracker.force(nxt)
                 if use_pen:
                     seen = seen | _seen_mask(MA.reshape(nxt, [b, 1]),
                                              cfg.vocab_size)
@@ -169,6 +201,7 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
             _advance(caches, 1)
             nxt = _sample(logits[:, -1, :], temperature, top_k, top_p,
                           repetition_penalty, seen=seen)
+            nxt = tracker.force(nxt)
         pieces.append(MA.reshape(nxt, [b, 1]))
         return MA.concat(pieces, axis=1)
 

@@ -152,7 +152,14 @@ class GPTModel(Layer):
         if position_ids is None:
             position_ids = creation.arange(s, dtype="int32")
             if caches is not None:
-                position_ids = position_ids + caches[0]["offset"]
+                off = caches[0]["offset"]
+                if len(getattr(off, "shape", [])) == 1:
+                    # per-slot offsets (serving): [B, S] positions so each
+                    # row is embedded at its own age
+                    position_ids = MA.reshape(off, [b, 1]) + \
+                        MA.reshape(position_ids, [1, s])
+                else:
+                    position_ids = position_ids + off
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         for i, block in enumerate(self.h):

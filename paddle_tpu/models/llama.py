@@ -93,7 +93,13 @@ class LlamaAttention(Layer):
         v = MA.reshape(self.v_proj(x), [b, s, cfg.num_kv_heads, d])
         if cache is not None:
             from ..tensor_ops import creation
-            pos = creation.arange(s, dtype="int32") + cache["offset"]
+            off = cache["offset"]
+            pos = creation.arange(s, dtype="int32")
+            if len(getattr(off, "shape", [])) == 1:
+                # per-slot offsets (serving): [B, S] rope positions
+                pos = MA.reshape(off, [b, 1]) + MA.reshape(pos, [1, s])
+            else:
+                pos = pos + off
             q, k, _ = IF.fused_rotary_position_embedding(
                 q, k, position_ids=pos, rotary_emb_base=cfg.rope_theta)
         else:

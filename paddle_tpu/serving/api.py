@@ -1,0 +1,123 @@
+"""Serving frontend types: config, sampling params, results, errors.
+
+The engine (serving/engine.py) consumes these; clients construct a
+`ServingConfig`, `Engine(model, config).start()`, then call the sync
+`generate()` or async `submit() -> Future` APIs.  Admission control is
+part of the contract: a bounded queue rejects with `QueueFullError`
+instead of buffering unboundedly, and per-request deadlines evict the
+slot (`DeadlineExceededError`) so one slow client cannot squat capacity.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-layer failures."""
+
+
+class QueueFullError(ServingError):
+    """Admission rejected: the bounded request queue is at capacity."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline passed; its slot was evicted (or it was
+    dropped from the queue before ever reaching a slot)."""
+
+
+class EngineShutdownError(ServingError):
+    """The engine stopped while the request was queued or in flight."""
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decoding knobs — the same semantics (and HF processor
+    order) as `models.generation.generate`; temperature=0.0 is greedy."""
+
+    temperature: float = 0.0
+    top_k: int | None = None
+    top_p: float | None = None
+    repetition_penalty: float | None = None
+
+    def validate(self):
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.repetition_penalty is not None and \
+                self.repetition_penalty <= 0.0:
+            raise ValueError("repetition_penalty must be > 0, got "
+                             f"{self.repetition_penalty}")
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+        return self
+
+    @property
+    def greedy(self):
+        return self.temperature == 0.0
+
+    @property
+    def uses_penalty(self):
+        return self.repetition_penalty is not None and \
+            self.repetition_penalty != 1.0
+
+
+@dataclass
+class ServingConfig:
+    """Engine knobs (docs/KNOBS.md "serving" table).
+
+    num_slots                decode-batch width = max concurrent
+                             sequences (the ONE compiled decode step is
+                             [num_slots, 1] whatever mix occupies it)
+    max_queue                bounded admission queue; submit() past this
+                             raises QueueFullError
+    max_seq_len              per-slot KV capacity; None → model's
+                             config.max_seq_len
+    default_max_new_tokens   per-request cap when submit() passes None
+    request_timeout_s        sync generate()'s Future.result timeout
+    deadline_policy          "evict": a request past its deadline_s is
+                             failed and its slot freed; "ignore":
+                             deadlines are recorded but never enforced
+    cache_dtype              KV-cache element type
+    idle_wait_s              scheduler sleep when no work is queued
+    """
+
+    num_slots: int = 4
+    max_queue: int = 64
+    max_seq_len: int | None = None
+    default_max_new_tokens: int = 64
+    request_timeout_s: float = 120.0
+    deadline_policy: str = "evict"
+    cache_dtype: str = "float32"
+    idle_wait_s: float = 0.005
+
+    def validate(self):
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got "
+                             f"{self.num_slots}")
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got "
+                             f"{self.max_queue}")
+        if self.deadline_policy not in ("evict", "ignore"):
+            raise ValueError(
+                "deadline_policy must be 'evict' or 'ignore', got "
+                f"{self.deadline_policy!r}")
+        return self
+
+
+@dataclass
+class RequestOutput:
+    """What a completed request's Future resolves to."""
+
+    request_id: int
+    prompt_ids: np.ndarray          # [S] int32, as submitted
+    output_ids: np.ndarray          # [T] int32 generated tokens
+    finish_reason: str              # "eos" | "length"
+    ttft_ms: float                  # submit → first token
+    latency_ms: float               # submit → completion
+
+    @property
+    def ids(self):
+        """[S+T] prompt + generated, the `generate()`-shaped view."""
+        return np.concatenate([self.prompt_ids, self.output_ids])

@@ -1,0 +1,353 @@
+"""Continuous-batching inference engine.
+
+Reference capability: the serving stacks the reference feeds through
+`AnalysisPredictor` put a request queue and a batcher in front of the
+blocking `run()`.  TPU-native realization (Orca/vLLM-style): because
+every decode step is the SAME static-shape compiled program (PR 1 caches
+the executable), throughput is purely a matter of keeping that program
+FED.  A background scheduler thread:
+
+1. admits queued requests into free KV slots (batch-1 prefill, sampled
+   first token → time-to-first-token),
+2. runs ONE batched decode step per iteration over all `num_slots` slots
+   — per-slot offsets (serving/kv_slots.py) let sequences of different
+   ages share the step, and a finished/evicted slot is refilled on the
+   next iteration without draining the batch,
+3. applies per-request sampling params (the processor chain factored out
+   of models/generation.py) and completes futures on EOS, max-tokens,
+   deadline, or shutdown.
+
+Requests never see each other: slots are independent batch rows, masked
+to their own causal horizon.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from . import stats
+from .api import (DeadlineExceededError, EngineShutdownError,
+                  QueueFullError, RequestOutput, SamplingParams,
+                  ServingConfig)
+from .kv_slots import SlotKVCache
+
+
+class _Request:
+    __slots__ = ("id", "prompt", "max_new_tokens", "sampling",
+                 "eos_token_id", "deadline", "future", "submit_t",
+                 "ttft_ms", "tokens", "seen", "last_token", "slot")
+
+    def __init__(self, rid, prompt, max_new_tokens, sampling,
+                 eos_token_id, deadline):
+        self.id = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.sampling = sampling
+        self.eos_token_id = eos_token_id
+        self.deadline = deadline
+        self.future = Future()
+        self.submit_t = time.monotonic()
+        self.ttft_ms = None
+        self.tokens = []
+        self.seen = None            # [V] bool, only under rep penalty
+        self.last_token = 0
+        self.slot = None
+
+
+class Engine:
+    """`Engine(model).start()`; then `submit()` (async, returns a
+    `Future[RequestOutput]`) or `generate()` (sync).  `shutdown()` stops
+    the scheduler and fails every queued/in-flight future with
+    `EngineShutdownError` — no leaked threads, no hung clients."""
+
+    def __init__(self, model, config: ServingConfig | None = None):
+        self.model = model
+        self.cfg = model.config
+        self.scfg = (config or ServingConfig()).validate()
+        if hasattr(model, "eval"):
+            model.eval()            # serving never wants dropout
+        self.max_len = self.scfg.max_seq_len or self.cfg.max_seq_len
+        self._kv_heads = getattr(self.cfg, "num_kv_heads",
+                                 self.cfg.num_heads)
+        self._queue: deque[_Request] = deque()
+        self._active: dict[int, _Request] = {}
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._running = False
+        self._thread = None
+        self._ids = itertools.count()
+        self.cache = None
+
+    # ---------------- lifecycle ----------------
+    def start(self):
+        with self._lock:
+            if self._running:
+                return self
+            stats.reset_serving_stats()
+            self.cache = SlotKVCache(
+                self.cfg.num_layers, self.scfg.num_slots, self.max_len,
+                self._kv_heads, self.cfg.head_dim,
+                dtype=self.scfg.cache_dtype)
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="paddle-tpu-serving", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self, wait_s=30.0):
+        """Stop the scheduler.  In-flight and queued futures resolve
+        with `EngineShutdownError`; the scheduler thread is joined."""
+        with self._work:
+            self._running = False
+            self._work.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(wait_s)
+            if t.is_alive():            # pragma: no cover
+                raise RuntimeError(
+                    "serving scheduler thread failed to stop within "
+                    f"{wait_s}s")
+        self._thread = None
+        # the loop's finally already failed everything; this covers a
+        # shutdown() racing a never-started or crashed loop
+        self._fail_all(EngineShutdownError("engine shut down"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # ---------------- client API ----------------
+    def submit(self, prompt_ids, max_new_tokens=None, sampling=None,
+               eos_token_id=None, deadline_s=None):
+        """Enqueue one request; returns a `Future[RequestOutput]`.
+        Raises `QueueFullError` when the bounded queue is at capacity
+        and `ValueError` for prompts the slot cache cannot hold."""
+        prompt = np.asarray(
+            prompt_ids._data_ if hasattr(prompt_ids, "_data_")
+            else prompt_ids).astype(np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size >= self.max_len:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens leaves no room to "
+                f"decode in a {self.max_len}-token slot")
+        sampling = (sampling or SamplingParams()).validate()
+        max_new = int(self.scfg.default_max_new_tokens
+                      if max_new_tokens is None else max_new_tokens)
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new}")
+        deadline = (time.monotonic() + deadline_s) \
+            if deadline_s is not None else None
+        req = _Request(next(self._ids), prompt, max_new, sampling,
+                       eos_token_id, deadline)
+        with self._work:
+            if not self._running:
+                raise EngineShutdownError(
+                    "engine is not running (call start())")
+            if len(self._queue) >= self.scfg.max_queue:
+                stats.incr("requests_rejected_queue_full")
+                raise QueueFullError(
+                    f"request queue is full ({self.scfg.max_queue} "
+                    "waiting); retry later or raise "
+                    "ServingConfig.max_queue")
+            self._queue.append(req)
+            stats.incr("requests_submitted")
+            stats.set_value("queue_depth", len(self._queue))
+            self._work.notify()
+        return req.future
+
+    def generate(self, prompt_ids, max_new_tokens=None, sampling=None,
+                 eos_token_id=None, deadline_s=None, timeout=None):
+        """Sync client: submit + wait.  Returns a `RequestOutput`."""
+        fut = self.submit(prompt_ids, max_new_tokens=max_new_tokens,
+                          sampling=sampling, eos_token_id=eos_token_id,
+                          deadline_s=deadline_s)
+        return fut.result(timeout or self.scfg.request_timeout_s)
+
+    def stats(self):
+        return stats.serving_stats()
+
+    # ---------------- scheduler ----------------
+    def _loop(self):
+        from ..core.state import no_grad
+        try:
+            with no_grad():
+                while True:
+                    with self._work:
+                        if not self._running:
+                            break
+                        self._expire_queued_locked()
+                        admits = []
+                        while self._queue and self.cache.free_slots:
+                            slot = self.cache.allocate()
+                            admits.append((self._queue.popleft(), slot))
+                        stats.set_value("queue_depth", len(self._queue))
+                        if not admits and not self._active:
+                            self._work.wait(self.scfg.idle_wait_s)
+                            continue
+                    for req, slot in admits:
+                        self._prefill(req, slot)
+                    if self._active:
+                        self._decode_step()
+        except BaseException as exc:    # never die silently: fail the
+            self._fail_all(exc)         # futures so clients see it
+            raise
+        finally:
+            self._fail_all(EngineShutdownError("engine shut down"))
+            stats.set_value("active_slots", 0)
+            stats.set_value("queue_depth", 0)
+
+    def _expire_queued_locked(self):
+        if self.scfg.deadline_policy != "evict":
+            return
+        now = time.monotonic()
+        keep = deque()
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                self._fail(req, DeadlineExceededError(
+                    f"request {req.id} expired after "
+                    f"{now - req.submit_t:.3f}s in queue"))
+                stats.incr("requests_evicted_deadline")
+            else:
+                keep.append(req)
+        self._queue = keep
+
+    def _prefill(self, req, slot):
+        """Batch-1 prompt pass into the slot's rows + first token."""
+        from ..core.tensor import Tensor
+        from ..models.generation import init_kv_caches
+        from ..profiler import RecordEvent
+        t0 = time.monotonic()
+        with RecordEvent("serving::prefill"):
+            caches = init_kv_caches(
+                self.cfg.num_layers, 1, self.max_len, self._kv_heads,
+                self.cfg.head_dim, dtype=self.scfg.cache_dtype)
+            logits = self.model(Tensor(req.prompt[None, :]),
+                                caches=caches)
+            self.cache.write_prefill(slot, caches, req.prompt.size)
+            if req.sampling.uses_penalty:
+                seen = np.zeros(self.cfg.vocab_size, bool)
+                seen[req.prompt] = True
+                req.seen = seen
+            tok = self._sample_row(logits[:, -1, :], req)
+        now = time.monotonic()
+        req.ttft_ms = (now - req.submit_t) * 1e3
+        stats.observe("ttft_ms", req.ttft_ms)
+        stats.observe("prefill_ms", (now - t0) * 1e3)
+        stats.incr("prefill_steps")
+        req.slot = slot
+        self._active[slot] = req
+        self._append_token(req, tok)
+        stats.set_value("active_slots", len(self._active))
+
+    def _decode_step(self):
+        """One batched step over ALL slots: the continuous batch."""
+        from ..core.tensor import Tensor
+        from ..profiler import RecordEvent
+        from ..tensor_ops import search as S
+        t0 = time.monotonic()
+        n_active = len(self._active)
+        with RecordEvent("serving::decode"):
+            tok_in = np.zeros((self.cache.num_slots, 1), np.int32)
+            for slot, req in self._active.items():
+                tok_in[slot, 0] = req.last_token
+            logits = self.model(Tensor(tok_in),
+                                caches=self.cache.layer_caches())
+            self.cache.advance(self._active.keys())
+            last = logits[:, -1, :]                  # [num_slots, V]
+            all_greedy = all(
+                r.sampling.greedy and not r.sampling.uses_penalty
+                for r in self._active.values())
+            if all_greedy:
+                toks = np.asarray(
+                    S.argmax(last, axis=-1)._data_)  # one batched argmax
+            for slot, req in list(self._active.items()):
+                tok = int(toks[slot]) if all_greedy else \
+                    self._sample_row(last[slot:slot + 1, :], req)
+                self._append_token(req, tok)
+        stats.observe("decode_ms", (time.monotonic() - t0) * 1e3)
+        stats.incr("decode_steps")
+        stats.incr("slot_steps", self.cache.num_slots)
+        stats.incr("slot_steps_active", n_active)
+        stats.set_value("active_slots", len(self._active))
+
+    def _sample_row(self, logits_row, req):
+        """[1, V] logits → one token under the request's params (the
+        processor chain shared with models/generation)."""
+        from ..core.tensor import Tensor
+        from ..models.generation import sample_next_token
+        sp = req.sampling
+        seen_t = Tensor(req.seen[None, :]) if req.seen is not None \
+            else None
+        nxt = sample_next_token(
+            logits_row, temperature=sp.temperature, top_k=sp.top_k,
+            top_p=sp.top_p, repetition_penalty=sp.repetition_penalty,
+            seen=seen_t)
+        return int(np.asarray(nxt._data_).reshape(-1)[0])
+
+    def _append_token(self, req, tok):
+        """Account one generated token, then finish/evict the request
+        if it hit EOS, its token budget, slot capacity, or deadline."""
+        req.tokens.append(tok)
+        req.last_token = tok
+        if req.seen is not None:
+            req.seen[tok] = True
+        stats.incr("tokens_generated")
+        now = time.monotonic()
+        if self.scfg.deadline_policy == "evict" and \
+                req.deadline is not None and now > req.deadline:
+            self._fail(req, DeadlineExceededError(
+                f"request {req.id} exceeded its deadline after "
+                f"{len(req.tokens)} token(s)"))
+            stats.incr("requests_evicted_deadline")
+            self._release(req)
+            return
+        reason = None
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            reason = "eos"
+        elif len(req.tokens) >= req.max_new_tokens:
+            reason = "length"
+        elif req.prompt.size + len(req.tokens) >= self.max_len:
+            reason = "length"       # slot capacity: no room to decode
+        if reason is not None:
+            self._complete(req, reason, now)
+            self._release(req)
+
+    def _complete(self, req, reason, now):
+        out = RequestOutput(
+            request_id=req.id, prompt_ids=req.prompt,
+            output_ids=np.asarray(req.tokens, np.int32),
+            finish_reason=reason, ttft_ms=req.ttft_ms,
+            latency_ms=(now - req.submit_t) * 1e3)
+        if not req.future.done():
+            req.future.set_result(out)
+        stats.incr("requests_completed")
+
+    def _fail(self, req, exc):
+        if not req.future.done():
+            req.future.set_exception(exc)
+
+    def _release(self, req):
+        if req.slot is not None and req.slot in self._active:
+            del self._active[req.slot]
+            self.cache.release(req.slot)
+            req.slot = None
+
+    def _fail_all(self, exc):
+        with self._lock:
+            queued = list(self._queue)
+            self._queue.clear()
+            active = list(self._active.values())
+            self._active.clear()
+        for req in queued + active:
+            if not req.future.done():
+                self._fail(req, exc)
+                stats.incr("requests_cancelled_shutdown")

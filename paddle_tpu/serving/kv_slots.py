@@ -1,0 +1,106 @@
+"""Slot-based KV caches for continuous batching.
+
+`models/generation.init_kv_caches` keys every sequence in a batch to ONE
+shared scalar offset — correct for a single `generate()` call, useless
+for serving where requests arrive and finish at different times.  This
+module generalizes the layout to a fixed ``[num_slots, max_len, H, D]``
+cache per layer with an int32 offset PER SLOT, the structure vLLM gets
+from paged KV blocks and Orca from request-level batching: sequences of
+different ages coexist in the same compiled decode step, and a finished
+slot is refilled by a new request without draining the batch.
+
+Static shapes throughout: whatever mix of ages occupies the slots, the
+decode step is the SAME XLA program (the per-slot offsets are runtime
+data, not shapes), so the executable cache from PR 1 serves every step.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class SlotKVCache:
+    """Per-layer ``{"k", "v", "offset"}`` dicts shaped for the model's
+    decode path (`IF.masked_multihead_attention` accepts the [num_slots]
+    offset vector) plus host-side slot bookkeeping.
+
+    Slot lifecycle::
+
+        free --allocate()--> reserved --write_prefill()--> active
+          ^                                                  |
+          +---------------- release() <-- (eos/length/deadline/shutdown)
+
+    A free slot still rides along in the batched decode step (static
+    shape!) — it re-writes position 0 with dummy K/V each step, which
+    the next `write_prefill` fully overwrites and the per-row causal
+    mask never exposes to live rows.
+    """
+
+    def __init__(self, num_layers, num_slots, max_len, num_kv_heads,
+                 head_dim, dtype="float32"):
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.offsets = np.zeros(self.num_slots, np.int32)
+        self._free = list(range(self.num_slots - 1, -1, -1))
+        shape = [self.num_slots, self.max_len, num_kv_heads, head_dim]
+        off = Tensor(jnp.asarray(self.offsets))
+        self.layers = [
+            {"k": Tensor(jnp.zeros(shape, dtype=dtype)),
+             "v": Tensor(jnp.zeros(shape, dtype=dtype)),
+             "offset": off}
+            for _ in range(num_layers)]
+
+    # ---------------- slot bookkeeping ----------------
+    @property
+    def free_slots(self):
+        return len(self._free)
+
+    def allocate(self):
+        """Reserve a free slot index, or None when fully occupied."""
+        return self._free.pop() if self._free else None
+
+    def release(self, slot):
+        """Return a slot to the free pool (offset pinned back to 0; the
+        stale K/V rows stay until the next prefill overwrites them)."""
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is already free")
+        self.offsets[slot] = 0
+        self._free.append(slot)
+        self._sync_offsets()
+
+    # ---------------- cache data ----------------
+    def write_prefill(self, slot, prefill_caches, prompt_len):
+        """Copy a batch-1 prefill's per-layer caches (the dicts
+        `init_kv_caches(..., batch=1, max_len=self.max_len)` produced
+        and the model filled) into `slot`'s rows, and start the slot's
+        clock at `prompt_len`."""
+        if prompt_len > self.max_len:
+            raise ValueError(
+                f"prompt of {prompt_len} tokens exceeds slot capacity "
+                f"{self.max_len}")
+        for lay, src in zip(self.layers, prefill_caches):
+            lay["k"] = Tensor(lay["k"]._data_.at[slot].set(
+                src["k"]._data_[0]))
+            lay["v"] = Tensor(lay["v"]._data_.at[slot].set(
+                src["v"]._data_[0]))
+        self.offsets[slot] = prompt_len
+        self._sync_offsets()
+
+    def advance(self, slots):
+        """Bump the offsets of `slots` by one decoded token."""
+        idx = list(slots)
+        if idx:
+            self.offsets[idx] += 1
+        self._sync_offsets()
+
+    def layer_caches(self):
+        """The per-layer cache dicts, ready to pass as
+        ``model(tokens, caches=...)`` for the batched decode step."""
+        return self.layers
+
+    def _sync_offsets(self):
+        off = Tensor(jnp.asarray(self.offsets))
+        for lay in self.layers:
+            lay["offset"] = off

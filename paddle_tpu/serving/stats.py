@@ -1,0 +1,81 @@
+"""Serving observability: queue depth, TTFT, per-token latency, slot
+occupancy, throughput.
+
+Reference capability: the reference's serving deployments watch
+predictor QPS through paddle/fluid/platform/monitor.h counters.
+TPU-native realization: the engine publishes its counters through
+`paddle_tpu.utils.monitor` under the ``serving.`` prefix (thread-safe —
+the scheduler thread writes while clients read `all_stats()`), and
+`serving_stats()` derives the dashboard quantities (averages, occupancy,
+tokens/sec) from the raw counters at read time.
+"""
+from __future__ import annotations
+
+from ..utils import monitor
+
+PREFIX = "serving."
+
+
+def incr(name, value=1):
+    return monitor.incr(PREFIX + name, value)
+
+
+def set_value(name, value):
+    monitor.set_value(PREFIX + name, value)
+
+
+def observe(name, value):
+    monitor.observe(PREFIX + name, value)
+
+
+def reset_serving_stats():
+    """Clear every ``serving.*`` counter (engine start does this so each
+    engine run's snapshot is self-contained)."""
+    for key in monitor.all_stats():
+        if key.startswith(PREFIX):
+            monitor.reset(key)
+
+
+def serving_stats():
+    """One consistent snapshot of the serving counters plus derived
+    quantities:
+
+    - ``ttft_ms_avg``       mean time-to-first-token (submit → first
+                            sampled token, prefill inclusive)
+    - ``per_token_ms_avg``  mean decode-step wall time (each active
+                            request gains one token per step)
+    - ``slot_occupancy``    active-slot steps / total slot steps — how
+                            full the continuous batch ran
+    - ``tokens_per_sec``    generated tokens / engine busy time
+                            (prefill + decode wall)
+    """
+    s = monitor.all_stats()
+
+    def g(name, default=0):
+        return s.get(PREFIX + name, default)
+
+    def avg(name):
+        count = g(name + ".count")
+        return (g(name + ".sum") / count) if count else None
+
+    busy_s = (g("prefill_ms.sum") + g("decode_ms.sum")) / 1e3
+    tokens = g("tokens_generated")
+    slot_steps = g("slot_steps")
+    active_steps = g("slot_steps_active")
+    return {
+        "queue_depth": g("queue_depth"),
+        "active_slots": g("active_slots"),
+        "requests_submitted": g("requests_submitted"),
+        "requests_completed": g("requests_completed"),
+        "requests_rejected_queue_full": g("requests_rejected_queue_full"),
+        "requests_evicted_deadline": g("requests_evicted_deadline"),
+        "requests_cancelled_shutdown": g("requests_cancelled_shutdown"),
+        "tokens_generated": tokens,
+        "prefill_steps": g("prefill_steps"),
+        "decode_steps": g("decode_steps"),
+        "ttft_ms_avg": avg("ttft_ms"),
+        "per_token_ms_avg": avg("decode_ms"),
+        "slot_occupancy": (active_steps / slot_steps) if slot_steps
+        else 0.0,
+        "tokens_per_sec": (tokens / busy_s) if busy_s > 0 else 0.0,
+    }

@@ -18,13 +18,27 @@ _STATS: dict[str, float] = {}
 
 
 def incr(name, value=1):
+    """Atomically add `value`; returns the new total (the module lock
+    makes read-modify-write safe against concurrent incr/all_stats —
+    e.g. the serving scheduler thread vs. client stat readers)."""
     with _LOCK:
-        _STATS[name] = _STATS.get(name, 0) + value
+        new = _STATS.get(name, 0) + value
+        _STATS[name] = new
+        return new
 
 
 def set_value(name, value):
     with _LOCK:
         _STATS[name] = value
+
+
+def observe(name, value):
+    """Record one observation into the `<name>.sum` / `<name>.count`
+    pair (atomic under the module lock) — averages derive as
+    sum/count at read time (e.g. serving ttft/per-token latency)."""
+    with _LOCK:
+        _STATS[name + ".sum"] = _STATS.get(name + ".sum", 0) + value
+        _STATS[name + ".count"] = _STATS.get(name + ".count", 0) + 1
 
 
 def get_monitor_value(name, default=0):

@@ -295,3 +295,71 @@ def test_beam_search_length_penalty_and_validation():
     c = beam_search(m, ids, max_new_tokens=6, num_beams=3,
                     eos_token_id=3, length_penalty=2.0)
     assert a.shape[0] == 1 and c.shape[0] == 1
+
+
+def test_top_p_one_is_noop():
+    """top_p=1.0 must not change sampling (the whole distribution is
+    kept) — same seed, same tokens as top_p=None."""
+    model = _tiny_gpt()
+    model.eval()
+    ids = paddle.to_tensor(np.random.default_rng(8).integers(
+        0, 512, (2, 6)).astype("int32"))
+    paddle.seed(11)
+    a = model.generate(ids, max_new_tokens=5, temperature=0.9, top_p=1.0)
+    paddle.seed(11)
+    b = model.generate(ids, max_new_tokens=5, temperature=0.9, top_p=None)
+    np.testing.assert_array_equal(_np(a), _np(b))
+
+
+def test_top_k_larger_than_vocab_is_noop():
+    """top_k >= vocab keeps every token (clamped, not an op error) —
+    same seed, same tokens as top_k=None."""
+    model = _tiny_gpt()
+    model.eval()
+    ids = paddle.to_tensor(np.random.default_rng(9).integers(
+        0, 512, (2, 6)).astype("int32"))
+    paddle.seed(12)
+    a = model.generate(ids, max_new_tokens=5, temperature=0.9,
+                       top_k=512 * 4)
+    paddle.seed(12)
+    b = model.generate(ids, max_new_tokens=5, temperature=0.9, top_k=None)
+    np.testing.assert_array_equal(_np(a), _np(b))
+
+
+def test_repetition_penalty_greedy_processor_semantics():
+    """HF processor order with greedy decoding: penalty divides positive
+    logits and multiplies negative ones for seen tokens only, and it can
+    flip the argmax."""
+    from paddle_tpu.models.generation import (
+        apply_logit_processors, sample_next_token)
+    logits = paddle.to_tensor(np.array([[2.0, 1.5, -1.0, -3.0]], "f4"))
+    seen = paddle.to_tensor(np.array([[True, False, True, False]]))
+    proc = apply_logit_processors(logits, temperature=0.0,
+                                  repetition_penalty=2.0, seen=seen)
+    np.testing.assert_allclose(_np(proc)[0], [1.0, 1.5, -2.0, -3.0],
+                               atol=1e-6)
+    tok = sample_next_token(logits, temperature=0.0,
+                            repetition_penalty=2.0, seen=seen)
+    assert int(_np(tok)[0]) == 1      # unpenalized argmax would be 0
+
+
+def test_finished_rows_emit_eos_suffix():
+    """Once a row trips the EOS tracker its remaining tokens are forced
+    to eos — no live samples leaking into finished rows when the batch
+    finishes unevenly (both cache paths)."""
+    model = _tiny_gpt()
+    model.eval()
+    rng = np.random.default_rng(10)
+    ids = paddle.to_tensor(rng.integers(0, 512, (2, 6)).astype("int32"))
+    # eos := row 0's first greedy token, so row 0 finishes immediately
+    # while row 1 (almost surely) keeps decoding
+    probe = model.generate(ids, max_new_tokens=1, temperature=0.0)
+    eos = int(_np(probe)[0, -1])
+    for use_cache in (True, False):
+        out = _np(model.generate(ids, max_new_tokens=8, temperature=0.0,
+                                 eos_token_id=eos, use_cache=use_cache))
+        gen = out[:, 6:]
+        for row in gen:
+            hits = np.nonzero(row == eos)[0]
+            if hits.size:
+                assert (row[hits[0]:] == eos).all(), (use_cache, row)

@@ -52,6 +52,7 @@ MODULES = [
     "paddle_tpu.optimizer",
     "paddle_tpu.optimizer.lr",
     "paddle_tpu.regularizer",
+    "paddle_tpu.serving",
     "paddle_tpu.signal",
     "paddle_tpu.sparse",
     "paddle_tpu.static",

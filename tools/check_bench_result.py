@@ -12,7 +12,9 @@ BENCH_BASELINE.json (written by bench.py).
 An `eager_op_dispatch_*` result (benchmarks/eager_overhead.py) is
 validated against its JSON schema instead of the throughput baseline —
 the microbench's comparison is self-contained (cached vs uncached in
-one process)."""
+one process).  A `serving_*` result (benchmarks/serving_bench.py) is
+likewise schema-validated, plus a floor on its self-contained
+continuous-batching speedup vs the sequential baseline."""
 from __future__ import annotations
 
 import argparse
@@ -79,6 +81,74 @@ def check_eager_overhead(run):
     return 0
 
 
+_SERVING_SCHEMA = {
+    # key -> accepted types; every key is required
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "speedup_vs_sequential": (int, float),
+    "sequential": dict,
+    "serving": dict,
+    "ttft_ms_avg": (int, float),
+    "per_token_ms_avg": (int, float),
+    "slot_occupancy": (int, float),
+    "num_requests": int,
+    "num_slots": int,
+    "max_new_tokens": int,
+    "greedy_mismatches": int,
+    "smoke": bool,
+    "platform": str,
+}
+
+# acceptance floor: continuous batching must sustain >= 2x the
+# sequential per-request generate() throughput at >= 4 concurrent
+# requests (ISSUE 3); CPU smoke runs clear ~3x, so 2.0 has margin
+# without being noise-sensitive
+_SERVING_MIN_SPEEDUP = 2.0
+
+
+def check_serving_bench(run):
+    """Schema + speedup gate for benchmarks/serving_bench.py output."""
+    errors = []
+    for key, types in _SERVING_SCHEMA.items():
+        if key not in run:
+            errors.append(f"missing key {key!r}")
+        elif run[key] is None or not isinstance(run[key], types):
+            errors.append(f"{key!r} has type {type(run[key]).__name__}, "
+                          f"expected {types}")
+    if not errors:
+        for side in ("sequential", "serving"):
+            for k in ("tokens_per_sec", "wall_s", "tokens"):
+                v = run[side].get(k)
+                if not isinstance(v, (int, float)) or v <= 0:
+                    errors.append(f"{side}.{k} must be a positive "
+                                  f"number, got {v!r}")
+        if run["value"] <= 0:
+            errors.append("value must be positive")
+        if run["greedy_mismatches"] != 0:
+            errors.append(f"{run['greedy_mismatches']} serving outputs "
+                          "diverged from the sequential greedy baseline")
+        if not 0.0 < run["slot_occupancy"] <= 1.0:
+            errors.append(f"slot_occupancy {run['slot_occupancy']!r} "
+                          "outside (0, 1]")
+        if run["num_requests"] >= 4 and \
+                run["speedup_vs_sequential"] < _SERVING_MIN_SPEEDUP:
+            errors.append(
+                f"speedup_vs_sequential {run['speedup_vs_sequential']:.2f}"
+                f" < required {_SERVING_MIN_SPEEDUP}x at "
+                f"{run['num_requests']} concurrent requests")
+    if errors:
+        print("serving_bench schema check FAILED:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(f"serving_bench schema OK: {run['value']:.1f} tokens/sec, "
+          f"{run['speedup_vs_sequential']:.2f}x vs sequential, "
+          f"occupancy {run['slot_occupancy']:.2f}, "
+          f"ttft {run['ttft_ms_avg']:.0f}ms")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("bench_json")
@@ -94,6 +164,8 @@ def main():
         run = run["parsed"]
     if str(run.get("metric", "")).startswith("eager_op_dispatch"):
         return check_eager_overhead(run)
+    if str(run.get("metric", "")).startswith("serving_"):
+        return check_serving_bench(run)
     value = float(run["value"])
     platform = "cpu" if "cpu" in run.get("metric", "") else "tpu"
 

@@ -43,6 +43,41 @@ for bad in ("bogus:after_bytes=1", "ckpt_write", "ckpt_write:after_bytes",
 print("fault-injection spec validation OK")
 EOF
 
+echo "== serving smoke (engine start -> concurrent requests -> clean shutdown) =="
+python - <<'EOF'
+import threading
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTForCausalLM, gpt_config
+from paddle_tpu.serving import Engine, ServingConfig
+
+before = {t.ident for t in threading.enumerate()}
+paddle.seed(0)
+model = GPTForCausalLM(gpt_config(
+    "gpt2-124m", num_layers=2, hidden_size=64, num_heads=2,
+    vocab_size=128, max_seq_len=64))
+rng = np.random.default_rng(0)
+eng = Engine(model, ServingConfig(num_slots=2)).start()
+futs = [eng.submit(rng.integers(0, 128, (int(rng.integers(3, 9)),))
+                   .astype("int32"), max_new_tokens=6)
+        for _ in range(6)]
+outs = [f.result(timeout=300) for f in futs]
+assert all(o.output_ids.size == 6 for o in outs), outs
+snap = eng.stats()
+assert snap["requests_completed"] == 6, snap
+assert snap["slot_occupancy"] > 0, snap
+eng.shutdown()
+leaked = {t.ident for t in threading.enumerate()} - before
+assert not leaked, f"leaked threads: {leaked}"
+print(f"serving smoke OK: 6 requests, occupancy "
+      f"{snap['slot_occupancy']:.2f}, ttft {snap['ttft_ms_avg']:.0f}ms, "
+      "no leaked threads")
+EOF
+
+echo "== serving continuous-batching bench (smoke) =="
+python benchmarks/serving_bench.py --smoke --out /tmp/serving_bench_ci.json
+python tools/check_bench_result.py /tmp/serving_bench_ci.json
+
 echo "== eager op-dispatch cache microbench (smoke) =="
 python benchmarks/eager_overhead.py --smoke --out /tmp/eager_overhead_ci.json
 python tools/check_bench_result.py /tmp/eager_overhead_ci.json
