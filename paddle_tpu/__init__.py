@@ -132,6 +132,7 @@ from .device import set_device, get_device, is_compiled_with_cuda  # noqa: F401,
 from . import utils  # noqa: F401,E402
 from .utils.flags import set_flags, get_flags  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
+from . import observability  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import static  # noqa: F401,E402

@@ -40,6 +40,7 @@ from collections import OrderedDict
 import jax
 
 from . import state as _state
+from ..observability import registry as _metrics
 from ..utils.flags import flag as _flag
 
 
@@ -63,13 +64,24 @@ class _Entry:
 
 
 _T1: "OrderedDict[tuple, _Entry]" = OrderedDict()
-_T1_STATS = {"hits": 0, "misses": 0, "evictions": 0, "bypasses": 0,
-             "bytes": 0}
+# tier counters live in the observability registry so cache behavior is
+# visible in render_prometheus()/dump_json() alongside everything else;
+# cache_stats() below keeps its historical dict shape as a view of them
+_T1_STATS = {
+    k: _metrics.counter(f"cache.tier1.{k}", f"tier-1 op-cache {k}")
+    for k in ("hits", "misses", "evictions", "bypasses")
+}
+_T1_BYTES = _metrics.gauge("cache.tier1.bytes",
+                           "summed input-aval bytes of cached signatures")
 # op names permanently opted out: impls that draw framework RNG inside
 # (caching would bake the first call's key) or fail to jit-trace
 _SKIP_OPS: set = set()
 
-_T2_STATS = {"hits": 0, "misses": 0}
+_T2_STATS = {
+    k: _metrics.counter(f"cache.tier2.{k}",
+                        f"persistent XLA compile cache {k}")
+    for k in ("hits", "misses")
+}
 _T2_APPLIED = None        # cache dir currently applied to jax.config
 _T2_LISTENING = False
 
@@ -152,15 +164,14 @@ def tier1_execute(name, fn, pure, arrays, template, static, need_grad):
             return None               # to_static bind trace / nested vjp
     key = _tier1_key(name, arrays, template, static, need_grad)
     if key is None:
-        with _LOCK:
-            _T1_STATS["bypasses"] += 1
+        _T1_STATS["bypasses"].inc()
         return None
 
     with _LOCK:
         entry = _T1.get(key)
         if entry is not None:
             _T1.move_to_end(key)
-            _T1_STATS["hits"] += 1
+            _T1_STATS["hits"].inc()
     if entry is not None:
         if entry.fn is not fn:
             return None               # op re-registered since caching
@@ -196,7 +207,7 @@ def tier1_execute(name, fn, pure, arrays, template, static, need_grad):
         # advances the counter (the uncached re-run takes the next key).
         with _LOCK:
             _SKIP_OPS.add(name)
-            _T1_STATS["bypasses"] += 1
+        _T1_STATS["bypasses"].inc()
         return None
     rng1 = _state.STATE.rng_counter + (getattr(tr, "rng_counter", 0)
                                        if tr is not None else 0)
@@ -210,14 +221,14 @@ def tier1_execute(name, fn, pure, arrays, template, static, need_grad):
 
     aval_bytes = sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays)
     with _LOCK:
-        _T1_STATS["misses"] += 1
+        _T1_STATS["misses"].inc()
         _T1[key] = _Entry(fn, jitted, need_grad, aval_bytes)
-        _T1_STATS["bytes"] += aval_bytes
+        _T1_BYTES.inc(aval_bytes)
         cap = int(_flag("FLAGS_eager_op_cache_size", 4096) or 4096)
         while len(_T1) > cap:
             _, old = _T1.popitem(last=False)
-            _T1_STATS["evictions"] += 1
-            _T1_STATS["bytes"] -= old.aval_bytes
+            _T1_STATS["evictions"].inc()
+            _T1_BYTES.dec(old.aval_bytes)
     return out, vjp_fn, False
 
 
@@ -226,10 +237,11 @@ def clear():
     with _LOCK:
         _T1.clear()
         _SKIP_OPS.clear()
-        for k in _T1_STATS:
-            _T1_STATS[k] = 0
-        for k in _T2_STATS:
-            _T2_STATS[k] = 0
+        for c in _T1_STATS.values():
+            c.reset()
+        _T1_BYTES.reset()
+        for c in _T2_STATS.values():
+            c.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -241,11 +253,9 @@ def _t2_listener(event, **kwargs):
     if not isinstance(event, str):
         return
     if event.endswith("/compilation_cache/cache_hits"):
-        with _LOCK:
-            _T2_STATS["hits"] += 1
+        _T2_STATS["hits"].inc()
     elif event.endswith("/compilation_cache/cache_misses"):
-        with _LOCK:
-            _T2_STATS["misses"] += 1
+        _T2_STATS["misses"].inc()
 
 
 def ensure_compile_cache():
@@ -302,12 +312,13 @@ def cache_stats():
     not XLA code size (which jax does not expose per jit wrapper).
     tier2 entries/bytes are measured from the cache directory."""
     with _LOCK:
-        t1 = dict(_T1_STATS)
+        t1 = {k: c.value for k, c in _T1_STATS.items()}
+        t1["bytes"] = _T1_BYTES.value
         t1["entries"] = len(_T1)
         t1["capacity"] = int(_flag("FLAGS_eager_op_cache_size", 4096)
                              or 4096)
         t1["skipped_ops"] = sorted(_SKIP_OPS)
-        t2 = dict(_T2_STATS)
+        t2 = {k: c.value for k, c in _T2_STATS.items()}
     d = str(_flag("FLAGS_compile_cache_dir") or "")
     t2["enabled"] = bool(d) and _T2_APPLIED == d
     t2["dir"] = d or None

@@ -142,6 +142,23 @@ def _multiproc_collective(local, group, jitted_fn):
 # collectives
 # ---------------------------------------------------------------------------
 
+
+_COLLECTIVE_CALLS = None
+
+
+def _count_collective(op_name):
+    """Per-op collective-call counter (``dist.collective_calls{op=...}``
+    in the observability registry) — the cheapest possible answer to
+    "is this run communication-bound, and on which primitive"."""
+    global _COLLECTIVE_CALLS
+    if _COLLECTIVE_CALLS is None:
+        from ..observability import registry as _metrics
+        _COLLECTIVE_CALLS = _metrics.counter(
+            "dist.collective_calls", "collective ops issued",
+            labelnames=("op",))
+    _COLLECTIVE_CALLS.labels(op=op_name).inc()
+
+
 _REDUCERS = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
              ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
              ReduceOp.AVG: jnp.mean}
@@ -150,6 +167,7 @@ _REDUCERS = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce of `tensor` across the group
     (reference: communication/all_reduce.py)."""
+    _count_collective("all_reduce")
     group = group or _get_default_group()
     x = _as_array(tensor)
     if group.nranks <= 1:
@@ -172,6 +190,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     """Gather `tensor` from every rank into `tensor_list`
     (reference: communication/all_gather.py)."""
+    _count_collective("all_gather")
     group = group or _get_default_group()
     x = _as_array(tensor)
     if group.nranks <= 1:
@@ -196,6 +215,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """reference: communication/broadcast.py"""
+    _count_collective("broadcast")
     group = group or _get_default_group()
     if group.nranks <= 1:
         return tensor
@@ -214,6 +234,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     """Reduce to `dst`: every rank participates, only dst's buffer is
     updated (reference semantics: process_group.h:172 — non-dst outputs
     are unspecified, the reference leaves them untouched)."""
+    _count_collective("reduce")
     group = group or _get_default_group()
     if group.nranks <= 1:
         return tensor
@@ -228,6 +249,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    _count_collective("scatter")
     group = group or _get_default_group()
     if group.nranks <= 1:
         if tensor_list:
@@ -252,6 +274,7 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
     the group axis, so XLA lowers it to a reduce-scatter collective — each
     rank only materializes its own slice (reference:
     communication/reduce_scatter.py over ProcessGroup::ReduceScatter)."""
+    _count_collective("reduce_scatter")
     group = group or _get_default_group()
     if group.nranks <= 1:
         tensor._data_ = _as_array(tensor_list[0])
@@ -275,6 +298,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     """Real all-to-all: transpose the (source, destination) axes of the
     global array with a sharded output — XLA lowers it to an all-to-all
     collective, not an all-gather (reference: communication/all_to_all.py)."""
+    _count_collective("all_to_all")
     group = group or _get_default_group()
     if group.nranks <= 1:
         out_tensor_list.extend(_wrap(_as_array(t)) for t in in_tensor_list)
@@ -314,6 +338,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     path — see functional.ppermute).  The world=1 degenerate path queues
     per (group, peer) so an unmatched send can't leak into an unrelated
     recv; `p2p_drained()` asserts the queues are empty."""
+    _count_collective("send")
     group = group or _get_default_group()
     if group.nranks <= 1:
         _P2P_BUF.setdefault((id(group), dst), []).append(
@@ -324,6 +349,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    _count_collective("recv")
     group = group or _get_default_group()
     if group.nranks <= 1:
         q = _P2P_BUF.get((id(group), _env.get_rank()))
@@ -349,6 +375,7 @@ def p2p_reset():
 
 def barrier(group=None):
     """reference: communication/batch_isend_irecv.py barrier"""
+    _count_collective("barrier")
     group = group or _get_default_group()
     if group.nranks <= 1:
         return

@@ -61,6 +61,15 @@ class PreemptionHandler:
 
     def _on_signal(self, signum, frame):
         self._event.set()
+        # leave a post-mortem trail NOW: the eviction grace window may
+        # expire before the loop reaches its next step boundary.  The
+        # recorder dedupes (once=True) and never raises.
+        try:
+            from ...observability import flight_recorder as _fr
+            _fr.record("preemption", f"signal_{signum}")
+            _fr.dump_on_preemption()
+        except Exception:
+            pass
 
     def preempted(self):
         return self._event.is_set()

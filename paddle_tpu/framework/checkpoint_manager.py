@@ -201,6 +201,8 @@ class CheckpointManager:
             self._error = e
 
     def _save_impl(self, state, step, meta):
+        import time as _time
+        t0 = _time.perf_counter()
         with self._lock:
             final = os.path.join(self.root, step_dir_name(step))
             if os.path.exists(final):
@@ -219,6 +221,11 @@ class CheckpointManager:
                 _rmtree_quiet(final)
                 raise
             _monitor.incr("ckpt.saves")
+            save_ms = (_time.perf_counter() - t0) * 1e3
+            _monitor.observe("ckpt.save_ms", save_ms)
+            from ..observability import flight_recorder as _fr
+            _fr.record("ckpt", "save", step=step,
+                       dur_ms=round(save_ms, 3))
             self._retain()
             return final
 

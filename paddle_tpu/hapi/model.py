@@ -20,6 +20,25 @@ from ..metric import Metric
 from .callbacks import config_callbacks
 
 
+def _batch_counts(x):
+    """(examples, tokens) for throughput accounting: examples = leading
+    dim; tokens = element count when the input is integer-typed (token
+    ids), else None (dense inputs have no token notion)."""
+    try:
+        data = x._data_ if hasattr(x, "_data_") else x
+        shape = tuple(getattr(data, "shape", ()) or ())
+        if not shape:
+            return 0, None
+        examples = int(shape[0])
+        kind = getattr(getattr(data, "dtype", None), "kind", None)
+        if kind is None:
+            kind = np.asarray(data).dtype.kind
+        tokens = int(np.prod(shape)) if kind in ("i", "u") else None
+        return examples, tokens
+    except Exception:
+        return 0, None
+
+
 class Model:
     """reference: hapi/model.py:1052."""
 
@@ -214,6 +233,16 @@ class Model:
             from ..distributed.fleet.elastic import PreemptionHandler
             handler = PreemptionHandler().install()
 
+        # unified telemetry (docs/OBSERVABILITY.md): step-time histogram,
+        # examples/tokens-per-sec, MFU, memory watermarks — published into
+        # the metrics registry; exporter thread only if the flag names a
+        # path.  FLOPs are measured ONCE from the first batch (one extra
+        # eager forward) so MFU works for any network without a formula.
+        from ..observability import StepMetrics, maybe_start_exporter
+        maybe_start_exporter()
+        self.step_metrics = StepMetrics(prefix="train.")
+        flops_pending = True
+
         self.stop_training = False
         cbs.call("on_train_begin")
         history = {"loss": []}
@@ -228,7 +257,13 @@ class Model:
                 for step, batch in enumerate(loader):
                     x, y = self._split_batch(batch)
                     cbs.call("on_train_batch_begin", step)
+                    if flops_pending:
+                        flops_pending = False
+                        self._measure_step_flops(x)
+                    examples, tokens = _batch_counts(x)
+                    self.step_metrics.begin_step()
                     loss = self.train_batch(x, y)
+                    self.step_metrics.end_step(examples, tokens)
                     logs = {"loss": loss[0]}
                     for m in self._metrics:
                         out = self.predict_batch(x)
@@ -260,6 +295,21 @@ class Model:
                 handler.uninstall()
         cbs.call("on_train_end", logs)
         return history
+
+    def _measure_step_flops(self, x):
+        """Analytic FLOPs of one train step via the dispatch-funnel
+        counter (ops/flops.py) — one extra eager forward, once per fit;
+        feeds the train.mfu gauge.  Never fatal: a network the counter
+        cannot run eagerly just reports no MFU."""
+        try:
+            from ..core.state import no_grad
+            from ..ops.flops import FlopsCounter
+            with no_grad(), FlopsCounter() as fc:
+                self.network(x)
+            if fc.forward_flops:
+                self.step_metrics.set_flops_per_step(fc.train_step_flops)
+        except Exception:
+            pass
 
     def _resume_from(self, resume, save_dir, ckpt_cb):
         """Restore model/optimizer/epoch from the latest valid checkpoint;

@@ -72,10 +72,17 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _fetch(self, indices):
+        import time
         from ..utils import monitor as _monitor
         _monitor.incr("io.batches_fetched")
+        t0 = time.perf_counter()
         samples = [self.dataset[i] for i in indices]
-        return self.collate_fn(samples)
+        batch = self.collate_fn(samples)
+        # reader cost distribution (histogram in the metrics registry):
+        # the number that says whether input pipeline or device bounds a
+        # training run
+        _monitor.observe("io.fetch_ms", (time.perf_counter() - t0) * 1e3)
+        return batch
 
     def _iter_iterable(self):
         batch = []

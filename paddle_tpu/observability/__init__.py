@@ -1,0 +1,34 @@
+"""paddle_tpu.observability — unified telemetry.
+
+Reference capability: the reference framework's observability subsystem
+(`paddle/fluid/platform/monitor.{h,cc}` global stats, host_tracer /
+chrometracing traces, per-op FLOPs metadata).  Here it is one coherent
+consumer layer over everything the framework already measures:
+
+- :mod:`registry` — typed metrics (Counter/Gauge/Histogram, optional
+  labels) + ``render_prometheus()`` / ``dump_json()`` exposition;
+  ``utils.monitor`` is a compatibility shim over it.
+- :mod:`exporter` — optional background thread appending periodic JSON
+  snapshots to ``FLAGS_metrics_export_path``.
+- :mod:`step_metrics` — ``StepMetrics``: per-step wall-time histograms,
+  examples/tokens-per-sec, analytic-FLOPs MFU, device-memory
+  watermarks; wired into ``hapi.Model.fit``.
+- :mod:`flight_recorder` — bounded ring of recent spans/events dumped
+  on unhandled exceptions and on SIGTERM preemption.
+
+See docs/OBSERVABILITY.md.
+"""
+from . import registry  # noqa: F401
+from .registry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
+    counter, gauge, histogram, log_buckets,
+    render_prometheus, dump_json,
+)
+from . import exporter  # noqa: F401
+from .exporter import (  # noqa: F401
+    MetricsExporter, maybe_start_exporter, stop_exporter, get_exporter,
+)
+from . import step_metrics  # noqa: F401
+from .step_metrics import StepMetrics, sample_memory_watermarks  # noqa: F401
+from . import flight_recorder  # noqa: F401
+from .flight_recorder import FlightRecorder  # noqa: F401

@@ -1,0 +1,118 @@
+"""Background metrics exporter: periodic JSON snapshots to a file.
+
+The registry itself is pull-only; dashboards that cannot scrape a
+process (CI, batch jobs, preemptible pods) instead read the snapshot
+file this exporter APPENDS to — one JSON object per line, each a full
+``dump_json()`` of the registry plus a wall-clock timestamp.
+
+Armed by ``FLAGS_metrics_export_path`` (empty = never starts — the
+zero-overhead-when-idle contract); interval from
+``FLAGS_metrics_export_interval_s``.  ``hapi.Model.fit`` and
+``serving.Engine.start`` call :func:`maybe_start_exporter` so setting
+the flag is the ONLY configuration a run needs.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ..utils.flags import flag as _flag
+from . import registry as _registry
+
+
+class MetricsExporter:
+    """Append a registry snapshot to ``path`` every ``interval_s``
+    seconds (and once at ``stop()``, so short runs still export)."""
+
+    def __init__(self, path, interval_s=10.0, registry=None):
+        if not path:
+            raise ValueError("MetricsExporter needs a file path")
+        self.path = str(path)
+        self.interval_s = float(interval_s)
+        self.registry = registry or _registry.REGISTRY
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="paddle-tpu-metrics-exporter",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self._write_snapshot()
+
+    def _write_snapshot(self):
+        rec = {"ts": time.time(), "pid": os.getpid()}
+        rec.update(self.registry.dump_json())
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass                      # telemetry must never kill the run
+
+    def snapshot_now(self):
+        """Force one snapshot line immediately (flush point)."""
+        self._write_snapshot()
+
+    def stop(self, final_snapshot=True):
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self._thread = None
+        if final_snapshot:
+            self._write_snapshot()
+
+    @property
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+
+_EXPORTER: MetricsExporter | None = None
+_LOCK = threading.Lock()
+
+
+def maybe_start_exporter():
+    """Start the process-wide exporter iff ``FLAGS_metrics_export_path``
+    is set.  Idempotent; returns the exporter or None.  Callers on the
+    idle path pay one flag read."""
+    path = str(_flag("FLAGS_metrics_export_path") or "")
+    if not path:
+        return None
+    global _EXPORTER
+    with _LOCK:
+        if _EXPORTER is not None and _EXPORTER.running \
+                and _EXPORTER.path == path:
+            return _EXPORTER
+        if _EXPORTER is not None:
+            _EXPORTER.stop(final_snapshot=False)
+        _EXPORTER = MetricsExporter(
+            path,
+            interval_s=float(
+                _flag("FLAGS_metrics_export_interval_s", 10.0) or 10.0))
+        return _EXPORTER.start()
+
+
+def stop_exporter(final_snapshot=True):
+    """Stop the process-wide exporter (tests / clean shutdown); writes a
+    last snapshot by default so the file always has the final state."""
+    global _EXPORTER
+    with _LOCK:
+        if _EXPORTER is not None:
+            _EXPORTER.stop(final_snapshot=final_snapshot)
+            _EXPORTER = None
+
+
+def get_exporter():
+    return _EXPORTER

@@ -132,11 +132,18 @@ def record_op_span(name, t0_ns, t1_ns, outs, shapes, static,
 
 class RecordEvent:
     """User-scope span (reference: profiler/utils.py RecordEvent over C++
-    event_tracing.h).  Usable as context manager or begin()/end()."""
+    event_tracing.h).  Usable as context manager or begin()/end().
 
-    def __init__(self, name, event_type="UserDefined"):
+    ``args`` lands in the chrome-trace event's ``args`` field (e.g. the
+    serving engine threads its ``request_id`` here so a trace span can
+    be joined against the request's metrics).  Finished spans also feed
+    the observability flight recorder — a bounded ring that survives
+    crashes — whether or not a profiler is attached."""
+
+    def __init__(self, name, event_type="UserDefined", args=None):
         self.name = name
         self.event_type = event_type
+        self.args = args
         self._t0 = None
 
     def begin(self):
@@ -151,7 +158,11 @@ class RecordEvent:
             _HOST_BUFFER.add(self.name, self._t0 / 1e3,
                              (t1 - self._t0) / 1e3,
                              threading.get_ident() % 2 ** 31,
-                             self.event_type)
+                             self.event_type, args=self.args)
+        from ..observability import flight_recorder as _fr
+        _fr.record("span", self.name,
+                   dur_ms=round((t1 - self._t0) / 1e6, 3),
+                   **(self.args or {}))
         self._t0 = None
 
     __enter__ = begin
@@ -280,11 +291,36 @@ class Profiler:
         self.stop()
 
 
+def _metadata_rows(events):
+    """process_name/thread_name metadata events ("ph": "M") for every
+    pid/tid a span references, so Perfetto/chrome://tracing shows
+    labeled rows instead of bare numbers (the same labeling
+    merge_chrome_traces applies to its per-host bands)."""
+    pids, tids = set(), set()
+    for e in events:
+        if e.get("ph") == "M":
+            continue
+        pids.add(e.get("pid", 0))
+        tids.add((e.get("pid", 0), e.get("tid", 0)))
+    rows = []
+    main_tid = threading.main_thread().ident
+    main_tid = main_tid % 2 ** 31 if main_tid is not None else None
+    for pid in sorted(pids):
+        rows.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": f"paddle_tpu host (pid {pid})"}})
+    for pid, tid in sorted(tids):
+        label = "main thread" if tid in (0, main_tid) else f"thread {tid}"
+        rows.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"name": label}})
+    return rows
+
+
 def export_chrome_tracing_data(prof: Profiler, path):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    trace = {"traceEvents": prof.events,
+    events = prof.events
+    trace = {"traceEvents": _metadata_rows(events) + events,
              "displayTimeUnit": "ms",
              "metadata": {"xplane_dir": prof._device_dir}}
     with open(path, "w") as f:

@@ -85,6 +85,8 @@ class Engine:
 
     # ---------------- lifecycle ----------------
     def start(self):
+        from ..observability.exporter import maybe_start_exporter
+        maybe_start_exporter()          # no-op unless the flag names a path
         with self._lock:
             if self._running:
                 return self
@@ -226,7 +228,8 @@ class Engine:
         from ..models.generation import init_kv_caches
         from ..profiler import RecordEvent
         t0 = time.monotonic()
-        with RecordEvent("serving::prefill"):
+        with RecordEvent("serving::prefill",
+                         args={"request_id": req.id}):
             caches = init_kv_caches(
                 self.cfg.num_layers, 1, self.max_len, self._kv_heads,
                 self.cfg.head_dim, dtype=self.scfg.cache_dtype)
@@ -255,7 +258,8 @@ class Engine:
         from ..tensor_ops import search as S
         t0 = time.monotonic()
         n_active = len(self._active)
-        with RecordEvent("serving::decode"):
+        rids = sorted(r.id for r in self._active.values())
+        with RecordEvent("serving::decode", args={"request_ids": rids}):
             tok_in = np.zeros((self.cache.num_slots, 1), np.int32)
             for slot, req in self._active.items():
                 tok_in[slot, 0] = req.last_token
@@ -330,10 +334,22 @@ class Engine:
         if not req.future.done():
             req.future.set_result(out)
         stats.incr("requests_completed")
+        # labeled by the same request_id the span args carry, so one
+        # request's trace and metrics can be joined post-hoc
+        stats.request_observe("request_tokens", req.id, len(req.tokens),
+                              help="tokens generated per request")
+        from ..observability import flight_recorder as _fr
+        _fr.record("serving", "request_done", request_id=req.id,
+                   reason=reason, tokens=len(req.tokens),
+                   ttft_ms=round(req.ttft_ms, 3)
+                   if req.ttft_ms is not None else None)
 
     def _fail(self, req, exc):
         if not req.future.done():
             req.future.set_exception(exc)
+            from ..observability import flight_recorder as _fr
+            _fr.record("serving", "request_failed", request_id=req.id,
+                       error=type(exc).__name__)
 
     def _release(self, req):
         if req.slot is not None and req.slot in self._active:

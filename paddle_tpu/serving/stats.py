@@ -20,6 +20,19 @@ def incr(name, value=1):
     return monitor.incr(PREFIX + name, value)
 
 
+def request_observe(name, request_id, value, help=""):  # noqa: A002
+    """Per-request labeled series ``serving.<name>{request_id=...}`` —
+    the same monotonically increasing id the engine puts in its
+    ``serving::prefill``/``serving::decode`` span args, so one request's
+    trace spans and metrics join on it.  Cardinality is bounded by the
+    engine run (``reset_serving_stats()`` clears the families at engine
+    start)."""
+    from ..observability import registry as _registry
+    _registry.counter(PREFIX + name, help,
+                      labelnames=("request_id",)) \
+        .labels(request_id=str(request_id)).inc(value)
+
+
 def set_value(name, value):
     monitor.set_value(PREFIX + name, value)
 

@@ -42,6 +42,21 @@ _FLAGS: dict[str, Any] = {
     # grammar in docs/FAULT_TOLERANCE.md).  Empty = disabled: the save and
     # step paths then pay a single falsy check, nothing more.
     "FLAGS_fault_inject": "",
+    # unified telemetry (paddle_tpu.observability, docs/OBSERVABILITY.md).
+    # A non-empty export path arms the background MetricsExporter thread:
+    # periodic JSON snapshots of the metrics registry are APPENDED there
+    # (one object per line) for dashboards.  Empty = no thread, no I/O.
+    "FLAGS_metrics_export_path": "",
+    "FLAGS_metrics_export_interval_s": 10.0,
+    # peak device FLOP/s for MFU accounting (StepMetrics).  0 = derive
+    # from the device generation (profiler/timer.py device_peak_flops).
+    "FLAGS_peak_flops": 0.0,
+    # flight recorder ring-buffer capacity (events kept for the crash /
+    # preemption dump).  0 disables recording AND the dump hooks.
+    "FLAGS_flight_recorder_size": 512,
+    # where the flight recorder dumps on crash/SIGTERM; empty = a
+    # flight_recorder.<pid>.json file in the current directory.
+    "FLAGS_flight_recorder_path": "",
 }
 
 

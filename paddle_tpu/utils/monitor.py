@@ -1,59 +1,128 @@
-"""Monitor counters: named int/float stats registry.
+"""Monitor counters: the legacy flat-dict stats API, now a thin
+compatibility shim over the typed metrics registry.
 
 Reference capability: `paddle/fluid/platform/monitor.{h,cc}` —
 `STAT_INT`/`DEFINE_INT_STATUS` global counters readable from python via
 core monitor getters; used for allocator/executor observability.
 
-TPU-native realization: a process-local thread-safe registry.  The
-framework increments counters at its seams (jit cache hits/misses,
-dataloader batches, collective calls); `get_monitor_value`/`all_stats`
-expose them to user dashboards and tests.
+TPU-native realization: every name used through this module is a typed
+metric in ``paddle_tpu.observability.REGISTRY`` — ``incr`` names are
+Counters, ``set_value`` names are Gauges, ``observe`` names are
+Histograms — so the counters the framework bumps at its seams (jit
+cache hits/misses, dataloader batches, checkpoint saves, serving
+traffic) are ALSO exported by ``render_prometheus()``/``dump_json()``
+with no caller changes.  ``all_stats()`` keeps the historical flat
+shape: counters/gauges as ``name: value``, histograms as the derived
+``<name>.sum`` / ``<name>.count`` pair, labeled series as
+``name{k=v,...}`` keys.
+
+``reset(name)`` clears the metric AND its derived keys — the old dict
+implementation popped only the exact key, leaving ``observe()``'s
+``.sum``/``.count`` pair orphaned.
 """
 from __future__ import annotations
 
-import threading
+from ..observability import registry as _registry
 
-_LOCK = threading.Lock()
-_STATS: dict[str, float] = {}
+_SUFFIXES = (".sum", ".count")
+
+
+def _reg():
+    return _registry.REGISTRY
 
 
 def incr(name, value=1):
-    """Atomically add `value`; returns the new total (the module lock
-    makes read-modify-write safe against concurrent incr/all_stats —
-    e.g. the serving scheduler thread vs. client stat readers)."""
-    with _LOCK:
-        new = _STATS.get(name, 0) + value
-        _STATS[name] = new
-        return new
+    """Atomically add `value`; returns the new total (registry metric
+    locks make read-modify-write safe against concurrent incr/all_stats
+    — e.g. the serving scheduler thread vs. client stat readers)."""
+    m = _reg().get(name)
+    if m is None:
+        m = _reg().counter(name, "legacy monitor counter")
+    if isinstance(m, _registry.Counter) and value < 0:
+        # the registry Counter is monotonic; the legacy API was not
+        with m._lock:
+            m.set(m.value + value)
+            return m.value
+    return m.inc(value)
 
 
 def set_value(name, value):
-    with _LOCK:
-        _STATS[name] = value
+    m = _reg().get(name)
+    if m is None:
+        m = _reg().gauge(name, "legacy monitor gauge")
+    m.set(value)
 
 
 def observe(name, value):
-    """Record one observation into the `<name>.sum` / `<name>.count`
-    pair (atomic under the module lock) — averages derive as
-    sum/count at read time (e.g. serving ttft/per-token latency)."""
-    with _LOCK:
-        _STATS[name + ".sum"] = _STATS.get(name + ".sum", 0) + value
-        _STATS[name + ".count"] = _STATS.get(name + ".count", 0) + 1
+    """Record one observation into the histogram registered under
+    ``name`` — surfaced in ``all_stats()`` as the historical
+    ``<name>.sum`` / ``<name>.count`` pair (averages derive as
+    sum/count at read time, e.g. serving ttft/per-token latency), and
+    as a full bucket histogram in the Prometheus/JSON exposition."""
+    m = _reg().get(name)
+    if not isinstance(m, _registry.Histogram):
+        m = _reg().histogram(name, "legacy monitor observation") \
+            if m is None else m
+    if isinstance(m, _registry.Histogram):
+        m.observe(value)
+    else:                             # name already taken by a scalar
+        m.inc(value)
 
 
 def get_monitor_value(name, default=0):
-    with _LOCK:
-        return _STATS.get(name, default)
+    m = _reg().get(name)
+    if m is not None and not isinstance(m, _registry.Histogram):
+        return m.value
+    for suffix in _SUFFIXES:
+        if name.endswith(suffix):
+            parent = _reg().get(name[:-len(suffix)])
+            if isinstance(parent, _registry.Histogram):
+                return parent.sum if suffix == ".sum" else parent.count
+    return default
 
 
 def all_stats():
-    with _LOCK:
-        return dict(_STATS)
+    """Flat snapshot of the whole registry (legacy shape)."""
+    out = {}
+    for m in _reg().metrics():
+        for labelvalues, leaf in m._samples():
+            key = m.name
+            if labelvalues:
+                key += "{" + ",".join(
+                    f"{k}={v}"
+                    for k, v in zip(m.labelnames, labelvalues)) + "}"
+            if isinstance(leaf, _registry.Histogram):
+                out[key + ".sum"] = leaf.sum
+                out[key + ".count"] = leaf.count
+            else:
+                out[key] = leaf.value
+    return out
+
+
+def _resolve(name):
+    """Map a legacy flat key back to its registry metric: strips the
+    ``{labels}`` suffix and the histogram-derived ``.sum``/``.count``."""
+    base = name.split("{", 1)[0] if "{" in name else name
+    m = _reg().get(base)
+    if m is not None:
+        return m
+    for suffix in _SUFFIXES:
+        if base.endswith(suffix):
+            parent = _reg().get(base[:-len(suffix)])
+            if parent is not None:
+                return parent
+    return None
 
 
 def reset(name=None):
-    with _LOCK:
-        if name is None:
-            _STATS.clear()
-        else:
-            _STATS.pop(name, None)
+    """Zero a metric (or all of them).  Clearing ``name`` also clears
+    its derived ``.sum``/``.count`` keys and any labeled children —
+    the pre-registry implementation popped only the exact key and left
+    ``observe()``'s pair orphaned."""
+    if name is None:
+        for m in _reg().metrics():
+            m.reset()
+        return
+    m = _resolve(name)
+    if m is not None:
+        m.reset()

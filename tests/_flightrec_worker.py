@@ -1,0 +1,54 @@
+"""Subprocess drill for the flight recorder (tests/test_observability.py).
+
+Modes:
+- ``crash``:   record a few training-loop events, then raise an
+  unhandled exception → the excepthook chain must leave a dump at
+  ``FLAGS_flight_recorder_path``.
+- ``sigterm``: install the PreemptionHandler, loop recording step
+  events until the parent delivers SIGTERM → the signal path must
+  leave a dump, then the worker exits cleanly.
+"""
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.observability import StepMetrics, flight_recorder  # noqa: E402
+from paddle_tpu.utils import monitor  # noqa: E402
+
+
+def main():
+    mode = sys.argv[1]
+    sm = StepMetrics(prefix="drill.", memory_every=1000)
+    monitor.incr("drill.runs")
+
+    if mode == "crash":
+        for _ in range(3):
+            with sm.step(examples=4):
+                pass
+        flight_recorder.record("drill", "about_to_fail")
+        raise RuntimeError("synthetic training failure for the drill")
+
+    if mode == "sigterm":
+        from paddle_tpu.distributed.fleet.elastic import PreemptionHandler
+        handler = PreemptionHandler().install()
+        for _ in range(3):              # history exists before the signal
+            with sm.step(examples=4):
+                pass
+        print("ready", flush=True)
+        deadline = time.monotonic() + 60
+        while not handler.preempted():
+            with sm.step(examples=4):
+                time.sleep(0.01)
+            if time.monotonic() > deadline:     # pragma: no cover
+                raise SystemExit("never received SIGTERM")
+        handler.uninstall()
+        return 0
+
+    raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -195,3 +195,59 @@ def test_op_spans_carry_cache_hit_annotation():
     assert spans2 and all("cache_hit" not in e.get("args", {})
                           for e in spans2)
     op_cache.clear()
+
+
+def test_make_scheduler_skip_first_and_repeat_edges():
+    """ISSUE 4 satellite: skip_first delays the whole cycle; repeat=0
+    cycles forever; a single-step window is RECORD_AND_RETURN."""
+    sched = make_scheduler(closed=1, ready=0, record=1, repeat=1,
+                           skip_first=3)
+    assert [sched(i) for i in range(3)] == [ProfilerState.CLOSED] * 3
+    assert sched(3) == ProfilerState.CLOSED          # cycle: closed
+    assert sched(4) == ProfilerState.RECORD_AND_RETURN
+    assert sched(5) == ProfilerState.CLOSED          # repeat exhausted
+    assert sched(50) == ProfilerState.CLOSED
+
+    # repeat=0 → cycles forever
+    sched = make_scheduler(closed=0, ready=1, record=1, repeat=0)
+    for base in (0, 2, 200):
+        assert sched(base) == ProfilerState.READY
+        assert sched(base + 1) == ProfilerState.RECORD_AND_RETURN
+
+    # single-step window: every step both records and returns
+    sched = make_scheduler(closed=0, ready=0, record=1, repeat=0)
+    assert sched(0) == ProfilerState.RECORD_AND_RETURN
+    assert sched(7) == ProfilerState.RECORD_AND_RETURN
+
+
+def test_chrome_export_has_process_and_thread_metadata(tmp_path):
+    """ISSUE 4 satellite: Perfetto shows bare pids/tids without
+    process_name/thread_name metadata rows — the export must emit them
+    for every pid/tid its spans reference."""
+    prof = Profiler(targets=[ProfilerTarget.CPU], timer_only=True)
+    with prof:
+        with RecordEvent("meta::span"):
+            pass
+    out = str(tmp_path / "meta_trace.json")
+    prof.export(out)
+    events = json.load(open(out))["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    metas = [e for e in events if e.get("ph") == "M"]
+    assert spans, "no spans exported"
+    proc_names = {m["pid"] for m in metas if m["name"] == "process_name"}
+    thread_names = {(m["pid"], m["tid"]) for m in metas
+                    if m["name"] == "thread_name"}
+    for e in spans:
+        assert e["pid"] in proc_names, e
+        assert (e["pid"], e["tid"]) in thread_names, e
+    pid_row = [m for m in metas if m["name"] == "process_name"][0]
+    assert str(os.getpid()) in pid_row["args"]["name"]
+
+
+def test_record_event_args_land_in_span():
+    prof = Profiler(targets=[ProfilerTarget.CPU], timer_only=True)
+    with prof:
+        with RecordEvent("tagged", args={"request_id": 11}):
+            pass
+    span = [e for e in prof.events if e["name"] == "tagged"][0]
+    assert span["args"]["request_id"] == 11

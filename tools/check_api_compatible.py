@@ -49,6 +49,7 @@ MODULES = [
     "paddle_tpu.nn.functional",
     "paddle_tpu.nn.initializer",
     "paddle_tpu.nn.utils",
+    "paddle_tpu.observability",
     "paddle_tpu.optimizer",
     "paddle_tpu.optimizer.lr",
     "paddle_tpu.regularizer",

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Telemetry exposition gate (ISSUE 4 CI satellite).
+
+Reference capability: tools/check_op_benchmark_result.py-style recorded
+validation, applied to the observability surfaces: a Prometheus text
+dump must round-trip a STRICT format-0.0.4 parser, and a
+MetricsExporter snapshot file must contain schema-valid JSON lines.
+CI fails on any unparseable exposition — a dashboard silently dropping
+a malformed series is the failure mode this gate exists to catch.
+
+Usage:
+    python tools/check_telemetry.py --prometheus PROM.txt \
+        --snapshots SNAP.jsonl [--require-series name ...]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>%s)(\{(?P<labels>[^}]*)\})? "
+    r"(?P<value>[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?)|NaN|[-+]?Inf)$"
+    % _NAME)
+_LABEL_RE = re.compile(r'(%s)="((?:[^"\\]|\\["\\n])*)"(,|$)' % _NAME)
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def parse_prometheus(text):
+    """Strict parse; returns ({series name: [(labels, value)]}, errors)."""
+    series: dict = {}
+    typed: dict = {}
+    errors = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if re.match(r"^# HELP %s .*$" % _NAME, line):
+                continue
+            m = re.match(r"^# TYPE (%s) (\w+)$" % _NAME, line)
+            if m and m.group(2) in _TYPES:
+                typed[m.group(1)] = m.group(2)
+                continue
+            errors.append(f"line {lineno}: malformed comment: {line!r}")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        labels = {}
+        body = m.group("labels") or ""
+        consumed = 0
+        for lm in _LABEL_RE.finditer(body):
+            labels[lm.group(1)] = lm.group(2)
+            consumed = lm.end()
+        if consumed != len(body):
+            errors.append(f"line {lineno}: bad label block: {body!r}")
+            continue
+        series.setdefault(m.group("name"), []).append(
+            (labels, m.group("value")))
+    # histogram integrity: cumulative buckets, +Inf == _count
+    for name, kind in typed.items():
+        if kind != "histogram":
+            continue
+        buckets = series.get(name + "_bucket", [])
+        counts = series.get(name + "_count", [])
+        if not buckets or not counts:
+            errors.append(f"histogram {name}: missing _bucket/_count")
+            continue
+        by_series: dict = {}
+        for labels, value in buckets:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            by_series.setdefault(key, []).append(
+                (labels.get("le"), float(value)))
+        for key, rows in by_series.items():
+            vals = [v for _, v in rows]
+            if vals != sorted(vals):
+                errors.append(f"histogram {name}{dict(key)}: bucket "
+                              "counts not cumulative")
+            inf = [v for le, v in rows if le == "+Inf"]
+            if not inf:
+                errors.append(f"histogram {name}{dict(key)}: no +Inf "
+                              "bucket")
+    return series, typed, errors
+
+
+_SNAPSHOT_KEYS = {"ts": (int, float), "pid": int, "counters": dict,
+                  "gauges": dict, "histograms": dict}
+_HIST_KEYS = ("count", "sum", "min", "max", "avg", "p50", "p90", "p99")
+
+
+def check_snapshots(path):
+    errors = []
+    n = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            n += 1
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"{path}:{lineno}: invalid JSON: {e}")
+                continue
+            for key, types in _SNAPSHOT_KEYS.items():
+                if key not in rec:
+                    errors.append(f"{path}:{lineno}: missing {key!r}")
+                elif not isinstance(rec[key], types):
+                    errors.append(
+                        f"{path}:{lineno}: {key!r} has type "
+                        f"{type(rec[key]).__name__}")
+            for scope in ("counters", "gauges"):
+                for k, v in (rec.get(scope) or {}).items():
+                    if not isinstance(v, (int, float)):
+                        errors.append(f"{path}:{lineno}: {scope}.{k} "
+                                      f"not numeric: {v!r}")
+            for k, v in (rec.get("histograms") or {}).items():
+                missing = [h for h in _HIST_KEYS
+                           if not isinstance(v, dict) or h not in v]
+                if missing:
+                    errors.append(f"{path}:{lineno}: histograms.{k} "
+                                  f"missing {missing}")
+    if n == 0:
+        errors.append(f"{path}: no snapshot lines")
+    return n, errors
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prometheus", help="Prometheus text dump to check")
+    ap.add_argument("--snapshots",
+                    help="MetricsExporter jsonl file to check")
+    ap.add_argument("--require-series", nargs="*", default=[],
+                    help="sanitized series names that must be present")
+    args = ap.parse_args()
+    if not args.prometheus and not args.snapshots:
+        ap.error("nothing to check: pass --prometheus and/or --snapshots")
+
+    failures = []
+    if args.prometheus:
+        text = open(args.prometheus).read()
+        series, typed, errors = parse_prometheus(text)
+        failures += errors
+        for want in args.require_series:
+            hit = want in series or (want + "_count") in series
+            if not hit:
+                failures.append(f"required series {want!r} absent "
+                                f"(have {len(series)} series)")
+        if not errors:
+            print(f"prometheus OK: {len(series)} series, "
+                  f"{len(typed)} typed families")
+    if args.snapshots:
+        n, errors = check_snapshots(args.snapshots)
+        failures += errors
+        if not errors:
+            print(f"snapshots OK: {n} line(s)")
+
+    if failures:
+        print("telemetry check FAILED:")
+        for e in failures:
+            print(f"  - {e}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,59 @@ echo "== eager op-dispatch cache microbench (smoke) =="
 python benchmarks/eager_overhead.py --smoke --out /tmp/eager_overhead_ci.json
 python tools/check_bench_result.py /tmp/eager_overhead_ci.json
 
+echo "== telemetry smoke (hapi fit + exporter -> prometheus/json gates) =="
+FLAGS_metrics_export_path=/tmp/pt_metrics_ci.jsonl \
+FLAGS_metrics_export_interval_s=0.2 \
+python - <<'EOF'
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observability as obs
+
+class Data:
+    def __len__(self):
+        return 32
+    def __getitem__(self, i):
+        rng = np.random.default_rng(i)
+        return (rng.normal(size=(8,)).astype(np.float32),
+                np.array([i % 2], dtype=np.int64))
+
+net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+model = paddle.Model(net)
+model.prepare(optimizer=paddle.optimizer.SGD(
+    learning_rate=0.1, parameters=net.parameters()),
+    loss=nn.CrossEntropyLoss())
+model.fit(Data(), batch_size=8, epochs=2, verbose=0)
+snap = model.step_metrics.snapshot()
+assert snap["steps"] == 8, snap
+assert snap["step_time_ms"]["p50"] and snap["step_time_ms"]["p99"], snap
+assert snap["examples_per_sec"] > 0, snap
+assert snap["mfu"] and snap["mfu"] > 0, snap
+obs.stop_exporter()                      # flush the final snapshot line
+with open("/tmp/pt_metrics_ci.prom", "w") as f:
+    f.write(obs.render_prometheus())
+print(f"telemetry smoke OK: p50 {snap['step_time_ms']['p50']:.2f}ms, "
+      f"p99 {snap['step_time_ms']['p99']:.2f}ms, "
+      f"{snap['examples_per_sec']:.0f} examples/s, mfu {snap['mfu']:.2e}")
+EOF
+python tools/check_telemetry.py --prometheus /tmp/pt_metrics_ci.prom \
+    --snapshots /tmp/pt_metrics_ci.jsonl \
+    --require-series train_step_time_ms train_examples_per_sec train_mfu
+
+echo "== flight-recorder drill (unhandled exception -> readable dump) =="
+rm -f /tmp/pt_flightrec_ci.json
+FLAGS_flight_recorder_path=/tmp/pt_flightrec_ci.json \
+    python tests/_flightrec_worker.py crash 2>/dev/null || true
+python - <<'EOF'
+import json
+data = json.load(open("/tmp/pt_flightrec_ci.json"))
+assert data["reason"] == "exception", data["reason"]
+assert data["error"]["type"] == "RuntimeError"
+assert any(e["kind"] == "step" for e in data["events"])
+print(f"flight recorder OK: {len(data['events'])} events, "
+      f"reason={data['reason']}")
+EOF
+
 echo "== TPU run-log audit =="
 python tools/validate_tpu_runs.py
 
