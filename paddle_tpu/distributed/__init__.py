@@ -24,6 +24,10 @@ from .collective import (  # noqa: F401
     barrier, P2POp, batch_isend_irecv,
 )
 from . import functional  # noqa: F401
+from . import watchdog  # noqa: F401
+from .watchdog import (  # noqa: F401
+    GuardianError, CollectiveTimeoutError, PeerFailureError, DesyncError,
+)
 from .topology import (  # noqa: F401
     HybridCommunicateGroup, set_hybrid_communicate_group,
     get_hybrid_communicate_group,

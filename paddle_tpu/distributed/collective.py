@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from . import env as _env
+from . import watchdog as _wd
 
 
 class ReduceOp:
@@ -118,24 +119,112 @@ def _group_devices(group: Group):
     return [per_proc[r] for r in group.ranks]
 
 
-def _multiproc_collective(local, group, jitted_fn):
+#: None = untested, True = the XLA backend runs cross-process programs,
+#: False = it raised "Multiprocess computations aren't implemented" and
+#: every collective since rides the host lane (gloo analog).
+_XLA_MULTIPROC_OK = None
+_HOST_FALLBACK_WARNED = False
+
+
+def _np_reduce(op, stacked):
+    """Reduce a host-gathered ``[nranks, ...]`` stack with XLA-matching
+    dtype semantics (sum/max/min/prod preserve dtype; mean of integers
+    promotes to float32 like jnp.mean under x32)."""
+    reducers = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max,
+                ReduceOp.MIN: np.min, ReduceOp.PROD: np.prod,
+                ReduceOp.AVG: np.mean}
+    res = np.asarray(reducers[op](stacked, axis=0))
+    if op == ReduceOp.AVG and stacked.dtype.kind not in "fc":
+        return res.astype(np.float32)
+    return res.astype(stacked.dtype)
+
+
+def _host_collective(local, group, op, host_fn):
+    from . import host_collectives as _hc
+    host = _hc.bootstrap()
+    if host is None:
+        raise RuntimeError(
+            f"collective {op!r}: host backend has no store — launch "
+            "through paddle_tpu.distributed.launch (guardian store) or "
+            "initialize jax.distributed (coordination-service KV)")
+    return host_fn(host.gather(group, np.asarray(local)))
+
+
+def _multiproc_collective(local, group, jitted_fn, op="collective",
+                          host_fn=None):
     """Assemble per-process local arrays into a global stacked array over the
-    group's devices, run the collective program, return this rank's slice."""
+    group's devices, run the collective program, return this rank's slice.
+
+    This is the single choke point every real (nranks>1) collective goes
+    through, so it hosts two cross-cutting layers:
+
+    - **hang guardian** (docs/RESILIENCE.md): the call registers
+      (op, group, seq, start-time) with the collective watchdog, which
+      converts a stall into a stall dump + `CollectiveTimeoutError` (or
+      a dead peer's original error) instead of an unbounded block.  With
+      the guardian off (`FLAGS_collective_timeout_s=0`, no trap store,
+      no collective fault points) `begin()` returns None after a few
+      dict lookups.
+    - **backend selection** (`FLAGS_collective_backend`): the XLA lane
+      compiles the collective into a cross-process program; backends
+      that cannot (jaxlib CPU raises "Multiprocess computations aren't
+      implemented") fall back to the host lane — a store-mediated
+      gather + local combine (`host_collectives.py`, the reference's
+      ProcessGroupGloo analog) with identical semantics.
+    """
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
     if group.rank < 0:
         raise ValueError(
             f"process rank {_env.get_rank()} is not a member of {group}; "
             "collectives must only be called by group members (reference: "
             "ProcessGroup membership contract, process_group.h:53)")
-    devs = _group_devices(group)
-    mesh = Mesh(np.array(devs, dtype=object), axis_names=("g",))
-    stacked_shape = (group.nranks,) + tuple(local.shape)
-    sharding = NamedSharding(mesh, PartitionSpec("g"))
-    garr = jax.make_array_from_single_device_arrays(
-        stacked_shape, sharding,
-        [jax.device_put(local[None], devs[group.rank])])
-    out = jitted_fn(garr, mesh)
-    return out
+    token = _wd.begin(op, group)
+    try:
+        _wd.preflight(token)    # fault injection + peer check + desync
+        global _XLA_MULTIPROC_OK, _HOST_FALLBACK_WARNED
+        from ..utils.flags import flag as _flag
+        backend = str(_flag("FLAGS_collective_backend", "auto"))
+        if host_fn is not None and (
+                backend == "host" or
+                (backend == "auto" and _XLA_MULTIPROC_OK is False)):
+            return _host_collective(local, group, op, host_fn)
+        try:
+            devs = _group_devices(group)
+            mesh = Mesh(np.array(devs, dtype=object), axis_names=("g",))
+            stacked_shape = (group.nranks,) + tuple(local.shape)
+            sharding = NamedSharding(mesh, PartitionSpec("g"))
+            garr = jax.make_array_from_single_device_arrays(
+                stacked_shape, sharding,
+                [jax.device_put(local[None], devs[group.rank])])
+            out = jitted_fn(garr, mesh)
+            _XLA_MULTIPROC_OK = True
+            return out
+        except Exception as e:
+            if backend == "auto" and host_fn is not None and \
+                    "Multiprocess computations aren't implemented" \
+                    in str(e):
+                # this backend will never run a cross-process program;
+                # remember and ride the host lane from now on
+                _XLA_MULTIPROC_OK = False
+                if not _HOST_FALLBACK_WARNED:
+                    _HOST_FALLBACK_WARNED = True
+                    import sys as _sys
+                    _sys.stderr.write(
+                        "[collective] XLA backend cannot run cross-"
+                        "process programs here; falling back to host-"
+                        "mediated collectives (FLAGS_collective_backend"
+                        "=host to silence)\n")
+                return _host_collective(local, group, op, host_fn)
+            raise
+    except BaseException as exc:
+        # an async-raised GuardianError arrives as a bare class; swap in
+        # the rich instance the watchdog prepared (op/seq/blame attrs)
+        rich = _wd.translate(token, exc)
+        if rich is not exc:
+            raise rich from None
+        raise
+    finally:
+        _wd.end(token)
 
 
 # ---------------------------------------------------------------------------
@@ -180,7 +269,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
                           mesh, jax.sharding.PartitionSpec()))(garr)
         return np.asarray(out.addressable_shards[0].data)
 
-    res = _multiproc_collective(x, group, prog)
+    res = _multiproc_collective(x, group, prog, op="all_reduce",
+                                host_fn=lambda st: _np_reduce(op, st))
     if isinstance(tensor, Tensor):
         tensor._data_ = jnp.asarray(res)
         return tensor
@@ -205,7 +295,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
                           mesh, jax.sharding.PartitionSpec()))(garr)
         return np.asarray(out.addressable_shards[0].data)
 
-    res = _multiproc_collective(x, group, prog)
+    res = _multiproc_collective(x, group, prog, op="all_gather",
+                                host_fn=lambda st: st)
     parts = [_wrap(jnp.asarray(res[i])) for i in range(group.nranks)]
     if tensor_list is not None:
         tensor_list.extend(parts)
@@ -289,7 +380,9 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                           mesh, jax.sharding.PartitionSpec("g")))(garr)
         return np.asarray(out.addressable_shards[0].data)[0]
 
-    res = _multiproc_collective(stacked, group, prog)
+    res = _multiproc_collective(
+        stacked, group, prog, op="reduce_scatter",
+        host_fn=lambda st: _np_reduce(op, st)[group.rank])
     tensor._data_ = jnp.asarray(res)
     return tensor
 
@@ -312,7 +405,9 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
                           mesh, jax.sharding.PartitionSpec("g")))(garr)
         return np.asarray(out.addressable_shards[0].data)[0]
 
-    res = _multiproc_collective(stacked, group, prog)
+    res = _multiproc_collective(
+        stacked, group, prog, op="all_to_all",
+        host_fn=lambda st: np.swapaxes(st, 0, 1)[group.rank])
     for r in range(group.nranks):
         out_tensor_list.append(_wrap(jnp.asarray(res[r])))
     return out_tensor_list

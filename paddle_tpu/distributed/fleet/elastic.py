@@ -47,6 +47,7 @@ class PreemptionHandler:
         self._event = threading.Event()
         self._prev = {}
         self._installed = False
+        self._callbacks = []
 
     def install(self):
         try:
@@ -57,6 +58,14 @@ class PreemptionHandler:
             # not the main thread — stay disarmed rather than crash; the
             # loop then simply never sees preempted()==True
             self._prev.clear()
+        return self
+
+    def add_callback(self, fn):
+        """Run ``fn()`` (on a fresh daemon thread) when the preemption
+        signal arrives — the serving engine registers its graceful
+        ``drain()`` here so SIGTERM finishes in-flight requests instead
+        of dropping them (docs/RESILIENCE.md)."""
+        self._callbacks.append(fn)
         return self
 
     def _on_signal(self, signum, frame):
@@ -70,6 +79,17 @@ class PreemptionHandler:
             _fr.dump_on_preemption()
         except Exception:
             pass
+        for fn in list(self._callbacks):
+            # signal context: hand real work to a thread immediately
+            threading.Thread(target=self._run_callback, args=(fn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _run_callback(fn):
+        try:
+            fn()
+        except Exception:
+            pass                  # a drain hook must never mask SIGTERM
 
     def preempted(self):
         return self._event.is_set()

@@ -9,6 +9,17 @@ TPU-native notes: one process per host is the JAX multi-controller model
 (all local chips belong to that process), so nproc_per_node>1 is for CPU
 testing; rendezvous is jax.distributed.initialize against the coordinator
 address instead of a bespoke TCPStore.
+
+Hang & failure guardian (docs/RESILIENCE.md): the controller exports a
+cross-rank error-trap store to its workers (``PADDLE_GUARDIAN_DIR`` — a
+shared directory; the elastic controller exports its TCPStore endpoint as
+``PADDLE_GUARDIAN_STORE`` instead).  A failing rank records its exception
+there before dying; the controller prints that *original* error as the
+blame line, healthy peers' watchdogs abort their blocked collectives with
+it and exit ``ELASTIC_EXIT_CODE``, and the restart loop relaunches into
+the PR 2 auto-resume path.  Reaping escalates SIGTERM → SIGKILL after
+``PADDLE_GUARDIAN_TERM_GRACE_S`` so a worker wedged inside a collective
+can never hang the controller itself.
 """
 from __future__ import annotations
 
@@ -16,11 +27,19 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 
 from .context import Context, free_port
 
 ELASTIC_EXIT_CODE = 101  # reference: fleet/elastic/manager.py:32
+
+
+def _fault_level():
+    """reference: manager.py:178, env PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL
+    (reference spelling): 0 = only ELASTIC_EXIT_CODE relaunches; >0 = ANY
+    worker failure relaunches (up to max_restart)."""
+    return int(os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "0"))
 
 
 class CollectiveController:
@@ -31,11 +50,41 @@ class CollectiveController:
         if master is None:
             master = f"127.0.0.1:{free_port()}"
         self.master = master
+        self._trap = None
+
+    # ---- guardian plumbing ----
+    def _guardian_env(self):
+        """Env entries pointing workers at the cross-rank error trap."""
+        if self._trap is None:
+            args = self.ctx.args
+            root = os.path.join(args.log_dir, "guardian") if args.log_dir \
+                else tempfile.mkdtemp(prefix="pt_guardian_")
+            from ..store import FileKVStore
+            from ..watchdog import ErrorTrap
+            # rank=-1: every worker record reads as a "peer" here
+            self._trap = ErrorTrap(FileKVStore(root),
+                                   job=args.job_id, rank=-1)
+            self._guardian = {"PADDLE_GUARDIAN_DIR": root}
+        return self._guardian
+
+    def _guardian_blame(self):
+        """Print (and return) the trapped per-rank errors — the blame
+        lines a human reads instead of N interleaved tracebacks."""
+        errs = self._trap.peers() if self._trap is not None else []
+        for e in errs:
+            where = f" at collective {e.get('op')!r} seq {e.get('seq')}" \
+                if e.get("op") else ""
+            sys.stderr.write(
+                f"[launch] rank {e.get('rank')} failed with "
+                f"{e.get('type')}: {e.get('message')}{where}\n")
+        sys.stderr.flush()
+        return errs
 
     def _spawn_one(self, local_rank, rank=None, world=None):
         args = self.ctx.args
         env = self.ctx.proc_env(local_rank, self.master,
                                 rank=rank, world=world)
+        env.update(self._guardian_env())
         cmd = [sys.executable, args.training_script,
                *args.training_script_args]
         stdout = stderr = None
@@ -52,37 +101,54 @@ class CollectiveController:
         args = self.ctx.args
         restarts = 0
         while True:
+            self._guardian_env()
+            if self._trap is not None:
+                # stale error records must not instantly re-trip the
+                # fresh incarnation's watchdogs
+                self._trap.clear()
             self.procs = [self._spawn_one(i)
                           for i in range(args.nproc_per_node)]
             codes = self._watch()
             if all(c == 0 for c in codes):
                 return 0
-            if any(c == ELASTIC_EXIT_CODE for c in codes) \
+            self._guardian_blame()
+            if (any(c == ELASTIC_EXIT_CODE for c in codes)
+                    or _fault_level() > 0) \
                     and restarts < args.max_restart:
                 restarts += 1
                 continue
             return max(codes)
 
     def _watch(self):
-        """Wait for all procs; if one fails, terminate the rest (the
+        """Wait for all procs; if one fails, give healthy peers
+        ``PADDLE_GUARDIAN_PEER_GRACE_S`` seconds to abort themselves
+        (their watchdogs trap the failing rank's error and exit with the
+        relaunch code), then terminate + reap the rest (the
         watcher/pod-failure policy of controllers/watcher.py)."""
         codes = [None] * len(self.procs)
+        peer_grace = float(os.environ.get(
+            "PADDLE_GUARDIAN_PEER_GRACE_S", "0") or 0)
+        grace_until = None
         try:
             while any(c is None for c in codes):
                 for i, p in enumerate(self.procs):
                     if codes[i] is None:
-                        c = p.poll()
-                        if c is not None:
-                            codes[i] = c
-                            if c != 0:
-                                self._terminate(exclude=i)
-                                for j, q in enumerate(self.procs):
-                                    if codes[j] is None:
-                                        codes[j] = q.wait()
-                                return codes
+                        codes[i] = p.poll()
+                if not any(c not in (None, 0) for c in codes):
+                    time.sleep(0.2)
+                    continue
+                if all(c is not None for c in codes):
+                    return codes
+                if grace_until is None:
+                    grace_until = time.time() + peer_grace
+                if time.time() >= grace_until:
+                    self._terminate()
+                    self._reap(codes)
+                    return codes
                 time.sleep(0.2)
         except KeyboardInterrupt:
             self._terminate()
+            self._reap(codes)
             raise
         return codes
 
@@ -93,6 +159,34 @@ class CollectiveController:
                     p.send_signal(signal.SIGTERM)
                 except OSError:
                     pass
+
+    def _reap(self, codes, grace=None):
+        """SIGTERM was sent; wait up to `grace` seconds, then SIGKILL
+        survivors.  A rank wedged in a collective defers signal handlers
+        indefinitely — without escalation the controller inherits the
+        hang it exists to end."""
+        if grace is None:
+            grace = float(os.environ.get(
+                "PADDLE_GUARDIAN_TERM_GRACE_S", "10") or 10)
+        deadline = time.time() + grace
+        for i, p in enumerate(self.procs):
+            if codes[i] is not None:
+                continue
+            try:
+                codes[i] = p.wait(
+                    timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                sys.stderr.write(
+                    f"[launch] worker {i} ignored SIGTERM for "
+                    f"{grace:g}s (wedged in a collective?); sending "
+                    "SIGKILL\n")
+                sys.stderr.flush()
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+                codes[i] = p.wait()
+        return codes
 
 
 class ElasticCollectiveController(CollectiveController):
@@ -112,6 +206,7 @@ class ElasticCollectiveController(CollectiveController):
         self.procs = []
         args = ctx.args
         self.master = args.master
+        self._trap = None
         self.min_nodes, self.max_nodes = ctx.nnodes_range()
         pod_id = args.pod_id or f"{ctx.node_ip}-{os.getpid()}"
         self.kv = KVMaster(args.master, pod_id,
@@ -121,20 +216,31 @@ class ElasticCollectiveController(CollectiveController):
                            ttl=max(3.0, args.elastic_timeout / 5.0),
                            timeout=float(args.elastic_timeout * 10))
 
+    def _guardian_env(self):
+        # pods may share no filesystem: workers dial the rendezvous
+        # TCPStore (the same KV the KVMaster heartbeat loop polls)
+        return {"PADDLE_GUARDIAN_STORE": self.master}
+
+    def _guardian_blame(self):
+        errs = self.kv.peer_errors()
+        for e in errs:
+            where = f" at collective {e.get('op')!r} seq {e.get('seq')}" \
+                if e.get("op") else ""
+            sys.stderr.write(
+                f"[launch] rank {e.get('rank')} failed with "
+                f"{e.get('type')}: {e.get('message')}{where}\n")
+        sys.stderr.flush()
+        return errs
+
     def run(self):
         from . import master as M
         args = self.ctx.args
         restarts = 0
-        # fault-tolerance level (reference: manager.py:178, env
-        # PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL — reference spelling):
-        # 0 = only the explicit ELASTIC_EXIT_CODE relaunches; >0 = ANY
-        # worker failure relaunches (up to max_restart) instead of
-        # failing the job
-        level = int(os.environ.get(
-            "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "0"))
+        level = _fault_level()
         self.kv.start_heartbeat()
         try:
             while True:
+                self.kv.clear_errors()
                 r, pods, my_idx = self.kv.rendezvous(
                     self.min_nodes, self.max_nodes,
                     quiet=args.elastic_quiet)
@@ -146,13 +252,13 @@ class ElasticCollectiveController(CollectiveController):
                 status, codes = self._watch_elastic()
                 if status == "done":
                     return 0
+                self._guardian_blame()
                 if status == M.RESTART or \
                         (level > 0 and status == "failed") or \
                         any(c == ELASTIC_EXIT_CODE for c in codes
                             if c is not None):
                     self._terminate()
-                    for p in self.procs:
-                        p.wait()
+                    self._reap(codes)
                     if restarts >= args.max_restart:
                         return 1   # workers reaped, not orphaned
                     restarts += 1
@@ -177,9 +283,7 @@ class ElasticCollectiveController(CollectiveController):
                 return "failed", codes
             if any(c not in (None, 0) for c in codes):
                 self._terminate()
-                for i, p in enumerate(self.procs):
-                    if codes[i] is None:
-                        codes[i] = p.wait()
+                self._reap(codes)
                 if any(c == ELASTIC_EXIT_CODE for c in codes):
                     return M.RESTART, codes
                 return "failed", codes

@@ -55,6 +55,7 @@ class KVMaster:
         self.round = -1
         self._baseline = None
         self._scale_base = 0
+        self._peer_error = None   # first {job}/error/* record seen
 
     def _k(self, *parts):
         return "/".join((self.job,) + parts)
@@ -74,7 +75,48 @@ class KVMaster:
                     self._hb.heartbeat(self.pod_id)
             except Exception:
                 pass
+            # the same loop polls the cross-rank error trap: a worker
+            # that died mid-collective recorded its exception under
+            # {job}/error/{rank} (distributed/watchdog.py); caching it
+            # here lets watch() turn the ORIGINAL error into a RESTART
+            # without waiting for heartbeat TTL expiry
+            try:
+                if self._peer_error is None:
+                    errs = self.peer_errors()
+                    if errs:
+                        self._peer_error = errs[0]
+            except Exception:
+                pass
             self._stop.wait(interval)
+
+    # ---- cross-rank error trap (docs/RESILIENCE.md) ----
+    def peer_errors(self):
+        """Error records workers trapped under ``{job}/error/*``."""
+        import json as _json
+        with self._lock:
+            raw = self.store.list_prefix(self._k("error") + "/")
+        out = []
+        for val in raw.values():
+            try:
+                out.append(_json.loads(val))
+            except (ValueError, TypeError):
+                continue
+        return sorted(out, key=lambda r: r.get("ts", 0))
+
+    def clear_errors(self):
+        """Drop all guardian state (trapped errors, arrival markers,
+        host-collective contributions) so a fresh incarnation neither
+        re-trips on a stale error nor reads a dead incarnation's
+        collective data at a colliding (group, seq)."""
+        self._peer_error = None
+        for prefix in ("error", "arrive", "hc"):
+            try:
+                with self._lock:
+                    for key in self.store.list_prefix(
+                            self._k(prefix) + "/"):
+                        self.store.delete_key(key)
+            except Exception:
+                pass
 
     def alive(self):
         with self._lock:
@@ -196,7 +238,10 @@ class KVMaster:
     # ---- membership watch (reference: etcd watch + scale triggers) ----
     def watch(self):
         """One poll while workers run: HOLD or RESTART (membership must
-        be rebuilt — a joiner requested scale-out, or a pod died)."""
+        be rebuilt — a joiner requested scale-out, a pod died, or a
+        worker trapped a fatal error in {job}/error/*)."""
+        if self._peer_error is not None:
+            return RESTART
         with self._lock:
             scale = self.store.add(self._k("scale"), 0)
         if scale != self._scale_base:

@@ -20,13 +20,18 @@ from __future__ import annotations
 
 import pickle
 import threading
+import time
 
 import numpy as np
 import jax.numpy as jnp
 
 from multiprocessing.connection import Listener, Client
 
+from ...utils import monitor
+from ...utils.log import get_logger
+
 _AUTHKEY = b"paddle_tpu_ps"
+log = get_logger("paddle_tpu.ps")
 
 
 # ------------------------------------------------------------------
@@ -986,6 +991,13 @@ class ShardedPSClient:
         self._pool.shutdown(wait=False)
 
 
+class PSFlushTimeoutError(RuntimeError):
+    """The push-drain barrier did not complete: the background thread is
+    wedged (or dead) with updates still queued.  Raised instead of
+    silently pretending the barrier completed — a trainer that proceeds
+    past a fake barrier reads stale rows and diverges quietly."""
+
+
 class Communicator:
     """Async push channel (reference: ps/service/communicator/
     communicator.h AsyncCommunicator): gradient pushes enqueue and a
@@ -1001,6 +1013,10 @@ class Communicator:
         self._running = True
         self._thread = threading.Thread(target=self._drain, daemon=True)
         self._thread.start()
+
+    def _pending(self):
+        with self._q.all_tasks_done:
+            return self._q.unfinished_tasks
 
     def _drain(self):
         while True:
@@ -1026,18 +1042,54 @@ class Communicator:
     def push_dense_async(self, table_id, grad):
         self._q.put(("dense", (table_id, np.asarray(grad, np.float32))))
 
-    def flush(self):
-        """Barrier: wait until every enqueued push is applied."""
-        self._q.join()
+    def flush(self, timeout=None):
+        """Barrier: wait until every enqueued push is applied.  With a
+        ``timeout`` (seconds) the wait is bounded — a wedged or dead
+        drain thread raises :class:`PSFlushTimeoutError` (and bumps the
+        ``ps.flush_timeouts`` counter) instead of blocking forever or,
+        worse, returning as if the barrier completed."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._q.all_tasks_done:
+            while self._q.unfinished_tasks:
+                if not self._thread.is_alive():
+                    monitor.incr("ps.flush_timeouts")
+                    raise PSFlushTimeoutError(
+                        f"ps push thread died with "
+                        f"{self._q.unfinished_tasks} update(s) still "
+                        "queued; the barrier can never complete")
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    monitor.incr("ps.flush_timeouts")
+                    raise PSFlushTimeoutError(
+                        f"ps flush barrier timed out after {timeout}s "
+                        f"with {self._q.unfinished_tasks} update(s) "
+                        "still queued (push thread wedged?)")
+                self._q.all_tasks_done.wait(
+                    0.5 if remaining is None else min(remaining, 0.5))
         if self._exc is not None:
             exc, self._exc = self._exc, None
             raise exc
 
-    def stop(self):
+    def stop(self, timeout=5.0):
+        """Stop the drain thread.  A thread that ignores the stop token
+        for ``timeout`` seconds is wedged mid-push: raise loudly (with
+        the ``ps.flush_timeouts`` counter bumped) — returning silently
+        here used to let callers believe every queued update landed."""
         if self._running:
             self._running = False
             self._q.put(None)
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                monitor.incr("ps.flush_timeouts")
+                pending = self._pending()
+                log.error(
+                    "ps Communicator.stop: push thread still alive "
+                    "after %.1fs with %d update(s) queued — updates "
+                    "may be lost", timeout, pending)
+                raise PSFlushTimeoutError(
+                    f"ps push thread failed to stop within {timeout}s "
+                    f"({pending} update(s) still queued)")
 
 
 class AsyncPSEmbedding(PSEmbedding):

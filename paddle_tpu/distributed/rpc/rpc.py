@@ -134,10 +134,19 @@ def _connect(to):
 
 
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
-    """reference: rpc.py rpc_sync — blocking remote call."""
+    """reference: rpc.py rpc_sync — blocking remote call.  A positive
+    ``timeout`` (seconds) bounds the wait for the response: a dead or
+    wedged worker raises ``TimeoutError`` naming it instead of blocking
+    this process forever in ``recv()``."""
     c = _connect(to)
     try:
         c.send(("call", fn, tuple(args or ()), kwargs))
+        if timeout is not None and timeout > 0:
+            if not c.poll(timeout):
+                raise TimeoutError(
+                    f"rpc to worker {to!r} ({getattr(fn, '__name__', fn)}) "
+                    f"timed out after {timeout}s — worker dead or call "
+                    "wedged; no response arrived")
         status, payload = c.recv()
     finally:
         c.close()
@@ -147,13 +156,16 @@ def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
 
 
 def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
-    """reference: rpc.py rpc_async — returns a Future."""
+    """reference: rpc.py rpc_async — returns a Future.  ``timeout``
+    bounds the remote wait exactly as in :func:`rpc_sync`; the Future
+    then resolves with that ``TimeoutError``."""
     fut: Future = Future()
 
     def run():
         try:
-            fut.set_result(rpc_sync(to, fn, args=args, kwargs=kwargs))
-        except Exception as e:
+            fut.set_result(rpc_sync(to, fn, args=args, kwargs=kwargs,
+                                    timeout=timeout))
+        except BaseException as e:
             fut.set_exception(e)
 
     threading.Thread(target=run, daemon=True).start()

@@ -194,6 +194,90 @@ class TCPStore:
             self._server = None
 
 
+class FileKVStore:
+    """TCPStore-shaped KV (set/get/add/delete_key/list_prefix) over a
+    shared directory — the guardian/error-trap substrate when the job
+    has no TCP store endpoint (single-host launch, tests).  Writes are
+    tmp+``os.replace`` atomic, so a concurrent reader never sees a torn
+    value; keys are percent-encoded into filenames so ``/``-structured
+    keys (``{job}/error/{rank}``) round-trip."""
+
+    def __init__(self, root):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _fname(self, key):
+        from urllib.parse import quote
+        return os.path.join(self.root, "kv." + quote(key, safe=""))
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        path = self._fname(key)
+        tmp = f"{path}.tmp.{os.getpid()}.{id(value)}"
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.replace(tmp, path)
+
+    def get(self, key, default=None):
+        try:
+            with open(self._fname(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return default
+
+    def add(self, key, delta=1):
+        """Atomic counter via an exclusive lock file (retry loop)."""
+        lock = os.path.join(self.root, "kv.lock")
+        deadline = time.time() + 10.0
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                break
+            except FileExistsError:
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f"FileKVStore.add({key!r}): lock file {lock} "
+                        "held for >10s (stale lock from a killed "
+                        "process? delete it)") from None
+                time.sleep(0.005)
+        try:
+            cur = self.get(key)
+            val = (int(cur) if cur else 0) + int(delta)
+            self.set(key, str(val))
+            return val
+        finally:
+            os.close(fd)
+            os.unlink(lock)
+
+    def delete_key(self, key):
+        try:
+            os.unlink(self._fname(key))
+        except FileNotFoundError:
+            pass
+
+    def list_prefix(self, prefix):
+        from urllib.parse import unquote
+        out = {}
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if not name.startswith("kv.") or ".tmp." in name or \
+                    name == "kv.lock":
+                continue
+            key = unquote(name[3:])
+            if key.startswith(prefix):
+                val = self.get(key)
+                if val is not None:
+                    out[key] = val
+        return out
+
+    def close(self):
+        pass
+
+
 class TCPElasticStore:
     """ElasticManager store interface (register/heartbeat/alive_nodes)
     over TCPStore — the etcd-grade replacement for FileStore when hosts

@@ -1,0 +1,700 @@
+"""Hang & failure guardian: collective watchdog + cross-rank error trap.
+
+A rank that crashes or stalls mid-step leaves every peer blocked forever
+inside ``all_reduce``/``barrier`` — the whole slice burns until some
+external timeout.  The reference's elastic manager only notices dead
+pods *between* rendezvous rounds; PyTorch's NCCL watchdog and TF's
+coordination service close that gap with a per-process watchdog that
+detects the stall, blames the rank that never arrived, and aborts the
+job into the relaunch path.  This module is that discipline for the
+TPU-native stack (docs/RESILIENCE.md):
+
+1. **Collective watchdog** — every collective that goes through
+   ``collective._multiproc_collective`` registers (op, group, seq,
+   start-time, thread) here.  A daemon thread polls; an op exceeding
+   ``FLAGS_collective_timeout_s`` triggers a *stall dump* (all-thread
+   stacks + the last-N completed collectives + a metrics snapshot,
+   through the PR 4 flight recorder) and raises
+   :class:`CollectiveTimeoutError` — naming the op, the per-group
+   sequence number, and the ranks whose arrival records never reached
+   the store — asynchronously in the blocked thread.  A thread wedged in
+   C (a real cross-process XLA transfer) cannot take the async
+   exception; after a grace period the watchdog hard-exits so the launch
+   controller reaps the rank instead of a silent multi-minute hang.
+
+2. **Cross-rank error trap** — a failing rank writes
+   ``{job}/error/{rank}`` (exception type + message + traceback + the
+   collective seq it died at) into the shared KV store before dying
+   (``sys.excepthook`` chain + the ``rank_crash`` fault point).  Healthy
+   peers' watchdogs poll that prefix, so a peer blocked in a collective
+   aborts with :class:`PeerFailureError` carrying the *original* rank's
+   error — and exits with ``ELASTIC_EXIT_CODE`` so the controller's
+   restart loop relaunches into the PR 2 auto-resume path.  The launch
+   ``KVMaster`` heartbeat loop polls the same prefix on the controller
+   side.
+
+3. **Desync detector** — collectives carry a per-group sequence number;
+   each call records ``{job}/arrive/g{gid}/r{rank} = "seq:op"`` and, on
+   a sampling interval (``FLAGS_desync_check_every``), compares peers'
+   records: a rank calling a *different op at the same seq* raises
+   :class:`DesyncError` naming both ops — blamed precisely instead of
+   manifesting as a mutual hang.
+
+The store is pluggable: ``PADDLE_GUARDIAN_STORE`` (host:port — the
+launch TCPStore) or ``PADDLE_GUARDIAN_DIR`` (a shared directory —
+``store.FileKVStore``); the launch controllers export one of them to
+workers automatically.  With ``FLAGS_collective_timeout_s=0``, no store
+configured, and no collective fault points armed, ``begin()`` returns
+``None`` after three dict lookups — the guardian costs nothing when off.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+from ..utils.flags import flag as _flag
+from ..utils import fault_injection as _fi
+
+#: cooperative-relaunch code (fleet/elastic.py, launch/controller.py):
+#: a peer-failure abort asks the controller to relaunch into auto-resume.
+ELASTIC_EXIT_CODE = 101
+#: hard-abort code for a plain collective timeout — a hang is a hard
+#: fault, not a cooperative relaunch request (distinct from the fault
+#: injector's DEFAULT_EXIT_CODE so drills can tell them apart).
+GUARDIAN_ABORT_EXIT_CODE = 107
+
+
+class GuardianError(RuntimeError):
+    """Base class for watchdog-raised failures."""
+
+
+class CollectiveTimeoutError(GuardianError):
+    """A collective exceeded ``FLAGS_collective_timeout_s``."""
+
+    def __init__(self, message="", op=None, seq=None, group_ranks=None,
+                 missing_ranks=None, waited_s=None):
+        super().__init__(message)
+        self.op = op
+        self.seq = seq
+        self.group_ranks = group_ranks
+        self.missing_ranks = missing_ranks
+        self.waited_s = waited_s
+
+
+class PeerFailureError(GuardianError):
+    """A peer rank died; this rank's blocked collective was aborted with
+    the originating rank's error instead of a generic timeout."""
+
+    def __init__(self, message="", rank=None, original_type=None,
+                 original_traceback=None):
+        super().__init__(message)
+        self.rank = rank
+        self.original_type = original_type
+        self.original_traceback = original_traceback
+
+
+class DesyncError(GuardianError):
+    """Two ranks issued different collectives at the same per-group
+    sequence number — a program divergence, not a hang."""
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def async_raise(thread_ident, exc_type):
+    """Schedule ``exc_type`` to be raised in the thread with the given
+    ident at its next bytecode boundary.  Returns False when the thread
+    is gone or wedged outside the interpreter (blocked in C) — callers
+    must escalate themselves."""
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread_ident), ctypes.py_object(exc_type))
+    if res > 1:    # pragma: no cover - "affected more than one thread"
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(thread_ident), None)
+        return False
+    return res == 1
+
+
+def all_thread_stacks():
+    """Stacks of every live thread — the heart of the stall dump."""
+    names = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        t = names.get(ident)
+        out.append({
+            "name": getattr(t, "name", f"thread-{ident}"),
+            "ident": ident,
+            "daemon": bool(getattr(t, "daemon", False)),
+            "stack": traceback.format_stack(frame),
+        })
+    return out
+
+
+def _guardian_rank():
+    env = os.environ.get("PADDLE_TRAINER_ID")
+    if env is not None:
+        return int(env)
+    try:
+        from . import env as _env
+        return _env.get_rank()
+    except Exception:
+        return 0
+
+
+def stall_dump_path(rank=None):
+    """Resolve the stall-dump destination.  ``FLAGS_stall_dump_path``
+    names a file; multi-rank jobs insert ``.rank<R>`` before the
+    extension so peers never clobber each other's dump."""
+    p = str(_flag("FLAGS_stall_dump_path", "") or "")
+    rank = _guardian_rank() if rank is None else rank
+    if not p:
+        return os.path.join(os.getcwd(),
+                            f"stall_dump.{os.getpid()}.json")
+    root, ext = os.path.splitext(p)
+    return f"{root}.rank{rank}{ext or '.json'}"
+
+
+# ---------------------------------------------------------------------------
+# cross-rank error trap
+# ---------------------------------------------------------------------------
+
+
+class ErrorTrap:
+    """``{job}/error/{rank}`` + ``{job}/arrive/...`` records over any
+    TCPStore-shaped KV (set/get/list_prefix/delete_key)."""
+
+    def __init__(self, store, job="default", rank=0):
+        self.store = store
+        self.job = str(job)
+        self.rank = int(rank)
+        # TCPStore multiplexes one fd: the watchdog thread and the main
+        # thread must not interleave frames
+        self._lock = threading.Lock()
+
+    def _k(self, *parts):
+        return "/".join((self.job,) + parts)
+
+    # ---- error records ----
+    def report(self, exc, op=None, seq=None):
+        """Record this rank's failure for peers/controller.  Never
+        raises — the trap is a courtesy on the way down."""
+        payload = {
+            "rank": self.rank,
+            "type": type(exc).__name__,
+            "message": str(exc)[:2000],
+            "traceback": "".join(traceback.format_exception(
+                type(exc), exc, exc.__traceback__))[-8000:],
+            "op": op,
+            "seq": seq,
+            "ts": time.time(),
+        }
+        try:
+            with self._lock:
+                self.store.set(self._k("error", str(self.rank)),
+                               json.dumps(payload))
+        except Exception:
+            pass
+
+    def peers(self):
+        """Error records written by OTHER ranks, oldest first."""
+        try:
+            with self._lock:
+                raw = self.store.list_prefix(self._k("error") + "/")
+        except Exception:
+            return []
+        out = []
+        for key, val in raw.items():
+            try:
+                rec = json.loads(val)
+            except (ValueError, TypeError):
+                continue
+            if int(rec.get("rank", -1)) != self.rank:
+                out.append(rec)
+        return sorted(out, key=lambda r: r.get("ts", 0))
+
+    def clear(self):
+        """Drop every guardian record — errors, arrival markers, and
+        host-collective contributions.  The controller calls this
+        between relaunch rounds: a stale error would instantly re-trip
+        the fresh incarnation's watchdogs, and a stale host-collective
+        key would satisfy a fresh gather at the same (group, seq) with
+        the DEAD incarnation's data (silent corruption, not a crash)."""
+        for prefix in ("error", "arrive", "hc"):
+            try:
+                with self._lock:
+                    raw = self.store.list_prefix(
+                        self._k(prefix) + "/")
+                    for key in raw:
+                        self.store.delete_key(key)
+            except Exception:
+                pass
+
+    # ---- arrival / desync records ----
+    def record_arrival(self, group_id, seq, op):
+        try:
+            with self._lock:
+                self.store.set(
+                    self._k("arrive", f"g{group_id}", f"r{self.rank}"),
+                    f"{seq}:{op}")
+        except Exception:
+            pass
+
+    def arrivals(self, group_id):
+        """{rank: (seq, op)} — each rank's newest recorded collective."""
+        try:
+            with self._lock:
+                raw = self.store.list_prefix(
+                    self._k("arrive", f"g{group_id}") + "/")
+        except Exception:
+            return {}
+        out = {}
+        for key, val in raw.items():
+            r = key.rsplit("/r", 1)[-1]
+            try:
+                seq, op = bytes(val).decode().split(":", 1)
+                out[int(r)] = (int(seq), op)
+            except (ValueError, TypeError):
+                continue
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the watchdog
+# ---------------------------------------------------------------------------
+
+
+class _InFlight:
+    __slots__ = ("op", "group_id", "ranks", "seq", "start", "thread_id",
+                 "thread_name", "exc", "kill_at", "exit_code")
+
+    def __init__(self, op, group_id, ranks, seq):
+        self.op = op
+        self.group_id = group_id
+        self.ranks = list(ranks)
+        self.seq = seq
+        self.start = time.monotonic()
+        self.thread_id = threading.get_ident()
+        self.thread_name = threading.current_thread().name
+        self.exc = None          # rich instance for translate()
+        self.kill_at = None      # hard-abort deadline once stalled
+        self.exit_code = GUARDIAN_ABORT_EXIT_CODE
+
+
+class CollectiveWatchdog:
+    def __init__(self, trap=None):
+        self.trap = trap
+        self._lock = threading.Lock()
+        self._inflight: dict[int, _InFlight] = {}
+        self._recent = deque(maxlen=32)   # last completed collectives
+        self._seq: dict[int, int] = {}    # per-group sequence counters
+        self._token = 0
+        self._thread = None
+        self._stop = threading.Event()
+        self._dumped = False
+
+    # ---- configuration -------------------------------------------------
+    def timeout_s(self):
+        try:
+            return float(_flag("FLAGS_collective_timeout_s", 0) or 0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    def _interval(self):
+        t = self.timeout_s()
+        if t <= 0:
+            return 0.5
+        return min(max(t / 4.0, 0.05), 1.0)
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="paddle-tpu-collective-watchdog",
+                daemon=True)
+            self._thread.start()
+
+    # ---- registration (called from collective.py) ----------------------
+    def begin(self, op, group):
+        gid = getattr(group, "id", 0)
+        with self._lock:
+            seq = self._seq.get(gid, 0)
+            self._seq[gid] = seq + 1
+            self._token += 1
+            tok = self._token
+            entry = _InFlight(op, gid, getattr(group, "ranks", []), seq)
+            self._inflight[tok] = entry
+        if self.timeout_s() > 0 or self.trap is not None:
+            self._ensure_thread()
+        return tok, entry
+
+    def preflight(self, entry):
+        """Fault injection + fail-fast peer check + arrival/desync
+        records.  Runs in the calling thread, may raise synchronously."""
+        self._inject(entry)
+        if self.trap is None:
+            return
+        peers = self.trap.peers()
+        if peers:
+            raise self._peer_error(peers)
+        self.trap.record_arrival(entry.group_id, entry.seq, entry.op)
+        every = int(_flag("FLAGS_desync_check_every", 16) or 0)
+        if every > 0 and entry.seq % every == 0:
+            self._desync_check(entry)
+
+    def end(self, tok):
+        with self._lock:
+            entry = self._inflight.pop(tok, None)
+            if entry is not None:
+                self._recent.append({
+                    "op": entry.op, "group": entry.group_id,
+                    "seq": entry.seq,
+                    "duration_s": round(
+                        time.monotonic() - entry.start, 4),
+                })
+
+    def translate(self, entry, exc):
+        """Swap a bare async-raised GuardianError for the rich instance
+        the watchdog prepared (PyThreadState_SetAsyncExc can only
+        deliver a class)."""
+        if entry is not None and entry.exc is not None and \
+                isinstance(exc, GuardianError) and not str(exc):
+            return entry.exc
+        return exc
+
+    def recent(self):
+        with self._lock:
+            return list(self._recent)
+
+    # ---- fault injection ------------------------------------------------
+    def _match(self, params, entry):
+        if params is None:
+            return False
+        if "op" in params and params["op"] != entry.op:
+            return False
+        if "at_seq" in params and params["at_seq"] != entry.seq:
+            return False
+        if "rank" in params and params["rank"] != _guardian_rank():
+            return False
+        once = params.get("once_file")
+        if once:
+            try:
+                fd = os.open(once, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+            except FileExistsError:
+                return False            # already fired once
+            except OSError:
+                pass
+        return True
+
+    def _inject(self, entry):
+        crash = _fi.active("rank_crash")
+        if self._match(crash, entry):
+            exc = _fi.InjectedFault(
+                f"rank_crash: injected crash of rank {_guardian_rank()} "
+                f"at collective {entry.op} seq {entry.seq}")
+            if self.trap is not None:
+                self.trap.report(exc, op=entry.op, seq=entry.seq)
+            if crash.get("mode", "exit") == "raise":
+                raise exc
+            sys.stderr.write(f"[guardian] {exc}\n")
+            sys.stderr.flush()
+            os._exit(int(crash.get("exit", _fi.DEFAULT_EXIT_CODE)))
+        delay = _fi.active("collective_delay")
+        if self._match(delay, entry):
+            # interruptible sleep: the watchdog's async exception lands
+            # at a bytecode boundary, so sleep in small slices
+            deadline = time.monotonic() + float(delay.get("delay_s", 30))
+            while time.monotonic() < deadline:
+                time.sleep(0.02)
+
+    # ---- desync ---------------------------------------------------------
+    def _desync_check(self, entry):
+        for rank, (seq, op) in self.trap.arrivals(entry.group_id).items():
+            if rank == self.trap.rank:
+                continue
+            if seq == entry.seq and op != entry.op:
+                exc = DesyncError(
+                    f"collective desync on group {entry.group_id} at "
+                    f"seq {entry.seq}: rank {self.trap.rank} called "
+                    f"{entry.op!r} but rank {rank} called {op!r} — the "
+                    "program diverged across ranks")
+                self.trap.report(exc, op=entry.op, seq=entry.seq)
+                raise exc
+
+    # ---- the poll loop --------------------------------------------------
+    def _run(self):
+        while not self._stop.wait(self._interval()):
+            try:
+                self._poll_once()
+            except Exception:       # the guardian must never be the fault
+                pass
+
+    def _poll_once(self):
+        with self._lock:
+            entries = list(self._inflight.items())
+        if not entries:
+            return
+        now = time.monotonic()
+        hard_abort = bool(_flag("FLAGS_collective_hard_abort", True))
+        for tok, e in entries:
+            if e.kill_at is not None:
+                if now >= e.kill_at and hard_abort:
+                    self._hard_abort(e)
+                continue
+            peers = self.trap.peers() if self.trap is not None else []
+            if peers:
+                self._stall(e, self._peer_error(peers),
+                            exit_code=ELASTIC_EXIT_CODE)
+                continue
+            timeout = self.timeout_s()
+            if timeout > 0 and now - e.start > timeout:
+                waited = now - e.start
+                missing = self._missing_ranks(e)
+                blame = (f"; ranks never arrived: {missing}"
+                         if missing else "")
+                exc = CollectiveTimeoutError(
+                    f"collective {e.op!r} (group ranks {e.ranks}, seq "
+                    f"{e.seq}) stuck for {waited:.1f}s on thread "
+                    f"{e.thread_name!r} (FLAGS_collective_timeout_s="
+                    f"{timeout:g}){blame}",
+                    op=e.op, seq=e.seq, group_ranks=e.ranks,
+                    missing_ranks=missing, waited_s=round(waited, 3))
+                if self.trap is not None:
+                    self.trap.report(exc, op=e.op, seq=e.seq)
+                self._stall(e, exc, exit_code=GUARDIAN_ABORT_EXIT_CODE)
+
+    def _peer_error(self, peers):
+        p = peers[0]
+        return PeerFailureError(
+            f"rank {p.get('rank')} failed with {p.get('type')}: "
+            f"{p.get('message')} (at collective {p.get('op')!r} seq "
+            f"{p.get('seq')}); aborting this rank's blocked collective "
+            f"for relaunch\n--- original traceback (rank "
+            f"{p.get('rank')}) ---\n{p.get('traceback', '')}",
+            rank=p.get("rank"), original_type=p.get("type"),
+            original_traceback=p.get("traceback"))
+
+    def _missing_ranks(self, e):
+        if self.trap is None:
+            return None
+        arr = self.trap.arrivals(e.group_id)
+        me = self.trap.rank
+        missing = [r for r in e.ranks
+                   if r != me and arr.get(r, (-1, ""))[0] < e.seq]
+        return missing
+
+    def _stall(self, e, exc, exit_code):
+        e.exc = exc
+        e.exit_code = exit_code
+        self._write_stall_dump(e, exc)
+        sys.stderr.write(
+            f"[guardian] {type(exc).__name__}: {exc}\n"
+            f"[guardian] stall dump: {stall_dump_path()}\n")
+        sys.stderr.flush()
+        delivered = async_raise(e.thread_id, type(exc))
+        grace = max(2 * self._interval(), 1.0)
+        if not delivered:
+            grace = min(grace, 0.5)   # thread already gone/wedged in C
+        e.kill_at = time.monotonic() + grace
+
+    def _hard_abort(self, e):
+        sys.stderr.write(
+            f"[guardian] thread {e.thread_name!r} did not unwind from "
+            f"{e.op!r} (blocked outside the interpreter); hard-aborting "
+            f"with exit code {e.exit_code} so the controller can reap "
+            "this rank\n")
+        sys.stderr.flush()
+        os._exit(e.exit_code)
+
+    def _write_stall_dump(self, e, exc):
+        if self._dumped:          # one stall dump per process is plenty
+            return
+        self._dumped = True
+        from ..observability import flight_recorder as _fr
+        peers = self.trap.peers() if self.trap is not None else []
+        stall = {
+            "op": e.op,
+            "seq": e.seq,
+            "group_ranks": e.ranks,
+            "rank": _guardian_rank(),
+            "waited_s": round(time.monotonic() - e.start, 3),
+            "timeout_s": self.timeout_s(),
+            "missing_ranks": self._missing_ranks(e) or [],
+            "peer_errors": peers,
+            "recent_collectives": self.recent(),
+            "threads": all_thread_stacks(),
+        }
+        _fr.record("stall", e.op, seq=e.seq, group=e.group_id)
+        _fr.dump(path=stall_dump_path(), reason="stall", error=exc,
+                 extra={"stall": stall})
+
+    # ---- teardown (tests) ----------------------------------------------
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# process-wide wiring
+# ---------------------------------------------------------------------------
+
+_WATCHDOG: CollectiveWatchdog | None = None
+_CONFIGURED = False
+_TRAP_HOOKED = False
+_LOCK = threading.Lock()
+
+
+def _auto_trap():
+    """Build an ErrorTrap from the launch env contract, if present."""
+    endpoint = os.environ.get("PADDLE_GUARDIAN_STORE")
+    root = os.environ.get("PADDLE_GUARDIAN_DIR")
+    if not endpoint and not root:
+        return None
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+    rank = _guardian_rank()
+    try:
+        if endpoint:
+            from .store import TCPStore
+            host, port = endpoint.rsplit(":", 1)
+            return ErrorTrap(TCPStore(host, int(port), timeout=20.0),
+                             job=job, rank=rank)
+        from .store import FileKVStore
+        return ErrorTrap(FileKVStore(root), job=job, rank=rank)
+    except Exception as e:     # a broken trap must not block training
+        sys.stderr.write(f"[guardian] error trap unavailable: {e}\n")
+        return None
+
+
+def _install_trap_hook(trap):
+    """Chain sys.excepthook so ANY unhandled exception is recorded for
+    peers before the process dies (the cross-rank error trap)."""
+    global _TRAP_HOOKED
+    if _TRAP_HOOKED:
+        return
+    _TRAP_HOOKED = True
+    prev = sys.excepthook
+
+    def _hook(etype, value, tb):
+        if not issubclass(etype, (KeyboardInterrupt, SystemExit)):
+            try:
+                trap.report(value)
+            except Exception:
+                pass
+        prev(etype, value, tb)
+        if issubclass(etype, PeerFailureError):
+            # this rank is healthy — it died because a PEER failed.
+            # Exit with the cooperative relaunch code so the launch
+            # controller restarts the job into auto-resume instead of
+            # counting this rank as a second independent fault.
+            sys.stderr.flush()
+            os._exit(ELASTIC_EXIT_CODE)
+
+    sys.excepthook = _hook
+
+
+def get_watchdog():
+    global _WATCHDOG, _CONFIGURED
+    with _LOCK:
+        if _WATCHDOG is None:
+            trap = _auto_trap()
+            if trap is not None:
+                _install_trap_hook(trap)
+            _WATCHDOG = CollectiveWatchdog(trap)
+            _CONFIGURED = True
+        return _WATCHDOG
+
+
+def configure(store=None, job="default", rank=0):
+    """Explicitly (re)configure the guardian with a store — tests and
+    embedders that don't go through the launch env contract."""
+    global _WATCHDOG, _CONFIGURED
+    with _LOCK:
+        if _WATCHDOG is not None:
+            _WATCHDOG.stop()
+        trap = ErrorTrap(store, job=job, rank=rank) \
+            if store is not None else None
+        if trap is not None:
+            _install_trap_hook(trap)
+        _WATCHDOG = CollectiveWatchdog(trap)
+        _CONFIGURED = True
+        return _WATCHDOG
+
+
+def reset():
+    """Tear down the process-wide watchdog (tests)."""
+    global _WATCHDOG, _CONFIGURED
+    with _LOCK:
+        if _WATCHDOG is not None:
+            _WATCHDOG.stop()
+        _WATCHDOG = None
+        _CONFIGURED = False
+
+
+def _armed():
+    """One cheap check deciding whether begin() does anything at all."""
+    if _WATCHDOG is not None and _WATCHDOG.trap is not None:
+        return True
+    try:
+        if float(_flag("FLAGS_collective_timeout_s", 0) or 0) > 0:
+            return True
+    except (TypeError, ValueError):
+        pass
+    if _fi.active("collective_delay") is not None or \
+            _fi.active("rank_crash") is not None:
+        return True
+    if not _CONFIGURED and (os.environ.get("PADDLE_GUARDIAN_STORE") or
+                            os.environ.get("PADDLE_GUARDIAN_DIR")):
+        return True
+    return False
+
+
+def begin(op, group):
+    """Guard entry for one collective.  Returns None when the guardian
+    is entirely off (the zero-overhead path), else an opaque token."""
+    if not _armed():
+        return None
+    wd = get_watchdog()
+    tok, entry = wd.begin(op, group)
+    return (wd, tok, entry)
+
+
+def preflight(token):
+    if token is not None:
+        wd, tok, entry = token
+        wd.preflight(entry)
+
+
+def end(token):
+    if token is not None:
+        wd, tok, entry = token
+        wd.end(tok)
+
+
+def translate(token, exc):
+    if token is None:
+        return exc
+    wd, tok, entry = token
+    return wd.translate(entry, exc)
+
+
+def report_error(exc, op=None, seq=None):
+    """Record this rank's failure in the cross-rank trap (no-op when no
+    store is configured)."""
+    wd = get_watchdog()
+    if wd.trap is not None:
+        wd.trap.report(exc, op=op, seq=seq)
+
+
+def peer_errors():
+    wd = get_watchdog()
+    return wd.trap.peers() if wd.trap is not None else []

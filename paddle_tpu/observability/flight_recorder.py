@@ -69,11 +69,15 @@ class FlightRecorder:
         return str(_flag("FLAGS_flight_recorder_path") or "") or \
             os.path.join(os.getcwd(), f"flight_recorder.{os.getpid()}.json")
 
-    def dump(self, path=None, reason="manual", error=None, once=False):
+    def dump(self, path=None, reason="manual", error=None, once=False,
+             extra=None):
         """Write the ring + a metrics snapshot to ``path`` (atomic).
         ``once=True`` dedupes per reason (the SIGTERM handler and the
-        fit loop may both fire).  Returns the path, or None when
-        disabled/empty/deduped — telemetry never raises."""
+        fit loop may both fire).  ``extra`` is a dict merged into the
+        payload top level — the collective watchdog rides it to attach
+        the stall section (all-thread stacks, blamed op/ranks).  Returns
+        the path, or None when disabled/empty/deduped — telemetry never
+        raises."""
         if self.capacity <= 0:
             return None
         with self._lock:
@@ -81,7 +85,7 @@ class FlightRecorder:
                 return None
             self._dumped.add(reason)
             events = list(self._events)
-        if not events and error is None:
+        if not events and error is None and extra is None:
             return None               # nothing to say: leave no litter
         payload = {
             "reason": reason,
@@ -90,6 +94,8 @@ class FlightRecorder:
             "argv": list(sys.argv),
             "events": events,
         }
+        if extra:
+            payload.update(extra)
         if error is not None:
             payload["error"] = {
                 "type": type(error).__name__,
@@ -136,9 +142,9 @@ def record(kind, name, **data):
     get_recorder().record(kind, name, **data)
 
 
-def dump(path=None, reason="manual", error=None, once=False):
+def dump(path=None, reason="manual", error=None, once=False, extra=None):
     return get_recorder().dump(path=path, reason=reason, error=error,
-                               once=once)
+                               once=once, extra=extra)
 
 
 def dump_on_preemption():

@@ -12,7 +12,8 @@ from __future__ import annotations
 
 from .api import (  # noqa: F401
     DeadlineExceededError, EngineShutdownError, QueueFullError,
-    RequestOutput, SamplingParams, ServingConfig, ServingError,
+    RequestOutput, SamplingParams, SchedulerStallError, ServingConfig,
+    ServingError,
 )
 from .engine import Engine  # noqa: F401
 from .kv_slots import SlotKVCache  # noqa: F401
@@ -21,6 +22,6 @@ from .stats import reset_serving_stats, serving_stats  # noqa: F401
 __all__ = [
     "Engine", "ServingConfig", "SamplingParams", "RequestOutput",
     "SlotKVCache", "ServingError", "QueueFullError",
-    "DeadlineExceededError", "EngineShutdownError", "serving_stats",
-    "reset_serving_stats",
+    "DeadlineExceededError", "EngineShutdownError",
+    "SchedulerStallError", "serving_stats", "reset_serving_stats",
 ]

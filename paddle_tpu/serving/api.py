@@ -28,7 +28,14 @@ class DeadlineExceededError(ServingError):
 
 
 class EngineShutdownError(ServingError):
-    """The engine stopped while the request was queued or in flight."""
+    """The engine stopped (or is draining) while the request was queued
+    or in flight."""
+
+
+class SchedulerStallError(ServingError):
+    """One scheduler iteration exceeded ``ServingConfig.step_timeout_s``;
+    the engine failed every outstanding future and restarted its loop
+    (bounded by ``max_scheduler_restarts``)."""
 
 
 @dataclass(frozen=True)
@@ -81,6 +88,17 @@ class ServingConfig:
                              deadlines are recorded but never enforced
     cache_dtype              KV-cache element type
     idle_wait_s              scheduler sleep when no work is queued
+    drain_grace_s            `drain()` deadline when none is passed: how
+                             long in-flight slots may run on before the
+                             engine shuts down anyway (the SIGTERM path)
+    step_timeout_s           scheduler-iteration watchdog budget: an
+                             iteration (prefills + one decode step)
+                             exceeding it fails every outstanding future
+                             with SchedulerStallError and restarts the
+                             loop; 0 (default) disables the watchdog
+    max_scheduler_restarts   bounded retries for the scheduler loop
+                             after a crash or stall before the engine
+                             gives up and stops accepting work
     """
 
     num_slots: int = 4
@@ -91,6 +109,9 @@ class ServingConfig:
     deadline_policy: str = "evict"
     cache_dtype: str = "float32"
     idle_wait_s: float = 0.005
+    drain_grace_s: float = 30.0
+    step_timeout_s: float = 0.0
+    max_scheduler_restarts: int = 2
 
     def validate(self):
         if self.num_slots < 1:
@@ -103,6 +124,15 @@ class ServingConfig:
             raise ValueError(
                 "deadline_policy must be 'evict' or 'ignore', got "
                 f"{self.deadline_policy!r}")
+        if self.drain_grace_s < 0:
+            raise ValueError(f"drain_grace_s must be >= 0, got "
+                             f"{self.drain_grace_s}")
+        if self.step_timeout_s < 0:
+            raise ValueError(f"step_timeout_s must be >= 0, got "
+                             f"{self.step_timeout_s}")
+        if self.max_scheduler_restarts < 0:
+            raise ValueError(f"max_scheduler_restarts must be >= 0, "
+                             f"got {self.max_scheduler_restarts}")
         return self
 
 

@@ -33,7 +33,7 @@ import numpy as np
 from . import stats
 from .api import (DeadlineExceededError, EngineShutdownError,
                   QueueFullError, RequestOutput, SamplingParams,
-                  ServingConfig)
+                  SchedulerStallError, ServingConfig)
 from .kv_slots import SlotKVCache
 
 
@@ -76,12 +76,30 @@ class Engine:
                                  self.cfg.num_heads)
         self._queue: deque[_Request] = deque()
         self._active: dict[int, _Request] = {}
-        self._lock = threading.Lock()
+        # EVERY unresolved request, from submit() until its future
+        # resolves — the audit set _fail_all drains.  A request can be
+        # outside both _queue and _active (popped for admission, prefill
+        # not yet finished); without this registry a scheduler crash in
+        # that window would leave its client blocked forever.
+        self._pending: dict[int, _Request] = {}
+        # RLock: _fail/_complete pop the pending registry under the lock
+        # and are reached from paths that already hold it (the queue
+        # expiry sweep runs inside the admission critical section)
+        self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
         self._running = False
+        self._draining = False
         self._thread = None
         self._ids = itertools.count()
         self.cache = None
+        # scheduler-thread watchdog state (step_timeout_s > 0)
+        self._sched_tid = None
+        self._iter_deadline = None
+        self._restarts = 0
+        self._monitor = None
+        self._monitor_stop = threading.Event()
+        self._stall_swept = False
+        self._preemption_handler = None
 
     # ---------------- lifecycle ----------------
     def start(self):
@@ -96,9 +114,18 @@ class Engine:
                 self._kv_heads, self.cfg.head_dim,
                 dtype=self.scfg.cache_dtype)
             self._running = True
+            self._draining = False
+            self._restarts = 0
+            self._stall_swept = False
         self._thread = threading.Thread(
             target=self._loop, name="paddle-tpu-serving", daemon=True)
         self._thread.start()
+        if self.scfg.step_timeout_s > 0:
+            self._monitor_stop.clear()
+            self._monitor = threading.Thread(
+                target=self._stall_monitor,
+                name="paddle-tpu-serving-watchdog", daemon=True)
+            self._monitor.start()
         return self
 
     def shutdown(self, wait_s=30.0):
@@ -107,6 +134,7 @@ class Engine:
         with self._work:
             self._running = False
             self._work.notify_all()
+        self._monitor_stop.set()
         t = self._thread
         if t is not None:
             t.join(wait_s)
@@ -115,9 +143,63 @@ class Engine:
                     "serving scheduler thread failed to stop within "
                     f"{wait_s}s")
         self._thread = None
+        m = self._monitor
+        if m is not None:
+            m.join(wait_s)
+            self._monitor = None
         # the loop's finally already failed everything; this covers a
         # shutdown() racing a never-started or crashed loop
         self._fail_all(EngineShutdownError("engine shut down"))
+
+    def drain(self, deadline_s=None):
+        """Graceful shutdown (the preemption/SIGTERM path): stop
+        admissions immediately, fail every still-queued request with
+        `EngineShutdownError`, let the slots already decoding run to
+        completion within `deadline_s` (default
+        `ServingConfig.drain_grace_s`), then shut the engine down —
+        whatever is still unfinished at the deadline fails like a normal
+        shutdown.  Idempotent; safe from any thread."""
+        deadline_s = self.scfg.drain_grace_s if deadline_s is None \
+            else float(deadline_s)
+        with self._work:
+            if not self._running:
+                return
+            already = self._draining
+            self._draining = True
+            queued = list(self._queue)
+            self._queue.clear()
+            stats.set_value("queue_depth", 0)
+            self._work.notify_all()
+        if already:
+            return
+        from ..observability import flight_recorder as _fr
+        _fr.record("serving", "drain_begin", queued=len(queued),
+                   active=len(self._active),
+                   deadline_s=round(deadline_s, 3))
+        for req in queued:
+            self._fail(req, EngineShutdownError(
+                f"engine draining: request {req.id} was still queued"))
+            stats.incr("requests_cancelled_drain")
+        deadline = time.monotonic() + deadline_s
+        while self._active and time.monotonic() < deadline:
+            time.sleep(0.01)
+        _fr.record("serving", "drain_end",
+                   unfinished=len(self._active))
+        self.shutdown()
+
+    def install_preemption_drain(self, handler=None, deadline_s=None):
+        """Wire `drain()` to the preemption notice: when SIGTERM (the
+        TPU-pod eviction warning) arrives, the engine stops admitting,
+        finishes in-flight requests within `deadline_s`, and fails the
+        queue — instead of dying mid-token.  Installs a fresh
+        `PreemptionHandler` when none is passed; returns the handler so
+        training/serving co-located code can share it."""
+        from ..distributed.fleet.elastic import PreemptionHandler
+        if handler is None:
+            handler = PreemptionHandler().install()
+        handler.add_callback(lambda: self.drain(deadline_s))
+        self._preemption_handler = handler
+        return handler
 
     def __enter__(self):
         return self.start()
@@ -154,6 +236,10 @@ class Engine:
             if not self._running:
                 raise EngineShutdownError(
                     "engine is not running (call start())")
+            if self._draining:
+                raise EngineShutdownError(
+                    "engine is draining (preemption notice); not "
+                    "accepting new requests")
             if len(self._queue) >= self.scfg.max_queue:
                 stats.incr("requests_rejected_queue_full")
                 raise QueueFullError(
@@ -161,6 +247,7 @@ class Engine:
                     "waiting); retry later or raise "
                     "ServingConfig.max_queue")
             self._queue.append(req)
+            self._pending[req.id] = req
             stats.incr("requests_submitted")
             stats.set_value("queue_depth", len(self._queue))
             self._work.notify()
@@ -179,33 +266,109 @@ class Engine:
 
     # ---------------- scheduler ----------------
     def _loop(self):
-        from ..core.state import no_grad
+        """Restart wrapper: a crashed or stalled iteration fails every
+        outstanding future (clients always see the real error, never a
+        silent hang) and the loop restarts with a fresh slot cache, up
+        to `max_scheduler_restarts` times."""
+        self._sched_tid = threading.get_ident()
         try:
-            with no_grad():
-                while True:
+            while True:
+                try:
+                    self._loop_once()
+                    return                       # clean shutdown
+                except BaseException as exc:
                     with self._work:
-                        if not self._running:
-                            break
-                        self._expire_queued_locked()
-                        admits = []
-                        while self._queue and self.cache.free_slots:
-                            slot = self.cache.allocate()
-                            admits.append((self._queue.popleft(), slot))
-                        stats.set_value("queue_depth", len(self._queue))
-                        if not admits and not self._active:
-                            self._work.wait(self.scfg.idle_wait_s)
-                            continue
-                    for req, slot in admits:
-                        self._prefill(req, slot)
-                    if self._active:
-                        self._decode_step()
-        except BaseException as exc:    # never die silently: fail the
-            self._fail_all(exc)         # futures so clients see it
-            raise
+                        running = self._running
+                    if not running:
+                        return                   # shutdown racing a crash
+                    # never die silently: fail the futures so clients
+                    # see the real error.  EXCEPT when the stall monitor
+                    # already swept — a request submitted between that
+                    # sweep and this unwind is healthy work for the
+                    # restarted loop, not part of the stalled batch.
+                    swept, self._stall_swept = self._stall_swept, False
+                    if not (swept and
+                            isinstance(exc, SchedulerStallError)):
+                        self._fail_all(exc)
+                    stats.incr("scheduler_restarts")
+                    from ..observability import flight_recorder as _fr
+                    _fr.record("serving", "scheduler_restart",
+                               error=type(exc).__name__,
+                               restarts=self._restarts + 1)
+                    if self._restarts >= self.scfg.max_scheduler_restarts:
+                        with self._work:
+                            self._running = False
+                        raise
+                    self._restarts += 1
+                    # the crash may have left slots torn mid-write:
+                    # rebuild rather than trust them
+                    self.cache = SlotKVCache(
+                        self.cfg.num_layers, self.scfg.num_slots,
+                        self.max_len, self._kv_heads,
+                        self.cfg.head_dim,
+                        dtype=self.scfg.cache_dtype)
         finally:
             self._fail_all(EngineShutdownError("engine shut down"))
             stats.set_value("active_slots", 0)
             stats.set_value("queue_depth", 0)
+
+    def _loop_once(self):
+        from ..core.state import no_grad
+        budget = self.scfg.step_timeout_s
+        with no_grad():
+            while True:
+                with self._work:
+                    if not self._running:
+                        break
+                    self._expire_queued_locked()
+                    admits = []
+                    while self._queue and self.cache.free_slots:
+                        slot = self.cache.allocate()
+                        admits.append((self._queue.popleft(), slot))
+                    stats.set_value("queue_depth", len(self._queue))
+                    if not admits and not self._active:
+                        self._iter_deadline = None
+                        self._work.wait(self.scfg.idle_wait_s)
+                        continue
+                if budget > 0:
+                    self._iter_deadline = time.monotonic() + budget
+                for req, slot in admits:
+                    self._prefill(req, slot)
+                if self._active:
+                    self._decode_step()
+                self._iter_deadline = None
+
+    def _stall_monitor(self):
+        """Scheduler-iteration watchdog (armed by step_timeout_s > 0):
+        when one iteration blows its budget, fail every outstanding
+        future RIGHT NOW (clients unblock even if the scheduler is
+        wedged inside a compiled step) and async-raise into the
+        scheduler thread so the restart wrapper rebuilds the loop."""
+        budget = self.scfg.step_timeout_s
+        poll = max(min(budget / 4.0, 0.25), 0.005)
+        while not self._monitor_stop.wait(poll):
+            deadline = self._iter_deadline
+            if deadline is None or time.monotonic() < deadline:
+                continue
+            self._iter_deadline = None
+            exc = SchedulerStallError(
+                f"scheduler iteration exceeded its "
+                f"step_timeout_s={budget:g}s budget; failing all "
+                "outstanding requests and restarting the decode loop")
+            stats.incr("scheduler_stalls")
+            from ..distributed.watchdog import (all_thread_stacks,
+                                                async_raise)
+            from ..observability import flight_recorder as _fr
+            _fr.record("serving", "scheduler_stall", budget_s=budget)
+            _fr.dump(reason="serving-stall", error=exc, once=True,
+                     extra={"stall": {
+                         "op": "serving::step", "seq": None,
+                         "budget_s": budget,
+                         "threads": all_thread_stacks()}})
+            self._stall_swept = True
+            self._fail_all(exc)
+            if self._sched_tid is not None:
+                async_raise(self._sched_tid, SchedulerStallError)
 
     def _expire_queued_locked(self):
         if self.scfg.deadline_policy != "evict":
@@ -331,8 +494,13 @@ class Engine:
             output_ids=np.asarray(req.tokens, np.int32),
             finish_reason=reason, ttft_ms=req.ttft_ms,
             latency_ms=(now - req.submit_t) * 1e3)
-        if not req.future.done():
-            req.future.set_result(out)
+        with self._lock:
+            self._pending.pop(req.id, None)
+        try:
+            if not req.future.done():
+                req.future.set_result(out)
+        except Exception:       # lost the race to a concurrent _fail
+            return
         stats.incr("requests_completed")
         # labeled by the same request_id the span args carry, so one
         # request's trace and metrics can be joined post-hoc
@@ -345,11 +513,17 @@ class Engine:
                    if req.ttft_ms is not None else None)
 
     def _fail(self, req, exc):
-        if not req.future.done():
+        with self._lock:
+            self._pending.pop(req.id, None)
+        try:
+            if req.future.done():
+                return
             req.future.set_exception(exc)
-            from ..observability import flight_recorder as _fr
-            _fr.record("serving", "request_failed", request_id=req.id,
-                       error=type(exc).__name__)
+        except Exception:       # resolved by a concurrent completer
+            return
+        from ..observability import flight_recorder as _fr
+        _fr.record("serving", "request_failed", request_id=req.id,
+                   error=type(exc).__name__)
 
     def _release(self, req):
         if req.slot is not None and req.slot in self._active:
@@ -358,12 +532,16 @@ class Engine:
             req.slot = None
 
     def _fail_all(self, exc):
+        """Fail EVERY outstanding future — queued, mid-admission, and
+        slot-resident alike (the `_pending` registry is the audit set;
+        `_queue` + `_active` alone would miss a request popped for
+        admission whose prefill never finished)."""
         with self._lock:
-            queued = list(self._queue)
+            reqs = list(self._pending.values())
+            self._pending.clear()
             self._queue.clear()
-            active = list(self._active.values())
             self._active.clear()
-        for req in queued + active:
+        for req in reqs:
             if not req.future.done():
                 self._fail(req, exc)
                 stats.incr("requests_cancelled_shutdown")

@@ -83,6 +83,9 @@ def serving_stats():
         "requests_rejected_queue_full": g("requests_rejected_queue_full"),
         "requests_evicted_deadline": g("requests_evicted_deadline"),
         "requests_cancelled_shutdown": g("requests_cancelled_shutdown"),
+        "requests_cancelled_drain": g("requests_cancelled_drain"),
+        "scheduler_restarts": g("scheduler_restarts"),
+        "scheduler_stalls": g("scheduler_stalls"),
         "tokens_generated": tokens,
         "prefill_steps": g("prefill_steps"),
         "decode_steps": g("decode_steps"),

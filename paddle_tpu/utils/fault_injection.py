@@ -34,6 +34,14 @@ KNOWN_POINTS = {
     "ckpt_write": {"after_bytes": int, "mode": str, "file": str,
                    "exit": int},
     "step": {"crash_at": int, "sigterm_at": int, "exit": int},
+    # hang-guardian drills (distributed/watchdog.py, docs/RESILIENCE.md).
+    # Both filter on op name / per-group collective sequence / global
+    # rank; `once_file` makes the injection fire once per path (the file
+    # is created on first fire), so a relaunched incarnation survives.
+    "collective_delay": {"op": str, "at_seq": int, "delay_s": float,
+                         "rank": int, "once_file": str},
+    "rank_crash": {"op": str, "at_seq": int, "rank": int, "exit": int,
+                   "mode": str, "once_file": str},
 }
 
 _IDENT = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")

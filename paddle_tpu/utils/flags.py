@@ -57,6 +57,33 @@ _FLAGS: dict[str, Any] = {
     # where the flight recorder dumps on crash/SIGTERM; empty = a
     # flight_recorder.<pid>.json file in the current directory.
     "FLAGS_flight_recorder_path": "",
+    # hang guardian (distributed/watchdog.py, docs/RESILIENCE.md).
+    # A collective stuck longer than this triggers a stall dump and a
+    # CollectiveTimeoutError naming the op, per-group sequence number,
+    # and the ranks that never arrived.  0 (default) disables the
+    # watchdog entirely — the collective path pays a few dict lookups.
+    "FLAGS_collective_timeout_s": 0.0,
+    # stall-dump destination (all-thread stacks + last-N collectives +
+    # metrics snapshot).  Empty = stall_dump.<pid>.json in the working
+    # directory; multi-rank jobs insert ".rank<R>" before the extension.
+    "FLAGS_stall_dump_path": "",
+    # after the stall dump + async abort, a thread still wedged outside
+    # the interpreter (a real cross-process transfer) is hard-exited so
+    # the controller can reap the rank.  Tests set this False to keep a
+    # deliberately-stalled pytest process alive.
+    "FLAGS_collective_hard_abort": True,
+    # eager collective backend (distributed/collective.py): "auto" runs
+    # the XLA cross-process program and falls back to host-mediated
+    # collectives (host_collectives.py, the ProcessGroupGloo analog)
+    # when the backend cannot execute multiprocess programs; "xla" and
+    # "host" pin a lane.
+    "FLAGS_collective_backend": "auto",
+    # desync detector sampling: every N-th collective per group reads
+    # peers' arrival records from the guardian store and raises
+    # DesyncError on an op mismatch at the same sequence number.
+    # 0 disables the proactive check (arrival records are still written
+    # whenever a guardian store is configured — stall blame needs them).
+    "FLAGS_desync_check_every": 16,
 }
 
 

@@ -1,0 +1,103 @@
+"""Hang-guardian drill worker (docs/RESILIENCE.md).
+
+A 2-process data-parallel training loop with real cross-process
+collectives: per-step gradients are all-reduced, rank 0 checkpoints each
+step through CheckpointManager, and both ranks record their local loss
+trajectory.  Guardian fault points drive the drills:
+
+- ``FLAGS_fault_inject=collective_delay:op=all_reduce,at_seq=N,delay_s=...,rank=1``
+  stalls rank 1 inside collective N; rank 0 blocks in the matching
+  all_reduce until its watchdog times out, writes the stall dump, blames
+  rank 1, and aborts (the hang drill).
+- ``FLAGS_fault_inject=rank_crash:at_seq=N,rank=1,once_file=...`` kills
+  rank 1 mid-step after recording its error in the trap; rank 0's
+  watchdog aborts its blocked collective with rank 1's ORIGINAL error
+  and exits ELASTIC_EXIT_CODE, the controller relaunches, and the run
+  resumes from the last checkpoint — the loss trajectory must equal an
+  uninterrupted run's.
+
+Each incarnation appends its starting step to ``incarnations.{rank}.log``;
+a completed run writes ``losses.{rank}.json``.
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+# rendezvous must precede ANY backend touch (paddle_tpu import probes
+# devices for dtype defaults)
+jax.distributed.initialize(
+    coordinator_address=os.environ["PADDLE_MASTER"],
+    num_processes=int(os.environ["WORLD_SIZE"]),
+    process_id=int(os.environ["PADDLE_TRAINER_ID"]))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.framework.checkpoint_manager import CheckpointManager  # noqa: E402
+
+TOTAL_STEPS = 6
+
+
+def main():
+    outdir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    assert dist.get_world_size() == 2
+
+    mgr = CheckpointManager(os.path.join(outdir, "ckpts"), max_to_keep=3)
+
+    paddle.seed(7)
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+
+    start_step, losses = 0, []
+    restored = mgr.restore_latest()
+    if restored is not None:
+        state, _step = restored
+        model.set_state_dict(state["model"])
+        opt.set_state_dict(state["optimizer"])
+        start_step = int(state["step"]) + 1
+        losses = list(state["losses"])
+
+    with open(os.path.join(outdir, f"incarnations.{rank}.log"), "a") as f:
+        f.write(f"{start_step}\n")
+
+    for step in range(start_step, TOTAL_STEPS):
+        # data keyed by (step, rank) only, so a resumed incarnation
+        # replays the identical batch
+        rng = np.random.default_rng(1000 * step + rank)
+        x = paddle.to_tensor(rng.standard_normal((4, 4)).astype("float32"))
+        y = paddle.to_tensor(rng.standard_normal((4, 2)).astype("float32"))
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        for p in model.parameters():
+            dist.all_reduce(p.grad, op=dist.ReduceOp.AVG)
+        opt.step()
+        opt.clear_grad()
+        # record the GLOBAL mean loss: identical on every rank, so the
+        # checkpointed trajectory restores correctly on either one
+        lt = paddle.to_tensor(
+            np.array([float(loss.numpy())], np.float32))
+        dist.all_reduce(lt, op=dist.ReduceOp.AVG)
+        losses.append(round(float(np.asarray(lt._data_)[0]), 6))
+
+        if rank == 0:
+            mgr.save({"model": model.state_dict(),
+                      "optimizer": opt.state_dict(),
+                      "step": step, "losses": losses}, step=step)
+            mgr.wait()
+        dist.barrier()
+
+    with open(os.path.join(outdir, f"losses.{rank}.json"), "w") as f:
+        json.dump(losses, f)
+    print(f"[rank {rank}] guardian worker finished {TOTAL_STEPS} steps")
+
+
+if __name__ == "__main__":
+    main()

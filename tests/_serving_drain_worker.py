@@ -1,0 +1,105 @@
+"""Serving graceful-drain drill worker (docs/RESILIENCE.md).
+
+Runs a tiny deterministic fake model through the real Engine, fills both
+slots with long-running requests plus a queued backlog, then delivers
+SIGTERM to itself.  The PreemptionHandler-wired drain must let the
+in-flight slots decode to completion, fail every queued request with
+EngineShutdownError, and reject new admissions — results recorded to
+``drain.json`` for the test to assert.
+"""
+import json
+import os
+import signal
+import sys
+import time
+from types import SimpleNamespace
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.core.tensor import Tensor  # noqa: E402
+from paddle_tpu.serving import (  # noqa: E402
+    Engine, EngineShutdownError, ServingConfig, serving_stats,
+)
+
+VOCAB = 32
+
+
+class TinyModel:
+    """Deterministic next-token = (last + 1) % VOCAB, ~20 tokens/s per
+    step so the drain has visible in-flight work."""
+
+    config = SimpleNamespace(num_layers=1, num_heads=1, num_kv_heads=1,
+                             head_dim=4, max_seq_len=128, vocab_size=VOCAB)
+
+    def eval(self):
+        return self
+
+    def __call__(self, tokens, caches=None):
+        tok = np.asarray(tokens._data_)
+        batch, seqlen = tok.shape
+        logits = np.zeros((batch, seqlen, VOCAB), np.float32)
+        logits[np.arange(batch), -1, (tok[:, -1] + 1) % VOCAB] = 10.0
+        time.sleep(0.05)
+        return Tensor(logits)
+
+
+def _result(fut, timeout=60.0):
+    from concurrent.futures import TimeoutError as FutTimeout
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return fut.result(timeout=0.2)
+        except (TimeoutError, FutTimeout):
+            if time.monotonic() > deadline:
+                raise
+
+
+def main():
+    outdir = sys.argv[1]
+    eng = Engine(TinyModel(), ServingConfig(
+        num_slots=2, max_queue=8, default_max_new_tokens=30,
+        drain_grace_s=30.0)).start()
+    eng.install_preemption_drain()
+
+    prompt = np.arange(1, 4, dtype=np.int32)
+    inflight = [eng.submit(prompt, max_new_tokens=30) for _ in range(2)]
+    t0 = time.monotonic()
+    while serving_stats()["active_slots"] < 2 and \
+            time.monotonic() - t0 < 30:
+        time.sleep(0.01)
+    queued = [eng.submit(prompt, max_new_tokens=30) for _ in range(3)]
+
+    os.kill(os.getpid(), signal.SIGTERM)
+
+    results = {"completed": 0, "queued_failed": 0,
+               "rejected_after_drain": 0, "tokens": [],
+               "inflight_errors": [], "queued_errors": []}
+    for f in inflight:
+        try:
+            out = _result(f)
+            results["completed"] += 1
+            results["tokens"].append(int(out.output_ids.size))
+        except Exception as e:
+            results["inflight_errors"].append(type(e).__name__)
+    for f in queued:
+        try:
+            _result(f)
+        except EngineShutdownError:
+            results["queued_failed"] += 1
+        except Exception as e:
+            results["queued_errors"].append(type(e).__name__)
+    try:
+        eng.submit(prompt)
+    except EngineShutdownError:
+        results["rejected_after_drain"] = 1
+
+    with open(os.path.join(outdir, "drain.json"), "w") as f:
+        json.dump(results, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -128,6 +128,58 @@ def check_snapshots(path):
     return n, errors
 
 
+_STALL_THREAD_KEYS = ("name", "stack")
+
+
+def check_stall_dump(path):
+    """Validate a collective-watchdog stall dump (ISSUE 5 CI satellite):
+    the guardian's post-mortem must parse and carry all-thread stacks,
+    the blamed op/seq, and the missing-rank list — a malformed dump is
+    a debugging session lost at 3am."""
+    errors = []
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable stall dump: {e}"]
+    if data.get("reason") not in ("stall", "serving-stall"):
+        errors.append(f"{path}: reason is {data.get('reason')!r}, "
+                      "expected 'stall' or 'serving-stall'")
+    if not isinstance(data.get("events"), list):
+        errors.append(f"{path}: missing events list")
+    if "metrics" not in data:
+        errors.append(f"{path}: missing metrics snapshot")
+    stall = data.get("stall")
+    if not isinstance(stall, dict):
+        return errors + [f"{path}: missing 'stall' section"]
+    if not isinstance(stall.get("op"), str) or not stall["op"]:
+        errors.append(f"{path}: stall.op missing/empty")
+    threads = stall.get("threads")
+    if not isinstance(threads, list) or not threads:
+        errors.append(f"{path}: stall.threads missing/empty (the "
+                      "all-thread stacks ARE the dump)")
+    else:
+        for i, t in enumerate(threads):
+            for key in _STALL_THREAD_KEYS:
+                if key not in (t or {}):
+                    errors.append(
+                        f"{path}: stall.threads[{i}] missing {key!r}")
+            if not isinstance((t or {}).get("stack"), list) or \
+                    not t.get("stack"):
+                errors.append(f"{path}: stall.threads[{i}].stack empty")
+    if data.get("reason") == "stall":
+        for key, types in (("seq", int), ("group_ranks", list),
+                           ("missing_ranks", list),
+                           ("waited_s", (int, float)),
+                           ("timeout_s", (int, float)),
+                           ("recent_collectives", list),
+                           ("rank", int)):
+            if not isinstance(stall.get(key), types):
+                errors.append(f"{path}: stall.{key} missing or not "
+                              f"{types}")
+    return errors
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--prometheus", help="Prometheus text dump to check")
@@ -135,9 +187,12 @@ def main():
                     help="MetricsExporter jsonl file to check")
     ap.add_argument("--require-series", nargs="*", default=[],
                     help="sanitized series names that must be present")
+    ap.add_argument("--stall-dump",
+                    help="collective-watchdog stall dump JSON to check")
     args = ap.parse_args()
-    if not args.prometheus and not args.snapshots:
-        ap.error("nothing to check: pass --prometheus and/or --snapshots")
+    if not args.prometheus and not args.snapshots and not args.stall_dump:
+        ap.error("nothing to check: pass --prometheus, --snapshots "
+                 "and/or --stall-dump")
 
     failures = []
     if args.prometheus:
@@ -157,6 +212,16 @@ def main():
         failures += errors
         if not errors:
             print(f"snapshots OK: {n} line(s)")
+    if args.stall_dump:
+        errors = check_stall_dump(args.stall_dump)
+        failures += errors
+        if not errors:
+            with open(args.stall_dump) as f:
+                stall = json.load(f)["stall"]
+            print(f"stall dump OK: op={stall.get('op')!r} "
+                  f"seq={stall.get('seq')} "
+                  f"missing_ranks={stall.get('missing_ranks')} "
+                  f"{len(stall.get('threads') or [])} thread stack(s)")
 
     if failures:
         print("telemetry check FAILED:")

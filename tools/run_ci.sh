@@ -135,6 +135,58 @@ print(f"flight recorder OK: {len(data['events'])} events, "
       f"reason={data['reason']}")
 EOF
 
+echo "== hang drill (collective_delay -> blamed timeout + stall dump) =="
+rm -rf /tmp/pt_hang_drill
+mkdir -p /tmp/pt_hang_drill/out /tmp/pt_hang_drill/logs
+drill_start=$(date +%s)
+set +e
+FLAGS_collective_timeout_s=3 \
+FLAGS_stall_dump_path=/tmp/pt_hang_drill/stall.json \
+FLAGS_flight_recorder_path=/tmp/pt_hang_drill/flightrec.json \
+FLAGS_fault_inject="collective_delay:op=all_reduce,at_seq=6,delay_s=300,rank=1" \
+PADDLE_GUARDIAN_TERM_GRACE_S=5 \
+timeout -k 10 120 python -m paddle_tpu.distributed.launch \
+    --nproc_per_node 2 --max_restart 0 \
+    --log_dir /tmp/pt_hang_drill/logs \
+    tests/_guardian_worker.py /tmp/pt_hang_drill/out
+drill_rc=$?
+set -e
+drill_elapsed=$(( $(date +%s) - drill_start ))
+# the job must FAIL (not hang to the harness timeout, not succeed)
+if [ "$drill_rc" -eq 0 ] || [ "$drill_rc" -ge 124 ]; then
+    echo "hang drill FAILED: rc=$drill_rc (expected fast guardian abort)"
+    exit 1
+fi
+grep -q "CollectiveTimeoutError" /tmp/pt_hang_drill/logs/worker.*.log
+grep -q "all_reduce" /tmp/pt_hang_drill/logs/worker.*.log
+# stall dump: schema-valid, blamed op/rank, detection < 2x the timeout
+python tools/check_telemetry.py \
+    --stall-dump /tmp/pt_hang_drill/stall.rank0.json
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/pt_hang_drill/stall.rank0.json"))
+s = d["stall"]
+assert s["op"] == "all_reduce" and s["missing_ranks"] == [1], s
+assert s["waited_s"] < 2 * s["timeout_s"], \
+    f"detection took {s['waited_s']}s vs timeout {s['timeout_s']}s"
+print(f"hang drill OK: blamed {s['op']!r} seq {s['seq']} missing "
+      f"ranks {s['missing_ranks']}, detected in {s['waited_s']}s")
+EOF
+echo "hang drill total wall time: ${drill_elapsed}s (rc=$drill_rc)"
+
+echo "== serving graceful-drain drill (SIGTERM -> finish in-flight, fail queue) =="
+rm -rf /tmp/pt_drain_drill && mkdir -p /tmp/pt_drain_drill
+FLAGS_flight_recorder_path=/tmp/pt_drain_drill/flightrec.json \
+    python tests/_serving_drain_worker.py /tmp/pt_drain_drill
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/pt_drain_drill/drain.json"))
+assert d["completed"] == 2 and d["tokens"] == [30, 30], d
+assert d["queued_failed"] == 3 and d["rejected_after_drain"] == 1, d
+print(f"serving drain OK: {d['completed']} in-flight completed, "
+      f"{d['queued_failed']} queued failed, admissions closed")
+EOF
+
 echo "== TPU run-log audit =="
 python tools/validate_tpu_runs.py
 
