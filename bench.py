@@ -78,17 +78,20 @@ def _relay_up():
     """Preflight: the axon claim rides a local relay to the pool
     (PALLAS_AXON_POOL_IPS).  Loopback-mode relays (AXON_LOOPBACK_RELAY=1)
     expose NO TCP listener on the historical relay ports, so a port scan
-    alone cannot decide — a successful claim probe is authoritative.  A
-    transiently-dead relay at driver capture time must not erase the
-    round's hardware evidence, so poll for a window (BENCH_RELAY_WAIT
-    seconds, default 5 min) before surrendering to the CPU smoke."""
+    alone cannot decide — a successful claim probe is authoritative.
+
+    A dead relay must fail FAST: one port scan + one short claim probe,
+    then surrender to the CPU smoke (lanes r02-r05 each burned ~300 s
+    polling a relay that never came back).  Operators who expect a
+    transient relay outage at capture time can opt back into a polling
+    window with BENCH_RELAY_WAIT=<seconds> (the old default was 300)."""
     import socket
     pool = os.environ.get("PALLAS_AXON_POOL_IPS", "")
     if not pool:
         return True  # no relay configured; let the probe decide
     host = pool.split(",")[0]
     ports = (8082, 8083, 8087, 8092)
-    wait = float(os.environ.get("BENCH_RELAY_WAIT", "300"))
+    wait = float(os.environ.get("BENCH_RELAY_WAIT", "0"))
     deadline = time.monotonic() + wait
     attempt = 0
     while True:
@@ -105,7 +108,16 @@ def _relay_up():
             if attempt > 1:
                 _log(f"relay came up on attempt {attempt}")
             return "ports"
-        ok, _detail = _probe_once(90)
+        loopback = os.environ.get("AXON_LOOPBACK_RELAY", "") == "1"
+        if not loopback and wait <= 0:
+            # a non-loopback relay always exposes a TCP listener, so a
+            # failed port scan is authoritative — skip even the claim
+            # probe and surrender to the CPU smoke NOW
+            _log(f"axon relay tunnel is DOWN (no listener on {host} "
+                 f"ports {ports}) — falling back to CPU smoke "
+                 "immediately.")
+            return False
+        ok, _detail = _probe_once(90 if wait > 0 else 45)
         if ok:
             if attempt > 1:
                 _log(f"relay came up on attempt {attempt}")
@@ -117,9 +129,10 @@ def _relay_up():
              f"claim probe failed); retrying for another "
              f"{remaining:.0f}s ...")
         time.sleep(min(15.0, max(remaining, 0.1)))
-    _log(f"axon relay tunnel is DOWN after {wait:.0f}s of polling: no "
-         f"listener on {host} ports {ports} and no claim granted — "
-         f"falling back to CPU smoke.")
+    _log(f"axon relay tunnel is DOWN (no listener on {host} ports "
+         f"{ports}, claim probe failed"
+         + (f" after {wait:.0f}s of polling" if wait > 0 else "")
+         + ") — falling back to CPU smoke immediately.")
     return False
 
 

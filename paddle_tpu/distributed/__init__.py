@@ -44,6 +44,16 @@ from .checkpoint import (  # noqa: F401
     save_state_dict, load_state_dict, DistributedSaver,
     CheckpointManager, save_checkpoint, restore_latest,
 )
+from .reshard import (  # noqa: F401 — elastic resize surface
+    MeshSpec, LayoutError, LayoutMismatchError, ShardedCheckpointer,
+    restore_resharded, restore_latest_resharded, offer_shards,
+)
+# importing .reshard above rebinds this package's `reshard` attribute to
+# the MODULE; the public paddle.distributed.reshard(tensor, mesh,
+# placements) API must stay the placement-move FUNCTION.  The elastic
+# module remains importable as `paddle_tpu.distributed.reshard` (import
+# statements resolve it through sys.modules, not this attribute).
+from .api import reshard  # noqa: F401,F811
 from . import launch  # noqa: F401
 from . import spawn as spawn_mod  # noqa: F401
 from .spawn import spawn  # noqa: F401

@@ -24,7 +24,88 @@ from ..framework.checkpoint_manager import (  # noqa: F401 — re-exported
     CheckpointManager, CheckpointError, read_manifest, scan_steps,
     step_dir_name, verify_checkpoint, write_manifest,
 )
+from .reshard import (  # noqa: F401 — re-exported
+    LAYOUT_VERSION, LayoutError, LayoutMismatchError, MeshSpec,
+    read_layout,
+)
 from ..utils.log import get_logger
+
+
+def _layout_from_arrays(arrays):
+    """The manifest layout section for a flat {key: jax.Array/ndarray}
+    dict: per-array global shape/dtype/partition read off each array's
+    committed NamedSharding (replicate for host arrays), plus the mesh
+    axes/shape and world size — the metadata a resized job needs to
+    validate (and the pickle-shard lane to reshard) on restore."""
+    from jax.sharding import NamedSharding
+    axes, shape = (), ()
+    for arr in arrays.values():
+        sh = getattr(arr, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh.axis_names:
+            axes = tuple(str(a) for a in sh.mesh.axis_names)
+            shape = tuple(int(s) for s in sh.mesh.devices.shape)
+            break
+    entries = {}
+    for key, arr in arrays.items():
+        ndim = len(getattr(arr, "shape", ()))
+        part = [None] * ndim
+        sh = getattr(arr, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            for d, entry in enumerate(sh.spec):
+                if entry is None:
+                    continue
+                names = entry if isinstance(entry, tuple) else (entry,)
+                part[d] = str(names[0]) if names else None
+        entries[key] = {
+            "global_shape": [int(s) for s in arr.shape],
+            "dtype": str(np.dtype(arr.dtype)) if not hasattr(
+                arr.dtype, "name") else str(arr.dtype),
+            "partition": part,
+        }
+    return {
+        "layout_version": LAYOUT_VERSION,
+        "format": "orbax",
+        "world_size": int(jax.process_count()),
+        "mesh": {"axes": list(axes), "shape": list(shape)},
+        "arrays": entries,
+    }
+
+
+def validate_layout(path, targets):
+    """Check a saved layout section against the restore targets (flat
+    {key: ShapeDtypeStruct-like}).  Missing layout (pre-elastic
+    checkpoint) passes — orbax validates shapes itself; a PRESENT layout
+    that disagrees on keys or global shapes raises
+    :class:`LayoutMismatchError` naming the saved vs requested layouts
+    instead of letting a wrong-topology restore load garbage."""
+    layout = read_layout(path)
+    if layout is None:
+        return None
+    saved = layout.get("arrays", {})
+    saved_mesh = layout.get("mesh", {})
+    mesh_str = "×".join(
+        f"{a}={s}" for a, s in zip(saved_mesh.get("axes", []),
+                                   saved_mesh.get("shape", [])))
+    missing = sorted(set(targets) - set(saved))
+    extra = sorted(set(saved) - set(targets))
+    if missing or extra:
+        raise LayoutMismatchError(
+            f"checkpoint {path} (saved on mesh {mesh_str or 'world=1'}, "
+            f"world={layout.get('world_size')}) does not match the "
+            f"requested state: missing keys {missing[:5]}, unexpected "
+            f"keys {extra[:5]}")
+    for key, meta in saved.items():
+        want = tuple(int(s) for s in targets[key].shape)
+        got = tuple(int(s) for s in meta["global_shape"])
+        if want != got:
+            raise LayoutMismatchError(
+                f"checkpoint {path}: array {key!r} was saved with global "
+                f"shape {list(got)} (mesh {mesh_str or 'world=1'}, "
+                f"partition {meta.get('partition')}, world="
+                f"{layout.get('world_size')}) but the requested layout "
+                f"wants {list(want)} — saved and requested layouts are "
+                "incompatible")
+    return layout
 
 
 def _ocp():
@@ -100,7 +181,7 @@ def save_state_dict(state_dict, path, process_group=None,
     ckptr.save(path, arrays, force=True)
     ckptr.wait_until_finished()
     if jax.process_index() == coordinator_rank:
-        write_manifest(path)
+        write_manifest(path, layout=_layout_from_arrays(arrays))
     return path
 
 
@@ -124,6 +205,7 @@ def load_state_dict(state_dict, path, process_group=None,
         else:
             a = np.asarray(v)
             targets[k] = jax.ShapeDtypeStruct(a.shape, a.dtype)
+    validate_layout(path, targets)
     restored = ckptr.restore(path, targets)
     return _restore_into(state_dict, restored)
 
@@ -167,6 +249,9 @@ def restore_latest(state_dict, root, process_group=None,
         try:
             load_state_dict(state_dict, path, process_group=process_group,
                             coordinator_rank=coordinator_rank)
+        except LayoutMismatchError:
+            raise      # incompatible topology: fail loudly, never fall
+            #            back to an older checkpoint silently
         except Exception as e:
             log.warning("distributed checkpoint %s failed to load (%s); "
                         "skipping", path, e)

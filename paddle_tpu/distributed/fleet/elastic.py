@@ -24,6 +24,48 @@ ELASTIC_EXIT_CODE = 101
 ELASTIC_TIMEOUT = 60
 
 
+def plan_topology(world_size, model_desc=None):
+    """dp×mp factorization for a (possibly resized) world — the elastic
+    relaunch path re-invokes the launch-level auto_tuner (predict mode:
+    roofline-ranked, no trial runs) exactly as the reference's elastic
+    manager re-plans after a membership change, so ``fit(resume=...)``
+    can reshard the checkpoint onto whatever the tuner picks for the new
+    world.  Falls back to pure data-parallel when the tuner has no
+    feasible candidate (tiny worlds, missing model description)."""
+    world_size = int(world_size)
+    try:
+        from ..auto_tuner.tuner import AutoTuner, TunerConfig
+        cfg = TunerConfig(n_devices=world_size, **(model_desc or {}))
+        # the elastic CPU/host lane replans dp×mp only; pp/sharding
+        # re-planning needs a program repartition, not just a reshard
+        cfg.pp_candidates = [1]
+        cfg.sharding_candidates = [1]
+        best = AutoTuner(cfg).tune(mode="predict")
+    except Exception:
+        best = None
+    if not best:
+        return {"dp": world_size, "mp": 1}
+    return {"dp": int(best["dp"]), "mp": int(best["mp"])}
+
+
+def reshard_mesh_for(world_size, model_desc=None):
+    """The target MeshSpec a resumed job reshards onto: the
+    ``PADDLE_RESHARD_MESH`` env override (JSON ``{"axes":..,"shape":..}``
+    exported by an operator or controller) wins; otherwise the
+    auto_tuner plan for ``world_size`` (a pure-dp mesh when mp=1)."""
+    import json as _json
+
+    from ..reshard import MeshSpec
+    raw = os.environ.get("PADDLE_RESHARD_MESH")
+    if raw:
+        obj = _json.loads(raw)
+        return MeshSpec(obj["axes"], obj["shape"])
+    plan = plan_topology(world_size, model_desc=model_desc)
+    if plan.get("mp", 1) > 1:
+        return MeshSpec(("dp", "mp"), (plan["dp"], plan["mp"]))
+    return MeshSpec(("dp",), (int(world_size),))
+
+
 class PreemptionHandler:
     """Cooperative preemption: catch SIGTERM (the preemptible-TPU-pod
     eviction notice) and let the training loop checkpoint at the next

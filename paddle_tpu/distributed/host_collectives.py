@@ -120,10 +120,14 @@ class HostCollectives:
     def _key(self, gid, seq, rank):
         return f"{self.job}/hc/g{gid}/s{seq}/r{rank}"
 
-    def gather(self, group, local, poll_s=0.005):
+    def gather(self, group, local, poll_s=0.005, rank=None):
         """Post this rank's array, block until every group member's
         contribution for the same per-group sequence number arrives,
         return them stacked ``[nranks, ...]`` in group order.
+
+        ``rank`` overrides the ambient process index — launched workers
+        that never initialize jax.distributed (the pickle/CPU lane, e.g.
+        the elastic resize drill) pass their PADDLE_TRAINER_ID here.
 
         The wait polls in small sleeps — deliberately interpreter-level,
         so the collective watchdog can abort it when a peer is dead."""
@@ -133,7 +137,7 @@ class HostCollectives:
             seq = self._seq.get(gid, 0)
             self._seq[gid] = seq + 1
         local = np.asarray(local)
-        me = _env.get_rank()
+        me = _env.get_rank() if rank is None else int(rank)
         buf = io.BytesIO()
         np.save(buf, local, allow_pickle=False)
         self.store.set(self._key(gid, seq, me), buf.getvalue())

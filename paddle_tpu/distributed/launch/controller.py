@@ -85,6 +85,7 @@ class CollectiveController:
         env = self.ctx.proc_env(local_rank, self.master,
                                 rank=rank, world=world)
         env.update(self._guardian_env())
+        env.update(getattr(self, "_extra_env", {}))
         cmd = [sys.executable, args.training_script,
                *args.training_script_args]
         stdout = stderr = None
@@ -238,6 +239,7 @@ class ElasticCollectiveController(CollectiveController):
         restarts = 0
         level = _fault_level()
         self.kv.start_heartbeat()
+        prev_world = None
         try:
             while True:
                 self.kv.clear_errors()
@@ -246,6 +248,19 @@ class ElasticCollectiveController(CollectiveController):
                     quiet=args.elastic_quiet)
                 offset = sum(p["np"] for p in pods[:my_idx])
                 world = sum(p["np"] for p in pods)
+                self._extra_env = {}
+                if prev_world is not None and world != prev_world:
+                    # elastic resize: tell the relaunched workers what
+                    # changed so resume logs/reshards knowingly (the
+                    # checkpoint layout, not this env, drives the actual
+                    # reshard — see distributed/reshard.py)
+                    sys.stderr.write(
+                        f"[launch] elastic resize: world {prev_world} -> "
+                        f"{world}; workers will reshard on resume\n")
+                    sys.stderr.flush()
+                    self._extra_env["PADDLE_ELASTIC_RESIZED"] = \
+                        f"{prev_world}:{world}"
+                prev_world = world
                 self.procs = [
                     self._spawn_one(i, rank=offset + i, world=world)
                     for i in range(args.nproc_per_node)]

@@ -157,6 +157,7 @@ def stall_dump_path(rank=None):
     rank = _guardian_rank() if rank is None else rank
     if not p:
         return os.path.join(os.getcwd(),
+                            str(_flag("FLAGS_dump_dir") or "."),
                             f"stall_dump.{os.getpid()}.json")
     root, ext = os.path.splitext(p)
     return f"{root}.rank{rank}{ext or '.json'}"

@@ -59,11 +59,14 @@ def _crc32_file(path, chunk=1 << 20):
 
 
 def write_manifest(dirpath, step=None, meta=None, files=None,
-                   manifest_path=None):
+                   manifest_path=None, layout=None):
     """Commit ``dirpath``: record size + crc32 of every payload file and
     os.replace the manifest into place.  ``manifest_path`` may point the
     manifest OUTSIDE the directory (sidecar marker) for formats that
-    refuse foreign files in their tree (orbax)."""
+    refuse foreign files in their tree (orbax).  ``layout`` attaches the
+    shard-layout section (per-array global shape/dtype/partition + mesh +
+    per-rank shard files — see ``distributed/reshard.py``) that lets a
+    resized job reshard this checkpoint on restore."""
     if files is None:
         files = []
         for base, _dirs, names in os.walk(dirpath):
@@ -83,6 +86,8 @@ def write_manifest(dirpath, step=None, meta=None, files=None,
         manifest["step"] = int(step)
     if meta:
         manifest["meta"] = meta
+    if layout:
+        manifest["layout"] = layout
     target = manifest_path or os.path.join(dirpath, MANIFEST_NAME)
     tmp = target + f".tmp.{os.getpid()}"
     with open(tmp, "w") as f:
@@ -175,10 +180,13 @@ class CheckpointManager:
         os.makedirs(self.root, exist_ok=True)
 
     # ---- save ----
-    def save(self, state, step=None, meta=None):
+    def save(self, state, step=None, meta=None, layout=None):
         """Checkpoint ``state`` under step number ``step`` (default: one
-        past the newest existing step).  Returns the committed directory
-        path, or None when async (resolve via ``wait()``)."""
+        past the newest existing step).  ``layout`` rides into the
+        manifest's shard-layout section (distributed/reshard.py) so a
+        resized job can reshard this checkpoint on restore.  Returns the
+        committed directory path, or None when async (resolve via
+        ``wait()``)."""
         self._reraise()
         if step is None:
             steps = scan_steps(self.root)
@@ -188,19 +196,20 @@ class CheckpointManager:
             self.wait()       # one in-flight save at a time
             self._reraise()
             self._thread = threading.Thread(
-                target=self._save_guarded, args=(state, step, meta),
+                target=self._save_guarded, args=(state, step, meta,
+                                                 layout),
                 daemon=True, name=f"ckpt-save-{step}")
             self._thread.start()
             return None
-        return self._save_impl(state, step, meta)
+        return self._save_impl(state, step, meta, layout)
 
-    def _save_guarded(self, state, step, meta):
+    def _save_guarded(self, state, step, meta, layout=None):
         try:
-            self._save_impl(state, step, meta)
+            self._save_impl(state, step, meta, layout)
         except BaseException as e:  # noqa: BLE001 — surfaced at wait()
             self._error = e
 
-    def _save_impl(self, state, step, meta):
+    def _save_impl(self, state, step, meta, layout=None):
         import time as _time
         t0 = _time.perf_counter()
         with self._lock:
@@ -213,7 +222,7 @@ class CheckpointManager:
             os.makedirs(final, exist_ok=True)
             try:
                 self._save_fn(state, final)
-                write_manifest(final, step=step, meta=meta)
+                write_manifest(final, step=step, meta=meta, layout=layout)
             except BaseException:
                 # keep the torn dir out of scans' way only if we survive
                 # (an injected os._exit never reaches here — that IS the

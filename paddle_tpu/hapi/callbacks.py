@@ -110,10 +110,24 @@ class ModelCheckpoint(Callback):
     @property
     def manager(self):
         if self._manager is None and self.save_dir:
-            from ..framework.checkpoint_manager import CheckpointManager
-            self._manager = CheckpointManager(
-                self.save_dir, max_to_keep=self.max_to_keep,
-                async_save=self.async_save)
+            nranks = getattr(self.model, "_nranks", 1)
+            if nranks > 1:
+                # every rank writes its own shard file into ONE ckpt dir
+                # (layout-bearing manifest committed by rank 0) instead
+                # of N ranks racing a whole-state save; the layout is
+                # what lets a resized relaunch reshard on resume
+                from ..distributed.reshard import (MeshSpec,
+                                                   ShardedCheckpointer)
+                self._manager = ShardedCheckpointer(
+                    self.save_dir, MeshSpec(("dp",), (nranks,)),
+                    rank=getattr(self.model, "_rank", 0),
+                    max_to_keep=self.max_to_keep)
+            else:
+                from ..framework.checkpoint_manager import \
+                    CheckpointManager
+                self._manager = CheckpointManager(
+                    self.save_dir, max_to_keep=self.max_to_keep,
+                    async_save=self.async_save)
         return self._manager
 
     def _state(self, next_epoch):

@@ -311,24 +311,51 @@ class Model:
         except Exception:
             pass
 
+    def _resume_target_mesh(self):
+        """The mesh this incarnation reshards checkpoints onto.  The hapi
+        trainer is data-parallel, so the target is the pure-dp mesh over
+        the current world — which also matches what ModelCheckpoint saves,
+        keeping the same-topology resume on the zero-copy fast path.  A
+        ``PADDLE_RESHARD_MESH`` env override (an operator or controller
+        pinning a dp×mp plan, cf. fleet.elastic.reshard_mesh_for) wins."""
+        import json as _json
+        from ..distributed.reshard import MeshSpec
+        raw = os.environ.get("PADDLE_RESHARD_MESH")
+        if raw:
+            obj = _json.loads(raw)
+            return MeshSpec(obj["axes"], obj["shape"])
+        return MeshSpec(("dp",), (max(self._nranks, 1),))
+
     def _resume_from(self, resume, save_dir, ckpt_cb):
         """Restore model/optimizer/epoch from the latest valid checkpoint;
-        returns the epoch to continue from (0 when nothing to restore)."""
+        returns the epoch to continue from (0 when nothing to restore).
+
+        Elastic resize (docs/FAULT_TOLERANCE.md): when the checkpoint's
+        manifest carries a shard layout and this relaunch runs a
+        DIFFERENT world size / mesh, the state is resharded onto the
+        topology the auto_tuner picked for the new world.  Identical
+        layouts take the zero-copy fast path (each rank reads only its
+        own shard file); a layout-incompatible checkpoint raises
+        ``LayoutMismatchError`` naming both layouts instead of silently
+        loading garbage.  Pre-layout checkpoints still load whole, as
+        before."""
         resume_dir = resume if isinstance(resume, (str, os.PathLike)) \
             else (save_dir or (ckpt_cb.save_dir if ckpt_cb else None))
         if not resume_dir:
             raise ValueError(
                 "fit(resume=True) needs save_dir (or resume=<dir>)")
-        if ckpt_cb is not None and \
-                str(resume_dir) == str(ckpt_cb.save_dir):
-            mgr = ckpt_cb.manager
-        else:
-            from ..framework.checkpoint_manager import CheckpointManager
-            mgr = CheckpointManager(resume_dir)
-        restored = mgr.restore_latest()
+        from ..distributed.reshard import restore_latest_resharded
+        restored = restore_latest_resharded(
+            str(resume_dir), self._resume_target_mesh(), self._rank)
         if restored is None:
             return 0
-        state, _step = restored
+        state, _step, report = restored
+        if not report.get("fast_path"):
+            from ..utils.log import get_logger
+            get_logger().warning(
+                "resume resharded checkpoint %s -> %s (%s arrays)",
+                report.get("saved_mesh"), report.get("target_mesh"),
+                report.get("arrays_resharded"))
         self.network.set_state_dict(state["model"])
         if self._optimizer is not None and state.get("optimizer"):
             self._optimizer.set_state_dict(state["optimizer"])

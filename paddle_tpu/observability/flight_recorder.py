@@ -66,8 +66,12 @@ class FlightRecorder:
             self._dumped.clear()
 
     def default_path(self):
-        return str(_flag("FLAGS_flight_recorder_path") or "") or \
-            os.path.join(os.getcwd(), f"flight_recorder.{os.getpid()}.json")
+        explicit = str(_flag("FLAGS_flight_recorder_path") or "")
+        if explicit:
+            return explicit
+        return os.path.join(
+            os.getcwd(), str(_flag("FLAGS_dump_dir") or "."),
+            f"flight_recorder.{os.getpid()}.json")
 
     def dump(self, path=None, reason="manual", error=None, once=False,
              extra=None):

@@ -55,8 +55,17 @@ _FLAGS: dict[str, Any] = {
     # preemption dump).  0 disables recording AND the dump hooks.
     "FLAGS_flight_recorder_size": 512,
     # where the flight recorder dumps on crash/SIGTERM; empty = a
-    # flight_recorder.<pid>.json file in the current directory.
+    # flight_recorder.<pid>.json file under FLAGS_dump_dir.
     "FLAGS_flight_recorder_path": "",
+    # default directory (relative to the working dir) for crash/stall
+    # dumps whose *_path flag is unset — keeps post-mortem litter out of
+    # the repo/cwd root and under one ignorable prefix.
+    "FLAGS_dump_dir": ".paddle_tpu_dumps",
+    # elastic resharding (distributed/reshard.py): allow fit(resume=...)
+    # to reshard a checkpoint whose saved mesh layout differs from the
+    # resumed topology (world-size change).  False = any layout change
+    # fails loudly with LayoutMismatchError naming both layouts.
+    "FLAGS_reshard_on_resume": True,
     # hang guardian (distributed/watchdog.py, docs/RESILIENCE.md).
     # A collective stuck longer than this triggers a stall dump and a
     # CollectiveTimeoutError naming the op, per-group sequence number,

@@ -1,0 +1,147 @@
+"""Elastic resize drill worker (docs/FAULT_TOLERANCE.md "Elastic resize").
+
+Data-parallel training whose loss trajectory is world-size-invariant: the
+GLOBAL batch is keyed by step alone, each rank computes grads on its
+contiguous slice, and grads/losses are mean-reduced across ranks over the
+launch controller's guardian store (the PR 5 host-collective substrate).
+Checkpoints go through ``ShardedCheckpointer``: params replicated,
+optimizer moments sharded over the dp axis on disk — so resuming on a
+DIFFERENT world size must genuinely reshard (reassemble moment shards),
+not just re-read a replica.
+
+Drill flow (tests/test_reshard.py, tools/run_ci.sh resize gate):
+``FLAGS_fault_inject=step:sigterm_at=N`` preempts every rank at step N;
+each incarnation appends ``rank:world:start_step:fast_path:resharded`` to
+``incarnations.log``; rank 0 of the completing incarnation writes
+``losses.json``.  The world size is whatever the relaunch chose — the
+auto_tuner re-plan (fleet.elastic.plan_topology) picks the dp×mp split
+for it.
+"""
+import json
+import os
+import sys
+from types import SimpleNamespace
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.distributed.fleet.elastic import (  # noqa: E402
+    PreemptionHandler, plan_topology,
+)
+from paddle_tpu.distributed.host_collectives import (  # noqa: E402
+    HostCollectives, guardian_store,
+)
+from paddle_tpu.distributed.reshard import (  # noqa: E402
+    MeshSpec, ShardedCheckpointer, split_bounds,
+)
+from paddle_tpu.utils import fault_injection  # noqa: E402
+
+TOTAL_STEPS = 6
+GLOBAL_BATCH = 8
+IN_DIM, HID_DIM, OUT_DIM = 6, 16, 4
+
+
+def global_batch(step):
+    rng = np.random.default_rng(1000 + step)   # data keyed by step only
+    x = rng.standard_normal((GLOBAL_BATCH, IN_DIM)).astype("float32")
+    y = rng.standard_normal((GLOBAL_BATCH, OUT_DIM)).astype("float32")
+    return x, y
+
+
+def moment_partition(key, arr):
+    """On-disk layout: optimizer moments ride sharded over dp (ZeRO-1
+    style disk layout); everything else replicated."""
+    if ".moment" in key and arr.ndim >= 1 and arr.shape[0] >= 1:
+        return ("dp",) + (None,) * (arr.ndim - 1)
+    return (None,) * arr.ndim
+
+
+def main():
+    outdir = sys.argv[1]
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    # relaunch re-plans the topology for THIS world (auto_tuner predict
+    # mode); the CPU drill lane folds mp into dp — one process axis
+    plan = plan_topology(world)
+    mesh = MeshSpec(("dp",), (world,))
+    ckpt = ShardedCheckpointer(os.path.join(outdir, "ckpts"), mesh, rank,
+                               partition_fn=moment_partition,
+                               max_to_keep=3)
+    handler = PreemptionHandler().install()
+
+    paddle.seed(7)
+    model = nn.Sequential(nn.Linear(IN_DIM, HID_DIM), nn.Tanh(),
+                          nn.Linear(HID_DIM, OUT_DIM))
+    opt = paddle.optimizer.AdamW(5e-3, parameters=model.parameters())
+
+    start_step, losses = 0, []
+    restored = ckpt.restore_latest()
+    if restored is not None:
+        state, _step = restored
+        model.set_state_dict(state["model"])
+        opt.set_state_dict(state["optimizer"])
+        start_step = int(state["step"]) + 1
+        losses = list(state["losses"])
+    report = ckpt.last_report or {}
+    with open(os.path.join(outdir, "incarnations.log"), "a") as f:
+        f.write(f"{rank}:{world}:{start_step}:"
+                f"{int(bool(report.get('fast_path')))}:"
+                f"{int(report.get('arrays_resharded', 0))}:"
+                f"{plan['dp']}x{plan['mp']}\n")
+
+    hc = None
+    group = SimpleNamespace(id=0, ranks=list(range(world)), nranks=world)
+    if world > 1:
+        store = guardian_store()
+        assert store is not None, "launch controller exports the store"
+        hc = HostCollectives(store,
+                             job=os.environ.get("PADDLE_JOB_ID",
+                                                "reshard"))
+
+    def allmean(arr):
+        """Rank-order-deterministic mean over ranks (f64 accumulate)."""
+        if hc is None:
+            return np.asarray(arr)
+        stacked = hc.gather(group, np.asarray(arr), rank=rank)
+        return np.mean(stacked, axis=0, dtype=np.float64).astype(
+            np.asarray(arr).dtype)
+
+    for step in range(start_step, TOTAL_STEPS):
+        fault_injection.check_step(step)
+        x, y = global_batch(step)
+        lo, hi = split_bounds(GLOBAL_BATCH, world, rank)
+        xb = paddle.to_tensor(x[lo:hi])
+        yb = paddle.to_tensor(y[lo:hi])
+        loss = ((model(xb) - yb) ** 2).mean()    # local mean (equal counts)
+        loss.backward()
+        if hc is not None:
+            for p in model.parameters():
+                if p.grad is not None:
+                    p.grad._data = jax.numpy.asarray(
+                        allmean(np.asarray(p.grad._data_)))
+        opt.step()
+        opt.clear_grad()
+        gloss = allmean(np.float32(loss.numpy()))
+        losses.append(round(float(gloss), 6))
+
+        ckpt.save({"model": model.state_dict(),
+                   "optimizer": opt.state_dict(),
+                   "step": step, "losses": losses}, step=step)
+
+        if handler.preempted():
+            ckpt.wait()
+            handler.exit_for_relaunch()
+
+    if rank == 0:
+        with open(os.path.join(outdir, "losses.json"), "w") as f:
+            json.dump(losses, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,502 @@
+"""Elastic world-size resharding (docs/FAULT_TOLERANCE.md "Elastic
+resize"): shard-overlap math, layout manifests, reshard-on-restore, and
+the subprocess resize drills (train on 4 procs → SIGTERM → resume on 2,
+and 2 → 4), reference pattern: auto_parallel/static/converter.py re-slice
++ the fleet elastic relaunch flow."""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.framework.checkpoint_manager import (
+    CheckpointManager, CheckpointError,
+)
+from paddle_tpu.distributed.reshard import (
+    LayoutError, LayoutMismatchError, MeshSpec, ShardedCheckpointer,
+    offer_shards, overlap_slices, read_layout, replicated,
+    restore_latest_resharded, restore_resharded, shard_slices,
+    split_bounds,
+)
+from paddle_tpu.utils.flags import set_flags
+
+WORKER = os.path.join(os.path.dirname(__file__), "_reshard_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# shard math
+# ---------------------------------------------------------------------------
+
+def test_split_bounds_uneven():
+    # np.array_split semantics: first n % parts chunks get +1
+    assert [split_bounds(7, 4, i) for i in range(4)] == \
+        [(0, 2), (2, 4), (4, 6), (6, 7)]
+    assert [split_bounds(3, 4, i) for i in range(4)] == \
+        [(0, 1), (1, 2), (2, 3), (3, 3)]      # empty tail chunk
+    assert split_bounds(8, 2, 1) == (4, 8)
+    with pytest.raises(ValueError):
+        split_bounds(4, 2, 2)
+
+
+def test_shard_slices_and_overlap():
+    mesh = MeshSpec(("dp", "mp"), (2, 2))
+    # rank 3 = coords dp=1, mp=1
+    assert shard_slices((8, 6), ("dp", "mp"), mesh, 3) == \
+        (slice(4, 8), slice(3, 6))
+    assert shard_slices((8, 6), (None, "mp"), mesh, 1) == \
+        (slice(0, 8), slice(3, 6))
+    # uneven: 7 rows over dp=2 → 4 + 3
+    assert shard_slices((7,), ("dp",), mesh, 2) == (slice(4, 7),)
+    # overlap is expressed in each side's local coordinates
+    src = (slice(2, 6),)
+    dst = (slice(4, 9),)
+    sel_src, sel_dst = overlap_slices(src, dst)
+    assert sel_src == (slice(2, 4),) and sel_dst == (slice(0, 2),)
+    assert overlap_slices((slice(0, 2),), (slice(2, 4),)) is None
+    # unknown axis in partition → mismatch error naming the mesh
+    with pytest.raises(LayoutMismatchError):
+        shard_slices((8,), ("pp",), mesh, 0)
+
+
+def _mesh_coords_cover():
+    mesh = MeshSpec(("dp", "mp"), (3, 2))
+    return [mesh.coords(r) for r in range(mesh.world)]
+
+
+def test_mesh_coords_row_major():
+    coords = _mesh_coords_cover()
+    assert coords[0] == {"dp": 0, "mp": 0}
+    assert coords[1] == {"dp": 0, "mp": 1}
+    assert coords[5] == {"dp": 2, "mp": 1}
+
+
+# ---------------------------------------------------------------------------
+# save/restore helpers
+# ---------------------------------------------------------------------------
+
+def _state(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "model": {
+            "w": paddle.to_tensor(
+                rng.standard_normal((7, 6)).astype("float32")),
+            "b": paddle.to_tensor(
+                rng.standard_normal((6,)).astype("float32")),
+        },
+        "optimizer": {
+            "moment1.0": paddle.to_tensor(
+                rng.standard_normal((7, 6)).astype("float32")),
+            "step_count": 3,
+        },
+        "losses": [0.5, 0.25],
+        "step": 1,
+    }
+
+
+def _moment_partition(key, arr):
+    if "moment" in key and arr.ndim >= 1:
+        return ("dp",) + (None,) * (arr.ndim - 1)
+    return replicated(arr.ndim)
+
+
+def _save_world(root, state, mesh, partition_fn=None, step=0):
+    """Simulate a lockstep multi-rank save with one thread per rank."""
+    errs = []
+
+    def _one(rank):
+        try:
+            ShardedCheckpointer(root, mesh, rank,
+                                partition_fn=partition_fn).save(
+                state, step=step)
+        except BaseException as e:  # noqa: BLE001
+            errs.append((rank, e))
+    ts = [threading.Thread(target=_one, args=(r,))
+          for r in range(mesh.world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs, errs
+
+
+def _np(t):
+    return np.asarray(t._data_) if hasattr(t, "_data_") else np.asarray(t)
+
+
+# ---------------------------------------------------------------------------
+# resharding restores
+# ---------------------------------------------------------------------------
+
+def test_reshard_4_to_2_and_3_roundtrip(tmp_path):
+    root = str(tmp_path / "ck")
+    state = _state()
+    mesh4 = MeshSpec(("dp",), (4,))
+    _save_world(root, state, mesh4, _moment_partition, step=0)
+    layout = read_layout(os.path.join(root, "ckpt-00000000"))
+    assert layout["world_size"] == 4
+    assert layout["arrays"]["optimizer.moment1.0"]["partition"] == \
+        ["dp", None]
+    assert layout["arrays"]["model.w"]["partition"] == [None, None]
+
+    want_m1 = _np(state["optimizer"]["moment1.0"])
+    for new_world in (2, 3, 1, 5):
+        meshN = MeshSpec(("dp",), (new_world,))
+        for rank in range(new_world):
+            ck = ShardedCheckpointer(root, meshN, rank)
+            restored, step = ck.restore_latest()
+            assert step == 0
+            # replicated arrays byte-equal; sharded moments reassembled
+            np.testing.assert_array_equal(_np(restored["model"]["w"]),
+                                          _np(state["model"]["w"]))
+            np.testing.assert_array_equal(
+                _np(restored["optimizer"]["moment1.0"]), want_m1)
+            assert restored["losses"] == [0.5, 0.25]
+            assert restored["optimizer"]["step_count"] == 3
+            assert ck.last_report["arrays_resharded"] >= 1
+            assert not ck.last_report["fast_path"]
+
+
+def test_reshard_2d_mesh_uneven(tmp_path):
+    root = str(tmp_path / "ck")
+    rng = np.random.default_rng(3)
+    arr = rng.standard_normal((7, 5)).astype("float32")
+    state = {"a": paddle.to_tensor(arr)}
+    mesh = MeshSpec(("dp", "mp"), (2, 2))
+
+    def pf(key, a):
+        return ("dp", "mp")
+    _save_world(root, state, mesh, pf, step=0)
+    # every saved shard file holds only its 2-D tile
+    layout = read_layout(os.path.join(root, "ckpt-00000000"))
+    from paddle_tpu.framework.io import load
+    s3 = load(os.path.join(root, "ckpt-00000000",
+                           layout["rank_files"]["3"]))
+    np.testing.assert_array_equal(_np(s3["arrays"]["a"]), arr[4:7, 3:5])
+    # reassemble on a 3-rank dp-only mesh
+    mesh3 = MeshSpec(("dp",), (3,))
+    for rank in range(3):
+        state_r, report = restore_resharded(
+            os.path.join(root, "ckpt-00000000"), mesh3, rank)
+        np.testing.assert_array_equal(_np(state_r["a"]), arr)
+        assert report["files_read"] == 4        # all tiles needed
+
+
+def test_fast_path_same_layout_bit_equal(tmp_path):
+    root = str(tmp_path / "ck")
+    state = _state()
+    mesh2 = MeshSpec(("dp",), (2,))
+    _save_world(root, state, mesh2, _moment_partition, step=0)
+    # identical mesh + identical (saved) partition target → fast path:
+    # the rank's own file, nothing else
+    path = os.path.join(root, "ckpt-00000000")
+    layout = read_layout(path)
+
+    def same_part(key, meta):
+        return tuple(layout["arrays"][key]["partition"]) \
+            if key in layout["arrays"] else tuple(meta["partition"])
+    for rank in range(2):
+        st, report = restore_resharded(
+            path, mesh2, rank,
+            target_partition_fn=lambda k, m: tuple(m["partition"]))
+        assert report["fast_path"] and report["files_read"] == 1
+        np.testing.assert_array_equal(_np(st["model"]["w"]),
+                                      _np(state["model"]["w"]))
+        # fast path returns the rank's own moment SLICE verbatim
+        lo, hi = split_bounds(7, 2, rank)
+        np.testing.assert_array_equal(
+            _np(st["optimizer"]["moment1.0"]),
+            _np(state["optimizer"]["moment1.0"])[lo:hi])
+    # replicated-only state: default (replicate) target also fast-paths
+    root2 = str(tmp_path / "ck2")
+    _save_world(root2, {"w": state["model"]["w"]}, mesh2, None, step=0)
+    st, report = restore_resharded(
+        os.path.join(root2, "ckpt-00000000"), mesh2, 1)
+    assert report["fast_path"] and report["files_read"] == 1
+    np.testing.assert_array_equal(_np(st["w"]), _np(state["model"]["w"]))
+
+
+def test_pre_layout_checkpoint_loads_and_errors(tmp_path):
+    """Satellite: a pre-PR-6 checkpoint (no layout section) still loads
+    whole via the latest-valid scan, and an explicit reshard request
+    raises the versioned LayoutError — never a KeyError."""
+    root = str(tmp_path / "legacy")
+    state = {"model": {"w": paddle.to_tensor(np.ones((3, 2), "float32"))},
+             "next_epoch": 2}
+    CheckpointManager(root).save(state, step=0)
+
+    mesh = MeshSpec(("dp",), (1,))
+    out = restore_latest_resharded(root, mesh, 0)
+    assert out is not None
+    st, step, report = out
+    assert report["format"] == "legacy" and step == 0
+    np.testing.assert_array_equal(_np(st["model"]["w"]),
+                                  np.ones((3, 2), "float32"))
+
+    path = os.path.join(root, "ckpt-00000000")
+    with pytest.raises(LayoutError) as ei:
+        restore_resharded(path, MeshSpec(("dp",), (2,)), 0)
+    assert not isinstance(ei.value, KeyError)
+    assert "layout" in str(ei.value) and "version" in str(ei.value)
+
+    with pytest.raises(LayoutError):
+        restore_latest_resharded(root, mesh, 0, strict_layout=True)
+
+
+def test_layout_mismatch_names_both_layouts(tmp_path):
+    root = str(tmp_path / "ck")
+    state = {"a": paddle.to_tensor(
+        np.arange(24, dtype="float32").reshape(6, 4))}
+    mesh22 = MeshSpec(("dp", "mp"), (2, 2))
+
+    def pf(key, a):
+        return ("dp", "mp")
+    _save_world(root, state, mesh22, pf, step=0)
+    path = os.path.join(root, "ckpt-00000000")
+    # requesting the SAVED partition on a mesh without the mp axis
+    with pytest.raises(LayoutMismatchError) as ei:
+        restore_resharded(path, MeshSpec(("dp",), (2,)), 0,
+                          target_partition_fn=lambda k, m: ("dp", "mp"))
+    msg = str(ei.value)
+    assert "dp=2×mp=2" in msg and "dp=2" in msg  # names both layouts
+
+
+def test_reshard_on_resume_flag_off_fails_loudly(tmp_path):
+    root = str(tmp_path / "ck")
+    state = {"a": paddle.to_tensor(np.ones((4, 2), "float32"))}
+    mesh2 = MeshSpec(("dp",), (2,))
+    _save_world(root, state, mesh2, None, step=0)
+    path = os.path.join(root, "ckpt-00000000")
+    set_flags({"FLAGS_reshard_on_resume": False})
+    try:
+        # same layout still restores (fast path needs no resharding) …
+        st, report = restore_resharded(
+            path, mesh2, 0,
+            target_partition_fn=lambda k, m: tuple(m["partition"]))
+        assert report["fast_path"]
+        # … but a topology change now fails loudly, naming both sides
+        with pytest.raises(LayoutMismatchError) as ei:
+            restore_resharded(path, MeshSpec(("dp",), (4,)), 0)
+        msg = str(ei.value)
+        assert "dp=2" in msg and "dp=4" in msg
+        assert "FLAGS_reshard_on_resume" in msg
+    finally:
+        set_flags({"FLAGS_reshard_on_resume": True})
+
+
+def test_optimizer_state_roundtrip_through_reshard(tmp_path):
+    """AdamW moments sharded to disk on world 4, reassembled on world 1:
+    continuing training must match the uninterrupted run exactly (same
+    process, same arithmetic — byte-for-byte)."""
+    def build():
+        paddle.seed(11)
+        m = nn.Sequential(nn.Linear(5, 9), nn.Tanh(), nn.Linear(9, 3))
+        o = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        return m, o
+
+    def step(m, o, i):
+        rng = np.random.default_rng(i)
+        x = paddle.to_tensor(rng.standard_normal((4, 5)).astype("float32"))
+        y = paddle.to_tensor(rng.standard_normal((4, 3)).astype("float32"))
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return float(loss.numpy())
+
+    # uninterrupted reference
+    m_ref, o_ref = build()
+    ref = [step(m_ref, o_ref, i) for i in range(6)]
+
+    # train 3, save sharded over a virtual 4-rank mesh, restore, continue
+    m, o = build()
+    first = [step(m, o, i) for i in range(3)]
+    root = str(tmp_path / "ck")
+    mesh4 = MeshSpec(("dp",), (4,))
+    _save_world(root, {"model": m.state_dict(),
+                       "optimizer": o.state_dict()},
+                mesh4, _moment_partition, step=2)
+
+    m2, o2 = build()
+    ck = ShardedCheckpointer(root, MeshSpec(("dp",), (1,)), 0)
+    restored, _step = ck.restore_latest()
+    assert ck.last_report["arrays_resharded"] >= 1
+    m2.set_state_dict(restored["model"])
+    o2.set_state_dict(restored["optimizer"])
+    rest = first + [step(m2, o2, i) for i in range(3, 6)]
+    assert rest == ref                      # byte-equal continuation
+
+
+def test_shard_fetch_via_guardian_store(tmp_path):
+    """A shard file unreadable on this host rides the PR 5 guardian-store
+    substrate: a peer offers it, the restorer fetches it."""
+    from paddle_tpu.distributed.store import FileKVStore
+    root = str(tmp_path / "ck")
+    rng = np.random.default_rng(5)
+    arr = rng.standard_normal((6, 3)).astype("float32")
+    state = {"a": paddle.to_tensor(arr)}
+    mesh2 = MeshSpec(("dp",), (2,))
+
+    def pf(key, a):
+        return ("dp",) + (None,) * (a.ndim - 1)
+    _save_world(root, state, mesh2, pf, step=0)
+    path = os.path.join(root, "ckpt-00000000")
+    store = FileKVStore(str(tmp_path / "kv"))
+    assert offer_shards(store, path) == 2   # both files posted
+
+    # delete rank 1's shard file locally; crc check would now fail, so
+    # restore the directory directly (the cross-host case: the manifest
+    # is readable, one payload file is not)
+    layout = read_layout(path)
+    os.remove(os.path.join(path, layout["rank_files"]["1"]))
+    st, report = restore_resharded(path, MeshSpec(("dp",), (1,)), 0,
+                                   store=store, fetch_timeout_s=5)
+    np.testing.assert_array_equal(_np(st["a"]), arr)
+
+    # no store, missing file → clear CheckpointError, not a hang
+    with pytest.raises(CheckpointError):
+        restore_resharded(path, MeshSpec(("dp",), (1,)), 0,
+                          store=FileKVStore(str(tmp_path / "kv2")),
+                          fetch_timeout_s=0.2)
+
+
+def test_sharded_retention_and_torn_dir_skipped(tmp_path):
+    root = str(tmp_path / "ck")
+    mesh1 = MeshSpec(("dp",), (1,))
+    ck = ShardedCheckpointer(root, mesh1, 0, max_to_keep=2)
+    for s in range(4):
+        ck.save({"v": paddle.to_tensor(np.full((2,), s, "float32"))},
+                step=s)
+    names = sorted(os.listdir(root))
+    assert names == ["ckpt-00000002", "ckpt-00000003"]
+    # tear the newest (drop its manifest) → restore falls back to older
+    os.remove(os.path.join(root, "ckpt-00000003", "manifest.json"))
+    st, step = ck.restore_latest()
+    assert step == 2 and float(_np(st["v"])[0]) == 2.0
+
+
+def test_barrier_timeout_leaves_torn_dir(tmp_path):
+    root = str(tmp_path / "ck")
+    mesh2 = MeshSpec(("dp",), (2,))
+    ck0 = ShardedCheckpointer(root, mesh2, 0, barrier_timeout_s=0.4)
+    with pytest.raises(CheckpointError):
+        ck0.save({"v": paddle.to_tensor(np.ones((2,), "float32"))},
+                 step=0)                    # rank 1 never shows up
+    # no manifest committed → scan treats it as torn
+    assert ck0.restore_latest() is None
+
+
+def test_hapi_fit_resumes_resharded_checkpoint(tmp_path):
+    """A checkpoint written by a (simulated) 2-rank hapi job resumes on a
+    single process: Model.fit(resume=...) reshards model + optimizer and
+    continues at the recorded epoch."""
+    class Data:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            rng = np.random.default_rng(i)
+            return (rng.normal(size=(4,)).astype(np.float32),
+                    np.array([i % 2], dtype=np.int64))
+
+    def build():
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        model = paddle.Model(net)
+        model.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+        return net, model
+
+    net, model = build()
+    model.fit(Data(), batch_size=4, epochs=1, verbose=0)
+    save_dir = str(tmp_path / "ck")
+    state = {"model": net.state_dict(),
+             "optimizer": model._optimizer.state_dict(),
+             "next_epoch": 1}
+    _save_world(save_dir, state, MeshSpec(("dp",), (2,)), None, step=0)
+
+    net2, model2 = build()
+    hist = model2.fit(Data(), batch_size=4, epochs=2, verbose=0,
+                      resume=save_dir)
+    # epoch 0 was skipped (resumed at 1) and weights came from the ckpt
+    assert len(hist["loss"]) == 1
+    for k, v in net.state_dict().items():
+        got = net2.state_dict()[k]
+        # weights continued FROM the checkpoint; equality not expected
+        # after another epoch — just assert the restore happened by
+        # shape/dtype and that training progressed
+        assert _np(got).shape == _np(v).shape
+
+
+# ---------------------------------------------------------------------------
+# subprocess resize drills
+# ---------------------------------------------------------------------------
+
+def _launch(nproc, outdir, fault=None, max_restart=0):
+    from paddle_tpu.distributed.launch.context import Context, parse_args
+    from paddle_tpu.distributed.launch.controller import \
+        CollectiveController
+    args = parse_args(["--nproc_per_node", str(nproc),
+                       "--max_restart", str(max_restart),
+                       WORKER, str(outdir)])
+    old = os.environ.get("FLAGS_fault_inject")
+    if fault is not None:
+        os.environ["FLAGS_fault_inject"] = fault
+    else:
+        os.environ.pop("FLAGS_fault_inject", None)
+    try:
+        return CollectiveController(Context(args=args)).run()
+    finally:
+        if old is None:
+            os.environ.pop("FLAGS_fault_inject", None)
+        else:
+            os.environ["FLAGS_fault_inject"] = old
+
+
+def _reference_losses(tmp_path):
+    d = tmp_path / "ref"
+    d.mkdir()
+    assert _launch(1, d) == 0
+    with open(d / "losses.json") as f:
+        return json.load(f)
+
+
+def _assert_drill(tmp_path, ref, w_before, w_after):
+    from paddle_tpu.distributed.fleet.elastic import ELASTIC_EXIT_CODE
+    d = tmp_path / f"resize_{w_before}_{w_after}"
+    d.mkdir()
+    # incarnation 1: SIGTERM at step 3 → save-at-boundary → exit 101
+    code = _launch(w_before, d, fault="step:sigterm_at=3")
+    assert code == ELASTIC_EXIT_CODE
+    assert not (d / "losses.json").exists()
+    # incarnation 2: the slice came back a different size
+    assert _launch(w_after, d) == 0
+    with open(d / "losses.json") as f:
+        got = json.load(f)
+    assert len(got) == len(ref)
+    np.testing.assert_allclose(got, ref, rtol=0, atol=5e-4)
+    lines = [ln.split(":") for ln in
+             (d / "incarnations.log").read_text().splitlines()]
+    first = [ln for ln in lines if ln[1] == str(w_before)]
+    second = [ln for ln in lines if ln[1] == str(w_after)]
+    assert len(first) == w_before and len(second) == w_after
+    assert all(ln[2] == "0" for ln in first)       # fresh start
+    assert all(ln[2] == "4" for ln in second)      # resumed after step 3
+    # the resumed incarnation really RESHARDED (no fast path, moments
+    # reassembled from the old world's shards)
+    assert all(ln[3] == "0" and int(ln[4]) >= 1 for ln in second)
+    return got
+
+
+def test_resize_4_to_2_drill(tmp_path):
+    ref = _reference_losses(tmp_path)
+    assert len(ref) == 6
+    _assert_drill(tmp_path, ref, 4, 2)
+
+
+def test_resize_2_to_4_drill(tmp_path):
+    ref = _reference_losses(tmp_path)
+    _assert_drill(tmp_path, ref, 2, 4)

@@ -174,6 +174,14 @@ print(f"hang drill OK: blamed {s['op']!r} seq {s['seq']} missing "
 EOF
 echo "hang drill total wall time: ${drill_elapsed}s (rc=$drill_rc)"
 
+echo "== elastic resize drill (train on 4 procs -> SIGTERM -> resume on 2) =="
+# trains 4 steps on 4 procs, preempts, resumes on 2 — trajectory must
+# match the uninterrupted run modulo batch order, and the resumed
+# incarnation must genuinely reshard (layout fast path off, moment
+# shards reassembled).  Bounded: the drill itself takes ~20s on CPU.
+timeout -k 10 300 python -m pytest tests/test_reshard.py -q \
+    -k "resize_4_to_2" -p no:randomly
+
 echo "== serving graceful-drain drill (SIGTERM -> finish in-flight, fail queue) =="
 rm -rf /tmp/pt_drain_drill && mkdir -p /tmp/pt_drain_drill
 FLAGS_flight_recorder_path=/tmp/pt_drain_drill/flightrec.json \
