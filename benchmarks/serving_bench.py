@@ -57,11 +57,17 @@ def _run_sequential(paddle, model, prompts, max_new):
     return outs, tokens, wall
 
 
-def _run_serving(model, prompts, max_new, num_slots):
+def _run_serving(model, prompts, max_new, num_slots, config=None,
+                 warm_prompt=None):
     from paddle_tpu.serving import Engine, ServingConfig
-    eng = Engine(model, ServingConfig(num_slots=num_slots,
-                                      max_queue=len(prompts))).start()
+    cfg = config or ServingConfig(num_slots=num_slots,
+                                  max_queue=len(prompts))
+    eng = Engine(model, cfg).start()
     try:
+        if warm_prompt is not None:
+            # steady-state serving: the shared system prompt is already
+            # resident (prefix tree for paged, a no-op for slots)
+            eng.submit(warm_prompt, max_new_tokens=2).result(timeout=600)
         t0 = time.perf_counter()
         futs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
         outs = [f.result(timeout=600) for f in futs]
@@ -73,6 +79,85 @@ def _run_serving(model, prompts, max_new, num_slots):
     return outs, tokens, wall, snap
 
 
+def _run_prefix_workload(paddle, args):
+    """Long-context + shared-prefix lane: N requests that share one
+    long system prompt, served by the PR 3 slot engine vs the paged
+    engine at EQUAL cache memory — the paged side holds the prefix KV
+    once (prefix tree), prefills only each request's tail in chunks,
+    and spreads the saved pool bytes over twice the decode slots."""
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+    from paddle_tpu.serving import ServingConfig
+    import jax
+
+    max_seq, prefix_len = (128, 64) if args.smoke else (160, 96)
+    n_req = 8 if args.smoke else 16
+    max_new, tail, page = 8, 4, 16
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=2, hidden_size=128, num_heads=4,
+        vocab_size=512, max_seq_len=max_seq))
+    model.eval()
+    rng = np.random.default_rng(42)
+    prefix = rng.integers(0, 512, (prefix_len,)).astype("int32")
+    prompts = [np.concatenate([prefix, rng.integers(
+        0, 512, (tail,)).astype("int32")]) for _ in range(n_req)]
+    warm = np.concatenate([prefix,
+                           rng.integers(0, 512, (tail,)).astype("int32")])
+
+    slot_width = 4                        # the PR 3 baseline geometry
+    pages_per_seq = -(-max_seq // page)
+    pool_pages = slot_width * pages_per_seq   # same bytes as 4 stripes
+    slots_cfg = ServingConfig(kv_layout="slots", num_slots=slot_width,
+                              max_queue=n_req + 1)
+    paged_cfg = ServingConfig(kv_layout="paged", num_slots=2 * slot_width,
+                              page_size=page, kv_pool_pages=pool_pages,
+                              enable_prefix_cache=True,
+                              prefill_chunk_tokens=32,
+                              max_queue=n_req + 1)
+
+    # correctness reference + warm both lanes' executables
+    seq_out, _, _ = _run_sequential(paddle, model, prompts, max_new)
+    _run_serving(model, prompts[:1], 2, slot_width, config=slots_cfg)
+    _run_serving(model, prompts[:1], 2, 0, config=paged_cfg)
+
+    _, slot_tokens, slot_wall, slot_snap = _run_serving(
+        model, prompts, max_new, 0, config=slots_cfg, warm_prompt=warm)
+    paged_out, paged_tokens, paged_wall, paged_snap = _run_serving(
+        model, prompts, max_new, 0, config=paged_cfg, warm_prompt=warm)
+
+    mismatches = sum(0 if np.array_equal(o.output_ids, ref) else 1
+                     for o, ref in zip(paged_out, seq_out))
+    slot_tps = slot_tokens / slot_wall
+    paged_tps = paged_tokens / paged_wall
+    return {
+        "metric": "serving_paged_prefix_cpu",
+        "value": paged_tps,
+        "unit": "tokens_per_sec",
+        "speedup_vs_slots": paged_tps / slot_tps,
+        "slots": {"tokens_per_sec": slot_tps, "wall_s": slot_wall,
+                  "tokens": slot_tokens,
+                  "slot_occupancy": slot_snap["slot_occupancy"],
+                  "ttft_ms_avg": slot_snap["ttft_ms_avg"]},
+        "paged": {"tokens_per_sec": paged_tps, "wall_s": paged_wall,
+                  "tokens": paged_tokens,
+                  "slot_occupancy": paged_snap["slot_occupancy"],
+                  "ttft_ms_avg": paged_snap["ttft_ms_avg"],
+                  "prefill_chunks": paged_snap["prefill_chunks"],
+                  "kv_pages_in_use": paged_snap["kv_pages_in_use"]},
+        "prefix_cache_hits": paged_snap["prefix_cache_hits"],
+        "prefix_cache_hit_tokens": paged_snap["prefix_cache_hit_tokens"],
+        "max_concurrent": paged_snap["max_active_slots"],
+        "prealloc_capacity": slot_width,
+        "pool_pages": pool_pages,
+        "prefix_len": prefix_len,
+        "num_requests": n_req,
+        "max_new_tokens": max_new,
+        "greedy_mismatches": mismatches,
+        "smoke": bool(args.smoke),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=16)
@@ -80,9 +165,15 @@ def main():
     ap.add_argument("--max-new-tokens", type=int, default=32)
     ap.add_argument("--smoke", action="store_true",
                     help="CI scale: 6 requests x 12 tokens")
+    ap.add_argument("--workload", default="mixed",
+                    choices=("mixed", "prefix"),
+                    help="mixed: the PR 3 continuous-batching lane; "
+                         "prefix: long-context shared-prefix lane "
+                         "(paged vs slot engine at equal cache bytes)")
     ap.add_argument("--out", default=None,
                     help="result path (default benchmarks/"
-                         "SERVING_BENCH.json)")
+                         "SERVING_BENCH.json or "
+                         "SERVING_PAGED_BENCH.json)")
     ap.add_argument("--no-write", action="store_true")
     args = ap.parse_args()
     if args.smoke:
@@ -91,6 +182,20 @@ def main():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
     import paddle_tpu as paddle
+
+    if args.workload == "prefix":
+        rec = _run_prefix_workload(paddle, args)
+        out_path = args.out or os.path.join(
+            os.path.dirname(__file__), "SERVING_PAGED_BENCH.json")
+        if not args.no_write:
+            with open(out_path, "w") as f:
+                json.dump(rec, f, indent=1)
+            print(f"wrote {out_path}", file=sys.stderr)
+        print(json.dumps({k: rec[k] for k in
+                          ("metric", "value", "speedup_vs_slots",
+                           "prefix_cache_hits", "max_concurrent",
+                           "greedy_mismatches")}))
+        return 0 if rec["greedy_mismatches"] == 0 else 1
 
     model = _build_model(paddle)
     rng = np.random.default_rng(42)

@@ -218,9 +218,6 @@ def masked_multihead_attention(q, k, v, cache_k, cache_v, offset,
             f"tokens > cache capacity {s_cap}")
 
     def fn(qa, ka, va, ck, cv, off):
-        b, s, h_q, d = qa.shape
-        s_max, h_kv = ck.shape[1], ck.shape[2]
-        sc = scale if scale is not None else 1.0 / _math.sqrt(d)
         off = off.astype(jnp.int32) if hasattr(off, "astype") else \
             jnp.int32(off)
         if off.ndim == 1:
@@ -230,36 +227,130 @@ def masked_multihead_attention(q, k, v, cache_k, cache_v, offset,
                 c, u, (o, 0, 0)))
             ck = upd(ck, ka.astype(ck.dtype), off)
             cv = upd(cv, va.astype(cv.dtype), off)
-            q_pos = off[:, None, None] + jnp.arange(s)[None, :, None]
-            k_pos = jnp.arange(s_max)[None, None, :]
-            mask = k_pos <= q_pos                     # [b, s, s_max]
         else:
             ck = jax.lax.dynamic_update_slice(ck, ka.astype(ck.dtype),
                                               (0, off, 0, 0))
             cv = jax.lax.dynamic_update_slice(cv, va.astype(cv.dtype),
                                               (0, off, 0, 0))
-            q_pos = off + jnp.arange(s)[:, None]      # [s, 1]
-            k_pos = jnp.arange(s_max)[None, :]        # [1, s_max]
-            mask = (k_pos <= q_pos)[None]             # [1, s, s_max]
-        qf = qa.astype(jnp.float32)
-        kf = ck.astype(jnp.float32)
-        if h_q == h_kv:
-            logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * sc
-            logits = jnp.where(mask[:, None], logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cv.dtype), cv)
-        else:                                         # grouped-query
-            rep = h_q // h_kv
-            qg = qf.reshape(b, s, h_kv, rep, d)
-            logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, kf) * sc
-            logits = jnp.where(mask[:, None, None], logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1)
-            out = jnp.einsum("bhrqk,bkhd->bqhrd", probs.astype(cv.dtype),
-                             cv).reshape(b, s, h_q, d)
-        return out.astype(qa.dtype), ck, cv
+        out = _cache_attend(qa, ck, cv, off, scale)
+        return out, ck, cv
 
     return apply_op("masked_multihead_attention", fn,
                     (q, k, v, cache_k, cache_v, offset))
+
+
+def _cache_attend(qa, ck, cv, off, scale):
+    """Causal attention of `qa` [B, S, Hq, D] against a full cache
+    view `ck`/`cv` [B, S_max, Hkv, D] at per-row ([B]) or scalar
+    offsets — the computation shared by the dense slot cache and the
+    paged cache, so identical cache contents give bitwise-identical
+    outputs regardless of the storage layout (masked positions
+    contribute exactly 0 after softmax underflow, so even different
+    S_max capacities agree).  GQA groups Q heads onto the kv heads
+    inside the einsum."""
+    import math as _math
+
+    b, s, h_q, d = qa.shape
+    s_max, h_kv = ck.shape[1], ck.shape[2]
+    sc = scale if scale is not None else 1.0 / _math.sqrt(d)
+    if off.ndim == 1:
+        q_pos = off[:, None, None] + jnp.arange(s)[None, :, None]
+        k_pos = jnp.arange(s_max)[None, None, :]
+        mask = k_pos <= q_pos                     # [b, s, s_max]
+    else:
+        q_pos = off + jnp.arange(s)[:, None]      # [s, 1]
+        k_pos = jnp.arange(s_max)[None, :]        # [1, s_max]
+        mask = (k_pos <= q_pos)[None]             # [1, s, s_max]
+    qf = qa.astype(jnp.float32)
+    kf = ck.astype(jnp.float32)
+    if h_q == h_kv:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * sc
+        logits = jnp.where(mask[:, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cv.dtype), cv)
+    else:                                         # grouped-query
+        rep = h_q // h_kv
+        qg = qf.reshape(b, s, h_kv, rep, d)
+        logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, kf) * sc
+        logits = jnp.where(mask[:, None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhrqk,bkhd->bqhrd", probs.astype(cv.dtype),
+                         cv).reshape(b, s, h_q, d)
+    return out.astype(qa.dtype)
+
+
+def paged_masked_multihead_attention(q, k, v, k_pool, v_pool, page_table,
+                                     offset, page_size, scale=None,
+                                     name=None):
+    """Decode/chunked-prefill attention against a PAGED KV cache
+    (serving/paged_kv.py — the vLLM PagedAttention layout kept
+    static-shape for TPU).
+
+    q/k/v: [B, S, H, D] new tokens; k_pool/v_pool: [P, page_size, Hkv,
+    D] fixed page pools shared by every sequence; page_table: int32
+    [B, N] mapping each row's logical pages to physical pool pages;
+    offset: int32 [B] tokens already cached per row.  Writes the new
+    K/V through the page table at offset..offset+S per row (rows whose
+    table entries are 0 scatter into the reserved scratch page — how
+    free/ungrown slots ride the static batch harmlessly), gathers each
+    row's logical [N*page_size] cache view, and attends causally with
+    exactly `masked_multihead_attention`'s math — so paged and dense
+    caches holding the same values produce bit-identical outputs.
+
+    On TPU (or with ``PADDLE_TPU_PAGED_PALLAS=1`` under interpret
+    mode) the single-token decode read runs the Pallas kernel
+    (`pallas.flash_attention.paged_decode_attention`) that streams
+    pages via a scalar-prefetched page table instead of materializing
+    the gather; its online softmax is numerically (not bitwise)
+    equivalent, so the XLA gather path stays the default off-TPU.
+    """
+    import os as _os
+
+    psz = int(page_size)
+    s_new = q.shape[1] if hasattr(q, "shape") else 0
+    n_pages = page_table.shape[1]
+    s_cap = n_pages * psz
+    off_concrete = None
+    try:
+        import numpy as _np
+        raw = offset._data_ if isinstance(offset, Tensor) else offset
+        if not isinstance(raw, jax.core.Tracer):
+            off_concrete = _np.asarray(raw)
+    except Exception:
+        pass   # traced offset: caller owns the bound
+    if off_concrete is not None and (off_concrete + s_new > s_cap).any():
+        raise ValueError(
+            f"paged KV cache overflow: offset {off_concrete} + {s_new} "
+            f"new tokens > page-table capacity {s_cap}")
+
+    env = _os.environ.get("PADDLE_TPU_PAGED_PALLAS", "")
+    from ....pallas import flash_attention as _fa
+    use_kernel = (s_new == 1 and env != "0"
+                  and (_fa._on_tpu() or
+                       (env == "1" and _fa._interpret())))
+
+    def fn(qa, ka, va, kp, vp, pt, off):
+        b, s, h_q, d = qa.shape
+        off = off.astype(jnp.int32)
+        pos = off[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        page_ids = jnp.take_along_axis(pt.astype(jnp.int32),
+                                       pos // psz, axis=1)
+        in_page = pos % psz
+        kp = kp.at[page_ids, in_page].set(ka.astype(kp.dtype))
+        vp = vp.at[page_ids, in_page].set(va.astype(vp.dtype))
+        if use_kernel:
+            out = _fa.paged_decode_attention(
+                qa[:, 0], kp, vp, pt.astype(jnp.int32), off,
+                scale=scale)[:, None]
+        else:
+            h_kv = kp.shape[2]
+            kf = kp[pt].reshape(b, n_pages * psz, h_kv, d)
+            vf = vp[pt].reshape(b, n_pages * psz, h_kv, d)
+            out = _cache_attend(qa, kf, vf, off, scale)
+        return out, kp, vp
+
+    return apply_op("paged_masked_multihead_attention", fn,
+                    (q, k, v, k_pool, v_pool, page_table, offset))
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):

@@ -86,8 +86,17 @@ class GPTAttention(Layer):
         if cache is not None:
             # decode path: static-shape attention against the KV cache
             from ..incubate.nn import functional as IF
-            out, cache["k"], cache["v"] = IF.masked_multihead_attention(
-                q, k, v, cache["k"], cache["v"], cache["offset"])
+            if "page_table" in cache:
+                # paged serving cache: K/V live in a shared page pool
+                # addressed through this row's page table
+                out, cache["k_pool"], cache["v_pool"] = \
+                    IF.paged_masked_multihead_attention(
+                        q, k, v, cache["k_pool"], cache["v_pool"],
+                        cache["page_table"], cache["offset"],
+                        cache["page_size"])
+            else:
+                out, cache["k"], cache["v"] = IF.masked_multihead_attention(
+                    q, k, v, cache["k"], cache["v"], cache["offset"])
         else:
             # head-major [B, H, S, D] into the flash kernels: the
             # relayout fuses into the qkv-projection epilogue instead of

@@ -108,8 +108,15 @@ class LlamaAttention(Layer):
         if cache is not None:
             # cache stores PRE-repeat K/V (num_kv_heads) — the MMHA op
             # groups Q heads natively, so GQA keeps its memory win
-            out, cache["k"], cache["v"] = IF.masked_multihead_attention(
-                q, k, v, cache["k"], cache["v"], cache["offset"])
+            if "page_table" in cache:
+                out, cache["k_pool"], cache["v_pool"] = \
+                    IF.paged_masked_multihead_attention(
+                        q, k, v, cache["k_pool"], cache["v_pool"],
+                        cache["page_table"], cache["offset"],
+                        cache["page_size"])
+            else:
+                out, cache["k"], cache["v"] = IF.masked_multihead_attention(
+                    q, k, v, cache["k"], cache["v"], cache["offset"])
         else:
             # K/V stay at num_kv_heads: the flash kernels index the shared
             # kv head natively (q_head // n_rep in the BlockSpecs), so GQA

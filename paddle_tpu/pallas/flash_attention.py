@@ -727,6 +727,119 @@ def autotune_blocks(s, d, dtype=jnp.bfloat16, batch=1, heads=1):
     return fwd_blocks, bwd_blocks
 
 
+# ------------------------------------------------------------------
+# Paged decode attention: page-table-aware gather/masking for the
+# serving engine's paged KV cache (serving/paged_kv.py)
+# ------------------------------------------------------------------
+
+def _paged_decode_kernel(pt_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, scale, page_size):
+    """One (batch, kv_head, page) step of a single-token decode.
+
+    The page axis is innermost: scratch (m, l, acc) carries the online
+    softmax across a row's pages.  Which physical page this step reads
+    was decided by the BlockSpec index map from the scalar-prefetched
+    page table — the kernel body only sees the already-gathered block.
+    Pages past the row's offset are skipped (their fetch is clamped to
+    the last live page, so Mosaic dedupes the DMA)."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    off = off_ref[b]
+    live = j * page_size <= off
+
+    @pl.when(live)
+    def _compute():
+        qf = q_ref[:].astype(jnp.float32)       # [n_rep, d]
+        kf = k_ref[:].astype(jnp.float32)       # [page_size, d]
+        vf = v_ref[:].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qf, kf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        k_pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= off, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = alpha * acc_scr[:] + jnp.dot(
+            p, vf, preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)  # noqa: E741
+        o_ref[:] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, page_table, offsets,
+                           scale=None):
+    """Single-token decode attention over a paged KV cache.
+
+    q: [B, H, D] this step's queries; k_pool/v_pool: [P, page_size,
+    H_kv, D] physical page pools; page_table: int32 [B, N] logical →
+    physical page map; offsets: int32 [B] — row b attends positions
+    <= offsets[b] (its freshly written token included).
+
+    The page table and offsets ride ``PrefetchScalarGridSpec`` scalar
+    prefetch, so the K/V BlockSpec index maps dereference them to pick
+    each grid step's physical page — the paged gather never
+    materializes a contiguous [B, N*page_size] cache copy the way the
+    XLA fallback does.  GQA is native: Q is regrouped [B, H_kv, n_rep,
+    D] and each kv head's block serves its n_rep query heads.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    psz, h_kv = k_pool.shape[1], k_pool.shape[2]
+    n_pages = page_table.shape[1]
+    n_rep = h // h_kv
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    qg = q.reshape(b, h_kv, n_rep, d)
+
+    def q_index(bi, hi, j, pt, off):
+        return (bi, hi, 0, 0)
+
+    def kv_index(bi, hi, j, pt, off):
+        # dead pages (past the row's offset) clamp to the last live
+        # page so the skipped steps re-fetch a block already resident
+        j_live = jnp.minimum(j, off[bi] // psz)
+        return (pt[bi, j_live], 0, hi, 0)
+
+    q_spec = pl.BlockSpec((None, None, n_rep, d), q_index)
+    kv_spec = pl.BlockSpec((None, psz, None, d), kv_index)
+    kernel = functools.partial(_paged_decode_kernel, scale=sc,
+                               page_size=psz)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h_kv, n_pages),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((n_rep, 1), jnp.float32),
+                        pltpu.VMEM((n_rep, 1), jnp.float32),
+                        pltpu.VMEM((n_rep, d), jnp.float32)])
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, n_rep, d), q.dtype),
+        interpret=_interpret(),
+    )(page_table.astype(jnp.int32), offsets.astype(jnp.int32),
+      qg, k_pool, v_pool)
+    return out.reshape(b, h, d)
+
+
 def _supports_pallas(q, k, v, attn_mask, segment_ids):
     if not (_on_tpu() or _interpret()):
         return False

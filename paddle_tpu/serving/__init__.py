@@ -3,10 +3,12 @@
 The single-shot entry points (`models.generation.generate`,
 `inference.Predictor.run`) decode one fixed batch to completion.  This
 package turns the compile-once decode step into a multi-tenant server:
-slot-based KV caches (`kv_slots`), a background scheduler with
-Orca-style continuous batching (`engine`), admission control with
-bounded queueing and per-request deadlines (`api`), and serving metrics
-through `utils.monitor` (`stats`).  See docs/SERVING.md.
+a paged KV cache with shared-prefix reuse and chunked prefill
+(`paged_kv`, the default) or fixed per-slot stripes (`kv_slots`), a
+background scheduler with Orca-style continuous batching (`engine`),
+admission control with bounded queueing and per-request deadlines
+(`api`), and serving metrics through `utils.monitor` (`stats`).  See
+docs/SERVING.md.
 """
 from __future__ import annotations
 
@@ -17,11 +19,12 @@ from .api import (  # noqa: F401
 )
 from .engine import Engine  # noqa: F401
 from .kv_slots import SlotKVCache  # noqa: F401
+from .paged_kv import PagedKVCache, PrefixTree  # noqa: F401
 from .stats import reset_serving_stats, serving_stats  # noqa: F401
 
 __all__ = [
     "Engine", "ServingConfig", "SamplingParams", "RequestOutput",
-    "SlotKVCache", "ServingError", "QueueFullError",
-    "DeadlineExceededError", "EngineShutdownError",
+    "SlotKVCache", "PagedKVCache", "PrefixTree", "ServingError",
+    "QueueFullError", "DeadlineExceededError", "EngineShutdownError",
     "SchedulerStallError", "serving_stats", "reset_serving_stats",
 ]

@@ -99,6 +99,26 @@ class ServingConfig:
     max_scheduler_restarts   bounded retries for the scheduler loop
                              after a crash or stall before the engine
                              gives up and stops accepting work
+    kv_layout                "paged" (default): block-granular KV pages
+                             with lazy per-page growth, shared-prefix
+                             reuse and chunked prefill
+                             (serving/paged_kv.py); "slots": the PR 3
+                             fixed [num_slots, max_seq_len] stripes
+    page_size                tokens per KV page (paged layout); pick a
+                             divisor of max_seq_len
+    kv_pool_pages            physical pages in the pool (paged layout);
+                             None → num_slots * ceil(max_seq_len /
+                             page_size), i.e. the same bytes the slot
+                             layout preallocates
+    enable_prefix_cache      keep released prompt pages in a refcounted
+                             prefix tree so requests sharing a system
+                             prompt reuse its KV instead of recomputing
+                             prefill (paged layout only)
+    prefill_chunk_tokens     prompts prefill this many tokens per
+                             scheduler iteration, interleaved with
+                             decode steps, so a long prompt cannot
+                             starve in-flight streams (paged layout;
+                             one compiled prefill program total)
     """
 
     num_slots: int = 4
@@ -112,6 +132,11 @@ class ServingConfig:
     drain_grace_s: float = 30.0
     step_timeout_s: float = 0.0
     max_scheduler_restarts: int = 2
+    kv_layout: str = "paged"
+    page_size: int = 16
+    kv_pool_pages: int | None = None
+    enable_prefix_cache: bool = True
+    prefill_chunk_tokens: int = 32
 
     def validate(self):
         if self.num_slots < 1:
@@ -120,6 +145,18 @@ class ServingConfig:
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got "
                              f"{self.max_queue}")
+        if self.kv_layout not in ("paged", "slots"):
+            raise ValueError("kv_layout must be 'paged' or 'slots', "
+                             f"got {self.kv_layout!r}")
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got "
+                             f"{self.page_size}")
+        if self.kv_pool_pages is not None and self.kv_pool_pages < 1:
+            raise ValueError(f"kv_pool_pages must be >= 1, got "
+                             f"{self.kv_pool_pages}")
+        if self.prefill_chunk_tokens < 1:
+            raise ValueError(f"prefill_chunk_tokens must be >= 1, got "
+                             f"{self.prefill_chunk_tokens}")
         if self.deadline_policy not in ("evict", "ignore"):
             raise ValueError(
                 "deadline_policy must be 'evict' or 'ignore', got "

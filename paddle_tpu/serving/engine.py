@@ -40,7 +40,8 @@ from .kv_slots import SlotKVCache
 class _Request:
     __slots__ = ("id", "prompt", "max_new_tokens", "sampling",
                  "eos_token_id", "deadline", "future", "submit_t",
-                 "ttft_ms", "tokens", "seen", "last_token", "slot")
+                 "ttft_ms", "tokens", "seen", "last_token", "slot",
+                 "prefill_pos", "shared_len", "prefix_nodes")
 
     def __init__(self, rid, prompt, max_new_tokens, sampling,
                  eos_token_id, deadline):
@@ -57,6 +58,9 @@ class _Request:
         self.seen = None            # [V] bool, only under rep penalty
         self.last_token = 0
         self.slot = None
+        self.prefill_pos = 0        # next prompt token to prefill (paged)
+        self.shared_len = 0         # prompt tokens reused from the tree
+        self.prefix_nodes = []      # tree nodes this request references
 
 
 class Engine:
@@ -76,6 +80,11 @@ class Engine:
                                  self.cfg.num_heads)
         self._queue: deque[_Request] = deque()
         self._active: dict[int, _Request] = {}
+        # requests holding a slot whose prompt is mid-(chunked-)prefill
+        self._prefilling: deque[_Request] = deque()
+        self._paged = self.scfg.kv_layout == "paged"
+        self.prefix_tree = None
+        self._max_active = 0
         # EVERY unresolved request, from submit() until its future
         # resolves — the audit set _fail_all drains.  A request can be
         # outside both _queue and _active (popped for admission, prefill
@@ -109,10 +118,8 @@ class Engine:
             if self._running:
                 return self
             stats.reset_serving_stats()
-            self.cache = SlotKVCache(
-                self.cfg.num_layers, self.scfg.num_slots, self.max_len,
-                self._kv_heads, self.cfg.head_dim,
-                dtype=self.scfg.cache_dtype)
+            self.cache = self._new_cache()
+            self._max_active = 0
             self._running = True
             self._draining = False
             self._restarts = 0
@@ -127,6 +134,28 @@ class Engine:
                 name="paddle-tpu-serving-watchdog", daemon=True)
             self._monitor.start()
         return self
+
+    def _new_cache(self):
+        """Fresh KV storage (and prefix tree) for a (re)started loop."""
+        if self._paged:
+            from .paged_kv import PagedKVCache, PrefixTree
+            cache = PagedKVCache(
+                self.cfg.num_layers, self.scfg.num_slots, self.max_len,
+                self._kv_heads, self.cfg.head_dim,
+                page_size=self.scfg.page_size,
+                num_pages=self.scfg.kv_pool_pages,
+                dtype=self.scfg.cache_dtype)
+            self.prefix_tree = PrefixTree(self.scfg.page_size) \
+                if self.scfg.enable_prefix_cache else None
+            # one compiled prefill program: every chunk is this wide
+            self._chunk = min(self.scfg.prefill_chunk_tokens,
+                              cache.capacity)
+            self._prefilling.clear()
+            return cache
+        return SlotKVCache(
+            self.cfg.num_layers, self.scfg.num_slots, self.max_len,
+            self._kv_heads, self.cfg.head_dim,
+            dtype=self.scfg.cache_dtype)
 
     def shutdown(self, wait_s=30.0):
         """Stop the scheduler.  In-flight and queued futures resolve
@@ -181,7 +210,8 @@ class Engine:
                 f"engine draining: request {req.id} was still queued"))
             stats.incr("requests_cancelled_drain")
         deadline = time.monotonic() + deadline_s
-        while self._active and time.monotonic() < deadline:
+        while (self._active or self._prefilling) and \
+                time.monotonic() < deadline:
             time.sleep(0.01)
         _fr.record("serving", "drain_end",
                    unfinished=len(self._active))
@@ -228,6 +258,18 @@ class Engine:
         if max_new < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got "
                              f"{max_new}")
+        if self._paged:
+            # infeasible requests are rejected up front: admission
+            # backpressure only helps when the pool could EVER fit it
+            psz = self.scfg.page_size
+            pool = self.scfg.kv_pool_pages or \
+                self.scfg.num_slots * (-(-self.max_len // psz))
+            need = -(-min(prompt.size + max_new, self.max_len) // psz)
+            if need > pool:
+                raise ValueError(
+                    f"request needs {need} KV pages (prompt "
+                    f"{prompt.size} + max_new {max_new}) but the pool "
+                    f"holds {pool}; raise ServingConfig.kv_pool_pages")
         deadline = (time.monotonic() + deadline_s) \
             if deadline_s is not None else None
         req = _Request(next(self._ids), prompt, max_new, sampling,
@@ -300,13 +342,9 @@ class Engine:
                             self._running = False
                         raise
                     self._restarts += 1
-                    # the crash may have left slots torn mid-write:
-                    # rebuild rather than trust them
-                    self.cache = SlotKVCache(
-                        self.cfg.num_layers, self.scfg.num_slots,
-                        self.max_len, self._kv_heads,
-                        self.cfg.head_dim,
-                        dtype=self.scfg.cache_dtype)
+                    # the crash may have left slots/pages torn
+                    # mid-write: rebuild rather than trust them
+                    self.cache = self._new_cache()
         finally:
             self._fail_all(EngineShutdownError("engine shut down"))
             stats.set_value("active_slots", 0)
@@ -323,19 +361,38 @@ class Engine:
                     self._expire_queued_locked()
                     admits = []
                     while self._queue and self.cache.free_slots:
-                        slot = self.cache.allocate()
-                        admits.append((self._queue.popleft(), slot))
+                        if self._paged:
+                            slot = self._try_admit_paged(self._queue[0])
+                            if slot is None:
+                                break       # page backpressure: FIFO
+                            admits.append((self._queue.popleft(), slot))
+                        else:
+                            slot = self.cache.allocate()
+                            admits.append((self._queue.popleft(), slot))
                     stats.set_value("queue_depth", len(self._queue))
-                    if not admits and not self._active:
+                    if not admits and not self._active \
+                            and not self._prefilling:
                         self._iter_deadline = None
                         self._work.wait(self.scfg.idle_wait_s)
                         continue
                 if budget > 0:
                     self._iter_deadline = time.monotonic() + budget
-                for req, slot in admits:
-                    self._prefill(req, slot)
+                if self._paged:
+                    for req, slot in admits:
+                        self._start_prefill(req, slot)
+                    # ONE batched chunk call covers every prefilling
+                    # request, then the decode step runs: long prompts
+                    # advance without ever blocking in-flight streams
+                    # for more than a chunk
+                    if self._prefilling:
+                        self._prefill_round()
+                else:
+                    for req, slot in admits:
+                        self._prefill(req, slot)
                 if self._active:
                     self._decode_step()
+                if self._paged:
+                    self._publish_pool_stats()
                 self._iter_deadline = None
 
     def _stall_monitor(self):
@@ -414,6 +471,130 @@ class Engine:
         self._append_token(req, tok)
         stats.set_value("active_slots", len(self._active))
 
+    # ---------------- paged scheduler (kv_layout="paged") ----------------
+    def _try_admit_paged(self, req):
+        """Reserve a slot + worst-case page budget for `req` (called
+        under the lock).  Matches the prompt against the prefix tree
+        first — shared pages shrink the reservation — and evicts LRU
+        zero-ref tree pages under pool pressure.  Returns the slot, or
+        None when the pool cannot promise the pages yet (the request
+        stays queued: backpressure, never a crash)."""
+        psz = self.scfg.page_size
+        total = min(req.prompt.size + req.max_new_tokens, self.max_len)
+        nodes, pages = [], []
+        if self.prefix_tree is not None:
+            nodes, pages = self.prefix_tree.match(req.prompt)
+        need = -(-total // psz) - len(pages)
+        short = need - self.cache.available_pages
+        if short > 0 and self.prefix_tree is not None:
+            freed = self.prefix_tree.evict(short, self.cache.reclaim)
+            if freed:
+                stats.incr("prefix_cache_evictions", freed)
+        slot = self.cache.allocate(need, pages)
+        if slot is None:
+            if nodes:
+                self.prefix_tree.release(nodes)
+            return None
+        if self.prefix_tree is not None:
+            stats.incr("prefix_cache_hits" if pages
+                       else "prefix_cache_misses")
+            if pages:
+                stats.incr("prefix_cache_hit_tokens", len(pages) * psz)
+        req.prefix_nodes = nodes
+        req.shared_len = len(pages) * psz
+        return slot
+
+    def _start_prefill(self, req, slot):
+        """Arm chunked prefill: the slot's clock starts at the shared
+        prefix length — those tokens' KV pages came from the tree and
+        are never recomputed."""
+        req.slot = slot
+        req.prefill_pos = req.shared_len
+        self.cache.set_offset(slot, req.shared_len)
+        self._prefilling.append(req)
+
+    def _prefill_round(self):
+        """One `prefill_chunk_tokens`-wide chunk for EVERY prefilling
+        request, batched into a single model call, THEN the decode step
+        runs — long prompts no longer starve in-flight streams, and a
+        burst of admissions costs one call, not one per request.
+
+        Static shapes: every round is the same [num_slots, C] program
+        (surplus rows ride the scratch page like free decode slots).
+        A final short chunk is left-shifted to start at ``min(offset,
+        capacity - C)`` — re-fed positions recompute bitwise-identical
+        K/V (same tokens, same cache contents), and pad positions past
+        the prompt scatter into unassigned table entries, i.e. the
+        scratch page, which no causal mask ever exposes."""
+        from ..core.tensor import Tensor
+        from ..profiler import RecordEvent
+        now = time.monotonic()
+        if self.scfg.deadline_policy == "evict":
+            for req in list(self._prefilling):
+                if req.deadline is not None and now > req.deadline:
+                    self._prefilling.remove(req)
+                    self._fail(req, DeadlineExceededError(
+                        f"request {req.id} exceeded its deadline "
+                        f"mid-prefill at {req.prefill_pos}/"
+                        f"{req.prompt.size} tokens"))
+                    stats.incr("requests_evicted_deadline")
+                    self._release(req)
+        if not self._prefilling:
+            return
+        reqs = list(self._prefilling)       # each holds a slot: <= B
+        chunk = self._chunk
+        cap = self.cache.capacity
+        tokens = np.zeros((self.cache.num_slots, chunk), np.int32)
+        starts = []
+        for row, req in enumerate(reqs):
+            off = req.prefill_pos
+            start = min(off, cap - chunk)
+            seg = req.prompt[start:min(start + chunk, req.prompt.size)]
+            tokens[row, :seg.size] = seg
+            new_real = min(start + chunk, req.prompt.size) - off
+            self.cache.ensure_capacity(req.slot, off + new_real - 1)
+            starts.append(start)
+        t0 = time.monotonic()
+        with RecordEvent("serving::prefill",
+                         args={"request_ids": [r.id for r in reqs]}):
+            views = self.cache.prefill_view([r.slot for r in reqs],
+                                            starts)
+            logits = self.model(Tensor(tokens), caches=views)
+            self.cache.absorb_view(views)
+        dt_ms = (time.monotonic() - t0) * 1e3
+        stats.observe("prefill_chunk_ms", dt_ms)
+        stats.observe("prefill_ms", dt_ms)
+        stats.incr("prefill_chunks", len(reqs))
+        for row, req in enumerate(reqs):
+            plen = req.prompt.size
+            start = starts[row]
+            req.prefill_pos = min(start + chunk, plen)
+            self.cache.set_offset(req.slot, req.prefill_pos)
+            if req.prefill_pos < plen:
+                continue
+            # prompt fully cached: sample the first token from the
+            # last REAL position of this row's chunk
+            self._prefilling.remove(req)
+            if req.sampling.uses_penalty:
+                seen = np.zeros(self.cfg.vocab_size, bool)
+                seen[req.prompt] = True
+                req.seen = seen
+            tok = self._sample_row(
+                logits[row:row + 1, plen - 1 - start, :], req)
+            req.ttft_ms = (time.monotonic() - req.submit_t) * 1e3
+            stats.observe("ttft_ms", req.ttft_ms)
+            stats.incr("prefill_steps")
+            if self.prefix_tree is not None:
+                self.prefix_tree.insert(req.prompt, self.cache,
+                                        req.slot, req.prefix_nodes)
+            self._active[req.slot] = req
+            self._append_token(req, tok)
+        stats.set_value("active_slots", len(self._active))
+
+    def _publish_pool_stats(self):
+        stats.set_value("kv_pages_in_use", self.cache.pages_in_use)
+        stats.set_value("kv_pages_free", self.cache.free_page_count)
+
     def _decode_step(self):
         """One batched step over ALL slots: the continuous batch."""
         from ..core.tensor import Tensor
@@ -421,8 +602,17 @@ class Engine:
         from ..tensor_ops import search as S
         t0 = time.monotonic()
         n_active = len(self._active)
+        self._max_active = max(self._max_active, n_active)
+        stats.set_value("max_active_slots", self._max_active)
         rids = sorted(r.id for r in self._active.values())
         with RecordEvent("serving::decode", args={"request_ids": rids}):
+            if self._paged:
+                # page-by-page growth: assign a fresh page only when a
+                # row's write position crosses a page boundary (the
+                # admission reservation guarantees the page exists)
+                for slot in self._active:
+                    self.cache.ensure_capacity(
+                        slot, int(self.cache.offsets[slot]))
             tok_in = np.zeros((self.cache.num_slots, 1), np.int32)
             for slot, req in self._active.items():
                 tok_in[slot, 0] = req.last_token
@@ -526,10 +716,21 @@ class Engine:
                    error=type(exc).__name__)
 
     def _release(self, req):
-        if req.slot is not None and req.slot in self._active:
+        if req.slot is None:
+            return
+        in_active = req.slot in self._active and \
+            self._active[req.slot] is req
+        if in_active:
             del self._active[req.slot]
+        if in_active or self._paged:
+            # paged requests hold pages from admission on (prefill
+            # included); slot-layout requests only own a slot once
+            # active
             self.cache.release(req.slot)
-            req.slot = None
+            if req.prefix_nodes and self.prefix_tree is not None:
+                self.prefix_tree.release(req.prefix_nodes)
+                req.prefix_nodes = []
+        req.slot = None
 
     def _fail_all(self, exc):
         """Fail EVERY outstanding future — queued, mid-admission, and
@@ -541,6 +742,7 @@ class Engine:
             self._pending.clear()
             self._queue.clear()
             self._active.clear()
+            self._prefilling.clear()
         for req in reqs:
             if not req.future.done():
                 self._fail(req, exc)

@@ -44,6 +44,7 @@ class SlotKVCache:
         self.max_len = int(max_len)
         self.offsets = np.zeros(self.num_slots, np.int32)
         self._free = list(range(self.num_slots - 1, -1, -1))
+        self._dirty = False
         shape = [self.num_slots, self.max_len, num_kv_heads, head_dim]
         off = Tensor(jnp.asarray(self.offsets))
         self.layers = [
@@ -68,7 +69,7 @@ class SlotKVCache:
             raise ValueError(f"slot {slot} is already free")
         self.offsets[slot] = 0
         self._free.append(slot)
-        self._sync_offsets()
+        self._dirty = True
 
     # ---------------- cache data ----------------
     def write_prefill(self, slot, prefill_caches, prompt_len):
@@ -86,21 +87,29 @@ class SlotKVCache:
             lay["v"] = Tensor(lay["v"]._data_.at[slot].set(
                 src["v"]._data_[0]))
         self.offsets[slot] = prompt_len
-        self._sync_offsets()
+        self._dirty = True
 
     def advance(self, slots):
         """Bump the offsets of `slots` by one decoded token."""
         idx = list(slots)
         if idx:
             self.offsets[idx] += 1
-        self._sync_offsets()
+        self._dirty = True
 
     def layer_caches(self):
         """The per-layer cache dicts, ready to pass as
-        ``model(tokens, caches=...)`` for the batched decode step."""
+        ``model(tokens, caches=...)`` for the batched decode step.
+        Host-side offset mutations (advance/release/write_prefill) only
+        mark the cache dirty; the ONE shared device offsets array is
+        re-uploaded here, once per scheduler iteration — not once per
+        bookkeeping call per layer as the original `_sync_offsets` did."""
+        self._flush()
         return self.layers
 
-    def _sync_offsets(self):
+    def _flush(self):
+        if not self._dirty:
+            return
         off = Tensor(jnp.asarray(self.offsets))
         for lay in self.layers:
             lay["offset"] = off
+        self._dirty = False

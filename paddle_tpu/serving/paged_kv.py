@@ -1,0 +1,353 @@
+"""Paged KV cache + prefix tree for the serving engine.
+
+`SlotKVCache` reserves a full ``max_seq_len`` stripe per slot up front —
+a request generating 40 tokens from a 10-token prompt squats the same
+HBM as one that fills the slot.  This module brings the PagedAttention
+(vLLM) / RadixAttention (SGLang) memory model to the TPU's static-shape
+regime:
+
+- **Fixed page pool per layer** ``[num_pages, page_size, H, D]`` plus an
+  int32 page table ``[num_slots, pages_per_slot]`` and per-slot offsets.
+  Shapes never change: the decode step stays ONE compiled XLA program
+  (page-table/offset *values* are runtime data), while physical pages
+  are assigned to a slot lazily as its sequence grows.
+- **Scratch page 0** is never allocated.  Free slots (and table entries
+  not yet grown into) point at it, so the static-shape batch's dummy
+  writes land in scratch and the per-row causal mask keeps every live
+  row blind to it — the paged analog of SlotKVCache's "free slots ride
+  the batch harmlessly".
+- **Prefix tree** (`PrefixTree`): refcounted, page-granular radix tree
+  over prompt tokens.  Requests that share a system prompt attach the
+  shared pages to their page table instead of recomputing prefill;
+  pages whose refcount drops to zero stay cached until pool pressure
+  evicts them LRU.  Shared pages are only ever *read*: a page enters
+  the tree only when the prompt covers it entirely, and every write a
+  slot performs lands at positions >= its private boundary.
+
+Admission-time **reservations** make growth safe: `allocate()` records
+how many pages the request may still claim (its worst case, ``ceil(
+min(prompt+max_new, max_len)/page_size)`` minus shared), and
+`available_pages` subtracts outstanding reservations — so admission
+backpressure happens up front and `ensure_capacity` can never fail
+mid-decode.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class PagedKVCache:
+    """Block-granular KV storage behind the same scheduler-facing
+    surface as `SlotKVCache` (allocate/release/advance/layer_caches)
+    plus the page machinery (`ensure_capacity`, `prefill_view`,
+    `make_shared`, `reclaim`).
+
+    Host-side bookkeeping is plain numpy; device uploads are batched:
+    mutations only mark the cache dirty, and `layer_caches()` uploads
+    the offsets + page table ONCE per scheduler iteration (the same
+    lazy-flush contract as `SlotKVCache`).
+    """
+
+    def __init__(self, num_layers, num_slots, max_len, num_kv_heads,
+                 head_dim, page_size=16, num_pages=None, dtype="float32"):
+        self.num_slots = int(num_slots)
+        self.page_size = int(page_size)
+        self.max_len = int(max_len)
+        self.pages_per_slot = -(-self.max_len // self.page_size)
+        #: attention capacity per slot — max_len rounded up to pages
+        self.capacity = self.pages_per_slot * self.page_size
+        #: pages a request can actually hold K/V in (excludes scratch)
+        self.usable_pages = int(num_pages) if num_pages else \
+            self.num_slots * self.pages_per_slot
+        if self.usable_pages < 1:
+            raise ValueError(
+                f"kv_pool_pages must be >= 1, got {self.usable_pages}")
+        total = self.usable_pages + 1          # + scratch page 0
+        self.offsets = np.zeros(self.num_slots, np.int32)
+        self.table = np.zeros((self.num_slots, self.pages_per_slot),
+                              np.int32)
+        self._free_pages = list(range(total - 1, 0, -1))
+        self._free_slots = list(range(self.num_slots - 1, -1, -1))
+        self._private = {}       # slot -> [page ids owned by the slot]
+        self._shared = {}        # slot -> leading tree-owned page count
+        self._reserved = {}      # slot -> pages it may still claim
+        self._dirty = True
+        pool_shape = [total, self.page_size, num_kv_heads, head_dim]
+        self.layers = [
+            {"k_pool": Tensor(jnp.zeros(pool_shape, dtype=dtype)),
+             "v_pool": Tensor(jnp.zeros(pool_shape, dtype=dtype)),
+             "page_table": None, "offset": None,
+             "page_size": self.page_size}
+            for _ in range(num_layers)]
+        self._flush()
+
+    # ---------------- pool accounting ----------------
+    @property
+    def free_slots(self):
+        return len(self._free_slots)
+
+    @property
+    def free_page_count(self):
+        return len(self._free_pages)
+
+    @property
+    def pages_in_use(self):
+        return self.usable_pages - len(self._free_pages)
+
+    @property
+    def available_pages(self):
+        """Pages admission may promise to a NEW request: the free list
+        minus what already-admitted requests may still claim."""
+        return len(self._free_pages) - sum(self._reserved.values())
+
+    # ---------------- slot lifecycle ----------------
+    def allocate(self, reserve_pages, shared_pages=()):
+        """Reserve a slot whose sequence may grow into `reserve_pages`
+        fresh pages, with `shared_pages` (tree-owned, already full)
+        prefixed onto its page table.  Returns the slot index, or None
+        when no slot or not enough uncommitted pages remain — the
+        caller keeps the request queued (backpressure, never a crash)."""
+        if not self._free_slots or reserve_pages > self.available_pages:
+            return None
+        slot = self._free_slots.pop()
+        for i, page in enumerate(shared_pages):
+            self.table[slot, i] = page
+        self._shared[slot] = len(shared_pages)
+        self._private[slot] = []
+        self._reserved[slot] = int(reserve_pages)
+        self.offsets[slot] = 0
+        self._dirty = True
+        return slot
+
+    def release(self, slot):
+        """Free the slot: its private pages return to the pool, its
+        remaining reservation is dropped, and its table row falls back
+        to the scratch page.  Tree-owned (shared) pages are NOT freed
+        here — the prefix tree's refcounts govern those."""
+        if slot in self._free_slots:
+            raise ValueError(f"slot {slot} is already free")
+        self._free_pages.extend(self._private.pop(slot, ()))
+        self._shared.pop(slot, None)
+        self._reserved.pop(slot, None)
+        self.table[slot, :] = 0
+        self.offsets[slot] = 0
+        self._free_slots.append(slot)
+        self._dirty = True
+
+    def ensure_capacity(self, slot, pos):
+        """Assign physical pages so position `pos` is writable.  Called
+        before every write that may cross a page boundary; the
+        admission-time reservation guarantees the pop cannot fail."""
+        need_idx = int(pos) // self.page_size
+        assigned = self._shared.get(slot, 0) + len(self._private[slot])
+        while assigned <= need_idx:
+            if not self._free_pages:      # pragma: no cover - reserved
+                raise RuntimeError(
+                    "KV page pool exhausted past its reservations — "
+                    "admission accounting bug")
+            if self._reserved[slot] <= 0:  # pragma: no cover - reserved
+                raise RuntimeError(
+                    f"slot {slot} grew past its page reservation")
+            page = self._free_pages.pop()
+            self._reserved[slot] -= 1
+            self._private[slot].append(page)
+            self.table[slot, assigned] = page
+            assigned += 1
+            self._dirty = True
+
+    def set_offset(self, slot, off):
+        self.offsets[slot] = int(off)
+        self._dirty = True
+
+    def advance(self, slots):
+        """Bump the offsets of `slots` by one decoded token."""
+        idx = list(slots)
+        if idx:
+            self.offsets[idx] += 1
+        self._dirty = True
+
+    # ---------------- prefix-tree ownership transfer ----------------
+    def make_shared(self, slot, table_index):
+        """Transfer the page at `table_index` of the slot's table from
+        slot-private to caller (tree) ownership; returns its id.  The
+        slot keeps using the page — only who frees it changes."""
+        shared = self._shared.get(slot, 0)
+        # the shared prefix stays contiguous: pages become shared in
+        # order, so the boundary just advances
+        if table_index != shared:
+            raise ValueError(
+                f"non-contiguous share: index {table_index} with "
+                f"shared boundary {shared}")
+        page = int(self.table[slot, table_index])
+        self._private[slot].remove(page)
+        self._shared[slot] = shared + 1
+        return page
+
+    def reclaim(self, page):
+        """Return a tree-owned page to the free pool (LRU eviction)."""
+        self._free_pages.append(int(page))
+
+    # ---------------- device views ----------------
+    def layer_caches(self):
+        """Per-layer cache dicts for the batched decode step.  Flushes
+        the (single, shared) offsets + page-table device arrays if any
+        host-side mutation happened since the last call."""
+        self._flush()
+        return self.layers
+
+    def prefill_view(self, slots, starts):
+        """Per-layer cache dicts for one BATCHED prefill-chunk call:
+        always [num_slots] rows (static shape — one compiled prefill
+        program total), row i carrying `slots[i]`'s page-table row at
+        write offset `starts[i]`; surplus rows point at the scratch
+        page, so their pad writes vanish like any free slot's.  Pool
+        updates made by the model call are pulled back with
+        `absorb_view`."""
+        table = np.zeros_like(self.table)
+        off = np.zeros(self.num_slots, np.int32)
+        for row, (slot, start) in enumerate(zip(slots, starts)):
+            table[row] = self.table[slot]
+            off[row] = start
+        pt = Tensor(jnp.asarray(table))
+        offt = Tensor(jnp.asarray(off))
+        return [{"k_pool": lay["k_pool"], "v_pool": lay["v_pool"],
+                 "page_table": pt, "offset": offt,
+                 "page_size": self.page_size}
+                for lay in self.layers]
+
+    def absorb_view(self, views):
+        """Adopt the functionally-updated pools from a `prefill_view`
+        model call back into the shared layer dicts."""
+        for lay, view in zip(self.layers, views):
+            lay["k_pool"] = view["k_pool"]
+            lay["v_pool"] = view["v_pool"]
+
+    def _flush(self):
+        if not self._dirty:
+            return
+        off = Tensor(jnp.asarray(self.offsets))
+        pt = Tensor(jnp.asarray(self.table))
+        for lay in self.layers:
+            lay["offset"] = off
+            lay["page_table"] = pt
+        self._dirty = False
+
+
+class _PrefixNode:
+    __slots__ = ("key", "page", "children", "refs", "tick", "parent")
+
+    def __init__(self, key, page, parent):
+        self.key = key
+        self.page = page
+        self.children = {}
+        self.refs = 0
+        self.tick = 0
+        self.parent = parent
+
+
+class PrefixTree:
+    """Page-granular radix tree over prompt tokens (RadixAttention's
+    structure): node = one FULL page of `page_size` prompt tokens
+    holding the physical page that stores its K/V.
+
+    Refcounts count *active requests* using the page.  A released
+    request decrements; pages at refcount zero stay cached (warm
+    prefix) until `evict()` reclaims them LRU under pool pressure.
+    `match` never returns the whole prompt: at least the final token is
+    always recomputed so the engine has last-token logits to sample
+    from."""
+
+    def __init__(self, page_size):
+        self.page_size = int(page_size)
+        self.root = _PrefixNode(None, None, None)
+        self._ticks = itertools.count(1)
+
+    def _page_key(self, prompt, i):
+        p = self.page_size
+        return tuple(np.asarray(prompt[i * p:(i + 1) * p]).tolist())
+
+    def match(self, prompt):
+        """Longest cached page-aligned prefix of `prompt`, capped at
+        ``(len-1)//page_size`` pages.  Acquires a reference on every
+        matched node; returns (nodes, page_ids)."""
+        limit = (len(prompt) - 1) // self.page_size
+        node, nodes, pages = self.root, [], []
+        for i in range(limit):
+            child = node.children.get(self._page_key(prompt, i))
+            if child is None:
+                break
+            child.refs += 1
+            child.tick = next(self._ticks)
+            nodes.append(child)
+            pages.append(child.page)
+            node = child
+        return nodes, pages
+
+    def insert(self, prompt, cache, slot, held_nodes):
+        """Register the prompt's fully-covered pages after its prefill
+        completed, transferring ownership of the slot's corresponding
+        private pages to the tree (refcount 1 for the inserting
+        request).  Nodes in `held_nodes` (this request's match) are
+        skipped; a node inserted concurrently by a twin request stops
+        the walk — our duplicate pages simply stay slot-private.
+        Appends newly created nodes to `held_nodes` and returns how
+        many were inserted."""
+        full = len(prompt) // self.page_size
+        held = set(id(n) for n in held_nodes)
+        node, inserted = self.root, 0
+        for i in range(full):
+            key = self._page_key(prompt, i)
+            child = node.children.get(key)
+            if child is not None:
+                if id(child) not in held:
+                    break               # a twin got here first
+                node = child
+                continue
+            page = cache.make_shared(slot, i)
+            child = _PrefixNode(key, page, node)
+            child.refs = 1
+            child.tick = next(self._ticks)
+            node.children[key] = child
+            held_nodes.append(child)
+            inserted += 1
+            node = child
+        return inserted
+
+    def release(self, nodes):
+        for node in nodes:
+            node.refs -= 1
+
+    def evict(self, n_pages, reclaim):
+        """Free up to `n_pages` pages by pruning LRU zero-ref leaves
+        (interior nodes are protected while descendants exist).  Each
+        victim's page goes through `reclaim`; returns pages freed."""
+        freed = 0
+        while freed < n_pages:
+            victim, best = None, None
+            stack = list(self.root.children.values())
+            while stack:
+                node = stack.pop()
+                if node.children:
+                    stack.extend(node.children.values())
+                elif node.refs == 0 and (best is None or node.tick < best):
+                    victim, best = node, node.tick
+            if victim is None:
+                break
+            del victim.parent.children[victim.key]
+            reclaim(victim.page)
+            freed += 1
+        return freed
+
+    def cached_pages(self):
+        """Total pages the tree currently owns (any refcount)."""
+        count, stack = 0, list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            count += 1
+            stack.extend(node.children.values())
+        return count

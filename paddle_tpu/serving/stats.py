@@ -61,6 +61,15 @@ def serving_stats():
                             full the continuous batch ran
     - ``tokens_per_sec``    generated tokens / engine busy time
                             (prefill + decode wall)
+
+    Paged-cache quantities (kv_layout="paged", zero otherwise):
+    ``kv_pages_in_use``/``kv_pages_free`` pool gauges,
+    ``prefix_cache_hits``/``misses``/``evictions`` and
+    ``prefix_cache_hit_tokens`` tree counters, ``prefill_chunks`` and
+    ``prefill_chunk_ms_avg`` chunked-prefill cadence, and
+    ``max_active_slots`` — the high-water mark of concurrent decoding
+    sequences (the paged pool admits more of them than
+    ``pool_bytes / max_seq_len`` stripes would).
     """
     s = monitor.all_stats()
 
@@ -88,7 +97,16 @@ def serving_stats():
         "scheduler_stalls": g("scheduler_stalls"),
         "tokens_generated": tokens,
         "prefill_steps": g("prefill_steps"),
+        "prefill_chunks": g("prefill_chunks"),
+        "prefill_chunk_ms_avg": avg("prefill_chunk_ms"),
         "decode_steps": g("decode_steps"),
+        "kv_pages_in_use": g("kv_pages_in_use"),
+        "kv_pages_free": g("kv_pages_free"),
+        "prefix_cache_hits": g("prefix_cache_hits"),
+        "prefix_cache_misses": g("prefix_cache_misses"),
+        "prefix_cache_evictions": g("prefix_cache_evictions"),
+        "prefix_cache_hit_tokens": g("prefix_cache_hit_tokens"),
+        "max_active_slots": g("max_active_slots"),
         "ttft_ms_avg": avg("ttft_ms"),
         "per_token_ms_avg": avg("decode_ms"),
         "slot_occupancy": (active_steps / slot_steps) if slot_steps

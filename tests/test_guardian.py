@@ -364,8 +364,12 @@ class _FakeModel:
             time.sleep(self.step_sleep)
         tok = np.asarray(tokens._data_)
         batch, seqlen = tok.shape
+        # causal next-token head at EVERY position (the paged engine's
+        # chunked prefill samples at the last REAL prompt position, not
+        # the last padded one)
         logits = np.zeros((batch, seqlen, VOCAB), np.float32)
-        logits[np.arange(batch), -1, (tok[:, -1] + 1) % VOCAB] = 10.0
+        logits[np.arange(batch)[:, None], np.arange(seqlen)[None],
+               (tok + 1) % VOCAB] = 10.0
         return Tensor(logits)
 
 

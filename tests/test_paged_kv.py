@@ -1,0 +1,404 @@
+"""Paged KV cache serving (serving/paged_kv.py): page-pool bookkeeping,
+pool-exhaustion backpressure, prefix-tree refcounts/eviction, chunked
+prefill equivalence, and the paged attention op/kernel."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import (
+    DeadlineExceededError, Engine, PagedKVCache, PrefixTree,
+    QueueFullError, ServingConfig, serving_stats,
+)
+
+
+def _np(t):
+    return np.asarray(t._data_)
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=2, hidden_size=128, num_heads=4,
+        vocab_size=512, max_seq_len=128))
+    m.eval()
+    return m
+
+
+def _prompts(lens, seed=0, vocab=512):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (n,)).astype("int32") for n in lens]
+
+
+def _ref_greedy(model, prompt, max_new):
+    ids = model.generate(paddle.to_tensor(prompt[None, :]),
+                         max_new_tokens=max_new, temperature=0.0)
+    return _np(ids)[0, prompt.size:]
+
+
+# ------------------------------------------------------------------
+# pool bookkeeping
+# ------------------------------------------------------------------
+
+def test_paged_cache_bookkeeping():
+    cache = PagedKVCache(num_layers=2, num_slots=2, max_len=64,
+                         num_kv_heads=2, head_dim=4, page_size=16,
+                         num_pages=6)
+    assert cache.usable_pages == 6 and cache.pages_in_use == 0
+    assert cache.capacity == 64 and cache.pages_per_slot == 4
+    # reservation counts against availability before any page moves
+    slot = cache.allocate(3)
+    assert slot is not None
+    assert cache.pages_in_use == 0 and cache.available_pages == 3
+    # growth assigns pages lazily, one per boundary crossing
+    cache.ensure_capacity(slot, 0)
+    assert cache.pages_in_use == 1
+    cache.ensure_capacity(slot, 15)           # same page: no-op
+    assert cache.pages_in_use == 1
+    cache.ensure_capacity(slot, 33)           # crosses into page 3
+    assert cache.pages_in_use == 3 and cache.available_pages == 3
+    assert (cache.table[slot, :3] > 0).all()  # scratch page 0 never used
+    assert cache.table[slot, 3] == 0
+    # a second reservation past availability is refused, not crashed
+    assert cache.allocate(4) is None
+    other = cache.allocate(3)
+    assert other is not None and cache.available_pages == 0
+    # release returns private pages AND the unclaimed reservation
+    cache.release(slot)
+    assert cache.pages_in_use == 0 and cache.available_pages == 3
+    with pytest.raises(ValueError, match="already free"):
+        cache.release(slot)
+    cache.release(other)
+    assert cache.available_pages == 6
+    # offsets/page table ride ONE shared device array across layers
+    s2 = cache.allocate(1)
+    cache.set_offset(s2, 5)
+    cache.advance([s2])
+    lays = cache.layer_caches()
+    assert _np(lays[0]["offset"])[s2] == 6
+    assert lays[0]["offset"] is lays[1]["offset"]
+    assert lays[0]["page_table"] is lays[1]["page_table"]
+
+
+def test_submit_rejects_infeasible_request(model):
+    cfg = ServingConfig(num_slots=1, page_size=16, kv_pool_pages=2)
+    with Engine(model, cfg) as eng:
+        with pytest.raises(ValueError, match="KV pages"):
+            eng.submit(np.zeros(40, np.int32), max_new_tokens=20)
+        # a request the pool CAN hold still flows
+        out = eng.submit(np.zeros(10, np.int32),
+                         max_new_tokens=4).result(timeout=300)
+        assert out.output_ids.size == 4
+
+
+def test_pool_exhaustion_backpressure(model):
+    """More concurrent demand than pages: requests queue (never crash),
+    QueueFullError only past max_queue, and everything completes."""
+    # pool fits ONE request at a time (each needs 3 of the 4 pages)
+    cfg = ServingConfig(num_slots=4, page_size=16, kv_pool_pages=4,
+                        max_queue=2, enable_prefix_cache=False)
+    prompts = _prompts([10, 12, 9, 11], seed=5)
+    eng = Engine(model, cfg).start()
+    try:
+        import time
+        first = eng.submit(prompts[0], max_new_tokens=24)
+        t0 = time.monotonic()
+        while serving_stats()["queue_depth"] > 0:      # admitted?
+            time.sleep(0.005)
+            assert time.monotonic() - t0 < 60
+        queued = [eng.submit(p, max_new_tokens=24) for p in prompts[1:3]]
+        with pytest.raises(QueueFullError):
+            eng.submit(prompts[3], max_new_tokens=24)
+        outs = [f.result(timeout=300) for f in [first] + queued]
+        for p, o in zip(prompts[:3], outs):
+            np.testing.assert_array_equal(o.output_ids,
+                                          _ref_greedy(model, p, 24))
+        assert eng.cache.pages_in_use == 0        # all pages returned
+        snap = eng.stats()
+        assert snap["requests_completed"] == 3
+    finally:
+        eng.shutdown()
+
+
+def test_deadline_evict_and_drain_return_all_pages(model):
+    """Satellite: deadline eviction (mid-decode AND mid-prefill) and
+    drain leak no pages across engine restarts."""
+    cfg = ServingConfig(num_slots=2, page_size=16,
+                        enable_prefix_cache=False,
+                        prefill_chunk_tokens=8)
+    (short, long) = _prompts([5, 100], seed=2)
+    eng = Engine(model, cfg).start()
+    try:
+        doomed = eng.submit(short, max_new_tokens=10000, deadline_s=0.05)
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(timeout=300)
+        # a 100-token prompt at 8 tokens/chunk cannot beat a 1ms
+        # deadline: evicted mid-prefill
+        slow = eng.submit(long, max_new_tokens=4, deadline_s=0.001)
+        with pytest.raises(DeadlineExceededError):
+            slow.result(timeout=300)
+        ok = eng.submit(short, max_new_tokens=3).result(timeout=300)
+        np.testing.assert_array_equal(ok.output_ids,
+                                      _ref_greedy(model, short, 3))
+        assert eng.cache.pages_in_use == 0
+        eng.drain(deadline_s=5.0)
+        assert eng.cache.pages_in_use == 0
+    finally:
+        eng.shutdown()
+    # restart reuses nothing stale: fresh pool, requests still exact
+    eng = Engine(model, cfg).start()
+    try:
+        assert eng.cache.pages_in_use == 0
+        out = eng.submit(short, max_new_tokens=4).result(timeout=300)
+        np.testing.assert_array_equal(out.output_ids,
+                                      _ref_greedy(model, short, 4))
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------------------------
+# prefix tree
+# ------------------------------------------------------------------
+
+def test_prefix_tree_refcounts_and_eviction():
+    cache = PagedKVCache(num_layers=1, num_slots=2, max_len=64,
+                         num_kv_heads=2, head_dim=4, page_size=4,
+                         num_pages=8)
+    tree = PrefixTree(page_size=4)
+    prompt = np.arange(10, dtype=np.int32)          # 2 full pages + 2
+    nodes, pages = tree.match(prompt)
+    assert nodes == [] and pages == []
+    slot = cache.allocate(3)
+    for pos in (0, 4, 8):
+        cache.ensure_capacity(slot, pos)
+    held = []
+    assert tree.insert(prompt, cache, slot, held) == 2
+    assert [n.refs for n in held] == [1, 1]
+    assert tree.cached_pages() == 2
+    # a second request matching the prefix bumps refcounts
+    nodes2, pages2 = tree.match(prompt)
+    assert len(pages2) == 2 and [n.refs for n in nodes2] == [2, 2]
+    # match never hands out the whole prompt: last token is recomputed
+    exact = np.arange(8, dtype=np.int32)            # == 2 full pages
+    nodes3, pages3 = tree.match(exact)
+    assert len(pages3) == 1                         # (8-1)//4 == 1 page
+    tree.release(nodes3)
+    # refcounts drop to zero on release...
+    tree.release(held)
+    tree.release(nodes2)
+    assert all(n.refs == 0 for n in held)
+    # ...but pages stay cached (warm) until pool pressure evicts LRU
+    assert tree.cached_pages() == 2
+    freed = tree.evict(10, cache.reclaim)
+    assert freed == 2 and tree.cached_pages() == 0
+    cache.release(slot)
+    assert cache.pages_in_use == 0                  # nothing leaked
+
+
+def test_prefix_reuse_bit_equal_and_counted(model):
+    """Requests sharing a system prompt reuse its KV pages: greedy
+    output stays bit-equal to sequential generate(), hits are counted,
+    and releasing every request drops tree refcounts to zero."""
+    rng = np.random.default_rng(7)
+    prefix = rng.integers(0, 512, (48,)).astype("int32")
+    prompts = [np.concatenate([prefix,
+                               rng.integers(0, 512, (4,)).astype("int32")])
+               for _ in range(3)]
+    cfg = ServingConfig(num_slots=2, page_size=16,
+                        prefill_chunk_tokens=16)
+    with Engine(model, cfg) as eng:
+        warm = eng.submit(prompts[0], max_new_tokens=5).result(timeout=300)
+        np.testing.assert_array_equal(
+            warm.output_ids, _ref_greedy(model, prompts[0], 5))
+        futs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+        outs = [f.result(timeout=300) for f in futs]
+        snap = eng.stats()
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(o.output_ids,
+                                          _ref_greedy(model, p, 5))
+        assert snap["prefix_cache_hits"] >= 3
+        assert snap["prefix_cache_hit_tokens"] >= 3 * 48
+        # every request released: only the tree still owns pages
+        tree_pages = eng.prefix_tree.cached_pages()
+        assert tree_pages >= 3                      # 48-token prefix
+        assert eng.cache.pages_in_use == tree_pages
+
+
+# ------------------------------------------------------------------
+# chunked prefill
+# ------------------------------------------------------------------
+
+def test_chunked_prefill_byte_equal_one_shot(model):
+    """The same prompt prefilled 8 tokens at a time vs in one shot:
+    byte-identical outputs (and both equal generate())."""
+    (p,) = _prompts([50], seed=9)
+    outs = {}
+    for chunk in (8, 128):          # 128 >= prompt: single chunk
+        cfg = ServingConfig(num_slots=2, prefill_chunk_tokens=chunk,
+                            enable_prefix_cache=False)
+        with Engine(model, cfg) as eng:
+            outs[chunk] = eng.submit(p, max_new_tokens=6).result(
+                timeout=300)
+            snap = eng.stats()
+        assert snap["prefill_chunks"] == (7 if chunk == 8 else 1)
+        assert snap["prefill_chunk_ms_avg"] > 0
+    np.testing.assert_array_equal(outs[8].output_ids,
+                                  outs[128].output_ids)
+    np.testing.assert_array_equal(outs[8].output_ids,
+                                  _ref_greedy(model, p, 6))
+
+
+def test_long_prompt_does_not_starve_inflight_decode(model):
+    """Chunked prefill interleaves with decode: a stream that is
+    already decoding keeps producing tokens while a long prompt
+    prefills, instead of stalling for the whole prompt pass."""
+    (short, long) = _prompts([4, 100], seed=13)
+    cfg = ServingConfig(num_slots=2, prefill_chunk_tokens=8,
+                        enable_prefix_cache=False)
+    with Engine(model, cfg) as eng:
+        first = eng.submit(short, max_new_tokens=40)
+        # wait until the short request is decoding
+        import time
+        t0 = time.monotonic()
+        while serving_stats()["active_slots"] < 1:
+            time.sleep(0.005)
+            assert time.monotonic() - t0 < 60
+        before = serving_stats()["decode_steps"]
+        fut = eng.submit(long, max_new_tokens=4)
+        out_long = fut.result(timeout=300)
+        snap = eng.stats()
+        out_short = first.result(timeout=300)
+    # 100 tokens / 8-token chunks = 13 chunks; decode ran meanwhile
+    assert snap["prefill_chunks"] >= 13
+    assert snap["decode_steps"] - before >= 5
+    np.testing.assert_array_equal(out_short.output_ids,
+                                  _ref_greedy(model, short, 40))
+    np.testing.assert_array_equal(out_long.output_ids,
+                                  _ref_greedy(model, long, 4))
+
+
+def test_paged_admits_more_sequences_than_preallocation(model):
+    """The acceptance bound: with the SAME pool bytes the slot layout
+    spends on 2 × max_seq_len stripes, the paged engine runs 4
+    sequences concurrently."""
+    pages_per_slot = 128 // 16
+    cfg = ServingConfig(num_slots=4, page_size=16,
+                        kv_pool_pages=2 * pages_per_slot,   # 2 stripes
+                        enable_prefix_cache=False)
+    prompts = _prompts([6, 9, 7, 8], seed=21)
+    with Engine(model, cfg) as eng:
+        futs = [eng.submit(p, max_new_tokens=16) for p in prompts]
+        outs = [f.result(timeout=300) for f in futs]
+        snap = eng.stats()
+    for p, o in zip(prompts, outs):
+        np.testing.assert_array_equal(o.output_ids,
+                                      _ref_greedy(model, p, 16))
+    assert snap["max_active_slots"] == 4      # > the 2 stripes' worth
+
+
+# ------------------------------------------------------------------
+# op / kernel equivalence
+# ------------------------------------------------------------------
+
+def test_paged_op_bitwise_matches_dense_op():
+    """Same logical cache through the paged layout and the dense slot
+    layout → bit-identical attention output (the engine's bit-equality
+    guarantee reduces to this)."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.incubate.nn import functional as IF
+    rng = np.random.default_rng(3)
+    B, S_max, H, Hkv, D, psz = 2, 32, 4, 2, 8, 8
+    n_pages = S_max // psz
+    offs = np.array([5, 19], np.int32)
+    dense_k = rng.normal(size=(B, S_max, Hkv, D)).astype(np.float32)
+    dense_v = rng.normal(size=(B, S_max, Hkv, D)).astype(np.float32)
+    q = rng.normal(size=(B, 1, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, 1, Hkv, D)).astype(np.float32)
+    v = rng.normal(size=(B, 1, Hkv, D)).astype(np.float32)
+    # paged copy of the same cache through a shuffled page table
+    table = np.zeros((B, n_pages), np.int32)
+    perm = rng.permutation(np.arange(1, 1 + B * n_pages))
+    k_pool = np.zeros((1 + B * n_pages, psz, Hkv, D), np.float32)
+    v_pool = np.zeros_like(k_pool)
+    for b in range(B):
+        for j in range(n_pages):
+            pg = int(perm[b * n_pages + j])
+            table[b, j] = pg
+            k_pool[pg] = dense_k[b, j * psz:(j + 1) * psz]
+            v_pool[pg] = dense_v[b, j * psz:(j + 1) * psz]
+    out_d, ck, cv = IF.masked_multihead_attention(
+        Tensor(q), Tensor(k), Tensor(v), Tensor(dense_k),
+        Tensor(dense_v), Tensor(offs))
+    out_p, kp, vp = IF.paged_masked_multihead_attention(
+        Tensor(q), Tensor(k), Tensor(v), Tensor(k_pool),
+        Tensor(v_pool), Tensor(table), Tensor(offs), psz)
+    np.testing.assert_array_equal(_np(out_d), _np(out_p))
+    # and the write landed in the right page/position
+    for b in range(B):
+        pg = table[b, offs[b] // psz]
+        np.testing.assert_array_equal(_np(kp)[pg, offs[b] % psz], k[b, 0])
+        np.testing.assert_array_equal(_np(vp)[pg, offs[b] % psz], v[b, 0])
+
+
+def test_paged_pallas_kernel_matches_gather_path():
+    """The Pallas paged-decode kernel (scalar-prefetched page table)
+    agrees with the XLA gather path in interpreter mode."""
+    prev = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")
+    os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+    try:
+        import jax.numpy as jnp
+        from paddle_tpu.pallas.flash_attention import \
+            paged_decode_attention
+        rng = np.random.default_rng(0)
+        B, H, Hkv, D, psz, N = 3, 8, 2, 16, 8, 4
+        P = 1 + B * N
+        k_pool = rng.normal(size=(P, psz, Hkv, D)).astype(np.float32)
+        v_pool = rng.normal(size=(P, psz, Hkv, D)).astype(np.float32)
+        q = rng.normal(size=(B, H, D)).astype(np.float32)
+        pt = rng.permutation(np.arange(1, P)).reshape(B, N) \
+            .astype(np.int32)
+        off = np.array([5, 17, 30], np.int32)
+        out = np.asarray(paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(pt), jnp.asarray(off)))
+        kf = k_pool[pt].reshape(B, N * psz, Hkv, D)
+        vf = v_pool[pt].reshape(B, N * psz, Hkv, D)
+        rep = H // Hkv
+        qg = q.reshape(B, Hkv, rep, D)
+        ref = np.zeros((B, Hkv, rep, D), np.float32)
+        for b in range(B):
+            for h in range(Hkv):
+                for r in range(rep):
+                    s = (kf[b, :, h] @ qg[b, h, r]) / np.sqrt(D)
+                    s[np.arange(N * psz) > off[b]] = -np.inf
+                    p = np.exp(s - s.max())
+                    p /= p.sum()
+                    ref[b, h, r] = p @ vf[b, :, h]
+        np.testing.assert_allclose(out, ref.reshape(B, H, D),
+                                   rtol=1e-5, atol=1e-5)
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_PALLAS_INTERPRET", None)
+        else:
+            os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = prev
+
+
+def test_paged_metrics_reach_prometheus(model):
+    """Satellite: the new serving gauges/counters/histogram flow
+    through the PR 4 registry into Prometheus exposition."""
+    from paddle_tpu import observability as obs
+    (p,) = _prompts([40], seed=4)
+    with Engine(model, ServingConfig(num_slots=1,
+                                     prefill_chunk_tokens=8)) as eng:
+        eng.submit(p, max_new_tokens=4).result(timeout=300)
+        snap = eng.stats()
+    assert snap["kv_pages_in_use"] >= 0
+    assert snap["prefill_chunks"] >= 5
+    text = obs.render_prometheus()
+    for series in ("serving_kv_pages_in_use", "serving_kv_pages_free",
+                   "serving_prefix_cache_misses",
+                   "serving_prefill_chunk_ms"):
+        assert series in text, f"{series} missing from exposition"

@@ -14,7 +14,11 @@ validated against its JSON schema instead of the throughput baseline —
 the microbench's comparison is self-contained (cached vs uncached in
 one process).  A `serving_*` result (benchmarks/serving_bench.py) is
 likewise schema-validated, plus a floor on its self-contained
-continuous-batching speedup vs the sequential baseline."""
+continuous-batching speedup vs the sequential baseline.  A
+`serving_paged_*` result (--workload prefix) gates the paged KV
+cache: >= 2x tokens/sec vs the slot engine at equal cache memory,
+prefix-cache hits on every shared-prompt request, and strictly more
+concurrent sequences than preallocation would have allowed."""
 from __future__ import annotations
 
 import argparse
@@ -149,6 +153,86 @@ def check_serving_bench(run):
     return 0
 
 
+_PAGED_SCHEMA = {
+    # key -> accepted types; every key is required
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "speedup_vs_slots": (int, float),
+    "slots": dict,
+    "paged": dict,
+    "prefix_cache_hits": int,
+    "prefix_cache_hit_tokens": int,
+    "max_concurrent": int,
+    "prealloc_capacity": int,
+    "pool_pages": int,
+    "prefix_len": int,
+    "num_requests": int,
+    "max_new_tokens": int,
+    "greedy_mismatches": int,
+    "smoke": bool,
+    "platform": str,
+}
+
+# acceptance floors (ISSUE 7): on the shared-prefix workload the paged
+# engine must sustain >= 2x the slot engine's tokens/sec at EQUAL cache
+# memory (smoke clears ~2.3x, full ~2.6x), and must have run strictly
+# more concurrent sequences than the same bytes preallocated as
+# max_seq_len stripes could
+_PAGED_MIN_SPEEDUP = 2.0
+
+
+def check_paged_bench(run):
+    """Schema + speedup/occupancy gates for the shared-prefix lane of
+    benchmarks/serving_bench.py (--workload prefix)."""
+    errors = []
+    for key, types in _PAGED_SCHEMA.items():
+        if key not in run:
+            errors.append(f"missing key {key!r}")
+        elif run[key] is None or not isinstance(run[key], types):
+            errors.append(f"{key!r} has type {type(run[key]).__name__}, "
+                          f"expected {types}")
+    if not errors:
+        for side in ("slots", "paged"):
+            for k in ("tokens_per_sec", "wall_s", "tokens",
+                      "slot_occupancy", "ttft_ms_avg"):
+                v = run[side].get(k)
+                if not isinstance(v, (int, float)) or v <= 0:
+                    errors.append(f"{side}.{k} must be a positive "
+                                  f"number, got {v!r}")
+        if run["value"] <= 0:
+            errors.append("value must be positive")
+        if run["greedy_mismatches"] != 0:
+            errors.append(f"{run['greedy_mismatches']} paged outputs "
+                          "diverged from the sequential greedy baseline")
+        if run["num_requests"] >= 4 and \
+                run["speedup_vs_slots"] < _PAGED_MIN_SPEEDUP:
+            errors.append(
+                f"speedup_vs_slots {run['speedup_vs_slots']:.2f} < "
+                f"required {_PAGED_MIN_SPEEDUP}x at equal cache memory")
+        if run["prefix_cache_hits"] < run["num_requests"]:
+            errors.append(
+                f"prefix_cache_hits {run['prefix_cache_hits']} < "
+                f"{run['num_requests']} — the shared system prompt was "
+                "recomputed instead of reused")
+        if run["max_concurrent"] <= run["prealloc_capacity"]:
+            errors.append(
+                f"max_concurrent {run['max_concurrent']} <= "
+                f"prealloc_capacity {run['prealloc_capacity']} — paging "
+                "admitted no more sequences than slot preallocation")
+    if errors:
+        print("serving_paged schema check FAILED:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(f"serving_paged schema OK: {run['value']:.1f} tokens/sec, "
+          f"{run['speedup_vs_slots']:.2f}x vs slot engine, "
+          f"{run['prefix_cache_hits']} prefix hits, "
+          f"{run['max_concurrent']} concurrent vs "
+          f"{run['prealloc_capacity']} preallocated")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("bench_json")
@@ -164,6 +248,8 @@ def main():
         run = run["parsed"]
     if str(run.get("metric", "")).startswith("eager_op_dispatch"):
         return check_eager_overhead(run)
+    if str(run.get("metric", "")).startswith("serving_paged"):
+        return check_paged_bench(run)
     if str(run.get("metric", "")).startswith("serving_"):
         return check_serving_bench(run)
     value = float(run["value"])

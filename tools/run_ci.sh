@@ -78,6 +78,11 @@ echo "== serving continuous-batching bench (smoke) =="
 python benchmarks/serving_bench.py --smoke --out /tmp/serving_bench_ci.json
 python tools/check_bench_result.py /tmp/serving_bench_ci.json
 
+echo "== paged KV cache bench: shared-prefix + chunked prefill (smoke) =="
+python benchmarks/serving_bench.py --workload prefix --smoke \
+    --out /tmp/serving_paged_ci.json
+python tools/check_bench_result.py /tmp/serving_paged_ci.json
+
 echo "== eager op-dispatch cache microbench (smoke) =="
 python benchmarks/eager_overhead.py --smoke --out /tmp/eager_overhead_ci.json
 python tools/check_bench_result.py /tmp/eager_overhead_ci.json
