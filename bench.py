@@ -255,17 +255,53 @@ def main():
 
     amp_level = "O2" if on_tpu else "O0"
 
-    @paddle.jit.to_static(input_spec=[
-        paddle.jit.InputSpec([None, seq], "int32"),
-        paddle.jit.InputSpec([None, seq], "int32")])
-    def train_step(x, y):
+    def _forward(x, y):
         with paddle.amp.auto_cast(enable=on_tpu, level=amp_level,
                                   dtype="bfloat16"):
             _, loss = model(x, labels=y)
+        return loss
+
+    def _eager_step(x, y, update=True):
+        loss = _forward(x, y)
         loss.backward()
         opt.step()
         opt.clear_grad()
         return loss
+
+    # the framework-owned compiled train step (framework/train_step.py,
+    # FLAGS_compiled_train_step, default ON) fuses fwd+bwd+optimizer into
+    # one donated-buffer program; BENCH_TO_STATIC=1 pins the legacy
+    # to_static lane, and the flag off runs op-by-op eager — the three
+    # lanes the ISSUE 8 gate compares
+    use_compiled = (paddle.get_flags("FLAGS_compiled_train_step")
+                    ["FLAGS_compiled_train_step"]
+                    and not os.environ.get("BENCH_TO_STATIC"))
+    if use_compiled:
+        from paddle_tpu.framework.train_step import CompiledTrainStep
+        _cstep = CompiledTrainStep(_forward, opt, network=model,
+                                   eager_step=_eager_step)
+
+        def train_step(x, y):
+            return _cstep(x, y, update=True)
+        _fingerprint = _cstep.hlo_fingerprint
+        step_lane = "compiled"
+    elif os.environ.get("BENCH_TO_STATIC"):
+        @paddle.jit.to_static(input_spec=[
+            paddle.jit.InputSpec([None, seq], "int32"),
+            paddle.jit.InputSpec([None, seq], "int32")])
+        def train_step(x, y):
+            loss = _forward(x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        _fingerprint = train_step.hlo_fingerprint
+        step_lane = "to_static"
+    else:
+        train_step = _eager_step
+        _fingerprint = lambda x, y: None  # noqa: E731
+        step_lane = "eager"
+    _log(f"train-step lane: {step_lane}")
 
     # warmup: eager + discovery (batch 1) + ≥2 full-batch compiled calls —
     # the donating jit variant is built after the first compiled call and
@@ -309,6 +345,10 @@ def main():
         lv = float(lv)
         return time.perf_counter() - t0, lv
 
+    # step-time telemetry through the SAME StepMetrics instrument hapi
+    # fit publishes (train.step_time_ms p50 is the ISSUE 8 gate metric)
+    from paddle_tpu.observability import StepMetrics
+    sm = StepMetrics(prefix="bench.", tokens_per_example=seq)
     if on_tpu:
         # slope-based timing: t(N)-t(1) over N-1 steps cancels the fixed
         # ~70ms relay round-trip of the value fetch
@@ -318,6 +358,8 @@ def main():
         tokens_per_sec = batch * seq / slope
         timing = {"t1_s": round(t1, 6), "tN_s": round(tN, 6), "N": steps,
                   "slope_s_per_step": round(slope, 6), "method": "slope"}
+        for _ in range(steps):
+            sm.step_time_ms.observe(slope * 1e3)  # per-step estimate
     else:
         # 20-step steady-state window with a trimmed mean: the old 3-step
         # best-of-3 estimator had a ±15% run-to-run envelope
@@ -327,10 +369,12 @@ def main():
         per_step = []
         loss = None
         for _ in range(steps):
+            sm.begin_step()
             t0 = time.perf_counter()
             loss = train_step(x, y)
             jax.block_until_ready(loss._data_)
             per_step.append(time.perf_counter() - t0)
+            sm.end_step(examples=batch)
         # force a value read BEFORE reporting: async dispatch errors (e.g.
         # resource exhaustion) must fail the bench, not surface after JSON
         final_loss = float(loss)
@@ -388,7 +432,7 @@ def main():
         run_ts = datetime.datetime.now(datetime.timezone.utc).isoformat(
             timespec="seconds")
         try:
-            hlo_sha = train_step.hlo_fingerprint(x, y)
+            hlo_sha = _fingerprint(x, y)
         except Exception:
             hlo_sha = None
         rec = {
@@ -397,6 +441,9 @@ def main():
             "tokens_per_sec": round(tokens_per_sec, 1),
             "mfu": round(mfu, 4),
             "loss": round(final_loss, 4),
+            "step_lane": step_lane,
+            "step_time_ms_p50": round(sm.step_time_ms.percentile(50) or 0,
+                                      3),
             "timing": timing,
             "batch": batch, "seq": seq, "amp": amp_level,
             "model": "gpt2-124m",
@@ -438,7 +485,8 @@ def main():
         "vs_baseline": round(vs_baseline, 3),
     }))
     print(f"# loss={final_loss:.4f} mfu={mfu:.3f} "
-          f"steps={steps} batch={batch} seq={seq} platform="
+          f"steps={steps} batch={batch} seq={seq} lane={step_lane} "
+          f"step_p50={sm.step_time_ms.percentile(50) or 0:.1f}ms platform="
           f"{jax.devices()[0].platform}", file=sys.stderr)
 
 

@@ -73,6 +73,12 @@ def main():
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "EAGER_OVERHEAD.json"))
     ap.add_argument("--no-write", action="store_true")
+    ap.add_argument("--baseline", default=None, metavar="JSON",
+                    help="drift gate: fail when the measured cached/"
+                         "uncached speedups fall below --drift-floor of "
+                         "the recorded ones (speedup RATIOS are compared "
+                         "— host-speed independent, unlike raw ops/sec)")
+    ap.add_argument("--drift-floor", type=float, default=0.6)
     args = ap.parse_args()
 
     import jax
@@ -131,14 +137,74 @@ def main():
     }
     if not args.no_write:
         try:
+            existing = {}
+            if os.path.exists(args.out):
+                with open(args.out) as f:
+                    existing = json.load(f)
+            if args.smoke and existing and not existing.get("smoke"):
+                # never clobber a recorded full-mode baseline with a CI
+                # smoke run: refresh only its smoke_ref section
+                existing["smoke_ref"] = {
+                    "speedup_vs_uncached": rec["speedup_vs_uncached"],
+                    "step_speedup_vs_uncached":
+                        rec["step_speedup_vs_uncached"],
+                }
+                rec_out = existing
+            else:
+                if existing.get("smoke_ref"):
+                    rec["smoke_ref"] = existing["smoke_ref"]
+                rec_out = rec
             with open(args.out, "w") as f:
-                json.dump(rec, f, indent=1)
-        except OSError as e:
+                json.dump(rec_out, f, indent=1)
+        except (OSError, ValueError) as e:
             print(f"[eager_overhead] could not write {args.out}: {e}",
                   file=sys.stderr)
     print(json.dumps({k: rec[k] for k in
                       ("metric", "value", "unit", "speedup_vs_uncached",
                        "step_speedup_vs_uncached", "smoke")}))
+
+    if args.baseline:
+        # drift gate (ISSUE 8 satellite): the op-dispatch hot path drifted
+        # ~0.9x across PRs 2-5 without any gate noticing.  Raw ops/sec
+        # depends on the host, so the gate compares the cached/uncached
+        # SPEEDUP ratios, which cancel machine speed: a real hot-path
+        # regression (instrumentation on the per-op path) shrinks the
+        # cached advantage no matter how fast the box is.
+        try:
+            base = json.load(open(args.baseline))
+        except (OSError, ValueError) as e:
+            print(f"DRIFT GATE ERROR: cannot read {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 1
+        # iteration counts shape the ratios (short smoke loops amortize
+        # warmup differently), so a smoke run gates against the recorded
+        # smoke_ref section, a full run against the top-level numbers
+        if bool(base.get("smoke")) != rec["smoke"]:
+            base = base.get("smoke_ref") or {}
+            if not base:
+                print("[eager_overhead] drift gate SKIPPED: baseline has "
+                      "no smoke_ref section for this mode",
+                      file=sys.stderr)
+                return 0
+        failures = []
+        for key in ("speedup_vs_uncached", "step_speedup_vs_uncached"):
+            recorded = float(base.get(key, 0) or 0)
+            measured = float(rec[key])
+            if recorded > 1.0 and measured < args.drift_floor * recorded:
+                failures.append(
+                    f"  {key}: measured {measured:.2f}x < "
+                    f"{args.drift_floor:.2f} x recorded {recorded:.2f}x")
+        if failures:
+            print("EAGER-OVERHEAD DRIFT GATE FAILED (vs "
+                  f"{args.baseline}):", file=sys.stderr)
+            print("\n".join(failures), file=sys.stderr)
+            print("the eager per-op hot path regressed — profile "
+                  "core/dispatch.apply_op + op_cache.tier1_execute for "
+                  "new per-op work before re-recording the baseline",
+                  file=sys.stderr)
+            return 1
+        print(f"[eager_overhead] drift gate OK vs {args.baseline} "
+              f"(floor {args.drift_floor})", file=sys.stderr)
     return 0
 
 

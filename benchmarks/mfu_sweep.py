@@ -61,16 +61,26 @@ data = rng.integers(0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32)
 x, y = paddle.to_tensor(data[:, :-1]), paddle.to_tensor(data[:, 1:])
 x1, y1 = paddle.to_tensor(data[:1, :-1]), paddle.to_tensor(data[:1, 1:])
 
-@paddle.jit.to_static(input_spec=[
-    paddle.jit.InputSpec([None, seq], "int32"),
-    paddle.jit.InputSpec([None, seq], "int32")])
-def train_step(x, y):
+# one donated-buffer compiled step (framework/train_step.py) — the same
+# lane bench.py measures; eager fallback stays byte-identical
+from paddle_tpu.framework.train_step import CompiledTrainStep
+
+def forward(x, y):
     with paddle.amp.auto_cast(enable=True, level="O2", dtype="bfloat16"):
         _, loss = model(x, labels=y)
+    return loss
+
+def eager_step(x, y, update=True):
+    loss = forward(x, y)
     loss.backward()
     opt.step()
     opt.clear_grad()
     return loss
+
+_cs = CompiledTrainStep(forward, opt, network=model, eager_step=eager_step)
+
+def train_step(x, y):
+    return _cs(x, y, update=True)
 
 for _ in range(2):
     loss = train_step(x1, y1)
@@ -91,6 +101,8 @@ tN, final_loss = timed(steps)
 slope = (tN - t1) / (steps - 1)
 print(json.dumps({{"batch": batch, "slope": slope,
                   "tokens_per_sec": batch * seq / slope,
+                  "step_time_ms_p50": slope * 1e3,
+                  "step_lane": "compiled" if _cs.compiled else "eager",
                   "t1": t1, "tN": tN, "loss": final_loss}}))
 """
     try:
@@ -134,6 +146,9 @@ def main():
                 "metric": "gpt2_124m_train_tokens_per_sec",
                 "sweep": True, "batch": rec["batch"], "seq": SEQ,
                 "tokens_per_sec": round(rec["tokens_per_sec"], 1),
+                "step_lane": rec.get("step_lane"),
+                "step_time_ms_p50": round(
+                    rec.get("step_time_ms_p50", 0), 3),
                 "loss": round(rec["loss"], 4),
                 "timing": {"t1_s": round(rec["t1"], 6),
                            "tN_s": round(rec["tN"], 6), "N": STEPS,

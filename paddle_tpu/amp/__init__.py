@@ -85,7 +85,7 @@ class GradScaler:
             return loss
         return loss * self._scale
 
-    def unscale_(self, optimizer):
+    def unscale_(self, optimizer, defer_found_inf=False):
         # once-per-step guard: an explicit unscale_ (e.g. before a
         # cross-rank grad sync or clipping) must not re-divide in step()
         if not self._enable or self._unscaled:
@@ -93,6 +93,7 @@ class GradScaler:
         self._unscaled = True
         import jax.numpy as jnp
         inv = 1.0 / self._scale
+        self._found_inf_dev = None
         found_inf = False
         for p in optimizer._all_params():
             if p.grad is not None:
@@ -100,16 +101,33 @@ class GradScaler:
                 if self._scale != 1.0:
                     g = g * jnp.asarray(inv, g.dtype)
                     p.grad._data = g
-        # NaN/Inf check is lazy (host sync) — only when scaling is active
+        # NaN/Inf check — only when scaling is active.  ONE stacked
+        # device reduction over all per-grad sums, then a single host
+        # read (the old per-grad fetch loop was one device→host sync per
+        # parameter).  With defer_found_inf the flag STAYS on device so
+        # the caller can batch it into its gradient all_reduce and read
+        # it once after the reduction (Model._sync_grads).
         if self._scale != 1.0:
-            for p in optimizer._all_params():
-                if p.grad is not None:
+            sums = [jnp.sum(p.grad._data) for p in optimizer._all_params()
+                    if p.grad is not None]
+            if sums:
+                bad = ~jnp.isfinite(jnp.stack(sums)).all()
+                if defer_found_inf:
+                    self._found_inf_dev = bad
+                else:
                     import numpy as np
-                    if not np.isfinite(np.asarray(
-                            jnp.sum(p.grad._data))).all():
-                        found_inf = True
-                        break
+                    found_inf = bool(np.asarray(bad))
         self._found_inf = found_inf
+
+    def _found_inf_tensor(self):
+        """The deferred found-inf decision as a [1] float Tensor ready to
+        ride a gradient all_reduce (0.0 = all finite)."""
+        import jax.numpy as jnp
+        bad = getattr(self, "_found_inf_dev", None)
+        if bad is None:
+            bad = jnp.asarray(self._found_inf)
+        self._found_inf_dev = None
+        return Tensor(jnp.reshape(bad, (1,)).astype(jnp.float32))
 
     def step(self, optimizer):
         if not self._enable:

@@ -71,6 +71,23 @@ _T1_STATS = {
     k: _metrics.counter(f"cache.tier1.{k}", f"tier-1 op-cache {k}")
     for k in ("hits", "misses", "evictions", "bypasses")
 }
+# the HIT path is the per-op hot path (every cached eager op lands here):
+# registry Counter.inc takes the metric family's RLock, a second lock
+# acquisition per op on top of _LOCK.  Hits are batched in a plain int
+# under _LOCK and flushed to the registry counter every _T1_FLUSH_EVERY
+# hits and on every slow-path event (miss, cache_stats(), clear()), so
+# exposition lags by at most _T1_FLUSH_EVERY - 1 op hits.
+_T1_HOT_HITS = [0]
+_T1_FLUSH_EVERY = 256
+
+
+def _flush_hot_hits():
+    """Publish batched hit counts into the registry.  Caller holds
+    _LOCK."""
+    n = _T1_HOT_HITS[0]
+    if n:
+        _T1_HOT_HITS[0] = 0
+        _T1_STATS["hits"].inc(n)
 _T1_BYTES = _metrics.gauge("cache.tier1.bytes",
                            "summed input-aval bytes of cached signatures")
 # op names permanently opted out: impls that draw framework RNG inside
@@ -171,7 +188,9 @@ def tier1_execute(name, fn, pure, arrays, template, static, need_grad):
         entry = _T1.get(key)
         if entry is not None:
             _T1.move_to_end(key)
-            _T1_STATS["hits"].inc()
+            _T1_HOT_HITS[0] += 1
+            if _T1_HOT_HITS[0] >= _T1_FLUSH_EVERY:
+                _flush_hot_hits()
     if entry is not None:
         if entry.fn is not fn:
             return None               # op re-registered since caching
@@ -221,6 +240,7 @@ def tier1_execute(name, fn, pure, arrays, template, static, need_grad):
 
     aval_bytes = sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays)
     with _LOCK:
+        _flush_hot_hits()
         _T1_STATS["misses"].inc()
         _T1[key] = _Entry(fn, jitted, need_grad, aval_bytes)
         _T1_BYTES.inc(aval_bytes)
@@ -237,6 +257,7 @@ def clear():
     with _LOCK:
         _T1.clear()
         _SKIP_OPS.clear()
+        _T1_HOT_HITS[0] = 0
         for c in _T1_STATS.values():
             c.reset()
         _T1_BYTES.reset()
@@ -312,6 +333,7 @@ def cache_stats():
     not XLA code size (which jax does not expose per jit wrapper).
     tier2 entries/bytes are measured from the cache directory."""
     with _LOCK:
+        _flush_hot_hits()
         t1 = {k: c.value for k, c in _T1_STATS.items()}
         t1["bytes"] = _T1_BYTES.value
         t1["entries"] = len(_T1)

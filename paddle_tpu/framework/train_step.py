@@ -1,0 +1,758 @@
+"""Compiled train step: the whole optimizer step as ONE XLA program.
+
+Reference capability: the reference's static-graph train executor runs a
+whole step (forward, backward, gradient communication, optimizer update)
+as one `InterpreterCore` program (reference:
+python/paddle/distributed/passes/auto_parallel_gradient_merge.py +
+new_executor/interpretercore.cc), which is how it reaches its published
+MFU numbers; op-by-op eager dispatch cannot overlap collectives or fuse
+the update.
+
+TPU-native realization (docs/TRAIN_STEP.md): :class:`CompiledTrainStep`
+extracts the parameter / optimizer-state / gradient pytrees from a live
+eager model, lowers the step body — forward via the op-dispatch funnel,
+tape backward, AMP unscale + in-program found-inf reduction, global-norm
+clip, the optimizer's ``_fused_update`` — as a pure function of those
+pytrees, and compiles it with ``jax.jit`` donating the parameter,
+gradient and optimizer-state buffers so XLA updates them in place.  When
+a data-parallel mesh spans more than one local device the body runs
+under ``shard_map`` over the ``NamedSharding`` mesh
+(``distributed/mesh.py``): the batch is sharded over ``dp`` and gradient
+reduction happens as an in-program ``psum``/``pmean`` that XLA can
+overlap with the rest of the backward, instead of the eager path's
+post-hoc per-tensor host collectives (``hapi.Model._sync_grads``).
+
+Lifecycle (two-phase, mirroring ``jit/tracer.py``):
+
+1. **Call 1 — eager + discovery.**  The step runs through the caller's
+   byte-identical eager path (a REAL step, so lazily-initialized
+   optimizer state and gradients exist), then one no-grad forward under
+   a discovery tracer records every pre-existing tensor the forward
+   reads (parameters, buffers, masks); its side effects (RNG counter,
+   buffer writes) are rolled back.
+2. **Call 2 — bind + compile.**  A pure wrapper installs JAX tracers
+   into the captured tensors' data slots, replays the step body, and
+   collects loss + every mutated value as program outputs; ``jax.jit``
+   compiles it with ``donate_argnums`` over params/grads/state.  All
+   later calls execute the one cached executable per input signature.
+
+Eager stays the fallback and is byte-for-byte today's path: flag off
+(``FLAGS_compiled_train_step``), layer/tensor hooks installed, active
+tracers or ``saved_tensors_hooks``, data-dependent host reads in the
+forward, optimizers without a fused update (LBFGS), ZeRO-sharded
+accumulators, or a launched multi-process world whose backend cannot
+run cross-process XLA programs.  A trace failure at any point warns
+once and permanently falls back — training never dies on the compiler.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import state as _state
+from ..core.tensor import Tensor
+from ..utils.flags import flag as _flag
+
+
+_DONATED_FAILURE_MSG = (
+    "compiled train step failed after buffer donation; parameters/"
+    "optimizer state backing this step are invalid — reload them from a "
+    "checkpoint, or set FLAGS_jit_donate_buffers=False to trade memory "
+    "for failure recovery")
+
+
+class TraceEscape(Exception):
+    """Raised when the step body performs a host interaction the
+    compiled program cannot replay; the step falls back to eager
+    permanently."""
+
+
+class _StepBindTracer:
+    """Minimal tracer active while ``jax.jit`` traces the step body.
+
+    Compared to ``jit/tracer._BindTracer`` it is stricter: any host read
+    of a traced value (``float()`` / ``item()`` / ``bool()`` branch)
+    raises :class:`TraceEscape` — the compiled train step supports no
+    guard re-specialization; such steps simply run eagerly.
+    """
+
+    __slots__ = ("created", "mutated", "mutated_list", "rng_counter",
+                 "_rng_key", "_lr", "_lr_used")
+
+    def __init__(self, rng_key, lr):
+        self.created = set()
+        self.mutated = {}             # id(Tensor) -> pre-write concrete data
+        self.mutated_list = []
+        self.rng_counter = 0
+        self._rng_key = rng_key
+        self._lr = lr
+        self._lr_used = False
+
+    def on_create(self, t):
+        self.created.add(id(t))
+
+    def on_read(self, t):
+        # a concrete read of a tensor discovery did not capture would be
+        # silently baked into the program as a constant — a stale-state
+        # bug.  (Captured tensors hold tracers by now, so they never
+        # reach this branch.)
+        if (id(t) not in self.created and id(t) not in self.mutated
+                and not isinstance(t._data_, jax.core.Tracer)):
+            raise TraceEscape(
+                "step body read a tensor the discovery pass did not see "
+                f"(shape {tuple(t._data_.shape)}, name={t.name!r}) — "
+                "control flow diverged between calls")
+
+    def on_write(self, t):
+        i = id(t)
+        if i not in self.created and i not in self.mutated:
+            self.mutated[i] = t._data_
+            self.mutated_list.append(t)
+
+    def host_read(self, t, bool_read=False):
+        raise TraceEscape(
+            "host read of a traced value (float()/item()/bool()) inside "
+            "the train step — the value escapes into python, which one "
+            "compiled program cannot replay")
+
+    def host_input(self, provider):
+        # the only legitimate host scalar inside the step body is the
+        # learning rate (schedulers); it is a traced input fed per call
+        if not self._lr_used:
+            self._lr_used = True
+            return self._lr
+        raise TraceEscape("unexpected host-scalar provider in step body")
+
+    def rng_base(self):
+        return self._rng_key
+
+
+class _Installed:
+    """Exception-safe swap of tensors' device-array slots.  Uses the
+    raw ``_data_`` slot so installs/restores never fire tracer hooks."""
+
+    def __init__(self, pairs):
+        self._saved = [(t, t._data_) for t, _ in pairs]
+        self._new = [a for _, a in pairs]
+
+    def __enter__(self):
+        for (t, _), a in zip(self._saved, self._new):
+            t._data_ = a
+        return self
+
+    def __exit__(self, *exc):
+        for t, orig in self._saved:
+            t._data_ = orig
+        return False
+
+
+def _resolve_mesh(mesh=None):
+    """The dp mesh this step shards over, or None for single-device.
+
+    Precedence: explicit argument > the framework's active/default
+    ``ProcessMesh`` (``distributed.mesh``) when it carries a pure-dp
+    layout > the ``PADDLE_COMPILED_DP`` env var (dp over the first N
+    local devices).  There is deliberately NO implicit
+    all-local-devices default: silently resharding the batch would
+    change trajectories whenever CI forces a multi-device host
+    platform."""
+    import os
+    from ..distributed import mesh as _mesh_mod
+    if mesh is None:
+        mesh = _mesh_mod.get_mesh()
+    if mesh is None:
+        n = int(os.environ.get("PADDLE_COMPILED_DP", "0") or 0)
+        if n > 1:
+            mesh = _mesh_mod.init_mesh([n], ["dp"])
+    if mesh is None or "dp" not in mesh.dim_names:
+        return None
+    for name in mesh.dim_names:
+        if name != "dp" and mesh.get_dim_size(name) != 1:
+            return None   # model-parallel axes are not this step's job
+    if mesh.get_dim_size("dp") <= 1:
+        return None
+    return mesh
+
+
+class CompiledTrainStep:
+    """One donated-buffer XLA program per (input signature, phase).
+
+    ``forward_fn(x, y) -> loss Tensor`` is the only user code replayed
+    inside the program (wrap autocast inside it); everything after the
+    loss — backward, loss scaling, found-inf, dp reduction, clip, the
+    fused optimizer update — is the framework-owned step tail.
+
+    ``eager_step(x, y, update) -> loss Tensor`` supplies the exact eager
+    semantics used for the warmup call and every fallback
+    (``update=False`` marks a gradient-accumulation micro-step: backward
+    only, no optimizer update / clear).  hapi passes its historical
+    ``Model._train_step`` so fallbacks stay byte-identical; standalone
+    callers get a default with the same structure.
+    """
+
+    def __init__(self, forward_fn, optimizer, *, scaler=None, network=None,
+                 accumulate_grad_batches=1, mesh=None, eager_step=None):
+        self._forward = forward_fn
+        self._opt = optimizer
+        self._scaler = scaler
+        self._network = network
+        self._accum = max(int(accumulate_grad_batches or 1), 1)
+        self._mesh_arg = mesh
+        self._eager = eager_step or self._default_eager_step
+        self._micro = 0               # position within the accum window
+        self._calls = 0
+        self._fallback_reason = None
+        self._warned = False
+        # build products (populated by discovery / first bind)
+        self._built = False
+        self._mesh = None
+        self._dp = 1
+        self._caps = []               # non-param captured tensors
+        self._params = []             # params receiving grads (update set)
+        self._idxs = []               # their positions in the optimizer list
+        self._lr_scales = ()
+        self._wd_mask = ()
+        self._state_names = ()
+        self._mut_caps = []           # forward-mutated captures (buffers)
+        self._jit_full = None
+        self._jit_micro = None
+        self._donating = None
+        self._scaler_vec = None       # device [scale, good, bad] fp32
+        self.check_static_eligibility()
+
+    # ------------------------------------------------------------------
+    # public surface
+    # ------------------------------------------------------------------
+
+    @property
+    def compiled(self):
+        return self._built and self._fallback_reason is None
+
+    @property
+    def fallback_reason(self):
+        return self._fallback_reason
+
+    def __call__(self, x, y=None, update=None):
+        if update is None:
+            # standalone callers: position within the accumulation window
+            update = (self._micro + 1) >= self._accum
+        self._calls += 1
+        from ..utils import monitor as _monitor
+        if self._fallback_reason is not None or not self._eligible_now():
+            _monitor.incr("jit.compiled_step_fallback")
+            loss = self._run_eager(x, y, update)
+        elif self._calls == 1:
+            loss = self._run_eager(x, y, update)   # real warmup step
+            try:
+                self._discover(x, y)
+            except TraceEscape as e:
+                self._set_fallback(str(e))
+            except Exception as e:  # noqa: BLE001 — any failure → eager
+                self._set_fallback(
+                    f"discovery failed: {type(e).__name__}: {e}")
+        else:
+            try:
+                loss = self._run_compiled(x, y, update)
+                _monitor.incr("jit.compiled_step_hit")
+            except TraceEscape as e:
+                self._set_fallback(str(e))
+                loss = self._run_eager(x, y, update)
+            except Exception as e:  # noqa: BLE001
+                if self._donation_burned():
+                    raise RuntimeError(_DONATED_FAILURE_MSG) from e
+                self._set_fallback(f"{type(e).__name__}: {e}")
+                loss = self._run_eager(x, y, update)
+        self._micro = 0 if update else self._micro + 1
+        return loss
+
+    step = __call__
+
+    def hlo_fingerprint(self, x, y=None):
+        """sha256 (first 16 hex) of the StableHLO of the full-update
+        program for this batch signature — the auditable program identity
+        benchmark records carry.  None until compiled (or on lowering
+        failure)."""
+        import hashlib
+        if self._jit_full is None:
+            return None
+        try:
+            args = self._gather_args(x, y)
+            text = self._jit_full.lower(*args).as_text()
+        except Exception:
+            return None
+        finally:
+            # _gather_args advanced the RNG counter; a fingerprint read
+            # must not perturb the training stream
+            _state.STATE.rng_counter -= 1
+        return hashlib.sha256(text.encode()).hexdigest()[:16]
+
+    def sync_scaler(self):
+        """Materialize the device-held loss-scaling state back into the
+        python ``GradScaler`` (scale / good / bad counters)."""
+        if self._scaler is None or self._scaler_vec is None:
+            return
+        vec = np.asarray(self._scaler_vec)
+        self._scaler._scale = float(vec[0])
+        self._scaler._good_steps = int(vec[1])
+        self._scaler._bad_steps = int(vec[2])
+
+    # ------------------------------------------------------------------
+    # eligibility & fallback
+    # ------------------------------------------------------------------
+
+    def _set_fallback(self, reason):
+        self.sync_scaler()
+        self._scaler_vec = None
+        self._fallback_reason = reason
+        if not self._warned:
+            self._warned = True
+            warnings.warn(
+                f"compiled train step disabled ({reason}); running the "
+                "eager step for this model")
+
+    def check_static_eligibility(self):
+        """One-time structural checks; returns None when eligible, else
+        the (latched) fallback reason."""
+        opt = self._opt
+        from ..optimizer.optimizer import Optimizer
+        if opt is None:
+            self._fallback_reason = "no optimizer"
+        elif type(opt).step is not Optimizer.step:
+            self._set_fallback(
+                f"{type(opt).__name__}.step is overridden (closure-style "
+                "optimizers run eagerly)")
+        elif type(opt)._fused_update is Optimizer._fused_update:
+            self._set_fallback(f"{type(opt).__name__} has no fused update")
+        elif getattr(opt, "_accumulator_commit_hook", None) is not None:
+            self._set_fallback("ZeRO-sharded accumulators (fleet.sharding)")
+        else:
+            world = self._world_blocker()
+            if world:
+                self._set_fallback(world)
+        return self._fallback_reason
+
+    def _world_blocker(self):
+        """Launched multi-process worlds ride eager unless the backend
+        can genuinely run one cross-process XLA program (TPU pods with a
+        global mesh); the CPU host-collective lane cannot."""
+        try:
+            nprocs = jax.process_count()
+        except Exception:
+            nprocs = 1
+        if nprocs <= 1:
+            return None
+        plat = jax.devices()[0].platform
+        if plat not in ("tpu", "axon"):
+            return (f"{nprocs}-process world on {plat!r}: backend cannot "
+                    "run cross-process XLA programs (host-collective "
+                    "eager lane)")
+        return None
+
+    def _eligible_now(self):
+        """Cheap per-call checks for state that may change mid-run."""
+        if not _flag("FLAGS_compiled_train_step", True):
+            return False
+        if _state.STATE.tracer is not None:
+            return False     # someone is tracing us: compose eagerly
+        if getattr(_state.STATE, "saved_tensor_hooks", None) is not None:
+            return False
+        if self._network is not None:
+            for layer in self._network.sublayers(include_self=True):
+                if layer._forward_pre_hooks or layer._forward_post_hooks:
+                    self._set_fallback("layer forward hooks installed")
+                    return False
+        for p in self._opt._parameter_list:
+            if p._hooks:
+                self._set_fallback("tensor gradient hooks installed")
+                return False
+        return True
+
+    def _donation_burned(self):
+        for p in self._params:
+            if getattr(p._data_, "is_deleted", lambda: False)():
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    # eager lane
+    # ------------------------------------------------------------------
+
+    def _run_eager(self, x, y, update):
+        # a mid-run fallback (ragged batch, flag flip) must not read a
+        # stale host scaler: pull the device-held state down first
+        if self._scaler_vec is not None:
+            self.sync_scaler()
+            self._scaler_vec = None
+        return self._eager(x, y, update)
+
+    def _default_eager_step(self, x, y, update):
+        """Standalone eager semantics (scaler/clip-aware, single rank)."""
+        loss = self._forward(x, y)
+        bwd = loss
+        if self._scaler is not None:
+            bwd = self._scaler.scale(bwd)
+        if self._accum > 1:
+            bwd = bwd * (1.0 / self._accum)
+        bwd.backward()
+        if update:
+            if self._scaler is not None:
+                self._scaler.step(self._opt)   # unscale→found-inf→update
+            else:
+                self._opt.step()
+            self._opt.clear_grad()
+        return loss
+
+    # ------------------------------------------------------------------
+    # phase 1: discovery (side-effect-free capture of forward reads)
+    # ------------------------------------------------------------------
+
+    def _discover(self, x, y):
+        from ..jit.tracer import _DiscoveryTracer
+        from ..core.state import no_grad
+
+        opt = self._opt
+        opt._ensure_state()
+        tr = _DiscoveryTracer()
+        # snapshot values at first read/write so the discovery forward's
+        # side effects (batchnorm running stats, write-only counters)
+        # can be rolled back to the post-warmup state
+        read_snap = {}
+        write_snap = {}
+
+        def on_read(t):
+            if id(t) not in tr.created and id(t) not in read_snap:
+                read_snap[id(t)] = (t, t._data_)
+            i = id(t)
+            if i not in tr.created and i not in tr.captured:
+                tr.captured[i] = t
+                tr.capture_list.append(t)
+
+        def on_write(t):
+            if id(t) not in tr.created and id(t) not in write_snap:
+                write_snap[id(t)] = (t, t._data_)
+        tr.on_read, tr.on_write = on_read, on_write
+        saved_rng = (_state.STATE.rng_key, _state.STATE.rng_counter)
+        _state.STATE.tracer = tr
+        try:
+            with no_grad():
+                self._forward(x, y)
+        finally:
+            _state.STATE.tracer = None
+            _state.STATE.rng_key, _state.STATE.rng_counter = saved_rng
+            for t, arr in write_snap.values():
+                t._data_ = arr
+            for t, arr in read_snap.values():
+                t._data_ = arr
+        if any(rec[0] for rec in tr.host_reads):
+            raise TraceEscape(
+                "data-dependent python branch (bool(tensor)) in the "
+                "forward — guard re-specialization is to_static's job")
+        if tr.host_reads:
+            raise TraceEscape(
+                "host read (float()/item()/numpy()) in the forward")
+
+        # classify captures: the optimizer's update set vs const captures
+        grads_present = {id(p) for p in opt._parameter_list
+                         if p.grad is not None and not p.stop_gradient}
+        self._idxs = [i for i, p in enumerate(opt._parameter_list)
+                      if id(p) in grads_present]
+        self._params = [opt._parameter_list[i] for i in self._idxs]
+        if not self._params:
+            raise TraceEscape("no trainable parameters received gradients")
+        # the batch tensors are per-call program INPUTS, not captures —
+        # holding them in _caps would feed call 1's batch forever
+        batch_ids = {id(t) for t in (x, y) if isinstance(t, Tensor)}
+        param_ids = {id(p) for p in self._params}
+        self._caps = [t for t in tr.capture_list
+                      if id(t) not in param_ids and id(t) not in batch_ids]
+        # whether the forward draws framework RNG (dropout): only then is
+        # a fresh key fed per call — feeding one unconditionally would
+        # advance the global RNG counter the eager lane does not touch,
+        # desynchronizing everything else that draws from it (shuffling)
+        self._uses_rng = tr.rng_counter > 0
+        self._lr_scales = tuple(
+            p.optimize_attr.get("learning_rate", 1.0) for p in self._params)
+        self._wd_mask = tuple(opt._wd_applies(p) for p in self._params)
+        self._state_names = tuple(opt._state)
+        self._mesh = _resolve_mesh(self._mesh_arg)
+        self._dp = self._mesh.get_dim_size("dp") if self._mesh else 1
+        self._built = True
+
+    # ------------------------------------------------------------------
+    # phase 2: the pure step body (replayed under jax.jit tracing)
+    # ------------------------------------------------------------------
+
+    def _traced_body(self, update, x, y, param_arrs, grad_arrs, cap_arrs,
+                     states, step_arr, svec, lr, key):
+        """Replay the step over tracer arrays; returns array pytrees.
+        Runs only while jax traces — per-step python cost is zero after
+        compilation."""
+        from ..core.state import no_grad
+
+        tracer = _StepBindTracer(key, lr)
+        installs = (list(zip(self._params, param_arrs))
+                    + list(zip(self._caps, cap_arrs)))
+        grad_seed = [(p.grad, g) for p, g in zip(self._params, grad_arrs)]
+        _state.STATE.tracer = tracer
+        try:
+            with _Installed(installs), _Installed(grad_seed):
+                # the forward expects framework Tensors; wrap the traced
+                # batch arrays (created under the tracer, so on_read never
+                # mistakes them for uncaptured state)
+                x_t = Tensor(x)
+                y_t = Tensor(y) if y is not None else None
+                loss_t = self._forward(x_t, y_t)
+                bwd_t = loss_t
+                if svec is not None:
+                    # scale is device state: multiply by the traced value
+                    bwd_t = bwd_t * Tensor(
+                        svec[0].astype(loss_t._data_.dtype))
+                if self._accum > 1:
+                    bwd_t = bwd_t * (1.0 / self._accum)
+                bwd_t.backward()
+                loss = loss_t._data_
+                grads = [p.grad._data_ for p in self._params]
+                grad_ids = {id(p.grad) for p in self._params}
+                mut_caps = [t for t in tracer.mutated_list
+                            if id(t) not in grad_ids]
+                if mut_caps and self._dp > 1:
+                    raise TraceEscape(
+                        "forward mutates non-parameter state (running "
+                        "stats?) — per-shard divergence under dp is not "
+                        "representable; run eager or dp=1")
+                self._mut_caps = mut_caps
+                mut_vals = tuple(t._data_ for t in mut_caps)
+                if not update:
+                    return loss, tuple(grads), mut_vals
+                with no_grad():
+                    tail = self._update_tail(grads, param_arrs, states,
+                                             step_arr, svec, lr)
+                new_params, new_states, new_step, new_svec, zeroed = tail
+                return (loss, tuple(new_params), tuple(zeroed), new_states,
+                        new_step, new_svec, mut_vals)
+        finally:
+            _state.STATE.tracer = None
+            # roll back any forward-mutated captures still holding
+            # tracers to their pre-write concrete values
+            for t in tracer.mutated_list:
+                if isinstance(t._data_, jax.core.Tracer):
+                    orig = tracer.mutated.get(id(t))
+                    if orig is not None and not isinstance(
+                            orig, jax.core.Tracer):
+                        t._data_ = orig
+
+    def _update_tail(self, grads, param_arrs, states, step_arr, svec, lr):
+        """Unscale → dp pmean → found-inf → clip → fused update → select.
+        Pure array math mirroring the eager sequence op-for-op."""
+        opt = self._opt
+        scaler_on = svec is not None
+        if scaler_on:
+            inv = 1.0 / svec[0]
+            grads = [g * inv.astype(g.dtype) for g in grads]
+        if self._dp > 1:
+            # the in-program analogue of _sync_grads' per-tensor
+            # all_reduce + divide: one psum/pmean per gradient that XLA
+            # schedules/overlaps inside the step program
+            grads = [jax.lax.pmean(g, "dp") for g in grads]
+        found = None
+        if scaler_on:
+            flags = [~jnp.isfinite(jnp.sum(g)) for g in grads]
+            found = jnp.any(jnp.stack(flags))
+            if self._dp > 1:
+                # global decision — a scalar psum, not a host round-trip
+                found = jax.lax.pmax(found.astype(jnp.int32),
+                                     "dp").astype(jnp.bool_)
+            # eager parity: the check is armed only while scaling is
+            # active (GradScaler.unscale_ skips it at scale == 1.0)
+            found = jnp.logical_and(found, svec[0] != 1.0)
+
+        if opt._grad_clip is not None:
+            pairs = opt._grad_clip(
+                [(p, Tensor(g)) for p, g in zip(self._params, grads)])
+            grads = [g._data_ for _, g in pairs]
+
+        new_step = step_arr + 1.0
+        new_params, new_states = type(opt)._fused_update(
+            opt, lr, new_step, list(param_arrs), grads, states,
+            lr_scales=self._lr_scales, wd_mask=self._wd_mask)
+
+        new_svec = svec
+        if scaler_on:
+            take = ~found
+            new_params = [jnp.where(take, n, o)
+                          for n, o in zip(new_params, param_arrs)]
+            new_states = {
+                name: [None if n is None else jnp.where(take, n, o)
+                       for n, o in zip(vals, states[name])]
+                for name, vals in new_states.items()}
+            new_step = jnp.where(take, new_step, step_arr)
+            new_svec = self._scaler_update(svec, found)
+        zeroed = [jnp.zeros_like(g) for g in grads]
+        return new_params, new_states, new_step, new_svec, zeroed
+
+    def _scaler_update(self, svec, found):
+        """``GradScaler.update`` as pure in-program math."""
+        sc = self._scaler
+        scale, good, bad = svec[0], svec[1], svec[2]
+        active = jnp.logical_and(
+            jnp.asarray(bool(sc._enable and sc._dynamic)), scale != 1.0)
+        bad_n = jnp.where(found, bad + 1.0, 0.0)
+        good_n = jnp.where(found, 0.0, good + 1.0)
+        dec = jnp.logical_and(found, bad_n >= sc._decr_every)
+        inc = jnp.logical_and(~found, good_n >= sc._incr_every)
+        scale_n = jnp.where(
+            dec, jnp.maximum(scale * sc._decr_ratio, 1.0),
+            jnp.where(inc, scale * sc._incr_ratio, scale))
+        bad_n = jnp.where(dec, 0.0, bad_n)
+        good_n = jnp.where(inc, 0.0, good_n)
+        out = jnp.stack([scale_n, good_n, bad_n])
+        return jnp.where(active, out, svec)
+
+    # ------------------------------------------------------------------
+    # compile + execute
+    # ------------------------------------------------------------------
+
+    def _build_jit(self, update):
+        from ..core.op_cache import ensure_compile_cache
+        ensure_compile_cache()     # tier-2 persistent XLA compile cache
+        mesh = self._mesh
+
+        def fn(x, y, params, grads, caps, states, step_arr, svec, lr,
+               key):
+            if self._dp > 1:
+                from jax.experimental.shard_map import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                def body(x, y, params, grads, caps, states, step_arr,
+                         svec, lr, key):
+                    # decorrelate per-shard RNG like per-rank eager dp
+                    key_s = jax.random.fold_in(
+                        key, jax.lax.axis_index("dp"))
+                    out = self._traced_body(update, x, y, params, grads,
+                                            caps, states, step_arr,
+                                            svec, lr, key_s)
+                    loss = jax.lax.pmean(out[0], "dp")
+                    return (loss,) + tuple(out[1:])
+                rep = P()
+                in_specs = (P("dp"), P("dp"), rep, rep, rep, rep, rep,
+                            rep, rep, rep)
+                return shard_map(body, mesh=mesh.jax_mesh,
+                                 in_specs=in_specs, out_specs=rep,
+                                 check_rep=False)(
+                    x, y, params, grads, caps, states, step_arr, svec,
+                    lr, key)
+            return self._traced_body(update, x, y, params, grads, caps,
+                                     states, step_arr, svec, lr, key)
+
+        self._donating = bool(_flag("FLAGS_jit_donate_buffers", True))
+        donate = ()
+        if self._donating:
+            # params, grads, opt state, step counter, scaler vec — the
+            # buffers the program replaces in place
+            donate = (2, 3, 5, 6, 7) if update else (3,)
+        kwargs = {}
+        if self._dp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            kwargs["out_shardings"] = NamedSharding(self._mesh.jax_mesh,
+                                                    P())
+        return jax.jit(fn, donate_argnums=donate, **kwargs)
+
+    def _gather_args(self, x, y):
+        opt = self._opt
+        xa = x._data_ if isinstance(x, Tensor) else jnp.asarray(x)
+        ya = y._data_ if isinstance(y, Tensor) else (
+            None if y is None else jnp.asarray(y))
+        params = tuple(p._data_ for p in self._params)
+        grads = tuple(p.grad._data_ for p in self._params)
+        caps = tuple(t._data_ for t in self._caps)
+        states = {name: [None if opt._state[name][i] is None
+                         else opt._state[name][i]._data_
+                         for i in self._idxs]
+                  for name in self._state_names}
+        step_arr = opt._step_tensor._data_
+        svec = None
+        if self._scaler is not None and self._scaler._enable:
+            if self._scaler_vec is None:
+                sc = self._scaler
+                self._scaler_vec = jnp.asarray(
+                    [sc._scale, float(sc._good_steps),
+                     float(sc._bad_steps)], jnp.float32)
+            svec = self._scaler_vec
+        lr = np.float32(opt.get_lr())
+        key = jax.random.fold_in(_state.STATE.rng_key,
+                                 _state.STATE.rng_counter)
+        _state.STATE.rng_counter += 1
+        return xa, ya, params, grads, caps, states, step_arr, svec, lr, key
+
+    def _run_compiled(self, x, y, update):
+        from ..utils import monitor as _monitor
+        opt = self._opt
+        args = self._gather_args(x, y)
+        if self._dp > 1 and (args[0].shape[0] % self._dp):
+            # ragged tail batch cannot shard evenly: one-off eager step
+            _monitor.incr("jit.compiled_step_ragged_fallback")
+            return self._run_eager(x, y, update)
+        if self._donating is not None and self._donating != bool(
+                _flag("FLAGS_jit_donate_buffers", True)):
+            self._jit_full = self._jit_micro = None   # flag flipped
+        jit = self._jit_full if update else self._jit_micro
+        if jit is None:
+            jit = self._build_jit(update)
+            if update:
+                self._jit_full = jit
+            else:
+                self._jit_micro = jit
+            _monitor.incr("jit.compiled_step_compile")
+        if self._donating and self._aliased(args, update):
+            _monitor.incr("jit.compiled_step_alias_fallback")
+            return self._run_eager(x, y, update)
+
+        if update:
+            (loss, new_params, zeroed, new_states, new_step, new_svec,
+             mut_vals) = jit(*args)
+            for p, arr in zip(self._params, new_params):
+                p._data_ = arr
+            for name in self._state_names:
+                vals = opt._state[name]
+                for k, i in enumerate(self._idxs):
+                    nv = new_states[name][k]
+                    if nv is None:
+                        continue
+                    if vals[i] is None:
+                        vals[i] = Tensor(nv)
+                    else:
+                        vals[i]._data_ = nv
+            opt._step_tensor._data_ = new_step
+            opt._step_count += 1
+            if new_svec is not None:
+                self._scaler_vec = new_svec
+            for p, g in zip(self._params, zeroed):
+                p.grad._data_ = g
+        else:
+            loss, new_grads, mut_vals = jit(*args)
+            for p, g in zip(self._params, new_grads):
+                p.grad._data_ = g
+        for t, arr in zip(self._mut_caps, mut_vals):
+            t._data_ = arr
+        return Tensor(loss)
+
+    def _aliased(self, args, update):
+        """Donation is unsound when one device buffer backs two donated
+        leaves (tied weights sharing an array): skip this call."""
+        if update:
+            donated = list(args[2]) + list(args[3]) + [args[6]]
+            for vals in args[5].values():
+                donated.extend(a for a in vals if a is not None)
+            if args[7] is not None:
+                donated.append(args[7])
+        else:
+            donated = list(args[3])
+        seen = set()
+        for a in donated:
+            if id(a) in seen:
+                return True
+            seen.add(id(a))
+        return False

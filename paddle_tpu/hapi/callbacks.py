@@ -136,6 +136,16 @@ class ModelCheckpoint(Callback):
         opt = getattr(self.model, "_optimizer", None)
         if opt is not None:
             state["optimizer"] = opt.state_dict()
+        if self.async_save:
+            # snapshot: the background thread must not race the
+            # donating compiled train step, which deletes the live
+            # param/state buffers in place on the very next step
+            from ..core.tensor import Tensor as _T
+            state = {
+                k: ({kk: _T(vv._data_.copy()) if isinstance(vv, _T)
+                     else vv for kk, vv in v.items()}
+                    if isinstance(v, dict) else v)
+                for k, v in state.items()}
         return state
 
     def save_now(self, next_epoch):
@@ -219,10 +229,13 @@ class LRScheduler(Callback):
 
 def config_callbacks(callbacks, model, epochs=None, steps=None,
                      verbose=2, save_freq=1, save_dir=None, metrics=None,
-                     max_to_keep=None):
+                     max_to_keep=None, log_freq=1):
     cbs = list(callbacks or [])
     if not any(isinstance(c, ProgBarLogger) for c in cbs):
-        cbs.insert(0, ProgBarLogger(verbose=verbose))
+        # the logger's cadence matches fit's log_freq: those are the
+        # steps where fit materializes the device-held loss for logs
+        cbs.insert(0, ProgBarLogger(log_freq=max(int(log_freq), 1),
+                                    verbose=verbose))
     if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbs):
         cbs.append(ModelCheckpoint(save_freq, save_dir,
                                    max_to_keep=max_to_keep))

@@ -105,6 +105,10 @@ class Model:
         if jit:
             from ..jit import to_static
             self._train_fn = to_static(self._train_step)
+        # compiled train step (framework/train_step.py): built lazily at
+        # the first train batch; None = not yet decided, False = ruled out
+        self._compiled_step = None
+        self._accum_steps = 1
         return self
 
     # ---- steps ----
@@ -121,49 +125,117 @@ class Model:
                                  custom_white_list=self._amp_lists[0],
                                  custom_black_list=self._amp_lists[1])
 
-    def _sync_grads(self):
+    def _sync_grads(self, with_found_inf=False):
         """Cross-process DP gradient all-reduce (mean) — the EagerReducer
-        analog for the launched-workers path."""
+        analog for the launched-workers path.
+
+        ``with_found_inf`` batches the AMP global inf/nan decision into
+        the same reduction pass: the scaler's DEVICE-side flag (computed
+        without a host read by ``unscale_(defer_found_inf=True)``) rides
+        one extra scalar all_reduce, and the single device→host sync
+        happens on the already-reduced scalar — a global decision with no
+        per-rank host round-trip.  (A rank skipping the step while
+        another applies the possibly inf-contaminated update would
+        diverge the replicas.)"""
         from .. import distributed as dist
         for p in self._optimizer._all_params():
             if p.grad is not None:
                 dist.all_reduce(p.grad)
                 p.grad._data = p.grad._data / self._nranks
+        if with_found_inf:
+            flag = self._scaler._found_inf_tensor()
+            dist.all_reduce(flag)
+            self._scaler._found_inf = bool(
+                float(np.asarray(flag._data_)[0]) > 0)
 
-    def _train_step(self, x, y):
+    def _forward_loss(self, x, y):
+        """Forward + loss under autocast — the only user code the
+        compiled train step replays inside its XLA program."""
+        with self._autocast():
+            out = self.network(x)
+            return self._compute_loss(out, y)
+
+    def _train_step(self, x, y, update=True):
         with self._autocast():
             out = self.network(x)
             loss = self._compute_loss(out, y)
+        bwd = loss
         if self._scaler is not None:
-            self._scaler.scale(loss).backward()
+            bwd = self._scaler.scale(bwd)
+        if self._accum_steps > 1:
+            # scale each micro-batch so the accumulated gradient is the
+            # MEAN over the window (matching one big-batch step)
+            bwd = bwd * (1.0 / self._accum_steps)
+        bwd.backward()
+        if not update:
+            return loss, out     # micro-step: gradients accumulate
+        if self._scaler is not None:
             if self._nranks > 1:
-                self._scaler.unscale_(self._optimizer)
-                self._sync_grads()
-                # inf/nan is a GLOBAL decision: a rank skipping the step
-                # while another applies the (now all-reduced, possibly
-                # inf-contaminated) update would diverge the replicas
-                from .. import distributed as dist
-                from ..core.tensor import Tensor
-                import jax.numpy as jnp
-                flag = Tensor(jnp.asarray(
-                    [1.0 if self._scaler._found_inf else 0.0]))
-                dist.all_reduce(flag)
-                self._scaler._found_inf = bool(
-                    float(np.asarray(flag._data_)[0]) > 0)
+                self._scaler.unscale_(self._optimizer,
+                                      defer_found_inf=True)
+                self._sync_grads(with_found_inf=True)
             self._scaler.step(self._optimizer)  # step() runs update()
         else:
-            loss.backward()
             if self._nranks > 1:
                 self._sync_grads()
             self._optimizer.step()
         self._optimizer.clear_grad()
         return loss, out
 
-    def train_batch(self, inputs, labels=None, update=True):
+    def _ensure_compiled_step(self):
+        """The CompiledTrainStep for this model, or None for the eager
+        lane.  None stays undecided while the flag is off (it may flip
+        on); False latches structural ineligibility."""
+        if self._compiled_step is False:
+            return None
+        if self._compiled_step is not None:
+            return self._compiled_step
+        from ..utils.flags import flag as _flag
+        if not _flag("FLAGS_compiled_train_step", True):
+            return None
+        if (self._jit or self._loss is None or self._optimizer is None
+                or type(self).train_batch is not Model.train_batch
+                or type(self)._train_step is not Model._train_step
+                or type(self)._forward_loss is not Model._forward_loss):
+            self._compiled_step = False
+            return None
+        from ..framework.train_step import CompiledTrainStep
+        cs = CompiledTrainStep(
+            self._forward_loss, self._optimizer, scaler=self._scaler,
+            network=self.network,
+            accumulate_grad_batches=self._accum_steps,
+            eager_step=lambda x, y, update:
+                self._train_step(x, y, update)[0])
+        if cs.fallback_reason is not None:
+            self._compiled_step = False   # structurally eager: skip wrap
+            return None
+        self._compiled_step = cs
+        return cs
+
+    def _train_batch_device(self, inputs, labels=None, update=True):
+        """One train step returning the loss ON DEVICE (no host sync):
+        fit materializes it only at log_freq boundaries."""
         self.network.train()
         x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
         y = labels[0] if isinstance(labels, (list, tuple)) else labels
-        loss, out = self._train_fn(x, y)
+        cs = self._ensure_compiled_step()
+        if cs is not None:
+            return cs(x, y, update=update)
+        if self._jit:
+            # the to_static wrapper traces (x, y) only — it must not see
+            # the python `update` flag as a traced arg, and a traced
+            # full-step program may not honor grads accumulated outside
+            # it, so micro-steps (and their closing update) run eagerly
+            if update and self._accum_steps <= 1:
+                loss, _ = self._train_fn(x, y)
+            else:
+                loss, _ = self._train_step(x, y, update)
+        else:
+            loss, _ = self._train_fn(x, y, update)
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        loss = self._train_batch_device(inputs, labels, update)
         return [float(np.asarray(loss._data_))]
 
     def eval_batch(self, inputs, labels=None):
@@ -216,11 +288,15 @@ class Model:
             steps = len(loader)
         except TypeError:
             steps = None
+        accumulate_grad_batches = max(int(accumulate_grad_batches or 1), 1)
+        if accumulate_grad_batches != self._accum_steps:
+            self._accum_steps = accumulate_grad_batches
+            self._compiled_step = None   # rebuild for the new window
         cbs = config_callbacks(callbacks, self, epochs=epochs, steps=steps,
                                verbose=verbose, save_freq=save_freq,
                                save_dir=save_dir,
                                metrics=[m.name() for m in self._metrics],
-                               max_to_keep=max_to_keep)
+                               max_to_keep=max_to_keep, log_freq=log_freq)
         ckpt_cb = next((c for c in cbs.callbacks
                         if isinstance(c, ModelCheckpoint)), None)
 
@@ -254,6 +330,7 @@ class Model:
                 for m in self._metrics:
                     m.reset()
                 logs = {}
+                loss_t = None
                 for step, batch in enumerate(loader):
                     x, y = self._split_batch(batch)
                     cbs.call("on_train_batch_begin", step)
@@ -261,10 +338,16 @@ class Model:
                         flops_pending = False
                         self._measure_step_flops(x)
                     examples, tokens = _batch_counts(x)
+                    update = (accumulate_grad_batches <= 1
+                              or (it + 1) % accumulate_grad_batches == 0)
                     self.step_metrics.begin_step()
-                    loss = self.train_batch(x, y)
+                    loss_t = self._train_batch_device(x, y, update=update)
                     self.step_metrics.end_step(examples, tokens)
-                    logs = {"loss": loss[0]}
+                    # the loss stays ON DEVICE between log points — the
+                    # old per-step float() fetch was a full host sync
+                    # stalling the dispatch pipeline every step
+                    if step % log_freq == 0 or self._metrics:
+                        logs["loss"] = float(np.asarray(loss_t._data_))
                     for m in self._metrics:
                         out = self.predict_batch(x)
                         m.update(*m.compute(out, y))
@@ -274,6 +357,7 @@ class Model:
                         # save at the step boundary, then request relaunch
                         # — the restarted process redoes this epoch from
                         # its start with the mid-epoch weights
+                        self._sync_compiled_state()
                         ckpt_cb.save_now(next_epoch=epoch)
                         ckpt_cb.manager.wait()
                         handler.uninstall()
@@ -281,6 +365,9 @@ class Model:
                     it += 1
                     if num_iters and it >= num_iters:
                         break
+                if loss_t is not None:
+                    logs["loss"] = float(np.asarray(loss_t._data_))
+                self._sync_compiled_state()
                 history["loss"].append(logs.get("loss"))
                 if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                     eval_logs = self.evaluate(eval_loader, verbose=0,
@@ -295,6 +382,14 @@ class Model:
                 handler.uninstall()
         cbs.call("on_train_end", logs)
         return history
+
+    def _sync_compiled_state(self):
+        """Materialize device-held compiled-step state (loss-scaler
+        scale/good/bad counters) back into the python objects before a
+        checkpoint save or epoch boundary reads them."""
+        cs = self._compiled_step
+        if cs is not None and cs is not False:
+            cs.sync_scaler()
 
     def _measure_step_flops(self, x):
         """Analytic FLOPs of one train step via the dispatch-funnel

@@ -338,22 +338,32 @@ class Adam(Optimizer):
         wd = _wd_coeff(self._weight_decay)
         bc1 = 1.0 - b1 ** step_t
         bc2 = 1.0 - b2 ** step_t
+        # Pallas fused-update lane (pallas/fused.py): TPU/interpret only,
+        # per-parameter shape-gated, bitwise-equal to the jnp sequence
+        # below (FLAGS_pallas_fused_optimizer; docs/TRAIN_STEP.md)
+        from ..pallas import fused as _pf
+        pallas_on = _pf.optimizer_kernels_enabled()
         new_p, new_m1, new_m2, new_mw = [], [], [], []
         for p, g, m1, m2, mw, s, use_wd in zip(
                 params, grads, states["moment1"], states["moment2"],
                 states["master"], lr_scales, wd_mask):
             w = mw if mw is not None else p.astype(jnp.float32)
-            gf = g.astype(jnp.float32)
-            if wd and use_wd and not self._decoupled:
-                gf = gf + wd * w  # L2-coupled (Adam semantics)
-            m1 = b1 * m1 + (1 - b1) * gf
-            m2 = b2 * m2 + (1 - b2) * jnp.square(gf)
-            m1_hat = m1 / bc1
-            m2_hat = m2 / bc2
-            upd = m1_hat / (jnp.sqrt(m2_hat) + eps)
-            if wd and use_wd and self._decoupled:
-                upd = upd + wd * w  # decoupled (AdamW semantics)
-            w = w - lr * s * upd
+            if pallas_on and _pf.adam_update_supported(w):
+                w, m1, m2 = _pf.adam_update_pallas(
+                    w, g, m1, m2, lr * s, bc1, bc2, b1=b1, b2=b2, eps=eps,
+                    wd=(wd if use_wd else 0.0), decoupled=self._decoupled)
+            else:
+                gf = g.astype(jnp.float32)
+                if wd and use_wd and not self._decoupled:
+                    gf = gf + wd * w  # L2-coupled (Adam semantics)
+                m1 = b1 * m1 + (1 - b1) * gf
+                m2 = b2 * m2 + (1 - b2) * jnp.square(gf)
+                m1_hat = m1 / bc1
+                m2_hat = m2 / bc2
+                upd = m1_hat / (jnp.sqrt(m2_hat) + eps)
+                if wd and use_wd and self._decoupled:
+                    upd = upd + wd * w  # decoupled (AdamW semantics)
+                w = w - lr * s * upd
             new_p.append(w.astype(p.dtype))
             new_m1.append(m1)
             new_m2.append(m2)

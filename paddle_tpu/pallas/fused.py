@@ -1,16 +1,25 @@
-"""Fused Pallas kernels: RMS norm and rotary embedding (rope).
+"""Fused Pallas kernels: RMS norm, rotary embedding (rope), and the
+Adam/AdamW optimizer update.
 
 Reference capability: the CUDA fusion pack —
 paddle/phi/kernels/gpu/rms_norm_kernel.cu (+ its grad in
-rms_norm_grad_kernel) and paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu.
+rms_norm_grad_kernel), paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu,
+and the multi-tensor fused adam/adamw kernels
+(paddle/phi/kernels/gpu/adamw_kernel.cu).
 TPU-native realization: row-blocked Pallas kernels with fp32 accumulation.
 RMS norm saves the per-row reciprocal-RMS as a residual so backward never
 re-reduces x², and accumulates the weight gradient across the sequential
 TPU grid in VMEM scratch (one kernel, no second pass).  Rope's backward is
 the forward kernel with negated sin (the rotation adjoint), so one kernel
-serves both directions.
+serves both directions.  The Adam update kernel streams (w, g, m1, m2)
+through VMEM row blocks and performs the EXACT elementwise fp32 sequence
+of ``optimizer.Adam._fused_update`` — same ops, same order — so the
+Pallas lane is bitwise-equal to the jnp lane (verified in interpreter
+mode by tests/test_train_step.py); it is gated by
+``FLAGS_pallas_fused_optimizer`` and used only on TPU (or under
+interpret mode), only for shapes the row-blocking supports.
 
-Both kernels run in interpreter mode on CPU for CI (see
+All kernels run in interpreter mode on CPU for CI (see
 flash_attention._interpret).
 """
 from __future__ import annotations
@@ -231,3 +240,92 @@ def rope_supported(t_shape, d):
     if not (_on_tpu() or _interpret()):
         return False
     return d % 2 == 0 and d <= 512 and t_shape[1] % 8 == 0
+
+
+# ------------------------------------------------------------------
+# Adam / AdamW fused update
+# ------------------------------------------------------------------
+
+def _adam_kernel(scal_ref, w_ref, g_ref, m1_ref, m2_ref,
+                 w_out, m1_out, m2_out, *, b1, b2, eps, wd, decoupled):
+    """One row block of the Adam/AdamW elementwise update.
+
+    The op sequence MUST mirror ``optimizer.Adam._fused_update`` exactly
+    (same fp32 ops, same order) so this lane is bitwise-equal to the jnp
+    lane — that is the "exact" contract FLAGS_pallas_fused_optimizer
+    promises.  scal_ref holds the three runtime scalars
+    [lr*lr_scale, bias_corr1, bias_corr2]."""
+    lr_s = scal_ref[0, 0]
+    bc1 = scal_ref[0, 1]
+    bc2 = scal_ref[0, 2]
+    w = w_ref[:]
+    gf = g_ref[:].astype(jnp.float32)
+    m1 = m1_ref[:]
+    m2 = m2_ref[:]
+    if wd and not decoupled:
+        gf = gf + wd * w              # L2-coupled (Adam semantics)
+    m1 = b1 * m1 + (1 - b1) * gf
+    m2 = b2 * m2 + (1 - b2) * jnp.square(gf)
+    m1_hat = m1 / bc1
+    m2_hat = m2 / bc2
+    upd = m1_hat / (jnp.sqrt(m2_hat) + eps)
+    if wd and decoupled:
+        upd = upd + wd * w            # decoupled (AdamW semantics)
+    w_out[:] = w - lr_s * upd
+    m1_out[:] = m1
+    m2_out[:] = m2
+
+
+_ADAM_LANES = 128
+
+
+def adam_update_supported(w):
+    """Row-blocking constraint: the fp32 working value must reshape to
+    [rows, 128] with rows a multiple of 8 (Mosaic sublane granularity)."""
+    n = 1
+    for d in w.shape:
+        n *= int(d)
+    return n % (_ADAM_LANES * 8) == 0
+
+
+def optimizer_kernels_enabled():
+    from ..utils.flags import flag as _flag
+    return bool(_flag("FLAGS_pallas_fused_optimizer", True)) and \
+        (_on_tpu() or _interpret())
+
+
+def adam_update_pallas(w, g, m1, m2, lr_s, bc1, bc2, *, b1, b2, eps, wd,
+                       decoupled):
+    """Fused Adam/AdamW step for one parameter.
+
+    w/m1/m2: fp32 working value and moments (any shape whose element
+    count satisfies :func:`adam_update_supported`); g: gradient (cast to
+    fp32 inside the kernel); lr_s/bc1/bc2: runtime scalars (traced).
+    Returns (new_w, new_m1, new_m2) with w's shape/dtype."""
+    from jax.experimental import pallas as pl
+
+    shape = w.shape
+    n = w.size
+    rows = n // _ADAM_LANES
+    w2 = w.reshape(rows, _ADAM_LANES)
+    g2 = g.reshape(rows, _ADAM_LANES)
+    m1_2 = m1.reshape(rows, _ADAM_LANES)
+    m2_2 = m2.reshape(rows, _ADAM_LANES)
+    block = _pick_block_rows(rows, _ADAM_LANES)
+    grid = (rows // block,)
+    scal = jnp.stack([jnp.asarray(lr_s, jnp.float32),
+                      jnp.asarray(bc1, jnp.float32),
+                      jnp.asarray(bc2, jnp.float32)]).reshape(1, 3)
+    row_spec = pl.BlockSpec((block, _ADAM_LANES), lambda i: (i, 0))
+    out = pl.pallas_call(
+        functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps, wd=wd,
+                          decoupled=decoupled),
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, 3), lambda i: (0, 0)),
+                  row_spec, row_spec, row_spec, row_spec],
+        out_specs=[row_spec, row_spec, row_spec],
+        out_shape=[jax.ShapeDtypeStruct((rows, _ADAM_LANES), jnp.float32)] * 3,
+        interpret=_interpret(),
+    )(scal, w2, g2, m1_2, m2_2)
+    return (out[0].reshape(shape), out[1].reshape(shape),
+            out[2].reshape(shape))

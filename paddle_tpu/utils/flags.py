@@ -87,6 +87,22 @@ _FLAGS: dict[str, Any] = {
     # when the backend cannot execute multiprocess programs; "xla" and
     # "host" pin a lane.
     "FLAGS_collective_backend": "auto",
+    # compiled train step (framework/train_step.py, docs/TRAIN_STEP.md):
+    # hapi Model.fit and the train benches execute the WHOLE training
+    # step — forward, backward, grad clip/scale, AMP found-inf check,
+    # optimizer update — as one donated-buffer jax.jit program (with dp
+    # gradient reduction as in-program psum under shard_map when a dp
+    # mesh spans >1 local device) instead of op-by-op eager dispatch.
+    # Eager stays the fallback: hooks, tracers, custom train_batch
+    # overrides, launched multi-process worlds without a global jax
+    # mesh, or this flag off all run the byte-identical eager path.
+    "FLAGS_compiled_train_step": True,
+    # Pallas fused-optimizer kernels (pallas/fused.py): run the AdamW/
+    # Adam elementwise update as a row-blocked Pallas kernel on TPU
+    # (exact — same fp32 arithmetic as the XLA lane, verified bitwise in
+    # interpreter mode).  Off, or on shapes/backends the kernel does not
+    # support, the jnp update runs unchanged.
+    "FLAGS_pallas_fused_optimizer": True,
     # desync detector sampling: every N-th collective per group reads
     # peers' arrival records from the guardian store and raises
     # DesyncError on an op mismatch at the same sequence number.

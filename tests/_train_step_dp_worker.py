@@ -1,0 +1,63 @@
+"""2-process eager-dp trajectory worker for tests/test_train_step.py.
+
+Each rank trains the identically-seeded MLP on its shard of the SAME
+global batches through hapi's eager lane (per-tensor ``_sync_grads``
+all-reduce); the parent test replays the global batches through the
+compiled train step's in-program dp ``pmean`` on a 2-device mesh and
+asserts the trajectories match.  Also asserts the compiled step itself
+DECLINES a multi-process CPU world (host-collective lane)."""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["PADDLE_MASTER"],
+    num_processes=int(os.environ["WORLD_SIZE"]),
+    process_id=int(os.environ["PADDLE_TRAINER_ID"]))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn, Model  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+    assert world == 2
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.AdamW(0.01, parameters=net.parameters(),
+                                 weight_decay=0.01)
+    model = Model(net)
+    model.prepare(optimizer=opt, loss=lambda o, y: ((o - y) ** 2).mean())
+    assert model._nranks == 2
+
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(6):
+        xg = rng.standard_normal((4, 8)).astype("float32")
+        yg = rng.standard_normal((4, 4)).astype("float32")
+        x = paddle.to_tensor(xg[rank * 2:(rank + 1) * 2])
+        y = paddle.to_tensor(yg[rank * 2:(rank + 1) * 2])
+        losses.append(model.train_batch(x, y)[0])
+
+    # the compiled step must have declined this world: 2-proc CPU runs
+    # the host-collective eager lane, which one XLA program cannot span
+    assert model._compiled_step is False, model._compiled_step
+
+    with open(os.path.join(out_dir, f"result.{rank}.json"), "w") as f:
+        json.dump({"losses": losses,
+                   "weights": [p.numpy().ravel().tolist()
+                               for p in net.parameters()]}, f)
+    open(os.path.join(out_dir, f"ok.{rank}"), "w").close()
+
+
+if __name__ == "__main__":
+    main()

@@ -83,9 +83,14 @@ python benchmarks/serving_bench.py --workload prefix --smoke \
     --out /tmp/serving_paged_ci.json
 python tools/check_bench_result.py /tmp/serving_paged_ci.json
 
-echo "== eager op-dispatch cache microbench (smoke) =="
-python benchmarks/eager_overhead.py --smoke --out /tmp/eager_overhead_ci.json
+echo "== eager op-dispatch cache microbench (smoke + drift gate) =="
+python benchmarks/eager_overhead.py --smoke --out /tmp/eager_overhead_ci.json \
+    --baseline benchmarks/EAGER_OVERHEAD.json
 python tools/check_bench_result.py /tmp/eager_overhead_ci.json
+
+echo "== compiled train step bench (smoke: >=1.5x vs eager + ulp-equal trajectories) =="
+python benchmarks/train_step_bench.py --smoke --out /tmp/train_step_ci.json
+python tools/check_bench_result.py /tmp/train_step_ci.json
 
 echo "== telemetry smoke (hapi fit + exporter -> prometheus/json gates) =="
 FLAGS_metrics_export_path=/tmp/pt_metrics_ci.jsonl \
