@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Serving-fleet chaos benchmark: kill a replica mid-load, lose nothing.
+
+Drives `paddle_tpu.serving.ServingFleet` — 3 engine replicas in
+separate processes behind the drain-aware `ServingRouter` — through the
+two replica-death modes while a concurrent greedy workload is in
+flight:
+
+- **sigkill** — chaos: one replica is SIGKILLed with requests active on
+  it.  The router detects the death (dropped rpc connection / expired
+  heartbeat lease), marks it sticky-dead, and resubmits the orphaned
+  requests to survivors under their idempotent request ids;
+- **sigterm** — graceful scale-down: the replica publishes `draining`,
+  finishes its in-flight slots inside the drain deadline, bounces its
+  queue back for resubmission, and exits 0.
+
+Asserted invariants (the CI gate re-checks them from the JSON):
+zero lost requests (every future resolves), zero duplicate tokens
+(every output is bit-equal to the single-model greedy reference — a
+resubmitted stream that decoded twice or dropped tokens could not be),
+p99 recovery latency below the drain deadline, and no leaked replica
+processes after shutdown.
+
+Prints ONE JSON line and (unless --no-write) records the result at
+benchmarks/SERVING_FLEET_BENCH.json.  `--smoke` shrinks the workload
+for CI (tools/run_ci.sh), which then validates schema + gates via
+tools/check_bench_result.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+VOCAB = 256
+
+
+def make_model():
+    """Replica model factory (top-level: spawn pickles it)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=2, hidden_size=64, num_heads=4,
+        vocab_size=VOCAB, max_seq_len=64))
+    m.eval()
+    return m
+
+
+def _prompts(n, rng):
+    lens = [int(rng.integers(4, 12)) for _ in range(n)]
+    return [rng.integers(0, VOCAB, (m,)).astype("int32") for m in lens]
+
+
+def _reference(prompts, max_new):
+    import paddle_tpu as paddle
+    model = make_model()
+    refs = []
+    for p in prompts:
+        ids = model.generate(paddle.to_tensor(p[None, :]),
+                             max_new_tokens=max_new, temperature=0.0)
+        refs.append(np.asarray(ids._data_)[0, p.size:])
+    return refs
+
+
+def _p99(xs):
+    return float(np.percentile(np.asarray(xs), 99)) if xs else 0.0
+
+
+def _run_variant(variant, prompts, refs, max_new, args):
+    """One chaos round: fleet up, load on, kill/drain one replica
+    mid-flight, account for every request."""
+    from paddle_tpu.serving import (ReplicaConfig, RouterConfig,
+                                    ServingConfig, ServingFleet)
+    rng = np.random.default_rng(1)
+    warm = rng.integers(0, VOCAB, (4,)).astype("int32")
+    drain_deadline_s = args.drain_deadline_s
+    fleet = ServingFleet(
+        make_model, num_replicas=args.num_replicas,
+        serving_config=ServingConfig(num_slots=args.num_slots,
+                                     max_queue=len(prompts)),
+        replica_config=ReplicaConfig(heartbeat_interval_s=0.2,
+                                     heartbeat_ttl_s=1.5,
+                                     drain_deadline_s=drain_deadline_s),
+        router_config=RouterConfig(heartbeat_ttl_s=1.5,
+                                   poll_interval_s=0.1),
+        warmup_prompt=warm)
+    res = {"variant": variant}
+    t_up = time.perf_counter()
+    with fleet:
+        res["startup_s"] = round(time.perf_counter() - t_up, 3)
+        t0 = time.perf_counter()
+        futs = [fleet.submit(p, max_new_tokens=max_new, session_id=i)
+                for i, p in enumerate(prompts)]
+        # let the load spread across replicas before striking
+        time.sleep(args.kill_after_s)
+        victim = sorted(fleet._procs)[0]
+        t_kill = time.perf_counter()
+        if variant == "sigkill":
+            fleet.kill_replica(victim, sig=signal.SIGKILL)
+        else:
+            fleet.drain_replica(victim)       # SIGTERM
+        done_at, outs, lost = [], [], 0
+        for fut in futs:
+            try:
+                outs.append(fut.result(timeout=args.timeout_s))
+                done_at.append(time.perf_counter())
+            except Exception as e:            # noqa: BLE001
+                outs.append(e)
+                lost += 1
+        wall = time.perf_counter() - t0
+        mismatches = 0
+        for o, ref in zip(outs, refs):
+            if isinstance(o, Exception) or \
+                    not np.array_equal(o.output_ids, ref):
+                mismatches += 1
+        victim_proc = fleet._procs[victim]
+        if variant == "sigterm":
+            victim_proc.join(drain_deadline_s + 10)
+            res["drain_exit_s"] = round(time.perf_counter() - t_kill, 3)
+            res["drain_exitcode"] = victim_proc.exitcode
+        snap = fleet.stats()
+        states = fleet.router.replicas()
+        procs = dict(fleet._procs)
+    leaked = [n for n, p in procs.items() if p.is_alive()]
+    tokens = sum(o.output_ids.size for o in outs
+                 if not isinstance(o, Exception))
+    res.update({
+        "victim": victim,
+        "requests": len(prompts),
+        "lost_requests": lost,
+        "greedy_mismatches": mismatches,
+        "duplicate_tokens": mismatches,   # bit-equality covers both
+        "recovery_p99_s": round(_p99(
+            [max(0.0, t - t_kill) for t in done_at]), 3),
+        "wall_s": round(wall, 3),
+        "tokens_per_sec": round(tokens / wall, 2) if wall > 0 else 0.0,
+        "failovers": snap["router_failovers"],
+        "resubmissions": snap["router_resubmissions"],
+        "requests_recovered": snap["router_requests_recovered"],
+        "requests_shed": snap["router_requests_shed"],
+        "victim_final_state": states.get(victim),
+        "leaked_processes": leaked,
+    })
+    return res
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="small CI workload (tools/run_ci.sh)")
+    ap.add_argument("--variants", default="sigkill,sigterm")
+    ap.add_argument("--num-replicas", type=int, default=3)
+    ap.add_argument("--num-slots", type=int, default=2)
+    ap.add_argument("--num-requests", type=int, default=None)
+    ap.add_argument("--max-new-tokens", type=int, default=None)
+    ap.add_argument("--drain-deadline-s", type=float, default=10.0)
+    ap.add_argument("--kill-after-s", type=float, default=0.3)
+    ap.add_argument("--timeout-s", type=float, default=180.0)
+    ap.add_argument("--out", default=None,
+                    help="write the JSON here instead of "
+                         "benchmarks/SERVING_FLEET_BENCH.json")
+    ap.add_argument("--no-write", action="store_true")
+    args = ap.parse_args(argv)
+    if args.num_requests is None:
+        args.num_requests = 8 if args.smoke else 16
+    if args.max_new_tokens is None:
+        args.max_new_tokens = 8 if args.smoke else 24
+
+    import jax
+    rng = np.random.default_rng(0)
+    prompts = _prompts(args.num_requests, rng)
+    refs = _reference(prompts, args.max_new_tokens)
+
+    variants = {}
+    for variant in args.variants.split(","):
+        variants[variant] = _run_variant(variant, prompts, refs,
+                                         args.max_new_tokens, args)
+
+    worst_recovery = max(v["recovery_p99_s"] for v in variants.values())
+    ok = all(v["lost_requests"] == 0 and v["greedy_mismatches"] == 0
+             and not v["leaked_processes"] for v in variants.values())
+    result = {
+        "metric": "serving_fleet_chaos",
+        "value": worst_recovery,
+        "unit": "recovery_p99_s",
+        "passed": ok,
+        "num_replicas": args.num_replicas,
+        "num_slots": args.num_slots,
+        "num_requests": args.num_requests,
+        "max_new_tokens": args.max_new_tokens,
+        "drain_deadline_s": args.drain_deadline_s,
+        "variants": variants,
+        "smoke": bool(args.smoke),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(result))
+    if not args.no_write:
+        out = args.out or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "SERVING_FLEET_BENCH.json")
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    if not ok:
+        print("FLEET CHAOS FAILED", file=sys.stderr)
+        return 1
+    if worst_recovery >= args.drain_deadline_s:
+        print(f"recovery p99 {worst_recovery}s exceeds drain deadline "
+              f"{args.drain_deadline_s}s", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,89 @@ def _serve_loop():
         threading.Thread(target=_handle, args=(conn,), daemon=True).start()
 
 
+class RpcServer:
+    """Standalone rpc agent: a listener serving python callables with NO
+    master rendezvous — the endpoint is published out of band (the
+    serving fleet gossips it through ``distributed/store.py``).  Unlike
+    :func:`init_rpc`'s process-global agent, any number of RpcServers
+    can coexist in one process (thread-mode replica tests host several),
+    each with its own listener and accept loop.  ``close()`` is
+    idempotent."""
+
+    def __init__(self, name, host="127.0.0.1", port=0):
+        self.name = name
+        # backlog: the default of 1 drops SYNs when several router
+        # dispatch threads dial at once — the kernel then retransmits
+        # with exponential backoff and a "fast" connect silently takes
+        # seconds to minutes.  A serving endpoint needs real depth.
+        self._listener = Listener((host, port), backlog=64,
+                                  authkey=_state["authkey"])
+        self.info = WorkerInfo(name, -1, host, self._listener.address[1])
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name=f"rpc-server-{name}", daemon=True)
+        self._thread.start()
+        # reachable through the local registry too (self-calls in tests)
+        _state["workers"][name] = self.info
+
+    def _loop(self):
+        while self._running:
+            try:
+                conn = self._listener.accept()
+            except OSError:
+                return
+            except Exception:
+                # failed handshake (incl. close()'s wake-up poke):
+                # keep serving while running, exit once closed
+                continue
+            if not self._running:
+                conn.close()
+                return
+            threading.Thread(target=_handle, args=(conn,),
+                             daemon=True).start()
+
+    def close(self):
+        if not self._running:
+            return
+        self._running = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        # a thread blocked in accept() holds the kernel listening socket
+        # open — close() alone does NOT wake it, and the port would keep
+        # accepting calls.  Poke one throwaway connection to unblock it.
+        _poke(self.info.ip, self.info.port)
+        self._thread.join(2.0)
+        if _state["workers"].get(self.name) is self.info:
+            del _state["workers"][self.name]
+
+
+def _poke(ip, port):
+    """Wake a thread blocked in Listener.accept() so the closed socket
+    is actually released by the kernel (see RpcServer.close)."""
+    import socket
+    try:
+        s = socket.create_connection((ip, port), timeout=0.5)
+        s.close()
+    except OSError:
+        pass
+
+
+def connect_worker(name, ip, port, rank=-1):
+    """Register a remote worker endpoint discovered out of band (store
+    gossip) so ``rpc_sync``/``rpc_async`` can reach it without the
+    master-coordinated registry.  Returns the WorkerInfo."""
+    info = WorkerInfo(name, rank, ip, int(port))
+    _state["workers"][name] = info
+    return info
+
+
+def forget_worker(name):
+    """Drop a worker from the local registry (dead replica)."""
+    _state["workers"].pop(name, None)
+
+
 def _handle(conn):
     try:
         while True:
@@ -82,7 +165,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     master = master_endpoint or os.environ.get("PADDLE_MASTER_ENDPOINT",
                                                "127.0.0.1:29590")
     ip = "127.0.0.1"
-    listener = Listener((ip, 0), authkey=_state["authkey"])
+    listener = Listener((ip, 0), backlog=64, authkey=_state["authkey"])
     port = listener.address[1]
     me = WorkerInfo(name, rank, ip, port)
     _state.update(me=me, listener=listener, running=True)
@@ -95,7 +178,8 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     if rank == 0:
         # rank0 IS the master registry; rebind listener already done — also
         # listen on the master port for registrations
-        reg = Listener((mhost, int(mport)), authkey=_state["authkey"])
+        reg = Listener((mhost, int(mport)), backlog=64,
+                       authkey=_state["authkey"])
         _state["master_listener"] = reg
 
         def master_loop():
@@ -126,11 +210,38 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
 
 
 def _connect(to):
+    """Dial ``to``.  Transient connect-time failures (listener backlog,
+    restarting worker) are retried with jittered exponential backoff —
+    connect happens strictly BEFORE the call is sent, so retrying here
+    can never double-deliver a call (utils/retry.py; a call that already
+    went out is never retried by this layer).  The ``rpc_drop`` /
+    ``rpc_delay`` fault-injection points fire here for the same reason:
+    an injected failure is always a clean, safe-to-retry connect
+    failure."""
     info = _state["workers"].get(to)
     if info is None:
         raise ValueError(f"unknown worker {to!r}; known: "
                          f"{sorted(_state['workers'])}")
-    return Client((info.ip, info.port), authkey=_state["authkey"])
+    from ...utils import fault_injection as _fi
+    _fi.check_rpc("rpc_delay", to)           # sleeps when armed
+    if _fi.check_rpc("rpc_drop", to):
+        raise ConnectionError(
+            f"rpc to worker {to!r}: connect dropped by injected fault "
+            "(FLAGS_fault_inject rpc_drop)")
+    from ...utils.retry import retry_call
+
+    def _dial():
+        return Client((info.ip, info.port), authkey=_state["authkey"])
+
+    try:
+        return retry_call(_dial, tries=3,
+                          retry_on=(ConnectionRefusedError,
+                                    ConnectionResetError),
+                          base=0.05, max_delay=0.5)
+    except (ConnectionRefusedError, ConnectionResetError) as e:
+        raise ConnectionError(
+            f"rpc to worker {to!r} at {info.ip}:{info.port}: connect "
+            f"failed after retries ({e})") from e
 
 
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
@@ -147,7 +258,17 @@ def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
                     f"rpc to worker {to!r} ({getattr(fn, '__name__', fn)}) "
                     f"timed out after {timeout}s — worker dead or call "
                     "wedged; no response arrived")
-        status, payload = c.recv()
+        try:
+            status, payload = c.recv()
+        except (EOFError, ConnectionResetError, BrokenPipeError) as e:
+            # the peer died mid-call: distinct from a clean connect
+            # failure — the call MAY have been delivered, so this layer
+            # never retries it (callers with idempotent request ids, like
+            # the serving router, may)
+            raise ConnectionError(
+                f"rpc to worker {to!r} "
+                f"({getattr(fn, '__name__', fn)}): connection lost "
+                f"mid-call ({type(e).__name__}) — worker died") from e
     finally:
         c.close()
     if status == "err":
@@ -186,13 +307,22 @@ def get_current_worker_info():
 
 
 def shutdown():
+    """Stop the process-global agent.  Idempotent: calling it twice (or
+    without ever calling init_rpc) is a no-op — the serving fleet's
+    replica teardown and the router's close() both call it defensively."""
     _state["running"] = False
     for key in ("listener", "master_listener"):
-        lst = _state.get(key)
+        lst = _state.pop(key, None)
         if lst is not None:
+            addr = getattr(lst, "address", None)
             try:
                 lst.close()
-            except OSError:
+            except (OSError, ValueError):
                 pass
+            # wake any thread blocked in accept() so the kernel really
+            # releases the listening socket (see RpcServer.close)
+            if isinstance(addr, tuple) and len(addr) == 2:
+                _poke(addr[0], addr[1])
+    _state["listener"] = None
     _state["workers"].clear()
     _state["me"] = None

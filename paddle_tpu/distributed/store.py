@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 import time
 
 _LIB = None
@@ -69,6 +70,10 @@ class TCPStore:
         lib = _lib()
         self._server = None
         self.host = host
+        # one fd, strict request/response framing: concurrent callers
+        # (serving router watcher + dispatch threads, fleet orchestrator)
+        # must not interleave on the wire
+        self._io = threading.Lock()
         if is_master:
             self._server = lib.ts_server_start(port)
             if not self._server:
@@ -107,8 +112,9 @@ class TCPStore:
     def set(self, key, value):
         if isinstance(value, str):
             value = value.encode()
-        r = _lib().ts_set(self._fd, key.encode(), len(key.encode()),
-                          value, len(value))
+        with self._io:
+            r = _lib().ts_set(self._fd, key.encode(), len(key.encode()),
+                              value, len(value))
         if r < 0:
             raise RuntimeError(f"TCPStore.set({key!r}) failed")
 
@@ -119,8 +125,9 @@ class TCPStore:
         cap = 1 << 16
         while True:
             buf = ctypes.create_string_buffer(cap)
-            r = _lib().ts_get(self._fd, key.encode(), len(key.encode()),
-                              buf, cap)
+            with self._io:
+                r = _lib().ts_get(self._fd, key.encode(),
+                                  len(key.encode()), buf, cap)
             if r == -1:
                 return default
             if r == -2:
@@ -131,8 +138,9 @@ class TCPStore:
 
     def wait(self, key, timeout=60.0):
         buf = ctypes.create_string_buffer(1 << 16)
-        r = _lib().ts_wait(self._fd, key.encode(), len(key.encode()),
-                           int(timeout * 1000), buf, len(buf))
+        with self._io:
+            r = _lib().ts_wait(self._fd, key.encode(), len(key.encode()),
+                               int(timeout * 1000), buf, len(buf))
         if r == -1:
             raise TimeoutError(f"TCPStore.wait({key!r}): not set within "
                                f"{timeout}s")
@@ -141,25 +149,30 @@ class TCPStore:
         return buf.raw[:r]
 
     def add(self, key, delta=1):
-        v = _lib().ts_add(self._fd, key.encode(), len(key.encode()),
-                          int(delta))
+        with self._io:
+            v = _lib().ts_add(self._fd, key.encode(), len(key.encode()),
+                              int(delta))
         if v == -(2 ** 63):
             raise RuntimeError(f"TCPStore.add({key!r}) failed")
         return v
 
     def delete_key(self, key):
-        _lib().ts_del(self._fd, key.encode(), len(key.encode()))
+        with self._io:
+            _lib().ts_del(self._fd, key.encode(), len(key.encode()))
 
     def stamp(self, key):
         """Write the SERVER's clock under key (liveness heartbeats must
         not mix per-host wall clocks)."""
-        r = _lib().ts_stamp(self._fd, key.encode(), len(key.encode()))
+        with self._io:
+            r = _lib().ts_stamp(self._fd, key.encode(),
+                                len(key.encode()))
         if r < 0:
             raise RuntimeError(f"TCPStore.stamp({key!r}) failed")
 
     def server_now(self):
         """The server's clock (f64 seconds since epoch)."""
-        v = _lib().ts_now(self._fd)
+        with self._io:
+            v = _lib().ts_now(self._fd)
         if v < 0:
             raise RuntimeError("TCPStore.server_now failed")
         return v
@@ -169,8 +182,9 @@ class TCPStore:
         cap = 1 << 16
         while True:
             buf = ctypes.create_string_buffer(cap)
-            r = _lib().ts_list(self._fd, prefix.encode(),
-                               len(prefix.encode()), buf, cap)
+            with self._io:
+                r = _lib().ts_list(self._fd, prefix.encode(),
+                                   len(prefix.encode()), buf, cap)
             if r < 0:
                 raise RuntimeError("TCPStore: connection lost")
             if r <= cap:
@@ -186,12 +200,13 @@ class TCPStore:
             cap = int(r)
 
     def close(self):
-        if self._fd >= 0:
-            _lib().ts_close(self._fd)
-            self._fd = -1
-        if self._server:
-            _lib().ts_server_stop(self._server)
-            self._server = None
+        with self._io:
+            if self._fd >= 0:
+                _lib().ts_close(self._fd)
+                self._fd = -1
+            if self._server:
+                _lib().ts_server_stop(self._server)
+                self._server = None
 
 
 class FileKVStore:
@@ -283,32 +298,80 @@ class TCPElasticStore:
     over TCPStore — the etcd-grade replacement for FileStore when hosts
     share no filesystem.  Heartbeats are stamped with the SERVER's clock
     and compared against the server's clock (etcd leases pattern): a
-    worker whose wall clock is skewed must not look dead."""
+    worker whose wall clock is skewed must not look dead.
 
-    def __init__(self, store: TCPStore, ttl=10):
+    Also accepts any TCPStore-shaped KV without ``stamp``/``server_now``
+    (``FileKVStore``): heartbeats then carry the writer's wall clock —
+    fine for the single-host layouts those stores serve.
+
+    Expired nodes are *filtered* by :meth:`alive_nodes` but their keys
+    linger until :meth:`reap` deletes them.  The distinction matters to
+    consumers like the serving router: a node key that exists-but-expired
+    is a node that MISSED heartbeats (suspect, sticky-dead until it
+    re-registers), while a reaped/absent key is a clean departure — so a
+    flapping node cannot oscillate a consumer's view between polls."""
+
+    def __init__(self, store, ttl=10):
         self.store = store
         self.ttl = ttl
+
+    def _now(self):
+        if hasattr(self.store, "server_now"):
+            return self.store.server_now()
+        return time.time()
 
     def register(self, node_id):
         self.heartbeat(node_id)
 
     def heartbeat(self, node_id):
-        self.store.stamp(f"node.{node_id}")
+        if hasattr(self.store, "stamp"):
+            self.store.stamp(f"node.{node_id}")
+        else:
+            import struct
+            self.store.set(f"node.{node_id}",
+                           struct.pack("<d", time.time()))
+
+    def is_registered(self, node_id):
+        """Whether the node's key exists at all (expired or not) — a
+        heartbeater whose key was reaped must RE-register (fresh join)
+        instead of silently stamping a new key into existence."""
+        return self.store.get(f"node.{node_id}") is not None
 
     def deregister(self, node_id):
         self.store.delete_key(f"node.{node_id}")
 
-    def alive_nodes(self):
+    def _scan(self):
         import struct
-        now = self.store.server_now()
-        out = []
+        now = self._now()
+        alive, expired = [], []
         for key, val in self.store.list_prefix("node.").items():
             if len(val) != 8:
                 continue
             ts = struct.unpack("<d", val)[0]
-            if now - ts <= self.ttl:
-                out.append(key[len("node."):])
-        return sorted(out)
+            node = key[len("node."):]
+            (alive if now - ts <= self.ttl else expired).append(node)
+        return sorted(alive), sorted(expired)
+
+    def alive_nodes(self):
+        return self._scan()[0]
+
+    def expired_nodes(self):
+        """Nodes whose key exists but whose lease lapsed (missed
+        heartbeats, not yet reaped)."""
+        return self._scan()[1]
+
+    def reap(self):
+        """Delete every expired-TTL node key and return the reaped ids.
+        Until now expiry was only a read-side filter: dead keys lingered
+        forever and a node that resumed stamping a stale key would flap
+        back into ``alive_nodes()`` with no explicit rejoin.  After a
+        reap the node's next heartbeat finds its key gone (see
+        ``is_registered``) and must re-register — an explicit membership
+        event instead of an oscillation."""
+        reaped = self._scan()[1]
+        for node in reaped:
+            self.store.delete_key(f"node.{node}")
+        return reaped
 
 
 class Master:

@@ -46,6 +46,19 @@ def _constrain_act(x, seq_axis=None):
     return shard_constraint(x, mesh, spec=P(*entries))
 
 
+def _constrain_heads(x, mesh=None):
+    """[b, s, H, d] heads→mp when the mesh has an mp axis that divides
+    H (GQA kv heads may not; those stay replicated)."""
+    mesh = mesh or get_mesh()
+    if mesh is None or "mp" not in mesh.dim_names:
+        return x
+    if x.shape[2] % mesh.get_dim_size("mp") != 0:
+        return x
+    from jax.sharding import PartitionSpec as P
+    spec = P("dp" if "dp" in mesh.dim_names else None, None, "mp", None)
+    return shard_constraint(x, mesh, spec=spec)
+
+
 def _masked_parallel_ce(loss_fn, logits, labels, vocab_size):
     """Masked-mean over ParallelCrossEntropy per-token losses: divide by
     the NON-ignored count to match serial cross_entropy(reduction='mean')."""
@@ -77,7 +90,7 @@ class ParallelGPTAttention(Layer):
         self.out_proj = RowParallelLinear(h, h, weight_attr=out_init,
                                           input_is_parallel=True)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         cfg = self.config
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
@@ -86,13 +99,28 @@ class ParallelGPTAttention(Layer):
         # heads sharded over mp (dim 2 of [b,s,H,d]) — GSPMD keeps attention
         # fully local per mp shard, the Megatron layout
         mesh = get_mesh()
-        if mesh is not None and "mp" in mesh.dim_names:
-            from jax.sharding import PartitionSpec as P
-            spec = P("dp" if "dp" in mesh.dim_names else None, None, "mp",
-                     None)
-            q = shard_constraint(q, mesh, spec=spec)
-            k = shard_constraint(k, mesh, spec=spec)
-            v = shard_constraint(v, mesh, spec=spec)
+        q = _constrain_heads(q, mesh)
+        k = _constrain_heads(k, mesh)
+        v = _constrain_heads(v, mesh)
+        if cache is not None:
+            # serving decode path (same op chain as models/gpt.py): K/V
+            # stream through the slot/page cache on full LOGICAL shapes;
+            # the head axis stays mp-sharded through the op, so one
+            # replica id hosts every shard behind one engine
+            from ..incubate.nn import functional as IF
+            if "page_table" in cache:
+                out, cache["k_pool"], cache["v_pool"] = \
+                    IF.paged_masked_multihead_attention(
+                        q, k, v, cache["k_pool"], cache["v_pool"],
+                        cache["page_table"], cache["offset"],
+                        cache["page_size"])
+            else:
+                out, cache["k"], cache["v"] = \
+                    IF.masked_multihead_attention(
+                        q, k, v, cache["k"], cache["v"],
+                        cache["offset"])
+            out = MA.reshape(out, [b, s, h])
+            return self.out_proj(out)
         if self.use_ring_attention and mesh is not None \
                 and "sep" in mesh.dim_names \
                 and mesh.get_dim_size("sep") > 1:
@@ -150,17 +178,17 @@ class ParallelGPTBlock(Layer):
             self.mlp = ParallelGPTMLP(config)
         self.dropout = Dropout(config.dropout)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         # recompute lives ON the block (not the caller) so every user —
         # ParallelGPTModel's loop AND the pipeline's stage scan — gets
         # activation checkpointing from config.use_recompute alone
-        if self.use_recompute and not x.stop_gradient:
+        if self.use_recompute and cache is None and not x.stop_gradient:
             from ..distributed.fleet.utils import recompute
             return recompute(self._block_fwd, x)
-        return self._block_fwd(x)
+        return self._block_fwd(x, cache=cache)
 
-    def _block_fwd(self, x):
-        x = x + self.dropout(self.attn(self.ln_1(x)))
+    def _block_fwd(self, x, cache=None):
+        x = x + self.dropout(self.attn(self.ln_1(x), cache=cache))
         x = x + self.dropout(self.mlp(self.ln_2(x)))
         # between blocks: keep activations seq-sharded (Megatron-SP over mp
         # when sequence_parallel, else context-parallel over sep)
@@ -191,14 +219,23 @@ class ParallelGPTModel(Layer):
         self.ln_f = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None):
         b, s = input_ids.shape
         if position_ids is None:
             position_ids = creation.arange(s, dtype="int32")
+            if caches is not None:
+                off = caches[0]["offset"]
+                if len(getattr(off, "shape", [])) == 1:
+                    # per-slot offsets (serving): [B, S] positions so
+                    # each row is embedded at its own age
+                    position_ids = MA.reshape(off, [b, 1]) + \
+                        MA.reshape(position_ids, [1, s])
+                else:
+                    position_ids = position_ids + off
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(_constrain_act(x, seq_axis="sep"))
-        for block in self.h:
-            x = block(x)    # block self-recomputes per config
+        for i, block in enumerate(self.h):
+            x = block(x, cache=None if caches is None else caches[i])
         return self.ln_f(x)
 
 
@@ -220,8 +257,9 @@ class ParallelGPTForCausalLM(Layer):
                                     num_experts, moe_capacity)
         self.loss_fn = ParallelCrossEntropy()
 
-    def forward(self, input_ids, labels=None, position_ids=None):
-        hidden = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, labels=None, position_ids=None,
+                caches=None):
+        hidden = self.gpt(input_ids, position_ids, caches=caches)
         logits = F.linear(hidden, self.gpt.wte.weight.T)
         mesh = get_mesh()
         if mesh is not None and "mp" in mesh.dim_names:
@@ -236,6 +274,19 @@ class ParallelGPTForCausalLM(Layer):
                                        self.config.vocab_size)
             return logits, loss
         return logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=None, top_p=None, repetition_penalty=None,
+                 use_cache=True, eos_token_id=None):
+        """KV-cache incremental decoding (models/generation.py) — the
+        TP-sharded model decodes through the same cache ops as the
+        serial one, so a tensor-parallel serving replica hosts it
+        unchanged."""
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens=max_new_tokens,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, repetition_penalty=repetition_penalty,
+                        use_cache=use_cache, eos_token_id=eos_token_id)
 
     def num_params(self, non_embedding=True):
         n = sum(p.size for p in self.parameters())

@@ -65,13 +65,45 @@ class ParallelLlamaAttention(Layer):
                                         has_bias=False,
                                         input_is_parallel=True)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         cfg = self.config
         b, s, h = x.shape
         d = cfg.head_dim
         q = MA.reshape(self.q_proj(x), [b, s, cfg.num_heads, d])
         k = MA.reshape(self.k_proj(x), [b, s, cfg.num_kv_heads, d])
         v = MA.reshape(self.v_proj(x), [b, s, cfg.num_kv_heads, d])
+        if cache is not None:
+            # serving decode path — same op chain as models/llama.py:
+            # rope at each row's own cache age, K/V stored PRE-repeat
+            # (num_kv_heads) since the MMHA op groups Q heads natively.
+            # Head axes keep their mp constraints when divisible
+            # (gpt_parallel._constrain_heads), so the TP shards serve
+            # under one replica id.
+            from ..tensor_ops import creation
+            from .gpt_parallel import _constrain_heads
+            q = _constrain_heads(q)
+            k = _constrain_heads(k)
+            v = _constrain_heads(v)
+            off = cache["offset"]
+            pos = creation.arange(s, dtype="int32")
+            if len(getattr(off, "shape", [])) == 1:
+                pos = MA.reshape(off, [b, 1]) + MA.reshape(pos, [1, s])
+            else:
+                pos = pos + off
+            q, k, _ = IF.fused_rotary_position_embedding(
+                q, k, position_ids=pos, rotary_emb_base=cfg.rope_theta)
+            if "page_table" in cache:
+                out, cache["k_pool"], cache["v_pool"] = \
+                    IF.paged_masked_multihead_attention(
+                        q, k, v, cache["k_pool"], cache["v_pool"],
+                        cache["page_table"], cache["offset"],
+                        cache["page_size"])
+            else:
+                out, cache["k"], cache["v"] = \
+                    IF.masked_multihead_attention(
+                        q, k, v, cache["k"], cache["v"],
+                        cache["offset"])
+            return self.o_proj(MA.reshape(out, [b, s, h]))
         q, k, _ = IF.fused_rotary_position_embedding(
             q, k, rotary_emb_base=cfg.rope_theta)
         rep = cfg.num_heads // cfg.num_kv_heads
@@ -129,8 +161,8 @@ class ParallelLlamaBlock(Layer):
                                                 epsilon=config.rms_norm_eps)
         self.mlp = ParallelLlamaMLP(config)
 
-    def forward(self, x):
-        x = x + self.self_attn(self.input_layernorm(x))
+    def forward(self, x, cache=None):
+        x = x + self.self_attn(self.input_layernorm(x), cache=cache)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return _constrain_act(
             x, seq_axis="mp" if self.sequence_parallel else "sep")
@@ -151,11 +183,11 @@ class ParallelLlamaModel(Layer):
             for _ in range(config.num_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None):
         x = self.embed_tokens(input_ids)
         x = _constrain_act(x, seq_axis="sep")
-        for blk in self.layers:
-            x = blk(x)
+        for i, blk in enumerate(self.layers):
+            x = blk(x, cache=None if caches is None else caches[i])
         return self.norm(x)
 
 
@@ -183,8 +215,8 @@ class ParallelLlamaForCausalLM(Layer):
                 gather_output=False)
         self.loss_fn = ParallelCrossEntropy()
 
-    def forward(self, input_ids, labels=None):
-        hidden = self.llama(input_ids)
+    def forward(self, input_ids, labels=None, caches=None):
+        hidden = self.llama(input_ids, caches=caches)
         if self.lm_head is not None:
             logits = self.lm_head(hidden)
         else:
@@ -202,6 +234,19 @@ class ParallelLlamaForCausalLM(Layer):
                                        self.config.vocab_size)
             return logits, loss
         return logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=None, top_p=None, repetition_penalty=None,
+                 use_cache=True, eos_token_id=None):
+        """KV-cache incremental decoding (models/generation.py) — the
+        TP-sharded model decodes through the same cache ops as the
+        serial one, so a tensor-parallel serving replica hosts it
+        unchanged."""
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens=max_new_tokens,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, repetition_penalty=repetition_penalty,
+                        use_cache=use_cache, eos_token_id=eos_token_id)
 
     def num_params(self):
         return sum(p.size for p in self.parameters())

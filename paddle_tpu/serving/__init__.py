@@ -1,4 +1,4 @@
-"""`paddle_tpu.serving` — continuous-batching inference engine.
+"""`paddle_tpu.serving` — continuous-batching inference engine + fleet.
 
 The single-shot entry points (`models.generation.generate`,
 `inference.Predictor.run`) decode one fixed batch to completion.  This
@@ -7,24 +7,33 @@ a paged KV cache with shared-prefix reuse and chunked prefill
 (`paged_kv`, the default) or fixed per-slot stripes (`kv_slots`), a
 background scheduler with Orca-style continuous batching (`engine`),
 admission control with bounded queueing and per-request deadlines
-(`api`), and serving metrics through `utils.monitor` (`stats`).  See
-docs/SERVING.md.
+(`api`), serving metrics through `utils.monitor` (`stats`), and —
+scaling past one process — replicated engines behind a drain-aware,
+session-affine router that loses zero requests when a replica dies
+(`router`, `fleet`).  See docs/SERVING.md.
 """
 from __future__ import annotations
 
 from .api import (  # noqa: F401
-    DeadlineExceededError, EngineShutdownError, QueueFullError,
-    RequestOutput, SamplingParams, SchedulerStallError, ServingConfig,
-    ServingError,
+    DeadlineExceededError, EngineShutdownError, NoReplicaError,
+    QueueFullError, RequestOutput, SamplingParams, SchedulerStallError,
+    ServingConfig, ServingError,
 )
 from .engine import Engine  # noqa: F401
+from .fleet import ReplicaConfig, ReplicaServer, ServingFleet  # noqa: F401
 from .kv_slots import SlotKVCache  # noqa: F401
 from .paged_kv import PagedKVCache, PrefixTree  # noqa: F401
-from .stats import reset_serving_stats, serving_stats  # noqa: F401
+from .router import HashRing, RouterConfig, ServingRouter  # noqa: F401
+from .stats import (  # noqa: F401
+    reset_router_stats, reset_serving_stats, serving_stats,
+)
 
 __all__ = [
     "Engine", "ServingConfig", "SamplingParams", "RequestOutput",
     "SlotKVCache", "PagedKVCache", "PrefixTree", "ServingError",
     "QueueFullError", "DeadlineExceededError", "EngineShutdownError",
-    "SchedulerStallError", "serving_stats", "reset_serving_stats",
+    "SchedulerStallError", "NoReplicaError", "serving_stats",
+    "reset_serving_stats", "reset_router_stats", "ServingRouter",
+    "RouterConfig", "HashRing", "ServingFleet", "ReplicaServer",
+    "ReplicaConfig",
 ]

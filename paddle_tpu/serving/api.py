@@ -19,7 +19,21 @@ class ServingError(RuntimeError):
 
 
 class QueueFullError(ServingError):
-    """Admission rejected: the bounded request queue is at capacity."""
+    """Admission rejected: the bounded request queue is at capacity.
+
+    When the serving router sheds a request because every ready replica
+    is at capacity, ``retry_after_s`` carries the suggested client
+    backoff (the fleet analog of an HTTP 429 Retry-After header)."""
+
+    def __init__(self, *args, retry_after_s=None):
+        super().__init__(*args)
+        self.retry_after_s = retry_after_s
+
+
+class NoReplicaError(ServingError):
+    """The router found no ready replica to route to (none registered,
+    all dead, or all draining) and the request's deadline/patience ran
+    out — the loud alternative to a client hanging on a dead fleet."""
 
 
 class DeadlineExceededError(ServingError):

@@ -168,6 +168,11 @@ class Engine:
         if t is not None:
             t.join(wait_s)
             if t.is_alive():            # pragma: no cover
+                # fail the futures BEFORE raising: a scheduler wedged in
+                # a compiled step must not strand every client blocked
+                # on result() just because the join timed out
+                self._fail_all(EngineShutdownError(
+                    "engine shut down (scheduler thread wedged)"))
                 raise RuntimeError(
                     "serving scheduler thread failed to stop within "
                     f"{wait_s}s")

@@ -1,0 +1,474 @@
+"""Serving fleet: replicated engines behind the drain-aware router.
+
+Reference capability: the reference's fleet layer runs replicated
+inference workers with membership, failure detection and elastic
+relaunch (PAPER.md layers 5/9).  TPU-native realization:
+
+- :class:`ReplicaServer` hosts ONE `Engine` plus its rpc endpoint
+  (`distributed/rpc.RpcServer`), heartbeats a TTL lease and gossips
+  load through `distributed/store.py`, answers idempotent
+  `_remote_submit` calls (a resubmitted request id re-awaits the SAME
+  engine future — at-most-once decode per replica), and turns SIGTERM
+  into publish-`draining` → `Engine.drain` → deregister;
+- :func:`_replica_proc_main` is the subprocess entry the fleet spawns
+  one replica per process through; `tensor_parallel_degree > 1` builds
+  a local `"mp"` mesh over that many devices first, so an mp-sharded
+  `models/gpt_parallel.py` / `llama_parallel.py` model serves as ONE
+  replica id — models that don't fit a chip still present a single
+  engine to the router;
+- :class:`ServingFleet` is the local orchestrator: starts the
+  membership `TCPStore`, spawns N replicas, waits for them to warm into
+  the ring, fronts them with a `ServingRouter`, and supports chaos
+  (SIGKILL), graceful scale-down (SIGTERM → drain) and scale-up
+  (`add_replica`).  `benchmarks/serving_fleet_bench.py` drives it.
+
+Replica lifecycle states gossiped in the `fleet.{name}` record:
+``warming`` (model building / warmup compile) → ``ready`` (routable) →
+``draining`` (SIGTERM received; finishing in-flight, refusing new work).
+Join generations come from an atomic store counter, so EVERY
+(re)incarnation of a name is strictly ordered — the router's
+sticky-dead set compares generations, never wall clocks.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import TimeoutError as FuturesTimeout
+from dataclasses import dataclass
+
+import numpy as np
+
+from .api import EngineShutdownError, SamplingParams, ServingConfig
+from .router import INFO_PREFIX, RouterConfig, ServingRouter
+
+
+@dataclass
+class ReplicaConfig:
+    """Per-replica fleet knobs (docs/KNOBS.md "serving fleet" table).
+
+    heartbeat_interval_s    lease-stamp + load-gossip cadence
+    heartbeat_ttl_s         lease TTL; must exceed the interval with
+                            margin (a missed beat must not look dead)
+    drain_deadline_s        SIGTERM → how long in-flight slots may
+                            finish before the replica exits anyway
+    tensor_parallel_degree  >1 shards the replica's model over an
+                            "mp" mesh of that many LOCAL devices
+                            (one replica id, one engine, N shards)
+    dedup_results           how many request-id → future entries the
+                            idempotency cache keeps (resubmits of a
+                            known rid re-await instead of re-decoding)
+    """
+
+    heartbeat_interval_s: float = 0.5
+    heartbeat_ttl_s: float = 3.0
+    drain_deadline_s: float = 20.0
+    tensor_parallel_degree: int = 1
+    dedup_results: int = 512
+
+    def validate(self):
+        if self.heartbeat_interval_s <= 0:
+            raise ValueError(f"heartbeat_interval_s must be > 0, got "
+                             f"{self.heartbeat_interval_s}")
+        if self.heartbeat_ttl_s <= self.heartbeat_interval_s:
+            raise ValueError(
+                f"heartbeat_ttl_s ({self.heartbeat_ttl_s}) must exceed "
+                f"heartbeat_interval_s ({self.heartbeat_interval_s})")
+        if self.tensor_parallel_degree < 1:
+            raise ValueError(f"tensor_parallel_degree must be >= 1, "
+                             f"got {self.tensor_parallel_degree}")
+        if self.dedup_results < 1:
+            raise ValueError(f"dedup_results must be >= 1, got "
+                             f"{self.dedup_results}")
+        return self
+
+
+#: replicas hosted in THIS process (thread-mode tests host several),
+#: resolved by the rpc plane's `_remote_submit`
+_REPLICAS: dict[str, "ReplicaServer"] = {}
+
+
+def _remote_submit(replica_name, rid, prompt, max_new_tokens, sampling,
+                   eos_token_id, deadline_s):
+    """The request plane's rpc target: runs inside the replica process
+    (one rpc handler thread per router connection, so blocking on the
+    engine future is fine)."""
+    rep = _REPLICAS.get(replica_name)
+    if rep is None:
+        raise EngineShutdownError(
+            f"replica {replica_name!r} is not hosted in this process "
+            f"(hosted: {sorted(_REPLICAS)})")
+    return rep.handle_submit(rid, prompt, max_new_tokens, sampling,
+                             eos_token_id, deadline_s)
+
+
+def _open_store(spec):
+    """("tcp", host, port) | ("file", dir) → TCPStore-shaped client."""
+    from ..distributed.store import FileKVStore, TCPStore
+    kind = spec[0]
+    if kind == "tcp":
+        return TCPStore(spec[1], int(spec[2]))
+    if kind == "file":
+        return FileKVStore(spec[1])
+    raise ValueError(f"unknown store spec {spec!r}")
+
+
+def _init_tp_mesh(degree):
+    """Local "mp" mesh over `degree` devices — the tensor-parallel
+    substrate inside one replica.  On CPU smoke rigs the devices come
+    from XLA_FLAGS --xla_force_host_platform_device_count (the fleet
+    exports it before spawning)."""
+    import jax
+
+    from ..distributed.mesh import ProcessMesh, set_mesh
+    devs = jax.devices()
+    if len(devs) < degree:
+        raise RuntimeError(
+            f"tensor_parallel_degree={degree} needs {degree} local "
+            f"devices, found {len(devs)}; export XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={degree} (CPU) or "
+            "use a host with enough chips")
+    mesh = ProcessMesh(np.arange(degree), ["mp"])
+    set_mesh(mesh)
+    return mesh
+
+
+class ReplicaServer:
+    """One engine replica: rpc endpoint + membership lease + gossip.
+
+    Thread-mode (tests): construct directly in-process — several can
+    coexist.  Process-mode: `_replica_proc_main` builds one per spawned
+    process.  `close()` is idempotent."""
+
+    def __init__(self, name, model, store, serving_config=None,
+                 config: ReplicaConfig | None = None,
+                 warmup_prompt=None):
+        from ..distributed import rpc
+        from ..distributed.store import TCPElasticStore
+        from .engine import Engine
+        self.name = name
+        self.cfg = (config or ReplicaConfig()).validate()
+        self.store = store
+        self.membership = TCPElasticStore(
+            store, ttl=self.cfg.heartbeat_ttl_s)
+        # store-side atomic counter: strictly ordered join generations
+        # across every incarnation of this name (anti-flap rejoins)
+        self.gen = int(store.add(f"fleetgen.{name}", 1))
+        self._state = "warming"
+        self._closed = False
+        self._dedup: OrderedDict[str, object] = OrderedDict()
+        self._dedup_lock = threading.Lock()
+        self._store_lock = threading.Lock()
+        self.engine = Engine(model, serving_config).start()
+        self.rpc_server = rpc.RpcServer(name)
+        _REPLICAS[name] = self
+        self.membership.register(name)
+        self._publish()
+        self._stop = threading.Event()
+        self._beat = threading.Thread(
+            target=self._beat_loop, name=f"fleet-beat-{name}",
+            daemon=True)
+        self._beat.start()
+        if warmup_prompt is not None:
+            # pay the first-compile cost before joining the ring
+            self.engine.generate(warmup_prompt, max_new_tokens=2)
+        self.set_state("ready")
+
+    # ---------------- membership ----------------
+    def _load(self):
+        eng = self.engine
+        return {"queue_depth": len(eng._queue),
+                "active_slots": len(eng._active),
+                "max_queue": eng.scfg.max_queue,
+                "num_slots": eng.scfg.num_slots}
+
+    def _publish(self):
+        info = {"name": self.name, "ip": self.rpc_server.info.ip,
+                "port": self.rpc_server.info.port, "state": self._state,
+                "gen": self.gen, "pid": os.getpid(),
+                "tp": self.cfg.tensor_parallel_degree,
+                "load": self._load(), "load_ts": time.time()}
+        with self._store_lock:
+            self.store.set(INFO_PREFIX + self.name, json.dumps(info))
+
+    def set_state(self, state):
+        self._state = state
+        self._publish()
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.cfg.heartbeat_interval_s):
+            try:
+                if not self.membership.is_registered(self.name):
+                    # our lease was reaped (we looked dead): rejoin
+                    # EXPLICITLY with a fresh generation instead of
+                    # stamping the old key back into existence
+                    self.gen = int(self.store.add(
+                        f"fleetgen.{self.name}", 1))
+                with self._store_lock:
+                    self.membership.heartbeat(self.name)
+                self._publish()
+            except Exception:
+                # a flaky store write must not kill the replica; the
+                # next beat retries (and the router's TTL covers us)
+                pass
+
+    # ---------------- request plane ----------------
+    def handle_submit(self, rid, prompt, max_new_tokens, sampling,
+                      eos_token_id, deadline_s):
+        """Idempotent submit: a rid seen before re-awaits the SAME
+        engine future (a router resubmission after an ambiguous timeout
+        can never make this replica decode — or deliver — twice)."""
+        with self._dedup_lock:
+            fut = self._dedup.get(rid)
+            if fut is None:
+                fut = self.engine.submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    sampling=SamplingParams(**(sampling or {})),
+                    eos_token_id=eos_token_id, deadline_s=deadline_s)
+                self._dedup[rid] = fut
+                while len(self._dedup) > self.cfg.dedup_results:
+                    self._dedup.popitem(last=False)
+        timeout = deadline_s if deadline_s is not None \
+            else self.engine.scfg.request_timeout_s
+        try:
+            out = fut.result(timeout=timeout + 1.0)
+        except FuturesTimeout:
+            # normalize (on py<3.11 futures.TimeoutError is NOT the
+            # builtin): the engine missed the deadline without evicting
+            # (deadline_policy="ignore") — surface the serving error
+            from .api import DeadlineExceededError
+            raise DeadlineExceededError(
+                f"request {rid} exceeded its {timeout:.1f}s budget on "
+                f"replica {self.name}") from None
+        return {"request_id": rid, "replica": self.name,
+                "output_ids": np.asarray(out.output_ids, np.int32),
+                "finish_reason": out.finish_reason,
+                "ttft_ms": out.ttft_ms, "latency_ms": out.latency_ms}
+
+    # ---------------- lifecycle ----------------
+    def drain(self, deadline_s=None):
+        """The SIGTERM path: advertise `draining` (the router stops
+        routing here within a poll), let in-flight slots finish inside
+        the deadline, fail whatever is still queued, then leave the
+        ring."""
+        try:
+            self.set_state("draining")
+        except Exception:
+            pass
+        self.engine.drain(deadline_s if deadline_s is not None
+                          else self.cfg.drain_deadline_s)
+        self.close()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._beat.join(5.0)
+        try:
+            with self._store_lock:
+                self.membership.deregister(self.name)
+                self.store.delete_key(INFO_PREFIX + self.name)
+        except Exception:
+            pass
+        self.engine.shutdown()
+        self.rpc_server.close()
+        if _REPLICAS.get(self.name) is self:
+            del _REPLICAS[self.name]
+
+
+def _replica_proc_main(name, store_spec, serving_config, replica_config,
+                       model_factory, warmup_prompt=None):
+    """Subprocess entry: host one replica until SIGTERM (drain) or the
+    parent kills us.  `model_factory` must be a picklable top-level
+    callable; it runs AFTER the tp mesh is installed so parallel models
+    can consult `get_mesh()`."""
+    stop = {"mode": None}
+    evt = threading.Event()
+
+    def _sigterm(signum, frame):
+        stop["mode"] = "drain"
+        evt.set()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    cfg = (replica_config or ReplicaConfig()).validate()
+    if cfg.tensor_parallel_degree > 1:
+        _init_tp_mesh(cfg.tensor_parallel_degree)
+    store = _open_store(store_spec)
+    model = model_factory()
+    rep = ReplicaServer(name, model, store, serving_config, cfg,
+                        warmup_prompt=warmup_prompt)
+    try:
+        while not evt.wait(0.25):
+            pass
+        if stop["mode"] == "drain":
+            rep.drain()
+        else:
+            rep.close()
+    finally:
+        try:
+            store.close()
+        except Exception:
+            pass
+    # daemon rpc/scheduler threads may linger; exit deliberately
+    os._exit(0)
+
+
+class ServingFleet:
+    """Local multi-process fleet: membership store + N replica
+    processes + router, one object.  The chaos bench and CI drive this;
+    production deployments run `ReplicaServer`s on their own hosts
+    against a shared TCPStore endpoint and a standalone
+    `ServingRouter`."""
+
+    def __init__(self, model_factory, num_replicas=2,
+                 serving_config: ServingConfig | None = None,
+                 replica_config: ReplicaConfig | None = None,
+                 router_config: RouterConfig | None = None,
+                 warmup_prompt=None, name_prefix="replica"):
+        self.model_factory = model_factory
+        self.num_replicas = int(num_replicas)
+        self.scfg = serving_config
+        self.rcfg = (replica_config or ReplicaConfig()).validate()
+        self.router_cfg = router_config or RouterConfig(
+            heartbeat_ttl_s=self.rcfg.heartbeat_ttl_s)
+        self.warmup_prompt = warmup_prompt
+        self.name_prefix = name_prefix
+        self.router: ServingRouter | None = None
+        self._store = None
+        self._procs: dict[str, object] = {}
+        self._next_idx = 0
+        self._ctx = None
+
+    # ---------------- lifecycle ----------------
+    def start(self, warmup_timeout_s=300.0):
+        import multiprocessing as mp
+
+        from ..distributed.store import TCPStore
+        self._store = TCPStore(is_master=True)
+        self._store_spec = ("tcp", "127.0.0.1", self._store.port)
+        self._ctx = mp.get_context("spawn")
+        for _ in range(self.num_replicas):
+            self._spawn()
+        self.wait_ready(self.num_replicas, timeout=warmup_timeout_s)
+        self.router = ServingRouter(self._store,
+                                    self.router_cfg).start()
+        return self
+
+    def _spawn(self):
+        name = f"{self.name_prefix}-{self._next_idx}"
+        self._next_idx += 1
+        tp = self.rcfg.tensor_parallel_degree
+        override = {"JAX_PLATFORMS": os.environ.get(
+            "JAX_PLATFORMS", "cpu"), "PALLAS_AXON_POOL_IPS": ""}
+        if tp > 1:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "host_platform_device_count" not in flags:
+                override["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count"
+                    f"={tp}").strip()
+        old = {k: os.environ.get(k) for k in override}
+        os.environ.update(override)
+        try:
+            p = self._ctx.Process(
+                target=_replica_proc_main,
+                args=(name, self._store_spec, self.scfg, self.rcfg,
+                      self.model_factory, self.warmup_prompt),
+                name=name)
+            p.start()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        self._procs[name] = p
+        return name
+
+    def wait_ready(self, n, timeout=300.0):
+        """Block until >= n replicas gossip `ready` with a live lease."""
+        deadline = time.time() + timeout
+        while True:
+            ready = [name for name, state in self.replica_states().items()
+                     if state == "ready"]
+            if len(ready) >= n:
+                return ready
+            for name, p in self._procs.items():
+                if p.exitcode not in (None, 0):
+                    raise RuntimeError(
+                        f"replica {name} died during warmup "
+                        f"(exitcode {p.exitcode})")
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"only {len(ready)}/{n} replicas ready within "
+                    f"{timeout}s: {self.replica_states()}")
+            time.sleep(0.2)
+
+    def replica_states(self):
+        out = {}
+        for key, val in self._store.list_prefix(INFO_PREFIX).items():
+            try:
+                info = json.loads(val.decode())
+                out[info["name"]] = info.get("state", "?")
+            except (ValueError, KeyError):
+                continue
+        return out
+
+    # ---------------- client passthrough ----------------
+    def submit(self, *args, **kwargs):
+        return self.router.submit(*args, **kwargs)
+
+    def generate(self, *args, **kwargs):
+        return self.router.generate(*args, **kwargs)
+
+    def stats(self):
+        return self.router.stats()
+
+    # ---------------- chaos / elasticity ----------------
+    def kill_replica(self, name, sig=signal.SIGKILL):
+        """SIGKILL (default) = chaos: no drain, no deregistration — the
+        router must detect the death itself."""
+        p = self._procs[name]
+        os.kill(p.pid, sig)
+        return p.pid
+
+    def drain_replica(self, name):
+        """SIGTERM = graceful scale-down: the replica drains and leaves
+        the ring before the deadline."""
+        return self.kill_replica(name, sig=signal.SIGTERM)
+
+    def add_replica(self):
+        """Scale up: spawn a fresh replica; it registers, warms, and
+        the router's watcher rings it in."""
+        return self._spawn()
+
+    def shutdown(self, timeout=30.0):
+        if self.router is not None:
+            self.router.close()
+            self.router = None
+        for name, p in self._procs.items():
+            if p.is_alive():
+                try:
+                    os.kill(p.pid, signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        deadline = time.time() + timeout
+        for name, p in self._procs.items():
+            p.join(max(0.1, deadline - time.time()))
+        for name, p in self._procs.items():
+            if p.is_alive():                 # pragma: no cover
+                os.kill(p.pid, signal.SIGKILL)
+                p.join(5.0)
+        self._procs.clear()
+        if self._store is not None:
+            self._store.close()
+            self._store = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()

@@ -1,0 +1,583 @@
+"""Drain-aware serving router: the fleet's front door.
+
+Reference capability: the reference serves at pod scale through a fleet
+layer pairing replicated predictors with membership, failure detection
+and elastic relaunch (PAPER.md layers 5/9).  TPU-native realization:
+`ServingRouter` spreads requests over N `Engine` replicas living in
+separate processes (or threads, in tests), with
+
+- **membership + gossip** over `distributed/store.py`: each replica
+  heartbeats a TTL lease (`TCPElasticStore`) and gossips a
+  `fleet.{name}` info record — rpc endpoint, lifecycle state
+  (`warming|ready|draining`), join generation, and load (queue depth,
+  active slots) — which the router polls to maintain its ring;
+- **session-affine consistent hashing**: requests carrying the same
+  `session_id` (or sharing a prompt prefix when none is given) hash to
+  the same replica, so its warm prefix cache keeps serving them; a
+  replica joining or leaving only remaps the sessions it owns;
+- **load shedding with the engine's own admission semantics**: a
+  replica at capacity raises `QueueFullError` through the rpc plane;
+  the router spills to ring successors and, when EVERY ready replica
+  sheds, fails fast with `QueueFullError(retry_after_s=...)` instead of
+  queueing unboundedly.  Deadlines propagate end to end: the remaining
+  budget rides along to the replica engine and bounds the rpc wait;
+- **failure detection + transparent resubmission**: a dead replica is
+  detected by its dropped rpc connection (SIGKILL closes the socket
+  mid-call) or its expired heartbeat lease; in-flight requests are
+  resubmitted to survivors under the SAME idempotent request id.  A
+  request's Future resolves exactly once, so token delivery is
+  at-most-once — never a duplicate, never a silently dropped stream.
+  An rpc *timeout* against a replica that is still heartbeating is
+  ambiguous (the call may be executing) and fails LOUDLY rather than
+  hanging or blindly retrying;
+- **drain awareness**: a replica entering `draining` (SIGTERM) stops
+  receiving new routes within one poll interval; its queued requests
+  bounce back as `EngineShutdownError` and are resubmitted to
+  survivors, while its active slots finish inside the drain deadline.
+  Fresh replicas register `warming`, flip to `ready`, and the watcher
+  warms them into the ring (scale up).
+
+Anti-flap protocol (with `TCPElasticStore.reap`): a replica whose lease
+expires is marked dead *sticky* under its join generation — resumed
+heartbeats on the stale lease do NOT resurrect it.  The watcher reaps
+the expired lease; the replica's own heartbeat loop notices the reap
+and re-registers with a bumped generation, which the router accepts as
+an explicit rejoin.  Membership events are edges, never oscillation.
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+import itertools
+import json
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import stats
+from .api import (DeadlineExceededError, EngineShutdownError,
+                  NoReplicaError, QueueFullError, RequestOutput,
+                  SamplingParams, ServingError)
+
+#: membership key prefixes on the fleet store (shared with fleet.py)
+INFO_PREFIX = "fleet."
+
+
+@dataclass
+class RouterConfig:
+    """Router knobs (docs/KNOBS.md "serving fleet" table).
+
+    heartbeat_ttl_s      replica lease: heartbeats older than this mark
+                         the replica dead (sticky until it re-registers)
+    poll_interval_s      membership watcher cadence; also bounds how
+                         long a draining replica keeps receiving routes
+    rpc_timeout_s        per-attempt cap on one replica call (a request
+                         deadline below this wins)
+    max_resubmits        resubmission budget per request across replica
+                         deaths before the router fails it loudly
+    retry_after_s        backoff hint carried by shed requests'
+                         QueueFullError (the 429 Retry-After analog)
+    virtual_nodes        consistent-hash vnodes per replica: higher =
+                         smoother spread, slower ring rebuild
+    no_replica_patience_s how long submit-time dispatch waits for ANY
+                         ready replica (fleet warming up / mid-failover)
+                         before NoReplicaError
+    request_timeout_s    sync generate()'s Future wait
+    """
+
+    heartbeat_ttl_s: float = 3.0
+    poll_interval_s: float = 0.2
+    rpc_timeout_s: float = 120.0
+    max_resubmits: int = 3
+    retry_after_s: float = 1.0
+    virtual_nodes: int = 64
+    no_replica_patience_s: float = 30.0
+    request_timeout_s: float = 120.0
+
+    def validate(self):
+        if self.heartbeat_ttl_s <= 0:
+            raise ValueError(f"heartbeat_ttl_s must be > 0, got "
+                             f"{self.heartbeat_ttl_s}")
+        if self.poll_interval_s <= 0:
+            raise ValueError(f"poll_interval_s must be > 0, got "
+                             f"{self.poll_interval_s}")
+        if self.virtual_nodes < 1:
+            raise ValueError(f"virtual_nodes must be >= 1, got "
+                             f"{self.virtual_nodes}")
+        if self.max_resubmits < 0:
+            raise ValueError(f"max_resubmits must be >= 0, got "
+                             f"{self.max_resubmits}")
+        return self
+
+
+def _hash64(data):
+    if isinstance(data, str):
+        data = data.encode()
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(),
+                          "big")
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.  `lookup(key)` returns
+    the owner; `successors(key)` yields every member once, owner first,
+    in ring order — the router's spill/failover candidate order."""
+
+    def __init__(self, virtual_nodes=64):
+        self.vnodes = virtual_nodes
+        self._points: list[tuple[int, str]] = []
+        self._members: set[str] = set()
+
+    def rebuild(self, members):
+        members = set(members)
+        if members == self._members:
+            return False
+        pts = []
+        for name in members:
+            for v in range(self.vnodes):
+                pts.append((_hash64(f"{name}#{v}"), name))
+        pts.sort()
+        self._points = pts
+        self._members = members
+        return True
+
+    @property
+    def members(self):
+        return set(self._members)
+
+    def lookup(self, key):
+        nxt = next(self.successors(key), None)
+        return nxt
+
+    def successors(self, key):
+        """Distinct members starting at the key's owner, ring order."""
+        if not self._points:
+            return
+        h = _hash64(key)
+        idx = bisect.bisect_left(self._points, (h, ""))
+        seen = set()
+        n = len(self._points)
+        for i in range(n):
+            _, name = self._points[(idx + i) % n]
+            if name not in seen:
+                seen.add(name)
+                yield name
+
+
+class _ReplicaView:
+    __slots__ = ("name", "ip", "port", "state", "gen", "load",
+                 "load_ts", "tp")
+
+    def __init__(self, info):
+        self.name = info["name"]
+        self.ip = info.get("ip", "127.0.0.1")
+        self.port = int(info.get("port", 0))
+        self.state = info.get("state", "warming")
+        self.gen = int(info.get("gen", 0))
+        self.load = info.get("load") or {}
+        self.load_ts = float(info.get("load_ts", 0.0))
+        self.tp = int(info.get("tp", 1))
+
+
+class _RoutedRequest:
+    __slots__ = ("rid", "prompt", "max_new_tokens", "sampling",
+                 "eos_token_id", "deadline", "session_key", "future",
+                 "submit_t", "attempts", "resubmits")
+
+    def __init__(self, rid, prompt, max_new_tokens, sampling,
+                 eos_token_id, deadline, session_key):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.sampling = sampling
+        self.eos_token_id = eos_token_id
+        self.deadline = deadline            # absolute monotonic or None
+        self.session_key = session_key
+        self.future = Future()
+        self.submit_t = time.monotonic()
+        self.attempts = 0                   # dispatch rounds
+        self.resubmits = 0                  # re-sends after the first
+
+
+class ServingRouter:
+    """`ServingRouter(store).start()`; then `submit()` / `generate()`
+    exactly like a local `Engine` — the fleet is one logical engine.
+    `close()` stops the watcher and fails outstanding futures."""
+
+    def __init__(self, store, config: RouterConfig | None = None,
+                 name="router"):
+        from ..distributed.store import TCPElasticStore
+        self.store = store
+        self.cfg = (config or RouterConfig()).validate()
+        self.name = name
+        self.membership = TCPElasticStore(store,
+                                          ttl=self.cfg.heartbeat_ttl_s)
+        self.ring = HashRing(self.cfg.virtual_nodes)
+        self._replicas: dict[str, _ReplicaView] = {}
+        self._dead_gen: dict[str, int] = {}   # sticky-dead by generation
+        self._lock = threading.RLock()
+        self._inflight: dict[str, _RoutedRequest] = {}
+        self._running = False
+        self._watcher = None
+        self._rid_prefix = f"{name}-{_hash64(repr(time.time())) % 10**6}"
+        self._ids = itertools.count()
+
+    # ---------------- lifecycle ----------------
+    def start(self):
+        with self._lock:
+            if self._running:
+                return self
+            stats.reset_router_stats()
+            self._running = True
+        self._poll_membership()               # synchronous first view
+        self._watcher = threading.Thread(
+            target=self._watch_loop, name="paddle-tpu-serving-router",
+            daemon=True)
+        self._watcher.start()
+        return self
+
+    def close(self):
+        with self._lock:
+            if not self._running:
+                return
+            self._running = False
+            pending = list(self._inflight.values())
+            self._inflight.clear()
+        for req in pending:
+            if not req.future.done():
+                try:
+                    req.future.set_exception(EngineShutdownError(
+                        "serving router closed"))
+                except Exception:
+                    pass
+        w = self._watcher
+        if w is not None:
+            w.join(5.0)
+            self._watcher = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---------------- membership ----------------
+    def _watch_loop(self):
+        while self._running:
+            try:
+                self._poll_membership()
+            except Exception:
+                # a flaky store read must not kill routing; the next
+                # poll retries and the sticky-dead set is unchanged
+                pass
+            time.sleep(self.cfg.poll_interval_s)
+
+    def _poll_membership(self):
+        alive, expired = self.membership._scan()
+        alive, expired = set(alive), set(expired)
+        infos = {}
+        for key, val in self.store.list_prefix(INFO_PREFIX).items():
+            try:
+                view = _ReplicaView(json.loads(val.decode()))
+            except (ValueError, KeyError):
+                continue
+            infos[view.name] = view
+        with self._lock:
+            ready = set()
+            for name, view in infos.items():
+                dead_gen = self._dead_gen.get(name)
+                if dead_gen is not None and view.gen <= dead_gen:
+                    continue                      # sticky dead, no rejoin
+                if dead_gen is not None and view.gen > dead_gen:
+                    del self._dead_gen[name]      # explicit rejoin
+                if name in expired or (name not in alive
+                                       and name not in infos):
+                    self._mark_dead_locked(name, view.gen)
+                    continue
+                if name not in alive:
+                    # info published but no lease yet (registering) —
+                    # not ready, not dead
+                    continue
+                if view.state == "ready":
+                    ready.add(name)
+            self._replicas = infos
+            was = self.ring.members
+            self.ring.rebuild(ready)
+            for name in ready - was:
+                from ..distributed import rpc
+                rpc.connect_worker(name, infos[name].ip,
+                                   infos[name].port)
+            stats.set_value("router.replicas_alive", len(ready))
+        # reap expired leases so a paused-then-resumed heartbeater must
+        # explicitly re-register (anti-flap; see module docstring)
+        if expired:
+            self.membership.reap()
+
+    def _mark_dead_locked(self, name, gen):
+        if self._dead_gen.get(name, -1) < gen:
+            self._dead_gen[name] = gen
+        if name in self.ring.members:
+            self.ring.rebuild(self.ring.members - {name})
+            stats.incr("router.replicas_lost")
+        from ..distributed import rpc
+        rpc.forget_worker(name)
+
+    def _mark_dead(self, name):
+        with self._lock:
+            view = self._replicas.get(name)
+            self._mark_dead_locked(name, view.gen if view else 0)
+            stats.set_value("router.replicas_alive",
+                            len(self.ring.members))
+
+    def replicas(self):
+        """Current membership snapshot: {name: state} (ready members are
+        routable; draining/warming/dead ones are not)."""
+        with self._lock:
+            out = {}
+            for name, view in self._replicas.items():
+                if name in self._dead_gen and \
+                        view.gen <= self._dead_gen[name]:
+                    out[name] = "dead"
+                else:
+                    out[name] = view.state
+            return out
+
+    # ---------------- client API ----------------
+    def submit(self, prompt_ids, max_new_tokens=None, sampling=None,
+               eos_token_id=None, deadline_s=None, session_id=None):
+        """Route one request; returns a `Future[RequestOutput]`.  The
+        Future resolves exactly once — with the output, or with the
+        loudest-applicable error (`QueueFullError` when the fleet sheds,
+        `DeadlineExceededError`, `NoReplicaError`, ...)."""
+        if not self._running:
+            raise EngineShutdownError("router is not running")
+        prompt = np.asarray(
+            prompt_ids._data_ if hasattr(prompt_ids, "_data_")
+            else prompt_ids).astype(np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        sampling = (sampling or SamplingParams()).validate()
+        deadline = (time.monotonic() + deadline_s) \
+            if deadline_s is not None else None
+        key = str(session_id) if session_id is not None \
+            else prompt[:16].tobytes()
+        rid = f"{self._rid_prefix}-{next(self._ids)}"
+        req = _RoutedRequest(rid, prompt, max_new_tokens, sampling,
+                             eos_token_id, deadline, key)
+        with self._lock:
+            self._inflight[rid] = req
+        threading.Thread(target=self._dispatch, args=(req,),
+                         name=f"route-{rid}", daemon=True).start()
+        return req.future
+
+    def generate(self, prompt_ids, max_new_tokens=None, sampling=None,
+                 eos_token_id=None, deadline_s=None, session_id=None,
+                 timeout=None):
+        fut = self.submit(prompt_ids, max_new_tokens=max_new_tokens,
+                          sampling=sampling, eos_token_id=eos_token_id,
+                          deadline_s=deadline_s, session_id=session_id)
+        return fut.result(timeout or self.cfg.request_timeout_s)
+
+    def stats(self):
+        return stats.serving_stats()
+
+    # ---------------- dispatch ----------------
+    def _remaining(self, req):
+        if req.deadline is None:
+            return None
+        return req.deadline - time.monotonic()
+
+    def _candidates(self, req):
+        """Ready replicas in affinity order, cheap-shed filtered: a
+        replica whose fresh gossip already says its queue is full is
+        skipped without paying an rpc."""
+        with self._lock:
+            order = list(self.ring.successors(req.session_key))
+            views = dict(self._replicas)
+        now = time.time()
+        out, skipped_full = [], 0
+        for name in order:
+            view = views.get(name)
+            if view is None:
+                continue
+            load = view.load
+            fresh = (now - view.load_ts) <= \
+                max(2 * self.cfg.heartbeat_ttl_s, 1.0)
+            if fresh and load and \
+                    load.get("queue_depth", 0) >= load.get(
+                        "max_queue", float("inf")):
+                skipped_full += 1
+                continue
+            out.append(name)
+        return out, skipped_full
+
+    def _fail(self, req, exc):
+        with self._lock:
+            self._inflight.pop(req.rid, None)
+        if not req.future.done():
+            try:
+                req.future.set_exception(exc)
+            except Exception:
+                pass
+
+    def _complete(self, req, payload, replica):
+        out = RequestOutput(
+            request_id=req.rid, prompt_ids=req.prompt,
+            output_ids=np.asarray(payload["output_ids"], np.int32),
+            finish_reason=payload["finish_reason"],
+            ttft_ms=payload.get("ttft_ms"),
+            latency_ms=(time.monotonic() - req.submit_t) * 1e3)
+        with self._lock:
+            self._inflight.pop(req.rid, None)
+        if req.future.done():            # at-most-once delivery
+            return
+        try:
+            req.future.set_result(out)
+        except Exception:
+            return
+        stats.route_observe(replica)
+        stats.observe("router.route_latency_ms", out.latency_ms)
+        if req.resubmits:
+            stats.incr("router.requests_recovered")
+
+    def _dispatch(self, req):
+        cfg = self.cfg
+        patience = time.monotonic() + cfg.no_replica_patience_s
+        while True:
+            if req.future.done():
+                return
+            if not self._running:
+                self._fail(req, EngineShutdownError(
+                    "serving router closed"))
+                return
+            remaining = self._remaining(req)
+            if remaining is not None and remaining <= 0:
+                self._fail(req, DeadlineExceededError(
+                    f"request {req.rid} expired after "
+                    f"{time.monotonic() - req.submit_t:.3f}s at the "
+                    "router"))
+                return
+            candidates, skipped_full = self._candidates(req)
+            if not candidates:
+                if skipped_full:
+                    self._shed(req)
+                    return
+                # no ready replica AT ALL: wait for the fleet (warming
+                # up or mid-failover) within the patience window
+                if time.monotonic() >= patience:
+                    self._fail(req, NoReplicaError(
+                        f"no ready replica for request {req.rid} "
+                        f"within {cfg.no_replica_patience_s:.1f}s "
+                        f"(membership: {self.replicas()})"))
+                    return
+                time.sleep(cfg.poll_interval_s)
+                continue
+            all_full = True
+            for name in candidates:
+                remaining = self._remaining(req)
+                if remaining is not None and remaining <= 0:
+                    self._fail(req, DeadlineExceededError(
+                        f"request {req.rid} expired mid-dispatch"))
+                    return
+                budget = cfg.rpc_timeout_s if remaining is None \
+                    else min(cfg.rpc_timeout_s, remaining)
+                err = self._try_replica(req, name, budget)
+                if err is None:
+                    return                       # delivered
+                if isinstance(err, QueueFullError):
+                    continue                     # spill to successor
+                if isinstance(err, EngineShutdownError):
+                    # draining/stopped: resubmit elsewhere — counted
+                    # against the same budget as death-failovers so a
+                    # replica stuck bouncing every submit can never pin
+                    # a request in the dispatch loop forever
+                    stats.incr("router.resubmissions")
+                    req.resubmits += 1
+                    req.attempts += 1
+                    all_full = False
+                    if req.attempts > cfg.max_resubmits:
+                        self._fail(req, ServingError(
+                            f"request {req.rid}: exhausted "
+                            f"{cfg.max_resubmits} resubmits (last: "
+                            f"replica {name} refused: {err})"))
+                        return
+                    continue
+                if isinstance(err, (ConnectionError, OSError)):
+                    self._mark_dead(name)
+                    stats.incr("router.failovers")
+                    stats.incr("router.resubmissions")
+                    req.resubmits += 1
+                    req.attempts += 1
+                    all_full = False
+                    if req.attempts > cfg.max_resubmits:
+                        self._fail(req, ServingError(
+                            f"request {req.rid}: exhausted "
+                            f"{cfg.max_resubmits} resubmits across "
+                            f"replica failures (last: {err})"))
+                        return
+                    continue
+                if isinstance(err, TimeoutError):
+                    # ambiguous: the replica may still be computing.
+                    # Dead (lease expired) -> safe to resubmit under the
+                    # idempotent rid; alive -> fail LOUDLY, never hang.
+                    if name in self.membership.alive_nodes():
+                        self._fail(req, DeadlineExceededError(
+                            f"request {req.rid}: rpc to live replica "
+                            f"{name} timed out after {budget:.1f}s; "
+                            "not retrying a possibly-executing call "
+                            "on a healthy replica"))
+                        return
+                    self._mark_dead(name)
+                    stats.incr("router.failovers")
+                    stats.incr("router.resubmissions")
+                    req.resubmits += 1
+                    req.attempts += 1
+                    all_full = False
+                    if req.attempts > cfg.max_resubmits:
+                        self._fail(req, ServingError(
+                            f"request {req.rid}: exhausted "
+                            f"{cfg.max_resubmits} resubmits (last: "
+                            f"rpc timeout on dead replica {name})"))
+                        return
+                    continue
+                self._fail(req, err)             # app-level error
+                return
+            if all_full:
+                self._shed(req)
+                return
+            # unsuccessful round that wasn't a shed: give the watcher
+            # one poll to settle the ring before re-reading membership
+            time.sleep(cfg.poll_interval_s)
+
+    def _shed(self, req):
+        stats.incr("router.requests_shed")
+        self._fail(req, QueueFullError(
+            f"request {req.rid}: every ready replica is at capacity; "
+            f"retry after {self.cfg.retry_after_s:.1f}s",
+            retry_after_s=self.cfg.retry_after_s))
+
+    def _try_replica(self, req, name, budget):
+        """One delivery attempt.  Returns None on success (future
+        completed) or the exception describing why this replica did not
+        serve it."""
+        from ..distributed import rpc
+        from .fleet import _remote_submit
+        remaining = self._remaining(req)
+        sampling = {"temperature": req.sampling.temperature,
+                    "top_k": req.sampling.top_k,
+                    "top_p": req.sampling.top_p,
+                    "repetition_penalty":
+                        req.sampling.repetition_penalty}
+        try:
+            payload = rpc.rpc_sync(
+                name, _remote_submit,
+                args=(name, req.rid, req.prompt,
+                      req.max_new_tokens, sampling, req.eos_token_id,
+                      remaining),
+                timeout=budget + 1.0)
+        except Exception as e:               # noqa: BLE001
+            return e
+        self._complete(req, payload, name)
+        return None

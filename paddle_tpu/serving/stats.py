@@ -41,11 +41,65 @@ def observe(name, value):
     monitor.observe(PREFIX + name, value)
 
 
+ROUTER_PREFIX = PREFIX + "router."
+
+
+def route_observe(replica):
+    """One routed request: the per-replica labeled counter
+    ``serving.router.requests_routed{replica=...}`` plus the flat total
+    the snapshot reads."""
+    from ..observability import registry as _registry
+    _registry.counter(ROUTER_PREFIX + "requests_routed",
+                      "requests routed per replica",
+                      labelnames=("replica",)) \
+        .labels(replica=str(replica)).inc()
+    monitor.incr(ROUTER_PREFIX + "requests_routed_total")
+
+
 def reset_serving_stats():
-    """Clear every ``serving.*`` counter (engine start does this so each
-    engine run's snapshot is self-contained)."""
+    """Clear every ``serving.*`` counter EXCEPT the router's (engine
+    start does this so each engine run's snapshot is self-contained;
+    the router outlives engine restarts across the fleet, so its
+    counters reset only with the router — `reset_router_stats`)."""
     for key in monitor.all_stats():
-        if key.startswith(PREFIX):
+        if key.startswith(PREFIX) and not key.startswith(ROUTER_PREFIX):
+            monitor.reset(key)
+
+
+def declare_router_stats():
+    """Get-or-create every ``serving.router.*`` metric family so the
+    Prometheus exposition carries the full fleet schema from router
+    start — a dashboard must see ``requests_shed`` at 0, not a missing
+    series, before the first shed (tools/check_telemetry.py --router
+    gates on exactly this)."""
+    from ..observability import registry as _registry
+    _registry.counter(ROUTER_PREFIX + "requests_routed",
+                      "requests routed per replica",
+                      labelnames=("replica",))
+    for name, doc in (
+            ("requests_routed_total", "requests routed, all replicas"),
+            ("requests_shed", "fail-fast rejections: every ready "
+                              "replica at capacity"),
+            ("failovers", "replica deaths detected mid-request"),
+            ("resubmissions", "re-sends under the same idempotent id"),
+            ("requests_recovered", "requests completed after >= 1 "
+                                   "resubmission"),
+            ("replicas_lost", "replicas marked sticky-dead")):
+        _registry.counter(ROUTER_PREFIX + name, doc)
+    _registry.gauge(ROUTER_PREFIX + "replicas_alive",
+                    "ready replicas in the routing ring")
+    _registry.histogram(ROUTER_PREFIX + "route_latency_ms",
+                        "submit-to-completion through the fleet (ms)")
+
+
+def reset_router_stats():
+    """Clear the ``serving.router.*`` counters (router start).  Labeled
+    children (``requests_routed{replica=...}``) reset with their family
+    — ``monitor.reset`` resolves the flat key back to the registry
+    metric."""
+    declare_router_stats()
+    for key in monitor.all_stats():
+        if key.startswith(ROUTER_PREFIX):
             monitor.reset(key)
 
 
@@ -70,6 +124,17 @@ def serving_stats():
     ``max_active_slots`` — the high-water mark of concurrent decoding
     sequences (the paged pool admits more of them than
     ``pool_bytes / max_seq_len`` stripes would).
+
+    Fleet/router quantities (``serving.router.*``, zero without a
+    router; per-replica ``requests_routed{replica=...}`` series live in
+    the Prometheus exposition): ``router_requests_routed`` total,
+    ``router_requests_shed`` (fail-fast admission rejections),
+    ``router_failovers`` (replica deaths detected mid-request),
+    ``router_resubmissions`` (re-sends under the same idempotent id),
+    ``router_requests_recovered`` (requests that completed after >= 1
+    resubmission), ``router_replicas_alive``/``router_replicas_lost``,
+    and ``router_route_latency_ms_avg`` (submit → completion through
+    the fleet).
     """
     s = monitor.all_stats()
 
@@ -112,4 +177,12 @@ def serving_stats():
         "slot_occupancy": (active_steps / slot_steps) if slot_steps
         else 0.0,
         "tokens_per_sec": (tokens / busy_s) if busy_s > 0 else 0.0,
+        "router_requests_routed": g("router.requests_routed_total"),
+        "router_requests_shed": g("router.requests_shed"),
+        "router_failovers": g("router.failovers"),
+        "router_resubmissions": g("router.resubmissions"),
+        "router_requests_recovered": g("router.requests_recovered"),
+        "router_replicas_alive": g("router.replicas_alive"),
+        "router_replicas_lost": g("router.replicas_lost"),
+        "router_route_latency_ms_avg": avg("router.route_latency_ms"),
     }

@@ -23,6 +23,7 @@ from __future__ import annotations
 import os
 import re
 import signal
+import time
 
 from .flags import flag
 
@@ -42,6 +43,16 @@ KNOWN_POINTS = {
                          "rank": int, "once_file": str},
     "rank_crash": {"op": str, "at_seq": int, "rank": int, "exit": int,
                    "mode": str, "once_file": str},
+    # serving-fleet failover drills (distributed/rpc, serving/router.py).
+    # Both fire at CONNECT time — before the call could possibly have
+    # been delivered — so a drilled retry/failover never risks the
+    # "possibly-delivered" ambiguity the rpc layer refuses to retry.
+    # `to` filters on a substring of the target worker name; `count`
+    # bounds how many connects fail (re-armed when the spec changes);
+    # `once_file` fires once per path as in the guardian points.
+    "rpc_drop": {"to": str, "count": int, "once_file": str},
+    "rpc_delay": {"to": str, "delay_s": float, "count": int,
+                  "once_file": str},
 }
 
 _IDENT = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
@@ -146,6 +157,50 @@ def write_bytes(f, data, filename=None):
                     f"of {filename or getattr(f, 'name', '?')}")
             _crash(params)
     f.write(data)
+
+
+#: per-point remaining-fire budgets for the rpc points; re-armed whenever
+#: the spec string changes so one test's exhausted `count` cannot leak
+#: into the next.
+_RPC_STATE = {"raw": "", "counts": {}}
+
+
+def check_rpc(point, worker_name):
+    """Consult an armed ``rpc_drop``/``rpc_delay`` point for a CONNECT to
+    ``worker_name`` (the rpc client calls this before dialing, so an
+    injected failure can never masquerade as a possibly-delivered call).
+    Returns True when an armed ``rpc_drop`` says this connect must fail
+    — the caller raises ``ConnectionError`` — and False otherwise;
+    ``rpc_delay`` sleeps ``delay_s`` here and returns False.  Filters:
+    ``to`` = substring of the target worker name, ``count`` = max fires
+    (re-armed when the spec string changes), ``once_file`` = fire once
+    per path (the file is created on first fire)."""
+    params = active(point)
+    if params is None:
+        return False
+    substr = params.get("to")
+    if substr is not None and substr not in str(worker_name):
+        return False
+    raw = flag("FLAGS_fault_inject", "") or ""
+    if _RPC_STATE["raw"] != raw:
+        _RPC_STATE["raw"] = raw
+        _RPC_STATE["counts"] = {}
+    if "count" in params:
+        left = _RPC_STATE["counts"].get(point, params["count"])
+        if left <= 0:
+            return False
+        _RPC_STATE["counts"][point] = left - 1
+    once = params.get("once_file")
+    if once:
+        try:
+            fd = os.open(once, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+        except FileExistsError:
+            return False
+    if point == "rpc_delay":
+        time.sleep(float(params.get("delay_s", 0.0)))
+        return False
+    return True
 
 
 def check_step(step):

@@ -307,6 +307,91 @@ def check_paged_bench(run):
     return 0
 
 
+_FLEET_SCHEMA = {
+    # key -> accepted types; every key is required
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "passed": bool,
+    "num_replicas": int,
+    "num_slots": int,
+    "num_requests": int,
+    "max_new_tokens": int,
+    "drain_deadline_s": (int, float),
+    "variants": dict,
+    "smoke": bool,
+    "platform": str,
+}
+_FLEET_VARIANT_KEYS = ("lost_requests", "greedy_mismatches",
+                       "duplicate_tokens", "recovery_p99_s", "failovers",
+                       "resubmissions", "requests_recovered",
+                       "leaked_processes")
+
+
+def check_fleet_bench(run):
+    """Schema + zero-loss/recovery gates for
+    benchmarks/serving_fleet_bench.py (ISSUE 9): with replicas dying
+    mid-load, every request completes bit-equal to the single-model
+    greedy reference (zero lost, zero duplicate tokens), p99 recovery
+    stays under the drain deadline, the SIGTERM victim exits 0 within
+    the deadline, and no replica process leaks."""
+    errors = []
+    for key, types in _FLEET_SCHEMA.items():
+        if key not in run:
+            errors.append(f"missing key {key!r}")
+        elif run[key] is None or not isinstance(run[key], types):
+            errors.append(f"{key!r} has type {type(run[key]).__name__}, "
+                          f"expected {types}")
+    if not errors:
+        if not run["variants"]:
+            errors.append("no chaos variants recorded")
+        for name, v in run["variants"].items():
+            for k in _FLEET_VARIANT_KEYS:
+                if k not in v:
+                    errors.append(f"variants.{name} missing {k!r}")
+            if errors:
+                continue
+            if v["lost_requests"] != 0:
+                errors.append(f"{name}: {v['lost_requests']} requests "
+                              "LOST when the replica died")
+            if v["greedy_mismatches"] != 0 or v["duplicate_tokens"] != 0:
+                errors.append(
+                    f"{name}: {v['greedy_mismatches']} outputs diverged "
+                    "from the single-model greedy reference (dropped or "
+                    "duplicated tokens on failover)")
+            if v["recovery_p99_s"] >= run["drain_deadline_s"]:
+                errors.append(
+                    f"{name}: recovery p99 {v['recovery_p99_s']}s >= "
+                    f"drain deadline {run['drain_deadline_s']}s")
+            if v["leaked_processes"]:
+                errors.append(f"{name}: leaked replica processes "
+                              f"{v['leaked_processes']}")
+        sigkill = run["variants"].get("sigkill")
+        if sigkill is not None and sigkill.get("failovers", 0) < 1:
+            errors.append("sigkill variant recorded no failover — the "
+                          "kill landed on an idle fleet (not mid-load)")
+        sigterm = run["variants"].get("sigterm")
+        if sigterm is not None:
+            if sigterm.get("drain_exitcode") != 0:
+                errors.append(f"sigterm victim exit code "
+                              f"{sigterm.get('drain_exitcode')!r} != 0")
+            if sigterm.get("drain_exit_s", 1e9) >= \
+                    run["drain_deadline_s"] + 10:
+                errors.append(
+                    f"sigterm victim took {sigterm.get('drain_exit_s')}s "
+                    "to exit — past the drain deadline + grace")
+    if errors:
+        print("serving_fleet schema check FAILED:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    worst = max(v["recovery_p99_s"] for v in run["variants"].values())
+    print(f"serving_fleet schema OK: {len(run['variants'])} chaos "
+          f"variant(s), zero lost requests, recovery p99 {worst:.2f}s "
+          f"< {run['drain_deadline_s']}s deadline")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("bench_json")
@@ -324,6 +409,8 @@ def main():
         return check_eager_overhead(run)
     if str(run.get("metric", "")).startswith("train_step"):
         return check_train_step_bench(run)
+    if str(run.get("metric", "")).startswith("serving_fleet"):
+        return check_fleet_bench(run)
     if str(run.get("metric", "")).startswith("serving_paged"):
         return check_paged_bench(run)
     if str(run.get("metric", "")).startswith("serving_"):

@@ -180,6 +180,52 @@ def check_stall_dump(path):
     return errors
 
 
+_ROUTER_COUNTERS = ("serving_router_requests_routed_total",
+                    "serving_router_requests_shed",
+                    "serving_router_failovers",
+                    "serving_router_resubmissions",
+                    "serving_router_requests_recovered",
+                    "serving_router_replicas_lost")
+
+
+def check_router_exposition(series, typed):
+    """Schema gate for the serving-fleet router telemetry (ISSUE 9): the
+    full ``serving.router.*`` family must expose — correctly typed —
+    from router start, with per-replica ``requests_routed`` labels and a
+    ``route_latency_ms`` histogram.  A missing series reads as 'never
+    shed / never failed over' on a dashboard that is actually blind."""
+    errors = []
+    for name in _ROUTER_COUNTERS:
+        if name not in series:
+            errors.append(f"router counter {name!r} absent")
+        elif typed.get(name) != "counter":
+            errors.append(f"{name!r} typed {typed.get(name)!r}, "
+                          "expected counter")
+    gname = "serving_router_replicas_alive"
+    if gname not in series:
+        errors.append(f"router gauge {gname!r} absent")
+    elif typed.get(gname) != "gauge":
+        errors.append(f"{gname!r} typed {typed.get(gname)!r}, "
+                      "expected gauge")
+    routed = "serving_router_requests_routed"
+    if typed.get(routed) != "counter":
+        errors.append(f"{routed!r} (per-replica) absent or not a counter")
+    else:
+        labeled = [labels for labels, _ in series.get(routed, [])
+                   if "replica" in labels]
+        total = sum(float(v) for labels, v in
+                    series.get(routed + "_total", []))
+        if total > 0 and not labeled:
+            errors.append(f"{routed!r} has no replica-labeled samples "
+                          "despite routed requests")
+    hname = "serving_router_route_latency_ms"
+    if typed.get(hname) != "histogram":
+        errors.append(f"{hname!r} absent or not a histogram")
+    elif hname + "_bucket" not in series:
+        errors.append(f"{hname!r} exposes no buckets")
+    return errors
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--prometheus", help="Prometheus text dump to check")
@@ -189,7 +235,12 @@ def main():
                     help="sanitized series names that must be present")
     ap.add_argument("--stall-dump",
                     help="collective-watchdog stall dump JSON to check")
+    ap.add_argument("--router", action="store_true",
+                    help="also gate the serving-fleet router metric "
+                         "schema in the --prometheus dump")
     args = ap.parse_args()
+    if args.router and not args.prometheus:
+        ap.error("--router needs --prometheus")
     if not args.prometheus and not args.snapshots and not args.stall_dump:
         ap.error("nothing to check: pass --prometheus, --snapshots "
                  "and/or --stall-dump")
@@ -207,6 +258,12 @@ def main():
         if not errors:
             print(f"prometheus OK: {len(series)} series, "
                   f"{len(typed)} typed families")
+        if args.router:
+            router_errors = check_router_exposition(series, typed)
+            failures += router_errors
+            if not router_errors:
+                print("router exposition OK: full serving.router.* "
+                      "schema present")
     if args.snapshots:
         n, errors = check_snapshots(args.snapshots)
         failures += errors

@@ -205,6 +205,62 @@ print(f"serving drain OK: {d['completed']} in-flight completed, "
       f"{d['queued_failed']} queued failed, admissions closed")
 EOF
 
+echo "== serving fleet chaos drill (3 replicas, SIGKILL + SIGTERM mid-load) =="
+# bounded: smoke workload, both chaos variants, ~90s wall on this box.
+# The bench itself asserts zero lost requests / bit-equal outputs / no
+# leaked replica processes; the gate re-checks the recorded JSON.
+timeout -k 10 300 python benchmarks/serving_fleet_bench.py --smoke \
+    --out /tmp/serving_fleet_ci.json
+python tools/check_bench_result.py /tmp/serving_fleet_ci.json
+
+echo "== serving fleet router telemetry (thread-mode fleet -> prometheus gate) =="
+python - <<'EOF'
+import threading
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.models import GPTForCausalLM, gpt_config
+from paddle_tpu.serving import (ReplicaConfig, ReplicaServer,
+                                RouterConfig, ServingConfig,
+                                ServingRouter)
+
+before = {t.ident for t in threading.enumerate()}
+paddle.seed(0)
+model = GPTForCausalLM(gpt_config(
+    "gpt2-124m", num_layers=2, hidden_size=64, num_heads=2,
+    vocab_size=128, max_seq_len=64))
+rng = np.random.default_rng(0)
+master = TCPStore(is_master=True)
+rep = ReplicaServer("rep-ci", model, TCPStore("127.0.0.1", master.port),
+                    ServingConfig(num_slots=2, max_queue=8),
+                    ReplicaConfig(heartbeat_interval_s=0.2,
+                                  heartbeat_ttl_s=1.5))
+router = ServingRouter(TCPStore("127.0.0.1", master.port),
+                       RouterConfig(heartbeat_ttl_s=1.5,
+                                    poll_interval_s=0.1)).start()
+futs = [router.submit(rng.integers(0, 128, (5,)).astype("int32"),
+                      max_new_tokens=4, session_id=i) for i in range(3)]
+outs = [f.result(timeout=300) for f in futs]
+assert all(o.output_ids.size == 4 for o in outs), outs
+snap = router.stats()
+assert snap["router_requests_routed"] == 3, snap
+assert snap["router_replicas_alive"] == 1, snap
+with open("/tmp/pt_fleet_ci.prom", "w") as f:
+    f.write(obs.render_prometheus())
+router.close()
+rep.close()
+master.close()
+import time
+time.sleep(1.0)                    # rpc handler threads exit on close
+leaked = [t.name for t in threading.enumerate()
+          if t.ident not in before and t.is_alive()]
+assert not leaked, f"leaked threads: {leaked}"
+print("fleet telemetry smoke OK: 3 routed, prometheus dumped, "
+      "no leaked threads")
+EOF
+python tools/check_telemetry.py --prometheus /tmp/pt_fleet_ci.prom --router
+
 echo "== TPU run-log audit =="
 python tools/validate_tpu_runs.py
 
