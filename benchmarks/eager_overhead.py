@@ -55,6 +55,69 @@ def _build(paddle):
     return fwd, step
 
 
+def _sentinel_overhead(paddle, jax, iters):
+    """Eager-lane sentinel cost (ISSUE 10 satellite): a guarded train
+    step (unit-scale GradScaler, found-inf skip armed — what the
+    sentinel installs for non-AMP runs) vs the same step with the
+    sentinel's detection feeds (fused grad-health dispatch + window
+    bookkeeping + cadence fetch), INTERLEAVED so box drift cancels.
+    The model is deliberately non-micro: the contract is about real
+    train steps, where one fused health dispatch amortizes."""
+    import time
+    import numpy as np
+    from paddle_tpu import nn
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.framework.sentinel import TrainingSentinel
+
+    def build(sentinel):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(256, 256), nn.Tanh(),
+                            nn.Linear(256, 256), nn.Tanh(),
+                            nn.Linear(256, 1))
+        m = paddle.Model(net)
+        m.prepare(optimizer=paddle.optimizer.AdamW(
+            1e-3, parameters=net.parameters()), loss=nn.MSELoss())
+        m._scaler = GradScaler(init_loss_scaling=1.0,
+                               use_dynamic_loss_scaling=False,
+                               always_check_found_inf=True)
+        if sentinel:
+            m._sentinel = TrainingSentinel(m)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(64, 256))
+                             .astype(np.float32))
+        y = paddle.to_tensor(rng.normal(size=(64, 1))
+                             .astype(np.float32))
+        return m, x, y
+
+    guarded, xg, yg = build(False)
+    sent, xs, ys = build(True)
+    for _ in range(3):
+        guarded._train_step(xg, yg)
+        sent._fi_step = 0
+        sent._train_step(xs, ys)
+        sent._sentinel.after_step(0, 0, 0, None, update=False)
+    tg, ts = [], []
+    for i in range(iters):
+        t0 = time.perf_counter()
+        loss, _ = guarded._train_step(xg, yg)
+        jax.block_until_ready(loss._data_)
+        tg.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        sent._fi_step = i
+        loss, _ = sent._train_step(xs, ys)
+        sent._sentinel.after_step(i, 0, i, loss, update=True)
+        jax.block_until_ready(loss._data_)
+        ts.append(time.perf_counter() - t0)
+    g_p50 = float(np.median(tg) * 1e3)
+    s_p50 = float(np.median(ts) * 1e3)
+    return {
+        "guarded_step_p50_ms": round(g_p50, 3),
+        "sentinel_step_p50_ms": round(s_p50, 3),
+        "overhead_vs_guarded": round(s_p50 / g_p50, 4),
+        "anomalies": len(sent._sentinel.report()["anomalies"]),
+    }
+
+
 def _time_loop(fn, iters, jax):
     fn()                       # warm (compiles on the cached pass)
     t0 = time.perf_counter()
@@ -134,6 +197,8 @@ def main():
         "tier1": {k: stats["tier1"][k]
                   for k in ("hits", "misses", "evictions", "bypasses",
                             "entries", "bytes")},
+        "sentinel": _sentinel_overhead(paddle, jax,
+                                       max(iters // 2, 16)),
     }
     if not args.no_write:
         try:

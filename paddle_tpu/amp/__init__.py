@@ -67,7 +67,8 @@ class GradScaler:
 
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
-                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True,
+                 min_loss_scale=1.0, always_check_found_inf=False):
         self._enable = enable
         self._scale = float(init_loss_scaling) if enable else 1.0
         self._incr_ratio = incr_ratio
@@ -75,10 +76,25 @@ class GradScaler:
         self._incr_every = incr_every_n_steps
         self._decr_every = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
+        # decay floor: repeated found-inf streaks used to be able to
+        # drive the scale toward the hard 1.0 minimum silently; a higher
+        # floor keeps fp16 gradients representable AND the streak metric
+        # below makes the pathology visible to the training sentinel
+        self._min_scale = max(float(min_loss_scale), 1.0)
+        # run the found-inf check even at scale == 1.0: the training
+        # sentinel wraps non-AMP runs in a unit-scale GradScaler so the
+        # existing skip machinery guards them against non-finite steps
+        self._always_check = bool(always_check_found_inf)
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._found_inf_streak = 0
         self._unscaled = False
+        # a caller that already reduced the gradients (the training
+        # sentinel's fused health pass) can plant its device-side
+        # found-inf flag here; the next unscale_ consumes it instead of
+        # paying a second reduction over every gradient
+        self._planted_found_inf = None
 
     def scale(self, loss):
         if not self._enable or self._scale == 1.0:
@@ -107,11 +123,16 @@ class GradScaler:
         # parameter).  With defer_found_inf the flag STAYS on device so
         # the caller can batch it into its gradient all_reduce and read
         # it once after the reduction (Model._sync_grads).
-        if self._scale != 1.0:
-            sums = [jnp.sum(p.grad._data) for p in optimizer._all_params()
-                    if p.grad is not None]
-            if sums:
-                bad = ~jnp.isfinite(jnp.stack(sums)).all()
+        if self._scale != 1.0 or self._always_check:
+            bad = self._planted_found_inf
+            self._planted_found_inf = None
+            if bad is None:
+                sums = [jnp.sum(p.grad._data)
+                        for p in optimizer._all_params()
+                        if p.grad is not None]
+                if sums:
+                    bad = ~jnp.isfinite(jnp.stack(sums)).all()
+            if bad is not None:
                 if defer_found_inf:
                     self._found_inf_dev = bad
                 else:
@@ -143,13 +164,31 @@ class GradScaler:
         self.step(optimizer)
 
     def update(self):
-        if not (self._enable and self._dynamic) or self._scale == 1.0:
+        if not self._enable:
+            return
+        # consecutive-found-inf accounting runs for EVERY enabled scaler
+        # (the unit-scale sentinel wrapper included): a growing streak is
+        # itself an anomaly — repeated infs silently decaying the scale
+        # toward its floor — and the amp.found_inf_streak gauge is how
+        # the sentinel and dashboards see it.  Healthy steps with an
+        # already-zero streak pay no registry traffic.
+        from ..utils import monitor as _monitor
+        if self._found_inf:
+            self._found_inf_streak += 1
+            _monitor.incr("amp.found_inf_total")
+            _monitor.set_value("amp.found_inf_streak",
+                               self._found_inf_streak)
+        elif self._found_inf_streak:
+            self._found_inf_streak = 0
+            _monitor.set_value("amp.found_inf_streak", 0)
+        if not self._dynamic or self._scale == 1.0:
             return
         if self._found_inf:
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._scale = max(self._scale * self._decr_ratio,
+                                  self._min_scale)
                 self._bad_steps = 0
         else:
             self._good_steps += 1
@@ -157,6 +196,12 @@ class GradScaler:
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+
+    @property
+    def found_inf_streak(self):
+        """Consecutive steps whose update was skipped for non-finite
+        gradients (reset by the first healthy step)."""
+        return self._found_inf_streak
 
     def is_enable(self):
         return self._enable

@@ -107,8 +107,15 @@ class CollectiveController:
                 # stale error records must not instantly re-trip the
                 # fresh incarnation's watchdogs
                 self._trap.clear()
-            self.procs = [self._spawn_one(i)
-                          for i in range(args.nproc_per_node)]
+            world = getattr(self, "_world", None)
+            if world is None:
+                self.procs = [self._spawn_one(i)
+                              for i in range(args.nproc_per_node)]
+            else:
+                # sentinel-quarantined world: fewer workers, explicit
+                # rank/world so the resumed job reshards (PR 6 path)
+                self.procs = [self._spawn_one(i, rank=i, world=world)
+                              for i in range(world)]
             codes = self._watch()
             if all(c == 0 for c in codes):
                 return 0
@@ -117,8 +124,39 @@ class CollectiveController:
                     or _fault_level() > 0) \
                     and restarts < args.max_restart:
                 restarts += 1
+                self._apply_quarantine()
                 continue
             return max(codes)
+
+    def _apply_quarantine(self):
+        """Shrink the next incarnation's world when the training
+        sentinel blamed a rank for repeated local gradient anomalies
+        (``{job}/sentinel/blame`` on the guardian store): relaunch with
+        one fewer worker and let the elastic-resharding resume path
+        continue the job without the flaky host."""
+        if self._trap is None:
+            return
+        try:
+            from ...framework.sentinel import clear_blame, read_blame
+        except Exception:
+            return
+        rec = read_blame(self._trap.store, self._trap.job)
+        if not rec:
+            return
+        world = getattr(self, "_world", None) or \
+            self.ctx.args.nproc_per_node
+        if world <= 1:
+            return
+        clear_blame(self._trap.store, self._trap.job)
+        self._world = world - 1
+        self._extra_env = dict(getattr(self, "_extra_env", {}))
+        self._extra_env["PADDLE_ELASTIC_RESIZED"] = \
+            f"{world}:{self._world}"
+        sys.stderr.write(
+            f"[launch] sentinel blamed rank {rec.get('rank')} "
+            f"(local anomalies: {rec.get('anomalies')}); quarantining "
+            f"it — relaunching on {self._world} worker(s)\n")
+        sys.stderr.flush()
 
     def _watch(self):
         """Wait for all procs; if one fails, give healthy peers

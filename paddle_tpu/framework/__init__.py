@@ -1,5 +1,9 @@
 from .io import save, load  # noqa: F401
 from .checkpoint_manager import (  # noqa: F401
-    CheckpointManager, CheckpointError, verify_checkpoint,
+    CheckpointManager, CheckpointError, NonFiniteCheckpointError,
+    verify_checkpoint,
+)
+from .sentinel import (  # noqa: F401
+    TrainingSentinel, SentinelError, RollbackDirective, sentinel_enabled,
 )
 from ..core.state import seed, get_default_dtype, set_default_dtype  # noqa: F401

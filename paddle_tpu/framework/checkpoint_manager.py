@@ -42,8 +42,55 @@ class CheckpointError(RuntimeError):
     pass
 
 
+class NonFiniteCheckpointError(CheckpointError):
+    """``save(..., validate_finite=True)`` found a NaN/Inf in the
+    payload: the checkpoint was NOT committed.  Persisting poisoned
+    weights would let retention garbage-collect every healthy
+    pre-poison checkpoint — the exact failure the training sentinel's
+    last-known-good anchor exists to prevent."""
+
+    def __init__(self, message, key=None):
+        super().__init__(message)
+        self.key = key
+
+
 def step_dir_name(step):
     return f"ckpt-{int(step):08d}"
+
+
+ANCHOR_DIR_NAME = "anchor"
+
+
+def _walk_state(state, prefix=""):
+    """Depth-first (key-path, leaf) pairs over nested dict/list state."""
+    if isinstance(state, dict):
+        for k, v in state.items():
+            yield from _walk_state(v, f"{prefix}{k}.")
+    elif isinstance(state, (list, tuple)):
+        for i, v in enumerate(state):
+            yield from _walk_state(v, f"{prefix}{i}.")
+    else:
+        yield prefix.rstrip("."), state
+
+
+def validate_finite_state(state):
+    """Raise :class:`NonFiniteCheckpointError` naming the first key
+    whose float array payload contains a NaN/Inf.  Non-array and
+    integer leaves are ignored."""
+    import numpy as np
+    for key, leaf in _walk_state(state):
+        arr = getattr(leaf, "_data_", leaf)
+        try:
+            a = np.asarray(arr)
+        except Exception:
+            continue
+        if a.dtype.kind != "f" or a.size == 0:
+            continue
+        if not bool(np.isfinite(a).all()):
+            raise NonFiniteCheckpointError(
+                f"checkpoint payload contains non-finite values at "
+                f"{key!r}; refusing to commit a poisoned checkpoint",
+                key=key)
 
 
 def _crc32_file(path, chunk=1 << 20):
@@ -180,14 +227,22 @@ class CheckpointManager:
         os.makedirs(self.root, exist_ok=True)
 
     # ---- save ----
-    def save(self, state, step=None, meta=None, layout=None):
+    def save(self, state, step=None, meta=None, layout=None,
+             validate_finite=False):
         """Checkpoint ``state`` under step number ``step`` (default: one
         past the newest existing step).  ``layout`` rides into the
         manifest's shard-layout section (distributed/reshard.py) so a
-        resized job can reshard this checkpoint on restore.  Returns the
-        committed directory path, or None when async (resolve via
-        ``wait()``)."""
+        resized job can reshard this checkpoint on restore.
+        ``validate_finite=True`` refuses to commit a payload containing
+        NaN/Inf float values, raising
+        :class:`NonFiniteCheckpointError` BEFORE anything is persisted
+        — the sentinel's last-known-good anchor rides this so a
+        poisoned incarnation can never overwrite its own rescue point.
+        Returns the committed directory path, or None when async
+        (resolve via ``wait()``)."""
         self._reraise()
+        if validate_finite:
+            validate_finite_state(state)
         if step is None:
             steps = scan_steps(self.root)
             step = (steps[0][0] + 1) if steps else 0
@@ -202,6 +257,52 @@ class CheckpointManager:
             self._thread.start()
             return None
         return self._save_impl(state, step, meta, layout)
+
+    # ---- last-known-good anchor ----
+    # The anchor lives in an `anchor/` directory next to the ckpt-N
+    # steps.  scan_steps() does not match it, so retention can NEVER
+    # garbage-collect it — that is the point: after a silent-corruption
+    # episode poisons N checkpoints in a row, max_to_keep would happily
+    # rotate every healthy pre-poison ckpt-N out of existence while the
+    # anchor stays pinned.
+
+    def save_anchor(self, state, step, meta=None):
+        """Pin ``state`` as the last-known-good anchor (finiteness
+        always validated; the previous anchor is replaced only after
+        the new one commits)."""
+        validate_finite_state(state)
+        with self._lock:
+            final = os.path.join(self.root, ANCHOR_DIR_NAME)
+            tmp = final + f".tmp.{os.getpid()}"
+            _rmtree_quiet(tmp)
+            os.makedirs(tmp, exist_ok=True)
+            try:
+                self._save_fn(state, tmp)
+                write_manifest(tmp, step=step,
+                               meta=dict(meta or {}, anchor=True))
+            except BaseException:
+                _rmtree_quiet(tmp)
+                raise
+            _rmtree_quiet(final)
+            os.replace(tmp, final)
+            _monitor.incr("ckpt.anchor_saves")
+            return final
+
+    def restore_anchor(self):
+        """(state, step) from the pinned anchor, or None when absent or
+        torn (an anchor that fails verification is treated as absent —
+        it is a rescue point, corruption there means fall back to the
+        ordinary ckpt-N scan)."""
+        path = os.path.join(self.root, ANCHOR_DIR_NAME)
+        if not verify_checkpoint(path):
+            return None
+        try:
+            state = self._load_fn(path)
+        except Exception as e:
+            self._log.warning("anchor %s failed to load (%s)", path, e)
+            return None
+        manifest = read_manifest(path) or {}
+        return state, int(manifest.get("step", -1))
 
     def _save_guarded(self, state, step, meta, layout=None):
         try:

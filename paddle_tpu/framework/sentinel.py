@@ -1,0 +1,623 @@
+"""Training sentinel: anomaly detection, last-known-good rollback, and
+bad-batch / bad-host quarantine.
+
+The fault-tolerance stack survives crashes, hangs, preemptions and
+resizes — failures that kill the process.  The failure class it missed
+is the one that does NOT crash: a NaN/Inf step, a loss spike from a
+corrupt batch, or silent gradient corruption from a flaky host poisons
+the weights, gets dutifully checkpointed, and retention then
+garbage-collects every pre-poison checkpoint.  This module is the
+production guardrail for that class (docs/RESILIENCE.md):
+
+1. **Detection** — cheap health signals that ride the existing
+   device-resident plumbing: the compiled train step
+   (``framework/train_step.py``) emits a per-step health vector
+   ``[grad_norm_sq, skipped]`` as an extra program output (device-only,
+   no host sync), the eager step stashes the same two scalars after the
+   backward.  Every ``FLAGS_sentinel_check_every`` update steps the
+   sentinel fetches the accumulated window in ONE batched device→host
+   transfer and evaluates: non-finite loss/grad-norm, loss-spike
+   z-score over a rolling window of accepted losses, and grad-norm
+   explosion against an EMA.
+
+2. **Response escalation** — (a) non-finite steps are *skipped
+   in-program* by the AMP found-inf machinery, which the sentinel arms
+   for non-AMP runs too (a unit-scale ``GradScaler`` with
+   ``always_check_found_inf=True``); (b) an anomaly that already hit
+   the weights (a finite spike is only detectable after the fact), or a
+   skip streak exceeding ``FLAGS_sentinel_max_skips``, triggers a
+   rollback to the pinned **last-known-good anchor**
+   (``CheckpointManager.save_anchor`` — finiteness-validated at save,
+   exempt from ``max_to_keep`` retention) and a replay in which the
+   offending iterations are **quarantined**: the deterministic batch
+   order lets ``Model.fit`` fast-forward the loader and skip exactly
+   the poisoned batches; (c) after ``FLAGS_sentinel_max_rollbacks``
+   failed rollbacks the sentinel declares the anomaly persistent and
+   stands down loudly instead of looping.
+
+3. **Blame** — in multi-process worlds each rank publishes a health
+   vector (local anomaly count, skip count, last grad norm) under
+   ``{job}/sentinel/health/r{rank}`` on the guardian store (PR 5).  A
+   rank whose LOCAL gradients are repeatedly the anomaly source while
+   every peer stays clean is named in a sentinel dump
+   (``reason: "sentinel"``, schema gated by ``tools/check_telemetry.py
+   --sentinel-dump``) and recorded under ``{job}/sentinel/blame`` — the
+   launch controller consults that key on relaunch and shrinks the
+   world by one so the PR 6 elastic-resize path resumes without the
+   flaky host.
+
+``FLAGS_sentinel`` off (default): none of this exists — ``Model.fit``
+trajectories are bitwise identical to a build without this module.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+
+from ..utils.flags import flag as _flag
+from ..utils import monitor as _monitor
+from ..utils.log import get_logger
+
+BLAME_MIN_ANOMALIES = 2
+
+
+def sentinel_enabled():
+    return bool(_flag("FLAGS_sentinel", False))
+
+
+_EAGER_HEALTH_FN = None
+
+
+def _eager_health(grads):
+    """(grad_norm_sq, found_inf) over a gradient list as ONE jitted
+    program (retraced per shape signature, cached after) — the eager
+    lane's per-step health cost is a single dispatch instead of ~3N
+    small reductions.  ``found_inf`` mirrors GradScaler.unscale_'s
+    check: any non-finite per-gradient sum."""
+    global _EAGER_HEALTH_FN
+    if _EAGER_HEALTH_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        def health(gs):
+            sums = jnp.stack([jnp.sum(g) for g in gs])
+            sq = jnp.stack([jnp.sum(jnp.square(g.astype(jnp.float32)))
+                            for g in gs])
+            return jnp.sum(sq), ~jnp.isfinite(sums).all()
+
+        _EAGER_HEALTH_FN = jax.jit(health)
+    return _EAGER_HEALTH_FN(grads)
+
+
+def sentinel_dump_path(rank=0, nranks=1):
+    """Resolve the sentinel-dump destination (mirrors the stall-dump
+    convention: multi-rank jobs insert ``.rank<R>`` before the
+    extension so peers never clobber each other)."""
+    p = str(_flag("FLAGS_sentinel_dump_path", "") or "")
+    if not p:
+        return os.path.join(os.getcwd(),
+                            str(_flag("FLAGS_dump_dir") or "."),
+                            f"sentinel_dump.{os.getpid()}.json")
+    if nranks <= 1:
+        return p
+    root, ext = os.path.splitext(p)
+    return f"{root}.rank{rank}{ext or '.json'}"
+
+
+class SentinelError(RuntimeError):
+    pass
+
+
+class RollbackDirective:
+    """What ``Model.fit`` must do after the sentinel restored the
+    anchor: rewind the iteration counter to ``it``, redo the epoch
+    ``epoch`` fast-forwarding batches before ``next_step``, and skip
+    quarantined iterations on the way."""
+
+    __slots__ = ("it", "epoch", "next_step", "reason")
+
+    def __init__(self, it, epoch, next_step, reason):
+        self.it = int(it)
+        self.epoch = int(epoch)
+        self.next_step = int(next_step)
+        self.reason = str(reason)
+
+    def __repr__(self):
+        return (f"RollbackDirective(it={self.it}, epoch={self.epoch}, "
+                f"next_step={self.next_step}, reason={self.reason!r})")
+
+
+# ---------------------------------------------------------------------------
+# blame records over the guardian store
+# ---------------------------------------------------------------------------
+
+
+def publish_health(trap, record):
+    """Write this rank's health vector (never raises — telemetry)."""
+    try:
+        trap.store.set(f"{trap.job}/sentinel/health/r{trap.rank}",
+                       json.dumps(record))
+    except Exception:
+        pass
+
+
+def read_health(trap):
+    """{rank: health record} across all ranks that published one."""
+    try:
+        raw = trap.store.list_prefix(f"{trap.job}/sentinel/health/")
+    except Exception:
+        return {}
+    out = {}
+    for key, val in raw.items():
+        try:
+            rank = int(key.rsplit("/r", 1)[-1])
+            out[rank] = json.loads(bytes(val).decode()
+                                   if not isinstance(val, str) else val)
+        except (ValueError, TypeError):
+            continue
+    return out
+
+
+def decide_blame(health, min_anomalies=BLAME_MIN_ANOMALIES):
+    """The rank to quarantine, or None.  Deliberately strict: exactly
+    one rank must show ``min_anomalies``+ local anomalies while every
+    peer shows zero — a global pathology (bad data, bad LR) blames
+    nobody, only a rank-local one (flaky host) does."""
+    if len(health) < 2:
+        return None
+    guilty = [r for r, h in health.items()
+              if int(h.get("local_anomalies", 0)) >= min_anomalies]
+    clean = [r for r, h in health.items()
+             if int(h.get("local_anomalies", 0)) == 0]
+    if len(guilty) == 1 and len(clean) == len(health) - 1:
+        return guilty[0]
+    return None
+
+
+def publish_blame(trap, rank, info=None):
+    try:
+        payload = dict(info or {}, rank=int(rank), ts=time.time())
+        trap.store.set(f"{trap.job}/sentinel/blame", json.dumps(payload))
+    except Exception:
+        pass
+
+
+def read_blame(store, job="default"):
+    """The recorded blame record ({"rank": ..}), or None."""
+    try:
+        raw = store.get(f"{job}/sentinel/blame")
+    except Exception:
+        return None
+    if not raw:
+        return None
+    try:
+        return json.loads(bytes(raw).decode()
+                          if not isinstance(raw, str) else raw)
+    except (ValueError, TypeError):
+        return None
+
+
+def clear_blame(store, job="default"):
+    try:
+        store.delete_key(f"{job}/sentinel/blame")
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the sentinel
+# ---------------------------------------------------------------------------
+
+
+class TrainingSentinel:
+    """Per-fit watchdog over the loss/gradient stream.
+
+    ``model`` is the ``hapi.Model`` being guarded (it supplies
+    ``_sentinel_snapshot()`` / ``_sentinel_restore()``); ``manager`` an
+    optional :class:`~paddle_tpu.framework.checkpoint_manager.
+    CheckpointManager` whose ``save_anchor`` pins the last-known-good
+    state on disk — without one, anchors are host-memory copies (same
+    semantics, not crash-persistent).
+    """
+
+    def __init__(self, model=None, manager=None, nranks=1, rank=0,
+                 trap=None):
+        self.model = model
+        self.manager = manager
+        self.nranks = int(nranks)
+        self.rank = int(rank)
+        self.enabled = True
+        self.window = int(_flag("FLAGS_sentinel_window", 32))
+        self.check_every = max(int(_flag("FLAGS_sentinel_check_every", 8)),
+                               1)
+        self.spike_z = float(_flag("FLAGS_sentinel_spike_zscore", 6.0))
+        self.max_skips = int(_flag("FLAGS_sentinel_max_skips", 3))
+        self.rollback_after = int(_flag("FLAGS_sentinel_rollback_after", 1))
+        self.anchor_every = int(_flag("FLAGS_sentinel_anchor_every", 32))
+        self.grad_factor = float(_flag("FLAGS_sentinel_grad_factor", 100.0))
+        self.max_rollbacks = int(_flag("FLAGS_sentinel_max_rollbacks", 3))
+        self._log = get_logger()
+        self._losses = deque(maxlen=max(self.window, 4))  # accepted losses
+        self._pending = []            # unfetched per-step device records
+        self._quarantine = set()      # global iterations never replayed
+        self._anomalies = []          # [{step, signal, value}] (bounded)
+        self._skip_streak = 0
+        self._applied_since_anchor = 0
+        self._local_anomalies = 0     # THIS rank's grads were the source
+        self._skips_total = 0
+        self._rollbacks = 0
+        self._gema = None             # grad-norm EMA (healthy steps)
+        self._gema_n = 0
+        self._anchor = None           # in-memory anchor record
+        self._last_anchor_it = None
+        self._last_gnorm_dev = None   # eager lane stash (device scalar)
+        self._last_skip = None        # eager lane stash (host bool)
+        self._trap_obj = trap
+        self._trap_tried = trap is not None
+        self._blamed = None
+
+    # ---- guardian store ------------------------------------------------
+    def _trap(self):
+        if not self._trap_tried:
+            self._trap_tried = True
+            try:
+                from ..distributed.watchdog import get_watchdog
+                self._trap_obj = get_watchdog().trap
+            except Exception:
+                self._trap_obj = None
+        return self._trap_obj
+
+    # ---- anchors -------------------------------------------------------
+    def begin(self, it=0, epoch=0, next_step=0):
+        """Pin the pristine pre-training state so even a poison before
+        the first cadence check has a rescue point."""
+        self._save_anchor(it, epoch, next_step)
+
+    def _save_anchor(self, next_it, epoch, next_step):
+        from .checkpoint_manager import NonFiniteCheckpointError
+        try:
+            state = self.model._sentinel_snapshot()
+        except Exception as e:
+            self._log.warning("sentinel: snapshot failed (%s); anchor "
+                              "not updated", e)
+            return
+        book = {"it": int(next_it), "epoch": int(epoch),
+                "next_step": int(next_step)}
+        try:
+            if self.manager is not None:
+                self.manager.save_anchor(state, step=next_it, meta=book)
+            else:
+                from .checkpoint_manager import validate_finite_state
+                validate_finite_state(state)
+                self._anchor = (state, book)
+        except NonFiniteCheckpointError as e:
+            # live weights are already poisoned: keep the previous
+            # anchor — overwriting the rescue point is the one
+            # unrecoverable move
+            self._log.warning("sentinel: refusing anchor update: %s", e)
+            return
+        self._last_anchor_it = int(next_it)
+        _monitor.incr("train.anomaly.anchor_saves")
+
+    def _load_anchor(self):
+        """(state, bookkeeping) of the pinned anchor, or None."""
+        if self.manager is not None:
+            restored = self.manager.restore_anchor()
+            if restored is None:
+                return None
+            state, _step = restored
+            from .checkpoint_manager import read_manifest, ANCHOR_DIR_NAME
+            manifest = read_manifest(os.path.join(self.manager.root,
+                                                  ANCHOR_DIR_NAME)) or {}
+            book = (manifest.get("meta") or {})
+            return state, book
+        return self._anchor
+
+    # ---- per-step feeds ------------------------------------------------
+    def note_eager(self, optimizer):
+        """Eager-lane health: squared norm + found-inf of the LOCAL
+        (pre-all-reduce) gradients, fused into ONE jitted dispatch and
+        kept on device — the per-rank signal blame needs, computed
+        before dp reduction can smear a flaky host's Inf across the
+        world.  Returns the device found-inf flag so the caller can
+        plant it into the GradScaler instead of paying a second
+        reduction pass."""
+        grads = [p.grad._data_ for p in optimizer._all_params()
+                 if p.grad is not None]
+        if not grads:
+            self._last_gnorm_dev = None
+            return None
+        gnorm_sq, found = _eager_health(grads)
+        self._last_gnorm_dev = gnorm_sq
+        return found
+
+    def note_eager_skip(self, skipped):
+        """Eager-lane skip flag (the scaler's found-inf decision, a
+        host bool the AMP machinery already materialized)."""
+        self._last_skip = bool(skipped)
+
+    def quarantined(self, it):
+        return it in self._quarantine
+
+    def after_step(self, it, epoch, step, loss_t, update=True):
+        """Record one completed train step; on cadence boundaries fetch
+        + evaluate the window.  Returns a :class:`RollbackDirective`
+        when the model was just rolled back, else None."""
+        if not self.enabled or not update:
+            return None
+        gnorm = skip = None
+        cs = getattr(self.model, "_compiled_step", None)
+        health = getattr(cs, "last_health", None) if cs not in (None, False) \
+            else None
+        if health is not None:
+            gnorm, skip = health[0], health[1]
+            cs.last_health = None
+        else:
+            gnorm, skip = self._last_gnorm_dev, self._last_skip
+        self._last_gnorm_dev = self._last_skip = None
+        self._pending.append({"it": int(it), "epoch": int(epoch),
+                              "step": int(step),
+                              "loss": getattr(loss_t, "_data_", loss_t),
+                              "gnorm": gnorm, "skip": skip})
+        if len(self._pending) >= self.check_every:
+            return self._check()
+        return None
+
+    def flush(self):
+        """Evaluate any unfetched records (epoch end)."""
+        if not self.enabled:
+            return None
+        return self._check()
+
+    # ---- the cadence check --------------------------------------------
+    def _fetch(self, pending):
+        import jax
+        import numpy as np
+        devicey, idx = [], []
+        for i, rec in enumerate(pending):
+            for key in ("loss", "gnorm", "skip"):
+                v = rec[key]
+                if v is not None and not isinstance(v, (bool, int, float)):
+                    devicey.append(v)
+                    idx.append((i, key))
+        fetched = jax.device_get(devicey) if devicey else []
+        out = [dict(r) for r in pending]
+        for (i, key), v in zip(idx, fetched):
+            out[i][key] = np.asarray(v).reshape(-1)[0]
+        return out
+
+    def _check(self):
+        import numpy as np
+        pending, self._pending = self._pending, []
+        if not pending:
+            return None
+        recs = self._fetch(pending)
+        rollback_reason = None
+        last_healthy = None
+        for rec in recs:
+            it = rec["it"]
+            loss = float(rec["loss"]) if rec["loss"] is not None else None
+            gsq = rec["gnorm"]
+            if gsq is not None and np.isfinite(gsq) and float(gsq) < 0:
+                gsq = None       # compiled lane: gnorm not sampled on
+            gnorm = float(np.sqrt(max(float(gsq), 0.0))) \
+                if gsq is not None and np.isfinite(gsq) else \
+                (float("inf") if gsq is not None else None)
+            skipped = bool(rec["skip"]) if rec["skip"] is not None \
+                else False
+            if skipped:
+                self._skip_streak += 1
+                self._skips_total += 1
+                self._quarantine.add(it)
+                self._note_anomaly(it, "nonfinite_step", gnorm or loss,
+                                   local=self._local_source(gsq))
+                _monitor.incr("train.anomaly.steps_skipped")
+                if self._skip_streak >= self.max_skips:
+                    rollback_reason = rollback_reason or "skip_streak"
+                continue
+            signal = value = None
+            if loss is None or not np.isfinite(loss):
+                signal, value = "nonfinite_loss", loss
+            else:
+                z = self._zscore(loss)
+                if z is not None and z > self.spike_z:
+                    signal, value = "loss_spike", z
+            if signal is None and gnorm is not None \
+                    and self.grad_factor > 0:
+                if not np.isfinite(gnorm):
+                    signal, value = "grad_nonfinite", gnorm
+                elif self._gema_n >= 5 and self._gema > 0 \
+                        and gnorm > self.grad_factor * self._gema:
+                    signal, value = "grad_explosion", gnorm / self._gema
+            if signal is not None:
+                # the update was APPLIED before we could see it: the
+                # weights are suspect from this iteration on
+                self._quarantine.add(it)
+                self._applied_since_anchor += 1
+                self._note_anomaly(it, signal, value, local=True)
+                if self._applied_since_anchor >= self.rollback_after:
+                    rollback_reason = rollback_reason or signal
+                continue
+            # healthy
+            self._skip_streak = 0
+            self._losses.append(loss)
+            if gnorm is not None:
+                self._gema = gnorm if self._gema is None \
+                    else 0.9 * self._gema + 0.1 * gnorm
+                self._gema_n += 1
+                _monitor.set_value("train.anomaly.grad_norm_ema",
+                                   self._gema)
+            last_healthy = rec
+        if self.nranks > 1:
+            self._exchange_health(recs[-1]["it"])
+        if rollback_reason is not None:
+            return self._escalate(rollback_reason, recs[-1])
+        if last_healthy is not None and last_healthy is recs[-1] \
+                and (self._last_anchor_it is None
+                     or recs[-1]["it"] + 1 - self._last_anchor_it
+                     >= self.anchor_every):
+            self._save_anchor(recs[-1]["it"] + 1, recs[-1]["epoch"],
+                              recs[-1]["step"] + 1)
+        return None
+
+    def _local_source(self, gsq):
+        """Whether THIS rank's local gradients look like the source of
+        a non-finite step (vs a peer's Inf arriving via all-reduce).
+        Single-rank: always local."""
+        import numpy as np
+        if self.nranks <= 1:
+            return True
+        return gsq is not None and not np.isfinite(gsq)
+
+    def _zscore(self, loss):
+        import numpy as np
+        if len(self._losses) < max(self.window // 4, 4):
+            return None
+        arr = np.asarray(self._losses, np.float64)
+        std = max(float(arr.std()), abs(float(arr.mean())) * 1e-3, 1e-8)
+        z = (loss - float(arr.mean())) / std
+        _monitor.set_value("train.anomaly.loss_zscore", float(z))
+        return z
+
+    def _note_anomaly(self, it, signal, value, local):
+        rec = {"step": int(it), "signal": str(signal),
+               "value": None if value is None else float(value)}
+        self._anomalies.append(rec)
+        del self._anomalies[:-64]
+        if local:
+            self._local_anomalies += 1
+        from ..observability import registry as _registry
+        _registry.counter("train.anomaly.detected",
+                          "sentinel anomalies by signal",
+                          labelnames=("signal",)) \
+            .labels(signal=str(signal)).inc()
+        _monitor.incr("train.anomaly.total")
+        self._log.warning(
+            "sentinel: anomaly at iteration %d: %s (value=%s)", it,
+            signal, value)
+
+    # ---- blame ---------------------------------------------------------
+    def _exchange_health(self, it):
+        trap = self._trap()
+        if trap is None:
+            return
+        publish_health(trap, {
+            "local_anomalies": self._local_anomalies,
+            "skips": self._skips_total,
+            "grad_norm_ema": self._gema,
+            "it": int(it), "ts": time.time()})
+        health = read_health(trap)
+        blamed = decide_blame(health)
+        if blamed is not None and self._blamed != blamed:
+            self._blamed = blamed
+            publish_blame(trap, blamed,
+                          {"anomalies": health.get(blamed, {})
+                           .get("local_anomalies"), "by": self.rank})
+            _monitor.incr("train.anomaly.ranks_blamed")
+            self._log.warning(
+                "sentinel: rank %d blamed for repeated local gradient "
+                "anomalies (health=%s)", blamed, health)
+            self.dump(action="blame", step=it, per_rank=health,
+                      blamed_rank=blamed)
+
+    # ---- escalation ----------------------------------------------------
+    def _escalate(self, reason, last_rec):
+        it = last_rec["it"]
+        if self._rollbacks >= self.max_rollbacks:
+            self.enabled = False
+            self.dump(action="disabled", step=it)
+            self._log.warning(
+                "sentinel: anomaly persists after %d rollbacks "
+                "(%s); sentinel standing down — investigate the data "
+                "pipeline / hardware", self._rollbacks, reason)
+            return None
+        if self.nranks > 1 or self.model is None:
+            # multi-rank rollback needs a coordinated world-wide rewind;
+            # the recovery story there is skip + blame + the
+            # controller's quarantine relaunch (docs/RESILIENCE.md)
+            trap = self._trap()
+            if trap is not None:
+                blame = read_blame(trap.store, trap.job)
+                if blame is not None:
+                    self._blamed = int(blame.get("rank", -1))
+            self.dump(action="quarantine", step=it,
+                      blamed_rank=self._blamed)
+            self._applied_since_anchor = 0   # re-arm instead of
+            self._skip_streak = 0            # re-escalating every check
+            if self.nranks > 1 and self._blamed is not None:
+                raise SentinelError(
+                    f"persistent training anomaly ({reason}); rank "
+                    f"{self._blamed} blamed for local gradient "
+                    "corruption — exiting so the controller can "
+                    "relaunch without it")
+            return None
+        anchor = self._load_anchor()
+        if anchor is None:
+            self.dump(action="no-anchor", step=it)
+            self._log.warning("sentinel: rollback wanted (%s) but no "
+                              "valid anchor exists", reason)
+            return None
+        state, book = anchor
+        self.model._sentinel_restore(state)
+        self._rollbacks += 1
+        self._applied_since_anchor = 0
+        self._skip_streak = 0
+        self._losses.clear()          # stats restart from the anchor
+        self._gema, self._gema_n = None, 0
+        _monitor.incr("train.anomaly.rollbacks")
+        directive = RollbackDirective(book.get("it", 0),
+                                      book.get("epoch", 0),
+                                      book.get("next_step", 0), reason)
+        self.dump(action="rollback", step=it,
+                  anchor_step=directive.it)
+        self._log.warning(
+            "sentinel: %s at iteration %d — rolled back to anchor "
+            "(it=%d, epoch=%d), %d iteration(s) quarantined", reason,
+            it, directive.it, directive.epoch, len(self._quarantine))
+        return directive
+
+    # ---- dump ----------------------------------------------------------
+    def dump(self, action, step, anchor_step=None, per_rank=None,
+             blamed_rank=None):
+        """Write the sentinel dump (flight-recorder framing, reason
+        ``sentinel``; schema: tools/check_telemetry.py
+        --sentinel-dump).  Never raises."""
+        from ..observability import flight_recorder as _fr
+        section = {
+            "action": str(action),
+            "step": int(step),
+            "window": int(self.window),
+            "check_every": int(self.check_every),
+            "anomalies": list(self._anomalies),
+            "quarantined": sorted(self._quarantine),
+            "rollbacks": int(self._rollbacks),
+            "skip_streak": int(self._skip_streak),
+            "anchor_step": (int(anchor_step)
+                            if anchor_step is not None
+                            else self._last_anchor_it),
+            "per_rank": {str(k): v
+                         for k, v in (per_rank or {}).items()},
+            "blamed_rank": blamed_rank,
+            "recent_losses": [float(v) for v in list(self._losses)[-8:]],
+        }
+        try:
+            return _fr.dump(
+                path=sentinel_dump_path(self.rank, self.nranks),
+                reason="sentinel", extra={"sentinel": section})
+        except Exception:
+            return None
+
+    # ---- introspection -------------------------------------------------
+    def report(self):
+        return {
+            "enabled": self.enabled,
+            "anomalies": list(self._anomalies),
+            "quarantined": sorted(self._quarantine),
+            "rollbacks": self._rollbacks,
+            "skips": self._skips_total,
+            "local_anomalies": self._local_anomalies,
+            "blamed_rank": self._blamed,
+            "anchor_it": self._last_anchor_it,
+        }

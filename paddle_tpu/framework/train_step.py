@@ -194,7 +194,8 @@ class CompiledTrainStep:
     """
 
     def __init__(self, forward_fn, optimizer, *, scaler=None, network=None,
-                 accumulate_grad_batches=1, mesh=None, eager_step=None):
+                 accumulate_grad_batches=1, mesh=None, eager_step=None,
+                 sentinel=False):
         self._forward = forward_fn
         self._opt = optimizer
         self._scaler = scaler
@@ -202,6 +203,15 @@ class CompiledTrainStep:
         self._accum = max(int(accumulate_grad_batches or 1), 1)
         self._mesh_arg = mesh
         self._eager = eager_step or self._default_eager_step
+        # training-sentinel mode (framework/sentinel.py): the full-step
+        # program additionally emits a [grad_norm_sq, skipped] health
+        # vector as a device output — detection signals ride the
+        # program, the hot path gains NO host syncs.  Off: the program
+        # is bit-identical to a sentinel-less build.
+        self._sentinel = bool(sentinel)
+        self._health_every = max(
+            int(_flag("FLAGS_sentinel_check_every", 8) or 1), 1)
+        self.last_health = None
         self._micro = 0               # position within the accum window
         self._calls = 0
         self._fallback_reason = None
@@ -386,7 +396,8 @@ class CompiledTrainStep:
         if self._scaler_vec is not None:
             self.sync_scaler()
             self._scaler_vec = None
-        return self._eager(x, y, update)
+        self.last_health = None   # stale compiled health must not be
+        return self._eager(x, y, update)  # mistaken for this step's
 
     def _default_eager_step(self, x, y, update):
         """Standalone eager semantics (scaler/clip-aware, single rank)."""
@@ -486,7 +497,7 @@ class CompiledTrainStep:
     # ------------------------------------------------------------------
 
     def _traced_body(self, update, x, y, param_arrs, grad_arrs, cap_arrs,
-                     states, step_arr, svec, lr, key):
+                     states, step_arr, svec, lr, key, hmark=None):
         """Replay the step over tracer arrays; returns array pytrees.
         Runs only while jax traces — per-step python cost is zero after
         compilation."""
@@ -529,10 +540,12 @@ class CompiledTrainStep:
                     return loss, tuple(grads), mut_vals
                 with no_grad():
                     tail = self._update_tail(grads, param_arrs, states,
-                                             step_arr, svec, lr)
-                new_params, new_states, new_step, new_svec, zeroed = tail
+                                             step_arr, svec, lr,
+                                             hmark=hmark)
+                (new_params, new_states, new_step, new_svec, zeroed,
+                 health) = tail
                 return (loss, tuple(new_params), tuple(zeroed), new_states,
-                        new_step, new_svec, mut_vals)
+                        new_step, new_svec, mut_vals, health)
         finally:
             _state.STATE.tracer = None
             # roll back any forward-mutated captures still holding
@@ -544,7 +557,8 @@ class CompiledTrainStep:
                             orig, jax.core.Tracer):
                         t._data_ = orig
 
-    def _update_tail(self, grads, param_arrs, states, step_arr, svec, lr):
+    def _update_tail(self, grads, param_arrs, states, step_arr, svec, lr,
+                     hmark=None):
         """Unscale → dp pmean → found-inf → clip → fused update → select.
         Pure array math mirroring the eager sequence op-for-op."""
         opt = self._opt
@@ -566,8 +580,41 @@ class CompiledTrainStep:
                 found = jax.lax.pmax(found.astype(jnp.int32),
                                      "dp").astype(jnp.bool_)
             # eager parity: the check is armed only while scaling is
-            # active (GradScaler.unscale_ skips it at scale == 1.0)
-            found = jnp.logical_and(found, svec[0] != 1.0)
+            # active (GradScaler.unscale_ skips it at scale == 1.0) —
+            # unless the scaler always checks (the sentinel's unit-scale
+            # wrapper generalizing the skip machinery to non-AMP runs)
+            if not getattr(self._scaler, "_always_check", False):
+                found = jnp.logical_and(found, svec[0] != 1.0)
+
+        health = None
+        if self._sentinel:
+            if found is None:
+                # scaler-less runs: the sentinel arms the same
+                # found-inf check the AMP machinery uses, so non-finite
+                # steps are skipped in-program here too
+                flags = [~jnp.isfinite(jnp.sum(g)) for g in grads]
+                found = jnp.any(jnp.stack(flags))
+                if self._dp > 1:
+                    found = jax.lax.pmax(found.astype(jnp.int32),
+                                         "dp").astype(jnp.bool_)
+            # device-resident health vector [grad_norm_sq, skipped]:
+            # the sentinel fetches a window of these in one batched
+            # transfer at its check cadence — zero per-step host syncs.
+            # The squared-norm pass costs a full read of every gradient,
+            # so it runs under lax.cond only on the calls hmark flags
+            # (the sentinel check cadence); other steps carry -1.0
+            # ("not sampled").  The found-inf flag stays per-step — it
+            # is what the skip select consumes.
+            def _gnorm_sq():
+                sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in grads]
+                return jnp.sum(jnp.stack(sq)) if sq \
+                    else jnp.asarray(0.0, jnp.float32)
+
+            gnorm_sq = jax.lax.cond(
+                hmark > 0.5, _gnorm_sq,
+                lambda: jnp.asarray(-1.0, jnp.float32))
+            health = jnp.stack([gnorm_sq, found.astype(jnp.float32)])
 
         if opt._grad_clip is not None:
             pairs = opt._grad_clip(
@@ -579,9 +626,13 @@ class CompiledTrainStep:
             opt, lr, new_step, list(param_arrs), grads, states,
             lr_scales=self._lr_scales, wd_mask=self._wd_mask)
 
+        # skip decision: the scaler's found-inf flag when one is
+        # installed (bitwise-identical to the pre-sentinel program), or
+        # the sentinel's own non-finite check for scaler-less runs
+        skip = found
         new_svec = svec
-        if scaler_on:
-            take = ~found
+        if skip is not None:
+            take = ~skip
             new_params = [jnp.where(take, n, o)
                           for n, o in zip(new_params, param_arrs)]
             new_states = {
@@ -589,9 +640,10 @@ class CompiledTrainStep:
                        for n, o in zip(vals, states[name])]
                 for name, vals in new_states.items()}
             new_step = jnp.where(take, new_step, step_arr)
+        if scaler_on:
             new_svec = self._scaler_update(svec, found)
         zeroed = [jnp.zeros_like(g) for g in grads]
-        return new_params, new_states, new_step, new_svec, zeroed
+        return new_params, new_states, new_step, new_svec, zeroed, health
 
     def _scaler_update(self, svec, found):
         """``GradScaler.update`` as pure in-program math."""
@@ -604,7 +656,8 @@ class CompiledTrainStep:
         dec = jnp.logical_and(found, bad_n >= sc._decr_every)
         inc = jnp.logical_and(~found, good_n >= sc._incr_every)
         scale_n = jnp.where(
-            dec, jnp.maximum(scale * sc._decr_ratio, 1.0),
+            dec, jnp.maximum(scale * sc._decr_ratio,
+                             getattr(sc, "_min_scale", 1.0)),
             jnp.where(inc, scale * sc._incr_ratio, scale))
         bad_n = jnp.where(dec, 0.0, bad_n)
         good_n = jnp.where(inc, 0.0, good_n)
@@ -621,31 +674,33 @@ class CompiledTrainStep:
         mesh = self._mesh
 
         def fn(x, y, params, grads, caps, states, step_arr, svec, lr,
-               key):
+               key, hmark):
             if self._dp > 1:
                 from jax.experimental.shard_map import shard_map
                 from jax.sharding import PartitionSpec as P
 
                 def body(x, y, params, grads, caps, states, step_arr,
-                         svec, lr, key):
+                         svec, lr, key, hmark):
                     # decorrelate per-shard RNG like per-rank eager dp
                     key_s = jax.random.fold_in(
                         key, jax.lax.axis_index("dp"))
                     out = self._traced_body(update, x, y, params, grads,
                                             caps, states, step_arr,
-                                            svec, lr, key_s)
+                                            svec, lr, key_s,
+                                            hmark=hmark)
                     loss = jax.lax.pmean(out[0], "dp")
                     return (loss,) + tuple(out[1:])
                 rep = P()
                 in_specs = (P("dp"), P("dp"), rep, rep, rep, rep, rep,
-                            rep, rep, rep)
+                            rep, rep, rep, rep)
                 return shard_map(body, mesh=mesh.jax_mesh,
                                  in_specs=in_specs, out_specs=rep,
                                  check_rep=False)(
                     x, y, params, grads, caps, states, step_arr, svec,
-                    lr, key)
+                    lr, key, hmark)
             return self._traced_body(update, x, y, params, grads, caps,
-                                     states, step_arr, svec, lr, key)
+                                     states, step_arr, svec, lr, key,
+                                     hmark=hmark)
 
         self._donating = bool(_flag("FLAGS_jit_donate_buffers", True))
         donate = ()
@@ -685,7 +740,13 @@ class CompiledTrainStep:
         key = jax.random.fold_in(_state.STATE.rng_key,
                                  _state.STATE.rng_counter)
         _state.STATE.rng_counter += 1
-        return xa, ya, params, grads, caps, states, step_arr, svec, lr, key
+        # hmark: sample the expensive in-program grad-norm pass only on
+        # the sentinel's check cadence (lax.cond skips it otherwise)
+        hmark = np.float32(
+            1.0 if self._sentinel
+            and self._calls % self._health_every == 1 else 0.0)
+        return (xa, ya, params, grads, caps, states, step_arr, svec, lr,
+                key, hmark)
 
     def _run_compiled(self, x, y, update):
         from ..utils import monitor as _monitor
@@ -712,7 +773,8 @@ class CompiledTrainStep:
 
         if update:
             (loss, new_params, zeroed, new_states, new_step, new_svec,
-             mut_vals) = jit(*args)
+             mut_vals, health) = jit(*args)
+            self.last_health = health    # device [gnorm_sq, skipped]
             for p, arr in zip(self._params, new_params):
                 p._data_ = arr
             for name in self._state_names:

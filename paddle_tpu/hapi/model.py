@@ -17,6 +17,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..io import DataLoader
 from ..metric import Metric
+from ..utils import fault_injection as _fault_injection
 from .callbacks import config_callbacks
 
 
@@ -54,6 +55,12 @@ class Model:
         self._scaler = None
         self._nranks = 1
         self._rank = 0
+        # training sentinel (framework/sentinel.py): installed by fit
+        # when FLAGS_sentinel is on; None costs one attr read per step
+        self._sentinel = None
+        # global iteration fed to the sentinel fault-injection seams
+        # (bad_batch / loss_spike / grad_bitflip); set by fit per step
+        self._fi_step = None
 
     # ---- configuration ----
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -159,6 +166,8 @@ class Model:
         with self._autocast():
             out = self.network(x)
             loss = self._compute_loss(out, y)
+        if self._fi_step is not None:
+            loss = _fault_injection.spike_loss(loss, self._fi_step)
         bwd = loss
         if self._scaler is not None:
             bwd = self._scaler.scale(bwd)
@@ -167,14 +176,29 @@ class Model:
             # MEAN over the window (matching one big-batch step)
             bwd = bwd * (1.0 / self._accum_steps)
         bwd.backward()
+        if self._fi_step is not None:
+            _fault_injection.corrupt_grads(self._optimizer, self._fi_step)
         if not update:
             return loss, out     # micro-step: gradients accumulate
+        if self._sentinel is not None:
+            # LOCAL (pre-all-reduce) grad health, kept on device: the
+            # per-rank signal blame attribution needs, computed before
+            # a dp reduction smears a flaky host's Inf across the world
+            found = self._sentinel.note_eager(self._optimizer)
+            if (found is not None and self._scaler is not None
+                    and self._scaler._scale == 1.0
+                    and self._scaler._always_check):
+                # the unit-scale sentinel wrapper reuses this fused
+                # flag instead of re-reducing every gradient
+                self._scaler._planted_found_inf = found
         if self._scaler is not None:
             if self._nranks > 1:
                 self._scaler.unscale_(self._optimizer,
                                       defer_found_inf=True)
                 self._sync_grads(with_found_inf=True)
             self._scaler.step(self._optimizer)  # step() runs update()
+            if self._sentinel is not None:
+                self._sentinel.note_eager_skip(self._scaler._found_inf)
         else:
             if self._nranks > 1:
                 self._sync_grads()
@@ -189,7 +213,11 @@ class Model:
         if self._compiled_step is False:
             return None
         if self._compiled_step is not None:
-            return self._compiled_step
+            if self._compiled_step._sentinel != (self._sentinel
+                                                 is not None):
+                self._compiled_step = None   # rebuild with/without the
+            else:                            # health-vector output
+                return self._compiled_step
         from ..utils.flags import flag as _flag
         if not _flag("FLAGS_compiled_train_step", True):
             return None
@@ -204,6 +232,7 @@ class Model:
             self._forward_loss, self._optimizer, scaler=self._scaler,
             network=self.network,
             accumulate_grad_batches=self._accum_steps,
+            sentinel=self._sentinel is not None,
             eager_step=lambda x, y, update:
                 self._train_step(x, y, update)[0])
         if cs.fallback_reason is not None:
@@ -309,6 +338,14 @@ class Model:
             from ..distributed.fleet.elastic import PreemptionHandler
             handler = PreemptionHandler().install()
 
+        # training sentinel (framework/sentinel.py, docs/RESILIENCE.md):
+        # anomaly detection over the device-resident loss/grad stream,
+        # last-known-good anchor rollback with the offending batch
+        # window quarantined on replay, per-rank blame in multi-process
+        # worlds.  Off (default): self._sentinel stays None and every
+        # seam below is a single attr read.
+        sentinel = self._install_sentinel(ckpt_cb)
+
         # unified telemetry (docs/OBSERVABILITY.md): step-time histogram,
         # examples/tokens-per-sec, MFU, memory watermarks — published into
         # the metrics registry; exporter thread only if the flag names a
@@ -324,15 +361,32 @@ class Model:
         history = {"loss": []}
         it = 0
         logs = {}
+        if sentinel is not None:
+            sentinel.begin(it=0, epoch=initial_epoch)
         try:
-            for epoch in range(initial_epoch, epochs):
+            epoch = initial_epoch
+            # post-rollback replay: redo the anchor's epoch, consuming
+            # (but not training on) the batches before the anchor point
+            # — the deterministic loader order maps global iteration ->
+            # batch stably across replays
+            replay_epoch, replay_from = None, -1
+            while epoch < epochs:
                 cbs.call("on_epoch_begin", epoch)
                 for m in self._metrics:
                     m.reset()
                 logs = {}
                 loss_t = None
+                rollback = None
                 for step, batch in enumerate(loader):
+                    if replay_epoch == epoch and step < replay_from:
+                        continue       # fast-forward to the anchor point
                     x, y = self._split_batch(batch)
+                    if sentinel is not None and sentinel.quarantined(it):
+                        it += 1        # poisoned batch window: skipped
+                        continue       # on replay, never refed
+                    if _fault_injection.active("bad_batch") is not None:
+                        x = _fault_injection.corrupt_batch(x, it)
+                    self._fi_step = it
                     cbs.call("on_train_batch_begin", step)
                     if flops_pending:
                         flops_pending = False
@@ -362,9 +416,23 @@ class Model:
                         ckpt_cb.manager.wait()
                         handler.uninstall()
                         handler.exit_for_relaunch()
+                    if sentinel is not None:
+                        rollback = sentinel.after_step(it, epoch, step,
+                                                       loss_t, update)
                     it += 1
+                    if rollback is not None:
+                        break
                     if num_iters and it >= num_iters:
                         break
+                if rollback is None and sentinel is not None:
+                    rollback = sentinel.flush()
+                if rollback is not None:
+                    it = rollback.it
+                    epoch = rollback.epoch
+                    replay_epoch, replay_from = (rollback.epoch,
+                                                 rollback.next_step)
+                    continue           # redo from the anchor point
+                replay_epoch, replay_from = None, -1
                 if loss_t is not None:
                     logs["loss"] = float(np.asarray(loss_t._data_))
                 self._sync_compiled_state()
@@ -375,11 +443,14 @@ class Model:
                     logs.update({f"eval_{k}": v
                                  for k, v in eval_logs.items()})
                 cbs.call("on_epoch_end", epoch, logs)
+                epoch += 1
                 if self.stop_training or (num_iters and it >= num_iters):
                     break
         finally:
             if handler is not None:
                 handler.uninstall()
+            self._sentinel = None
+            self._fi_step = None
         cbs.call("on_train_end", logs)
         return history
 
@@ -390,6 +461,83 @@ class Model:
         cs = self._compiled_step
         if cs is not None and cs is not False:
             cs.sync_scaler()
+
+    # ---- training sentinel (framework/sentinel.py) ----
+    def _install_sentinel(self, ckpt_cb):
+        """Build the fit-scoped TrainingSentinel when FLAGS_sentinel is
+        on (returns None otherwise).  Non-AMP runs get a unit-scale
+        GradScaler with ``always_check_found_inf`` so the existing AMP
+        found-inf machinery skips non-finite steps for them too — the
+        in-program response the compiled lane applies as a select, with
+        no host sync."""
+        from ..framework.sentinel import sentinel_enabled
+        jit = getattr(self, "_jit", False)
+        if not sentinel_enabled() or jit:
+            if jit and sentinel_enabled():
+                import warnings
+                warnings.warn("FLAGS_sentinel is ignored under "
+                              "prepare(jit=True): the to_static step "
+                              "cannot host the sentinel's seams")
+            if getattr(self._scaler, "_sentinel_wrapper", False):
+                self._scaler = None     # sentinel turned off since the
+            self._sentinel = None       # last fit installed its wrapper
+            return None
+        from ..framework.sentinel import TrainingSentinel
+        from .. import amp as amp_pkg
+        if self._scaler is None:
+            self._scaler = amp_pkg.GradScaler(
+                enable=True, init_loss_scaling=1.0,
+                use_dynamic_loss_scaling=False,
+                always_check_found_inf=True)
+            self._scaler._sentinel_wrapper = True
+        manager = None
+        if ckpt_cb is not None and ckpt_cb.save_dir and self._nranks == 1:
+            from ..framework.checkpoint_manager import CheckpointManager
+            if isinstance(ckpt_cb.manager, CheckpointManager):
+                manager = ckpt_cb.manager
+        self._sentinel = TrainingSentinel(
+            self, manager=manager, nranks=self._nranks, rank=self._rank)
+        return self._sentinel
+
+    def _sentinel_snapshot(self):
+        """Host-copied model/optimizer/scaler state for the sentinel's
+        last-known-good anchor (device buffers may be donated in place
+        by the compiled step right after this returns)."""
+        self._sync_compiled_state()
+
+        def host(sd):
+            return {k: (np.asarray(v._data_) if hasattr(v, "_data_")
+                        else v)
+                    for k, v in sd.items()}
+
+        from ..core import state as _cstate
+        state = {"model": host(self.network.state_dict()),
+                 "rng_counter": int(_cstate.STATE.rng_counter)}
+        if self._optimizer is not None:
+            state["optimizer"] = host(self._optimizer.state_dict())
+        if self._scaler is not None:
+            state["scaler"] = dict(self._scaler.state_dict())
+        return state
+
+    def _sentinel_restore(self, state):
+        """Roll the live model back onto an anchor snapshot."""
+        cs = self._compiled_step
+        if cs is not None and cs is not False:
+            cs._scaler_vec = None       # re-seed device scaler state
+            cs.last_health = None       # from the restored host values
+        self.network.set_state_dict(state["model"])
+        if self._optimizer is not None and state.get("optimizer"):
+            opt_state = {k: (Tensor(v) if isinstance(v, np.ndarray)
+                             else v)
+                         for k, v in state["optimizer"].items()}
+            self._optimizer.set_state_dict(opt_state)
+        if self._scaler is not None and state.get("scaler"):
+            self._scaler.load_state_dict(dict(state["scaler"]))
+            self._scaler._found_inf = False
+            self._scaler._unscaled = False
+        if "rng_counter" in state:
+            from ..core import state as _cstate
+            _cstate.STATE.rng_counter = int(state["rng_counter"])
 
     def _measure_step_flops(self, x):
         """Analytic FLOPs of one train step via the dispatch-funnel

@@ -43,6 +43,22 @@ KNOWN_POINTS = {
                          "rank": int, "once_file": str},
     "rank_crash": {"op": str, "at_seq": int, "rank": int, "exit": int,
                    "mode": str, "once_file": str},
+    # training-sentinel drills (framework/sentinel.py, docs/RESILIENCE.md).
+    # All three filter on the fit loop's global iteration (`at_step`) and
+    # optionally on the global rank; `count` bounds total fires.
+    # `bad_batch` corrupts the input batch host-side before it is fed
+    # (mode=scale multiplies, mode=nan poisons with NaNs) — it works in
+    # both the eager and the compiled train-step lanes since the data is
+    # a per-call program input.  `loss_spike` multiplies the loss after
+    # the forward and `grad_bitflip` overwrites one gradient element
+    # after the backward — both are eager-lane seams (the compiled
+    # program replays neither).
+    "bad_batch": {"at_step": int, "rank": int, "mode": str,
+                  "scale": float, "count": int},
+    "loss_spike": {"at_step": int, "rank": int, "scale": float,
+                   "count": int},
+    "grad_bitflip": {"at_step": int, "rank": int, "value": float,
+                     "param": int, "count": int},
     # serving-fleet failover drills (distributed/rpc, serving/router.py).
     # Both fire at CONNECT time — before the call could possibly have
     # been delivered — so a drilled retry/failover never risks the
@@ -215,3 +231,82 @@ def check_step(step):
         _crash(params)
     if params.get("sigterm_at") == step:
         os.kill(os.getpid(), signal.SIGTERM)
+
+
+#: per-point remaining-fire budgets for the sentinel points (bad_batch /
+#: loss_spike / grad_bitflip); re-armed when the spec string changes.
+_SENTINEL_STATE = {"raw": "", "counts": {}}
+
+
+def _sentinel_point(point, step):
+    """Params for an armed sentinel fault point firing at ``step`` on
+    this rank, else None.  One dict lookup when the flag is unset."""
+    params = active(point)
+    if params is None or step is None:
+        return None
+    if "at_step" in params and params["at_step"] != int(step):
+        return None
+    if "rank" in params:
+        if params["rank"] != int(os.environ.get("PADDLE_TRAINER_ID", "0")):
+            return None
+    raw = flag("FLAGS_fault_inject", "") or ""
+    if _SENTINEL_STATE["raw"] != raw:
+        _SENTINEL_STATE["raw"] = raw
+        _SENTINEL_STATE["counts"] = {}
+    if "count" in params:
+        left = _SENTINEL_STATE["counts"].get(point, params["count"])
+        if left <= 0:
+            return None
+        _SENTINEL_STATE["counts"][point] = left - 1
+    return params
+
+
+def corrupt_batch(x, step):
+    """The ``bad_batch`` seam: hapi fit routes every input batch through
+    here (with the global iteration) before feeding it to the train
+    step.  An armed point returns a corrupted copy — ``mode=scale``
+    (default) multiplies by ``scale`` (default 1e6), ``mode=nan`` fills
+    with NaNs — simulating host-side data corruption; it rides both the
+    eager and the compiled lanes because the batch is a per-call
+    input."""
+    params = _sentinel_point("bad_batch", step)
+    if params is None:
+        return x
+    data = getattr(x, "_data_", x)
+    if params.get("mode", "scale") == "nan":
+        bad = data * float("nan")
+    else:
+        bad = data * params.get("scale", 1e6)
+    return type(x)(bad) if hasattr(x, "_data_") else bad
+
+
+def spike_loss(loss, step):
+    """The ``loss_spike`` seam (eager train step, post-forward): an
+    armed point multiplies the loss by ``scale`` (default 1e6) so the
+    backward poisons the weights with a finite-but-huge update — the
+    silent-corruption class the sentinel's z-score detector exists
+    for."""
+    params = _sentinel_point("loss_spike", step)
+    if params is None:
+        return loss
+    return loss * params.get("scale", 1e6)
+
+
+def corrupt_grads(optimizer, step):
+    """The ``grad_bitflip`` seam (eager train step, post-backward): an
+    armed point overwrites element 0 of gradient ``param`` (index into
+    the optimizer's parameter list, default 0) with ``value`` (default
+    +inf) — a flipped exponent bit on a flaky host.  Returns True when
+    it fired."""
+    params = _sentinel_point("grad_bitflip", step)
+    if params is None:
+        return False
+    with_grads = [p for p in optimizer._all_params() if p.grad is not None]
+    if not with_grads:
+        return False
+    p = with_grads[min(params.get("param", 0), len(with_grads) - 1)]
+    g = p.grad._data_
+    val = params.get("value", float("inf"))
+    if hasattr(g, "at"):
+        p.grad._data_ = g.at[(0,) * len(g.shape)].set(val)
+    return True

@@ -109,6 +109,48 @@ _FLAGS: dict[str, Any] = {
     # 0 disables the proactive check (arrival records are still written
     # whenever a guardian store is configured — stall blame needs them).
     "FLAGS_desync_check_every": 16,
+    # training sentinel (framework/sentinel.py, docs/RESILIENCE.md):
+    # anomaly detection (non-finite loss/grads, loss-spike z-score,
+    # grad-norm explosion vs EMA), poisoned-step skip via the AMP
+    # found-inf machinery, last-known-good anchor rollback with the
+    # offending batch window quarantined on replay, and per-rank blame
+    # over the guardian store.  Off (default): training is bitwise
+    # identical to the sentinel never existing.
+    "FLAGS_sentinel": False,
+    # rolling window of accepted losses the spike z-score is computed
+    # against; also bounds how many device-held health records are
+    # fetched per host sync.
+    "FLAGS_sentinel_window": 32,
+    # a finite loss more than this many stds above the rolling-window
+    # mean is an anomaly (the window must be at least 1/4 full first).
+    "FLAGS_sentinel_spike_zscore": 6.0,
+    # health records (device loss/grad-norm/skip-flag) are fetched and
+    # evaluated every N update steps — ONE batched device->host sync per
+    # N steps, so the compiled hot path stays sync-free between checks.
+    "FLAGS_sentinel_check_every": 8,
+    # consecutive in-program skipped (non-finite) steps tolerated before
+    # the sentinel escalates to a rollback.
+    "FLAGS_sentinel_max_skips": 3,
+    # weight-poisoning anomalies (finite spikes / grad explosions that
+    # were APPLIED before detection) tolerated before rollback.  1 =
+    # any applied anomaly rolls back to the last-known-good anchor.
+    "FLAGS_sentinel_rollback_after": 1,
+    # minimum update steps between last-known-good anchor saves (anchors
+    # are only taken after a fully-healthy check window).
+    "FLAGS_sentinel_anchor_every": 32,
+    # a finite grad norm more than this multiple of its EMA is a
+    # grad-explosion anomaly.  0 disables the grad-norm signal.
+    "FLAGS_sentinel_grad_factor": 100.0,
+    # rollbacks attempted before the sentinel declares the anomaly
+    # persistent: multi-rank jobs publish blame and abort into the
+    # controller's quarantine-relaunch path, single-rank jobs disable
+    # the sentinel with a loud warning rather than loop forever.
+    "FLAGS_sentinel_max_rollbacks": 3,
+    # sentinel dump destination (reason "sentinel": signals, escalation
+    # action, per-rank health, blamed rank).  Empty = a
+    # sentinel_dump.<pid>.json under FLAGS_dump_dir; multi-rank jobs
+    # insert .rank<R> before the extension, like stall dumps.
+    "FLAGS_sentinel_dump_path": "",
 }
 
 

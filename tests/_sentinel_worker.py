@@ -1,0 +1,134 @@
+"""Training-sentinel drill worker (docs/RESILIENCE.md).
+
+Modes (argv[1]):
+
+- ``rollback <outdir>``: single process.  Trains a tiny regression fit
+  with the sentinel armed while ``FLAGS_fault_inject`` (set by the
+  caller, e.g. ``loss_spike:at_step=7,scale=1e6``) poisons one step.
+  Writes ``report.json`` (sentinel report + final weights) and a
+  sentinel dump under the caller's ``FLAGS_sentinel_dump_path``.
+
+- ``blame <outdir>``: 2-process (launched by CollectiveController).
+  Rank 1's gradients are repeatedly corrupted via ``grad_bitflip``; the
+  sentinel must skip the poisoned steps globally, attribute the
+  anomalies to rank 1 locally, publish blame over the guardian store,
+  and escalate with SentinelError.  Each rank writes
+  ``blame_report.<rank>.json``.
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+MODE = sys.argv[1]
+OUTDIR = sys.argv[2]
+
+if MODE == "blame":
+    jax.distributed.initialize(
+        coordinator_address=os.environ["PADDLE_MASTER"],
+        num_processes=int(os.environ["WORLD_SIZE"]),
+        process_id=int(os.environ["PADDLE_TRAINER_ID"]))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.framework.sentinel import SentinelError  # noqa: E402
+
+
+class ToyData:
+    """Deterministic per-index regression batches."""
+
+    def __init__(self, n=48):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(i)
+        x = rng.normal(size=(8,)).astype(np.float32)
+        return x, np.tanh(np.sum(x, keepdims=True)).astype(np.float32)
+
+
+def build():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.AdamW(0.01,
+                                         parameters=net.parameters()),
+        loss=nn.MSELoss())
+    return model, net
+
+
+def grab_sentinel(model):
+    holder = {}
+    orig = paddle.Model._install_sentinel
+
+    def patched(self, cb):
+        s = orig(self, cb)
+        holder["sentinel"] = s
+        return s
+
+    paddle.Model._install_sentinel = patched
+    return holder
+
+
+def main():
+    os.makedirs(OUTDIR, exist_ok=True)
+    if MODE == "rollback":
+        paddle.set_flags({
+            "FLAGS_sentinel": True,
+            "FLAGS_compiled_train_step": False,   # loss_spike is an
+            "FLAGS_sentinel_check_every": 4,      # eager-lane seam
+            "FLAGS_sentinel_anchor_every": 4,
+        })
+        model, net = build()
+        holder = grab_sentinel(model)
+        model.fit(ToyData(), batch_size=4, epochs=1, verbose=0,
+                  shuffle=False, save_dir=os.path.join(OUTDIR, "ckpts"))
+        sen = holder["sentinel"]
+        report = sen.report()
+        sen.dump(action="rollback", step=report["quarantined"][0]
+                 if report["quarantined"] else 0,
+                 anchor_step=report["anchor_it"])
+        weights = {k: np.asarray(v._data_).tolist()
+                   for k, v in net.state_dict().items()}
+        with open(os.path.join(OUTDIR, "report.json"), "w") as f:
+            json.dump({"report": report, "weights": weights}, f)
+        return 0
+
+    if MODE == "blame":
+        import paddle_tpu.distributed as dist
+        dist.init_parallel_env()
+        rank = dist.get_rank()
+        paddle.set_flags({
+            "FLAGS_sentinel": True,
+            "FLAGS_sentinel_check_every": 2,
+            "FLAGS_sentinel_max_skips": 3,
+            "FLAGS_fault_inject": "grad_bitflip:rank=1,count=6",
+        })
+        model, net = build()
+        holder = grab_sentinel(model)
+        outcome = "completed"
+        try:
+            model.fit(ToyData(32), batch_size=4, epochs=2, verbose=0,
+                      shuffle=False)
+        except SentinelError as e:
+            outcome = f"sentinel-error: {e}"
+        sen = holder["sentinel"]
+        with open(os.path.join(OUTDIR, f"blame_report.{rank}.json"),
+                  "w") as f:
+            json.dump({"rank": rank, "outcome": outcome,
+                       "report": sen.report()}, f)
+        return 0
+
+    raise SystemExit(f"unknown mode {MODE!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,428 @@
+"""Training sentinel: anomaly detection, last-known-good rollback,
+bad-batch quarantine, and bad-host blame (docs/RESILIENCE.md).
+
+The drills mirror the fault-tolerance suites: fault points
+(``loss_spike`` / ``bad_batch`` / ``grad_bitflip``) make every branch
+reachable deterministically, and recovered trajectories are compared
+against clean runs that skip the same batches.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.framework.checkpoint_manager import (
+    CheckpointManager, NonFiniteCheckpointError, validate_finite_state,
+    verify_checkpoint)
+from paddle_tpu.framework.sentinel import (
+    TrainingSentinel, decide_blame, read_blame, sentinel_enabled)
+from paddle_tpu.utils import fault_injection
+
+N, BS = 48, 4
+
+
+class ToyData:
+    def __len__(self):
+        return N
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(i)
+        x = rng.normal(size=(8,)).astype(np.float32)
+        return x, np.tanh(np.sum(x, keepdims=True)).astype(np.float32)
+
+
+def _build():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.AdamW(0.01,
+                                         parameters=net.parameters()),
+        loss=nn.MSELoss())
+    return model, net
+
+
+def _weights(net):
+    return {k: np.asarray(v._data_) for k, v in net.state_dict().items()}
+
+
+def _clean_run_skipping(skip_iters, compiled=False):
+    """Reference trajectory: same batches, the quarantined iterations
+    never trained, no sentinel."""
+    paddle.set_flags({"FLAGS_sentinel": False,
+                      "FLAGS_compiled_train_step": compiled})
+    model, net = _build()
+    data = ToyData()
+    for it in range(N // BS):
+        if it in skip_iters:
+            continue
+        xs = np.stack([data[i][0] for i in range(it * BS, (it + 1) * BS)])
+        ys = np.stack([data[i][1] for i in range(it * BS, (it + 1) * BS)])
+        model.train_batch(paddle.to_tensor(xs), paddle.to_tensor(ys))
+    return _weights(net)
+
+
+@pytest.fixture
+def flags():
+    """Snapshot/restore the flags the drills touch."""
+    keys = ("FLAGS_sentinel", "FLAGS_compiled_train_step",
+            "FLAGS_fault_inject", "FLAGS_sentinel_check_every",
+            "FLAGS_sentinel_anchor_every", "FLAGS_sentinel_max_skips",
+            "FLAGS_sentinel_rollback_after", "FLAGS_sentinel_window",
+            "FLAGS_sentinel_dump_path")
+    old = {k: paddle.get_flags([k])[k] for k in keys}
+    yield paddle.set_flags
+    paddle.set_flags(old)
+
+
+def _fit_with_sentinel(tmp_path=None, **fit_kw):
+    model, net = _build()
+    holder = {}
+    orig = paddle.Model._install_sentinel
+
+    def patched(self, cb):
+        s = orig(self, cb)
+        holder["sentinel"] = s
+        return s
+
+    paddle.Model._install_sentinel = patched
+    try:
+        kw = dict(batch_size=BS, epochs=1, verbose=0, shuffle=False)
+        kw.update(fit_kw)
+        if tmp_path is not None:
+            kw["save_dir"] = str(tmp_path)
+        model.fit(ToyData(), **kw)
+    finally:
+        paddle.Model._install_sentinel = orig
+    return model, net, holder.get("sentinel")
+
+
+# ---------------------------------------------------------------------------
+# satellites: GradScaler floor/streak, finite-validated checkpoints
+# ---------------------------------------------------------------------------
+
+
+def test_gradscaler_min_loss_scale_floor_and_streak_metric():
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.utils import monitor
+    sc = GradScaler(init_loss_scaling=256.0, decr_every_n_nan_or_inf=1,
+                    min_loss_scale=64.0)
+    for _ in range(10):
+        sc._found_inf = True
+        sc.update()
+    assert sc.get_loss_scaling() == 64.0      # floored, not 1.0
+    assert sc.found_inf_streak == 10
+    assert monitor.get_monitor_value("amp.found_inf_streak") == 10
+    sc._found_inf = False
+    sc.update()
+    assert sc.found_inf_streak == 0
+    assert monitor.get_monitor_value("amp.found_inf_streak") == 0
+
+
+def test_gradscaler_always_check_skips_at_unit_scale():
+    """The sentinel's unit-scale wrapper must catch an Inf gradient —
+    previously the check was skipped entirely at scale == 1.0."""
+    from paddle_tpu.amp import GradScaler
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = net(x).sum()
+    loss.backward()
+    p = net.parameters()[0]
+    p.grad._data_ = p.grad._data_.at[(0, 0)].set(float("inf"))
+    before = np.asarray(p._data_).copy()
+    sc = GradScaler(init_loss_scaling=1.0,
+                    use_dynamic_loss_scaling=False,
+                    always_check_found_inf=True)
+    sc.step(opt)
+    assert sc._found_inf
+    assert np.array_equal(before, np.asarray(p._data_))  # update skipped
+
+
+def test_validate_finite_refuses_poisoned_checkpoint(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    bad = {"model": {"w": np.array([1.0, np.nan], np.float32)}}
+    with pytest.raises(NonFiniteCheckpointError) as ei:
+        mgr.save(bad, step=0, validate_finite=True)
+    assert "model.w" in str(ei.value)
+    assert mgr.restore_latest() is None       # nothing was persisted
+    # default save still accepts (pre-PR behavior unchanged)
+    mgr.save(bad, step=0)
+    assert mgr.latest_step() == 0
+
+
+def test_validate_finite_walks_nested_state():
+    validate_finite_state({"a": [np.zeros(3), {"b": np.ones(2)}],
+                           "n": 7, "s": "text"})
+    with pytest.raises(NonFiniteCheckpointError):
+        validate_finite_state({"a": [np.zeros(3),
+                                     {"b": np.array([np.inf])}]})
+
+
+def test_anchor_is_exempt_from_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    mgr.save_anchor({"w": np.ones(3, np.float32)}, step=1)
+    for s in range(6):
+        mgr.save({"w": np.full(3, float(s), np.float32)}, step=s)
+    steps = mgr.all_steps()
+    assert steps == [4, 5]                    # retention rotated ckpts
+    restored = mgr.restore_anchor()
+    assert restored is not None
+    state, step = restored
+    assert step == 1 and np.array_equal(state["w"], np.ones(3))
+    # a poisoned anchor update must refuse and keep the old anchor
+    with pytest.raises(NonFiniteCheckpointError):
+        mgr.save_anchor({"w": np.array([np.nan])}, step=2)
+    assert mgr.restore_anchor()[1] == 1
+
+
+def test_new_fault_point_specs_validate():
+    spec = fault_injection.parse(
+        "bad_batch:at_step=3,mode=nan;loss_spike:at_step=2,scale=1e6;"
+        "grad_bitflip:rank=1,count=6")
+    assert spec["bad_batch"]["at_step"] == 3
+    assert spec["loss_spike"]["scale"] == 1e6
+    assert spec["grad_bitflip"]["count"] == 6
+    for bad in ("bad_batch:nope=1", "loss_spike:at_step=x",
+                "grad_bitflip"):
+        with pytest.raises(fault_injection.FaultSpecError):
+            fault_injection.parse(bad)
+
+
+# ---------------------------------------------------------------------------
+# detection units
+# ---------------------------------------------------------------------------
+
+
+def test_zscore_spike_detection_unit(flags):
+    flags({"FLAGS_sentinel": True, "FLAGS_sentinel_window": 16,
+           "FLAGS_sentinel_check_every": 1})
+    sen = TrainingSentinel(model=None)
+    for it in range(12):
+        sen.after_step(it, 0, it, 1.0 + 0.01 * it, update=True)
+    assert sen.report()["anomalies"] == []
+    sen.after_step(12, 0, 12, 1e6, update=True)   # finite spike
+    rep = sen.report()
+    assert [a["signal"] for a in rep["anomalies"]] == ["loss_spike"]
+    assert rep["quarantined"] == [12]
+
+
+def test_nonfinite_loss_detection_unit(flags):
+    flags({"FLAGS_sentinel": True, "FLAGS_sentinel_check_every": 1})
+    sen = TrainingSentinel(model=None)
+    sen.after_step(0, 0, 0, float("nan"), update=True)
+    rep = sen.report()
+    assert rep["anomalies"][0]["signal"] == "nonfinite_loss"
+    assert rep["quarantined"] == [0]
+
+
+def test_blame_decision_unit():
+    h = {0: {"local_anomalies": 0}, 1: {"local_anomalies": 3}}
+    assert decide_blame(h) == 1
+    # global pathology (both ranks anomalous) blames nobody
+    assert decide_blame({0: {"local_anomalies": 2},
+                         1: {"local_anomalies": 3}}) is None
+    # below the threshold: not enough evidence
+    assert decide_blame({0: {"local_anomalies": 0},
+                         1: {"local_anomalies": 1}}) is None
+    assert decide_blame({0: {"local_anomalies": 4}}) is None  # 1 rank
+
+
+def test_sentinel_dump_schema(flags, tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import check_telemetry
+    finally:
+        sys.path.pop(0)
+    dump_path = str(tmp_path / "sentinel.json")
+    flags({"FLAGS_sentinel": True, "FLAGS_sentinel_check_every": 1,
+           "FLAGS_sentinel_dump_path": dump_path})
+    sen = TrainingSentinel(model=None)
+    sen.after_step(0, 0, 0, float("nan"), update=True)
+    path = sen.dump(action="rollback", step=0, anchor_step=0)
+    assert path == dump_path and os.path.exists(path)
+    errors = check_telemetry.check_sentinel_dump(path)
+    assert not errors, errors
+    data = json.load(open(path))
+    assert data["reason"] == "sentinel"
+    assert data["sentinel"]["anomalies"][0]["signal"] == "nonfinite_loss"
+
+
+# ---------------------------------------------------------------------------
+# fit drills
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_off_trajectory_bitwise_identical_eager(flags):
+    """Healthy-path parity, eager lane: the sentinel's seams are pure
+    pass-throughs — trajectories must be BITWISE equal on/off."""
+    flags({"FLAGS_sentinel": False, "FLAGS_compiled_train_step": False})
+    _, net_off, _ = _fit_with_sentinel()
+    flags({"FLAGS_sentinel": True})
+    _, net_on, sen = _fit_with_sentinel()
+    assert sen is not None and sen.report()["anomalies"] == []
+    off, on = _weights(net_off), _weights(net_on)
+    for k in off:
+        assert np.array_equal(off[k], on[k]), k
+
+
+def test_sentinel_healthy_compiled_trajectory_ulp_equal(flags):
+    """Compiled lane: the sentinel program adds the scaler-vec + health
+    outputs, so XLA may re-fuse reductions — trajectories agree to the
+    same ~1-ulp bound docs/TRAIN_STEP.md sets for program refusion (the
+    flag-OFF program itself is byte-identical to pre-sentinel builds)."""
+    flags({"FLAGS_sentinel": False, "FLAGS_compiled_train_step": True})
+    _, net_off, _ = _fit_with_sentinel()
+    flags({"FLAGS_sentinel": True})
+    _, net_on, sen = _fit_with_sentinel()
+    assert sen is not None and sen.report()["anomalies"] == []
+    off, on = _weights(net_off), _weights(net_on)
+    for k in off:
+        np.testing.assert_allclose(off[k], on[k], rtol=2e-6, atol=1e-7,
+                                   err_msg=k)
+
+
+def test_rollback_drill_eager_loss_spike(flags, tmp_path):
+    spike_it = 7
+    flags({"FLAGS_sentinel": True, "FLAGS_compiled_train_step": False,
+           "FLAGS_sentinel_check_every": 4,
+           "FLAGS_sentinel_anchor_every": 4,
+           "FLAGS_fault_inject":
+               f"loss_spike:at_step={spike_it},scale=1e6"})
+    _, net, sen = _fit_with_sentinel(tmp_path=tmp_path / "ckpts")
+    rep = sen.report()
+    assert rep["rollbacks"] == 1, rep
+    assert spike_it in rep["quarantined"], rep
+    # detection within the window: the anomaly step is the spiked one
+    assert any(a["step"] == spike_it for a in rep["anomalies"])
+    flags({"FLAGS_fault_inject": ""})
+    ref = _clean_run_skipping({spike_it})
+    got = _weights(net)
+    worst = max(float(np.abs(got[k] - ref[k]).max()) for k in ref)
+    assert worst < 5e-4, worst
+    # the anchor rode the CheckpointManager anchor dir, exempt from
+    # regular scans
+    assert (tmp_path / "ckpts" / "anchor").exists()
+    assert verify_checkpoint(str(tmp_path / "ckpts" / "anchor"))
+
+
+def test_quarantine_drill_compiled_bad_batch(flags):
+    bad_it = 7
+    flags({"FLAGS_sentinel": True, "FLAGS_compiled_train_step": True,
+           "FLAGS_sentinel_check_every": 4,
+           "FLAGS_sentinel_anchor_every": 4,
+           "FLAGS_fault_inject": f"bad_batch:at_step={bad_it},mode=nan"})
+    model, net, sen = _fit_with_sentinel()
+    rep = sen.report()
+    cs = model._compiled_step
+    assert cs not in (None, False) and cs.compiled, \
+        getattr(cs, "fallback_reason", cs)
+    # the NaN batch was skipped IN-PROGRAM (no rollback needed) and
+    # quarantined for any future replay
+    assert rep["skips"] >= 1 and bad_it in rep["quarantined"], rep
+    flags({"FLAGS_fault_inject": ""})
+    ref = _clean_run_skipping({bad_it}, compiled=True)
+    got = _weights(net)
+    worst = max(float(np.abs(got[k] - ref[k]).max()) for k in ref)
+    assert worst < 5e-4, worst
+
+
+def test_skip_streak_escalates_to_rollback(flags, tmp_path):
+    flags({"FLAGS_sentinel": True, "FLAGS_compiled_train_step": False,
+           "FLAGS_sentinel_check_every": 2,
+           "FLAGS_sentinel_max_skips": 2,
+           "FLAGS_sentinel_anchor_every": 2,
+           "FLAGS_fault_inject": "bad_batch:mode=nan,count=3"})
+    _, net, sen = _fit_with_sentinel(tmp_path=tmp_path / "ckpts")
+    rep = sen.report()
+    assert rep["rollbacks"] >= 1, rep
+    assert {0, 1}.issubset(set(rep["quarantined"])), rep
+    flags({"FLAGS_fault_inject": ""})
+    ref = _clean_run_skipping(set(rep["quarantined"]))
+    got = _weights(net)
+    worst = max(float(np.abs(got[k] - ref[k]).max()) for k in ref)
+    assert worst < 5e-4, worst
+
+
+def test_controller_quarantine_shrinks_world(tmp_path, monkeypatch):
+    from paddle_tpu.distributed.launch.context import Context, parse_args
+    from paddle_tpu.distributed.launch.controller import (
+        CollectiveController)
+    from paddle_tpu.framework.sentinel import publish_blame
+
+    monkeypatch.setenv("PADDLE_JOB_ID", "default")
+    args = parse_args(["--nproc_per_node", "2", "--log_dir",
+                       str(tmp_path), "dummy.py"])
+    ctl = CollectiveController(Context(args=args))
+    ctl._guardian_env()
+    publish_blame(ctl._trap, 1, {"anomalies": 4})
+    assert read_blame(ctl._trap.store, ctl._trap.job)["rank"] == 1
+    ctl._apply_quarantine()
+    assert ctl._world == 1
+    assert ctl._extra_env["PADDLE_ELASTIC_RESIZED"] == "2:1"
+    # blame consumed: a second relaunch does not shrink again
+    ctl._apply_quarantine()
+    assert ctl._world == 1
+
+
+def test_sentinel_disabled_flag_reads_false(flags):
+    flags({"FLAGS_sentinel": False})
+    assert not sentinel_enabled()
+    flags({"FLAGS_sentinel": True})
+    assert sentinel_enabled()
+
+
+# ---------------------------------------------------------------------------
+# 2-process blame drill (slow: spawns a jax.distributed world)
+# ---------------------------------------------------------------------------
+
+
+def test_blame_drill_two_procs(tmp_path):
+    from paddle_tpu.distributed.launch.context import Context, parse_args
+    from paddle_tpu.distributed.launch.controller import (
+        CollectiveController)
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_sentinel_worker.py")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    old = {k: os.environ.get(k)
+           for k in ("PYTHONPATH", "FLAGS_sentinel_dump_path")}
+    os.environ["PYTHONPATH"] = repo + os.pathsep + \
+        os.environ.get("PYTHONPATH", "")
+    os.environ["FLAGS_sentinel_dump_path"] = \
+        str(tmp_path / "sentinel.json")
+    try:
+        args = parse_args(["--nproc_per_node", "2", "--max_restart", "0",
+                           "--log_dir", str(tmp_path / "logs"),
+                           worker, "blame", str(tmp_path)])
+        CollectiveController(Context(args=args)).run()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    reports = {}
+    for rank in (0, 1):
+        p = tmp_path / f"blame_report.{rank}.json"
+        assert p.exists(), list(tmp_path.iterdir())
+        reports[rank] = json.load(open(p))
+    # rank 1's grads were the anomaly source: blamed by name
+    assert reports[0]["report"]["blamed_rank"] == 1, reports
+    assert reports[0]["report"]["local_anomalies"] == 0, reports
+    assert reports[1]["report"]["local_anomalies"] >= 2, reports
+    # the sentinel dump carries the blame for post-mortem reading
+    dump = tmp_path / "sentinel.rank0.json"
+    assert dump.exists(), list(tmp_path.iterdir())
+    data = json.load(open(dump))
+    assert data["reason"] == "sentinel"
+    assert data["sentinel"]["blamed_rank"] == 1
+    # escalation ended in the quarantine path on at least one rank
+    assert any("sentinel-error" in reports[r]["outcome"]
+               for r in (0, 1)), reports

@@ -74,6 +74,22 @@ def check_eager_overhead(run):
             if run["tier1"]["hits"] <= 0:
                 errors.append("tier1.hits is zero — the cached pass "
                               "never hit its own cache")
+        # sentinel healthy-path gate (ISSUE 10): detection on top of
+        # the guarded eager step must cost <= 2% (older recorded
+        # baselines predate the section, so it is optional there)
+        sen = run.get("sentinel")
+        if isinstance(sen, dict):
+            ratio = sen.get("overhead_vs_guarded")
+            if not isinstance(ratio, (int, float)) or ratio <= 0:
+                errors.append("sentinel.overhead_vs_guarded missing or "
+                              f"not positive: {ratio!r}")
+            elif ratio > _SENTINEL_MAX_OVERHEAD:
+                errors.append(
+                    f"sentinel eager overhead {ratio:.3f}x > "
+                    f"{_SENTINEL_MAX_OVERHEAD}x vs the guarded step")
+            if sen.get("anomalies"):
+                errors.append("sentinel flagged anomalies on the "
+                              "healthy bench workload")
     if errors:
         print("eager_overhead schema check FAILED:")
         for e in errors:
@@ -113,6 +129,13 @@ _TRAIN_STEP_SCHEMA = {
 _TRAIN_STEP_MIN_SPEEDUP_SMOKE = 1.5
 _TRAIN_STEP_MIN_SPEEDUP_FULL = 1.15
 
+# sentinel healthy-path ceiling (ISSUE 10): the sentinel's detection
+# signals (device health vector, cond-sampled grad norm) on top of the
+# guarded (found-inf-armed) step, measured interleaved so box drift
+# cancels.  The skip machinery itself is the PRE-EXISTING AMP select
+# path and is recorded informationally, not gated here.
+_SENTINEL_MAX_OVERHEAD = 1.02
+
 
 def check_train_step_bench(run):
     """Schema + speedup/equality gate for benchmarks/train_step_bench.py."""
@@ -146,6 +169,21 @@ def check_train_step_bench(run):
                 "compiled fp32 loss trajectory diverged from eager on "
                 f"CPU beyond ulp tolerance (max rel diff "
                 f"{run.get('losses_max_reldiff')})")
+        sen = run.get("sentinel")
+        if not isinstance(sen, dict):
+            errors.append("missing 'sentinel' overhead section")
+        else:
+            ratio = sen.get("overhead_vs_guarded")
+            if not isinstance(ratio, (int, float)) or ratio <= 0:
+                errors.append("sentinel.overhead_vs_guarded missing or "
+                              f"not positive: {ratio!r}")
+            elif ratio > _SENTINEL_MAX_OVERHEAD:
+                errors.append(
+                    f"sentinel compiled overhead {ratio:.3f}x > "
+                    f"{_SENTINEL_MAX_OVERHEAD}x vs the guarded step")
+            if not sen.get("pair_compiled"):
+                errors.append("sentinel overhead pair fell back to "
+                              "eager — the gate measured nothing")
     if errors:
         print("train_step_bench schema check FAILED:")
         for e in errors:

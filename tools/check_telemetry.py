@@ -180,6 +180,51 @@ def check_stall_dump(path):
     return errors
 
 
+_SENTINEL_ACTIONS = ("rollback", "quarantine", "blame", "skip",
+                     "disabled", "no-anchor")
+
+
+def check_sentinel_dump(path):
+    """Validate a training-sentinel dump (ISSUE 10 CI satellite): the
+    post-mortem of a poisoned-run recovery must parse and carry the
+    escalation action, the anomaly list (step + signal + value), the
+    quarantined iterations, and the per-rank health/blame section."""
+    errors = []
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable sentinel dump: {e}"]
+    if data.get("reason") != "sentinel":
+        errors.append(f"{path}: reason is {data.get('reason')!r}, "
+                      "expected 'sentinel'")
+    if "metrics" not in data:
+        errors.append(f"{path}: missing metrics snapshot")
+    section = data.get("sentinel")
+    if not isinstance(section, dict):
+        return errors + [f"{path}: missing 'sentinel' section"]
+    if section.get("action") not in _SENTINEL_ACTIONS:
+        errors.append(f"{path}: sentinel.action is "
+                      f"{section.get('action')!r}, expected one of "
+                      f"{_SENTINEL_ACTIONS}")
+    for key, types in (("step", int), ("window", int),
+                       ("anomalies", list), ("quarantined", list),
+                       ("rollbacks", int), ("per_rank", dict),
+                       ("recent_losses", list)):
+        if not isinstance(section.get(key), types):
+            errors.append(f"{path}: sentinel.{key} missing or not "
+                          f"{types}")
+    for i, a in enumerate(section.get("anomalies") or []):
+        if not isinstance(a, dict) or not isinstance(a.get("step"), int) \
+                or not isinstance(a.get("signal"), str):
+            errors.append(f"{path}: sentinel.anomalies[{i}] needs int "
+                          "'step' + str 'signal'")
+    blamed = section.get("blamed_rank")
+    if blamed is not None and not isinstance(blamed, int):
+        errors.append(f"{path}: sentinel.blamed_rank must be int|null")
+    return errors
+
+
 _ROUTER_COUNTERS = ("serving_router_requests_routed_total",
                     "serving_router_requests_shed",
                     "serving_router_failovers",
@@ -235,15 +280,18 @@ def main():
                     help="sanitized series names that must be present")
     ap.add_argument("--stall-dump",
                     help="collective-watchdog stall dump JSON to check")
+    ap.add_argument("--sentinel-dump",
+                    help="training-sentinel dump JSON to check")
     ap.add_argument("--router", action="store_true",
                     help="also gate the serving-fleet router metric "
                          "schema in the --prometheus dump")
     args = ap.parse_args()
     if args.router and not args.prometheus:
         ap.error("--router needs --prometheus")
-    if not args.prometheus and not args.snapshots and not args.stall_dump:
-        ap.error("nothing to check: pass --prometheus, --snapshots "
-                 "and/or --stall-dump")
+    if not args.prometheus and not args.snapshots \
+            and not args.stall_dump and not args.sentinel_dump:
+        ap.error("nothing to check: pass --prometheus, --snapshots, "
+                 "--stall-dump and/or --sentinel-dump")
 
     failures = []
     if args.prometheus:
@@ -279,6 +327,17 @@ def main():
                   f"seq={stall.get('seq')} "
                   f"missing_ranks={stall.get('missing_ranks')} "
                   f"{len(stall.get('threads') or [])} thread stack(s)")
+    if args.sentinel_dump:
+        errors = check_sentinel_dump(args.sentinel_dump)
+        failures += errors
+        if not errors:
+            with open(args.sentinel_dump) as f:
+                sen = json.load(f)["sentinel"]
+            print(f"sentinel dump OK: action={sen.get('action')!r} "
+                  f"step={sen.get('step')} "
+                  f"{len(sen.get('anomalies') or [])} anomaly(ies), "
+                  f"quarantined={sen.get('quarantined')} "
+                  f"blamed_rank={sen.get('blamed_rank')}")
 
     if failures:
         print("telemetry check FAILED:")

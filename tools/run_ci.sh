@@ -92,6 +92,28 @@ echo "== compiled train step bench (smoke: >=1.5x vs eager + ulp-equal trajector
 python benchmarks/train_step_bench.py --smoke --out /tmp/train_step_ci.json
 python tools/check_bench_result.py /tmp/train_step_ci.json
 
+echo "== sentinel rollback drill (loss spike -> anchor rollback -> replay-with-skip) =="
+# bounded: the fast in-process drills prove detection + rollback +
+# quarantined replay match a clean run, then the worker produces a
+# sentinel dump that must pass the schema gate.
+timeout -k 10 240 python -m pytest tests/test_sentinel.py -q -p no:randomly \
+    -k "rollback_drill or quarantine_drill or off_trajectory"
+rm -rf /tmp/pt_sentinel_drill && mkdir -p /tmp/pt_sentinel_drill
+FLAGS_sentinel_dump_path=/tmp/pt_sentinel_drill/sentinel.json \
+FLAGS_fault_inject="loss_spike:at_step=7,scale=1e6" \
+    python tests/_sentinel_worker.py rollback /tmp/pt_sentinel_drill
+python tools/check_telemetry.py \
+    --sentinel-dump /tmp/pt_sentinel_drill/sentinel.json
+python - <<'EOF'
+import json
+rep = json.load(open("/tmp/pt_sentinel_drill/report.json"))["report"]
+assert rep["rollbacks"] == 1, rep
+assert 7 in rep["quarantined"], rep
+print(f"sentinel drill OK: {rep['rollbacks']} rollback, "
+      f"quarantined {rep['quarantined']}, anchor at it "
+      f"{rep['anchor_it']}")
+EOF
+
 echo "== telemetry smoke (hapi fit + exporter -> prometheus/json gates) =="
 FLAGS_metrics_export_path=/tmp/pt_metrics_ci.jsonl \
 FLAGS_metrics_export_interval_s=0.2 \
