@@ -421,6 +421,18 @@ def main():
         _log(f"cpu timing estimator changed "
              f"({entry.get('method')!r} -> {timing['method']!r}); "
              f"re-seeding the cpu baseline (vs_baseline will read 1.0)")
+    if not on_tpu and prev and os.environ.get("BENCH_RESEED_CPU"):
+        # Shared-box throughput drifts across rounds (the r03 A/B
+        # falsification, commit 756e79a), so the all-time-best CPU
+        # comparison goes stale between epochs.  Re-seed ONLY after an
+        # A/B run of an older commit on the same box shows the gap is
+        # the box, not the code — record that evidence here.
+        _log(f"BENCH_RESEED_CPU set: re-seeding the cpu baseline epoch "
+             f"(old best {prev:.1f} t/s; vs_baseline will read 1.0)")
+        base.setdefault("cpu_epochs", []).append(
+            {"superseded_best": prev,
+             "reason": os.environ["BENCH_RESEED_CPU"]})
+        prev = None
     vs_baseline = tokens_per_sec / prev if prev else 1.0
 
     # Every successful TPU measurement appends a raw, auditable record —

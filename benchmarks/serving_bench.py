@@ -158,6 +158,130 @@ def _run_prefix_workload(paddle, args):
     }
 
 
+def _build_spec_models(paddle):
+    """Target/draft pair for the speculative lane.
+
+    The target is an 8-block GPT whose blocks 1-7 have ZEROED output
+    projections — residual-identity blocks that still cost their full
+    matmul time — and the 1-block draft shares the target's embeddings,
+    block 0, and final norm.  The two therefore compute the same
+    function at a ~8x block-cost ratio, which pins the acceptance rate at
+    ~1.0: the lane measures the ENGINE's speculative ceiling
+    (draft/verify/rollback overheads at perfect agreement) rather than
+    the agreement of two arbitrary random inits, while the acceptance
+    machinery still runs token-by-token for real."""
+    import jax.numpy as jnp
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+
+    paddle.seed(0)
+    target = GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=8, hidden_size=128, num_heads=4,
+        vocab_size=512, max_seq_len=160))
+    target.eval()
+    for block in list(target.gpt.h)[1:]:
+        for lin in (block.attn.out_proj, block.mlp.fc_out):
+            lin.weight._data_ = jnp.zeros_like(lin.weight._data_)
+            if lin.bias is not None:
+                lin.bias._data_ = jnp.zeros_like(lin.bias._data_)
+    paddle.seed(1)
+    draft = GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=1, hidden_size=128, num_heads=4,
+        vocab_size=512, max_seq_len=160))
+    draft.eval()
+    tgt_params = dict(target.named_parameters())
+    for name, p in draft.named_parameters():
+        p._data_ = tgt_params[name]._data_
+    return target, draft
+
+
+def _run_spec_workload(paddle, args):
+    """Speculative-decoding lane (ISSUE 11): paged engine with a draft
+    model proposing K tokens per iteration vs the same engine decoding
+    one token per step, at batch 1 and 4; plus the int8-KV capacity
+    check (pages-in-use peak at equal token load, quantized vs fp32)."""
+    from paddle_tpu.serving import ServingConfig
+    import jax
+
+    target, draft = _build_spec_models(paddle)
+    K = 8
+    max_new = 16 if args.smoke else 32
+    rng = np.random.default_rng(42)
+    sides = {}
+    mismatches = 0
+    acceptance = None
+    spec_snap = None
+    for batch in (1, 4):
+        prompts = [rng.integers(0, 512, (int(rng.integers(6, 12)),))
+                   .astype("int32") for _ in range(batch)]
+        seq_out, _, _ = _run_sequential(paddle, target, prompts, max_new)
+        base_cfg = ServingConfig(num_slots=batch, max_queue=batch + 1,
+                                 enable_prefix_cache=False)
+        spec_cfg = ServingConfig(num_slots=batch, max_queue=batch + 1,
+                                 enable_prefix_cache=False,
+                                 draft_model=draft, speculation_k=K)
+        # warm both lanes' executables off the clock
+        _run_serving(target, prompts[:1], 2, 0, config=base_cfg)
+        _run_serving(target, prompts[:1], 2, 0, config=spec_cfg)
+        base_out, base_tokens, base_wall, _ = _run_serving(
+            target, prompts, max_new, 0, config=base_cfg)
+        spec_out, spec_tokens, spec_wall, spec_snap = _run_serving(
+            target, prompts, max_new, 0, config=spec_cfg)
+        for o, ref in zip(base_out, seq_out):
+            mismatches += 0 if np.array_equal(o.output_ids, ref) else 1
+        for o, ref in zip(spec_out, seq_out):
+            mismatches += 0 if np.array_equal(o.output_ids, ref) else 1
+        base_tps = base_tokens / base_wall
+        spec_tps = spec_tokens / spec_wall
+        acceptance = spec_snap["spec_acceptance_rate"]
+        sides[f"batch_{batch}"] = {
+            "baseline_tokens_per_sec": base_tps,
+            "spec_tokens_per_sec": spec_tps,
+            "speedup": spec_tps / base_tps,
+            "baseline_wall_s": base_wall, "spec_wall_s": spec_wall,
+            "tokens": spec_tokens,
+            "spec_windows": spec_snap["spec_windows"],
+        }
+
+    # int8 KV capacity: the same token load (page-aligned: 64 positions
+    # per request = 4 fp32 pages or 2 double-width int8 pages) must
+    # ~halve the pages-in-use peak when the pool stores int8
+    int8 = {"tokens_per_request": 64}
+    int8_outs = {}
+    for dtype in ("float32", "int8"):
+        cfg = ServingConfig(num_slots=2, max_queue=4, cache_dtype=dtype,
+                            enable_prefix_cache=False)
+        prompts = [rng.integers(0, 512, (16,)).astype("int32")
+                   for _ in range(2)]
+        outs, _, _, snap = _run_serving(target, prompts, 48, 0,
+                                        config=cfg)
+        int8[f"pages_peak_{dtype}"] = snap["kv_pages_peak"]
+        int8_outs[dtype] = [o.output_ids for o in outs]
+    int8["ratio"] = int8["pages_peak_int8"] / int8["pages_peak_float32"]
+    int8["greedy_mismatches"] = sum(
+        0 if np.array_equal(a, b) else 1
+        for a, b in zip(int8_outs["float32"], int8_outs["int8"]))
+
+    speedups = {k: v["speedup"] for k, v in sides.items()}
+    return {
+        "metric": "serving_speculative_cpu",
+        "value": sides["batch_4"]["spec_tokens_per_sec"],
+        "unit": "tokens_per_sec",
+        "speedups": speedups,
+        "speedup_min": min(speedups.values()),
+        "speculation_k": K,
+        "acceptance_rate": acceptance,
+        "batches": sides,
+        "int8_kv": int8,
+        "max_new_tokens": max_new,
+        "greedy_mismatches": mismatches,
+        "spec_draft_ms_avg": spec_snap["spec_draft_ms_avg"],
+        "spec_verify_ms_avg": spec_snap["spec_verify_ms_avg"],
+        "spec_rollback_ms_avg": spec_snap["spec_rollback_ms_avg"],
+        "smoke": bool(args.smoke),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=16)
@@ -166,14 +290,17 @@ def main():
     ap.add_argument("--smoke", action="store_true",
                     help="CI scale: 6 requests x 12 tokens")
     ap.add_argument("--workload", default="mixed",
-                    choices=("mixed", "prefix"),
+                    choices=("mixed", "prefix", "speculative"),
                     help="mixed: the PR 3 continuous-batching lane; "
                          "prefix: long-context shared-prefix lane "
-                         "(paged vs slot engine at equal cache bytes)")
+                         "(paged vs slot engine at equal cache bytes); "
+                         "speculative: draft-model speculation + int8 "
+                         "KV capacity lane (spec vs plain paged engine "
+                         "at batch 1 and 4)")
     ap.add_argument("--out", default=None,
                     help="result path (default benchmarks/"
-                         "SERVING_BENCH.json or "
-                         "SERVING_PAGED_BENCH.json)")
+                         "SERVING_BENCH.json, SERVING_PAGED_BENCH.json "
+                         "or SERVING_SPEC_BENCH.json)")
     ap.add_argument("--no-write", action="store_true")
     args = ap.parse_args()
     if args.smoke:
@@ -182,6 +309,20 @@ def main():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
     import paddle_tpu as paddle
+
+    if args.workload == "speculative":
+        rec = _run_spec_workload(paddle, args)
+        out_path = args.out or os.path.join(
+            os.path.dirname(__file__), "SERVING_SPEC_BENCH.json")
+        if not args.no_write:
+            with open(out_path, "w") as f:
+                json.dump(rec, f, indent=1)
+            print(f"wrote {out_path}", file=sys.stderr)
+        print(json.dumps({k: rec[k] for k in
+                          ("metric", "value", "speedups",
+                           "acceptance_rate", "greedy_mismatches")}
+                         | {"int8_pages_ratio": rec["int8_kv"]["ratio"]}))
+        return 0 if rec["greedy_mismatches"] == 0 else 1
 
     if args.workload == "prefix":
         rec = _run_prefix_workload(paddle, args)

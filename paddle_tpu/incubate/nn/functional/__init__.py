@@ -281,6 +281,7 @@ def _cache_attend(qa, ck, cv, off, scale):
 
 def paged_masked_multihead_attention(q, k, v, k_pool, v_pool, page_table,
                                      offset, page_size, scale=None,
+                                     k_scale=None, v_scale=None,
                                      name=None):
     """Decode/chunked-prefill attention against a PAGED KV cache
     (serving/paged_kv.py — the vLLM PagedAttention layout kept
@@ -297,16 +298,27 @@ def paged_masked_multihead_attention(q, k, v, k_pool, v_pool, page_table,
     exactly `masked_multihead_attention`'s math — so paged and dense
     caches holding the same values produce bit-identical outputs.
 
+    Quantized KV storage: when ``k_scale``/``v_scale`` ([P, page_size]
+    float32 per-page scale arrays) are passed, the pools hold int8 (or
+    fp8) values.  The write quantizes each new token's [Hkv, D] row
+    with its own scale (`paddle_tpu.quantization.quantize_kv_rows`) and
+    scatters value + scale through the same page table; the read
+    dequantizes fused into the gather (scale × int8 feeds the attention
+    matmul directly), then runs the identical `_cache_attend` math.
+    Returns (out, k_pool', v_pool', k_scale', v_scale') in this mode.
+
     On TPU (or with ``PADDLE_TPU_PAGED_PALLAS=1`` under interpret
     mode) the single-token decode read runs the Pallas kernel
     (`pallas.flash_attention.paged_decode_attention`) that streams
     pages via a scalar-prefetched page table instead of materializing
-    the gather; its online softmax is numerically (not bitwise)
+    the gather (per-page scales ride their own scalar-prefetch-indexed
+    BlockSpec); its online softmax is numerically (not bitwise)
     equivalent, so the XLA gather path stays the default off-TPU.
     """
     import os as _os
 
     psz = int(page_size)
+    quant = k_scale is not None
     s_new = q.shape[1] if hasattr(q, "shape") else 0
     n_pages = page_table.shape[1]
     s_cap = n_pages * psz
@@ -329,28 +341,74 @@ def paged_masked_multihead_attention(q, k, v, k_pool, v_pool, page_table,
                   and (_fa._on_tpu() or
                        (env == "1" and _fa._interpret())))
 
-    def fn(qa, ka, va, kp, vp, pt, off):
+    def fn(qa, ka, va, kp, vp, pt, off, *scales):
+        from ....quantization import dequantize_kv, quantize_kv_rows
         b, s, h_q, d = qa.shape
         off = off.astype(jnp.int32)
         pos = off[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
         page_ids = jnp.take_along_axis(pt.astype(jnp.int32),
                                        pos // psz, axis=1)
         in_page = pos % psz
-        kp = kp.at[page_ids, in_page].set(ka.astype(kp.dtype))
-        vp = vp.at[page_ids, in_page].set(va.astype(vp.dtype))
+        if quant:
+            ks, vs = scales
+            qmax = 127.0 if kp.dtype == jnp.int8 else 448.0
+            qk, sk = quantize_kv_rows(ka, qmax, kp.dtype)
+            qv, sv = quantize_kv_rows(va, qmax, vp.dtype)
+            kp = kp.at[page_ids, in_page].set(qk)
+            vp = vp.at[page_ids, in_page].set(qv)
+            ks = ks.at[page_ids, in_page].set(sk)
+            vs = vs.at[page_ids, in_page].set(sv)
+        else:
+            kp = kp.at[page_ids, in_page].set(ka.astype(kp.dtype))
+            vp = vp.at[page_ids, in_page].set(va.astype(vp.dtype))
         if use_kernel:
             out = _fa.paged_decode_attention(
                 qa[:, 0], kp, vp, pt.astype(jnp.int32), off,
-                scale=scale)[:, None]
+                scale=scale,
+                k_scale=ks if quant else None,
+                v_scale=vs if quant else None)[:, None]
         else:
             h_kv = kp.shape[2]
-            kf = kp[pt].reshape(b, n_pages * psz, h_kv, d)
-            vf = vp[pt].reshape(b, n_pages * psz, h_kv, d)
+            if quant:
+                kf = dequantize_kv(kp[pt], ks[pt]) \
+                    .reshape(b, n_pages * psz, h_kv, d)
+                vf = dequantize_kv(vp[pt], vs[pt]) \
+                    .reshape(b, n_pages * psz, h_kv, d)
+            else:
+                kf = kp[pt].reshape(b, n_pages * psz, h_kv, d)
+                vf = vp[pt].reshape(b, n_pages * psz, h_kv, d)
             out = _cache_attend(qa, kf, vf, off, scale)
+        if quant:
+            return out, kp, vp, ks, vs
         return out, kp, vp
 
-    return apply_op("paged_masked_multihead_attention", fn,
-                    (q, k, v, k_pool, v_pool, page_table, offset))
+    args = (q, k, v, k_pool, v_pool, page_table, offset)
+    if quant:
+        args = args + (k_scale, v_scale)
+    return apply_op("paged_masked_multihead_attention", fn, args)
+
+
+def paged_cache_attention(q, k, v, cache, scale=None):
+    """Attention against one `PagedKVCache` layer dict: dispatches the
+    plain or quantized (int8/fp8, per-page scales) paged op, writes the
+    functionally-updated pools — and scales, when quantized — back into
+    the dict, and returns the attention output.  The single cache-path
+    entry point the model families share, so adding a storage format
+    never touches four attention call sites again."""
+    if cache.get("k_scale") is not None:
+        out, kp, vp, ks, vs = paged_masked_multihead_attention(
+            q, k, v, cache["k_pool"], cache["v_pool"],
+            cache["page_table"], cache["offset"], cache["page_size"],
+            scale=scale, k_scale=cache["k_scale"],
+            v_scale=cache["v_scale"])
+        cache["k_scale"], cache["v_scale"] = ks, vs
+    else:
+        out, kp, vp = paged_masked_multihead_attention(
+            q, k, v, cache["k_pool"], cache["v_pool"],
+            cache["page_table"], cache["offset"], cache["page_size"],
+            scale=scale)
+    cache["k_pool"], cache["v_pool"] = kp, vp
+    return out
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):

@@ -16,10 +16,15 @@ from ..tensor_ops import manipulation as MA
 
 
 def init_kv_caches(num_layers, batch, max_len, num_heads, head_dim,
-                   dtype="float32"):
-    """Per-layer {'k','v','offset'} cache dicts ([B, max_len, H, D])."""
+                   dtype="float32", per_row_offsets=False):
+    """Per-layer {'k','v','offset'} cache dicts ([B, max_len, H, D]).
+
+    ``per_row_offsets=True`` makes the offset an int32 [B] vector (one
+    clock per row — the serving-slot/speculative-decoding shape, where
+    rows advance unevenly) instead of the shared scalar."""
     caches = []
-    offset = creation.zeros([], dtype="int32")
+    offset = creation.zeros([batch] if per_row_offsets else [],
+                            dtype="int32")
     for _ in range(num_layers):
         caches.append({
             "k": creation.zeros([batch, max_len, num_heads, head_dim],
@@ -204,6 +209,141 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
             nxt = tracker.force(nxt)
         pieces.append(MA.reshape(nxt, [b, 1]))
         return MA.concat(pieces, axis=1)
+
+
+def speculative_generate(model, draft_model, input_ids,
+                         max_new_tokens=32, speculation_k=4,
+                         eos_token_id=None):
+    """Greedy draft-model speculative decoding (Leviathan et al.):
+    the small `draft_model` proposes K tokens per window, `model`
+    verifies all K+1 positions in ONE batched call, and the leading
+    run of proposals matching the target's argmaxes is accepted plus
+    the bonus token after it.  Every emitted token is a target-model
+    greedy argmax, so outputs match `generate(..., temperature=0.0)`;
+    the draft only decides how many tokens each window yields.
+
+    Both models keep dense KV caches with per-row int32 offset vectors
+    (rows accept different amounts, so each row has its own clock); a
+    rejected tail needs no cache surgery — rewinding the offset masks
+    it causally and the next window overwrites it.  K/V capacity
+    carries `speculation_k` positions of headroom for the verify
+    window's overshoot; positions past the accept boundary are never
+    attended by an accepted prediction, so the overshoot is inert.
+
+    `speculation_k=0` is exactly `generate` (greedy).  Returns
+    [B, S + n] ids; with `eos_token_id`, finished rows pad with eos
+    like `generate` and decoding stops when every row finished."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    from ..tensor_ops import search as S
+
+    K = int(speculation_k)
+    if K <= 0:
+        return generate(model, input_ids, max_new_tokens=max_new_tokens,
+                        temperature=0.0, eos_token_id=eos_token_id)
+    cfg = model.config
+    dcfg = draft_model.config
+    b, s = input_ids.shape
+    max_len = min(cfg.max_seq_len, s + max_new_tokens)
+    n_new = max_len - s
+    if n_new <= 0:
+        return input_ids
+    if dcfg.vocab_size != cfg.vocab_size:
+        raise ValueError(f"draft vocab {dcfg.vocab_size} != target "
+                         f"vocab {cfg.vocab_size}")
+    cap = max_len + K
+    kv_t = getattr(cfg, "num_kv_heads", cfg.num_heads)
+    kv_d = getattr(dcfg, "num_kv_heads", dcfg.num_heads)
+
+    def _argmax_np(logits):
+        return np.asarray(S.argmax(logits, axis=-1)._data_)
+
+    with no_grad():
+        caches = init_kv_caches(cfg.num_layers, b, cap, kv_t,
+                                cfg.head_dim, per_row_offsets=True)
+        d_caches = init_kv_caches(dcfg.num_layers, b, cap, kv_d,
+                                  dcfg.head_dim, per_row_offsets=True)
+
+        def set_offsets(cs, off_np):
+            off_t = Tensor(np.asarray(off_np, np.int32))
+            for c in cs:
+                c["offset"] = off_t
+
+        ids_np = np.asarray(input_ids._data_, np.int32)
+        logits = model(input_ids, caches=caches)          # prefill
+        draft_model(input_ids, caches=d_caches)
+        off = np.full(b, s, np.int32)          # target rows' clocks
+        d_off = np.full(b, s, np.int32)        # draft rows' clocks
+        set_offsets(caches, off)
+        set_offsets(d_caches, d_off)
+        first = _argmax_np(logits[:, -1, :])
+        rows = [[int(first[r])] for r in range(b)]
+        last = first.astype(np.int32)
+        done = np.zeros(b, bool)
+        if eos_token_id is not None:
+            done |= first == eos_token_id
+
+        def known(r, pos):
+            return int(ids_np[r, pos]) if pos < s \
+                else rows[r][pos - s]
+
+        while not done.all() and any(len(t) < n_new for t in rows):
+            # --- draft K proposer steps (teacher-forced catch-up) ---
+            prev = last.copy()
+            d_out = [[] for _ in range(b)]
+            d_start = d_off.copy()
+            for j in range(K):
+                tok_in = np.zeros((b, 1), np.int32)
+                for r in range(b):
+                    p = int(d_start[r]) + j
+                    tok_in[r, 0] = known(r, p) if p <= off[r] \
+                        else prev[r]
+                set_offsets(d_caches, d_start + j)
+                dl = draft_model(Tensor(tok_in), caches=d_caches)
+                step = _argmax_np(dl[:, -1, :])
+                for r in range(b):
+                    prev[r] = int(step[r])
+                    d_out[r].append(int(step[r]))
+            # --- one batched verify of [last, d_1..d_K] ---
+            tok_in = np.zeros((b, K + 1), np.int32)
+            caps_row = np.zeros(b, np.int32)
+            for r in range(b):
+                lag = int(off[r] - d_start[r])
+                caps_row[r] = max(0, K - lag)
+                tok_in[r, 0] = last[r]
+                for i in range(1, K + 1):
+                    tok_in[r, i] = d_out[r][lag + i - 1] \
+                        if i <= caps_row[r] else last[r]
+            set_offsets(caches, off)
+            t = _argmax_np(model(Tensor(tok_in), caches=caches))
+            # --- accept runs + per-row offset rewind ---
+            for r in range(b):
+                if done[r]:
+                    continue
+                a = 0
+                while a < caps_row[r] and tok_in[r, a + 1] == t[r, a]:
+                    a += 1
+                for i in range(a + 1):
+                    if len(rows[r]) >= n_new or done[r]:
+                        break
+                    tok = int(t[r, i])
+                    rows[r].append(tok)
+                    last[r] = tok
+                    off[r] += 1
+                    d_off[r] = min(d_start[r] + K, off[r])
+                    if eos_token_id is not None and \
+                            tok == eos_token_id:
+                        done[r] = True
+            done |= np.array([len(t) >= n_new for t in rows])
+
+    width = max(len(t) for t in rows)
+    pad = eos_token_id if eos_token_id is not None else 0
+    out = np.full((b, width), pad, ids_np.dtype)
+    for r, toks in enumerate(rows):
+        out[r, :len(toks)] = toks
+        if eos_token_id is None and len(toks) < width:
+            out[r, len(toks):] = toks[-1]      # unreachable: no-eos
+    return MA.concat([input_ids, Tensor(out)], axis=1)
 
 
 def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,

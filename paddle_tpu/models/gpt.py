@@ -88,12 +88,9 @@ class GPTAttention(Layer):
             from ..incubate.nn import functional as IF
             if "page_table" in cache:
                 # paged serving cache: K/V live in a shared page pool
+                # (plain or int8/fp8-quantized with per-page scales)
                 # addressed through this row's page table
-                out, cache["k_pool"], cache["v_pool"] = \
-                    IF.paged_masked_multihead_attention(
-                        q, k, v, cache["k_pool"], cache["v_pool"],
-                        cache["page_table"], cache["offset"],
-                        cache["page_size"])
+                out = IF.paged_cache_attention(q, k, v, cache)
             else:
                 out, cache["k"], cache["v"] = IF.masked_multihead_attention(
                     q, k, v, cache["k"], cache["v"], cache["offset"])

@@ -109,11 +109,7 @@ class ParallelGPTAttention(Layer):
             # replica id hosts every shard behind one engine
             from ..incubate.nn import functional as IF
             if "page_table" in cache:
-                out, cache["k_pool"], cache["v_pool"] = \
-                    IF.paged_masked_multihead_attention(
-                        q, k, v, cache["k_pool"], cache["v_pool"],
-                        cache["page_table"], cache["offset"],
-                        cache["page_size"])
+                out = IF.paged_cache_attention(q, k, v, cache)
             else:
                 out, cache["k"], cache["v"] = \
                     IF.masked_multihead_attention(

@@ -109,11 +109,7 @@ class LlamaAttention(Layer):
             # cache stores PRE-repeat K/V (num_kv_heads) — the MMHA op
             # groups Q heads natively, so GQA keeps its memory win
             if "page_table" in cache:
-                out, cache["k_pool"], cache["v_pool"] = \
-                    IF.paged_masked_multihead_attention(
-                        q, k, v, cache["k_pool"], cache["v_pool"],
-                        cache["page_table"], cache["offset"],
-                        cache["page_size"])
+                out = IF.paged_cache_attention(q, k, v, cache)
             else:
                 out, cache["k"], cache["v"] = IF.masked_multihead_attention(
                     q, k, v, cache["k"], cache["v"], cache["offset"])

@@ -93,11 +93,7 @@ class ParallelLlamaAttention(Layer):
             q, k, _ = IF.fused_rotary_position_embedding(
                 q, k, position_ids=pos, rotary_emb_base=cfg.rope_theta)
             if "page_table" in cache:
-                out, cache["k_pool"], cache["v_pool"] = \
-                    IF.paged_masked_multihead_attention(
-                        q, k, v, cache["k_pool"], cache["v_pool"],
-                        cache["page_table"], cache["offset"],
-                        cache["page_size"])
+                out = IF.paged_cache_attention(q, k, v, cache)
             else:
                 out, cache["k"], cache["v"] = \
                     IF.masked_multihead_attention(

@@ -732,8 +732,8 @@ def autotune_blocks(s, d, dtype=jnp.bfloat16, batch=1, heads=1):
 # serving engine's paged KV cache (serving/paged_kv.py)
 # ------------------------------------------------------------------
 
-def _paged_decode_kernel(pt_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr, *, scale, page_size):
+def _paged_decode_kernel(pt_ref, off_ref, q_ref, k_ref, v_ref, *rest,
+                         scale, page_size, quant):
     """One (batch, kv_head, page) step of a single-token decode.
 
     The page axis is innermost: scratch (m, l, acc) carries the online
@@ -741,9 +741,16 @@ def _paged_decode_kernel(pt_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
     was decided by the BlockSpec index map from the scalar-prefetched
     page table — the kernel body only sees the already-gathered block.
     Pages past the row's offset are skipped (their fetch is clamped to
-    the last live page, so Mosaic dedupes the DMA)."""
+    the last live page, so Mosaic dedupes the DMA).  Quantized pools
+    (int8/fp8) arrive with per-page [page_size, 1] scale blocks fetched
+    through the same index map; the dequant multiply fuses into the
+    block's dot."""
     from jax.experimental import pallas as pl
 
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     b = pl.program_id(0)
     j = pl.program_id(2)
     num_pages = pl.num_programs(2)
@@ -762,6 +769,9 @@ def _paged_decode_kernel(pt_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
         qf = q_ref[:].astype(jnp.float32)       # [n_rep, d]
         kf = k_ref[:].astype(jnp.float32)       # [page_size, d]
         vf = v_ref[:].astype(jnp.float32)
+        if quant:
+            kf = kf * ks_ref[:]                 # [page_size, 1] scales
+            vf = vf * vs_ref[:]
         s = jax.lax.dot_general(
             qf, kf, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -784,13 +794,17 @@ def _paged_decode_kernel(pt_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_decode_attention(q, k_pool, v_pool, page_table, offsets,
-                           scale=None):
+                           scale=None, k_scale=None, v_scale=None):
     """Single-token decode attention over a paged KV cache.
 
     q: [B, H, D] this step's queries; k_pool/v_pool: [P, page_size,
     H_kv, D] physical page pools; page_table: int32 [B, N] logical →
     physical page map; offsets: int32 [B] — row b attends positions
-    <= offsets[b] (its freshly written token included).
+    <= offsets[b] (its freshly written token included).  With
+    ``k_scale``/``v_scale`` ([P, page_size] float32) the pools hold
+    int8/fp8 values; each page's scale block streams in through the
+    same scalar-prefetched index map and the dequant multiply fuses
+    into the page's dot — K/V cross HBM at the quantized width.
 
     The page table and offsets ride ``PrefetchScalarGridSpec`` scalar
     prefetch, so the K/V BlockSpec index maps dereference them to pick
@@ -808,6 +822,7 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, offsets,
     n_rep = h // h_kv
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
     qg = q.reshape(b, h_kv, n_rep, d)
+    quant = k_scale is not None
 
     def q_index(bi, hi, j, pt, off):
         return (bi, hi, 0, 0)
@@ -818,26 +833,38 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, offsets,
         j_live = jnp.minimum(j, off[bi] // psz)
         return (pt[bi, j_live], 0, hi, 0)
 
+    def scale_index(bi, hi, j, pt, off):
+        j_live = jnp.minimum(j, off[bi] // psz)
+        return (pt[bi, j_live], 0, 0)
+
     q_spec = pl.BlockSpec((None, None, n_rep, d), q_index)
     kv_spec = pl.BlockSpec((None, psz, None, d), kv_index)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qg, k_pool, v_pool]
+    if quant:
+        sc_spec = pl.BlockSpec((None, psz, 1), scale_index)
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale.reshape(k_scale.shape[0], psz, 1),
+                     v_scale.reshape(v_scale.shape[0], psz, 1)]
     kernel = functools.partial(_paged_decode_kernel, scale=sc,
-                               page_size=psz)
+                               page_size=psz, quant=quant)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, h_kv, n_pages),
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((n_rep, 1), jnp.float32),
                         pltpu.VMEM((n_rep, 1), jnp.float32),
                         pltpu.VMEM((n_rep, d), jnp.float32)])
+    out_dtype = q.dtype if not quant else jnp.float32
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h_kv, n_rep, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, n_rep, d), out_dtype),
         interpret=_interpret(),
     )(page_table.astype(jnp.int32), offsets.astype(jnp.int32),
-      qg, k_pool, v_pool)
-    return out.reshape(b, h, d)
+      *operands)
+    return out.reshape(b, h, d).astype(q.dtype)
 
 
 def _supports_pallas(q, k, v, attn_mask, segment_ids):

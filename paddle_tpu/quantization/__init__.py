@@ -261,6 +261,63 @@ class PTQ:
 
 
 # ------------------------------------------------------------------
+# Quantized KV-cache storage (serving/paged_kv.py cache_dtype="int8" /
+# "fp8").  Each cached token position keeps one float32 scale covering
+# its [H, D] row, stored alongside the page ([P, page_size] scale
+# arrays): a write never needs to re-quantize older tokens (their
+# scales are theirs alone), and the read dequantizes inside the same
+# fused program as the attention gather, so K/V cross HBM at 1/4 (int8
+# vs fp32) the bytes.  fp8 (e4m3) rides the same machinery with
+# qmax=448 and a cast instead of round — "fp8-ready" on backends whose
+# jax exposes float8_e4m3fn.
+# ------------------------------------------------------------------
+
+#: cache_dtype name -> (storage jnp dtype, symmetric quant range max)
+KV_QUANT_DTYPES = {"int8": (jnp.int8, 127.0)}
+if hasattr(jnp, "float8_e4m3fn"):
+    KV_QUANT_DTYPES["fp8"] = (jnp.float8_e4m3fn, 448.0)
+
+
+def kv_quant_params(cache_dtype):
+    """(storage dtype, qmax) for a quantized KV ``cache_dtype``, or None
+    when the dtype is an ordinary float type.  Unknown/unsupported quant
+    names raise (fp8 on a jax without float8 support must fail loudly,
+    never silently store garbage)."""
+    if cache_dtype in KV_QUANT_DTYPES:
+        return KV_QUANT_DTYPES[cache_dtype]
+    if cache_dtype in ("fp8", "float8_e4m3fn"):
+        raise ValueError(
+            "cache_dtype='fp8' needs jax.numpy.float8_e4m3fn, which this "
+            "jax build does not expose")
+    return None
+
+
+def quantize_kv_rows(x, qmax, storage_dtype):
+    """Per-token-row symmetric quantization of new K/V values.
+
+    x: float [..., H, D]; the scale covers the trailing [H, D] row (one
+    scale per token position).  Returns (q[..., H, D] storage_dtype,
+    scale[...] float32) with ``q * scale ≈ x``.  Pure jnp — runs inside
+    the jitted attention program, where XLA fuses quant into the cache
+    scatter."""
+    xf = jnp.asarray(x, jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+    scale = jnp.maximum(absmax / qmax, 1e-12)
+    scaled = xf / scale[..., None, None]
+    if storage_dtype == jnp.int8:
+        q = jnp.clip(jnp.round(scaled), -qmax, qmax).astype(jnp.int8)
+    else:                           # fp8: the cast IS the rounding
+        q = jnp.clip(scaled, -qmax, qmax).astype(storage_dtype)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_kv(q, scale):
+    """Inverse of `quantize_kv_rows`: q[..., H, D] × scale[...] → f32.
+    XLA fuses this into the consuming attention matmul/gather."""
+    return q.astype(jnp.float32) * scale[..., None, None]
+
+
+# ------------------------------------------------------------------
 # True-int8 dynamic inference (reference capability: int8 predict with
 # activation quantization — analysis_predictor.h:94 TRT/mkldnn int8
 # modes).  TPU-native: int8×int8 dot_general accumulating int32 runs on

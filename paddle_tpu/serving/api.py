@@ -100,7 +100,14 @@ class ServingConfig:
     deadline_policy          "evict": a request past its deadline_s is
                              failed and its slot freed; "ignore":
                              deadlines are recorded but never enforced
-    cache_dtype              KV-cache element type
+    cache_dtype              KV-cache element type.  "int8" (or "fp8"
+                             on jax builds with float8) stores paged
+                             K/V quantized with per-page scale arrays
+                             and a dequant-fused read; each quantized
+                             page packs 2x page_size tokens in half the
+                             baseline page's bytes, so the pages-in-use
+                             gauge at equal token load ~halves (paged
+                             layout only)
     idle_wait_s              scheduler sleep when no work is queued
     drain_grace_s            `drain()` deadline when none is passed: how
                              long in-flight slots may run on before the
@@ -133,6 +140,21 @@ class ServingConfig:
                              decode steps, so a long prompt cannot
                              starve in-flight streams (paged layout;
                              one compiled prefill program total)
+    draft_model              small proposer model for speculative
+                             decoding (same tokenizer/vocab as the
+                             target; its config.max_seq_len must cover
+                             max_seq_len).  None (default) = no
+                             speculation
+    speculation_k            draft tokens proposed per slot per
+                             scheduler iteration; the target model
+                             verifies all K+1 positions in ONE batched
+                             call and an accept-mask rollback rewinds
+                             the rejected tail (paged layout only;
+                             0 = off — the decode loop is bitwise the
+                             plain one).  Speculation engages when
+                             every active request is greedy without
+                             repetition penalty; mixed batches fall
+                             back to the plain step for that iteration
     """
 
     num_slots: int = 4
@@ -151,6 +173,8 @@ class ServingConfig:
     kv_pool_pages: int | None = None
     enable_prefix_cache: bool = True
     prefill_chunk_tokens: int = 32
+    draft_model: object | None = None
+    speculation_k: int = 0
 
     def validate(self):
         if self.num_slots < 1:
@@ -184,6 +208,24 @@ class ServingConfig:
         if self.max_scheduler_restarts < 0:
             raise ValueError(f"max_scheduler_restarts must be >= 0, "
                              f"got {self.max_scheduler_restarts}")
+        from ..quantization import kv_quant_params
+        if kv_quant_params(self.cache_dtype) is not None and \
+                self.kv_layout != "paged":
+            raise ValueError(
+                f"cache_dtype={self.cache_dtype!r} (quantized KV with "
+                "per-page scales) requires kv_layout='paged'")
+        if self.speculation_k < 0:
+            raise ValueError(f"speculation_k must be >= 0, got "
+                             f"{self.speculation_k}")
+        if self.speculation_k > 0:
+            if self.draft_model is None:
+                raise ValueError(
+                    "speculation_k > 0 needs a draft_model to propose "
+                    "tokens; pass ServingConfig(draft_model=...)")
+            if self.kv_layout != "paged":
+                raise ValueError(
+                    "speculative decoding requires kv_layout='paged' "
+                    "(accept-mask rollback is a page-table/offset move)")
         return self
 
 

@@ -41,7 +41,8 @@ class _Request:
     __slots__ = ("id", "prompt", "max_new_tokens", "sampling",
                  "eos_token_id", "deadline", "future", "submit_t",
                  "ttft_ms", "tokens", "seen", "last_token", "slot",
-                 "prefill_pos", "shared_len", "prefix_nodes")
+                 "prefill_pos", "shared_len", "prefix_nodes",
+                 "draft_prefill_pos", "first_tok")
 
     def __init__(self, rid, prompt, max_new_tokens, sampling,
                  eos_token_id, deadline):
@@ -61,6 +62,8 @@ class _Request:
         self.prefill_pos = 0        # next prompt token to prefill (paged)
         self.shared_len = 0         # prompt tokens reused from the tree
         self.prefix_nodes = []      # tree nodes this request references
+        self.draft_prefill_pos = 0  # draft-model prefill progress (spec)
+        self.first_tok = None       # sampled first token awaiting draft
 
 
 class Engine:
@@ -78,6 +81,32 @@ class Engine:
         self.max_len = self.scfg.max_seq_len or self.cfg.max_seq_len
         self._kv_heads = getattr(self.cfg, "num_kv_heads",
                                  self.cfg.num_heads)
+        from ..quantization import kv_quant_params
+        self._quant = kv_quant_params(self.scfg.cache_dtype) is not None
+        # a quantized page packs 2x the baseline page's tokens in half
+        # its bytes: the pages-in-use gauge at equal token load ~halves
+        # and the pool's byte budget stretches (docs/SERVING.md)
+        self._page_size = self.scfg.page_size * (2 if self._quant else 1)
+        self._spec_k = int(self.scfg.speculation_k)
+        self._spec = bool(self.scfg.kv_layout == "paged"
+                          and self._spec_k > 0
+                          and self.scfg.draft_model is not None)
+        if self._spec:
+            draft = self.scfg.draft_model
+            if hasattr(draft, "eval"):
+                draft.eval()
+            dcfg = draft.config
+            if dcfg.max_seq_len < self.max_len:
+                raise ValueError(
+                    f"draft_model.config.max_seq_len {dcfg.max_seq_len} "
+                    f"< serving max_seq_len {self.max_len}; the draft "
+                    "must cover every position it proposes for")
+            if dcfg.vocab_size != self.cfg.vocab_size:
+                raise ValueError(
+                    f"draft_model vocab {dcfg.vocab_size} != target "
+                    f"vocab {self.cfg.vocab_size}")
+        self.draft_cache = None
+        self._pages_peak = 0
         self._queue: deque[_Request] = deque()
         self._active: dict[int, _Request] = {}
         # requests holding a slot whose prompt is mid-(chunked-)prefill
@@ -136,21 +165,39 @@ class Engine:
         return self
 
     def _new_cache(self):
-        """Fresh KV storage (and prefix tree) for a (re)started loop."""
+        """Fresh KV storage (and prefix tree, and the draft model's
+        mirror cache when speculating) for a (re)started loop."""
         if self._paged:
             from .paged_kv import PagedKVCache, PrefixTree
+            # +speculation_k positions of headroom: a verify window may
+            # write K tokens past the last real position before the
+            # accept-mask rollback rewinds them
             cache = PagedKVCache(
-                self.cfg.num_layers, self.scfg.num_slots, self.max_len,
+                self.cfg.num_layers, self.scfg.num_slots,
+                self.max_len + self._spec_k,
                 self._kv_heads, self.cfg.head_dim,
-                page_size=self.scfg.page_size,
+                page_size=self._page_size,
                 num_pages=self.scfg.kv_pool_pages,
                 dtype=self.scfg.cache_dtype)
-            self.prefix_tree = PrefixTree(self.scfg.page_size) \
+            self.prefix_tree = PrefixTree(self._page_size) \
                 if self.scfg.enable_prefix_cache else None
             # one compiled prefill program: every chunk is this wide
             self._chunk = min(self.scfg.prefill_chunk_tokens,
                               cache.capacity)
             self._prefilling.clear()
+            self._pages_peak = 0
+            if self._spec:
+                dcfg = self.scfg.draft_model.config
+                # full preallocation for the small draft model: prefix
+                # pages are never shared into the draft cache (the
+                # draft prefills the whole prompt itself), so its pool
+                # must never be the admission bottleneck
+                self.draft_cache = PagedKVCache(
+                    dcfg.num_layers, self.scfg.num_slots,
+                    self.max_len + self._spec_k,
+                    getattr(dcfg, "num_kv_heads", dcfg.num_heads),
+                    dcfg.head_dim, page_size=self._page_size,
+                    num_pages=None, dtype=self.scfg.cache_dtype)
             return cache
         return SlotKVCache(
             self.cfg.num_layers, self.scfg.num_slots, self.max_len,
@@ -266,10 +313,12 @@ class Engine:
         if self._paged:
             # infeasible requests are rejected up front: admission
             # backpressure only helps when the pool could EVER fit it
-            psz = self.scfg.page_size
+            psz = self._page_size
             pool = self.scfg.kv_pool_pages or \
-                self.scfg.num_slots * (-(-self.max_len // psz))
-            need = -(-min(prompt.size + max_new, self.max_len) // psz)
+                self.scfg.num_slots * \
+                (-(-(self.max_len + self._spec_k) // psz))
+            need = -(-(min(prompt.size + max_new, self.max_len)
+                       + self._spec_k) // psz)
             if need > pool:
                 raise ValueError(
                     f"request needs {need} KV pages (prompt "
@@ -395,7 +444,10 @@ class Engine:
                     for req, slot in admits:
                         self._prefill(req, slot)
                 if self._active:
-                    self._decode_step()
+                    if self._can_speculate():
+                        self._spec_step()
+                    else:
+                        self._decode_step()
                 if self._paged:
                     self._publish_pool_stats()
                 self._iter_deadline = None
@@ -484,8 +536,11 @@ class Engine:
         zero-ref tree pages under pool pressure.  Returns the slot, or
         None when the pool cannot promise the pages yet (the request
         stays queued: backpressure, never a crash)."""
-        psz = self.scfg.page_size
-        total = min(req.prompt.size + req.max_new_tokens, self.max_len)
+        psz = self._page_size
+        # +speculation_k: the verify window may write past the last
+        # real token before rollback, so the reservation covers it
+        total = min(req.prompt.size + req.max_new_tokens, self.max_len) \
+            + self._spec_k
         nodes, pages = [], []
         if self.prefix_tree is not None:
             nodes, pages = self.prefix_tree.match(req.prompt)
@@ -500,6 +555,16 @@ class Engine:
             if nodes:
                 self.prefix_tree.release(nodes)
             return None
+        if self._spec:
+            # mirror the slot in the draft cache: same free-slot stack
+            # discipline on both sides keeps the indices identical, and
+            # the draft pool is fully preallocated so this cannot fail
+            dslot = self.draft_cache.allocate(
+                self.draft_cache.pages_per_slot)
+            if dslot != slot:       # pragma: no cover - invariant
+                raise RuntimeError(
+                    f"draft cache slot {dslot} diverged from target "
+                    f"slot {slot}")
         if self.prefix_tree is not None:
             stats.incr("prefix_cache_hits" if pages
                        else "prefix_cache_misses")
@@ -512,10 +577,15 @@ class Engine:
     def _start_prefill(self, req, slot):
         """Arm chunked prefill: the slot's clock starts at the shared
         prefix length — those tokens' KV pages came from the tree and
-        are never recomputed."""
+        are never recomputed.  The draft model (speculation) always
+        prefills from 0: shared pages belong to the TARGET cache."""
         req.slot = slot
         req.prefill_pos = req.shared_len
+        req.first_tok = None
         self.cache.set_offset(slot, req.shared_len)
+        if self._spec:
+            req.draft_prefill_pos = 0
+            self.draft_cache.set_offset(slot, 0)
         self._prefilling.append(req)
 
     def _prefill_round(self):
@@ -548,57 +618,235 @@ class Engine:
             return
         reqs = list(self._prefilling)       # each holds a slot: <= B
         chunk = self._chunk
-        cap = self.cache.capacity
-        tokens = np.zeros((self.cache.num_slots, chunk), np.int32)
+        tgt = [r for r in reqs if r.prefill_pos < r.prompt.size]
+        if tgt:
+            logits, starts = self._prefill_chunk_call(
+                self.model, self.cache, tgt,
+                [r.prefill_pos for r in tgt])
+            for row, req in enumerate(tgt):
+                plen = req.prompt.size
+                start = starts[row]
+                req.prefill_pos = min(start + chunk, plen)
+                self.cache.set_offset(req.slot, req.prefill_pos)
+                if req.prefill_pos < plen:
+                    continue
+                # prompt fully cached: sample the first token from the
+                # last REAL position of this row's chunk
+                if req.sampling.uses_penalty:
+                    seen = np.zeros(self.cfg.vocab_size, bool)
+                    seen[req.prompt] = True
+                    req.seen = seen
+                req.first_tok = self._sample_row(
+                    logits[row:row + 1, plen - 1 - start, :], req)
+                req.ttft_ms = (time.monotonic() - req.submit_t) * 1e3
+                stats.observe("ttft_ms", req.ttft_ms)
+                stats.incr("prefill_steps")
+                if self.prefix_tree is not None:
+                    self.prefix_tree.insert(req.prompt, self.cache,
+                                            req.slot, req.prefix_nodes)
+        if self._spec:
+            # the draft model's own chunked prefill, same cadence: its
+            # cache must hold the whole prompt before the request can
+            # decode speculatively (no shared pages on the draft side)
+            dr = [r for r in reqs if r.draft_prefill_pos
+                  < r.prompt.size]
+            if dr:
+                _, dstarts = self._prefill_chunk_call(
+                    self.scfg.draft_model, self.draft_cache, dr,
+                    [r.draft_prefill_pos for r in dr])
+                for row, req in enumerate(dr):
+                    req.draft_prefill_pos = min(
+                        dstarts[row] + chunk, req.prompt.size)
+                    self.draft_cache.set_offset(req.slot,
+                                                req.draft_prefill_pos)
+        # activate when every cache the request decodes against is
+        # ready (target always; draft too when speculating)
+        for req in reqs:
+            if req.prefill_pos < req.prompt.size or req.first_tok is None:
+                continue
+            if self._spec and req.draft_prefill_pos < req.prompt.size:
+                continue
+            self._prefilling.remove(req)
+            self._active[req.slot] = req
+            tok, req.first_tok = req.first_tok, None
+            self._append_token(req, tok)
+        stats.set_value("active_slots", len(self._active))
+
+    def _prefill_chunk_call(self, model, cache, reqs, offs):
+        """One batched `[num_slots, chunk]` prefill-chunk call of
+        `model` against `cache` for `reqs` at per-request progress
+        `offs`; returns (logits, starts)."""
+        from ..core.tensor import Tensor
+        from ..profiler import RecordEvent
+        chunk = self._chunk
+        cap = cache.capacity
+        tokens = np.zeros((cache.num_slots, chunk), np.int32)
         starts = []
-        for row, req in enumerate(reqs):
-            off = req.prefill_pos
+        for row, (req, off) in enumerate(zip(reqs, offs)):
             start = min(off, cap - chunk)
             seg = req.prompt[start:min(start + chunk, req.prompt.size)]
             tokens[row, :seg.size] = seg
             new_real = min(start + chunk, req.prompt.size) - off
-            self.cache.ensure_capacity(req.slot, off + new_real - 1)
+            cache.ensure_capacity(req.slot, off + new_real - 1)
             starts.append(start)
         t0 = time.monotonic()
         with RecordEvent("serving::prefill",
                          args={"request_ids": [r.id for r in reqs]}):
-            views = self.cache.prefill_view([r.slot for r in reqs],
-                                            starts)
-            logits = self.model(Tensor(tokens), caches=views)
-            self.cache.absorb_view(views)
+            views = cache.prefill_view([r.slot for r in reqs], starts)
+            logits = model(Tensor(tokens), caches=views)
+            cache.absorb_view(views)
         dt_ms = (time.monotonic() - t0) * 1e3
         stats.observe("prefill_chunk_ms", dt_ms)
         stats.observe("prefill_ms", dt_ms)
         stats.incr("prefill_chunks", len(reqs))
-        for row, req in enumerate(reqs):
-            plen = req.prompt.size
-            start = starts[row]
-            req.prefill_pos = min(start + chunk, plen)
-            self.cache.set_offset(req.slot, req.prefill_pos)
-            if req.prefill_pos < plen:
-                continue
-            # prompt fully cached: sample the first token from the
-            # last REAL position of this row's chunk
-            self._prefilling.remove(req)
-            if req.sampling.uses_penalty:
-                seen = np.zeros(self.cfg.vocab_size, bool)
-                seen[req.prompt] = True
-                req.seen = seen
-            tok = self._sample_row(
-                logits[row:row + 1, plen - 1 - start, :], req)
-            req.ttft_ms = (time.monotonic() - req.submit_t) * 1e3
-            stats.observe("ttft_ms", req.ttft_ms)
-            stats.incr("prefill_steps")
-            if self.prefix_tree is not None:
-                self.prefix_tree.insert(req.prompt, self.cache,
-                                        req.slot, req.prefix_nodes)
-            self._active[req.slot] = req
-            self._append_token(req, tok)
-        stats.set_value("active_slots", len(self._active))
+        return logits, starts
 
     def _publish_pool_stats(self):
-        stats.set_value("kv_pages_in_use", self.cache.pages_in_use)
+        in_use = self.cache.pages_in_use
+        self._pages_peak = max(self._pages_peak, in_use)
+        stats.set_value("kv_pages_in_use", in_use)
         stats.set_value("kv_pages_free", self.cache.free_page_count)
+        stats.set_value("kv_pages_peak", self._pages_peak)
+
+    # ---------------- speculative decoding (speculation_k > 0) ----------------
+    def _can_speculate(self):
+        """Speculation engages when every active request samples greedily
+        without repetition penalty (accept = exact argmax match) and the
+        verify window's K+1 writes fit every slot's table; otherwise this
+        iteration takes the plain decode step — the draft's catch-up
+        machinery (`_known_token` teacher forcing) absorbs the lag."""
+        if not self._spec:
+            return False
+        K = self._spec_k
+        for req in self._active.values():
+            sp = req.sampling
+            if not sp.greedy or sp.uses_penalty:
+                return False
+            if int(self.cache.offsets[req.slot]) + K >= \
+                    self.cache.capacity:
+                return False
+        return True
+
+    @staticmethod
+    def _known_token(req, pos):
+        """The true token at `pos` of a request's sequence (prompt +
+        emitted tokens) — teacher-forcing input for draft positions the
+        engine has already committed."""
+        if pos < req.prompt.size:
+            return int(req.prompt[pos])
+        return int(req.tokens[pos - req.prompt.size])
+
+    def _spec_step(self):
+        """One speculative window over the continuous batch:
+
+        1. **draft** — K `[num_slots, 1]` steps of the draft model on
+           its mirror cache propose K tokens per slot.  Positions the
+           engine already knows (draft lagging after a bonus token or a
+           plain-step fallback) are teacher-forced, so the draft
+           re-converges instead of compounding stale guesses.
+        2. **verify** — ONE `[num_slots, K+1]` target-model call scores
+           `[last_token, d_1..d_K]`; its K+1 greedy argmaxes are the
+           true next tokens at every window position.
+        3. **accept + rollback** — per slot, the leading run of drafts
+           matching the target is accepted plus the bonus token after
+           it (a+1 tokens per window).  Offsets move to the accept
+           boundary and `PagedKVCache.rollback` returns pages wholly
+           past the new horizon — rejected K/V beyond it stays as
+           scratch (causally masked, overwritten before exposure).
+
+        Static shapes throughout: the draft step, the verify call, and
+        the rollback (pointer/offset moves) never depend on how many
+        tokens were accepted."""
+        from ..core.tensor import Tensor
+        from ..profiler import RecordEvent
+        from ..tensor_ops import search as S
+        K = self._spec_k
+        ns = self.cache.num_slots
+        active = dict(self._active)
+        n_active = len(active)
+        self._max_active = max(self._max_active, n_active)
+        stats.set_value("max_active_slots", self._max_active)
+        rids = sorted(r.id for r in active.values())
+        tgt_off = {s: int(self.cache.offsets[s]) for s in active}
+        d_off0 = {s: int(self.draft_cache.offsets[s]) for s in active}
+
+        # --- draft: K proposer steps on the mirror cache ---
+        t0 = time.monotonic()
+        prev_out = {s: 0 for s in active}
+        draft_out = {s: [] for s in active}
+        with RecordEvent("serving::spec_draft",
+                         args={"request_ids": rids}):
+            for j in range(K):
+                tok_in = np.zeros((ns, 1), np.int32)
+                for s, req in active.items():
+                    p = d_off0[s] + j
+                    tok_in[s, 0] = self._known_token(req, p) \
+                        if p <= tgt_off[s] else prev_out[s]
+                    self.draft_cache.ensure_capacity(s, p)
+                logits = self.scfg.draft_model(
+                    Tensor(tok_in), caches=self.draft_cache.layer_caches())
+                self.draft_cache.advance(active.keys())
+                toks = np.asarray(
+                    S.argmax(logits[:, -1, :], axis=-1)._data_)
+                for s in active:
+                    prev_out[s] = int(toks[s])
+                    draft_out[s].append(int(toks[s]))
+        stats.observe("spec_draft_ms", (time.monotonic() - t0) * 1e3)
+
+        # --- verify: one batched K+1 target call ---
+        t0 = time.monotonic()
+        tok_in = np.zeros((ns, K + 1), np.int32)
+        caps = {}
+        proposed = 0
+        for s, req in active.items():
+            # a lagging draft (bonus token / fallback steps) yields
+            # fewer usable proposals this window; the tail positions
+            # are padding that the accept cap below always rejects
+            lag = tgt_off[s] - d_off0[s]
+            cap = max(0, K - lag)
+            caps[s] = cap
+            tok_in[s, 0] = req.last_token
+            for i in range(1, K + 1):
+                tok_in[s, i] = draft_out[s][lag + i - 1] \
+                    if i <= cap else req.last_token
+            proposed += cap
+            self.cache.ensure_capacity(s, tgt_off[s] + K)
+        with RecordEvent("serving::spec_verify",
+                         args={"request_ids": rids}):
+            logits = self.model(Tensor(tok_in),
+                                caches=self.cache.layer_caches())
+            t = np.asarray(S.argmax(logits, axis=-1)._data_)  # [ns, K+1]
+        stats.observe("spec_verify_ms", (time.monotonic() - t0) * 1e3)
+
+        # --- accept mask + rollback ---
+        t0 = time.monotonic()
+        accepted = 0
+        for s, req in active.items():
+            a = 0
+            while a < caps[s] and tok_in[s, a + 1] == t[s, a]:
+                a += 1
+            accepted += a
+            for i in range(a + 1):
+                self._append_token(req, int(t[s, i]))
+                if req.slot is None:    # eos/length/deadline mid-window
+                    break               # truncates the rest of it
+            if req.slot is None:
+                continue                # _release returned the pages
+            new_off = tgt_off[s] + a + 1
+            self.cache.set_offset(s, new_off)
+            self.cache.rollback(s, new_off)
+            # the draft cache is valid through the accepted prefix it
+            # wrote itself (never past what IT cached this window)
+            d_new = min(d_off0[s] + K, new_off)
+            self.draft_cache.set_offset(s, d_new)
+            self.draft_cache.rollback(s, d_new)
+        stats.observe("spec_rollback_ms", (time.monotonic() - t0) * 1e3)
+        stats.incr("spec_windows")
+        stats.incr("spec_proposed_tokens", proposed)
+        stats.incr("spec_accepted_tokens", accepted)
+        stats.incr("slot_steps", ns)
+        stats.incr("slot_steps_active", n_active)
+        stats.set_value("active_slots", len(self._active))
 
     def _decode_step(self):
         """One batched step over ALL slots: the continuous batch."""
@@ -732,6 +980,8 @@ class Engine:
             # included); slot-layout requests only own a slot once
             # active
             self.cache.release(req.slot)
+            if self._spec and self.draft_cache is not None:
+                self.draft_cache.release(req.slot)
             if req.prefix_nodes and self.prefix_tree is not None:
                 self.prefix_tree.release(req.prefix_nodes)
                 req.prefix_nodes = []

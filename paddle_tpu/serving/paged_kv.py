@@ -77,13 +77,31 @@ class PagedKVCache:
         self._shared = {}        # slot -> leading tree-owned page count
         self._reserved = {}      # slot -> pages it may still claim
         self._dirty = True
+        from ..quantization import kv_quant_params
+        quant = kv_quant_params(dtype)
+        #: "int8"/"fp8" when K/V are stored quantized with per-page
+        #: scale arrays; None for plain float storage
+        self.quant_dtype = dtype if quant else None
+        store_dtype = quant[0] if quant else dtype
         pool_shape = [total, self.page_size, num_kv_heads, head_dim]
-        self.layers = [
-            {"k_pool": Tensor(jnp.zeros(pool_shape, dtype=dtype)),
-             "v_pool": Tensor(jnp.zeros(pool_shape, dtype=dtype)),
-             "page_table": None, "offset": None,
-             "page_size": self.page_size}
-            for _ in range(num_layers)]
+        self.layers = []
+        for _ in range(num_layers):
+            lay = {"k_pool": Tensor(jnp.zeros(pool_shape,
+                                              dtype=store_dtype)),
+                   "v_pool": Tensor(jnp.zeros(pool_shape,
+                                              dtype=store_dtype)),
+                   "page_table": None, "offset": None,
+                   "page_size": self.page_size}
+            if quant:
+                # one float32 scale per cached token position, stored
+                # page-major alongside the pools: a write only ever
+                # touches its own row's scale, so old tokens never need
+                # re-quantizing (paddle_tpu.quantization.quantize_kv_rows)
+                lay["k_scale"] = Tensor(jnp.ones([total, self.page_size],
+                                                 jnp.float32))
+                lay["v_scale"] = Tensor(jnp.ones([total, self.page_size],
+                                                 jnp.float32))
+            self.layers.append(lay)
         self._flush()
 
     # ---------------- pool accounting ----------------
@@ -164,6 +182,34 @@ class PagedKVCache:
         self.offsets[slot] = int(off)
         self._dirty = True
 
+    def rollback(self, slot, new_off):
+        """Speculative-decoding accept-mask rollback: after a verify
+        window wrote K/V past the accepted tokens, private pages lying
+        WHOLLY past the new write horizon (`new_off` is where the next
+        token lands, so its page stays) return to the free pool and the
+        slot's reservation is re-credited — pool accounting is exactly
+        what it was before the window grew them (``available_pages``
+        unchanged: +1 free, +1 reserved per page), so `ensure_capacity`
+        keeps its can-never-fail guarantee.  The rejected tokens' K/V in
+        the pages that remain become scratch: causally masked until the
+        offset passes them, and overwritten first.  Tree-owned (shared)
+        pages are never touched — they hold prompt tokens, which are
+        always behind the horizon."""
+        shared = self._shared.get(slot, 0)
+        keep = max(int(new_off) // self.page_size + 1, shared)
+        priv = self._private[slot]
+        while shared + len(priv) > keep:
+            idx = shared + len(priv) - 1
+            page = priv.pop()
+            if page != self.table[slot, idx]:   # pragma: no cover
+                raise RuntimeError(
+                    f"slot {slot} page-table tail {self.table[slot, idx]}"
+                    f" does not match private ownership {page}")
+            self.table[slot, idx] = 0
+            self._free_pages.append(page)
+            self._reserved[slot] += 1
+            self._dirty = True
+
     def advance(self, slots):
         """Bump the offsets of `slots` by one decoded token."""
         idx = list(slots)
@@ -215,17 +261,26 @@ class PagedKVCache:
             off[row] = start
         pt = Tensor(jnp.asarray(table))
         offt = Tensor(jnp.asarray(off))
-        return [{"k_pool": lay["k_pool"], "v_pool": lay["v_pool"],
-                 "page_table": pt, "offset": offt,
-                 "page_size": self.page_size}
-                for lay in self.layers]
+        views = []
+        for lay in self.layers:
+            view = {"k_pool": lay["k_pool"], "v_pool": lay["v_pool"],
+                    "page_table": pt, "offset": offt,
+                    "page_size": self.page_size}
+            if self.quant_dtype is not None:
+                view["k_scale"] = lay["k_scale"]
+                view["v_scale"] = lay["v_scale"]
+            views.append(view)
+        return views
 
     def absorb_view(self, views):
-        """Adopt the functionally-updated pools from a `prefill_view`
-        model call back into the shared layer dicts."""
+        """Adopt the functionally-updated pools (and per-page scales)
+        from a `prefill_view` model call back into the shared dicts."""
         for lay, view in zip(self.layers, views):
             lay["k_pool"] = view["k_pool"]
             lay["v_pool"] = view["v_pool"]
+            if self.quant_dtype is not None:
+                lay["k_scale"] = view["k_scale"]
+                lay["v_scale"] = view["v_scale"]
 
     def _flush(self):
         if not self._dirty:

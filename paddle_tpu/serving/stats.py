@@ -117,13 +117,22 @@ def serving_stats():
                             (prefill + decode wall)
 
     Paged-cache quantities (kv_layout="paged", zero otherwise):
-    ``kv_pages_in_use``/``kv_pages_free`` pool gauges,
+    ``kv_pages_in_use``/``kv_pages_free`` pool gauges plus the
+    ``kv_pages_peak`` high-water mark (the int8-KV capacity gate reads
+    it: at equal token load a quantized pool's peak ~halves),
     ``prefix_cache_hits``/``misses``/``evictions`` and
     ``prefix_cache_hit_tokens`` tree counters, ``prefill_chunks`` and
     ``prefill_chunk_ms_avg`` chunked-prefill cadence, and
     ``max_active_slots`` — the high-water mark of concurrent decoding
     sequences (the paged pool admits more of them than
     ``pool_bytes / max_seq_len`` stripes would).
+
+    Speculative-decoding quantities (``speculation_k > 0``, zero
+    otherwise): ``spec_windows`` (draft→verify→rollback iterations),
+    ``spec_proposed_tokens``/``spec_accepted_tokens`` and the derived
+    ``spec_acceptance_rate``, and per-phase latency
+    ``spec_draft_ms_avg``/``spec_verify_ms_avg``/
+    ``spec_rollback_ms_avg`` — all in the Prometheus exposition too.
 
     Fleet/router quantities (``serving.router.*``, zero without a
     router; per-replica ``requests_routed{replica=...}`` series live in
@@ -145,10 +154,13 @@ def serving_stats():
         count = g(name + ".count")
         return (g(name + ".sum") / count) if count else None
 
-    busy_s = (g("prefill_ms.sum") + g("decode_ms.sum")) / 1e3
+    busy_s = (g("prefill_ms.sum") + g("decode_ms.sum")
+              + g("spec_draft_ms.sum") + g("spec_verify_ms.sum")
+              + g("spec_rollback_ms.sum")) / 1e3
     tokens = g("tokens_generated")
     slot_steps = g("slot_steps")
     active_steps = g("slot_steps_active")
+    spec_proposed = g("spec_proposed_tokens")
     return {
         "queue_depth": g("queue_depth"),
         "active_slots": g("active_slots"),
@@ -167,6 +179,16 @@ def serving_stats():
         "decode_steps": g("decode_steps"),
         "kv_pages_in_use": g("kv_pages_in_use"),
         "kv_pages_free": g("kv_pages_free"),
+        "kv_pages_peak": g("kv_pages_peak"),
+        "spec_windows": g("spec_windows"),
+        "spec_proposed_tokens": spec_proposed,
+        "spec_accepted_tokens": g("spec_accepted_tokens"),
+        "spec_acceptance_rate": (g("spec_accepted_tokens")
+                                 / spec_proposed) if spec_proposed
+        else None,
+        "spec_draft_ms_avg": avg("spec_draft_ms"),
+        "spec_verify_ms_avg": avg("spec_verify_ms"),
+        "spec_rollback_ms_avg": avg("spec_rollback_ms"),
         "prefix_cache_hits": g("prefix_cache_hits"),
         "prefix_cache_misses": g("prefix_cache_misses"),
         "prefix_cache_evictions": g("prefix_cache_evictions"),

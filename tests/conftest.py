@@ -110,6 +110,19 @@ _SLOW_TESTS = {
     # gate and the fast unit/SIGTERM tests keep tier-1 coverage)
     "test_guardian.py::test_collective_delay_stall_dump",
     "test_guardian.py::test_rank_crash_relaunch_resume_matches_uninterrupted",
+    # r11 audit of the slowest tier-1 subprocess drills (ISSUE 11
+    # housekeeping; durations from the r11 measurement on this box).
+    # Every move keeps coverage elsewhere: the resize drills have a
+    # dedicated run_ci.sh lane (PADDLE_TPU_RUN_SLOW=1) plus the full
+    # RUN_SLOW suite, the sentinel/fault/train-step/elastic drills run
+    # in the RUN_SLOW full suite and their fast in-process siblings
+    # stay tier-1.
+    "test_reshard.py::test_resize_4_to_2_drill",                   # 14
+    "test_reshard.py::test_resize_2_to_4_drill",                   # 14
+    "test_sentinel.py::test_blame_drill_two_procs",                # 6
+    "test_fault_tolerance.py::test_drill_sigterm_preemption_relaunch_resumes",  # 5
+    "test_train_step.py::test_dp_psum_matches_two_proc_sync_grads_drill",       # 5
+    "test_launch_elastic.py::test_scale_in_dead_pod_triggers_rebuild",          # 5
 }
 
 

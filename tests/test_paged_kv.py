@@ -386,6 +386,59 @@ def test_paged_pallas_kernel_matches_gather_path():
             os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = prev
 
 
+def test_paged_pallas_kernel_int8_scales_match_dequant():
+    """The quantized-pool Pallas kernel (per-page scale blocks riding
+    the scalar-prefetch index map) agrees with an explicit
+    dequantize-then-attend reference in interpreter mode."""
+    prev = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")
+    os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+    try:
+        import jax.numpy as jnp
+        from paddle_tpu.pallas.flash_attention import \
+            paged_decode_attention
+        rng = np.random.default_rng(1)
+        B, H, Hkv, D, psz, N = 2, 4, 2, 16, 8, 3
+        P = 1 + B * N
+        k_pool = rng.integers(-127, 128, (P, psz, Hkv, D)) \
+            .astype(np.int8)
+        v_pool = rng.integers(-127, 128, (P, psz, Hkv, D)) \
+            .astype(np.int8)
+        k_scale = rng.uniform(0.005, 0.03, (P, psz)).astype(np.float32)
+        v_scale = rng.uniform(0.005, 0.03, (P, psz)).astype(np.float32)
+        q = rng.normal(size=(B, H, D)).astype(np.float32)
+        pt = rng.permutation(np.arange(1, P)).reshape(B, N) \
+            .astype(np.int32)
+        off = np.array([6, 19], np.int32)
+        out = np.asarray(paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(pt), jnp.asarray(off),
+            k_scale=jnp.asarray(k_scale), v_scale=jnp.asarray(v_scale)))
+        kf = (k_pool.astype(np.float32)
+              * k_scale[:, :, None, None])[pt].reshape(B, N * psz,
+                                                       Hkv, D)
+        vf = (v_pool.astype(np.float32)
+              * v_scale[:, :, None, None])[pt].reshape(B, N * psz,
+                                                       Hkv, D)
+        rep = H // Hkv
+        qg = q.reshape(B, Hkv, rep, D)
+        ref = np.zeros((B, Hkv, rep, D), np.float32)
+        for b in range(B):
+            for h in range(Hkv):
+                for r in range(rep):
+                    s = (kf[b, :, h] @ qg[b, h, r]) / np.sqrt(D)
+                    s[np.arange(N * psz) > off[b]] = -np.inf
+                    p = np.exp(s - s.max())
+                    p /= p.sum()
+                    ref[b, h, r] = p @ vf[b, :, h]
+        np.testing.assert_allclose(out, ref.reshape(B, H, D),
+                                   rtol=1e-5, atol=1e-5)
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_PALLAS_INTERPRET", None)
+        else:
+            os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = prev
+
+
 def test_paged_metrics_reach_prometheus(model):
     """Satellite: the new serving gauges/counters/histogram flow
     through the PR 4 registry into Prometheus exposition."""

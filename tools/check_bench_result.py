@@ -345,6 +345,102 @@ def check_paged_bench(run):
     return 0
 
 
+_SPEC_SCHEMA = {
+    # key -> accepted types; every key is required
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "speedups": dict,
+    "speedup_min": (int, float),
+    "speculation_k": int,
+    "acceptance_rate": (int, float),
+    "batches": dict,
+    "int8_kv": dict,
+    "max_new_tokens": int,
+    "greedy_mismatches": int,
+    "spec_draft_ms_avg": (int, float),
+    "spec_verify_ms_avg": (int, float),
+    "spec_rollback_ms_avg": (int, float),
+    "smoke": bool,
+    "platform": str,
+}
+
+# acceptance floors (ISSUE 11): the speculative lane must sustain >= 2x
+# the plain paged engine's decode tokens/sec at every measured batch
+# size 1..4 (smoke clears ~2.7x with K=8 and a 1-block draft against an
+# 8-block target), keep greedy outputs bit-equal to sequential
+# generate(), and accept most of what a perfectly-agreeing draft
+# proposes (the lane's draft computes the target's function; a low rate
+# means the accept machinery itself broke).  The int8-KV section must
+# show the pages-in-use peak at equal token load at ~half the fp32
+# pool's (quantized pages pack 2x the tokens in half the bytes).
+_SPEC_MIN_SPEEDUP = 2.0
+_SPEC_MIN_ACCEPTANCE = 0.8
+_SPEC_MAX_INT8_PAGES_RATIO = 0.6
+
+
+def check_spec_bench(run):
+    """Schema + speedup/acceptance/capacity gates for the speculative
+    lane of benchmarks/serving_bench.py (--workload speculative)."""
+    errors = []
+    for key, types in _SPEC_SCHEMA.items():
+        if key not in run:
+            errors.append(f"missing key {key!r}")
+        elif run[key] is None or not isinstance(run[key], types):
+            errors.append(f"{key!r} has type {type(run[key]).__name__}, "
+                          f"expected {types}")
+    if not errors:
+        for name in ("batch_1", "batch_4"):
+            side = run["batches"].get(name)
+            if not isinstance(side, dict):
+                errors.append(f"batches.{name} missing")
+                continue
+            for k in ("baseline_tokens_per_sec", "spec_tokens_per_sec",
+                      "speedup"):
+                v = side.get(k)
+                if not isinstance(v, (int, float)) or v <= 0:
+                    errors.append(f"batches.{name}.{k} must be a "
+                                  f"positive number, got {v!r}")
+            sp = run["speedups"].get(name)
+            if isinstance(sp, (int, float)) and sp < _SPEC_MIN_SPEEDUP:
+                errors.append(
+                    f"speedups.{name} {sp:.2f} < required "
+                    f"{_SPEC_MIN_SPEEDUP}x vs the non-speculative "
+                    "paged engine")
+        if run["value"] <= 0:
+            errors.append("value must be positive")
+        if run["greedy_mismatches"] != 0:
+            errors.append(
+                f"{run['greedy_mismatches']} outputs diverged from the "
+                "sequential greedy baseline — speculation must be "
+                "output-invariant")
+        if run["acceptance_rate"] < _SPEC_MIN_ACCEPTANCE:
+            errors.append(
+                f"acceptance_rate {run['acceptance_rate']:.2f} < "
+                f"{_SPEC_MIN_ACCEPTANCE} with a function-identical "
+                "draft — the accept machinery is rejecting good tokens")
+        int8 = run["int8_kv"]
+        for k in ("pages_peak_float32", "pages_peak_int8", "ratio"):
+            if not isinstance(int8.get(k), (int, float)) or \
+                    int8[k] <= 0:
+                errors.append(f"int8_kv.{k} missing or not positive")
+        if not errors and int8["ratio"] > _SPEC_MAX_INT8_PAGES_RATIO:
+            errors.append(
+                f"int8_kv.ratio {int8['ratio']:.2f} > "
+                f"{_SPEC_MAX_INT8_PAGES_RATIO} — quantized KV did not "
+                "deliver ~2x effective cache capacity at equal tokens")
+    if errors:
+        print("serving_speculative schema check FAILED:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(f"serving_speculative schema OK: {run['value']:.1f} tokens/"
+          f"sec, speedups {run['speedups']}, acceptance "
+          f"{run['acceptance_rate']:.2f}, int8 pages ratio "
+          f"{run['int8_kv']['ratio']:.2f}")
+    return 0
+
+
 _FLEET_SCHEMA = {
     # key -> accepted types; every key is required
     "metric": str,
@@ -449,6 +545,8 @@ def main():
         return check_train_step_bench(run)
     if str(run.get("metric", "")).startswith("serving_fleet"):
         return check_fleet_bench(run)
+    if str(run.get("metric", "")).startswith("serving_speculative"):
+        return check_spec_bench(run)
     if str(run.get("metric", "")).startswith("serving_paged"):
         return check_paged_bench(run)
     if str(run.get("metric", "")).startswith("serving_"):
