@@ -62,11 +62,18 @@ def collective_cost(bytes_total, n_devices, kind="all_reduce",
 
 @dataclass
 class TransformerCost:
-    """Per-step cost estimate for a GPT-style model under a hybrid config."""
+    """Per-step cost estimate for a GPT-style model under a hybrid config.
+
+    ``t_compute`` (math + the HBM-bound optimizer update) and ``t_comm``
+    (per-axis collectives) are the components the auto-layout planner
+    recombines when a measured COMM_BUDGET replaces the analytic comm
+    term (``planner.py``)."""
     step_time_s: float
     mfu: float
     hbm_per_device: float
     bound: str
+    t_compute: float = 0.0
+    t_comm: float = 0.0
 
 
 def transformer_step_cost(n_params, n_layers, hidden, batch, seq,
@@ -101,6 +108,15 @@ def transformer_step_cost(n_params, n_layers, hidden, batch, seq,
                  * act_factor / (dp * mp * pp * grad_accum))
     hbm = state_per_dev + act_bytes
 
+    # optimizer update: the fused Adam step streams params, grads and
+    # both moments (read + write ≈ 32 B/param fp32) once per step —
+    # HBM-bound work REPLICATED across dp, divided only by the axes
+    # that shard the state (mp/pp/ZeRO).  This is what makes pure-dp
+    # lose to dp×mp on parameter-heavy models even at equal FLOPs.
+    t_update = (32.0 * n_params / (mp * pp * max(sharding, 1))
+                / spec.hbm_bandwidth)
+    t_comp = t_compute + t_update
+
     # comms: dp grad all-reduce + mp per-layer collectives
     grad_bytes = dtype_bytes * n_params / (mp * pp)
     t_dp = collective_cost(grad_bytes, dp * sharding, "all_reduce", device)
@@ -108,12 +124,12 @@ def transformer_step_cost(n_params, n_layers, hidden, batch, seq,
     t_mp = (collective_cost(act_per_layer, mp, "all_reduce", device)
             * 4 * n_layers / pp)
     t_pp = collective_cost(act_per_layer, 2, "p2p", device) * 2 * (pp - 1)
+    t_comm = t_dp + t_mp + t_pp
 
-    step = max(t_compute, t_dp + t_mp + t_pp) + 0.1 * min(t_compute,
-                                                          t_dp + t_mp)
+    step = max(t_comp, t_comm) + 0.1 * min(t_comp, t_dp + t_mp)
     mfu = flops / (step * peak * n_dev)
-    bound = "compute" if t_compute >= (t_dp + t_mp + t_pp) else "comm"
-    return TransformerCost(step, mfu, hbm, bound)
+    bound = "compute" if t_comp >= t_comm else "comm"
+    return TransformerCost(step, mfu, hbm, bound, t_comp, t_comm)
 
 
 class CostModel:
@@ -129,6 +145,12 @@ class CostModel:
 
     def estimate_step(self, **kwargs):
         return transformer_step_cost(device=self.device, **kwargs)
+
+
+from .planner import (  # noqa: E402  (planner needs the roofline above)
+    BudgetSchemaError, COMM_BUDGET_SCHEMA_VERSION, LayoutPlan,
+    load_comm_budgets, plan_layout, project_comm_seconds, validate_budget,
+)
 
 
 def device_peak_flops(platform=None):

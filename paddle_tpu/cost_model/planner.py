@@ -1,0 +1,286 @@
+"""Auto-layout planner: pick a dp×mp(×pp) mesh for a model + world size.
+
+Reference capability: the static auto-parallel parallel tuner
+(reference: distributed/auto_parallel/static/tuner/parallel_tuner.py)
+searches process-mesh factorizations with a comm+comp cost model — the
+SURVEY.md layer-9 "auto parallel" capability behind the paper's ≥45%
+MFU headline.
+
+TPU-native realization: candidate dp×mp(×pp) factorizations of the
+world are scored by projected step time — the roofline compute term
+(``transformer_step_cost``: MXU math + the HBM-bound optimizer update)
+combined with per-axis collective time.  The collective term comes from
+a **measured COMM_BUDGET** when one is supplied (the per-axis bytes the
+compiled step's HLO actually moves, recorded by ``benchmarks/run.py
+--comm-report`` into ``benchmarks/COMM_BUDGET_*.json``), rescaled to
+each candidate's axis degrees; otherwise from the analytic roofline.
+The winner becomes a :class:`LayoutPlan` that can build a live
+``ProcessMesh`` (feeding :class:`~framework.train_step.CompiledTrainStep`)
+or a checkpoint ``MeshSpec`` (feeding PR 6's elastic reshard restore).
+
+Wired into ``distributed.auto_tuner`` (predict-mode ranking) and
+``distributed.fleet.elastic.plan_topology`` (elastic resizes re-plan
+instead of assuming pure-dp).
+
+Budget files are versioned: a consumer MUST validate
+``schema_version`` before use — a stale budget silently skewing plans
+is exactly the failure mode :class:`BudgetSchemaError` exists to make
+loud.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+from dataclasses import dataclass, field
+
+from . import DEVICE_SPECS, collective_cost, transformer_step_cost
+
+# bump when the COMM_BUDGET_*.json record layout changes; the producer
+# (profiler/comm_budget.budget_report via benchmarks/run.py) stamps it,
+# every consumer validates it before trusting the numbers
+COMM_BUDGET_SCHEMA_VERSION = 1
+
+_BUDGET_REQUIRED_KEYS = ("collectives", "mesh")
+_RECORD_REQUIRED_KEYS = ("axis", "op", "bytes", "n_devices")
+
+# HLO collective op name -> roofline kind (cost_model.collective_cost)
+_OP_KIND = {
+    "all-reduce": "all_reduce",
+    "all-gather": "all_gather",
+    "reduce-scatter": "reduce_scatter",
+    "all-to-all": "all_to_all",
+    "collective-permute": "p2p",
+}
+
+
+class BudgetSchemaError(ValueError):
+    """A COMM_BUDGET file is unusable: missing/mismatched schema_version
+    or a malformed record.  Raised loudly instead of letting a stale
+    budget silently skew layout plans."""
+
+
+def validate_budget(budget, source="<budget>"):
+    """Schema-gate one loaded budget dict; returns it on success."""
+    if not isinstance(budget, dict):
+        raise BudgetSchemaError(f"{source}: budget is not a JSON object")
+    ver = budget.get("schema_version")
+    if ver != COMM_BUDGET_SCHEMA_VERSION:
+        raise BudgetSchemaError(
+            f"{source}: schema_version {ver!r} does not match the "
+            f"version this build understands "
+            f"({COMM_BUDGET_SCHEMA_VERSION}); re-record the budget with "
+            "`benchmarks/run.py --comm-report` before planning with it")
+    for key in _BUDGET_REQUIRED_KEYS:
+        if key not in budget:
+            raise BudgetSchemaError(f"{source}: missing {key!r} section")
+    for i, rec in enumerate(budget["collectives"]):
+        for key in _RECORD_REQUIRED_KEYS:
+            if key not in rec:
+                raise BudgetSchemaError(
+                    f"{source}: collectives[{i}] missing {key!r}")
+    return budget
+
+
+def load_comm_budgets(search_dir=None):
+    """{name: validated budget} from ``COMM_BUDGET_<name>.json`` files.
+
+    ``search_dir`` defaults to ``PADDLE_COMM_BUDGET_DIR`` or the repo's
+    ``benchmarks/`` directory.  Any file failing the schema gate raises
+    :class:`BudgetSchemaError` naming it — a planner run over a stale
+    budget directory fails loudly, it never plans from garbage."""
+    if search_dir is None:
+        search_dir = os.environ.get("PADDLE_COMM_BUDGET_DIR") or \
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "..", "benchmarks")
+    out = {}
+    for path in sorted(glob.glob(os.path.join(search_dir,
+                                              "COMM_BUDGET_*.json"))):
+        name = os.path.basename(path)[len("COMM_BUDGET_"):-len(".json")]
+        try:
+            with open(path) as f:
+                budget = json.load(f)
+        except (OSError, ValueError) as e:
+            raise BudgetSchemaError(f"{path}: unreadable ({e})") from None
+        out[name] = validate_budget(budget, source=path)
+    return out
+
+
+def project_comm_seconds(budget, dp, mp, pp=1, device="v5e"):
+    """Per-step collective seconds for a candidate layout, projected
+    from a MEASURED per-axis budget.
+
+    Each recorded collective group is rescaled from the budget's mesh to
+    the candidate's: dp-axis records carry gradients (bytes ∝ 1/(mp·pp)
+    — the state those axes shard), mp-axis records carry activations
+    (bytes ∝ 1/dp), then ring time is re-derived at the candidate's axis
+    degree with ``collective_cost``.  Records for axes the candidate
+    does not run (sharding/sep/fused groups) are skipped — the plan has
+    no such collectives."""
+    m0 = budget.get("mesh", {})
+    dp0 = max(int(m0.get("dp", 1) or 1), 1)
+    mp0 = max(int(m0.get("mp", 1) or 1), 1)
+    pp0 = max(int(m0.get("pp", 1) or 1), 1)
+    total = 0.0
+    for rec in budget["collectives"]:
+        axis = rec["axis"]
+        kind = _OP_KIND.get(rec["op"])
+        if kind is None:
+            continue
+        if axis == "dp":
+            n_new, scale = dp, (mp0 * pp0) / float(mp * pp)
+        elif axis == "mp":
+            n_new, scale = mp, dp0 / float(dp)
+        elif axis == "pp":
+            n_new, scale = pp, dp0 / float(dp)
+        else:
+            continue
+        if n_new <= 1:
+            continue
+        total += collective_cost(rec["bytes"] * scale, n_new, kind,
+                                 device)
+    return total
+
+
+@dataclass(frozen=True)
+class LayoutPlan:
+    """One planned dp×mp(×pp) factorization + its projection."""
+
+    dp: int
+    mp: int
+    pp: int
+    world_size: int
+    projected_step_s: float
+    mfu: float
+    bound: str
+    source: str                       # "roofline" | "roofline+budget:<n>"
+    device: str = "v5e"
+    # every scored candidate, ranked: ((dp, mp, pp, projected_s), ...)
+    scores: tuple = field(default_factory=tuple)
+
+    @property
+    def axes(self):
+        return ("dp", "mp", "pp")[:3 if self.pp > 1 else 2]
+
+    @property
+    def shape(self):
+        return (self.dp, self.mp, self.pp)[:3 if self.pp > 1 else 2]
+
+    def mesh_spec(self):
+        """The checkpoint :class:`~distributed.reshard.MeshSpec` for this
+        plan — what elastic resumes reshard onto."""
+        from ..distributed.reshard import MeshSpec
+        return MeshSpec(self.axes, self.shape)
+
+    def build_mesh(self):
+        """A live :class:`~distributed.mesh.ProcessMesh` over local
+        devices — what :class:`CompiledTrainStep` compiles over."""
+        from ..distributed.mesh import init_mesh
+        return init_mesh(list(self.shape), list(self.axes))
+
+    def to_json(self):
+        return {
+            "dp": self.dp, "mp": self.mp, "pp": self.pp,
+            "world_size": self.world_size,
+            "projected_step_s": self.projected_step_s,
+            "mfu": self.mfu, "bound": self.bound,
+            "source": self.source, "device": self.device,
+            "scores": [list(s) for s in self.scores],
+        }
+
+
+_DESC_KEYS = ("n_params", "n_layers", "hidden", "global_batch",
+              "seq_len", "dtype_bytes", "grad_accum", "recompute")
+_DESC_DEFAULTS = dict(n_params=1.3e9, n_layers=24, hidden=2048,
+                      global_batch=512, seq_len=2048, dtype_bytes=2,
+                      grad_accum=1, recompute=False)
+
+
+def candidate_step_time(desc, dp, mp, pp=1, device="v5e", budget=None,
+                        sharding=1):
+    """Projected step seconds for one candidate: roofline compute +
+    (measured-budget OR analytic) per-axis collective time, recombined
+    with the roofline's overlap formula."""
+    desc = dict(_DESC_DEFAULTS, **{k: v for k, v in desc.items()
+                                   if k in _DESC_KEYS and v is not None})
+    est = transformer_step_cost(
+        desc["n_params"], desc["n_layers"], desc["hidden"],
+        desc["global_batch"], desc["seq_len"], dp=dp, mp=mp, pp=pp,
+        sharding=sharding, device=device,
+        dtype_bytes=desc["dtype_bytes"], grad_accum=desc["grad_accum"],
+        recompute=desc["recompute"])
+    if budget is None:
+        return est.step_time_s, est
+    comm = project_comm_seconds(budget, dp, mp, pp=pp, device=device)
+    step = max(est.t_compute, comm) + 0.1 * min(est.t_compute, comm)
+    return step, est
+
+
+def plan_layout(model_desc, world_size, device=None, budget=None,
+                max_mp=8, include_pp=False):
+    """Score every feasible dp×mp(×pp) factorization of ``world_size``
+    and return the best as a :class:`LayoutPlan`.
+
+    ``model_desc`` — ``n_params/n_layers/hidden/global_batch/seq_len``
+    (TunerConfig-compatible; unknown keys ignored), optionally
+    ``device`` and ``comm_budget`` (a budget name resolved through
+    :func:`load_comm_budgets`, schema-validated — stale files fail
+    loudly).  ``include_pp`` adds pp>1 candidates (scored with the 1F1B
+    bubble term) for lanes that run the fleet pipeline wrappers; the
+    compiled train step itself hosts dp×mp only.
+
+    Deterministic: same inputs → same plan (candidates are enumerated
+    and ranked with a total, tie-broken order — the auto_tuner and the
+    elastic re-plan must agree across processes)."""
+    desc = dict(_DESC_DEFAULTS)
+    md = dict(model_desc or {})
+    for key in _DESC_KEYS:
+        if md.get(key) is not None:
+            desc[key] = md[key]
+    device = device or md.get("device") or "v5e"
+    if device not in DEVICE_SPECS:
+        device = "v5e"
+    source = "roofline"
+    if budget is None and md.get("comm_budget"):
+        budget = load_comm_budgets().get(str(md["comm_budget"]))
+    if budget is not None:
+        validate_budget(budget)
+        source = "roofline+budget:%s" % (
+            budget.get("metric") or md.get("comm_budget") or "?")
+
+    world_size = int(world_size)
+    spec = DEVICE_SPECS[device]
+    scored = []
+    mps = [m for m in range(1, world_size + 1)
+           if world_size % m == 0 and m <= max_mp
+           and desc["hidden"] % m == 0]
+    for mp in mps:
+        pps = [1]
+        if include_pp:
+            pps = [p for p in range(1, world_size // mp + 1)
+                   if (world_size // mp) % p == 0
+                   and desc["n_layers"] % p == 0]
+        for pp in pps:
+            dp = world_size // (mp * pp)
+            if desc["global_batch"] % dp:
+                continue
+            step, est = candidate_step_time(desc, dp, mp, pp=pp,
+                                            device=device, budget=budget)
+            if est.hbm_per_device > spec.hbm_bytes * 0.9:
+                continue
+            scored.append((step, mp, pp, dp, est))
+    if not scored:
+        # nothing feasible (indivisible batch, tiny worlds): pure-dp is
+        # the always-valid degenerate plan — never return None
+        step, est = candidate_step_time(desc, world_size, 1,
+                                        device=device, budget=budget)
+        scored = [(step, 1, 1, world_size, est)]
+    # total deterministic order: projected time, then the LEAST invasive
+    # factorization on ties (smaller mp, then smaller pp)
+    scored.sort(key=lambda s: (s[0], s[1], s[2]))
+    step, mp, pp, dp, est = scored[0]
+    return LayoutPlan(
+        dp=dp, mp=mp, pp=pp, world_size=world_size,
+        projected_step_s=float(step), mfu=float(est.mfu),
+        bound=est.bound, source=source, device=device,
+        scores=tuple((d, m, p, float(s)) for s, m, p, d, _ in scored))

@@ -41,6 +41,10 @@ class TunerConfig:
     amp_candidates: list = field(default_factory=lambda: ["O0"])
     max_mp: int = 8          # mp beyond one host rides DCN — prune
     hbm_headroom: float = 0.9
+    # a measured per-axis collective budget (cost_model.planner
+    # load_comm_budgets entry, schema-validated) replaces the analytic
+    # comm term when ranking predict-mode candidates
+    comm_budget: dict = None
 
 
 def _divisors(n):
@@ -101,16 +105,39 @@ class AutoTuner:
         hbm = DEVICE_SPECS[c.device].hbm_bytes * c.hbm_headroom
         return est.hbm_per_device > hbm
 
+    def _predict_score(self, cand):
+        """Projected step seconds for predict-mode ranking: the
+        auto-layout planner's scoring (roofline compute + measured
+        COMM_BUDGET collective term when ``cfg.comm_budget`` is set),
+        deterministic across processes."""
+        from ...cost_model.planner import candidate_step_time
+        c = self.cfg
+        desc = dict(n_params=c.n_params, n_layers=c.n_layers,
+                    hidden=c.hidden, global_batch=c.global_batch,
+                    seq_len=c.seq_len, grad_accum=max(
+                        c.global_batch // (cand["dp"] * cand["sharding"]
+                                           * cand["micro_batch"]), 1),
+                    recompute=cand.get("use_recompute", False),
+                    dtype_bytes=4 if cand.get("amp", "O0") == "O0" else 2)
+        step, _ = candidate_step_time(
+            desc, cand["dp"], cand["mp"], pp=cand["pp"], device=c.device,
+            budget=c.comm_budget, sharding=cand["sharding"])
+        return step
+
     def tune(self, trial_fn=None, max_trials=None, mode="measure"):
         """Returns the best candidate.  trial_fn(cand) -> tokens/sec, or
-        mode='predict' ranks by the cost model alone."""
+        mode='predict' ranks by the cost model alone (the auto-layout
+        planner's projection — cost_model.plan_layout scoring)."""
         cands = list(self.candidates())
         # rank by predicted step time so measured trials start from the
-        # most promising region (reference: search.py ordered search)
-        cands.sort(key=lambda c: c["_est"].step_time_s)
+        # most promising region (reference: search.py ordered search);
+        # ties break toward the least invasive factorization so the
+        # ranking is total and deterministic
+        cands.sort(key=lambda c: (self._predict_score(c), c["mp"],
+                                  c["pp"], c["sharding"]))
         if mode == "predict" or trial_fn is None:
             best = cands[0] if cands else None
-            self.history = [(c, 1.0 / c["_est"].step_time_s)
+            self.history = [(c, 1.0 / self._predict_score(c))
                             for c in cands]
             return best
         best, best_tput = None, -1.0

@@ -26,26 +26,26 @@ ELASTIC_TIMEOUT = 60
 
 def plan_topology(world_size, model_desc=None):
     """dp×mp factorization for a (possibly resized) world — the elastic
-    relaunch path re-invokes the launch-level auto_tuner (predict mode:
-    roofline-ranked, no trial runs) exactly as the reference's elastic
-    manager re-plans after a membership change, so ``fit(resume=...)``
-    can reshard the checkpoint onto whatever the tuner picks for the new
-    world.  Falls back to pure data-parallel when the tuner has no
-    feasible candidate (tiny worlds, missing model description)."""
+    relaunch path re-invokes the auto-layout planner
+    (``cost_model.plan_layout``: roofline compute + per-axis collective
+    projection, COMM_BUDGET-calibrated when the description names one)
+    exactly as the reference's elastic manager re-plans after a
+    membership change, so ``fit(resume=...)`` can reshard the checkpoint
+    onto whatever the planner picks for the new world.  Falls back to
+    pure data-parallel when planning fails or there is no model
+    description (nothing to plan FOR — a descriptionless resize must
+    not silently adopt the default model's layout)."""
     world_size = int(world_size)
-    try:
-        from ..auto_tuner.tuner import AutoTuner, TunerConfig
-        cfg = TunerConfig(n_devices=world_size, **(model_desc or {}))
-        # the elastic CPU/host lane replans dp×mp only; pp/sharding
-        # re-planning needs a program repartition, not just a reshard
-        cfg.pp_candidates = [1]
-        cfg.sharding_candidates = [1]
-        best = AutoTuner(cfg).tune(mode="predict")
-    except Exception:
-        best = None
-    if not best:
+    if not model_desc:
         return {"dp": world_size, "mp": 1}
-    return {"dp": int(best["dp"]), "mp": int(best["mp"])}
+    try:
+        from ...cost_model import plan_layout
+        # the elastic CPU/host lane replans dp×mp only; pp re-planning
+        # needs a program repartition, not just a reshard
+        plan = plan_layout(model_desc, world_size, include_pp=False)
+    except Exception:
+        return {"dp": world_size, "mp": 1}
+    return {"dp": int(plan.dp), "mp": int(plan.mp)}
 
 
 def reshard_mesh_for(world_size, model_desc=None):

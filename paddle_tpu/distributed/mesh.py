@@ -114,6 +114,30 @@ def set_mesh(mesh: ProcessMesh):
     _DEFAULT[0] = mesh
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def suspended():
+    """Temporarily deactivate the scoped AND default mesh.
+
+    Used by ragged-batch eager fallbacks (framework/train_step.py): a
+    batch that does not divide the dp axis cannot satisfy the model's
+    activation ``shard_constraint``s in ANY lane, but with the mesh
+    scope lifted those constraints become no-ops while committed
+    (sharded) parameters still compute the same values through GSPMD
+    eager propagation."""
+    saved_stack = _MESH_STACK[:]
+    saved_default = _DEFAULT[0]
+    del _MESH_STACK[:]
+    _DEFAULT[0] = None
+    try:
+        yield
+    finally:
+        _MESH_STACK[:] = saved_stack
+        _DEFAULT[0] = saved_default
+
+
 def init_mesh(shape, dim_names, devices=None) -> ProcessMesh:
     """Build a mesh over the first prod(shape) available devices.
 

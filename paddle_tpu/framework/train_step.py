@@ -15,12 +15,28 @@ tape backward, AMP unscale + in-program found-inf reduction, global-norm
 clip, the optimizer's ``_fused_update`` — as a pure function of those
 pytrees, and compiles it with ``jax.jit`` donating the parameter,
 gradient and optimizer-state buffers so XLA updates them in place.  When
-a data-parallel mesh spans more than one local device the body runs
+a PURE data-parallel mesh spans more than one local device the body runs
 under ``shard_map`` over the ``NamedSharding`` mesh
 (``distributed/mesh.py``): the batch is sharded over ``dp`` and gradient
 reduction happens as an in-program ``psum``/``pmean`` that XLA can
 overlap with the rest of the backward, instead of the eager path's
 post-hoc per-tensor host collectives (``hapi.Model._sync_grads``).
+
+Hybrid dp×mp meshes (ISSUE 12) compile as ONE GSPMD program instead:
+``jax.jit`` over per-leaf ``NamedSharding`` trees derived from each
+parameter's declared partition (the ``mp_placement`` annotations the TP
+layers carry, committed by ``fleet.distributed_model``), gradients and
+optimizer moments mirroring their parameter's sharding, and the batch
+sharded over ``dp``.  The model's own ``shard_constraint`` calls then
+direct XLA to insert the exact mp collectives (row-parallel partial-sum
+all-reduce, vocab-parallel softmax reductions), while the dp gradient
+all-reduce falls out of differentiating the global-batch loss — all
+inside one program, so XLA's scheduler overlaps the dp grad reduction
+with mp compute instead of serializing them at a host boundary.  Mesh
+axes the one-program step cannot host (``pp`` — the 1F1B schedule is a
+python micro-batch loop; ``sharding`` — ZeRO accumulators rebind per
+step; ``sep``) fall back to eager with a :class:`MeshFallbackWarning`
+naming the axis.
 
 Lifecycle (two-phase, mirroring ``jit/tracer.py``):
 
@@ -64,10 +80,26 @@ _DONATED_FAILURE_MSG = (
     "for failure recovery")
 
 
+class MeshFallbackWarning(UserWarning):
+    """Warned once when the active ``ProcessMesh`` carries an axis the
+    one-program train step cannot host (pipeline, ZeRO sharding,
+    context parallel); the message names the axis that forced the
+    eager fallback."""
+
+
 class TraceEscape(Exception):
     """Raised when the step body performs a host interaction the
     compiled program cannot replay; the step falls back to eager
     permanently."""
+
+    category = UserWarning
+
+
+class _MeshEscape(TraceEscape):
+    """A mesh axis forced the eager fallback — warn with the typed
+    :class:`MeshFallbackWarning` so callers can filter on it."""
+
+    category = MeshFallbackWarning
 
 
 class _StepBindTracer:
@@ -150,15 +182,22 @@ class _Installed:
 
 
 def _resolve_mesh(mesh=None):
-    """The dp mesh this step shards over, or None for single-device.
+    """``(mesh, blocked_axis)`` — the mesh this step compiles over, or
+    the axis name that forces the eager fallback.
 
     Precedence: explicit argument > the framework's active/default
-    ``ProcessMesh`` (``distributed.mesh``) when it carries a pure-dp
-    layout > the ``PADDLE_COMPILED_DP`` env var (dp over the first N
-    local devices).  There is deliberately NO implicit
-    all-local-devices default: silently resharding the batch would
-    change trajectories whenever CI forces a multi-device host
-    platform."""
+    ``ProcessMesh`` (``distributed.mesh``) > the ``PADDLE_COMPILED_DP``
+    env var (dp over the first N local devices).  There is deliberately
+    NO implicit all-local-devices default: silently resharding the
+    batch would change trajectories whenever CI forces a multi-device
+    host platform.
+
+    A pure-dp mesh runs under ``shard_map`` (bit-identical to the PR 8
+    lane); a mesh with an ``mp`` axis > 1 runs as one GSPMD program
+    over NamedSharding trees.  Any other axis of size > 1 (``pp``: the
+    1F1B schedule is a python micro-batch loop, not one program;
+    ``sharding``: ZeRO accumulators rebind per step; ``sep``) blocks
+    compilation — ``blocked_axis`` names it for the typed warning."""
     import os
     from ..distributed import mesh as _mesh_mod
     if mesh is None:
@@ -167,14 +206,18 @@ def _resolve_mesh(mesh=None):
         n = int(os.environ.get("PADDLE_COMPILED_DP", "0") or 0)
         if n > 1:
             mesh = _mesh_mod.init_mesh([n], ["dp"])
-    if mesh is None or "dp" not in mesh.dim_names:
-        return None
+    if mesh is None:
+        return None, None
     for name in mesh.dim_names:
-        if name != "dp" and mesh.get_dim_size(name) != 1:
-            return None   # model-parallel axes are not this step's job
-    if mesh.get_dim_size("dp") <= 1:
-        return None
-    return mesh
+        if name not in ("dp", "mp") and mesh.get_dim_size(name) != 1:
+            return None, name
+    dp = mesh.get_dim_size("dp") if "dp" in mesh.dim_names else 1
+    mp = mesh.get_dim_size("mp") if "mp" in mesh.dim_names else 1
+    if dp <= 1 and mp <= 1:
+        return None, None
+    if mp > 1 and not _flag("FLAGS_compiled_mp_step", True):
+        return None, "mp"
+    return mesh, None
 
 
 class CompiledTrainStep:
@@ -220,6 +263,12 @@ class CompiledTrainStep:
         self._built = False
         self._mesh = None
         self._dp = 1
+        self._mp = 1
+        self._shard_map = False     # pure-dp shard_map lane (PR 8)
+        self._gspmd = False         # hybrid dp×mp GSPMD lane (ISSUE 12)
+        self._psh = None            # per-param NamedSharding tree
+        self._csh = None            # per-capture NamedSharding tree
+        self._rep = None            # replicated NamedSharding on the mesh
         self._caps = []               # non-param captured tensors
         self._params = []             # params receiving grads (update set)
         self._idxs = []               # their positions in the optimizer list
@@ -259,7 +308,7 @@ class CompiledTrainStep:
             try:
                 self._discover(x, y)
             except TraceEscape as e:
-                self._set_fallback(str(e))
+                self._set_fallback(str(e), category=e.category)
             except Exception as e:  # noqa: BLE001 — any failure → eager
                 self._set_fallback(
                     f"discovery failed: {type(e).__name__}: {e}")
@@ -268,7 +317,7 @@ class CompiledTrainStep:
                 loss = self._run_compiled(x, y, update)
                 _monitor.incr("jit.compiled_step_hit")
             except TraceEscape as e:
-                self._set_fallback(str(e))
+                self._set_fallback(str(e), category=e.category)
                 loss = self._run_eager(x, y, update)
             except Exception as e:  # noqa: BLE001
                 if self._donation_burned():
@@ -313,7 +362,7 @@ class CompiledTrainStep:
     # eligibility & fallback
     # ------------------------------------------------------------------
 
-    def _set_fallback(self, reason):
+    def _set_fallback(self, reason, category=UserWarning):
         self.sync_scaler()
         self._scaler_vec = None
         self._fallback_reason = reason
@@ -321,7 +370,7 @@ class CompiledTrainStep:
             self._warned = True
             warnings.warn(
                 f"compiled train step disabled ({reason}); running the "
-                "eager step for this model")
+                "eager step for this model", category)
 
     def check_static_eligibility(self):
         """One-time structural checks; returns None when eligible, else
@@ -488,9 +537,108 @@ class CompiledTrainStep:
             p.optimize_attr.get("learning_rate", 1.0) for p in self._params)
         self._wd_mask = tuple(opt._wd_applies(p) for p in self._params)
         self._state_names = tuple(opt._state)
-        self._mesh = _resolve_mesh(self._mesh_arg)
-        self._dp = self._mesh.get_dim_size("dp") if self._mesh else 1
+        self._mesh, blocked = _resolve_mesh(self._mesh_arg)
+        if blocked == "mp":      # only blocked when the flag is off
+            raise _MeshEscape("mesh axis 'mp' present but "
+                              "FLAGS_compiled_mp_step is off")
+        if blocked is not None:
+            raise _MeshEscape(
+                f"mesh axis '{blocked}' cannot run inside one compiled "
+                "program (pipeline schedules, ZeRO resharding and "
+                "context parallel keep their own lanes)")
+        names = self._mesh.dim_names if self._mesh is not None else ()
+        self._dp = self._mesh.get_dim_size("dp") if "dp" in names else 1
+        self._mp = self._mesh.get_dim_size("mp") if "mp" in names else 1
+        self._shard_map = self._mesh is not None and self._mp == 1
+        self._gspmd = self._mesh is not None and self._mp > 1
+        if self._gspmd:
+            self._build_sharding_trees()
         self._built = True
+
+    # ------------------------------------------------------------------
+    # hybrid dp×mp: NamedSharding trees + state realignment
+    # ------------------------------------------------------------------
+
+    def _derived_sharding(self, t):
+        """The NamedSharding a captured tensor carries in the hybrid
+        program: its committed placements when they were declared on a
+        mesh with this step's axes (the TP layers' ``mp_placement``
+        annotations committed by ``fleet.distributed_model``), else its
+        current NamedSharding when already on this mesh, else
+        replicated."""
+        from jax.sharding import NamedSharding
+        from ..distributed.placement import named_sharding
+        arr = t._data_
+        placements = getattr(t, "placements", None)
+        pmesh = getattr(t, "process_mesh", None)
+        if placements and pmesh is not None and \
+                tuple(pmesh.dim_names) == tuple(self._mesh.dim_names):
+            return named_sharding(self._mesh, placements,
+                                  len(arr.shape))
+        sh = getattr(arr, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh == self._mesh.jax_mesh:
+            return sh
+        return self._rep
+
+    def _build_sharding_trees(self):
+        """Per-axis NamedSharding trees for params / grads / optimizer
+        state / captures, derived once from the model's declared
+        partition.  Gradients and moments mirror their parameter's
+        sharding (``zeros_like`` inheritance made explicit)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        jm = self._mesh.jax_mesh
+        self._rep = NamedSharding(jm, P())
+        self._psh = tuple(self._derived_sharding(p) for p in self._params)
+        self._csh = tuple(self._derived_sharding(t) for t in self._caps)
+
+    def _align_hybrid(self):
+        """Realign committed state onto the derived sharding tree.  The
+        warmup eager step leaves gradients / moments / buffers committed
+        with whatever sharding GSPMD propagation gave them; ``jax.jit``
+        raises on committed inputs whose sharding differs from
+        ``in_shardings`` (and donation would be unusable).  After the
+        first compiled call the program outputs already carry these
+        shardings, so this degenerates to one sharding compare per
+        leaf."""
+        opt = self._opt
+        for k, p in enumerate(self._params):
+            want = self._psh[k]
+            for t in (p, p.grad):
+                if t is not None and t._data_.sharding != want:
+                    t._data_ = jax.device_put(t._data_, want)
+            for name in self._state_names:
+                v = opt._state[name][self._idxs[k]]
+                if v is None:
+                    continue
+                w = want if v._data_.shape == p._data_.shape else self._rep
+                if v._data_.sharding != w:
+                    v._data_ = jax.device_put(v._data_, w)
+        for t, w in zip(self._caps, self._csh):
+            if t._data_.sharding != w:
+                t._data_ = jax.device_put(t._data_, w)
+        st = opt._step_tensor
+        if st._data_.sharding != self._rep:
+            st._data_ = jax.device_put(st._data_, self._rep)
+
+    def _hybrid_shardings(self, args):
+        """The full in_shardings pytree mirroring ``_gather_args``'s
+        ``(x, y, params, grads, caps, states, step, svec, lr, key,
+        hmark)`` — batch over dp, params/grads/moments per the derived
+        trees, scalars replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = self._rep
+        bsh = NamedSharding(self._mesh.jax_mesh, P("dp")) \
+            if self._dp > 1 else rep
+        _xa, ya, _params, _grads, _caps, states, _step, svec, _lr, \
+            _key, _hmark = args
+        ssh = {name: [None if a is None else
+                      (self._psh[k] if getattr(a, "shape", None)
+                       == self._params[k]._data_.shape else rep)
+                      for k, a in enumerate(vals)]
+               for name, vals in states.items()}
+        return (bsh, None if ya is None else bsh, self._psh, self._psh,
+                self._csh, ssh, rep, None if svec is None else rep, rep,
+                rep, rep)
 
     # ------------------------------------------------------------------
     # phase 2: the pure step body (replayed under jax.jit tracing)
@@ -529,7 +677,10 @@ class CompiledTrainStep:
                 grad_ids = {id(p.grad) for p in self._params}
                 mut_caps = [t for t in tracer.mutated_list
                             if id(t) not in grad_ids]
-                if mut_caps and self._dp > 1:
+                if mut_caps and self._shard_map:
+                    # the GSPMD lane computes mutated state over the
+                    # GLOBAL batch (single-device semantics); only the
+                    # per-shard shard_map lane cannot represent it
                     raise TraceEscape(
                         "forward mutates non-parameter state (running "
                         "stats?) — per-shard divergence under dp is not "
@@ -566,16 +717,19 @@ class CompiledTrainStep:
         if scaler_on:
             inv = 1.0 / svec[0]
             grads = [g * inv.astype(g.dtype) for g in grads]
-        if self._dp > 1:
+        if self._dp > 1 and self._shard_map:
             # the in-program analogue of _sync_grads' per-tensor
             # all_reduce + divide: one psum/pmean per gradient that XLA
-            # schedules/overlaps inside the step program
+            # schedules/overlaps inside the step program.  (The GSPMD
+            # hybrid lane needs no explicit pmean: differentiating the
+            # global-batch loss already yields globally-reduced
+            # gradients — XLA inserts and overlaps the dp all-reduce.)
             grads = [jax.lax.pmean(g, "dp") for g in grads]
         found = None
         if scaler_on:
             flags = [~jnp.isfinite(jnp.sum(g)) for g in grads]
             found = jnp.any(jnp.stack(flags))
-            if self._dp > 1:
+            if self._dp > 1 and self._shard_map:
                 # global decision — a scalar psum, not a host round-trip
                 found = jax.lax.pmax(found.astype(jnp.int32),
                                      "dp").astype(jnp.bool_)
@@ -594,7 +748,7 @@ class CompiledTrainStep:
                 # steps are skipped in-program here too
                 flags = [~jnp.isfinite(jnp.sum(g)) for g in grads]
                 found = jnp.any(jnp.stack(flags))
-                if self._dp > 1:
+                if self._dp > 1 and self._shard_map:
                     found = jax.lax.pmax(found.astype(jnp.int32),
                                          "dp").astype(jnp.bool_)
             # device-resident health vector [grad_norm_sq, skipped]:
@@ -668,14 +822,14 @@ class CompiledTrainStep:
     # compile + execute
     # ------------------------------------------------------------------
 
-    def _build_jit(self, update):
+    def _build_jit(self, update, args):
         from ..core.op_cache import ensure_compile_cache
         ensure_compile_cache()     # tier-2 persistent XLA compile cache
         mesh = self._mesh
 
         def fn(x, y, params, grads, caps, states, step_arr, svec, lr,
                key, hmark):
-            if self._dp > 1:
+            if self._shard_map:
                 from jax.experimental.shard_map import shard_map
                 from jax.sharding import PartitionSpec as P
 
@@ -698,6 +852,10 @@ class CompiledTrainStep:
                                  check_rep=False)(
                     x, y, params, grads, caps, states, step_arr, svec,
                     lr, key, hmark)
+            # single-device AND the hybrid dp×mp GSPMD lane: one global
+            # program — the mesh (when present) enters through the
+            # in_shardings trees and the model's own shard_constraints,
+            # and the traced math is exactly the single-device step
             return self._traced_body(update, x, y, params, grads, caps,
                                      states, step_arr, svec, lr, key,
                                      hmark=hmark)
@@ -709,14 +867,22 @@ class CompiledTrainStep:
             # buffers the program replaces in place
             donate = (2, 3, 5, 6, 7) if update else (3,)
         kwargs = {}
-        if self._dp > 1:
+        if self._shard_map:
             from jax.sharding import NamedSharding, PartitionSpec as P
             kwargs["out_shardings"] = NamedSharding(self._mesh.jax_mesh,
                                                     P())
+        elif self._gspmd:
+            # pin every input leaf to its derived sharding; output
+            # shardings are inferred by GSPMD propagation (the update
+            # chain is elementwise, so outputs land on the input
+            # shardings and donation stays usable)
+            kwargs["in_shardings"] = self._hybrid_shardings(args)
         return jax.jit(fn, donate_argnums=donate, **kwargs)
 
     def _gather_args(self, x, y):
         opt = self._opt
+        if self._gspmd:
+            self._align_hybrid()
         xa = x._data_ if isinstance(x, Tensor) else jnp.asarray(x)
         ya = y._data_ if isinstance(y, Tensor) else (
             None if y is None else jnp.asarray(y))
@@ -755,13 +921,21 @@ class CompiledTrainStep:
         if self._dp > 1 and (args[0].shape[0] % self._dp):
             # ragged tail batch cannot shard evenly: one-off eager step
             _monitor.incr("jit.compiled_step_ragged_fallback")
+            if self._gspmd:
+                # the model's own dp activation constraints cannot
+                # shard a ragged batch either — lift the mesh scope for
+                # this one step (sharded params compute the same values
+                # through GSPMD eager propagation)
+                from ..distributed import mesh as _mesh_mod
+                with _mesh_mod.suspended():
+                    return self._run_eager(x, y, update)
             return self._run_eager(x, y, update)
         if self._donating is not None and self._donating != bool(
                 _flag("FLAGS_jit_donate_buffers", True)):
             self._jit_full = self._jit_micro = None   # flag flipped
         jit = self._jit_full if update else self._jit_micro
         if jit is None:
-            jit = self._build_jit(update)
+            jit = self._build_jit(update, args)
             if update:
                 self._jit_full = jit
             else:

@@ -118,8 +118,21 @@ class ModelCheckpoint(Callback):
                 # what lets a resized relaunch reshard on resume
                 from ..distributed.reshard import (MeshSpec,
                                                    ShardedCheckpointer)
+                # the same factorization the resume side targets: the
+                # active hybrid mesh's axes when a plan is installed,
+                # else pure-dp (Model._checkpoint_mesh_spec) — a
+                # planner-chosen dp×mp layout round-trips through
+                # sharded checkpoints without PADDLE_RESHARD_MESH
+                spec_fn = getattr(self.model, "_checkpoint_mesh_spec",
+                                  None)
+                spec = spec_fn() if spec_fn is not None else \
+                    MeshSpec(("dp",), (nranks,))
+                if spec.world != nranks:
+                    # a local (in-process GSPMD) mesh does not factorize
+                    # the launched RANKS; shard files are per rank
+                    spec = MeshSpec(("dp",), (nranks,))
                 self._manager = ShardedCheckpointer(
-                    self.save_dir, MeshSpec(("dp",), (nranks,)),
+                    self.save_dir, spec,
                     rank=getattr(self.model, "_rank", 0),
                     max_to_keep=self.max_to_keep)
             else:

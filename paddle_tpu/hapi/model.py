@@ -554,20 +554,41 @@ class Model:
         except Exception:
             pass
 
+    def _checkpoint_mesh_spec(self):
+        """The rank factorization sharded checkpoints use for BOTH save
+        and resume.  When a hybrid ``ProcessMesh`` is active (the
+        auto-layout planner's ``plan.build_mesh()``, or an operator's
+        ``with mesh:`` scope) its >1 axes ARE the factorization — the
+        plan's layout round-trips through sharded checkpoints with no
+        env override.  Otherwise the hapi trainer is data-parallel and
+        the spec is pure-dp over the launched world."""
+        from ..distributed.mesh import get_mesh
+        from ..distributed.reshard import MeshSpec
+        mesh = get_mesh()
+        if mesh is not None and any(
+                mesh.get_dim_size(n) > 1 for n in mesh.dim_names
+                if n != "dp"):
+            axes = [n for n in mesh.dim_names if mesh.get_dim_size(n) > 1]
+            return MeshSpec(tuple(axes),
+                            tuple(mesh.get_dim_size(n) for n in axes))
+        return MeshSpec(("dp",), (max(self._nranks, 1),))
+
     def _resume_target_mesh(self):
-        """The mesh this incarnation reshards checkpoints onto.  The hapi
-        trainer is data-parallel, so the target is the pure-dp mesh over
-        the current world — which also matches what ModelCheckpoint saves,
-        keeping the same-topology resume on the zero-copy fast path.  A
+        """The mesh this incarnation reshards checkpoints onto: the
         ``PADDLE_RESHARD_MESH`` env override (an operator or controller
-        pinning a dp×mp plan, cf. fleet.elastic.reshard_mesh_for) wins."""
+        pinning a plan, cf. fleet.elastic.reshard_mesh_for) wins, then
+        the active hybrid mesh's factorization
+        (:meth:`_checkpoint_mesh_spec` — the planner's dp×mp plan needs
+        no env override), then pure-dp over the current world — which
+        matches what ModelCheckpoint saves, keeping the same-topology
+        resume on the zero-copy fast path."""
         import json as _json
         from ..distributed.reshard import MeshSpec
         raw = os.environ.get("PADDLE_RESHARD_MESH")
         if raw:
             obj = _json.loads(raw)
             return MeshSpec(obj["axes"], obj["shape"])
-        return MeshSpec(("dp",), (max(self._nranks, 1),))
+        return self._checkpoint_mesh_spec()
 
     def _resume_from(self, resume, save_dir, ckpt_cb):
         """Restore model/optimizer/epoch from the latest valid checkpoint;

@@ -173,6 +173,8 @@ def budget_report(compiled_hlo_text, mesh, device="v5e",
         a["projected_seconds"] = t
         total_time += t
         rows.append(a)
-    return {"collectives": rows,
+    from ..cost_model.planner import COMM_BUDGET_SCHEMA_VERSION
+    return {"schema_version": COMM_BUDGET_SCHEMA_VERSION,
+            "collectives": rows,
             "projected_comm_seconds_per_step": total_time,
             "n_instructions": len(records)}

@@ -35,6 +35,13 @@ _FLAGS: dict[str, Any] = {
     # compilation cache is enabled there, so re-runs skip XLA recompiles
     # across processes (applies to to_static, static programs, sot
     # segments, onnx modules, bench.py and tier-1 misses alike).
+    # hybrid dp×mp compiled train step (framework/train_step.py,
+    # docs/TRAIN_STEP.md): a ProcessMesh with an mp axis > 1 compiles
+    # the step as ONE GSPMD program over NamedSharding trees derived
+    # from the model's declared partition.  Off: mp meshes run the
+    # byte-identical eager lane (the pre-ISSUE-12 behavior); pure-dp
+    # meshes and single-device steps are unaffected either way.
+    "FLAGS_compiled_mp_step": True,
     "FLAGS_eager_op_cache": True,
     "FLAGS_eager_op_cache_size": 4096,
     "FLAGS_compile_cache_dir": "",

@@ -486,6 +486,376 @@ def test_pallas_lane_through_optimizer_step_matches_flag_off(
     assert on.tobytes() == off.tobytes()
 
 
+# ------------------------------------------------- hybrid dp x mp lane
+#
+# ISSUE 12: a ProcessMesh with an mp axis compiles the step as ONE
+# GSPMD program over NamedSharding trees derived from the TP layers'
+# declared partitions.  Equality contract vs the single-device step:
+# ulp-level, NOT bitwise — the row-parallel product is a partial-sum
+# all-reduce whose fp32 accumulation order differs from one fused
+# matmul's (docs/TRAIN_STEP.md "Hybrid parallel").
+
+
+@pytest.fixture
+def _mesh_guard():
+    from paddle_tpu.distributed import mesh as mesh_mod
+    yield mesh_mod
+    mesh_mod.set_mesh(None)
+
+
+def _mp_net(clip=None, lr=0.01):
+    from paddle_tpu.distributed.fleet.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
+    paddle.seed(0)
+    net = nn.Sequential(
+        ColumnParallelLinear(8, 16, gather_output=False),
+        nn.ReLU(),
+        RowParallelLinear(16, 4, input_is_parallel=True))
+    opt = paddle.optimizer.AdamW(lr, parameters=net.parameters(),
+                                 weight_decay=0.01, grad_clip=clip)
+    return net, opt
+
+
+def _run_mp(mesh, compiled, clip=None, steps=8, accum=1, hook=None,
+            batches=None):
+    """Train the TP MLP on ``mesh`` (None = single device) through a
+    standalone CompiledTrainStep; returns (losses, weights, step)."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.base import _commit_params
+    from paddle_tpu.framework.train_step import CompiledTrainStep
+
+    mesh_mod.set_mesh(mesh)
+    paddle.set_flags({"FLAGS_compiled_train_step": compiled})
+    net, opt = _mp_net(clip=clip)
+    if mesh is not None:
+        _commit_params(net, mesh)
+    if hook:
+        hook(net)
+
+    def forward(x, y):
+        return ((net(x) - y) ** 2).mean()
+
+    cs = CompiledTrainStep(forward, opt, network=net,
+                           accumulate_grad_batches=accum)
+    losses = []
+    for i, (x, y) in enumerate(batches or _batches(steps=steps)):
+        update = (i + 1) % accum == 0
+        loss = cs(paddle.to_tensor(x), paddle.to_tensor(y),
+                  update=update)
+        losses.append(float(np.asarray(loss._data_)))
+    weights = [np.asarray(p._data_).copy() for p in net.parameters()]
+    grads = [None if p.grad is None else np.asarray(p.grad._data_).copy()
+             for p in net.parameters()]
+    mesh_mod.set_mesh(None)
+    return losses, weights, grads, cs
+
+
+def test_mp_mesh_matches_single_device(_mesh_guard):
+    """mp=2: the GSPMD one-program step trains the TP-sharded MLP to
+    the single-device trajectory at ulp tolerance, with the compiled
+    lane genuinely on and the mesh recognized as hybrid."""
+    le, we, _, _ = _run_mp(None, False)
+    mesh = _mesh_guard.init_mesh([1, 2], ["dp", "mp"])
+    lc, wc, _, cs = _run_mp(mesh, True)
+    assert cs.compiled, cs.fallback_reason
+    assert cs._gspmd and cs._mp == 2 and not cs._shard_map
+    _assert_ulp_close(le, lc, rel=5e-6)
+    for a, b in zip(we, wc):
+        np.testing.assert_allclose(a, b, rtol=5e-6, atol=5e-7)
+
+
+def test_mp_partial_sum_grads_match_single_device(_mesh_guard):
+    """Backward-only micro-steps (accum=2): the mp partial-sum grad
+    reduction (row-parallel all-reduce inserted by GSPMD) matches the
+    single-device gradients tightly, through the compiled micro
+    program."""
+    batches = _batches(steps=3)
+    _, _, ge, _ = _run_mp(None, False, accum=4, batches=batches)
+    mesh = _mesh_guard.init_mesh([1, 2], ["dp", "mp"])
+    _, _, gc, cs = _run_mp(mesh, True, accum=4, batches=batches)
+    assert cs.compiled and cs._jit_micro is not None
+    assert ge and all(g is not None for g in ge)
+    for a, b in zip(ge, gc):
+        np.testing.assert_allclose(a, b, rtol=5e-6, atol=5e-7)
+
+
+def test_mp_clip_active_matches_single_device(_mesh_guard):
+    """ACTIVE global-norm clip over mp-sharded grads: the norm crosses
+    the mp axis inside the program; trajectories stay ulp-close."""
+    clip = nn.ClipGradByGlobalNorm(0.05)
+    le, we, _, _ = _run_mp(None, False, clip=clip)
+    mesh = _mesh_guard.init_mesh([1, 2], ["dp", "mp"])
+    lc, wc, _, cs = _run_mp(mesh, True, clip=clip)
+    assert cs.compiled, cs.fallback_reason
+    assert len(set(np.float32(lc))) > 3
+    _assert_ulp_close(le, lc, rel=5e-6)
+    for a, b in zip(we, wc):
+        np.testing.assert_allclose(a, b, rtol=5e-6, atol=5e-7)
+
+
+def test_dp_mp_2x2_mesh_and_ragged_fallback(_mesh_guard):
+    """dp=2 × mp=2: batch shards over dp, params over mp, one program;
+    a ragged tail batch runs a one-off eager step (mesh scope lifted —
+    the model's own dp constraint cannot shard batch 3) and the
+    compiled lane resumes un-latched."""
+    from paddle_tpu.utils import monitor
+
+    le, we, _, _ = _run_mp(None, False)
+    mesh = _mesh_guard.init_mesh([2, 2], ["dp", "mp"])
+    lc, wc, _, cs = _run_mp(mesh, True)
+    assert cs.compiled and cs._dp == 2 and cs._mp == 2
+    _assert_ulp_close(le, lc, rel=5e-6)
+    for a, b in zip(we, wc):
+        np.testing.assert_allclose(a, b, rtol=5e-6, atol=5e-7)
+
+    _mesh_guard.set_mesh(mesh)
+    ragged = monitor.all_stats().get("jit.compiled_step_ragged_fallback",
+                                     0)
+    loss = cs(paddle.to_tensor(np.zeros((3, 8), np.float32)),
+              paddle.to_tensor(np.zeros((3, 4), np.float32)))
+    assert np.isfinite(float(np.asarray(loss._data_)))
+    assert monitor.all_stats().get(
+        "jit.compiled_step_ragged_fallback", 0) == ragged + 1
+    assert cs.fallback_reason is None
+    x4, y4 = _batches(steps=1)[0]
+    cs(paddle.to_tensor(x4), paddle.to_tensor(y4))
+    assert cs.compiled
+
+
+def test_mp_hook_fallback_byte_identical(_mesh_guard):
+    """Layer hooks on an mp-sharded model: the latch falls back to the
+    byte-identical eager mp lane (same GSPMD eager ops), exactly like
+    the dp-only latch."""
+    seen = []
+
+    def install(net):
+        net[0].register_forward_post_hook(
+            lambda layer, inp, out: seen.append(1) or out)
+
+    mesh = _mesh_guard.init_mesh([1, 2], ["dp", "mp"])
+    le, we, _, _ = _run_mp(mesh, False, hook=install)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        lc, wc, _, cs = _run_mp(mesh, True, hook=install)
+    assert "hook" in (cs.fallback_reason or "")
+    assert seen
+    assert [np.float32(a) for a in le] == [np.float32(b) for b in lc]
+    for a, b in zip(we, wc):
+        assert a.tobytes() == b.tobytes()
+
+
+def test_unsupported_mesh_axis_warns_typed_once(_mesh_guard):
+    """A pp>1 mesh axis forces eager with ONE MeshFallbackWarning
+    naming the axis; training continues byte-identically to eager."""
+    from paddle_tpu.framework.train_step import MeshFallbackWarning
+
+    mesh = _mesh_guard.init_mesh([1, 2], ["dp", "pp"])
+    le, we, _, _ = _run_mp(mesh, False)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        lc, wc, _, cs = _run_mp(mesh, True)
+    typed = [r for r in rec
+             if issubclass(r.category, MeshFallbackWarning)]
+    assert len(typed) == 1, [str(r.message) for r in rec]
+    assert "'pp'" in str(typed[0].message)
+    assert "'pp'" in (cs.fallback_reason or "")
+    assert [np.float32(a) for a in le] == [np.float32(b) for b in lc]
+    for a, b in zip(we, wc):
+        assert a.tobytes() == b.tobytes()
+
+
+def test_mp_donation_alias_tied_buffers_skips_compiled_call(_mesh_guard):
+    """Two mp-sharded parameters backed by ONE device buffer: the alias
+    check must detect it per call and run eager — donating one buffer
+    for two outputs is as unsound on a mesh as off it."""
+    from paddle_tpu.distributed.placement import Replicate, Shard, \
+        commit_param
+    from paddle_tpu.framework.train_step import CompiledTrainStep
+    from paddle_tpu.utils import monitor
+
+    mesh = _mesh_guard.init_mesh([1, 2], ["dp", "mp"])
+    _mesh_guard.set_mesh(mesh)
+    paddle.set_flags({"FLAGS_compiled_train_step": True})
+    paddle.seed(0)
+    w1 = paddle.Parameter(np.ones((4, 8), np.float32))
+    w2 = paddle.Parameter(np.ones((4, 8), np.float32))
+    commit_param(w1, mesh, [Replicate(), Shard(1)])
+    w2._data_ = w1._data_
+    w2.placements = list(w1.placements)
+    w2.process_mesh = mesh
+    opt = paddle.optimizer.AdamW(0.05, parameters=[w1, w2])
+
+    def forward(x, y):
+        return (((x @ w1) + (x @ w2) - y) ** 2).mean()
+
+    cs = CompiledTrainStep(forward, opt)
+    before = monitor.all_stats().get("jit.compiled_step_alias_fallback",
+                                     0)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((2, 8), np.float32))
+    for _ in range(3):
+        loss = cs(x, y)
+        assert np.isfinite(float(np.asarray(loss._data_)))
+        w2._data_ = w1._data_            # re-tie: keep the alias live
+    assert monitor.all_stats().get(
+        "jit.compiled_step_alias_fallback", 0) > before
+
+
+# ------------------------------------------------- auto-layout planner
+
+
+_PLANNER_DESC = dict(n_params=2e9, n_layers=2, hidden=16,
+                     global_batch=4, seq_len=32)
+
+
+def test_planner_deterministic_and_budget_schema_gate(tmp_path,
+                                                     monkeypatch):
+    """Same inputs -> byte-identical plan (the elastic re-plan must
+    agree across processes); a COMM_BUDGET file with a stale
+    schema_version fails loudly instead of skewing plans."""
+    from paddle_tpu.cost_model import (BudgetSchemaError, plan_layout,
+                                       load_comm_budgets)
+
+    p1 = plan_layout(_PLANNER_DESC, 8)
+    p2 = plan_layout(_PLANNER_DESC, 8)
+    assert p1.to_json() == p2.to_json()
+    assert p1.dp * p1.mp * p1.pp == 8
+    assert p1.mp > 1            # parameter-heavy desc: mp must win
+    spec = p1.mesh_spec()
+    assert spec.world == 8
+
+    # the recorded budgets load and validate
+    budgets = load_comm_budgets()
+    assert {"gpt-dp", "llama-tp", "moe"} <= set(budgets)
+    p3 = plan_layout(dict(_PLANNER_DESC, comm_budget="llama-tp"), 8)
+    assert p3.source.startswith("roofline+budget:")
+
+    # stale schema_version -> loud BudgetSchemaError naming the file
+    bad = tmp_path / "COMM_BUDGET_stale.json"
+    bad.write_text(json.dumps({"schema_version": 0, "collectives": [],
+                               "mesh": {}}))
+    monkeypatch.setenv("PADDLE_COMM_BUDGET_DIR", str(tmp_path))
+    with pytest.raises(BudgetSchemaError) as ei:
+        load_comm_budgets()
+    assert "COMM_BUDGET_stale.json" in str(ei.value)
+    # ...and a budget-less file (pre-versioning) is just as loud
+    bad.write_text(json.dumps({"collectives": [], "mesh": {}}))
+    with pytest.raises(BudgetSchemaError):
+        load_comm_budgets()
+
+
+def test_resume_target_mesh_derives_from_active_plan(_mesh_guard,
+                                                     monkeypatch):
+    """fit(resume=...)'s reshard target: PADDLE_RESHARD_MESH wins, then
+    the ACTIVE hybrid mesh's factorization (the planner's plan needs no
+    env override), then pure-dp."""
+    from paddle_tpu.distributed.reshard import MeshSpec
+
+    net = nn.Sequential(nn.Linear(4, 4))
+    m = Model(net)
+    assert m._resume_target_mesh() == MeshSpec(("dp",), (1,))
+    mesh = _mesh_guard.init_mesh([1, 2], ["dp", "mp"])
+    _mesh_guard.set_mesh(mesh)
+    assert m._resume_target_mesh() == MeshSpec(("mp",), (2,))
+    monkeypatch.setenv("PADDLE_RESHARD_MESH",
+                       json.dumps({"axes": ["dp"], "shape": [4]}))
+    assert m._resume_target_mesh() == MeshSpec(("dp",), (4,))
+
+
+def test_plan_topology_resize_4_to_2_replans_and_roundtrips(
+        _mesh_guard, tmp_path):
+    """The elastic 4->2 resize drill on planner meshes: train on the
+    world-4 plan (mp=4), checkpoint SHARDED per the plan's layout,
+    re-plan for world 2 (mp=2), reshard-restore, continue — the resumed
+    trajectory matches the uninterrupted run within 5e-4, with a real
+    reshard (no fast path) in between."""
+    from concurrent.futures import ThreadPoolExecutor
+    from paddle_tpu.distributed.fleet.base import _commit_params
+    from paddle_tpu.distributed.fleet.elastic import plan_topology
+    from paddle_tpu.distributed.reshard import (
+        MeshSpec, partition_from_tensor, restore_latest_resharded,
+        save_sharded)
+    from paddle_tpu.framework.train_step import CompiledTrainStep
+
+    batches = _batches(steps=6)
+    ref_losses, ref_w, _, _ = _run_mp(None, False, batches=batches)
+
+    plan4 = plan_topology(4, _PLANNER_DESC)
+    plan2 = plan_topology(2, _PLANNER_DESC)
+    assert plan4["mp"] > 1 and plan2["mp"] > 1    # genuinely re-planned
+    assert plan4["dp"] * plan4["mp"] == 4
+    assert plan2["dp"] * plan2["mp"] == 2
+
+    def mesh_for(plan):
+        return _mesh_guard.init_mesh([plan["dp"], plan["mp"]],
+                                     ["dp", "mp"])
+
+    def spec_for(plan):
+        return MeshSpec(("dp", "mp"), (plan["dp"], plan["mp"]))
+
+    # ---- first incarnation: world-4 plan, 3 steps, sharded save ----
+    mesh4 = mesh_for(plan4)
+    _mesh_guard.set_mesh(mesh4)
+    paddle.set_flags({"FLAGS_compiled_train_step": True})
+    net, opt = _mp_net()
+    _commit_params(net, mesh4)
+    cs = CompiledTrainStep(lambda x, y: ((net(x) - y) ** 2).mean(), opt,
+                           network=net)
+    losses = []
+    for x, y in batches[:3]:
+        losses.append(float(np.asarray(
+            cs(paddle.to_tensor(x), paddle.to_tensor(y))._data_)))
+    assert cs.compiled and cs._mp == plan4["mp"], cs.fallback_reason
+
+    spec4 = spec_for(plan4)
+    state = {"model": net.state_dict(), "optimizer": opt.state_dict()}
+    tensors = {f"model.{k}": v for k, v in state["model"].items()}
+
+    def partition_fn(key, arr):
+        t = tensors.get(key)
+        if t is None:
+            return (None,) * arr.ndim
+        return partition_from_tensor(t, spec4)
+
+    assert any(a is not None
+               for k in tensors
+               for a in partition_fn(k, np.asarray(tensors[k]._data_)))
+    ckdir = tmp_path / "ckpt-00000001"
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        futs = [ex.submit(save_sharded, str(ckdir), state, spec4, r,
+                          partition_fn=partition_fn, step=1)
+                for r in range(spec4.world)]
+        for f in futs:
+            f.result(timeout=120)
+    _mesh_guard.set_mesh(None)
+
+    # ---- resized incarnation: world-2 plan, reshard-restore ----
+    mesh2 = mesh_for(plan2)
+    _mesh_guard.set_mesh(mesh2)
+    restored = restore_latest_resharded(str(tmp_path), spec_for(plan2),
+                                        0)
+    assert restored is not None
+    state2, _step, report = restored
+    assert not report["fast_path"] and report["arrays_resharded"] > 0
+    net2, opt2 = _mp_net()
+    _commit_params(net2, mesh2)
+    net2.set_state_dict(state2["model"])
+    opt2.set_state_dict(state2["optimizer"])
+    cs2 = CompiledTrainStep(lambda x, y: ((net2(x) - y) ** 2).mean(),
+                            opt2, network=net2)
+    for x, y in batches[3:]:
+        losses.append(float(np.asarray(
+            cs2(paddle.to_tensor(x), paddle.to_tensor(y))._data_)))
+    assert cs2.compiled and cs2._mp == plan2["mp"], cs2.fallback_reason
+    _mesh_guard.set_mesh(None)
+
+    for a, b in zip(ref_losses, losses):
+        assert abs(a - b) <= 5e-4 * max(abs(a), 1.0), (a, b)
+    final_w = [np.asarray(p._data_) for p in net2.parameters()]
+    for a, b in zip(ref_w, final_w):
+        np.testing.assert_allclose(a, b, rtol=5e-4, atol=5e-5)
+
+
 # ------------------------------------------------------- observability
 
 

@@ -197,6 +197,98 @@ def check_train_step_bench(run):
     return 0
 
 
+_MFU_SWEEP_SCHEMA = {
+    # key -> accepted types; every key is required
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "world_size": int,
+    "model": dict,
+    "layouts": dict,
+    "speedup_hybrid_vs_dp": (int, float),
+    "planner": dict,
+    "steps": int,
+    "batch": int,
+    "seq": int,
+    "smoke": bool,
+    "platform": str,
+}
+_MFU_LAYOUT_KEYS = ("dp", "mp", "p50_ms", "tokens_per_sec", "compiled",
+                    "projected_ms", "projected_err", "anchor", "mfu")
+
+# acceptance floors (ISSUE 12): at equal world size the hybrid
+# dp×mp compiled step must beat the dp-only compiled step by >= 1.3x
+# step-time p50 on the parameter-heavy sweep config (pure dp moves the
+# full model per step in its grad all-reduce and replicates the
+# optimizer update; smoke clears ~3.5x), the planner's pick must match
+# or beat every hand-written layout on the grid (<= 5% of the measured
+# best), and the calibrated projection must land within 25% of the
+# measured step time on held-out layouts.
+_MFU_MIN_HYBRID_SPEEDUP = 1.3
+_MFU_MAX_PICK_VS_BEST = 1.05
+_MFU_MAX_PROJECTED_ERR = 0.25
+
+
+def check_mfu_sweep(run):
+    """Schema + hybrid-speedup/planner gates for
+    benchmarks/mfu_sweep.py (layout sweep, MFU_SWEEP.json)."""
+    errors = []
+    for key, types in _MFU_SWEEP_SCHEMA.items():
+        if key not in run:
+            errors.append(f"missing key {key!r}")
+        elif run[key] is None or not isinstance(run[key], types):
+            errors.append(f"{key!r} has type {type(run[key]).__name__}, "
+                          f"expected {types}")
+    if not errors:
+        if len(run["layouts"]) < 2:
+            errors.append("fewer than 2 layouts measured — nothing to "
+                          "compare")
+        for name, lay in run["layouts"].items():
+            for k in _MFU_LAYOUT_KEYS:
+                if k not in lay:
+                    errors.append(f"layouts.{name} missing {k!r}")
+            if not lay.get("compiled"):
+                errors.append(f"layouts.{name} fell back to eager "
+                              f"({lay.get('fallback_reason')}) — the "
+                              "sweep measured the wrong lane")
+        losses = {round(lay.get("loss", 0), 4)
+                  for lay in run["layouts"].values()}
+        if len(losses) != 1:
+            errors.append(f"per-layout losses diverged: {sorted(losses)}"
+                          " — layouts did not compute the same step")
+        if run["speedup_hybrid_vs_dp"] < _MFU_MIN_HYBRID_SPEEDUP:
+            errors.append(
+                f"speedup_hybrid_vs_dp {run['speedup_hybrid_vs_dp']:.2f}"
+                f" < required {_MFU_MIN_HYBRID_SPEEDUP}x at equal world "
+                "size")
+        planner = run["planner"]
+        if not planner.get("pick_measured"):
+            errors.append("planner pick was not on the measured grid")
+        ratio = planner.get("pick_vs_best")
+        if not isinstance(ratio, (int, float)) or \
+                ratio > _MFU_MAX_PICK_VS_BEST:
+            errors.append(
+                f"planner pick is {ratio!r}x the measured-best layout "
+                f"(> {_MFU_MAX_PICK_VS_BEST}) — the planner lost to a "
+                "hand-written layout")
+        err = planner.get("max_projected_err")
+        if not isinstance(err, (int, float)) or \
+                err > _MFU_MAX_PROJECTED_ERR:
+            errors.append(
+                f"max projected-vs-measured error {err!r} > "
+                f"{_MFU_MAX_PROJECTED_ERR} on held-out layouts")
+    if errors:
+        print("mfu_sweep schema check FAILED:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(f"mfu_sweep schema OK: best layout dp{run['planner']['pick']['dp']}"
+          f"xmp{run['planner']['pick']['mp']} at {run['value']:.1f}ms, "
+          f"{run['speedup_hybrid_vs_dp']:.2f}x vs dp-only, planner err "
+          f"{run['planner']['max_projected_err']:.3f}")
+    return 0
+
+
 _SERVING_SCHEMA = {
     # key -> accepted types; every key is required
     "metric": str,
@@ -543,6 +635,8 @@ def main():
         return check_eager_overhead(run)
     if str(run.get("metric", "")).startswith("train_step"):
         return check_train_step_bench(run)
+    if str(run.get("metric", "")).startswith("mfu_sweep"):
+        return check_mfu_sweep(run)
     if str(run.get("metric", "")).startswith("serving_fleet"):
         return check_fleet_bench(run)
     if str(run.get("metric", "")).startswith("serving_speculative"):

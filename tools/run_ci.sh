@@ -97,6 +97,16 @@ echo "== compiled train step bench (smoke: >=1.5x vs eager + ulp-equal trajector
 python benchmarks/train_step_bench.py --smoke --out /tmp/train_step_ci.json
 python tools/check_bench_result.py /tmp/train_step_ci.json
 
+echo "== hybrid-parallel layout sweep (dp x mp grid on a 4-device world: >=1.3x vs dp-only + planner gates) =="
+# bounded: three subprocess layouts on the virtual CPU mesh, ~90s wall.
+# Gates (ISSUE 12): hybrid compiled step >= 1.3x the dp-only compiled
+# step at equal world size, the planner's pick matches or beats every
+# hand layout, projections land within 25% of measured (two-anchor
+# calibrated), and every COMM_BUDGET file passes its schema gate.
+timeout -k 10 600 python benchmarks/mfu_sweep.py --smoke \
+    --out /tmp/mfu_sweep_ci.json
+python tools/check_bench_result.py /tmp/mfu_sweep_ci.json
+
 echo "== sentinel rollback drill (loss spike -> anchor rollback -> replay-with-skip) =="
 # bounded: the fast in-process drills prove detection + rollback +
 # quarantined replay match a clean run, then the worker produces a
