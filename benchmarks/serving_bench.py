@@ -158,6 +158,105 @@ def _run_prefix_workload(paddle, args):
     }
 
 
+def _run_occupancy_workload(paddle, args):
+    """High-occupancy compiled-tick lane (ISSUE 13): 8+ slots of short
+    decodes — the regime where Python glue between the per-iteration
+    compiled calls (dispatch, per-slot sampling syncs, bookkeeping) is
+    the tokens/sec ceiling — served by the same paged engine with
+    `FLAGS_compiled_tick` off (the uncompiled scheduler) vs on (ONE
+    donated-buffer program per tick).  Greedy outputs must be bit-equal
+    to the sequential generate() reference on BOTH lanes, and a seeded
+    sampled batch must be bit-equal ACROSS lanes (the key-derived
+    per-request streams are lane-independent)."""
+    from paddle_tpu.serving import SamplingParams, ServingConfig
+    from paddle_tpu.utils import flags as _flags
+    import jax
+
+    num_slots = 8
+    n_req = 16 if args.smoke else 32
+    max_new = 10 if args.smoke else 16
+    paddle.seed(0)
+    model = _build_model(paddle)
+    rng = np.random.default_rng(42)
+    prompts = [rng.integers(0, 512, (int(rng.integers(4, 10)),))
+               .astype("int32") for _ in range(n_req)]
+    cfg = lambda: ServingConfig(num_slots=num_slots,  # noqa: E731
+                                max_queue=n_req + 1)
+    seq_out, _, _ = _run_sequential(paddle, model, prompts, max_new)
+
+    flag0 = _flags._FLAGS.get("FLAGS_compiled_tick", True)
+    lanes = {}
+    sampled = {}
+    snaps = {}
+    try:
+        for name, flagval in (("uncompiled", False), ("compiled", True)):
+            _flags._FLAGS["FLAGS_compiled_tick"] = flagval
+            # ONE engine per lane: the warm request pays every
+            # executable build (decode program, prefill program, the
+            # tick program + its XLA compile) off the clock — steady-
+            # state serving is what the lane measures
+            from paddle_tpu.serving import Engine
+            eng = Engine(model, cfg()).start()
+            try:
+                eng.submit(prompts[0], max_new_tokens=2).result(
+                    timeout=600)
+                t0 = time.perf_counter()
+                futs = [eng.submit(p, max_new_tokens=max_new)
+                        for p in prompts]
+                outs = [f.result(timeout=600) for f in futs]
+                wall = time.perf_counter() - t0
+                tokens = sum(o.output_ids.size for o in outs)
+                lanes[name] = {
+                    "outs": [o.output_ids for o in outs],
+                    "tokens_per_sec": tokens / wall, "wall_s": wall,
+                    "tokens": tokens,
+                }
+                # seeded sampled batch: streams must be lane-independent
+                futs = [eng.submit(
+                    p, max_new_tokens=max_new,
+                    sampling=SamplingParams(temperature=0.8, top_k=40,
+                                            seed=1000 + i))
+                    for i, p in enumerate(prompts[:num_slots])]
+                sampled[name] = [f.result(timeout=600).output_ids
+                                 for f in futs]
+                snaps[name] = eng.stats()
+            finally:
+                eng.shutdown()
+    finally:
+        _flags._FLAGS["FLAGS_compiled_tick"] = flag0
+
+    greedy_mismatches = sum(
+        0 if np.array_equal(lanes[name]["outs"][i], seq_out[i]) else 1
+        for name in lanes for i in range(n_req))
+    sampled_mismatches = sum(
+        0 if np.array_equal(a, b) else 1
+        for a, b in zip(sampled["uncompiled"], sampled["compiled"]))
+    base_tps = lanes["uncompiled"]["tokens_per_sec"]
+    tick_tps = lanes["compiled"]["tokens_per_sec"]
+    return {
+        "metric": "serving_tick_occupancy_cpu",
+        "value": tick_tps,
+        "unit": "tokens_per_sec",
+        "speedup_vs_uncompiled": tick_tps / base_tps,
+        "uncompiled": {k: v for k, v in lanes["uncompiled"].items()
+                       if k != "outs"},
+        "compiled": {k: v for k, v in lanes["compiled"].items()
+                     if k != "outs"},
+        "tick_compiled_hits": snaps["compiled"]["tick_compiled_hits"],
+        "tick_fallbacks": snaps["compiled"]["tick_fallbacks"],
+        "tick_ms_avg_uncompiled": snaps["uncompiled"]["tick_ms_avg"],
+        "tick_ms_avg_compiled": snaps["compiled"]["tick_ms_avg"],
+        "slot_occupancy": snaps["compiled"]["slot_occupancy"],
+        "num_slots": num_slots,
+        "num_requests": n_req,
+        "max_new_tokens": max_new,
+        "greedy_mismatches": greedy_mismatches,
+        "sampled_mismatches": sampled_mismatches,
+        "smoke": bool(args.smoke),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def _build_spec_models(paddle):
     """Target/draft pair for the speculative lane.
 
@@ -290,17 +389,21 @@ def main():
     ap.add_argument("--smoke", action="store_true",
                     help="CI scale: 6 requests x 12 tokens")
     ap.add_argument("--workload", default="mixed",
-                    choices=("mixed", "prefix", "speculative"),
+                    choices=("mixed", "prefix", "speculative",
+                             "occupancy"),
                     help="mixed: the PR 3 continuous-batching lane; "
                          "prefix: long-context shared-prefix lane "
                          "(paged vs slot engine at equal cache bytes); "
                          "speculative: draft-model speculation + int8 "
                          "KV capacity lane (spec vs plain paged engine "
-                         "at batch 1 and 4)")
+                         "at batch 1 and 4); occupancy: high-occupancy "
+                         "compiled-tick lane (8 slots, short decodes, "
+                         "FLAGS_compiled_tick on vs off)")
     ap.add_argument("--out", default=None,
                     help="result path (default benchmarks/"
-                         "SERVING_BENCH.json, SERVING_PAGED_BENCH.json "
-                         "or SERVING_SPEC_BENCH.json)")
+                         "SERVING_BENCH.json, SERVING_PAGED_BENCH.json, "
+                         "SERVING_SPEC_BENCH.json or "
+                         "SERVING_TICK_BENCH.json)")
     ap.add_argument("--no-write", action="store_true")
     args = ap.parse_args()
     if args.smoke:
@@ -309,6 +412,21 @@ def main():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
     import paddle_tpu as paddle
+
+    if args.workload == "occupancy":
+        rec = _run_occupancy_workload(paddle, args)
+        out_path = args.out or os.path.join(
+            os.path.dirname(__file__), "SERVING_TICK_BENCH.json")
+        if not args.no_write:
+            with open(out_path, "w") as f:
+                json.dump(rec, f, indent=1)
+            print(f"wrote {out_path}", file=sys.stderr)
+        print(json.dumps({k: rec[k] for k in
+                          ("metric", "value", "speedup_vs_uncompiled",
+                           "tick_compiled_hits", "greedy_mismatches",
+                           "sampled_mismatches")}))
+        return 0 if rec["greedy_mismatches"] == 0 \
+            and rec["sampled_mismatches"] == 0 else 1
 
     if args.workload == "speculative":
         rec = _run_spec_workload(paddle, args)

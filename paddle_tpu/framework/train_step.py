@@ -71,6 +71,7 @@ import jax.numpy as jnp
 from ..core import state as _state
 from ..core.tensor import Tensor
 from ..utils.flags import flag as _flag
+from .capture import BindTracer, Installed, TraceEscape, run_discovery
 
 
 _DONATED_FAILURE_MSG = (
@@ -87,14 +88,6 @@ class MeshFallbackWarning(UserWarning):
     eager fallback."""
 
 
-class TraceEscape(Exception):
-    """Raised when the step body performs a host interaction the
-    compiled program cannot replay; the step falls back to eager
-    permanently."""
-
-    category = UserWarning
-
-
 class _MeshEscape(TraceEscape):
     """A mesh axis forced the eager fallback — warn with the typed
     :class:`MeshFallbackWarning` so callers can filter on it."""
@@ -102,83 +95,12 @@ class _MeshEscape(TraceEscape):
     category = MeshFallbackWarning
 
 
-class _StepBindTracer:
-    """Minimal tracer active while ``jax.jit`` traces the step body.
-
-    Compared to ``jit/tracer._BindTracer`` it is stricter: any host read
-    of a traced value (``float()`` / ``item()`` / ``bool()`` branch)
-    raises :class:`TraceEscape` — the compiled train step supports no
-    guard re-specialization; such steps simply run eagerly.
-    """
-
-    __slots__ = ("created", "mutated", "mutated_list", "rng_counter",
-                 "_rng_key", "_lr", "_lr_used")
-
-    def __init__(self, rng_key, lr):
-        self.created = set()
-        self.mutated = {}             # id(Tensor) -> pre-write concrete data
-        self.mutated_list = []
-        self.rng_counter = 0
-        self._rng_key = rng_key
-        self._lr = lr
-        self._lr_used = False
-
-    def on_create(self, t):
-        self.created.add(id(t))
-
-    def on_read(self, t):
-        # a concrete read of a tensor discovery did not capture would be
-        # silently baked into the program as a constant — a stale-state
-        # bug.  (Captured tensors hold tracers by now, so they never
-        # reach this branch.)
-        if (id(t) not in self.created and id(t) not in self.mutated
-                and not isinstance(t._data_, jax.core.Tracer)):
-            raise TraceEscape(
-                "step body read a tensor the discovery pass did not see "
-                f"(shape {tuple(t._data_.shape)}, name={t.name!r}) — "
-                "control flow diverged between calls")
-
-    def on_write(self, t):
-        i = id(t)
-        if i not in self.created and i not in self.mutated:
-            self.mutated[i] = t._data_
-            self.mutated_list.append(t)
-
-    def host_read(self, t, bool_read=False):
-        raise TraceEscape(
-            "host read of a traced value (float()/item()/bool()) inside "
-            "the train step — the value escapes into python, which one "
-            "compiled program cannot replay")
-
-    def host_input(self, provider):
-        # the only legitimate host scalar inside the step body is the
-        # learning rate (schedulers); it is a traced input fed per call
-        if not self._lr_used:
-            self._lr_used = True
-            return self._lr
-        raise TraceEscape("unexpected host-scalar provider in step body")
-
-    def rng_base(self):
-        return self._rng_key
-
-
-class _Installed:
-    """Exception-safe swap of tensors' device-array slots.  Uses the
-    raw ``_data_`` slot so installs/restores never fire tracer hooks."""
-
-    def __init__(self, pairs):
-        self._saved = [(t, t._data_) for t, _ in pairs]
-        self._new = [a for _, a in pairs]
-
-    def __enter__(self):
-        for (t, _), a in zip(self._saved, self._new):
-            t._data_ = a
-        return self
-
-    def __exit__(self, *exc):
-        for t, orig in self._saved:
-            t._data_ = orig
-        return False
+# the two-phase capture/replay machinery lived here through PR 12; it is
+# shared with the serving scheduler's compiled tick now (ISSUE 13) and
+# moved to framework/capture.py — these aliases keep the historical
+# import surface intact
+_StepBindTracer = BindTracer
+_Installed = Installed
 
 
 def _resolve_mesh(mesh=None):
@@ -470,49 +392,13 @@ class CompiledTrainStep:
     # ------------------------------------------------------------------
 
     def _discover(self, x, y):
-        from ..jit.tracer import _DiscoveryTracer
-        from ..core.state import no_grad
-
         opt = self._opt
         opt._ensure_state()
-        tr = _DiscoveryTracer()
-        # snapshot values at first read/write so the discovery forward's
-        # side effects (batchnorm running stats, write-only counters)
-        # can be rolled back to the post-warmup state
-        read_snap = {}
-        write_snap = {}
-
-        def on_read(t):
-            if id(t) not in tr.created and id(t) not in read_snap:
-                read_snap[id(t)] = (t, t._data_)
-            i = id(t)
-            if i not in tr.created and i not in tr.captured:
-                tr.captured[i] = t
-                tr.capture_list.append(t)
-
-        def on_write(t):
-            if id(t) not in tr.created and id(t) not in write_snap:
-                write_snap[id(t)] = (t, t._data_)
-        tr.on_read, tr.on_write = on_read, on_write
-        saved_rng = (_state.STATE.rng_key, _state.STATE.rng_counter)
-        _state.STATE.tracer = tr
-        try:
-            with no_grad():
-                self._forward(x, y)
-        finally:
-            _state.STATE.tracer = None
-            _state.STATE.rng_key, _state.STATE.rng_counter = saved_rng
-            for t, arr in write_snap.values():
-                t._data_ = arr
-            for t, arr in read_snap.values():
-                t._data_ = arr
-        if any(rec[0] for rec in tr.host_reads):
-            raise TraceEscape(
-                "data-dependent python branch (bool(tensor)) in the "
-                "forward — guard re-specialization is to_static's job")
-        if tr.host_reads:
-            raise TraceEscape(
-                "host read (float()/item()/numpy()) in the forward")
+        # the shared capture core runs the forward once eagerly under a
+        # discovery tracer (side effects — batchnorm running stats,
+        # write-only counters, the RNG counter — rolled back to the
+        # post-warmup state) and raises TraceEscape on any host read
+        disc = run_discovery(lambda: self._forward(x, y))
 
         # classify captures: the optimizer's update set vs const captures
         grads_present = {id(p) for p in opt._parameter_list
@@ -526,13 +412,13 @@ class CompiledTrainStep:
         # holding them in _caps would feed call 1's batch forever
         batch_ids = {id(t) for t in (x, y) if isinstance(t, Tensor)}
         param_ids = {id(p) for p in self._params}
-        self._caps = [t for t in tr.capture_list
+        self._caps = [t for t in disc.capture_list
                       if id(t) not in param_ids and id(t) not in batch_ids]
         # whether the forward draws framework RNG (dropout): only then is
         # a fresh key fed per call — feeding one unconditionally would
         # advance the global RNG counter the eager lane does not touch,
         # desynchronizing everything else that draws from it (shuffling)
-        self._uses_rng = tr.rng_counter > 0
+        self._uses_rng = disc.uses_rng
         self._lr_scales = tuple(
             p.optimize_attr.get("learning_rate", 1.0) for p in self._params)
         self._wd_mask = tuple(opt._wd_applies(p) for p in self._params)
@@ -651,13 +537,13 @@ class CompiledTrainStep:
         compilation."""
         from ..core.state import no_grad
 
-        tracer = _StepBindTracer(key, lr)
+        tracer = BindTracer(key, host_scalars=(lr,))
         installs = (list(zip(self._params, param_arrs))
                     + list(zip(self._caps, cap_arrs)))
         grad_seed = [(p.grad, g) for p, g in zip(self._params, grad_arrs)]
         _state.STATE.tracer = tracer
         try:
-            with _Installed(installs), _Installed(grad_seed):
+            with Installed(installs), Installed(grad_seed):
                 # the forward expects framework Tensors; wrap the traced
                 # batch arrays (created under the tracer, so on_read never
                 # mistakes them for uncaptured state)
@@ -701,12 +587,7 @@ class CompiledTrainStep:
             _state.STATE.tracer = None
             # roll back any forward-mutated captures still holding
             # tracers to their pre-write concrete values
-            for t in tracer.mutated_list:
-                if isinstance(t._data_, jax.core.Tracer):
-                    orig = tracer.mutated.get(id(t))
-                    if orig is not None and not isinstance(
-                            orig, jax.core.Tracer):
-                        t._data_ = orig
+            tracer.rollback_mutations()
 
     def _update_tail(self, grads, param_arrs, states, step_arr, svec, lr,
                      hmark=None):

@@ -19,6 +19,9 @@ from .api import (  # noqa: F401
     QueueFullError, RequestOutput, SamplingParams, SchedulerStallError,
     ServingConfig, ServingError,
 )
+from .compiled_tick import (  # noqa: F401
+    CompiledServingTick, TickFallbackWarning,
+)
 from .engine import Engine  # noqa: F401
 from .fleet import ReplicaConfig, ReplicaServer, ServingFleet  # noqa: F401
 from .kv_slots import SlotKVCache  # noqa: F401
@@ -30,6 +33,7 @@ from .stats import (  # noqa: F401
 
 __all__ = [
     "Engine", "ServingConfig", "SamplingParams", "RequestOutput",
+    "CompiledServingTick", "TickFallbackWarning",
     "SlotKVCache", "PagedKVCache", "PrefixTree", "ServingError",
     "QueueFullError", "DeadlineExceededError", "EngineShutdownError",
     "SchedulerStallError", "NoReplicaError", "serving_stats",

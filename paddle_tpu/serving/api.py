@@ -55,12 +55,21 @@ class SchedulerStallError(ServingError):
 @dataclass(frozen=True)
 class SamplingParams:
     """Per-request decoding knobs — the same semantics (and HF processor
-    order) as `models.generation.generate`; temperature=0.0 is greedy."""
+    order) as `models.generation.generate`; temperature=0.0 is greedy.
+
+    ``seed`` pins a non-greedy request to its own deterministic sampling
+    stream (``fold_in(PRNGKey(seed), n_generated)`` per draw) instead of
+    the process-global RNG.  Seeded requests are reproducible across
+    runs AND lane-independent — the per-row host path, the fused
+    per-iteration sampling call, and the compiled scheduler tick all
+    draw the identical token — which is also what makes a sampled
+    request *hostable* by the compiled tick (docs/SERVING.md)."""
 
     temperature: float = 0.0
     top_k: int | None = None
     top_p: float | None = None
     repetition_penalty: float | None = None
+    seed: int | None = None
 
     def validate(self):
         if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
@@ -72,6 +81,8 @@ class SamplingParams:
         if self.temperature < 0.0:
             raise ValueError(
                 f"temperature must be >= 0, got {self.temperature}")
+        if self.seed is not None and int(self.seed) < 0:
+            raise ValueError(f"seed must be >= 0, got {self.seed}")
         return self
 
     @property

@@ -1,0 +1,601 @@
+"""One compiled program per scheduler tick (ISSUE 13).
+
+PR 8 fused the whole training step into ONE donated-buffer jit program
+and the Python-dispatch ceiling disappeared (4.18x).  The serving
+scheduler iteration was still on the wrong side of that line:
+``Engine._decode_step`` orchestrated the batched decode call, per-slot
+host sampling (an ``np.asarray`` host sync per non-greedy slot per
+iteration), offset/page-table flushes, and eos/length bookkeeping as
+separate compiled calls with host round-trips between them — at high
+occupancy the Python glue WAS the tokens/sec ceiling.
+
+:class:`CompiledServingTick` captures the full tick as one program over
+device-resident scheduler state:
+
+- **state** — last tokens, generated-token ring buffers, per-slot
+  counts/limits/eos ids, alive masks, cache offsets, per-slot sampling
+  params (temperature/top-k/top-p/repetition-penalty vectors + seen
+  masks + per-request RNG keys) all live as fixed-shape device arrays;
+  the page pools and page table are the ``PagedKVCache``'s own device
+  arrays, donated through the program each tick;
+- **program** — one jitted call runs the [num_slots, 1] model forward
+  (replayed through the shared two-phase capture core,
+  ``framework/capture.py``), the vectorized per-slot logit-processor
+  chain + sampling, the token append, eos/max-length finish codes, and
+  the offset advance; the batched-argmax fast path compiles its own
+  leaner variant so an all-greedy batch stays bitwise the old argmax;
+- **host boundary** — per tick the host reads back ONE small
+  ``[num_slots]`` finish-code vector.  Request admission and completion
+  (and deadline eviction — a wall-clock decision) are the only times
+  token buffers cross to the host.
+
+Fallbacks latch the uncompiled scheduler byte-identically and warn once
+with the typed :class:`TickFallbackWarning`: flag off
+(``FLAGS_compiled_tick``), slot (non-paged) cache layout, speculative
+decoding configured, layer hooks installed, and non-greedy sampling
+without a per-request ``SamplingParams.seed`` (the vectorized chain
+derives each slot's stream from ``fold_in(PRNGKey(seed), n_generated)``
+— without a seed the old path's global-RNG draws cannot be reproduced
+in-program).  See docs/SERVING.md "Compiled scheduler tick".
+"""
+from __future__ import annotations
+
+import time
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import stats
+from ..core import state as _state
+from ..core.tensor import Tensor
+from ..framework.capture import (TRACE_LOCK, BindTracer, Installed,
+                                 TraceEscape, run_discovery)
+from ..utils.flags import flag as _flag
+
+
+class TickFallbackWarning(UserWarning):
+    """Warned once per reason when the compiled serving tick cannot host
+    the current scheduler state and the engine latches the uncompiled
+    (byte-identical) iteration instead."""
+
+
+# ---------------------------------------------------------------------------
+# vectorized per-slot sampling chain (shared by the compiled tick and the
+# uncompiled lane's fused per-iteration sampling call)
+# ---------------------------------------------------------------------------
+
+def process_logits_rows(logits, temp, top_k, top_p, penalty, seen):
+    """Per-row logit-processor chain over a whole batch at once —
+    ``models.generation.apply_logit_processors`` semantics (HF order:
+    repetition penalty → temperature → top-k → top-p), vectorized with
+    per-slot knob vectors so every slot's chain runs inside one program.
+
+    ``logits`` [ns, V] float; ``temp`` [ns] (0.0 = greedy: the row
+    bypasses temperature/top-k/top-p and keeps its penalized logits for
+    the argmax); ``top_k`` [ns] int32 (0 = off); ``top_p`` [ns] (>= 1.0
+    = off); ``penalty`` [ns] (1.0 = off); ``seen`` [ns, V] bool emitted
+    mask.  Off knobs reproduce the reference chain's skipped branches
+    exactly (the k-th/threshold values are the same elements the
+    reference's ``topk``/``masked_fill`` select)."""
+    neg_inf = jnp.asarray(float("-inf"), logits.dtype)
+    vocab = logits.shape[-1]
+    pen = penalty[:, None].astype(logits.dtype)
+    pen_on = (penalty != 1.0)[:, None]
+    pos = logits > 0
+    penalized = jnp.where(pos, logits / pen, logits * pen)
+    logits = jnp.where(pen_on & seen, penalized, logits)
+    greedy = temp == 0.0
+    safe_t = jnp.where(greedy, 1.0, temp).astype(logits.dtype)
+    x = logits / safe_t[:, None]
+    # top-k: threshold at the row's k-th largest value (same element
+    # topk()'s vals[:, -1] selects), k clamped to the vocab
+    k = jnp.clip(top_k.astype(jnp.int32), 0, vocab)
+    sorted_desc = jnp.sort(x, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(sorted_desc,
+                              jnp.clip(k - 1, 0, vocab - 1)[:, None],
+                              axis=-1)
+    x = jnp.where((k > 0)[:, None] & (x < kth), neg_inf, x)
+    # top-p: smallest prefix of the sorted row whose EXCLUSIVE mass is
+    # below top_p survives (the first token always does)
+    p_on = (top_p < 1.0)[:, None]
+    sorted_p = jnp.sort(x, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_p, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None].astype(probs.dtype)
+    minv = jnp.min(jnp.where(keep, sorted_p,
+                             jnp.asarray(float("inf"), x.dtype)),
+                   axis=-1, keepdims=True)
+    x = jnp.where(p_on & (x < minv), neg_inf, x)
+    return jnp.where(greedy[:, None], logits, x)
+
+
+def choose_tokens(logits, temp, top_k, top_p, penalty, seen, keys, counts):
+    """[ns, V] logits → [ns] int32 next tokens under per-slot params.
+
+    Greedy rows (temp == 0) take the argmax of their (penalized) logits
+    — bitwise the reference ``sample_next_token`` path.  Sampled rows
+    draw ``jax.random.categorical`` from the processed logits under the
+    slot's own key stream ``fold_in(base_key, n_generated)`` — the
+    per-request seed makes the stream identical whichever lane (fused
+    uncompiled call or compiled tick) executes the draw."""
+    processed = process_logits_rows(logits, temp, top_k, top_p, penalty,
+                                    seen)
+    greedy_tok = jnp.argmax(processed, axis=-1).astype(jnp.int32)
+
+    def draw(key, count, row):
+        return jax.random.categorical(
+            jax.random.fold_in(key, count), row)
+
+    sampled_tok = jax.vmap(draw)(keys, counts, processed).astype(jnp.int32)
+    return jnp.where(temp == 0.0, greedy_tok, sampled_tok)
+
+
+@jax.jit
+def fused_sample_call(logits, temp, top_k, top_p, penalty, seen, keys,
+                      counts):
+    """The uncompiled lane's ONE per-iteration sampling program: every
+    active slot's processor chain + draw in a single jitted call instead
+    of a host round-trip per non-greedy slot (ISSUE 13 satellite)."""
+    return choose_tokens(logits, temp, top_k, top_p, penalty, seen,
+                         keys, counts)
+
+
+def sampling_hostable(sp):
+    """Whether the vectorized chain can host this request's sampling:
+    greedy always (penalty included — the chain penalizes before the
+    argmax exactly like ``_sample_row``); non-greedy only with a
+    per-request ``seed`` (the in-program stream is key-derived — global
+    framework-RNG draws cannot be replayed inside one program)."""
+    return sp.greedy or sp.seed is not None
+
+
+def request_key(sp):
+    """[2] uint32 base key for a seeded request's sampling stream."""
+    return np.asarray(jax.random.PRNGKey(int(sp.seed)))
+
+
+# ---------------------------------------------------------------------------
+# the compiled tick
+# ---------------------------------------------------------------------------
+
+class CompiledServingTick:
+    """Owns the device-resident scheduler state and the per-mode jitted
+    tick programs for one :class:`~paddle_tpu.serving.engine.Engine`.
+
+    ``step()`` runs one compiled tick and returns True, or returns False
+    after latching/flushing so the engine's uncompiled iteration (the
+    byte-identical fallback) runs instead."""
+
+    def __init__(self, engine):
+        self.eng = engine
+        self._built = False
+        self._disabled = None          # permanent fallback reason
+        self._warned = set()           # reason kinds already warned
+        self._caps = []                # captured model tensors (params)
+        self._jits = {}                # (mode, donating) -> jitted fn
+        self._dev = None               # device state dict
+        self._rep = {}                 # slot -> req at last rebuild
+        self._mut_seen = -1            # engine mutation counter synced
+        self._h_counts = None          # host mirror of generated counts
+        self._ahead = False            # device tokens not yet on host
+        self._sublayers = None
+        # static blockers (cache layout, speculation) are known at
+        # construction: warn right away — an all-greedy speculative
+        # engine never even consults the tick (the spec step runs), so
+        # an iteration-time warning would stay silent forever
+        blk = self._static_blocker()
+        if blk is not None:
+            self._note_fallback(*blk)
+
+    # ------------------------------------------------------------------
+    # eligibility / fallback accounting
+    # ------------------------------------------------------------------
+
+    def _note_fallback(self, kind, reason, permanent=False):
+        stats.incr("tick.fallbacks")
+        if permanent:
+            self._disabled = reason
+        if kind not in self._warned:
+            self._warned.add(kind)
+            warnings.warn(
+                f"compiled serving tick disabled ({reason}); running the "
+                "uncompiled scheduler iteration", TickFallbackWarning)
+
+    def _static_blocker(self):
+        """(kind, reason, permanent) for configuration the tick can
+        never host, known at engine start; None otherwise."""
+        eng = self.eng
+        if not eng._paged:
+            return ("layout", "kv_layout='slots' — the compiled tick "
+                    "runs on the paged cache", True)
+        if eng._spec:
+            return ("spec", "speculative decoding configured "
+                    "(draft_model + speculation_k > 0)", True)
+        return None
+
+    def _blocker(self):
+        """(kind, reason, permanent) for the current scheduler state, or
+        None when this tick can run compiled."""
+        eng = self.eng
+        blk = self._static_blocker()
+        if blk is not None:
+            return blk
+        if _state.STATE.tracer is not None:
+            return ("tracer", "a framework tracer is active", False)
+        if self._sublayers is None and hasattr(eng.model, "sublayers"):
+            self._sublayers = list(
+                eng.model.sublayers(include_self=True))
+        for layer in self._sublayers or ():
+            if layer._forward_pre_hooks or layer._forward_post_hooks:
+                return ("hooks", "layer forward hooks installed", False)
+        for req in eng._active.values():
+            if not sampling_hostable(req.sampling):
+                return ("sampling", "non-greedy sampling without a "
+                        "per-request SamplingParams.seed — the "
+                        "vectorized in-program chain cannot reproduce "
+                        "global-RNG draws", False)
+        return None
+
+    @property
+    def fallback_reason(self):
+        return self._disabled
+
+    # ------------------------------------------------------------------
+    # capture (phase 1): discover the model forward's reads
+    # ------------------------------------------------------------------
+
+    def _capture(self):
+        eng = self.eng
+        cache = eng.cache
+        views = [dict(lay) for lay in cache.layer_caches()]
+        tok = Tensor(np.zeros((cache.num_slots, 1), np.int32))
+        exclude = {id(tok)}
+        for view in views:
+            for v in view.values():
+                if isinstance(v, Tensor):
+                    exclude.add(id(v))
+        with TRACE_LOCK:
+            disc = run_discovery(lambda: eng.model(tok, caches=views))
+        if disc.uses_rng:
+            raise TraceEscape(
+                "model forward draws framework RNG (dropout in eval?) — "
+                "the tick program feeds randomness only through "
+                "per-slot sampling keys")
+        self._caps = [t for t in disc.capture_list
+                      if id(t) not in exclude]
+        self._built = True
+
+    # ------------------------------------------------------------------
+    # the traced tick body (phase 2)
+    # ------------------------------------------------------------------
+
+    def _traced(self, mode, pools, pt, off, last, counts, alive, seen,
+                out, limits, eos, temp, topk, topp, pen, keys, caps):
+        eng = self.eng
+        cache = eng.cache
+        quant = cache.quant_dtype is not None
+        tracer = BindTracer(rng_key=None)
+        _state.STATE.tracer = tracer
+        try:
+            with Installed(list(zip(self._caps, caps))):
+                # dead/prefilling rows feed token 0 exactly like the
+                # uncompiled step's zero-filled tok_in; their scratch
+                # writes are causally masked (and prefill re-writes its
+                # positions next chunk) either way
+                tok_in = jnp.where(alive, last,
+                                   jnp.zeros_like(last))[:, None]
+                pt_t, off_t = Tensor(pt), Tensor(off)
+                views = []
+                i = 0
+                for _ in range(len(cache.layers)):
+                    view = {"k_pool": Tensor(pools[i]),
+                            "v_pool": Tensor(pools[i + 1]),
+                            "page_table": pt_t, "offset": off_t,
+                            "page_size": cache.page_size}
+                    i += 2
+                    if quant:
+                        view["k_scale"] = Tensor(pools[i])
+                        view["v_scale"] = Tensor(pools[i + 1])
+                        i += 2
+                    views.append(view)
+                logits_t = eng.model(Tensor(tok_in), caches=views)
+                logits = logits_t._data_[:, -1, :]
+                new_pools = []
+                for view in views:
+                    new_pools += [view["k_pool"]._data_,
+                                  view["v_pool"]._data_]
+                    if quant:
+                        new_pools += [view["k_scale"]._data_,
+                                      view["v_scale"]._data_]
+        finally:
+            _state.STATE.tracer = None
+            tracer.rollback_mutations()
+
+        ns = logits.shape[0]
+        if mode == "greedy":
+            # the batched-argmax fast path, bitwise the uncompiled
+            # lane's S.argmax over raw last-position logits
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            tok = choose_tokens(logits, temp, topk, topp, pen, seen,
+                                keys, counts)
+        tok = jnp.where(alive, tok, last)
+        rows = jnp.arange(ns)
+        idx = jnp.clip(counts, 0, out.shape[1] - 1)
+        new_out = out.at[rows, idx].set(
+            jnp.where(alive, tok, out[rows, idx]))
+        new_seen = seen.at[rows, tok].set(seen[rows, tok] | alive)
+        new_counts = counts + alive.astype(counts.dtype)
+        eos_hit = alive & (eos >= 0) & (tok == eos)
+        len_hit = alive & (new_counts >= limits)
+        fin = jnp.where(eos_hit, 1,
+                        jnp.where(len_hit, 2, 0)).astype(jnp.int32)
+        new_alive = alive & (fin == 0)
+        new_last = jnp.where(alive, tok, last)
+        new_off = off + alive.astype(off.dtype)
+        return (tuple(new_pools), new_off, new_last, new_counts,
+                new_alive, new_seen, new_out, fin)
+
+    def _build_jit(self, mode, donating):
+        from ..core.op_cache import ensure_compile_cache
+        ensure_compile_cache()      # tier-2 persistent XLA compile cache
+
+        def fn(pools, pt, off, last, counts, alive, seen, out, limits,
+               eos, temp, topk, topp, pen, keys, caps):
+            return self._traced(mode, pools, pt, off, last, counts,
+                                alive, seen, out, limits, eos, temp,
+                                topk, topp, pen, keys, caps)
+
+        # the pools (the big buffers) are donated and replaced in place
+        # each tick.  The small token/seen state buffers are NOT — on
+        # this jaxlib, donating them alongside the persistent
+        # compilation cache (conftest arms it suite-wide) corrupts the
+        # CPU client's buffer bookkeeping and aborts the process; their
+        # per-tick copy is a few KB, noise next to the pool bytes.
+        donate = (0,) if donating else ()
+        return jax.jit(fn, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    # host <-> device state sync
+    # ------------------------------------------------------------------
+
+    def flush_to_host(self):
+        """Materialize device-side token progress back into the request
+        objects (the step the uncompiled lane needs before it can take
+        over mid-request).  Token/seen/last bookkeeping only — stats
+        were already counted per tick."""
+        if not self._ahead or self._dev is None:
+            return
+        self._ahead = False
+        eng = self.eng
+        out_np = np.asarray(self._dev["out"])
+        for slot, req in self._rep.items():
+            if eng._active.get(slot) is not req:
+                continue
+            have = len(req.tokens)
+            count = int(self._h_counts[slot])
+            for tok in out_np[slot, have:count].tolist():
+                req.tokens.append(int(tok))
+                req.last_token = int(tok)
+                if req.seen is not None:
+                    req.seen[int(tok)] = True
+        self._dev = None            # force a rebuild before the next tick
+
+    def _rebuild(self):
+        """(Re)upload the scheduler state from the request objects —
+        the admission/completion host boundary."""
+        eng = self.eng
+        cache = eng.cache
+        ns = cache.num_slots
+        vocab = eng.cfg.vocab_size
+        width = eng.max_len
+        last = np.zeros(ns, np.int32)
+        counts = np.zeros(ns, np.int32)
+        limits = np.full(ns, np.iinfo(np.int32).max, np.int32)
+        eos = np.full(ns, -1, np.int32)
+        alive = np.zeros(ns, bool)
+        temp = np.zeros(ns, np.float32)
+        topk = np.zeros(ns, np.int32)
+        topp = np.ones(ns, np.float32)
+        pen = np.ones(ns, np.float32)
+        keys = np.zeros((ns, 2), np.uint32)
+        seen = np.zeros((ns, vocab), bool)
+        out = np.zeros((ns, width), np.int32)
+        for slot, req in eng._active.items():
+            alive[slot] = True
+            last[slot] = req.last_token
+            n = len(req.tokens)
+            counts[slot] = n
+            out[slot, :n] = req.tokens
+            limits[slot] = min(req.max_new_tokens,
+                               eng.max_len - req.prompt.size)
+            if req.eos_token_id is not None:
+                eos[slot] = req.eos_token_id
+            sp = req.sampling
+            temp[slot] = sp.temperature
+            topk[slot] = sp.top_k or 0
+            if sp.top_p is not None:
+                topp[slot] = sp.top_p
+            if sp.repetition_penalty is not None:
+                pen[slot] = sp.repetition_penalty
+            if not sp.greedy and sp.seed is not None:
+                keys[slot] = request_key(sp)
+            if req.seen is not None:
+                seen[slot] = req.seen
+        self._dev = {
+            "last": jnp.asarray(last), "counts": jnp.asarray(counts),
+            "limits": jnp.asarray(limits), "eos": jnp.asarray(eos),
+            "alive": jnp.asarray(alive), "temp": jnp.asarray(temp),
+            "topk": jnp.asarray(topk), "topp": jnp.asarray(topp),
+            "pen": jnp.asarray(pen), "keys": jnp.asarray(keys),
+            "seen": jnp.asarray(seen), "out": jnp.asarray(out),
+            "off": None,
+        }
+        self._h_counts = counts.copy()
+        self._rep = dict(eng._active)
+        self._mut_seen = eng._mut
+
+    # ------------------------------------------------------------------
+    # one tick
+    # ------------------------------------------------------------------
+
+    def step(self):
+        eng = self.eng
+        if not _flag("FLAGS_compiled_tick", True):
+            self.flush_to_host()        # flag flipped mid-run
+            return False
+        if self._disabled is not None:
+            stats.incr("tick.fallbacks")
+            return False
+        blk = self._blocker()
+        if blk is not None:
+            self.flush_to_host()
+            self._note_fallback(blk[0], blk[1], blk[2])
+            return False
+        if not self._built:
+            try:
+                self._capture()
+            except TraceEscape as e:
+                self._note_fallback("capture", str(e), True)
+                return False
+            except Exception as e:  # noqa: BLE001 — any failure → eager
+                self._note_fallback(
+                    "capture", f"capture failed: "
+                    f"{type(e).__name__}: {e}", True)
+                return False
+        if eng._mut != self._mut_seen or self._dev is None:
+            self.flush_to_host()
+            self._rebuild()
+        return self._run()
+
+    def _run(self):
+        eng = self.eng
+        cache = eng.cache
+        t0 = time.monotonic()
+        active = dict(eng._active)
+        n_active = len(active)
+        eng._max_active = max(eng._max_active, n_active)
+        stats.set_value("max_active_slots", eng._max_active)
+        # page-by-page growth exactly like the uncompiled step: the
+        # admission reservation guarantees the host-side pop succeeds
+        for slot in active:
+            cache.ensure_capacity(slot, int(cache.offsets[slot]))
+        # page table / offsets: host mutations (admission, release,
+        # growth) flow through the cache's own lazy flush; steady-state
+        # ticks ride the previous program's device outputs
+        if cache._dirty or self._dev["off"] is None:
+            lay0 = cache.layer_caches()[0]
+            pt = lay0["page_table"]._data_
+            off = lay0["offset"]._data_
+        else:
+            pt = cache.layers[0]["page_table"]._data_
+            off = self._dev["off"]
+        quant = cache.quant_dtype is not None
+        mode = "greedy" if all(
+            r.sampling.greedy and not r.sampling.uses_penalty
+            for r in active.values()) else "mixed"
+        donating = bool(_flag("FLAGS_jit_donate_buffers", True))
+        key = (mode, donating)
+        first = key not in self._jits
+        if first:
+            self._jits[key] = self._build_jit(mode, donating)
+        jit = self._jits[key]
+        d = self._dev
+        from ..profiler import RecordEvent
+        rids = sorted(r.id for r in active.values())
+        try:
+            # TRACE_LOCK covers reading the (possibly shared) parameter
+            # slots AND the program call: while ANOTHER engine's tick
+            # traces, those slots hold tracer arrays — gathering them
+            # here would bake a leaked tracer into this engine's call
+            with TRACE_LOCK, \
+                    RecordEvent("serving::decode",
+                                args={"request_ids": rids,
+                                      "compiled_tick": True}):
+                pools = []
+                for lay in cache.layers:
+                    pools += [lay["k_pool"]._data_, lay["v_pool"]._data_]
+                    if quant:
+                        pools += [lay["k_scale"]._data_,
+                                  lay["v_scale"]._data_]
+                caps = tuple(t._data_ for t in self._caps)
+                (new_pools, new_off, new_last, new_counts, new_alive,
+                 new_seen, new_out, fin) = jit(
+                    tuple(pools), pt, off, d["last"], d["counts"],
+                    d["alive"], d["seen"], d["out"], d["limits"],
+                    d["eos"], d["temp"], d["topk"], d["topp"], d["pen"],
+                    d["keys"], caps)
+            fin_np = np.asarray(fin)    # the per-tick host sync point
+        except TraceEscape as e:
+            self.flush_to_host()
+            self._dev = None
+            self._note_fallback("trace", str(e), True)
+            return False
+        except Exception as e:  # noqa: BLE001
+            burned = any(
+                getattr(a, "is_deleted", lambda: False)()
+                for lay in cache.layers for a in
+                (lay["k_pool"]._data_, lay["v_pool"]._data_))
+            if first and not burned:
+                # the model body cannot be traced (host reads of raw
+                # array slots, data-dependent control flow): latch the
+                # uncompiled scheduler permanently — serving never dies
+                # on the compiler
+                self.flush_to_host()
+                self._dev = None
+                self._note_fallback(
+                    "trace", f"tick trace/compile failed: "
+                    f"{type(e).__name__}: {e}", True)
+                return False
+            # a post-donation execution failure poisoned the pools —
+            # propagate so the scheduler's restart wrapper rebuilds the
+            # cache (the same crash semantics as any step failure)
+            raise
+        # adopt the functionally-updated pools + offsets back into the
+        # cache (device stays current; the host offset mirror advances
+        # in lockstep so fallbacks/admission see the truth)
+        offsets_np = cache.offsets.copy()
+        offsets_np[list(active)] += 1
+        cache.absorb_tick(new_pools, new_off, offsets_np)
+        d.update(off=new_off, last=new_last, counts=new_counts,
+                 alive=new_alive, seen=new_seen, out=new_out)
+        self._h_counts[list(active)] += 1
+        self._ahead = True
+
+        wall_ms = (time.monotonic() - t0) * 1e3
+        stats.observe("decode_ms", wall_ms)
+        stats.incr("decode_steps")
+        stats.incr("tick.compiled_hits")
+        stats.incr("slot_steps", cache.num_slots)
+        stats.incr("slot_steps_active", n_active)
+        stats.incr("tokens_generated", n_active)
+
+        now = time.monotonic()
+        evict = eng.scfg.deadline_policy == "evict"
+        out_np = None
+        for slot, req in active.items():
+            if evict and req.deadline is not None and now > req.deadline:
+                # same per-token deadline granularity (and precedence
+                # over eos/length) as the uncompiled _append_token
+                from .api import DeadlineExceededError
+                self.flush_to_host()
+                eng._fail(req, DeadlineExceededError(
+                    f"request {req.id} exceeded its deadline after "
+                    f"{len(req.tokens)} token(s)"))
+                stats.incr("requests_evicted_deadline")
+                eng._release(req)
+                continue
+            code = int(fin_np[slot])
+            if code == 0:
+                continue
+            if out_np is None:
+                out_np = np.asarray(new_out)
+            count = int(self._h_counts[slot])
+            req.tokens = [int(t) for t in out_np[slot, :count]]
+            req.last_token = req.tokens[-1]
+            eng._complete(req, "eos" if code == 1 else "length", now)
+            eng._release(req)
+        stats.set_value("active_slots", len(eng._active))
+        return True

@@ -130,6 +130,19 @@ class Engine:
         self._thread = None
         self._ids = itertools.count()
         self.cache = None
+        # compiled scheduler tick (serving/compiled_tick.py): ONE
+        # donated-buffer jit program per iteration over device-resident
+        # state, with admission/completion as the only host boundary.
+        # _mut counts host-lane mutations of request/slot state so the
+        # tick knows when its device mirror must be rebuilt.
+        self._tick = None
+        self._mut = 0
+        # pool-gauge throttle: publishing every iteration took the
+        # metrics-registry lock in the hot loop (the same drift class as
+        # the PR 8 tier-1 op-cache fix) — flush on-change or every
+        # _POOL_PUBLISH_EVERY ticks
+        self._pool_pub = None
+        self._pool_iters = 0
         # scheduler-thread watchdog state (step_timeout_s > 0)
         self._sched_tid = None
         self._iter_deadline = None
@@ -147,8 +160,12 @@ class Engine:
             if self._running:
                 return self
             stats.reset_serving_stats()
+            stats.declare_tick_stats()
             self.cache = self._new_cache()
+            self._tick = self._make_tick()
             self._max_active = 0
+            self._pool_pub = None
+            self._pool_iters = 0
             self._running = True
             self._draining = False
             self._restarts = 0
@@ -203,6 +220,17 @@ class Engine:
             self.cfg.num_layers, self.scfg.num_slots, self.max_len,
             self._kv_heads, self.cfg.head_dim,
             dtype=self.scfg.cache_dtype)
+
+    def _make_tick(self):
+        """A fresh compiled-tick driver for a (re)started loop, or None
+        with `FLAGS_compiled_tick` off — the flag-off scheduler is
+        byte-identical to the pre-tick engine (no tick object, no state
+        mirrors, no extra dispatches)."""
+        from ..utils.flags import flag as _flag
+        if not _flag("FLAGS_compiled_tick", True):
+            return None
+        from .compiled_tick import CompiledServingTick
+        return CompiledServingTick(self)
 
     def shutdown(self, wait_s=30.0):
         """Stop the scheduler.  In-flight and queued futures resolve
@@ -397,12 +425,16 @@ class Engine:
                         raise
                     self._restarts += 1
                     # the crash may have left slots/pages torn
-                    # mid-write: rebuild rather than trust them
+                    # mid-write (or donated through a failed tick
+                    # program): rebuild rather than trust them
                     self.cache = self._new_cache()
+                    self._tick = self._make_tick()
         finally:
             self._fail_all(EngineShutdownError("engine shut down"))
             stats.set_value("active_slots", 0)
             stats.set_value("queue_depth", 0)
+            if self._paged and self.cache is not None:
+                self._publish_pool_stats(force=True)
 
     def _loop_once(self):
         from ..core.state import no_grad
@@ -431,6 +463,7 @@ class Engine:
                         continue
                 if budget > 0:
                     self._iter_deadline = time.monotonic() + budget
+                t_tick = time.monotonic()
                 if self._paged:
                     for req, slot in admits:
                         self._start_prefill(req, slot)
@@ -446,10 +479,14 @@ class Engine:
                 if self._active:
                     if self._can_speculate():
                         self._spec_step()
+                    elif self._tick is not None and self._tick.step():
+                        pass        # ONE compiled program ran the tick
                     else:
                         self._decode_step()
                 if self._paged:
                     self._publish_pool_stats()
+                stats.observe("tick_ms",
+                              (time.monotonic() - t_tick) * 1e3)
                 self._iter_deadline = None
 
     def _stall_monitor(self):
@@ -504,14 +541,16 @@ class Engine:
         from ..core.tensor import Tensor
         from ..models.generation import init_kv_caches
         from ..profiler import RecordEvent
+        from ..framework.capture import TRACE_LOCK
         t0 = time.monotonic()
         with RecordEvent("serving::prefill",
                          args={"request_id": req.id}):
             caches = init_kv_caches(
                 self.cfg.num_layers, 1, self.max_len, self._kv_heads,
                 self.cfg.head_dim, dtype=self.scfg.cache_dtype)
-            logits = self.model(Tensor(req.prompt[None, :]),
-                                caches=caches)
+            with TRACE_LOCK:    # a shared model may be mid-capture
+                logits = self.model(Tensor(req.prompt[None, :]),
+                                    caches=caches)
             self.cache.write_prefill(slot, caches, req.prompt.size)
             if req.sampling.uses_penalty:
                 seen = np.zeros(self.cfg.vocab_size, bool)
@@ -666,7 +705,10 @@ class Engine:
                 continue
             if self._spec and req.draft_prefill_pos < req.prompt.size:
                 continue
-            self._prefilling.remove(req)
+            try:
+                self._prefilling.remove(req)
+            except ValueError:
+                continue    # a concurrent stall sweep already swept it
             self._active[req.slot] = req
             tok, req.first_tok = req.first_tok, None
             self._append_token(req, tok)
@@ -689,11 +731,13 @@ class Engine:
             new_real = min(start + chunk, req.prompt.size) - off
             cache.ensure_capacity(req.slot, off + new_real - 1)
             starts.append(start)
+        from ..framework.capture import TRACE_LOCK
         t0 = time.monotonic()
         with RecordEvent("serving::prefill",
                          args={"request_ids": [r.id for r in reqs]}):
             views = cache.prefill_view([r.slot for r in reqs], starts)
-            logits = model(Tensor(tokens), caches=views)
+            with TRACE_LOCK:    # a shared model may be mid-capture
+                logits = model(Tensor(tokens), caches=views)
             cache.absorb_view(views)
         dt_ms = (time.monotonic() - t0) * 1e3
         stats.observe("prefill_chunk_ms", dt_ms)
@@ -701,9 +745,20 @@ class Engine:
         stats.incr("prefill_chunks", len(reqs))
         return logits, starts
 
-    def _publish_pool_stats(self):
+    # forced gauge flush cadence: a steady-state decode stretch whose
+    # page counts never move publishes at most once per this many
+    # iterations instead of taking the metrics-registry lock every tick
+    _POOL_PUBLISH_EVERY = 64
+
+    def _publish_pool_stats(self, force=False):
         in_use = self.cache.pages_in_use
         self._pages_peak = max(self._pages_peak, in_use)
+        snap = (in_use, self.cache.free_page_count, self._pages_peak)
+        self._pool_iters += 1
+        if not force and snap == self._pool_pub and \
+                self._pool_iters % self._POOL_PUBLISH_EVERY:
+            return
+        self._pool_pub = snap
         stats.set_value("kv_pages_in_use", in_use)
         stats.set_value("kv_pages_free", self.cache.free_page_count)
         stats.set_value("kv_pages_peak", self._pages_peak)
@@ -758,6 +813,7 @@ class Engine:
         the rollback (pointer/offset moves) never depend on how many
         tokens were accepted."""
         from ..core.tensor import Tensor
+        from ..framework.capture import TRACE_LOCK
         from ..profiler import RecordEvent
         from ..tensor_ops import search as S
         K = self._spec_k
@@ -783,8 +839,10 @@ class Engine:
                     tok_in[s, 0] = self._known_token(req, p) \
                         if p <= tgt_off[s] else prev_out[s]
                     self.draft_cache.ensure_capacity(s, p)
-                logits = self.scfg.draft_model(
-                    Tensor(tok_in), caches=self.draft_cache.layer_caches())
+                with TRACE_LOCK:    # shared model may be mid-capture
+                    logits = self.scfg.draft_model(
+                        Tensor(tok_in),
+                        caches=self.draft_cache.layer_caches())
                 self.draft_cache.advance(active.keys())
                 toks = np.asarray(
                     S.argmax(logits[:, -1, :], axis=-1)._data_)
@@ -813,8 +871,9 @@ class Engine:
             self.cache.ensure_capacity(s, tgt_off[s] + K)
         with RecordEvent("serving::spec_verify",
                          args={"request_ids": rids}):
-            logits = self.model(Tensor(tok_in),
-                                caches=self.cache.layer_caches())
+            with TRACE_LOCK:    # shared model may be mid-capture
+                logits = self.model(Tensor(tok_in),
+                                    caches=self.cache.layer_caches())
             t = np.asarray(S.argmax(logits, axis=-1)._data_)  # [ns, K+1]
         stats.observe("spec_verify_ms", (time.monotonic() - t0) * 1e3)
 
@@ -869,18 +928,26 @@ class Engine:
             tok_in = np.zeros((self.cache.num_slots, 1), np.int32)
             for slot, req in self._active.items():
                 tok_in[slot, 0] = req.last_token
-            logits = self.model(Tensor(tok_in),
-                                caches=self.cache.layer_caches())
+            from ..framework.capture import TRACE_LOCK
+            with TRACE_LOCK:    # a shared model may be mid-capture
+                logits = self.model(Tensor(tok_in),
+                                    caches=self.cache.layer_caches())
             self.cache.advance(self._active.keys())
             last = logits[:, -1, :]                  # [num_slots, V]
             all_greedy = all(
                 r.sampling.greedy and not r.sampling.uses_penalty
                 for r in self._active.values())
+            toks = None
             if all_greedy:
                 toks = np.asarray(
                     S.argmax(last, axis=-1)._data_)  # one batched argmax
+            elif self._fused_sampling_ok():
+                # ISSUE 13 satellite: one fused jitted sampling call
+                # over every active slot instead of an np.asarray host
+                # round-trip per non-greedy slot per iteration
+                toks = self._fused_sample(last)
             for slot, req in list(self._active.items()):
-                tok = int(toks[slot]) if all_greedy else \
+                tok = int(toks[slot]) if toks is not None else \
                     self._sample_row(last[slot:slot + 1, :], req)
                 self._append_token(req, tok)
         stats.observe("decode_ms", (time.monotonic() - t0) * 1e3)
@@ -889,12 +956,77 @@ class Engine:
         stats.incr("slot_steps_active", n_active)
         stats.set_value("active_slots", len(self._active))
 
+    def _fused_sampling_ok(self):
+        """Whether ONE fused jitted call can sample every active slot
+        this iteration: the flag is on and each request is greedy or
+        carries a per-request seed (the vectorized chain's streams are
+        key-derived — unseeded sampling keeps the per-row host path)."""
+        from ..utils.flags import flag as _flag
+        if not _flag("FLAGS_serving_fused_sampling", True):
+            return False
+        from .compiled_tick import sampling_hostable
+        return all(sampling_hostable(r.sampling)
+                   for r in self._active.values())
+
+    def _fused_sample(self, last):
+        """One jitted per-iteration sampling call over all slots —
+        exactly the vectorized processor chain the compiled tick runs
+        in-program, so a request's token stream is identical whichever
+        lane draws it.  Returns np [num_slots] tokens."""
+        from .compiled_tick import fused_sample_call, request_key
+        ns = self.cache.num_slots
+        vocab = self.cfg.vocab_size
+        temp = np.zeros(ns, np.float32)
+        topk = np.zeros(ns, np.int32)
+        topp = np.ones(ns, np.float32)
+        pen = np.ones(ns, np.float32)
+        keys = np.zeros((ns, 2), np.uint32)
+        counts = np.zeros(ns, np.int32)
+        seen = np.zeros((ns, vocab), bool)
+        for slot, req in self._active.items():
+            sp = req.sampling
+            temp[slot] = sp.temperature
+            topk[slot] = sp.top_k or 0
+            if sp.top_p is not None:
+                topp[slot] = sp.top_p
+            if sp.repetition_penalty is not None:
+                pen[slot] = sp.repetition_penalty
+            counts[slot] = len(req.tokens)
+            if not sp.greedy and sp.seed is not None:
+                keys[slot] = request_key(sp)
+            if req.seen is not None:
+                seen[slot] = req.seen
+        return np.asarray(fused_sample_call(
+            last._data_, temp, topk, topp, pen, seen, keys, counts))
+
     def _sample_row(self, logits_row, req):
         """[1, V] logits → one token under the request's params (the
-        processor chain shared with models/generation)."""
+        processor chain shared with models/generation).  Seeded
+        non-greedy requests draw from their own key stream (the same
+        ``fold_in(PRNGKey(seed), n_generated)`` the fused call and the
+        compiled tick use, so the stream is lane-independent from token
+        0); everything else is the historical global-RNG path."""
         from ..core.tensor import Tensor
         from ..models.generation import sample_next_token
+        from ..utils.flags import flag as _flag
         sp = req.sampling
+        if not sp.greedy and sp.seed is not None and \
+                _flag("FLAGS_serving_fused_sampling", True):
+            from .compiled_tick import fused_sample_call, request_key
+            seen = req.seen[None, :] if req.seen is not None else \
+                np.zeros((1, self.cfg.vocab_size), bool)
+            tok = fused_sample_call(
+                logits_row._data_,
+                np.asarray([sp.temperature], np.float32),
+                np.asarray([sp.top_k or 0], np.int32),
+                np.asarray([sp.top_p if sp.top_p is not None else 1.0],
+                           np.float32),
+                np.asarray([sp.repetition_penalty
+                            if sp.repetition_penalty is not None
+                            else 1.0], np.float32),
+                seen, request_key(sp)[None, :],
+                np.asarray([len(req.tokens)], np.int32))
+            return int(np.asarray(tok)[0])
         seen_t = Tensor(req.seen[None, :]) if req.seen is not None \
             else None
         nxt = sample_next_token(
@@ -906,6 +1038,7 @@ class Engine:
     def _append_token(self, req, tok):
         """Account one generated token, then finish/evict the request
         if it hit EOS, its token budget, slot capacity, or deadline."""
+        self._mut += 1          # host-lane mutation: tick mirrors stale
         req.tokens.append(tok)
         req.last_token = tok
         if req.seen is not None:
@@ -971,6 +1104,7 @@ class Engine:
     def _release(self, req):
         if req.slot is None:
             return
+        self._mut += 1          # slot membership changed: tick rebuilds
         in_active = req.slot in self._active and \
             self._active[req.slot] is req
         if in_active:

@@ -282,6 +282,30 @@ class PagedKVCache:
                 lay["k_scale"] = view["k_scale"]
                 lay["v_scale"] = view["v_scale"]
 
+    def absorb_tick(self, pools_flat, new_offsets, offsets_np=None):
+        """Adopt one compiled scheduler tick's functionally-updated
+        device state (serving/compiled_tick.py): the donated-through
+        pools (+ per-page scales, flat per layer in ``layer_caches``
+        order), the in-program-advanced offsets device array, and —
+        when given — the host offset mirror that advanced in lockstep.
+        The dirty flag is NOT set: device and host agree after this
+        call, so a later ``layer_caches()`` must not re-upload stale
+        Tensors over the tick's outputs."""
+        off_t = Tensor(new_offsets)
+        quant = self.quant_dtype is not None
+        i = 0
+        for lay in self.layers:
+            lay["k_pool"] = Tensor(pools_flat[i])
+            lay["v_pool"] = Tensor(pools_flat[i + 1])
+            i += 2
+            if quant:
+                lay["k_scale"] = Tensor(pools_flat[i])
+                lay["v_scale"] = Tensor(pools_flat[i + 1])
+                i += 2
+            lay["offset"] = off_t
+        if offsets_np is not None:
+            self.offsets[:] = offsets_np
+
     def _flush(self):
         if not self._dirty:
             return

@@ -66,6 +66,23 @@ def reset_serving_stats():
             monitor.reset(key)
 
 
+def declare_tick_stats():
+    """Get-or-create the compiled-tick metric families at engine start
+    so the Prometheus exposition carries the full tick schema before
+    the first iteration — a dashboard must see ``tick_fallbacks`` at 0,
+    not a missing series, on an engine that never fell back
+    (tools/check_telemetry.py --serving-tick gates on exactly this)."""
+    from ..observability import registry as _registry
+    _registry.counter(PREFIX + "tick.compiled_hits",
+                      "scheduler iterations run as ONE compiled tick "
+                      "program")
+    _registry.counter(PREFIX + "tick.fallbacks",
+                      "scheduler iterations that latched the "
+                      "uncompiled fallback")
+    _registry.histogram(PREFIX + "tick_ms",
+                        "wall time of one scheduler iteration (ms)")
+
+
 def declare_router_stats():
     """Get-or-create every ``serving.router.*`` metric family so the
     Prometheus exposition carries the full fleet schema from router
@@ -115,6 +132,17 @@ def serving_stats():
                             full the continuous batch ran
     - ``tokens_per_sec``    generated tokens / engine busy time
                             (prefill + decode wall)
+
+    Compiled-tick quantities (ISSUE 13): ``tick_ms_avg`` — mean wall
+    time of one whole scheduler iteration (admissions + prefill chunk +
+    decode, whichever lane ran it) — plus ``tick_compiled_hits`` /
+    ``tick_fallbacks`` counting iterations the ONE-program compiled
+    tick executed vs iterations that latched the uncompiled scheduler
+    (flag off mid-run, slot layout, speculation, unhostable sampling,
+    hooks); all three ride the Prometheus exposition
+    (``serving_tick_ms`` histogram, ``serving_tick_compiled_hits`` /
+    ``serving_tick_fallbacks`` counters, gated by
+    tools/check_telemetry.py --serving-tick).
 
     Paged-cache quantities (kv_layout="paged", zero otherwise):
     ``kv_pages_in_use``/``kv_pages_free`` pool gauges plus the
@@ -177,6 +205,9 @@ def serving_stats():
         "prefill_chunks": g("prefill_chunks"),
         "prefill_chunk_ms_avg": avg("prefill_chunk_ms"),
         "decode_steps": g("decode_steps"),
+        "tick_ms_avg": avg("tick_ms"),
+        "tick_compiled_hits": g("tick.compiled_hits"),
+        "tick_fallbacks": g("tick.fallbacks"),
         "kv_pages_in_use": g("kv_pages_in_use"),
         "kv_pages_free": g("kv_pages_free"),
         "kv_pages_peak": g("kv_pages_peak"),

@@ -42,6 +42,21 @@ _FLAGS: dict[str, Any] = {
     # byte-identical eager lane (the pre-ISSUE-12 behavior); pure-dp
     # meshes and single-device steps are unaffected either way.
     "FLAGS_compiled_mp_step": True,
+    # compiled serving scheduler tick (serving/compiled_tick.py,
+    # docs/SERVING.md): the paged engine's decode iteration — batched
+    # decode + vectorized per-slot sampling chain + offset/eos/length
+    # bookkeeping — runs as ONE donated-buffer jit program over
+    # device-resident scheduler state, with admission/completion as the
+    # only host boundary.  Off: the scheduler is byte-identical to the
+    # pre-tick engine (per-call dispatch, host sampling).
+    "FLAGS_compiled_tick": True,
+    # fused per-iteration sampling on the UNCOMPILED serving lane: when
+    # every active slot is greedy or seeded, one jitted call samples
+    # all slots instead of a host round-trip per non-greedy slot.  Also
+    # routes seeded requests' per-row draws through the same key-derived
+    # stream the compiled tick uses (lane-independent tokens).  Off:
+    # the historical per-row global-RNG path, byte-for-byte.
+    "FLAGS_serving_fused_sampling": True,
     "FLAGS_eager_op_cache": True,
     "FLAGS_eager_op_cache_size": 4096,
     "FLAGS_compile_cache_dir": "",

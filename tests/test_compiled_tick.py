@@ -1,0 +1,414 @@
+"""Compiled serving tick (ISSUE 13): one donated-buffer jit program per
+scheduler iteration over device-resident state — bit-equality vs the
+uncompiled scheduler across mixed workloads, flag-off byte-identity,
+typed warn-once fallbacks, watchdog/drain semantics, and the shared
+capture core factored out of framework/train_step.py."""
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTForCausalLM, gpt_config
+from paddle_tpu.serving import (
+    DeadlineExceededError, Engine, SamplingParams, SchedulerStallError,
+    ServingConfig, serving_stats,
+)
+from paddle_tpu.serving.compiled_tick import (
+    CompiledServingTick, TickFallbackWarning,
+)
+from paddle_tpu.utils import flags as _flags
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=2, hidden_size=64, num_heads=2,
+        vocab_size=256, max_seq_len=64))
+    m.eval()
+    return m
+
+
+@pytest.fixture
+def tick_flag():
+    """Restore the tick/fused-sampling flags after each test."""
+    saved = {k: _flags._FLAGS[k] for k in
+             ("FLAGS_compiled_tick", "FLAGS_serving_fused_sampling")}
+    yield _flags._FLAGS
+    _flags._FLAGS.update(saved)
+
+
+def _prompts(lens, seed=0, vocab=256):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (n,)).astype("int32") for n in lens]
+
+
+def _ref_greedy(model, prompt, max_new, eos_token_id=None):
+    ids = model.generate(paddle.to_tensor(prompt[None, :]),
+                         max_new_tokens=max_new, temperature=0.0,
+                         eos_token_id=eos_token_id)
+    return np.asarray(ids._data_)[0, prompt.size:]
+
+
+def _serve(model, subs, cfg=None, compiled=True, flags=None):
+    """Run the engine with FLAGS_compiled_tick set to `compiled`;
+    returns ([RequestOutput], stats snapshot, engine tick object)."""
+    fl = flags if flags is not None else _flags._FLAGS
+    saved = fl["FLAGS_compiled_tick"]
+    fl["FLAGS_compiled_tick"] = compiled
+    try:
+        eng = Engine(model, cfg or ServingConfig(
+            num_slots=2, max_queue=len(subs) + 1)).start()
+        try:
+            futs = [eng.submit(p, max_new_tokens=mn, sampling=sp,
+                               eos_token_id=eos)
+                    for p, mn, sp, eos in subs]
+            outs = [f.result(timeout=300) for f in futs]
+            snap = eng.stats()
+            tick = eng._tick
+        finally:
+            eng.shutdown()
+        return outs, snap, tick
+    finally:
+        fl["FLAGS_compiled_tick"] = saved
+
+
+def test_mixed_workload_bit_equality(model, tick_flag):
+    """Greedy, greedy+eos (slot refilled mid-flight), seeded-sampled,
+    and seeded+penalty/top-k/top-p requests through 2 slots: the
+    compiled tick's outputs are bit-identical to the uncompiled
+    scheduler's, completion reasons included."""
+    pa, pb, pc, pd, pe = _prompts([5, 9, 3, 7, 6], seed=7)
+    eos = int(_ref_greedy(model, pb, 8)[1])   # pb finishes on eos @2
+    subs = [
+        (pa, 8, None, None),
+        (pb, 8, None, eos),
+        (pc, 8, SamplingParams(temperature=0.8, top_k=20, seed=3), None),
+        (pd, 8, SamplingParams(temperature=1.0, top_p=0.9,
+                               repetition_penalty=1.3, seed=5), None),
+        (pe, 8, None, None),
+    ]
+    ref, snap_u, _ = _serve(model, subs, compiled=False)
+    got, snap_c, _ = _serve(model, subs, compiled=True)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r.output_ids, g.output_ids)
+        assert r.finish_reason == g.finish_reason
+    assert got[1].finish_reason == "eos"
+    assert got[1].output_ids.size < 8         # refilled mid-flight
+    assert snap_c["tick_compiled_hits"] > 0
+    assert snap_u["tick_compiled_hits"] == 0
+    np.testing.assert_array_equal(got[0].output_ids,
+                                  _ref_greedy(model, pa, 8))
+
+
+def test_flag_off_is_tickless(model, tick_flag):
+    """FLAGS_compiled_tick off: no tick object is built at all — the
+    scheduler runs the historical per-call path (and with fused
+    sampling off too, unseeded draws consume the global RNG exactly as
+    before: same paddle.seed, same stream)."""
+    (p,) = _prompts([5])
+    tick_flag["FLAGS_serving_fused_sampling"] = False
+    subs = [(p, 5, SamplingParams(temperature=0.9), None)]
+
+    def run():
+        paddle.seed(123)
+        outs, snap, tick = _serve(model, subs, compiled=False)
+        return outs[0].output_ids, snap, tick
+
+    toks1, snap, tick = run()
+    toks2, _, _ = run()
+    assert tick is None
+    np.testing.assert_array_equal(toks1, toks2)   # global-RNG stream
+    assert snap["tick_compiled_hits"] == 0 and snap["tick_fallbacks"] == 0
+
+
+def test_unseeded_sampling_typed_warn_once_fallback(model, tick_flag):
+    """Non-greedy sampling without a seed cannot ride the vectorized
+    in-program chain: the engine warns ONCE with the typed
+    TickFallbackWarning and latches the uncompiled iteration."""
+    pa, pb = _prompts([4, 6], seed=1)
+    sp = SamplingParams(temperature=1.0)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        outs, snap, _ = _serve(
+            model, [(pa, 6, sp, None), (pb, 6, sp, None)],
+            compiled=True)
+    tw = [x for x in w if issubclass(x.category, TickFallbackWarning)]
+    assert len(tw) == 1, [str(x.message) for x in tw]
+    assert "seed" in str(tw[0].message)
+    assert snap["tick_compiled_hits"] == 0
+    assert snap["tick_fallbacks"] > 0
+    assert all(o.output_ids.size == 6 for o in outs)
+
+
+def test_slots_layout_and_speculation_fall_back_typed(model, tick_flag):
+    """kv_layout='slots' and speculation-on both latch the uncompiled
+    scheduler with the typed warning; speculation_k=0 with a draft
+    model configured does NOT (the tick runs)."""
+    (p,) = _prompts([5])
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        outs, snap, _ = _serve(
+            model, [(p, 4, None, None)],
+            cfg=ServingConfig(num_slots=1, kv_layout="slots"),
+            compiled=True)
+    assert any(issubclass(x.category, TickFallbackWarning) and
+               "slots" in str(x.message) for x in w)
+    assert snap["tick_compiled_hits"] == 0
+    np.testing.assert_array_equal(outs[0].output_ids,
+                                  _ref_greedy(model, p, 4))
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        outs, snap, _ = _serve(
+            model, [(p, 4, None, None)],
+            cfg=ServingConfig(num_slots=1, draft_model=model,
+                              speculation_k=2),
+            compiled=True)
+    assert any(issubclass(x.category, TickFallbackWarning) and
+               "speculative" in str(x.message) for x in w)
+    np.testing.assert_array_equal(outs[0].output_ids,
+                                  _ref_greedy(model, p, 4))
+
+    # K=0: bitwise the plain loop — and the tick hosts it
+    outs, snap, _ = _serve(
+        model, [(p, 4, None, None)],
+        cfg=ServingConfig(num_slots=1, draft_model=model,
+                          speculation_k=0),
+        compiled=True)
+    assert snap["tick_compiled_hits"] > 0
+    np.testing.assert_array_equal(outs[0].output_ids,
+                                  _ref_greedy(model, p, 4))
+
+
+def test_seeded_stream_reproducible_and_lane_independent(model,
+                                                         tick_flag):
+    """A seeded request's sampled stream is identical across engine
+    runs AND across lanes (per-row host path, fused call, compiled
+    tick); different seeds give different streams."""
+    (p,) = _prompts([6], seed=9)
+    sp7 = SamplingParams(temperature=0.9, top_k=50, seed=7)
+    subs = [(p, 8, sp7, None)]
+    a, _, _ = _serve(model, subs, compiled=True)
+    b, _, _ = _serve(model, subs, compiled=True)
+    c, _, _ = _serve(model, subs, compiled=False)
+    np.testing.assert_array_equal(a[0].output_ids, b[0].output_ids)
+    np.testing.assert_array_equal(a[0].output_ids, c[0].output_ids)
+    d, _, _ = _serve(model, [(p, 8, SamplingParams(
+        temperature=0.9, top_k=50, seed=8), None)], compiled=True)
+    assert not np.array_equal(a[0].output_ids, d[0].output_ids)
+
+
+def test_deadline_evict_under_compiled_tick(model, tick_flag):
+    """Deadline enforcement keeps its per-token granularity on the
+    compiled lane: an expired in-flight request is evicted (typed
+    error, slot freed) and the survivor completes bit-equal."""
+    pa, pb = _prompts([5, 4], seed=2)
+    tick_flag["FLAGS_compiled_tick"] = True
+    eng = Engine(model, ServingConfig(num_slots=2, max_queue=4)).start()
+    try:
+        f_slow = eng.submit(pa, max_new_tokens=50, deadline_s=0.12)
+        f_ok = eng.submit(pb, max_new_tokens=5)
+        with pytest.raises(DeadlineExceededError):
+            f_slow.result(timeout=60)
+        out = f_ok.result(timeout=60)
+        snap = eng.stats()
+    finally:
+        eng.shutdown()
+    np.testing.assert_array_equal(out.output_ids,
+                                  _ref_greedy(model, pb, 5))
+    assert snap["requests_evicted_deadline"] >= 1
+
+
+def test_drain_completes_inflight_under_compiled_tick(model, tick_flag):
+    """drain() semantics survive the compiled tick: in-flight slots run
+    to completion, queued requests fail, admissions stop."""
+    from paddle_tpu.serving import EngineShutdownError
+    pa, pb, pc = _prompts([5, 6, 4], seed=4)
+    tick_flag["FLAGS_compiled_tick"] = True
+    eng = Engine(model, ServingConfig(num_slots=1, max_queue=8)).start()
+    inflight = eng.submit(pa, max_new_tokens=30)
+    t0 = time.monotonic()
+    while serving_stats()["active_slots"] < 1 and \
+            time.monotonic() - t0 < 30:
+        time.sleep(0.005)
+    queued = eng.submit(pb, max_new_tokens=5)
+    eng.drain(deadline_s=60)
+    out = inflight.result(timeout=5)
+    assert out.output_ids.size == 30
+    with pytest.raises(EngineShutdownError):
+        queued.result(timeout=5)
+    with pytest.raises(EngineShutdownError):
+        eng.submit(pc)
+
+
+def test_stall_watchdog_restarts_compiled_tick(model, tick_flag,
+                                               monkeypatch):
+    """A stalled compiled tick trips the PR 5 scheduler watchdog: the
+    outstanding futures fail with SchedulerStallError, the loop
+    restarts with a FRESH tick (the donated pools may be torn), and the
+    engine serves again — scheduler_restarts/stalls counted."""
+    (p,) = _prompts([5], seed=6)
+    # warm the persistent compile cache for this tick program first: a
+    # cold first compile inside the watchdog's budget would read as a
+    # stall of its own and churn the restart budget
+    _serve(model, [(p, 2, None, None)],
+           cfg=ServingConfig(num_slots=1), compiled=True)
+    orig = CompiledServingTick._run
+    state = {"calls": 0}
+
+    def stalling_run(self):
+        state["calls"] += 1
+        if state["calls"] == 2:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 60.0:
+                time.sleep(0.01)     # interruptible by async-raise
+        return orig(self)
+
+    monkeypatch.setattr(CompiledServingTick, "_run", stalling_run)
+    tick_flag["FLAGS_compiled_tick"] = True
+    # budget must sit between the rebuilt tick's (cache-served)
+    # recompile time and the injected stall
+    eng = Engine(model, ServingConfig(
+        num_slots=1, step_timeout_s=6.0,
+        max_scheduler_restarts=2)).start()
+    try:
+        tick0 = eng._tick
+        f = eng.submit(p, max_new_tokens=4)
+        exc = f.exception(timeout=30)
+        assert isinstance(exc, SchedulerStallError), exc
+        out = eng.generate(p, max_new_tokens=4, timeout=60)
+        snap = eng.stats()
+        assert eng._tick is not tick0        # rebuilt on restart
+    finally:
+        eng.shutdown()
+    np.testing.assert_array_equal(out.output_ids,
+                                  _ref_greedy(model, p, 4))
+    assert snap["scheduler_stalls"] >= 1
+    assert snap["scheduler_restarts"] >= 1
+
+
+def test_pool_gauge_throttle_converges(model, tick_flag):
+    """The throttled pool-gauge publisher (ISSUE 13 satellite) still
+    converges: after the engine quiesces, the gauges reflect the true
+    pool state (every page back, peak recorded) even though steady
+    ticks skipped the registry lock."""
+    prompts = _prompts([5, 7, 4, 6], seed=8)
+    tick_flag["FLAGS_compiled_tick"] = True
+    eng = Engine(model, ServingConfig(
+        num_slots=2, max_queue=5, enable_prefix_cache=False)).start()
+    futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    outs = [f.result(timeout=300) for f in futs]
+    eng.shutdown()          # loop exit force-flushes the pool gauges
+    snap = serving_stats()
+    assert all(o.output_ids.size == 6 for o in outs)
+    assert snap["kv_pages_peak"] > 0
+    # quiesced engine: every page back in the pool, gauges converged
+    # despite steady-state ticks skipping the registry lock
+    assert snap["kv_pages_in_use"] == 0
+    assert snap["kv_pages_free"] == eng.cache.usable_pages
+
+
+def test_tick_metrics_in_snapshot_and_prometheus(model, tick_flag):
+    """serving.tick_ms / tick.compiled_hits / tick.fallbacks land in
+    serving_stats() and the Prometheus exposition (schema the
+    check_telemetry --serving-tick gate enforces)."""
+    import paddle_tpu.observability as obs
+    (p,) = _prompts([5])
+    _, snap, _ = _serve(model, [(p, 4, None, None)], compiled=True)
+    assert snap["tick_ms_avg"] is not None and snap["tick_ms_avg"] > 0
+    assert snap["tick_compiled_hits"] > 0
+    assert snap["tick_fallbacks"] == 0
+    text = obs.render_prometheus()
+    assert "serving_tick_ms_bucket" in text
+    assert "serving_tick_compiled_hits" in text
+    assert "serving_tick_fallbacks" in text
+    from tools.check_telemetry import (check_serving_tick_exposition,
+                                       parse_prometheus)
+    series, typed, errors = parse_prometheus(text)
+    assert not errors
+    assert check_serving_tick_exposition(series, typed) == []
+
+
+def test_capture_core_shared_with_train_step():
+    """The two-phase capture/replay machinery is ONE implementation:
+    train_step's historical names alias framework/capture.py, and
+    run_discovery captures reads + rolls back side effects."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.framework import capture, train_step
+    assert train_step._StepBindTracer is capture.BindTracer
+    assert train_step._Installed is capture.Installed
+    assert train_step.TraceEscape is capture.TraceEscape
+
+    pre = Tensor(np.ones(3, np.float32))
+    counter = Tensor(np.zeros((), np.float32))
+
+    def body():
+        from paddle_tpu.tensor_ops import math as M
+        counter._data = counter._data + 1.0      # write: rolled back
+        return M.add(Tensor(np.ones(3, np.float32)), pre)  # read: captured
+
+    disc = capture.run_discovery(body)
+    assert any(t is pre for t in disc.capture_list)
+    assert not disc.uses_rng
+    assert float(np.asarray(counter._data_)) == 0.0   # rollback
+
+    def hostly():
+        return float(np.asarray(pre.numpy()).sum())
+
+    with pytest.raises(capture.TraceEscape):
+        capture.run_discovery(hostly)
+
+
+def test_concurrent_engines_share_one_model(model, tick_flag):
+    """Thread-mode fleets host several engines over ONE model object:
+    while one engine's tick program traces (tracers swapped into the
+    shared parameters), the other engines' eager prefills/decodes must
+    not observe them — the process-wide capture TRACE_LOCK serializes
+    the window.  Both engines' greedy outputs stay bit-equal to the
+    sequential reference."""
+    prompts = _prompts([5, 7, 4, 6], seed=21)
+    refs = [_ref_greedy(model, p, 6) for p in prompts]
+    tick_flag["FLAGS_compiled_tick"] = True
+    engines = [Engine(model, ServingConfig(num_slots=2,
+                                           max_queue=8)).start()
+               for _ in range(2)]
+    try:
+        # submit to BOTH immediately: engine 0's first tick traces
+        # while engine 1 is mid-prefill/decode on the same parameters
+        futs = [(e, e.submit(p, max_new_tokens=6))
+                for p in prompts for e in engines]
+        outs = [(e, f.result(timeout=300)) for e, f in futs]
+    finally:
+        for e in engines:
+            e.shutdown()
+    for (e, o), ref in zip(outs, [r for r in refs for _ in engines]):
+        np.testing.assert_array_equal(o.output_ids, ref)
+
+
+def test_fused_sampling_flag_off_keeps_per_row_path(model, tick_flag):
+    """FLAGS_serving_fused_sampling off: seeded requests go back to the
+    historical per-row scheduler-thread RNG draw — the stream ignores
+    the request seed (a DIFFERENT request seed gives the same tokens,
+    unlike the seeded lane where streams are seed-derived)."""
+    (p,) = _prompts([5], seed=12)
+    tick_flag["FLAGS_serving_fused_sampling"] = False
+
+    def run(request_seed):
+        outs, _, _ = _serve(
+            model, [(p, 6, SamplingParams(temperature=0.9,
+                                          seed=request_seed), None)],
+            compiled=False)
+        return outs[0].output_ids
+
+    a, b = run(7), run(8)
+    # historical path: the scheduler thread's own RNG drives the draw,
+    # so changing the request seed changes nothing...
+    np.testing.assert_array_equal(a, b)
+    # ...while the fused lane derives the stream from the request seed
+    tick_flag["FLAGS_serving_fused_sampling"] = True
+    c, d = run(7), run(8)
+    assert not np.array_equal(c, d)
+    assert not np.array_equal(a, c)

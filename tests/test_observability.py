@@ -420,11 +420,12 @@ def test_record_event_feeds_flight_recorder():
     from paddle_tpu.profiler import RecordEvent
     from paddle_tpu.observability import flight_recorder as frmod
     rec = frmod.get_recorder()
-    before = len(rec.events())
+    # a saturated ring (earlier serving tests emit a span per scheduler
+    # tick) keeps a constant length as it evicts — assert on content,
+    # not growth
     with RecordEvent("obsv::probe", args={"request_id": 42}):
         pass
     evs = rec.events()
-    assert len(evs) > before
     last = [e for e in evs if e["name"] == "obsv::probe"][-1]
     assert last["kind"] == "span" and last["request_id"] == 42
 

@@ -471,6 +471,84 @@ _SPEC_MIN_ACCEPTANCE = 0.8
 _SPEC_MAX_INT8_PAGES_RATIO = 0.6
 
 
+_TICK_SCHEMA = {
+    # key -> accepted types; every key is required
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "speedup_vs_uncompiled": (int, float),
+    "uncompiled": dict,
+    "compiled": dict,
+    "tick_compiled_hits": (int, float),
+    "tick_fallbacks": (int, float),
+    "slot_occupancy": (int, float),
+    "num_slots": int,
+    "num_requests": int,
+    "max_new_tokens": int,
+    "greedy_mismatches": int,
+    "sampled_mismatches": int,
+    "smoke": bool,
+    "platform": str,
+}
+_TICK_MIN_SPEEDUP = 1.5
+
+
+def check_tick_bench(run):
+    """Schema + speedup/bit-equality gates for the high-occupancy
+    compiled-tick lane of benchmarks/serving_bench.py (--workload
+    occupancy, ISSUE 13): at 8+ slots of short decodes the ONE-program
+    tick must deliver >= 1.5x tokens/sec over the uncompiled scheduler
+    with outputs bit-equal (greedy vs the sequential reference, seeded
+    sampled across lanes) and zero fallbacks."""
+    errors = []
+    for key, types in _TICK_SCHEMA.items():
+        if key not in run:
+            errors.append(f"missing key {key!r}")
+        elif run[key] is None or not isinstance(run[key], types):
+            errors.append(f"{key!r} has type {type(run[key]).__name__}, "
+                          f"expected {types}")
+    if not errors:
+        for side in ("uncompiled", "compiled"):
+            for k in ("tokens_per_sec", "wall_s", "tokens"):
+                v = run[side].get(k)
+                if not isinstance(v, (int, float)) or v <= 0:
+                    errors.append(f"{side}.{k} must be a positive "
+                                  f"number, got {v!r}")
+        if run["num_slots"] < 8:
+            errors.append(f"num_slots {run['num_slots']} < 8 — not a "
+                          "high-occupancy lane")
+        if run["speedup_vs_uncompiled"] < _TICK_MIN_SPEEDUP:
+            errors.append(
+                f"speedup_vs_uncompiled {run['speedup_vs_uncompiled']:.2f}"
+                f" < required {_TICK_MIN_SPEEDUP}x at "
+                f"{run['num_slots']} slots")
+        if run["tick_compiled_hits"] <= 0:
+            errors.append("tick_compiled_hits is 0 — the compiled lane "
+                          "never actually ran the tick program")
+        if run["tick_fallbacks"] != 0:
+            errors.append(f"{run['tick_fallbacks']} tick fallback(s) on "
+                          "an all-hostable workload")
+        if run["greedy_mismatches"] != 0:
+            errors.append(
+                f"{run['greedy_mismatches']} outputs diverged from the "
+                "sequential greedy baseline — the compiled tick must be "
+                "output-invariant")
+        if run["sampled_mismatches"] != 0:
+            errors.append(
+                f"{run['sampled_mismatches']} seeded-sampled outputs "
+                "diverged between the compiled and uncompiled lanes")
+    if errors:
+        print("serving_tick schema check FAILED:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(f"serving_tick schema OK: {run['value']:.1f} tokens/sec, "
+          f"{run['speedup_vs_uncompiled']:.2f}x vs uncompiled at "
+          f"{run['num_slots']} slots, {run['tick_compiled_hits']} "
+          "compiled ticks, outputs bit-equal")
+    return 0
+
+
 def check_spec_bench(run):
     """Schema + speedup/acceptance/capacity gates for the speculative
     lane of benchmarks/serving_bench.py (--workload speculative)."""
@@ -639,6 +717,8 @@ def main():
         return check_mfu_sweep(run)
     if str(run.get("metric", "")).startswith("serving_fleet"):
         return check_fleet_bench(run)
+    if str(run.get("metric", "")).startswith("serving_tick"):
+        return check_tick_bench(run)
     if str(run.get("metric", "")).startswith("serving_speculative"):
         return check_spec_bench(run)
     if str(run.get("metric", "")).startswith("serving_paged"):

@@ -271,6 +271,28 @@ def check_router_exposition(series, typed):
     return errors
 
 
+def check_serving_tick_exposition(series, typed):
+    """Schema gate for the compiled-tick telemetry (ISSUE 13): the
+    ``serving.tick_ms`` iteration histogram plus the
+    ``serving.tick.compiled_hits``/``fallbacks`` lane counters must
+    expose — correctly typed — whenever the engine served traffic.  A
+    dashboard reading only tokens/sec cannot tell whether the ONE-
+    program tick or the uncompiled fallback produced them; these can."""
+    errors = []
+    hname = "serving_tick_ms"
+    if typed.get(hname) != "histogram":
+        errors.append(f"{hname!r} absent or not a histogram")
+    elif hname + "_bucket" not in series:
+        errors.append(f"{hname!r} exposes no buckets")
+    for name in ("serving_tick_compiled_hits", "serving_tick_fallbacks"):
+        if name not in series:
+            errors.append(f"tick counter {name!r} absent")
+        elif typed.get(name) != "counter":
+            errors.append(f"{name!r} typed {typed.get(name)!r}, "
+                          "expected counter")
+    return errors
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--prometheus", help="Prometheus text dump to check")
@@ -285,9 +307,15 @@ def main():
     ap.add_argument("--router", action="store_true",
                     help="also gate the serving-fleet router metric "
                          "schema in the --prometheus dump")
+    ap.add_argument("--serving-tick", action="store_true",
+                    help="also gate the compiled-tick metric schema "
+                         "(serving.tick_ms histogram + hit/fallback "
+                         "counters) in the --prometheus dump")
     args = ap.parse_args()
     if args.router and not args.prometheus:
         ap.error("--router needs --prometheus")
+    if args.serving_tick and not args.prometheus:
+        ap.error("--serving-tick needs --prometheus")
     if not args.prometheus and not args.snapshots \
             and not args.stall_dump and not args.sentinel_dump:
         ap.error("nothing to check: pass --prometheus, --snapshots, "
@@ -312,6 +340,12 @@ def main():
             if not router_errors:
                 print("router exposition OK: full serving.router.* "
                       "schema present")
+        if args.serving_tick:
+            tick_errors = check_serving_tick_exposition(series, typed)
+            failures += tick_errors
+            if not tick_errors:
+                print("serving-tick exposition OK: tick_ms histogram "
+                      "+ compiled_hits/fallbacks counters present")
     if args.snapshots:
         n, errors = check_snapshots(args.snapshots)
         failures += errors

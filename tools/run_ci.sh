@@ -69,14 +69,24 @@ assert snap["slot_occupancy"] > 0, snap
 eng.shutdown()
 leaked = {t.ident for t in threading.enumerate()} - before
 assert not leaked, f"leaked threads: {leaked}"
+import paddle_tpu.observability as obs
+with open("/tmp/pt_serving_ci.prom", "w") as f:
+    f.write(obs.render_prometheus())
 print(f"serving smoke OK: 6 requests, occupancy "
       f"{snap['slot_occupancy']:.2f}, ttft {snap['ttft_ms_avg']:.0f}ms, "
-      "no leaked threads")
+      f"{snap['tick_compiled_hits']} compiled ticks, no leaked threads")
 EOF
+python tools/check_telemetry.py --prometheus /tmp/pt_serving_ci.prom \
+    --serving-tick
 
 echo "== serving continuous-batching bench (smoke) =="
 python benchmarks/serving_bench.py --smoke --out /tmp/serving_bench_ci.json
 python tools/check_bench_result.py /tmp/serving_bench_ci.json
+
+echo "== compiled-tick high-occupancy bench (smoke: >=1.5x at 8 slots, bit-equal) =="
+python benchmarks/serving_bench.py --workload occupancy --smoke \
+    --out /tmp/serving_tick_ci.json
+python tools/check_bench_result.py /tmp/serving_tick_ci.json
 
 echo "== paged KV cache bench: shared-prefix + chunked prefill (smoke) =="
 python benchmarks/serving_bench.py --workload prefix --smoke \
