@@ -10,7 +10,12 @@ listeners (authenticated TCP with pickle transport — stdlib, no extra
 deps).  Each worker runs a daemon serving python callables; the master
 address coordinates the name→endpoint registry, exactly the reference's
 WorkerInfo exchange.  Host-side only: device data moves through the
-collective/checkpoint paths, not RPC (same division as the reference).
+collective/checkpoint paths, not RPC — EXCEPT serving KV-page
+migration, whose page tensors ride the raw-bytes fast path: a `Blob`
+argument (or any bytes-like arg >= RAW_THRESHOLD) is sent as one
+`send_bytes` frame straight from the caller's buffer instead of
+through pickle's object graph, so large payloads cost zero extra
+copies on the send side.
 """
 from __future__ import annotations
 
@@ -36,6 +41,82 @@ class WorkerInfo:
 
 _state = {"workers": {}, "me": None, "listener": None, "thread": None,
           "authkey": b"paddle_tpu_rpc", "running": False}
+
+#: args at least this big ride the raw-bytes fast path automatically
+#: (bytes/bytearray/memoryview; other buffer types wrap in `Blob`)
+RAW_THRESHOLD = 32 * 1024
+
+
+class Blob:
+    """A large binary rpc argument that rides raw byte frames instead of
+    pickle's object graph (the KV-page-migration fast path: a page
+    tensor serialized through pickle is walked, memo'd and copied; a
+    `send_bytes` frame is written straight from the caller's buffer).
+
+    Wraps any C-contiguous buffer (bytes, numpy array, ...) WITHOUT
+    copying: ``data`` is a flat byte memoryview over the original
+    object.  On the receiving side the callee gets a `Blob` over the
+    received frame; ``np.frombuffer(blob.data, ...)`` reconstructs
+    arrays without a further copy.  Pickling a Blob raises — taking the
+    slow path silently is exactly the bug this class exists to stop."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, obj):
+        view = memoryview(obj)
+        if not view.contiguous:
+            raise ValueError(
+                "Blob needs a C-contiguous buffer; copy first "
+                "(np.ascontiguousarray)")
+        self.data = view.cast("B")
+
+    def __len__(self):
+        return self.data.nbytes
+
+    def tobytes(self):
+        return self.data.tobytes()
+
+    def __reduce__(self):
+        raise TypeError(
+            "rpc.Blob must ride the raw-bytes fast path, never pickle "
+            "(a Blob arg reached a pickling code path)")
+
+
+class _BlobSlot:
+    """Pickled placeholder marking where a raw frame re-enters args."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index):
+        self.index = index
+
+    def __reduce__(self):
+        return (_BlobSlot, (self.index,))
+
+
+def _extract_blobs(args):
+    """Split (args) into (args with placeholders, blobs).  Explicit
+    `Blob`s always go raw; bytes-like args at or past RAW_THRESHOLD are
+    promoted automatically (small ones pickle as before — the framing
+    overhead only pays for itself on large payloads)."""
+    out, blobs = [], []
+    for a in args:
+        if not isinstance(a, Blob) and isinstance(
+                a, (bytes, bytearray, memoryview)) and \
+                memoryview(a).nbytes >= RAW_THRESHOLD:
+            a = Blob(a)
+        if isinstance(a, Blob):
+            out.append(_BlobSlot(len(blobs)))
+            blobs.append(a)
+        else:
+            out.append(a)
+    return tuple(out), blobs
+
+
+def _send_blob(conn, blob):
+    """One raw frame, written from the caller's own buffer (module-level
+    so tests can assert send-side zero-copy by interposing here)."""
+    conn.send_bytes(blob.data)
 
 
 def _serve_loop():
@@ -141,6 +222,24 @@ def _handle(conn):
             if kind == "call":
                 _, fn, args, kwargs = msg
                 try:
+                    result = fn(*args, **(kwargs or {}))
+                    conn.send(("ok", result))
+                except Exception as e:  # serialize the failure
+                    conn.send(("err", e))
+            elif kind == "callraw":
+                # raw-bytes fast path: the pickled header carries
+                # _BlobSlot placeholders; each blob follows as one raw
+                # frame and re-enters the args as a received-side Blob
+                _, fn, args, kwargs, n_blobs = msg
+                try:
+                    blobs = [Blob(conn.recv_bytes())
+                             for _ in range(n_blobs)]
+                except (EOFError, OSError):
+                    return
+                try:
+                    args = tuple(blobs[a.index]
+                                 if isinstance(a, _BlobSlot) else a
+                                 for a in args)
                     result = fn(*args, **(kwargs or {}))
                     conn.send(("ok", result))
                 except Exception as e:  # serialize the failure
@@ -251,7 +350,13 @@ def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
     this process forever in ``recv()``."""
     c = _connect(to)
     try:
-        c.send(("call", fn, tuple(args or ()), kwargs))
+        plain, blobs = _extract_blobs(tuple(args or ()))
+        if blobs:
+            c.send(("callraw", fn, plain, kwargs, len(blobs)))
+            for b in blobs:
+                _send_blob(c, b)
+        else:
+            c.send(("call", fn, plain, kwargs))
         if timeout is not None and timeout > 0:
             if not c.poll(timeout):
                 raise TimeoutError(

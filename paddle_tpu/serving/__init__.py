@@ -16,8 +16,8 @@ from __future__ import annotations
 
 from .api import (  # noqa: F401
     DeadlineExceededError, EngineShutdownError, NoReplicaError,
-    QueueFullError, RequestOutput, SamplingParams, SchedulerStallError,
-    ServingConfig, ServingError,
+    PageMigrationError, QueueFullError, RequestOutput, SamplingParams,
+    SchedulerStallError, ServingConfig, ServingError,
 )
 from .compiled_tick import (  # noqa: F401
     CompiledServingTick, TickFallbackWarning,
@@ -36,8 +36,8 @@ __all__ = [
     "CompiledServingTick", "TickFallbackWarning",
     "SlotKVCache", "PagedKVCache", "PrefixTree", "ServingError",
     "QueueFullError", "DeadlineExceededError", "EngineShutdownError",
-    "SchedulerStallError", "NoReplicaError", "serving_stats",
-    "reset_serving_stats", "reset_router_stats", "ServingRouter",
-    "RouterConfig", "HashRing", "ServingFleet", "ReplicaServer",
-    "ReplicaConfig",
+    "SchedulerStallError", "NoReplicaError", "PageMigrationError",
+    "serving_stats", "reset_serving_stats", "reset_router_stats",
+    "ServingRouter", "RouterConfig", "HashRing", "ServingFleet",
+    "ReplicaServer", "ReplicaConfig",
 ]

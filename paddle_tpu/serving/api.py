@@ -52,6 +52,13 @@ class SchedulerStallError(ServingError):
     (bounded by ``max_scheduler_restarts``)."""
 
 
+class PageMigrationError(ServingError):
+    """A KV-page migration payload cannot be adopted by the target
+    replica's pool — incompatible page size / dtype / layer geometry, or
+    an inconsistent offset.  The sending replica treats this exactly
+    like a dead target: it falls back to decoding locally."""
+
+
 @dataclass(frozen=True)
 class SamplingParams:
     """Per-request decoding knobs — the same semantics (and HF processor
@@ -166,6 +173,18 @@ class ServingConfig:
                              every active request is greedy without
                              repetition penalty; mixed batches fall
                              back to the plain step for that iteration
+    role                     prefill/decode disaggregation role this
+                             engine's replica advertises to the fleet:
+                             "mixed" (default — byte-identical to the
+                             pre-disaggregation fleet), "prefill"
+                             (prefers prefill work; hands finished
+                             prompts' KV pages to a decode replica),
+                             or "decode" (receives migrated pages and
+                             runs the pure-decode hot loop).  Roles are
+                             routing preferences, never hard fences: a
+                             replica of any role still serves whatever
+                             the router sends it (docs/SERVING.md
+                             "Prefill/decode disaggregation")
     """
 
     num_slots: int = 4
@@ -186,8 +205,13 @@ class ServingConfig:
     prefill_chunk_tokens: int = 32
     draft_model: object | None = None
     speculation_k: int = 0
+    role: str = "mixed"
 
     def validate(self):
+        if self.role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                "role must be 'mixed', 'prefill' or 'decode', got "
+                f"{self.role!r}")
         if self.num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got "
                              f"{self.num_slots}")
@@ -250,6 +274,9 @@ class RequestOutput:
     finish_reason: str              # "eos" | "length"
     ttft_ms: float                  # submit → first token
     latency_ms: float               # submit → completion
+    #: replica that decoded the tail of this request (fleet only): the
+    #: submit target unless KV-page migration resumed it elsewhere
+    decoded_by: str | None = None
 
     @property
     def ids(self):

@@ -42,7 +42,7 @@ class _Request:
                  "eos_token_id", "deadline", "future", "submit_t",
                  "ttft_ms", "tokens", "seen", "last_token", "slot",
                  "prefill_pos", "shared_len", "prefix_nodes",
-                 "draft_prefill_pos", "first_tok")
+                 "draft_prefill_pos", "first_tok", "handoff", "resume")
 
     def __init__(self, rid, prompt, max_new_tokens, sampling,
                  eos_token_id, deadline):
@@ -64,6 +64,8 @@ class _Request:
         self.prefix_nodes = []      # tree nodes this request references
         self.draft_prefill_pos = 0  # draft-model prefill progress (spec)
         self.first_tok = None       # sampled first token awaiting draft
+        self.handoff = None         # decode-replica target (disagg)
+        self.resume = None          # migrated-page payload + prior state
 
 
 class Engine:
@@ -151,6 +153,19 @@ class Engine:
         self._monitor_stop = threading.Event()
         self._stall_swept = False
         self._preemption_handler = None
+        # live KV-page migration (prefill/decode disaggregation): the
+        # hosting ReplicaServer installs `migrator(req, header, blobs,
+        # target) -> ack` (phase 1: transfer + remote adopt — once it
+        # returns, the LOCAL pages are free) and `migration_awaiter(req,
+        # ack) -> result payload` (phase 2: block for the remote decode
+        # with no local resources held).  None = this engine never
+        # migrates (the pre-disaggregation engine, byte-for-byte)
+        self.migrator = None
+        self.migration_awaiter = None
+        self._migrating_out: dict[int, _Request] = {}
+        self._migration_results: deque = deque()
+        self._migrate_failed: set[int] = set()
+        self._drain_migrate = False
 
     # ---------------- lifecycle ----------------
     def start(self):
@@ -161,6 +176,7 @@ class Engine:
                 return self
             stats.reset_serving_stats()
             stats.declare_tick_stats()
+            stats.declare_migration_stats()
             self.cache = self._new_cache()
             self._tick = self._make_tick()
             self._max_active = 0
@@ -260,20 +276,30 @@ class Engine:
         # shutdown() racing a never-started or crashed loop
         self._fail_all(EngineShutdownError("engine shut down"))
 
-    def drain(self, deadline_s=None):
+    def drain(self, deadline_s=None, migrate=False):
         """Graceful shutdown (the preemption/SIGTERM path): stop
         admissions immediately, fail every still-queued request with
         `EngineShutdownError`, let the slots already decoding run to
         completion within `deadline_s` (default
         `ServingConfig.drain_grace_s`), then shut the engine down —
         whatever is still unfinished at the deadline fails like a normal
-        shutdown.  Idempotent; safe from any thread."""
+        shutdown.  Idempotent; safe from any thread.
+
+        ``migrate=True`` (needs an installed `migrator`): instead of
+        decoding the in-flight slots out locally, their KV pages —
+        prompt AND tokens emitted so far — stream to a surviving
+        replica and each request resumes there with its cache intact
+        (docs/SERVING.md "Prefill/decode disaggregation").  A failed
+        transfer falls back to finishing locally, so migrate-on-drain
+        can only ever speed a drain up."""
         deadline_s = self.scfg.drain_grace_s if deadline_s is None \
             else float(deadline_s)
         with self._work:
             if not self._running:
                 return
             already = self._draining
+            self._drain_migrate = bool(migrate) and \
+                self.migrator is not None and self._paged
             self._draining = True
             queued = list(self._queue)
             self._queue.clear()
@@ -290,7 +316,8 @@ class Engine:
                 f"engine draining: request {req.id} was still queued"))
             stats.incr("requests_cancelled_drain")
         deadline = time.monotonic() + deadline_s
-        while (self._active or self._prefilling) and \
+        while (self._active or self._prefilling
+               or self._migrating_out) and \
                 time.monotonic() < deadline:
             time.sleep(0.01)
         _fr.record("serving", "drain_end",
@@ -319,10 +346,18 @@ class Engine:
 
     # ---------------- client API ----------------
     def submit(self, prompt_ids, max_new_tokens=None, sampling=None,
-               eos_token_id=None, deadline_s=None):
+               eos_token_id=None, deadline_s=None, handoff=None):
         """Enqueue one request; returns a `Future[RequestOutput]`.
         Raises `QueueFullError` when the bounded queue is at capacity
-        and `ValueError` for prompts the slot cache cannot hold."""
+        and `ValueError` for prompts the slot cache cannot hold.
+
+        ``handoff`` (disaggregation): a migration target descriptor the
+        hosting replica's `migrator` understands.  When set on a paged
+        engine with a migrator installed, the request's KV pages are
+        streamed to that replica once its prompt is hot and decoding
+        resumes there; on any migration failure the request falls back
+        to decoding locally — handoff can slow a request, never lose
+        it."""
         prompt = np.asarray(
             prompt_ids._data_ if hasattr(prompt_ids, "_data_")
             else prompt_ids).astype(np.int32).reshape(-1)
@@ -356,6 +391,8 @@ class Engine:
             if deadline_s is not None else None
         req = _Request(next(self._ids), prompt, max_new, sampling,
                        eos_token_id, deadline)
+        if handoff is not None and self._paged:
+            req.handoff = handoff
         with self._work:
             if not self._running:
                 raise EngineShutdownError(
@@ -384,6 +421,83 @@ class Engine:
                           sampling=sampling, eos_token_id=eos_token_id,
                           deadline_s=deadline_s)
         return fut.result(timeout or self.scfg.request_timeout_s)
+
+    def submit_resume(self, prompt_ids, prior_tokens, pages,
+                      max_new_tokens=None, sampling=None,
+                      eos_token_id=None, deadline_s=None, ttft_ms=None):
+        """Resume a migrated request from its transferred KV pages: the
+        receive side of prefill/decode disaggregation (and of drained-
+        replica recovery).  `pages` is `migration.unpack`'s dict —
+        layer-pooled K/V page arrays (+ per-page scales), offset — and
+        `prior_tokens` the tokens the sender already emitted (>= 1: the
+        prefill replica samples the first token before handing off).
+        The request enters the admission queue like any other; once the
+        pool adopts its pages it decodes from where the sender stopped,
+        bit-equal to never having moved, with the prompt never
+        recomputed.  Raises `PageMigrationError` for payloads this
+        engine's pool can never hold."""
+        from .api import PageMigrationError
+        if not self._paged:
+            raise PageMigrationError(
+                "page adoption requires kv_layout='paged'")
+        prompt = np.asarray(
+            prompt_ids._data_ if hasattr(prompt_ids, "_data_")
+            else prompt_ids).astype(np.int32).reshape(-1)
+        prior = [int(t) for t in np.asarray(prior_tokens).reshape(-1)]
+        if prompt.size == 0 or not prior:
+            raise ValueError("resume needs a prompt and >= 1 prior token")
+        sampling = (sampling or SamplingParams()).validate()
+        max_new = int(self.scfg.default_max_new_tokens
+                      if max_new_tokens is None else max_new_tokens)
+        if len(prior) >= max_new:
+            raise ValueError(
+                f"{len(prior)} prior tokens already exhaust the "
+                f"max_new_tokens={max_new} budget — nothing to resume")
+        if prompt.size + len(prior) >= self.max_len:
+            raise ValueError(
+                f"prompt {prompt.size} + {len(prior)} prior tokens "
+                f"leave no room to decode in a {self.max_len}-token slot")
+        if int(pages["offset"]) != prompt.size + len(prior) - 1:
+            raise PageMigrationError(
+                f"offset {pages['offset']} inconsistent with prompt "
+                f"{prompt.size} + {len(prior)} prior tokens (expected "
+                f"{prompt.size + len(prior) - 1} cached positions)")
+        psz = self._page_size
+        pool = self.scfg.kv_pool_pages or \
+            self.scfg.num_slots * \
+            (-(-(self.max_len + self._spec_k) // psz))
+        need = -(-(min(prompt.size + max_new, self.max_len)
+                   + self._spec_k) // psz)
+        if need > pool:
+            raise PageMigrationError(
+                f"resumed request needs {need} KV pages but the pool "
+                f"holds {pool}")
+        deadline = (time.monotonic() + deadline_s) \
+            if deadline_s is not None else None
+        req = _Request(next(self._ids), prompt, max_new, sampling,
+                       eos_token_id, deadline)
+        req.resume = dict(pages)
+        req.tokens = prior
+        req.last_token = prior[-1]
+        req.ttft_ms = ttft_ms
+        with self._work:
+            if not self._running:
+                raise EngineShutdownError(
+                    "engine is not running (call start())")
+            if self._draining:
+                raise EngineShutdownError(
+                    "engine is draining; not adopting migrated requests")
+            if len(self._queue) >= self.scfg.max_queue:
+                stats.incr("requests_rejected_queue_full")
+                raise QueueFullError(
+                    f"request queue is full ({self.scfg.max_queue} "
+                    "waiting); the sender should fall back or retry")
+            self._queue.append(req)
+            self._pending[req.id] = req
+            stats.incr("requests_submitted")
+            stats.set_value("queue_depth", len(self._queue))
+            self._work.notify()
+        return req.future
 
     def stats(self):
         return stats.serving_stats()
@@ -444,6 +558,7 @@ class Engine:
                 with self._work:
                     if not self._running:
                         break
+                    self._process_migration_results_locked()
                     self._expire_queued_locked()
                     admits = []
                     while self._queue and self.cache.free_slots:
@@ -464,9 +579,18 @@ class Engine:
                 if budget > 0:
                     self._iter_deadline = time.monotonic() + budget
                 t_tick = time.monotonic()
+                if self._paged and self._draining and \
+                        self._drain_migrate and self.migrator is not None:
+                    # preemption recovery: stream the still-decoding
+                    # slots' pages to survivors instead of racing the
+                    # drain deadline token by token
+                    self._migrate_out_active()
                 if self._paged:
                     for req, slot in admits:
-                        self._start_prefill(req, slot)
+                        if req.resume is not None:
+                            self._activate_resumed(req, slot)
+                        else:
+                            self._start_prefill(req, slot)
                     # ONE batched chunk call covers every prefilling
                     # request, then the decode step runs: long prompts
                     # advance without ever blocking in-flight streams
@@ -580,6 +704,31 @@ class Engine:
         # real token before rollback, so the reservation covers it
         total = min(req.prompt.size + req.max_new_tokens, self.max_len) \
             + self._spec_k
+        if req.resume is not None:
+            # migrated request: adopt its transferred pages instead of
+            # reserving for a prefill it will never run.  Adopted pages
+            # are slot-private; the reservation covers only the growth
+            # still ahead of the offset.
+            pay = req.resume
+            n = int(pay["k_pages"].shape[1])
+            reserve = max(0, -(-total // psz) - n)
+            slot = self.cache.adopt_pages(
+                reserve, pay["offset"], pay["k_pages"], pay["v_pages"],
+                pay["k_scales"], pay["v_scales"])
+            if slot is None:
+                return None         # pool backpressure: stays queued
+            if self._spec:
+                dslot = self.draft_cache.allocate(
+                    self.draft_cache.pages_per_slot)
+                if dslot != slot:   # pragma: no cover - invariant
+                    raise RuntimeError(
+                        f"draft cache slot {dslot} diverged from "
+                        f"target slot {slot}")
+                # the draft never saw this prompt; teacher-forced
+                # catch-up re-converges it from position 0
+                self.draft_cache.set_offset(slot, 0)
+            stats.incr("migration.pages_received", n)
+            return slot
         nodes, pages = [], []
         if self.prefix_tree is not None:
             nodes, pages = self.prefix_tree.match(req.prompt)
@@ -709,8 +858,19 @@ class Engine:
                 self._prefilling.remove(req)
             except ValueError:
                 continue    # a concurrent stall sweep already swept it
-            self._active[req.slot] = req
             tok, req.first_tok = req.first_tok, None
+            if self._migrate_ready(req, tok):
+                # disaggregation handoff: the prompt's pages are hot —
+                # stream them to the decode replica instead of joining
+                # this replica's decode batch
+                req.tokens = [tok]
+                req.last_token = tok
+                if req.seen is not None:
+                    req.seen[tok] = True
+                stats.incr("tokens_generated")
+                self._begin_migration(req)
+                continue
+            self._active[req.slot] = req
             self._append_token(req, tok)
         stats.set_value("active_slots", len(self._active))
 
@@ -744,6 +904,181 @@ class Engine:
         stats.observe("prefill_ms", dt_ms)
         stats.incr("prefill_chunks", len(reqs))
         return logits, starts
+
+    # ---------------- live KV-page migration (disaggregation) ----------------
+    def _migrate_ready(self, req, tok):
+        """Whether this just-prefilled request should hand off: a target
+        was assigned, a migrator is installed, and the request will not
+        finish on this very token (migrating a finished request is pure
+        waste) nor has it already blown its deadline."""
+        if req.handoff is None or self.migrator is None:
+            return False
+        if req.max_new_tokens <= 1:
+            return False
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            return False
+        if req.prompt.size + 1 >= self.max_len:
+            return False
+        if self.scfg.deadline_policy == "evict" and \
+                req.deadline is not None and \
+                time.monotonic() > req.deadline:
+            return False
+        return True
+
+    def _begin_migration(self, req):
+        """Export the slot's pages (scheduler thread: the only cache
+        writer) and ship them from a background thread — the transfer
+        must not stall other slots' decode.  The slot and its pages
+        stay held until the outcome lands: success releases them,
+        failure re-activates the request locally with nothing lost."""
+        from . import migration
+        header, blobs = migration.export_slot(self.cache, req.slot)
+        self._migrating_out[req.id] = req
+        self._mut += 1          # slot left the active set: tick rebuilds
+        stats.incr("migration.pages_sent", header["num_pages"])
+        threading.Thread(
+            target=self._migrate_async,
+            args=(req, header, blobs, req.handoff),
+            name=f"migrate-{req.id}", daemon=True).start()
+
+    def _migrate_async(self, req, header, blobs, target):
+        """Background transfer thread.  Phase 1 (`migrator`): ship the
+        frames + remote adopt — timed as ``migrate_ms``; a failure here
+        is recoverable (the local slot still holds everything) and
+        falls back.  Phase 2 (`migration_awaiter`): wait out the remote
+        decode holding NOTHING locally; a failure here (target died
+        mid-decode) fails the future with `EngineShutdownError`, which
+        the router answers with an idempotent resubmission."""
+        t0 = time.monotonic()
+        try:
+            ack = self.migrator(req, header, blobs, target)
+        except Exception as e:              # noqa: BLE001
+            stats.observe("migration.migrate_ms",
+                          (time.monotonic() - t0) * 1e3)
+            self._post_migration(req, "fail", e)
+            return
+        stats.observe("migration.migrate_ms",
+                      (time.monotonic() - t0) * 1e3)
+        if self.migration_awaiter is None:
+            # single-phase migrator (tests): phase 1 returned the result
+            self._post_migration(req, "done", ack)
+            return
+        self._post_migration(req, "sent", None)
+        try:
+            payload = self.migration_awaiter(req, ack)
+        except Exception as e:              # noqa: BLE001
+            self._post_migration(req, "lost", e)
+            return
+        self._post_migration(req, "done", payload)
+
+    def _post_migration(self, req, kind, val):
+        with self._work:
+            self._migration_results.append((req, kind, val))
+            self._work.notify()
+
+    def _process_migration_results_locked(self):
+        """Land transfer outcomes (scheduler thread, under the lock):
+
+        ``sent``  remote adopted the pages — release the local slot;
+                  the request keeps only a result relay in flight
+        ``done``  remote stream arrived — complete the future (and free
+                  the slot if no ``sent`` preceded: single-phase tests)
+        ``fail``  phase-1 failure — re-activate locally, nothing lost
+        ``lost``  target died AFTER adopting — local pages are gone, so
+                  fail the future loudly; the router's idempotent
+                  resubmission re-runs the request on a survivor
+        """
+        while self._migration_results:
+            req, kind, val = self._migration_results.popleft()
+            if req.id not in self._migrating_out:
+                continue        # swept by _fail_all/shutdown already
+            if kind == "sent":
+                self._release(req)      # keeps riding _migrating_out
+                continue
+            del self._migrating_out[req.id]
+            if kind == "fail":
+                stats.incr("migration.fallbacks")
+                from ..observability import flight_recorder as _fr
+                _fr.record("serving", "migration_fallback",
+                           request_id=req.id,
+                           error=type(val).__name__)
+                self._migrate_failed.add(req.id)
+                self._active[req.slot] = req
+                self._mut += 1
+                continue
+            if kind == "lost":
+                stats.incr("migration.remote_failures")
+                self._fail(req, EngineShutdownError(
+                    f"request {req.id}: migration target died after "
+                    f"adopting its pages ({type(val).__name__}: {val}); "
+                    "resubmit"))
+                continue
+            self._complete_migrated(req, val)
+            self._release(req)
+
+    def _complete_migrated(self, req, payload):
+        """Resolve a handed-off request's future with the stream the
+        decode replica produced (prior tokens included — bit-equal to
+        having decoded here)."""
+        out = RequestOutput(
+            request_id=req.id, prompt_ids=req.prompt,
+            output_ids=np.asarray(payload["output_ids"], np.int32),
+            finish_reason=payload["finish_reason"], ttft_ms=req.ttft_ms,
+            latency_ms=(time.monotonic() - req.submit_t) * 1e3,
+            decoded_by=payload.get("replica"))
+        with self._lock:
+            self._pending.pop(req.id, None)
+        try:
+            if not req.future.done():
+                req.future.set_result(out)
+        except Exception:       # lost the race to a concurrent _fail
+            return
+        stats.incr("requests_completed")
+        stats.incr("migration.migrations")
+        from ..observability import flight_recorder as _fr
+        _fr.record("serving", "request_done", request_id=req.id,
+                   reason=payload["finish_reason"],
+                   tokens=int(np.asarray(payload["output_ids"]).size),
+                   migrated_to=payload.get("replica"))
+
+    def _activate_resumed(self, req, slot):
+        """Receive side: the adopted request enters the decode batch
+        exactly where the sender stopped — tokens, last token, penalty
+        state and cache offset all continue, the prompt is never
+        recomputed."""
+        req.slot = slot
+        if req.sampling.uses_penalty:
+            seen = np.zeros(self.cfg.vocab_size, bool)
+            seen[req.prompt] = True
+            seen[np.asarray(req.tokens, np.int32)] = True
+            req.seen = seen
+        req.resume = None
+        self._active[slot] = req
+        self._mut += 1
+        stats.incr("migration.resumed_requests")
+        stats.set_value("active_slots", len(self._active))
+
+    def _migrate_out_active(self):
+        """Drain-time preemption recovery: every slot still decoding is
+        exported and resumed on a survivor (mid-stream: its emitted
+        tokens ride along), so a drain costs one page transfer instead
+        of re-running the prompt elsewhere."""
+        if self._tick is not None:
+            # the compiled tick keeps token buffers device-resident;
+            # the export ships req.tokens, so sync the host mirror first
+            self._tick.flush_to_host()
+        now = time.monotonic()
+        for slot, req in list(self._active.items()):
+            if req.id in self._migrate_failed:
+                continue        # one failed transfer: decode it out here
+            if self.scfg.deadline_policy == "evict" and \
+                    req.deadline is not None and now > req.deadline:
+                continue        # about to be evicted anyway
+            if len(req.tokens) >= req.max_new_tokens:
+                continue        # finishing this iteration regardless
+            del self._active[slot]
+            self._begin_migration(req)
+        stats.set_value("active_slots", len(self._active))
 
     # forced gauge flush cadence: a steady-state decode stretch whose
     # page counts never move publishes at most once per this many
@@ -1132,6 +1467,8 @@ class Engine:
             self._queue.clear()
             self._active.clear()
             self._prefilling.clear()
+            self._migrating_out.clear()
+            self._migration_results.clear()
         for req in reqs:
             if not req.future.done():
                 self._fail(req, exc)

@@ -28,6 +28,17 @@ Replica lifecycle states gossiped in the `fleet.{name}` record:
 Join generations come from an atomic store counter, so EVERY
 (re)incarnation of a name is strictly ordered — the router's
 sticky-dead set compares generations, never wall clocks.
+
+Prefill/decode disaggregation (ISSUE 14): the record also carries the
+replica's ``role`` (`ServingConfig.role`), and the replica hosts the
+KV-page-migration plane — `_remote_adopt` installs streamed page
+frames into the local pool and `_remote_await` relays the resumed
+request's result; `_migrate_request`/`_await_migration` are the
+sending side the engine calls through its migrator hooks.  Drain
+migrates a specialized replica's in-flight slots to a survivor
+(`migrate_on_drain`), and `ServingFleet.flip_role` rides drain + the
+bumped-generation rejoin to flip a live replica's role with zero lost
+requests.  See docs/SERVING.md "Prefill/decode disaggregation".
 """
 from __future__ import annotations
 
@@ -61,6 +72,13 @@ class ReplicaConfig:
     dedup_results           how many request-id → future entries the
                             idempotency cache keeps (resubmits of a
                             known rid re-await instead of re-decoding)
+    migrate_on_drain        role-specialized replicas (role != "mixed")
+                            stream their in-flight slots' KV pages to a
+                            surviving replica on SIGTERM/drain instead
+                            of decoding them out — the request resumes
+                            with its cache intact, never recomputing
+                            the prompt.  Mixed replicas keep the PR 9
+                            finish-in-place drain byte-identically
     """
 
     heartbeat_interval_s: float = 0.5
@@ -68,6 +86,7 @@ class ReplicaConfig:
     drain_deadline_s: float = 20.0
     tensor_parallel_degree: int = 1
     dedup_results: int = 512
+    migrate_on_drain: bool = True
 
     def validate(self):
         if self.heartbeat_interval_s <= 0:
@@ -92,7 +111,7 @@ _REPLICAS: dict[str, "ReplicaServer"] = {}
 
 
 def _remote_submit(replica_name, rid, prompt, max_new_tokens, sampling,
-                   eos_token_id, deadline_s):
+                   eos_token_id, deadline_s, handoff=None):
     """The request plane's rpc target: runs inside the replica process
     (one rpc handler thread per router connection, so blocking on the
     engine future is fine)."""
@@ -102,7 +121,32 @@ def _remote_submit(replica_name, rid, prompt, max_new_tokens, sampling,
             f"replica {replica_name!r} is not hosted in this process "
             f"(hosted: {sorted(_REPLICAS)})")
     return rep.handle_submit(rid, prompt, max_new_tokens, sampling,
-                             eos_token_id, deadline_s)
+                             eos_token_id, deadline_s, handoff=handoff)
+
+
+def _remote_adopt(replica_name, rid, meta, header, *blobs):
+    """Migration phase 1 rpc target (decode side): adopt the page
+    frames — which arrive as `rpc.Blob` raw frames, never pickle —
+    into this replica's pool and queue the resumed request.  Returns
+    as soon as the adoption is queued, so the SENDER's pages free
+    immediately; the result is fetched by `_remote_await`."""
+    rep = _REPLICAS.get(replica_name)
+    if rep is None:
+        raise EngineShutdownError(
+            f"replica {replica_name!r} is not hosted in this process "
+            f"(hosted: {sorted(_REPLICAS)})")
+    return rep.handle_resume_begin(rid, meta, header, blobs)
+
+
+def _remote_await(replica_name, rid, timeout_s):
+    """Migration phase 2 rpc target (decode side): block for the
+    resumed request's completion and return its payload."""
+    rep = _REPLICAS.get(replica_name)
+    if rep is None:
+        raise EngineShutdownError(
+            f"replica {replica_name!r} is not hosted in this process "
+            f"(hosted: {sorted(_REPLICAS)})")
+    return rep.handle_resume_await(rid, timeout_s)
 
 
 def _open_store(spec):
@@ -163,6 +207,10 @@ class ReplicaServer:
         self._dedup_lock = threading.Lock()
         self._store_lock = threading.Lock()
         self.engine = Engine(model, serving_config).start()
+        # live KV-page migration: the engine exports/adopts pages; the
+        # replica supplies the transport (rpc) + target selection
+        self.engine.migrator = self._migrate_request
+        self.engine.migration_awaiter = self._await_migration
         self.rpc_server = rpc.RpcServer(name)
         _REPLICAS[name] = self
         self.membership.register(name)
@@ -190,6 +238,7 @@ class ReplicaServer:
                 "port": self.rpc_server.info.port, "state": self._state,
                 "gen": self.gen, "pid": os.getpid(),
                 "tp": self.cfg.tensor_parallel_degree,
+                "role": self.engine.scfg.role,
                 "load": self._load(), "load_ts": time.time()}
         with self._store_lock:
             self.store.set(INFO_PREFIX + self.name, json.dumps(info))
@@ -217,17 +266,28 @@ class ReplicaServer:
 
     # ---------------- request plane ----------------
     def handle_submit(self, rid, prompt, max_new_tokens, sampling,
-                      eos_token_id, deadline_s):
+                      eos_token_id, deadline_s, handoff=None):
         """Idempotent submit: a rid seen before re-awaits the SAME
         engine future (a router resubmission after an ambiguous timeout
-        can never make this replica decode — or deliver — twice)."""
+        can never make this replica decode — or deliver — twice).
+        ``handoff`` names the decode replica this request's KV pages
+        should migrate to once its prompt is hot (disaggregation)."""
         with self._dedup_lock:
             fut = self._dedup.get(rid)
+            if fut is not None and fut.done() and \
+                    isinstance(fut.exception(), EngineShutdownError):
+                # the cached attempt failed without ever delivering
+                # (e.g. its migration target died after adopting): a
+                # resubmission under the same rid deserves a FRESH
+                # attempt — re-awaiting the corpse would bounce the
+                # request until its resubmit budget ran out
+                fut = None
             if fut is None:
                 fut = self.engine.submit(
                     prompt, max_new_tokens=max_new_tokens,
                     sampling=SamplingParams(**(sampling or {})),
-                    eos_token_id=eos_token_id, deadline_s=deadline_s)
+                    eos_token_id=eos_token_id, deadline_s=deadline_s,
+                    handoff=handoff)
                 self._dedup[rid] = fut
                 while len(self._dedup) > self.cfg.dedup_results:
                     self._dedup.popitem(last=False)
@@ -246,20 +306,144 @@ class ReplicaServer:
         return {"request_id": rid, "replica": self.name,
                 "output_ids": np.asarray(out.output_ids, np.int32),
                 "finish_reason": out.finish_reason,
-                "ttft_ms": out.ttft_ms, "latency_ms": out.latency_ms}
+                "ttft_ms": out.ttft_ms, "latency_ms": out.latency_ms,
+                "decoded_by": out.decoded_by or self.name}
+
+    # ---------------- migration plane ----------------
+    def handle_resume_begin(self, rid, meta, header, blobs):
+        """Adopt a migrated request (idempotent under the sender-scoped
+        rid, sharing the submit dedup cache): install its page frames
+        into the pool and queue decoding from its prior tokens.
+        Returns the ack the sender's `_remote_await` call echoes back —
+        from this moment the SENDER's copy of the pages is dead
+        weight."""
+        from . import migration
+        with self._dedup_lock:
+            fut = self._dedup.get(rid)
+            if fut is None:
+                pages = migration.unpack(header, *blobs)
+                fut = self.engine.submit_resume(
+                    meta["prompt"], meta["tokens"], pages,
+                    max_new_tokens=meta["max_new_tokens"],
+                    sampling=SamplingParams(**(meta["sampling"] or {})),
+                    eos_token_id=meta["eos_token_id"],
+                    deadline_s=meta["deadline_s"],
+                    ttft_ms=meta["ttft_ms"])
+                self._dedup[rid] = fut
+                while len(self._dedup) > self.cfg.dedup_results:
+                    self._dedup.popitem(last=False)
+        return {"rid": rid, "replica": self.name}
+
+    def handle_resume_await(self, rid, timeout_s):
+        """Block for a previously adopted request's completion."""
+        with self._dedup_lock:
+            fut = self._dedup.get(rid)
+        if fut is None:
+            raise EngineShutdownError(
+                f"replica {self.name} holds no migrated request {rid!r}"
+                " (evicted from the dedup cache or never adopted)")
+        out = fut.result(timeout=timeout_s)
+        return {"request_id": rid, "replica": self.name,
+                "output_ids": np.asarray(out.output_ids, np.int32),
+                "finish_reason": out.finish_reason,
+                "ttft_ms": out.ttft_ms, "latency_ms": out.latency_ms,
+                "decoded_by": out.decoded_by or self.name}
+
+    def _migration_meta(self, req):
+        return {"prompt": req.prompt, "tokens": list(req.tokens),
+                "max_new_tokens": req.max_new_tokens,
+                "sampling": {"temperature": req.sampling.temperature,
+                             "top_k": req.sampling.top_k,
+                             "top_p": req.sampling.top_p,
+                             "repetition_penalty":
+                                 req.sampling.repetition_penalty,
+                             "seed": req.sampling.seed},
+                "eos_token_id": req.eos_token_id,
+                "deadline_s": (req.deadline - time.monotonic())
+                if req.deadline is not None else None,
+                "ttft_ms": req.ttft_ms}
+
+    def _migrate_request(self, req, header, blobs, target):
+        """The engine's migrator hook (phase 1): ship one request's
+        pages to `target` (router-assigned) or — drain-time, target
+        None — to a survivor picked from the fleet gossip.  Returns
+        once the target adopted; raises on any failure and the engine
+        falls back to decoding locally."""
+        from ..distributed import rpc
+        from .api import NoReplicaError
+        if target is None:
+            target = self._pick_peer()
+        if target is None:
+            raise NoReplicaError(
+                f"replica {self.name}: no ready peer to migrate "
+                f"request {req.id} to")
+        rpc.connect_worker(target["name"], target["ip"], target["port"])
+        meta = self._migration_meta(req)
+        rid = f"mig-{self.name}-{self.gen}-{req.id}"
+        ack = rpc.rpc_sync(
+            target["name"], _remote_adopt,
+            args=(target["name"], rid, meta, header) + tuple(blobs),
+            timeout=30.0)
+        ack["target"] = dict(target)
+        ack["deadline_s"] = meta["deadline_s"]
+        return ack
+
+    def _await_migration(self, req, ack):
+        """The engine's awaiter hook (phase 2): relay the remote
+        result, holding nothing locally while the decode replica
+        works."""
+        from ..distributed import rpc
+        timeout = ack["deadline_s"] if ack["deadline_s"] is not None \
+            else self.engine.scfg.request_timeout_s
+        return rpc.rpc_sync(
+            ack["target"]["name"], _remote_await,
+            args=(ack["target"]["name"], ack["rid"], timeout + 1.0),
+            timeout=timeout + 2.0)
+
+    def _pick_peer(self):
+        """Drain-time migration target from the fleet gossip: a ready
+        peer, decode-role first, then mixed, then prefill; least loaded
+        within a class.  None when this replica is alone."""
+        rank = {"decode": 0, "mixed": 1, "prefill": 2}
+        best = None
+        with self._store_lock:
+            records = self.store.list_prefix(INFO_PREFIX)
+        for key, val in records.items():
+            try:
+                info = json.loads(val.decode())
+            except ValueError:
+                continue
+            if info.get("name") == self.name or \
+                    info.get("state") != "ready":
+                continue
+            load = info.get("load") or {}
+            score = (rank.get(info.get("role", "mixed"), 1),
+                     load.get("queue_depth", 0)
+                     + load.get("active_slots", 0), info["name"])
+            if best is None or score < best[0]:
+                best = (score, info)
+        if best is None:
+            return None
+        info = best[1]
+        return {"name": info["name"], "ip": info.get("ip", "127.0.0.1"),
+                "port": int(info.get("port", 0))}
 
     # ---------------- lifecycle ----------------
     def drain(self, deadline_s=None):
         """The SIGTERM path: advertise `draining` (the router stops
         routing here within a poll), let in-flight slots finish inside
-        the deadline, fail whatever is still queued, then leave the
-        ring."""
+        the deadline — role-specialized replicas instead MIGRATE them
+        to a survivor with their KV pages intact (migrate_on_drain) —
+        fail whatever is still queued, then leave the ring."""
         try:
             self.set_state("draining")
         except Exception:
             pass
+        migrate = self.cfg.migrate_on_drain and \
+            self.engine.scfg.role != "mixed"
         self.engine.drain(deadline_s if deadline_s is not None
-                          else self.cfg.drain_deadline_s)
+                          else self.cfg.drain_deadline_s,
+                          migrate=migrate)
         self.close()
 
     def close(self):
@@ -328,7 +512,8 @@ class ServingFleet:
                  serving_config: ServingConfig | None = None,
                  replica_config: ReplicaConfig | None = None,
                  router_config: RouterConfig | None = None,
-                 warmup_prompt=None, name_prefix="replica"):
+                 warmup_prompt=None, name_prefix="replica",
+                 roles=None):
         self.model_factory = model_factory
         self.num_replicas = int(num_replicas)
         self.scfg = serving_config
@@ -337,11 +522,32 @@ class ServingFleet:
             heartbeat_ttl_s=self.rcfg.heartbeat_ttl_s)
         self.warmup_prompt = warmup_prompt
         self.name_prefix = name_prefix
+        #: per-replica role, positional (disaggregated fleets spawn
+        #: asymmetric: e.g. roles=["prefill", "decode"]); None = every
+        #: replica "mixed" (byte-identical to the symmetric fleet)
+        self.roles = list(roles) if roles is not None else None
+        if self.roles is not None and \
+                len(self.roles) != self.num_replicas:
+            raise ValueError(
+                f"{len(self.roles)} roles for {self.num_replicas} "
+                "replicas")
         self.router: ServingRouter | None = None
         self._store = None
         self._procs: dict[str, object] = {}
+        self._configs: dict[str, ServingConfig | None] = {}
         self._next_idx = 0
         self._ctx = None
+
+    def _role_config(self, role, serving_config=None):
+        """The ServingConfig a replica of `role` runs: an explicit
+        per-replica config wins; otherwise the fleet default with the
+        role stamped in."""
+        import dataclasses
+        cfg = serving_config if serving_config is not None else self.scfg
+        if role is None:
+            return cfg
+        cfg = cfg if cfg is not None else ServingConfig()
+        return dataclasses.replace(cfg, role=role)
 
     # ---------------- lifecycle ----------------
     def start(self, warmup_timeout_s=300.0):
@@ -351,16 +557,19 @@ class ServingFleet:
         self._store = TCPStore(is_master=True)
         self._store_spec = ("tcp", "127.0.0.1", self._store.port)
         self._ctx = mp.get_context("spawn")
-        for _ in range(self.num_replicas):
-            self._spawn()
+        for i in range(self.num_replicas):
+            self._spawn(role=self.roles[i] if self.roles else None)
         self.wait_ready(self.num_replicas, timeout=warmup_timeout_s)
         self.router = ServingRouter(self._store,
                                     self.router_cfg).start()
         return self
 
-    def _spawn(self):
-        name = f"{self.name_prefix}-{self._next_idx}"
-        self._next_idx += 1
+    def _spawn(self, role=None, serving_config=None, name=None):
+        if name is None:
+            name = f"{self.name_prefix}-{self._next_idx}"
+            self._next_idx += 1
+        scfg = self._role_config(role, serving_config)
+        self._configs[name] = scfg
         tp = self.rcfg.tensor_parallel_degree
         override = {"JAX_PLATFORMS": os.environ.get(
             "JAX_PLATFORMS", "cpu"), "PALLAS_AXON_POOL_IPS": ""}
@@ -375,7 +584,7 @@ class ServingFleet:
         try:
             p = self._ctx.Process(
                 target=_replica_proc_main,
-                args=(name, self._store_spec, self.scfg, self.rcfg,
+                args=(name, self._store_spec, scfg, self.rcfg,
                       self.model_factory, self.warmup_prompt),
                 name=name)
             p.start()
@@ -407,12 +616,23 @@ class ServingFleet:
                     f"{timeout}s: {self.replica_states()}")
             time.sleep(0.2)
 
-    def replica_states(self):
+    def replica_states(self, detail=False):
+        """{name: state} snapshot from the gossip, or — ``detail=True``
+        — {name: {"state", "role", "gen", "pid"}} so asymmetric-fleet
+        tests and the disagg bench can assert role assignment
+        directly."""
         out = {}
         for key, val in self._store.list_prefix(INFO_PREFIX).items():
             try:
                 info = json.loads(val.decode())
-                out[info["name"]] = info.get("state", "?")
+                if detail:
+                    out[info["name"]] = {
+                        "state": info.get("state", "?"),
+                        "role": info.get("role", "mixed"),
+                        "gen": info.get("gen", 0),
+                        "pid": info.get("pid")}
+                else:
+                    out[info["name"]] = info.get("state", "?")
             except (ValueError, KeyError):
                 continue
         return out
@@ -440,10 +660,49 @@ class ServingFleet:
         the ring before the deadline."""
         return self.kill_replica(name, sig=signal.SIGTERM)
 
-    def add_replica(self):
+    def add_replica(self, role=None, serving_config=None, name=None):
         """Scale up: spawn a fresh replica; it registers, warms, and
-        the router's watcher rings it in."""
-        return self._spawn()
+        the router's watcher rings it in.  ``role`` stamps a
+        disaggregation role onto the fleet's serving config (or pass a
+        full per-replica ``serving_config``) so chaos tests and the
+        bench can build asymmetric fleets directly."""
+        return self._spawn(role=role, serving_config=serving_config,
+                           name=name)
+
+    def flip_role(self, name, role, serving_config=None,
+                  warmup_timeout_s=300.0):
+        """Mid-load role flip: SIGTERM-drain `name` (its in-flight
+        requests migrate to survivors or finish; its queue bounces back
+        to the router for resubmission), wait for the process to exit,
+        then respawn the SAME name with the new role — the store's
+        generation counter bumps, so the router admits the rejoin
+        through the PR 9 anti-flap protocol.  Zero requests are lost
+        across the flip."""
+        proc = self._procs[name]
+        self.drain_replica(name)
+        proc.join(self.rcfg.drain_deadline_s + 30)
+        if proc.is_alive():                   # pragma: no cover
+            raise RuntimeError(
+                f"replica {name} did not exit within the drain "
+                "deadline; refusing to respawn its name")
+        self._spawn(role=role, serving_config=serving_config, name=name)
+        deadline = time.time() + warmup_timeout_s
+        while True:
+            states = self.replica_states(detail=True)
+            info = states.get(name)
+            if info and info["state"] == "ready" \
+                    and info["role"] == role:
+                return name
+            p = self._procs[name]
+            if p.exitcode not in (None, 0):
+                raise RuntimeError(
+                    f"replica {name} died during role flip "
+                    f"(exitcode {p.exitcode})")
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"replica {name} never came back ready as "
+                    f"{role!r}: {states.get(name)}")
+            time.sleep(0.2)
 
     def shutdown(self, timeout=30.0):
         if self.router is not None:

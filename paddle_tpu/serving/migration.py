@@ -1,0 +1,106 @@
+"""Live KV-page migration: the wire format between serving replicas.
+
+Prefill/decode disaggregation (docs/SERVING.md) moves a request's hot
+KV pages from the replica that computed its prompt to the replica that
+will decode it.  `PagedKVCache`'s fixed page pools and int32 page
+tables make the transfer page-granular and static-shaped: a migration
+is exactly
+
+- one pickled **header** (small): pool geometry + offset + page count,
+- one pickled **meta** dict (small): the request itself — prompt ids,
+  tokens emitted so far, sampling params, budgets, remaining deadline,
+- 2 or 4 **raw byte frames** (large): layer-pooled K and V page bytes
+  (`[num_layers, n, page_size, H, D]`, the sender's pool rows
+  bit-exact) plus per-page scale arrays when the pool stores int8/fp8.
+
+The frames ride `distributed.rpc.Blob` — `send_bytes` straight from
+the export arrays, never pickle's object graph — and are reconstructed
+on the receive side with `np.frombuffer`, so the only unavoidable copy
+is the socket read.  `PagedKVCache.adopt_pages` installs them into
+free pool slots as slot-PRIVATE pages: refcounted prefix-tree
+ownership never crosses replicas (a shared prefix migrates as a copy;
+the sender's tree keeps its pages and refcounts).
+
+Wire format version history:
+  1 — initial: header/meta/K/V(+scales) as above.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+WIRE_VERSION = 1
+
+
+def _np_dtype(name):
+    """Resolve a dtype name, including the ml_dtypes float8 family that
+    plain numpy does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def export_slot(cache, slot):
+    """Snapshot `slot`'s cached pages from `cache` (a `PagedKVCache`)
+    into ``(header, blobs)`` ready for the rpc raw-bytes fast path."""
+    from ..distributed.rpc import Blob
+    off, k, v, ks, vs = cache.export_pages(slot)
+    header = {
+        "version": WIRE_VERSION,
+        "page_size": cache.page_size,
+        "offset": off,
+        "num_pages": int(k.shape[1]),
+        "num_layers": int(k.shape[0]),
+        "kv_heads": int(k.shape[3]),
+        "head_dim": int(k.shape[4]),
+        "store_dtype": str(k.dtype),
+        "quant": cache.quant_dtype,
+    }
+    blobs = [Blob(k), Blob(v)]
+    if ks is not None:
+        blobs += [Blob(ks), Blob(vs)]
+    return header, blobs
+
+
+def unpack(header, *blobs):
+    """Inverse of `export_slot` on the receiving replica: reconstruct
+    the page arrays from the raw frames.  Returns the kwargs-shaped
+    dict `Engine.submit_resume` expects.  Raises `PageMigrationError`
+    on a version/frame-count mismatch — a malformed payload must fail
+    loudly before it touches a pool."""
+    from .api import PageMigrationError
+    if header.get("version") != WIRE_VERSION:
+        raise PageMigrationError(
+            f"migration wire version {header.get('version')!r} != "
+            f"supported {WIRE_VERSION}")
+    quant = header.get("quant") is not None
+    want = 4 if quant else 2
+    if len(blobs) != want:
+        raise PageMigrationError(
+            f"{len(blobs)} page frames for a "
+            f"{'quantized' if quant else 'float'} pool (expected {want})")
+    shape = (header["num_layers"], header["num_pages"],
+             header["page_size"], header["kv_heads"],
+             header["head_dim"])
+    dt = _np_dtype(header["store_dtype"])
+    expect = int(np.prod(shape)) * dt.itemsize
+    for b in blobs[:2]:
+        if len(b) != expect:
+            raise PageMigrationError(
+                f"page frame holds {len(b)} bytes, geometry says "
+                f"{expect}")
+    out = {
+        "offset": int(header["offset"]),
+        "k_pages": np.frombuffer(blobs[0].data, dt).reshape(shape),
+        "v_pages": np.frombuffer(blobs[1].data, dt).reshape(shape),
+        "k_scales": None,
+        "v_scales": None,
+    }
+    if quant:
+        sshape = shape[:3]
+        out["k_scales"] = np.frombuffer(
+            blobs[2].data, np.float32).reshape(sshape)
+        out["v_scales"] = np.frombuffer(
+            blobs[3].data, np.float32).reshape(sshape)
+    return out

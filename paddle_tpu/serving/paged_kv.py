@@ -238,6 +238,112 @@ class PagedKVCache:
         """Return a tree-owned page to the free pool (LRU eviction)."""
         self._free_pages.append(int(page))
 
+    # ---------------- live page migration (serving/migration.py) ----------------
+    def adopt_pages(self, reserve_pages, offset, k_pages, v_pages,
+                    k_scales=None, v_scales=None):
+        """Install migrated KV pages into free pool slots: the receive
+        side of prefill/decode disaggregation.  ``k_pages``/``v_pages``
+        are ``[num_layers, n, page_size, H, D]`` host arrays (the
+        sender's pool rows, bit-exact), ``offset`` the migrated
+        sequence's cached-token count, and ``reserve_pages`` how many
+        MORE pages the resumed request may still claim while decoding.
+
+        Adopted pages are slot-PRIVATE — shared/tree ownership never
+        crosses replicas, so a migrated shared prefix arrives as a
+        plain copy.  Returns the slot index, or None when no slot or
+        not enough uncommitted pages remain (admission backpressure,
+        exactly like `allocate`).  Geometry/dtype mismatches raise
+        `PageMigrationError` — the sender falls back to decoding
+        locally rather than corrupting this pool."""
+        from .api import PageMigrationError
+        k_pages = np.asarray(k_pages)
+        v_pages = np.asarray(v_pages)
+        pool = np.asarray(self.layers[0]["k_pool"]._data_)
+        want = (len(self.layers),) + pool.shape[1:]
+        if k_pages.ndim != 5 or k_pages.shape[0] != want[0] or \
+                k_pages.shape[2:] != want[1:] or \
+                v_pages.shape != k_pages.shape:
+            raise PageMigrationError(
+                f"page payload {k_pages.shape}/{v_pages.shape} does not "
+                f"fit a [{want[0]}, n, {want[1]}, {want[2]}, {want[3]}] "
+                "pool (layers/page_size/heads/head_dim mismatch)")
+        if k_pages.dtype != pool.dtype:
+            raise PageMigrationError(
+                f"page payload dtype {k_pages.dtype} != pool dtype "
+                f"{pool.dtype} (sender and receiver must share "
+                "ServingConfig.cache_dtype)")
+        quant = self.quant_dtype is not None
+        if quant != (k_scales is not None):
+            raise PageMigrationError(
+                "per-page scales "
+                + ("missing for a quantized pool"
+                   if quant else "sent to an unquantized pool"))
+        n = int(k_pages.shape[1])
+        if n < 1 or n > self.pages_per_slot:
+            raise PageMigrationError(
+                f"{n} pages do not fit a {self.pages_per_slot}-page "
+                "table row")
+        if -(-int(offset) // self.page_size) > n:
+            raise PageMigrationError(
+                f"offset {offset} claims more cached tokens than the "
+                f"{n} migrated pages hold")
+        if not self._free_slots or \
+                n + int(reserve_pages) > self.available_pages:
+            return None                     # backpressure, never a crash
+        slot = self._free_slots.pop()
+        pages = [self._free_pages.pop() for _ in range(n)]
+        self.table[slot, :] = 0
+        self.table[slot, :n] = pages
+        self._private[slot] = list(pages)
+        self._shared[slot] = 0
+        self._reserved[slot] = int(reserve_pages)
+        self.offsets[slot] = int(offset)
+        if quant:
+            k_scales = np.asarray(k_scales)
+            v_scales = np.asarray(v_scales)
+        # page-at-a-time scatter: every update is the SAME [page_size,
+        # H, D] shape whatever the payload's page count, so the install
+        # compiles once ever instead of once per distinct n
+        for li, lay in enumerate(self.layers):
+            kp, vp = lay["k_pool"]._data_, lay["v_pool"]._data_
+            for j, pid in enumerate(pages):
+                kp = kp.at[pid].set(jnp.asarray(k_pages[li, j]))
+                vp = vp.at[pid].set(jnp.asarray(v_pages[li, j]))
+            lay["k_pool"], lay["v_pool"] = Tensor(kp), Tensor(vp)
+            if quant:
+                ks, vs = lay["k_scale"]._data_, lay["v_scale"]._data_
+                for j, pid in enumerate(pages):
+                    ks = ks.at[pid].set(jnp.asarray(k_scales[li, j]))
+                    vs = vs.at[pid].set(jnp.asarray(v_scales[li, j]))
+                lay["k_scale"], lay["v_scale"] = Tensor(ks), Tensor(vs)
+        self._dirty = True
+        return slot
+
+    def export_pages(self, slot):
+        """Host snapshot of the slot's cached pages, layer-pooled: the
+        send side of live migration.  Returns ``(offset, k, v,
+        k_scales, v_scales)`` with ``k``/``v`` ``[num_layers, n,
+        page_size, H, D]`` contiguous arrays covering every page the
+        offset has written into (shared tree pages included — the COPY
+        migrates; tree ownership stays here), scales None for float
+        pools."""
+        off = int(self.offsets[slot])
+        n = max(1, -(-off // self.page_size))
+        ids = [int(p) for p in self.table[slot, :n]]
+        ks, vs, kss, vss = [], [], [], []
+        for lay in self.layers:
+            ks.append(np.asarray(lay["k_pool"]._data_)[ids])
+            vs.append(np.asarray(lay["v_pool"]._data_)[ids])
+            if self.quant_dtype is not None:
+                kss.append(np.asarray(lay["k_scale"]._data_)[ids])
+                vss.append(np.asarray(lay["v_scale"]._data_)[ids])
+        k = np.ascontiguousarray(np.stack(ks))
+        v = np.ascontiguousarray(np.stack(vs))
+        if self.quant_dtype is None:
+            return off, k, v, None, None
+        return off, k, v, np.ascontiguousarray(np.stack(kss)), \
+            np.ascontiguousarray(np.stack(vss))
+
     # ---------------- device views ----------------
     def layer_caches(self):
         """Per-layer cache dicts for the batched decode step.  Flushes

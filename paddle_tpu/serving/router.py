@@ -37,6 +37,14 @@ separate processes (or threads, in tests), with
   Fresh replicas register `warming`, flip to `ready`, and the watcher
   warms them into the ring (scale up).
 
+Prefill/decode disaggregation (`RouterConfig.disaggregation`, ISSUE
+14): replicas gossip a role, candidates order prefill > mixed >
+decode, and every submit carries the least-loaded ready decode replica
+as its KV-page migration target — the prefill replica streams the
+finished prompt's pages there and the request resumes decoding with
+its cache intact, bit-equal to never having moved.  Knob off: routing
+is byte-identical to the symmetric fleet.
+
 Anti-flap protocol (with `TCPElasticStore.reap`): a replica whose lease
 expires is marked dead *sticky* under its join generation — resumed
 heartbeats on the stale lease do NOT resurrect it.  The watcher reaps
@@ -86,6 +94,21 @@ class RouterConfig:
                          ready replica (fleet warming up / mid-failover)
                          before NoReplicaError
     request_timeout_s    sync generate()'s Future wait
+    disaggregation       prefill/decode disaggregation: route new
+                         requests to prefill-role replicas first
+                         (prefill > mixed > decode preference, ring
+                         order within a class — roles are preferences,
+                         so a lone decode replica still serves direct
+                         traffic) and assign each request the least-
+                         loaded ready decode replica as its KV-page
+                         migration target.  Off (default): roles are
+                         ignored entirely — routing is byte-identical
+                         to the symmetric fleet
+    migrate_min_new_tokens  only requests decoding at least this many
+                         tokens get a migration target — a short tail
+                         is cheaper to decode where it prefilled than
+                         to move (requests without an explicit
+                         max_new_tokens always qualify)
     """
 
     heartbeat_ttl_s: float = 3.0
@@ -96,6 +119,8 @@ class RouterConfig:
     virtual_nodes: int = 64
     no_replica_patience_s: float = 30.0
     request_timeout_s: float = 120.0
+    disaggregation: bool = False
+    migrate_min_new_tokens: int = 2
 
     def validate(self):
         if self.heartbeat_ttl_s <= 0:
@@ -168,7 +193,7 @@ class HashRing:
 
 class _ReplicaView:
     __slots__ = ("name", "ip", "port", "state", "gen", "load",
-                 "load_ts", "tp")
+                 "load_ts", "tp", "role")
 
     def __init__(self, info):
         self.name = info["name"]
@@ -179,6 +204,7 @@ class _ReplicaView:
         self.load = info.get("load") or {}
         self.load_ts = float(info.get("load_ts", 0.0))
         self.tp = int(info.get("tp", 1))
+        self.role = info.get("role", "mixed")
 
 
 class _RoutedRequest:
@@ -392,7 +418,11 @@ class ServingRouter:
     def _candidates(self, req):
         """Ready replicas in affinity order, cheap-shed filtered: a
         replica whose fresh gossip already says its queue is full is
-        skipped without paying an rpc."""
+        skipped without paying an rpc.  Disaggregation reorders the
+        candidates by role preference (prefill > mixed > decode, ring
+        order within a class) — new prompts land on prefill replicas,
+        but a decode replica still serves as the last resort, so a
+        fleet mid-role-flip never strands a request."""
         with self._lock:
             order = list(self.ring.successors(req.session_key))
             views = dict(self._replicas)
@@ -411,6 +441,10 @@ class ServingRouter:
                 skipped_full += 1
                 continue
             out.append(name)
+        if self.cfg.disaggregation:
+            rank = {"prefill": 0, "mixed": 1, "decode": 2}
+            out.sort(key=lambda n: rank.get(
+                getattr(views.get(n), "role", "mixed"), 1))
         return out, skipped_full
 
     def _fail(self, req, exc):
@@ -428,16 +462,18 @@ class ServingRouter:
             output_ids=np.asarray(payload["output_ids"], np.int32),
             finish_reason=payload["finish_reason"],
             ttft_ms=payload.get("ttft_ms"),
-            latency_ms=(time.monotonic() - req.submit_t) * 1e3)
+            latency_ms=(time.monotonic() - req.submit_t) * 1e3,
+            decoded_by=payload.get("decoded_by") or replica)
         with self._lock:
             self._inflight.pop(req.rid, None)
+            view = self._replicas.get(replica)
         if req.future.done():            # at-most-once delivery
             return
         try:
             req.future.set_result(out)
         except Exception:
             return
-        stats.route_observe(replica)
+        stats.route_observe(replica, view.role if view else "mixed")
         stats.observe("router.route_latency_ms", out.latency_ms)
         if req.resubmits:
             stats.incr("router.requests_recovered")
@@ -558,6 +594,24 @@ class ServingRouter:
             f"retry after {self.cfg.retry_after_s:.1f}s",
             retry_after_s=self.cfg.retry_after_s))
 
+    def _pick_decode_target(self, exclude):
+        """The migration target for a request about to land on
+        `exclude`: the least-loaded ready decode-role replica, or None
+        when the fleet has none (the prefill replica then decodes
+        locally — disaggregation degrades to mixed, never to a
+        failure)."""
+        with self._lock:
+            ready = self.ring.members
+            views = [v for n, v in self._replicas.items()
+                     if n in ready and n != exclude
+                     and v.role == "decode"]
+        if not views:
+            return None
+        v = min(views, key=lambda v: (
+            v.load.get("queue_depth", 0) + v.load.get("active_slots", 0),
+            v.name))
+        return {"name": v.name, "ip": v.ip, "port": v.port}
+
     def _try_replica(self, req, name, budget):
         """One delivery attempt.  Returns None on success (future
         completed) or the exception describing why this replica did not
@@ -569,13 +623,18 @@ class ServingRouter:
                     "top_k": req.sampling.top_k,
                     "top_p": req.sampling.top_p,
                     "repetition_penalty":
-                        req.sampling.repetition_penalty}
+                        req.sampling.repetition_penalty,
+                    "seed": req.sampling.seed}
+        migratable = req.max_new_tokens is None or \
+            req.max_new_tokens >= self.cfg.migrate_min_new_tokens
+        handoff = self._pick_decode_target(name) \
+            if self.cfg.disaggregation and migratable else None
         try:
             payload = rpc.rpc_sync(
                 name, _remote_submit,
                 args=(name, req.rid, req.prompt,
                       req.max_new_tokens, sampling, req.eos_token_id,
-                      remaining),
+                      remaining, handoff),
                 timeout=budget + 1.0)
         except Exception as e:               # noqa: BLE001
             return e

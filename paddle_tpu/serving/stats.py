@@ -44,15 +44,20 @@ def observe(name, value):
 ROUTER_PREFIX = PREFIX + "router."
 
 
-def route_observe(replica):
+def route_observe(replica, role="mixed"):
     """One routed request: the per-replica labeled counter
-    ``serving.router.requests_routed{replica=...}`` plus the flat total
-    the snapshot reads."""
+    ``serving.router.requests_routed{replica=...}``, the per-role
+    ``serving.router.requests_routed_role{role=...}`` disaggregation
+    view, plus the flat total the snapshot reads."""
     from ..observability import registry as _registry
     _registry.counter(ROUTER_PREFIX + "requests_routed",
                       "requests routed per replica",
                       labelnames=("replica",)) \
         .labels(replica=str(replica)).inc()
+    _registry.counter(ROUTER_PREFIX + "requests_routed_role",
+                      "requests routed per replica role",
+                      labelnames=("role",)) \
+        .labels(role=str(role or "mixed")).inc()
     monitor.incr(ROUTER_PREFIX + "requests_routed_total")
 
 
@@ -83,6 +88,34 @@ def declare_tick_stats():
                         "wall time of one scheduler iteration (ms)")
 
 
+def declare_migration_stats():
+    """Get-or-create the KV-page-migration metric families at engine
+    start so the Prometheus exposition carries the full disaggregation
+    schema before the first transfer — a dashboard must see
+    ``migrations`` at 0, not a missing series, on a replica that never
+    migrated (tools/check_telemetry.py --migration gates on this)."""
+    from ..observability import registry as _registry
+    _registry.counter(PREFIX + "migration.pages_sent",
+                      "KV pages exported to another replica")
+    _registry.counter(PREFIX + "migration.pages_received",
+                      "KV pages adopted from another replica")
+    _registry.counter(PREFIX + "migration.migrations",
+                      "requests whose decode was handed off and "
+                      "completed remotely")
+    _registry.counter(PREFIX + "migration.resumed_requests",
+                      "migrated requests resumed from adopted pages "
+                      "on this replica")
+    _registry.counter(PREFIX + "migration.fallbacks",
+                      "failed transfers that fell back to decoding "
+                      "locally (dead target, pool full, timeout)")
+    _registry.counter(PREFIX + "migration.remote_failures",
+                      "targets that died AFTER adopting pages; the "
+                      "request was failed for router resubmission")
+    _registry.histogram(PREFIX + "migration.migrate_ms",
+                        "wall time of one page transfer + remote "
+                        "resume handshake (ms)")
+
+
 def declare_router_stats():
     """Get-or-create every ``serving.router.*`` metric family so the
     Prometheus exposition carries the full fleet schema from router
@@ -93,6 +126,9 @@ def declare_router_stats():
     _registry.counter(ROUTER_PREFIX + "requests_routed",
                       "requests routed per replica",
                       labelnames=("replica",))
+    _registry.counter(ROUTER_PREFIX + "requests_routed_role",
+                      "requests routed per replica role",
+                      labelnames=("role",))
     for name, doc in (
             ("requests_routed_total", "requests routed, all replicas"),
             ("requests_shed", "fail-fast rejections: every ready "
@@ -162,6 +198,16 @@ def serving_stats():
     ``spec_draft_ms_avg``/``spec_verify_ms_avg``/
     ``spec_rollback_ms_avg`` — all in the Prometheus exposition too.
 
+    Migration quantities (prefill/decode disaggregation, zero without
+    it): ``migrations`` (requests handed off and completed remotely),
+    ``migration_pages_sent``/``migration_pages_received`` page-transfer
+    volume, ``migration_resumed_requests`` (requests resumed here from
+    adopted pages), ``migration_fallbacks`` (failed transfers that
+    decoded locally instead), and ``migrate_ms_avg`` — all declared at
+    engine start and in the Prometheus exposition, gated by
+    tools/check_telemetry.py --migration, which also requires the
+    router's per-role ``requests_routed_role{role=...}`` family.
+
     Fleet/router quantities (``serving.router.*``, zero without a
     router; per-replica ``requests_routed{replica=...}`` series live in
     the Prometheus exposition): ``router_requests_routed`` total,
@@ -220,6 +266,12 @@ def serving_stats():
         "spec_draft_ms_avg": avg("spec_draft_ms"),
         "spec_verify_ms_avg": avg("spec_verify_ms"),
         "spec_rollback_ms_avg": avg("spec_rollback_ms"),
+        "migrations": g("migration.migrations"),
+        "migration_pages_sent": g("migration.pages_sent"),
+        "migration_pages_received": g("migration.pages_received"),
+        "migration_resumed_requests": g("migration.resumed_requests"),
+        "migration_fallbacks": g("migration.fallbacks"),
+        "migrate_ms_avg": avg("migration.migrate_ms"),
         "prefix_cache_hits": g("prefix_cache_hits"),
         "prefix_cache_misses": g("prefix_cache_misses"),
         "prefix_cache_evictions": g("prefix_cache_evictions"),

@@ -696,6 +696,120 @@ def check_fleet_bench(run):
     return 0
 
 
+_DISAGG_SCHEMA = {
+    # key -> accepted types; every key is required
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "ttft_p99_improvement": (int, float),
+    "decode_p50_improvement": (int, float),
+    "symmetric": dict,
+    "disagg": dict,
+    "flip": dict,
+    "greedy_mismatches": int,
+    "num_replicas": int,
+    "long_prompts": int,
+    "chat_prompts": int,
+    "parallel_host": bool,
+    "host_cores": int,
+    "smoke": bool,
+    "platform": str,
+}
+_DISAGG_SIDE_KEYS = ("ttft_p99_ms", "decode_p50_ms", "tokens_per_sec",
+                     "wall_s", "requests")
+_DISAGG_FLIP_KEYS = ("victim", "new_role", "lost_requests",
+                     "greedy_mismatches", "resubmissions", "converged",
+                     "gen_bumped")
+# acceptance floors (ISSUE 14): at EQUAL chip count on the mixed
+# long-prompt/chat workload, the disaggregated fleet must beat the
+# symmetric fleet on BOTH tail TTFT (prefill replicas run chunk rounds
+# without decode steps in the way) and median inter-token latency (the
+# decode replica's hot loop never pays a prefill chunk), migrated
+# outputs must be bit-equal to the single-replica greedy reference,
+# and a mid-load role flip must lose zero requests.
+#
+# The improvement floors apply on a `parallel_host` (>= 3 cores or
+# TPU): with the two replicas timesliced onto 1 core, total work is
+# conserved and wall-clock deltas measure the OS scheduler, not the
+# architecture — there the lane still gates bit-equality, actual
+# migration, and the lossless role flip, and records latencies
+# observationally (benchmarks/README.md: "a regression canary, never
+# a hardware claim").
+_DISAGG_MIN_IMPROVEMENT = 1.0
+
+
+def check_disagg_bench(run):
+    """Schema + improvement/bit-equality/flip gates for the
+    prefill/decode disaggregation lane of
+    benchmarks/serving_fleet_bench.py (--workload disagg, ISSUE 14)."""
+    errors = []
+    for key, types in _DISAGG_SCHEMA.items():
+        if key not in run:
+            errors.append(f"missing key {key!r}")
+        elif run[key] is None or not isinstance(run[key], types):
+            errors.append(f"{key!r} has type {type(run[key]).__name__}, "
+                          f"expected {types}")
+    if not errors:
+        for side in ("symmetric", "disagg"):
+            for k in _DISAGG_SIDE_KEYS:
+                v = run[side].get(k)
+                if not isinstance(v, (int, float)) or v <= 0:
+                    errors.append(f"{side}.{k} must be a positive "
+                                  f"number, got {v!r}")
+        for k in _DISAGG_FLIP_KEYS:
+            if k not in run["flip"]:
+                errors.append(f"flip missing {k!r}")
+    if not errors:
+        if run.get("parallel_host", True):
+            if run["ttft_p99_improvement"] <= _DISAGG_MIN_IMPROVEMENT:
+                errors.append(
+                    f"ttft_p99_improvement "
+                    f"{run['ttft_p99_improvement']:.3f}"
+                    f"x <= {_DISAGG_MIN_IMPROVEMENT}x — disaggregation "
+                    "did not improve tail TTFT vs the symmetric fleet")
+            if run["decode_p50_improvement"] <= _DISAGG_MIN_IMPROVEMENT:
+                errors.append(
+                    f"decode_p50_improvement "
+                    f"{run['decode_p50_improvement']:.3f}x <= "
+                    f"{_DISAGG_MIN_IMPROVEMENT}x — disaggregation did "
+                    "not improve median inter-token latency")
+        if run["greedy_mismatches"] != 0:
+            errors.append(
+                f"{run['greedy_mismatches']} outputs diverged from the "
+                "single-replica greedy reference — migrated KV pages "
+                "must be bit-exact")
+        if run["disagg"].get("migrated_requests", 0) < 1:
+            errors.append("no request actually migrated — the "
+                          "disaggregated lane measured nothing")
+        flip = run["flip"]
+        if flip["lost_requests"] != 0:
+            errors.append(f"{flip['lost_requests']} requests LOST "
+                          "through the mid-load role flip")
+        if flip["greedy_mismatches"] != 0:
+            errors.append(f"{flip['greedy_mismatches']} outputs "
+                          "diverged across the role flip")
+        if not flip["converged"]:
+            errors.append("fleet never converged after the role flip "
+                          "(victim not back ready under its new role)")
+        if not flip["gen_bumped"]:
+            errors.append("role flip rejoined WITHOUT a bumped "
+                          "generation — the anti-flap protocol was "
+                          "bypassed")
+    if errors:
+        print("serving_disagg schema check FAILED:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    gated = "" if run.get("parallel_host", True) else \
+        " (observational: timesliced host)"
+    print(f"serving_disagg schema OK: ttft p99 "
+          f"{run['ttft_p99_improvement']:.2f}x, decode p50 "
+          f"{run['decode_p50_improvement']:.2f}x vs symmetric{gated}, "
+          f"{run['disagg'].get('migrated_requests')} migrated, "
+          "flip lost 0")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("bench_json")
@@ -715,6 +829,8 @@ def main():
         return check_train_step_bench(run)
     if str(run.get("metric", "")).startswith("mfu_sweep"):
         return check_mfu_sweep(run)
+    if str(run.get("metric", "")).startswith("serving_disagg"):
+        return check_disagg_bench(run)
     if str(run.get("metric", "")).startswith("serving_fleet"):
         return check_fleet_bench(run)
     if str(run.get("metric", "")).startswith("serving_tick"):

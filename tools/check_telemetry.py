@@ -271,6 +271,49 @@ def check_router_exposition(series, typed):
     return errors
 
 
+_MIGRATION_COUNTERS = ("serving_migration_pages_sent",
+                       "serving_migration_pages_received",
+                       "serving_migration_migrations",
+                       "serving_migration_resumed_requests",
+                       "serving_migration_fallbacks")
+
+
+def check_migration_exposition(series, typed):
+    """Schema gate for the KV-page-migration telemetry (ISSUE 14): the
+    full ``serving.migration.*`` family — page-transfer volume both
+    directions, completed migrations, resumed requests, local
+    fallbacks, and the ``migrate_ms`` histogram — must expose,
+    correctly typed, from engine start, plus the router's per-role
+    ``requests_routed_role`` counter.  A missing series reads as
+    'never migrated / never fell back' on a dashboard that is actually
+    blind to the disaggregated fleet."""
+    errors = []
+    for name in _MIGRATION_COUNTERS:
+        if name not in series:
+            errors.append(f"migration counter {name!r} absent")
+        elif typed.get(name) != "counter":
+            errors.append(f"{name!r} typed {typed.get(name)!r}, "
+                          "expected counter")
+    hname = "serving_migration_migrate_ms"
+    if typed.get(hname) != "histogram":
+        errors.append(f"{hname!r} absent or not a histogram")
+    elif hname + "_bucket" not in series:
+        errors.append(f"{hname!r} exposes no buckets")
+    rname = "serving_router_requests_routed_role"
+    if typed.get(rname) != "counter":
+        errors.append(f"{rname!r} (per-role) absent or not a counter")
+    else:
+        labeled = [labels for labels, _ in series.get(rname, [])
+                   if "role" in labels]
+        total = sum(float(v) for labels, v in
+                    series.get("serving_router_requests_routed_total",
+                               []))
+        if total > 0 and not labeled:
+            errors.append(f"{rname!r} has no role-labeled samples "
+                          "despite routed requests")
+    return errors
+
+
 def check_serving_tick_exposition(series, typed):
     """Schema gate for the compiled-tick telemetry (ISSUE 13): the
     ``serving.tick_ms`` iteration histogram plus the
@@ -311,11 +354,18 @@ def main():
                     help="also gate the compiled-tick metric schema "
                          "(serving.tick_ms histogram + hit/fallback "
                          "counters) in the --prometheus dump")
+    ap.add_argument("--migration", action="store_true",
+                    help="also gate the KV-page-migration metric "
+                         "schema (serving.migration.* counters + "
+                         "migrate_ms histogram + per-role routed "
+                         "counter) in the --prometheus dump")
     args = ap.parse_args()
     if args.router and not args.prometheus:
         ap.error("--router needs --prometheus")
     if args.serving_tick and not args.prometheus:
         ap.error("--serving-tick needs --prometheus")
+    if args.migration and not args.prometheus:
+        ap.error("--migration needs --prometheus")
     if not args.prometheus and not args.snapshots \
             and not args.stall_dump and not args.sentinel_dump:
         ap.error("nothing to check: pass --prometheus, --snapshots, "
@@ -346,6 +396,12 @@ def main():
             if not tick_errors:
                 print("serving-tick exposition OK: tick_ms histogram "
                       "+ compiled_hits/fallbacks counters present")
+        if args.migration:
+            mig_errors = check_migration_exposition(series, typed)
+            failures += mig_errors
+            if not mig_errors:
+                print("migration exposition OK: full serving.migration"
+                      ".* schema + per-role routed counter present")
     if args.snapshots:
         n, errors = check_snapshots(args.snapshots)
         failures += errors

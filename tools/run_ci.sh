@@ -262,9 +262,10 @@ timeout -k 10 300 python benchmarks/serving_fleet_bench.py --smoke \
     --out /tmp/serving_fleet_ci.json
 python tools/check_bench_result.py /tmp/serving_fleet_ci.json
 
-echo "== serving fleet router telemetry (thread-mode fleet -> prometheus gate) =="
+echo "== serving fleet router + migration telemetry (thread-mode disagg fleet -> prometheus gate) =="
 python - <<'EOF'
 import threading
+import time
 import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import observability as obs
@@ -281,34 +282,57 @@ model = GPTForCausalLM(gpt_config(
     vocab_size=128, max_seq_len=64))
 rng = np.random.default_rng(0)
 master = TCPStore(is_master=True)
-rep = ReplicaServer("rep-ci", model, TCPStore("127.0.0.1", master.port),
-                    ServingConfig(num_slots=2, max_queue=8),
-                    ReplicaConfig(heartbeat_interval_s=0.2,
-                                  heartbeat_ttl_s=1.5))
+rcfg = ReplicaConfig(heartbeat_interval_s=0.2, heartbeat_ttl_s=1.5)
+rep_p = ReplicaServer("rep-p", model, TCPStore("127.0.0.1", master.port),
+                      ServingConfig(num_slots=2, max_queue=8,
+                                    role="prefill"), rcfg)
+rep_d = ReplicaServer("rep-d", model, TCPStore("127.0.0.1", master.port),
+                      ServingConfig(num_slots=2, max_queue=8,
+                                    role="decode"), rcfg)
 router = ServingRouter(TCPStore("127.0.0.1", master.port),
                        RouterConfig(heartbeat_ttl_s=1.5,
-                                    poll_interval_s=0.1)).start()
+                                    poll_interval_s=0.1,
+                                    disaggregation=True)).start()
+deadline = time.monotonic() + 60
+while len(router.ring.members) < 2:
+    assert time.monotonic() < deadline, router.replicas()
+    time.sleep(0.05)
 futs = [router.submit(rng.integers(0, 128, (5,)).astype("int32"),
                       max_new_tokens=4, session_id=i) for i in range(3)]
 outs = [f.result(timeout=300) for f in futs]
 assert all(o.output_ids.size == 4 for o in outs), outs
+assert all(o.decoded_by == "rep-d" for o in outs), \
+    [o.decoded_by for o in outs]
 snap = router.stats()
 assert snap["router_requests_routed"] == 3, snap
-assert snap["router_replicas_alive"] == 1, snap
+assert snap["router_replicas_alive"] == 2, snap
+assert snap["migrations"] == 3, snap
+assert snap["migration_pages_sent"] >= 3, snap
+assert snap["migration_resumed_requests"] == 3, snap
 with open("/tmp/pt_fleet_ci.prom", "w") as f:
     f.write(obs.render_prometheus())
 router.close()
-rep.close()
+rep_p.close()
+rep_d.close()
 master.close()
-import time
 time.sleep(1.0)                    # rpc handler threads exit on close
 leaked = [t.name for t in threading.enumerate()
           if t.ident not in before and t.is_alive()]
 assert not leaked, f"leaked threads: {leaked}"
-print("fleet telemetry smoke OK: 3 routed, prometheus dumped, "
-      "no leaked threads")
+print("fleet telemetry smoke OK: 3 routed, 3 migrated to rep-d, "
+      "prometheus dumped, no leaked threads")
 EOF
-python tools/check_telemetry.py --prometheus /tmp/pt_fleet_ci.prom --router
+python tools/check_telemetry.py --prometheus /tmp/pt_fleet_ci.prom \
+    --router --migration
+
+echo "== prefill/decode disaggregation bench (smoke: TTFT p99 + decode p50 vs symmetric at equal chips, zero-loss role flip) =="
+# bounded: three 2-replica fleets (symmetric, disagg, flip), ~3 min
+# wall on this box.  The bench asserts improvement on both latency
+# axes, bit-equal migrated outputs and a lossless mid-load role flip;
+# the gate re-checks the recorded JSON.
+timeout -k 10 600 python benchmarks/serving_fleet_bench.py \
+    --workload disagg --smoke --out /tmp/serving_disagg_ci.json
+python tools/check_bench_result.py /tmp/serving_disagg_ci.json
 
 echo "== TPU run-log audit =="
 python tools/validate_tpu_runs.py
