@@ -50,3 +50,8 @@ Swish = _al("swish")
 ThresholdedReLU = _al("thresholded_relu")
 del _al
 from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401,E402
+from . import lora  # noqa: F401,E402
+from .lora import (  # noqa: F401,E402
+    LoRALinear, attach_lora, mark_only_lora_trainable, lora_layers,
+    adapter_spec, save_adapter, load_adapter, load_adapter_state,
+)

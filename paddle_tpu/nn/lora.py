@@ -1,0 +1,267 @@
+"""LoRA: low-rank adaptation of Linear projections (training side).
+
+Reference capability: PEFT-style LoRA as layered over Paddle/HF stacks —
+``W' = W + A @ B * (alpha / rank)`` with the base weight frozen and only the
+rank-r factors trained.  TPU-native realization: ``LoRALinear`` ADOPTS the
+wrapped Linear's weight/bias Parameter objects (same leaves, same qualified
+names), so the existing optimizer, AMP, compiled train step, and checkpoint
+stacks see an ordinary model — no special casing anywhere.  The unmerged
+forward computes the effective weight ``W + matmul(A, B) * scaling`` and runs
+one ``F.linear`` over it; ``merge()`` bakes the IDENTICAL expression into the
+weight buffer, which is what makes merged and unmerged forwards bitwise equal
+(same ops, same order, same arrays).  ``unmerge()`` restores an exact stashed
+copy of the pre-merge weight — a float subtract would not round-trip.
+
+Adapter-only artifacts (``save_adapter``/``load_adapter``) persist just the
+A/B factors plus a manifest with per-file crc32, riding the same
+``write_manifest``/``verify_checkpoint`` protocol as ``CheckpointManager``,
+so a 124M-parameter fine-tune ships as a few hundred KB.  The serving-side
+``AdapterPool`` (serving/adapters.py) consumes the same artifact via
+``load_adapter_state``.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .layer import Layer
+from .layers_common import Linear
+from .initializer import Normal, Constant
+from . import functional as F
+from ..tensor_ops import linalg
+
+
+ADAPTER_FILE = "adapter.npz"
+
+# Projection attribute names wrapped by default: GPT (qkv_proj/out_proj/
+# fc_in/fc_out) and Llama (q/k/v/o_proj, gate/up/down_proj).
+DEFAULT_TARGETS = (
+    "qkv_proj", "out_proj", "fc_in", "fc_out",
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj",
+)
+
+
+class LoRALinear(Layer):
+    """A Linear with a trainable low-rank residual ``A @ B * scaling``.
+
+    Built FROM an existing ``nn.Linear`` whose weight/bias Parameters it
+    adopts (the state-dict names under the wrapped attribute are unchanged).
+    ``lora_A`` is Normal(0, 0.02)-initialized, ``lora_B`` zeros — the
+    adapter starts as an exact identity.
+    """
+
+    def __init__(self, base, rank=8, alpha=None, name=None):
+        super().__init__()
+        if not isinstance(base, Linear):
+            raise TypeError(
+                f"LoRALinear wraps nn.Linear, got {type(base).__name__}")
+        rank = int(rank)
+        if rank < 1:
+            raise ValueError(f"LoRA rank must be >= 1, got {rank}")
+        self.in_features = int(base.weight.shape[0])
+        self.out_features = int(base.weight.shape[1])
+        self.rank = rank
+        self.alpha = float(alpha) if alpha is not None else float(rank)
+        self.scaling = self.alpha / float(rank)
+        self.weight = base.weight
+        self.bias = base.bias
+        dtype = str(base.weight.dtype)
+        self.lora_A = self.create_parameter(
+            (self.in_features, rank), dtype=dtype,
+            default_initializer=Normal(0.0, 0.02))
+        self.lora_B = self.create_parameter(
+            (rank, self.out_features), dtype=dtype,
+            default_initializer=Constant(0.0))
+        self._merged = False
+        self._weight_stash = None
+
+    @property
+    def merged(self):
+        return self._merged
+
+    def _effective_weight(self):
+        return self.weight + linalg.matmul(self.lora_A, self.lora_B) \
+            * self.scaling
+
+    def forward(self, x):
+        if self._merged:
+            return F.linear(x, self.weight, self.bias)
+        return F.linear(x, self._effective_weight(), self.bias)
+
+    def merge(self):
+        """Bake ``A @ B * scaling`` into the weight buffer.  The merged
+        forward is bitwise equal to the unmerged one because it reuses the
+        effective weight computed by the identical op sequence."""
+        if self._merged:
+            return
+        stash = np.asarray(self.weight.numpy())
+        self.weight.set_value(self._effective_weight())
+        self._weight_stash = stash
+        self._merged = True
+
+    def unmerge(self):
+        """Restore the exact pre-merge weight from the stash."""
+        if not self._merged:
+            return
+        self.weight.set_value(self._weight_stash)
+        self._weight_stash = None
+        self._merged = False
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, rank={self.rank}, "
+                f"alpha={self.alpha}, merged={self._merged}")
+
+
+def attach_lora(model, rank=8, alpha=None, targets=None):
+    """Replace target Linear attrs of ``model`` with ``LoRALinear`` wrappers
+    in place.  Returns the qualified names of the wrapped projections.
+    Idempotent per layer (already-wrapped attrs are skipped)."""
+    targets = tuple(targets) if targets is not None else DEFAULT_TARGETS
+    wrapped = []
+    parents = [("", model)] + list(model.named_sublayers())
+    for pname, parent in parents:
+        if isinstance(parent, LoRALinear):
+            continue
+        for attr, child in list(parent._sub_layers.items()):
+            if attr not in targets or not isinstance(child, Linear):
+                continue
+            setattr(parent, attr, LoRALinear(child, rank=rank, alpha=alpha))
+            wrapped.append(f"{pname}.{attr}" if pname else attr)
+    if not wrapped:
+        raise ValueError(
+            f"attach_lora found no Linear sublayers matching targets "
+            f"{targets}")
+    return wrapped
+
+
+def mark_only_lora_trainable(model):
+    """Freeze every parameter except ``lora_A``/``lora_B`` factors.  The
+    optimizer/compiled-train-step stacks then skip the frozen leaves via the
+    ordinary ``stop_gradient``/``trainable`` contract."""
+    n_lora = 0
+    for name, p in model.named_parameters():
+        leaf = name.rsplit(".", 1)[-1]
+        train = leaf in ("lora_A", "lora_B")
+        p.trainable = train
+        p.stop_gradient = not train
+        n_lora += int(train)
+    if not n_lora:
+        raise ValueError(
+            "mark_only_lora_trainable: model has no LoRA parameters "
+            "(call attach_lora first)")
+    return n_lora
+
+
+def lora_layers(model):
+    """Qualified name -> LoRALinear for every wrapped projection."""
+    return {name: layer for name, layer in model.named_sublayers()
+            if isinstance(layer, LoRALinear)}
+
+
+def adapter_spec(model):
+    """In-memory adapter spec: {layer_name: {"A", "B", "rank", "alpha"}} —
+    the same structure ``load_adapter_state`` returns, accepted directly by
+    the serving ``AdapterPool`` registry (no disk round-trip needed)."""
+    layers = lora_layers(model)
+    if not layers:
+        raise ValueError("adapter_spec: model has no LoRA layers")
+    spec = {}
+    for name, lyr in layers.items():
+        if lyr.merged:
+            raise ValueError(
+                f"adapter_spec: layer {name} is merged — unmerge() first")
+        spec[name] = {
+            "A": np.asarray(lyr.lora_A.numpy()),
+            "B": np.asarray(lyr.lora_B.numpy()),
+            "rank": lyr.rank,
+            "alpha": lyr.alpha,
+        }
+    return spec
+
+
+def save_adapter(model, dirpath, meta=None):
+    """Persist only the adapter factors: one npz + a crc32 manifest
+    (``CheckpointManager`` protocol — ``verify_checkpoint(dirpath)`` works
+    on the artifact).  Returns the npz path."""
+    from ..framework.checkpoint_manager import write_manifest
+
+    spec = adapter_spec(model)
+    os.makedirs(dirpath, exist_ok=True)
+    arrays, layers_meta = {}, {}
+    for name, st in spec.items():
+        arrays[name + ".lora_A"] = st["A"]
+        arrays[name + ".lora_B"] = st["B"]
+        layers_meta[name] = {
+            "rank": st["rank"], "alpha": st["alpha"],
+            "in_features": int(st["A"].shape[0]),
+            "out_features": int(st["B"].shape[1]),
+        }
+    path = os.path.join(dirpath, ADAPTER_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    full_meta = {"format": "lora_adapter", "layers": layers_meta}
+    if meta:
+        full_meta.update(meta)
+    write_manifest(dirpath, meta=full_meta)
+    return path
+
+
+def load_adapter_state(dirpath):
+    """Read + crc-verify an adapter artifact.  Returns
+    {layer_name: {"A", "B", "rank", "alpha"}} (the ``adapter_spec``
+    structure)."""
+    from ..framework.checkpoint_manager import read_manifest, \
+        verify_checkpoint
+
+    man = read_manifest(dirpath)
+    if man is None:
+        raise FileNotFoundError(
+            f"no adapter manifest under {dirpath!r} (expected "
+            f"{ADAPTER_FILE} + manifest.json written by save_adapter)")
+    if not verify_checkpoint(dirpath):
+        raise ValueError(
+            f"adapter artifact at {dirpath!r} failed crc32 verification")
+    meta = man.get("meta") or {}
+    layers_meta = meta.get("layers") or {}
+    spec = {}
+    with np.load(os.path.join(dirpath, ADAPTER_FILE)) as z:
+        for name, lm in layers_meta.items():
+            spec[name] = {
+                "A": np.asarray(z[name + ".lora_A"]),
+                "B": np.asarray(z[name + ".lora_B"]),
+                "rank": int(lm["rank"]),
+                "alpha": float(lm["alpha"]),
+            }
+    if not spec:
+        raise ValueError(f"adapter manifest at {dirpath!r} lists no layers")
+    return spec
+
+
+def load_adapter(model, dirpath):
+    """Load adapter factors into an attach_lora'd model.  Ranks must match
+    the attached wrappers; alpha/scaling are adopted from the artifact."""
+    spec = load_adapter_state(dirpath)
+    layers = lora_layers(model)
+    missing = sorted(set(spec) - set(layers))
+    if missing:
+        raise ValueError(
+            f"load_adapter: model has no LoRA layers named {missing} "
+            f"(attached: {sorted(layers)})")
+    for name, st in spec.items():
+        lyr = layers[name]
+        if st["rank"] != lyr.rank:
+            raise ValueError(
+                f"load_adapter: layer {name} rank mismatch — artifact has "
+                f"rank {st['rank']}, model wrapper has rank {lyr.rank}")
+        lyr.lora_A.set_value(st["A"])
+        lyr.lora_B.set_value(st["B"])
+        lyr.alpha = st["alpha"]
+        lyr.scaling = st["alpha"] / float(st["rank"])
+    return sorted(spec)

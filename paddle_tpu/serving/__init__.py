@@ -14,10 +14,12 @@ session-affine router that loses zero requests when a replica dies
 """
 from __future__ import annotations
 
+from .adapters import AdapterPool  # noqa: F401
 from .api import (  # noqa: F401
-    DeadlineExceededError, EngineShutdownError, NoReplicaError,
-    PageMigrationError, QueueFullError, RequestOutput, SamplingParams,
-    SchedulerStallError, ServingConfig, ServingError,
+    AdapterConfigError, DeadlineExceededError, EngineShutdownError,
+    NoReplicaError, PageMigrationError, QueueFullError, RequestOutput,
+    SamplingParams, SchedulerStallError, ServingConfig, ServingError,
+    UnknownAdapterError,
 )
 from .compiled_tick import (  # noqa: F401
     CompiledServingTick, TickFallbackWarning,
@@ -37,6 +39,7 @@ __all__ = [
     "SlotKVCache", "PagedKVCache", "PrefixTree", "ServingError",
     "QueueFullError", "DeadlineExceededError", "EngineShutdownError",
     "SchedulerStallError", "NoReplicaError", "PageMigrationError",
+    "AdapterConfigError", "UnknownAdapterError", "AdapterPool",
     "serving_stats", "reset_serving_stats", "reset_router_stats",
     "ServingRouter", "RouterConfig", "HashRing", "ServingFleet",
     "ReplicaServer", "ReplicaConfig",

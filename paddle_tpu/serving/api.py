@@ -52,6 +52,23 @@ class SchedulerStallError(ServingError):
     (bounded by ``max_scheduler_restarts``)."""
 
 
+class AdapterConfigError(ServingError):
+    """An adapter registration is infeasible for this engine's pool at
+    construction time — rank over ``adapter_rank_pool``, factor shapes
+    that don't match the base model's projection widths/vocab, or a
+    projection name the base model does not have.  Raised from
+    ``Engine(...)``/``AdapterPool.register`` so the misconfiguration
+    surfaces as a typed error naming the offending layer, never as a
+    shape error mid-decode."""
+
+
+class UnknownAdapterError(ServingError):
+    """A request named an ``adapter_id`` absent from the engine's
+    adapter registry.  Delivered by failing THAT request's future (the
+    scheduler never sees the request); the message names the registered
+    ids so the client can correct itself."""
+
+
 class PageMigrationError(ServingError):
     """A KV-page migration payload cannot be adopted by the target
     replica's pool — incompatible page size / dtype / layer geometry, or
@@ -185,6 +202,28 @@ class ServingConfig:
                              replica of any role still serves whatever
                              the router sends it (docs/SERVING.md
                              "Prefill/decode disaggregation")
+    max_adapters             concurrent hot LoRA adapters multiplexed
+                             over the base model (docs/SERVING.md
+                             "Multi-tenant serving").  0 (default) = no
+                             adapter pool — the engine is byte-identical
+                             to the pre-LoRA engine.  >0 preallocates
+                             per-projection A/B stacks of
+                             max_adapters+1 slots (slot 0 = the exact
+                             identity base requests ride) and enables
+                             submit(..., adapter_id=...); requires
+                             kv_layout="paged"
+    adapter_rank_pool        fixed rank budget every pool slot is padded
+                             to; registering an adapter with rank >
+                             adapter_rank_pool raises AdapterConfigError
+                             at construction
+    adapters                 adapter registry {adapter_id: source},
+                             source a save_adapter() artifact dir or an
+                             in-memory nn.lora.adapter_spec dict.
+                             Validated at Engine construction (typed
+                             AdapterConfigError naming the layer, never
+                             a shape error mid-decode); more can be
+                             registered later via
+                             Engine.register_adapter
     """
 
     num_slots: int = 4
@@ -206,6 +245,9 @@ class ServingConfig:
     draft_model: object | None = None
     speculation_k: int = 0
     role: str = "mixed"
+    max_adapters: int = 0
+    adapter_rank_pool: int = 8
+    adapters: dict | None = None
 
     def validate(self):
         if self.role not in ("mixed", "prefill", "decode"):
@@ -261,6 +303,20 @@ class ServingConfig:
                 raise ValueError(
                     "speculative decoding requires kv_layout='paged' "
                     "(accept-mask rollback is a page-table/offset move)")
+        if self.max_adapters < 0:
+            raise ValueError(f"max_adapters must be >= 0, got "
+                             f"{self.max_adapters}")
+        if self.adapter_rank_pool < 1:
+            raise ValueError(f"adapter_rank_pool must be >= 1, got "
+                             f"{self.adapter_rank_pool}")
+        if self.max_adapters > 0 and self.kv_layout != "paged":
+            raise ValueError(
+                "max_adapters > 0 (multi-tenant LoRA serving) requires "
+                "kv_layout='paged'")
+        if self.adapters and self.max_adapters == 0:
+            raise ValueError(
+                "ServingConfig.adapters given but max_adapters == 0 — "
+                "set max_adapters to the concurrent-adapter budget")
         return self
 
 

@@ -257,7 +257,16 @@ class CompiledServingTick:
                 if isinstance(v, Tensor):
                     exclude.add(id(v))
         with TRACE_LOCK:
-            disc = run_discovery(lambda: eng.model(tok, caches=views))
+            # discovery runs under the SAME adapter activation as the
+            # live tick, so the pool's A/B stacks, scales, and per-slot
+            # index vector are read through op dispatch and join the
+            # re-gathered captures — hot-loads and admission re-points
+            # flow into the compiled program with no retrace, and the
+            # identity slot 0 keeps base-only batches on this one program
+            def _fwd():
+                with eng._lora_ctx():
+                    return eng.model(tok, caches=views)
+            disc = run_discovery(_fwd)
         if disc.uses_rng:
             raise TraceEscape(
                 "model forward draws framework RNG (dropout in eval?) — "
@@ -300,7 +309,8 @@ class CompiledServingTick:
                         view["v_scale"] = Tensor(pools[i + 1])
                         i += 2
                     views.append(view)
-                logits_t = eng.model(Tensor(tok_in), caches=views)
+                with eng._lora_ctx():
+                    logits_t = eng.model(Tensor(tok_in), caches=views)
                 logits = logits_t._data_[:, -1, :]
                 new_pools = []
                 for view in views:

@@ -22,6 +22,7 @@ to their own causal horizon.
 """
 from __future__ import annotations
 
+import contextlib
 import itertools
 import threading
 import time
@@ -42,7 +43,8 @@ class _Request:
                  "eos_token_id", "deadline", "future", "submit_t",
                  "ttft_ms", "tokens", "seen", "last_token", "slot",
                  "prefill_pos", "shared_len", "prefix_nodes",
-                 "draft_prefill_pos", "first_tok", "handoff", "resume")
+                 "draft_prefill_pos", "first_tok", "handoff", "resume",
+                 "adapter_id", "adapter_slot")
 
     def __init__(self, rid, prompt, max_new_tokens, sampling,
                  eos_token_id, deadline):
@@ -66,6 +68,8 @@ class _Request:
         self.first_tok = None       # sampled first token awaiting draft
         self.handoff = None         # decode-replica target (disagg)
         self.resume = None          # migrated-page payload + prior state
+        self.adapter_id = None      # LoRA adapter this request decodes
+        self.adapter_slot = 0       # its pool slot (0 = base identity)
 
 
 class Engine:
@@ -166,6 +170,21 @@ class Engine:
         self._migration_results: deque = deque()
         self._migrate_failed: set[int] = set()
         self._drain_migrate = False
+        # multi-tenant LoRA (serving/adapters.py): preallocated A/B
+        # stacks per wrapped projection + per-slot int32 adapter index.
+        # Built (and the registry validated — typed AdapterConfigError)
+        # at construction; None when max_adapters == 0, in which case
+        # every model call below is byte-identical to the pre-LoRA
+        # engine (the projection patches are inert without an active
+        # pool context).
+        self.adapter_pool = None
+        if self.scfg.max_adapters > 0:
+            from .adapters import AdapterPool
+            self.adapter_pool = AdapterPool(
+                model, self.scfg.max_adapters,
+                self.scfg.adapter_rank_pool, self.scfg.num_slots)
+            for aid, source in (self.scfg.adapters or {}).items():
+                self.adapter_pool.register(aid, source)
 
     # ---------------- lifecycle ----------------
     def start(self):
@@ -177,6 +196,7 @@ class Engine:
             stats.reset_serving_stats()
             stats.declare_tick_stats()
             stats.declare_migration_stats()
+            stats.declare_adapter_stats()
             self.cache = self._new_cache()
             self._tick = self._make_tick()
             self._max_active = 0
@@ -346,7 +366,8 @@ class Engine:
 
     # ---------------- client API ----------------
     def submit(self, prompt_ids, max_new_tokens=None, sampling=None,
-               eos_token_id=None, deadline_s=None, handoff=None):
+               eos_token_id=None, deadline_s=None, handoff=None,
+               adapter_id=None):
         """Enqueue one request; returns a `Future[RequestOutput]`.
         Raises `QueueFullError` when the bounded queue is at capacity
         and `ValueError` for prompts the slot cache cannot hold.
@@ -357,7 +378,12 @@ class Engine:
         streamed to that replica once its prompt is hot and decoding
         resumes there; on any migration failure the request falls back
         to decoding locally — handoff can slow a request, never lose
-        it."""
+        it.
+
+        ``adapter_id``: decode under this registered LoRA adapter
+        (multi-tenant serving, ``max_adapters > 0``).  An id absent
+        from the registry fails THIS request's returned future with
+        ``UnknownAdapterError`` — the scheduler never sees it."""
         prompt = np.asarray(
             prompt_ids._data_ if hasattr(prompt_ids, "_data_")
             else prompt_ids).astype(np.int32).reshape(-1)
@@ -387,10 +413,25 @@ class Engine:
                     f"request needs {need} KV pages (prompt "
                     f"{prompt.size} + max_new {max_new}) but the pool "
                     f"holds {pool}; raise ServingConfig.kv_pool_pages")
+        if adapter_id is not None:
+            known = self.adapter_pool.known_ids() \
+                if self.adapter_pool is not None else []
+            if str(adapter_id) not in known:
+                from .api import UnknownAdapterError
+                msg = (f"adapter_id {adapter_id!r} is not in this "
+                       f"engine's registry (registered: {known})")
+                if self.adapter_pool is None:
+                    msg += ("; the engine has no adapter pool — set "
+                            "ServingConfig.max_adapters > 0")
+                fut = Future()
+                fut.set_exception(UnknownAdapterError(msg))
+                return fut
         deadline = (time.monotonic() + deadline_s) \
             if deadline_s is not None else None
         req = _Request(next(self._ids), prompt, max_new, sampling,
                        eos_token_id, deadline)
+        if adapter_id is not None:
+            req.adapter_id = str(adapter_id)
         if handoff is not None and self._paged:
             req.handoff = handoff
         with self._work:
@@ -415,11 +456,12 @@ class Engine:
         return req.future
 
     def generate(self, prompt_ids, max_new_tokens=None, sampling=None,
-                 eos_token_id=None, deadline_s=None, timeout=None):
+                 eos_token_id=None, deadline_s=None, timeout=None,
+                 adapter_id=None):
         """Sync client: submit + wait.  Returns a `RequestOutput`."""
         fut = self.submit(prompt_ids, max_new_tokens=max_new_tokens,
                           sampling=sampling, eos_token_id=eos_token_id,
-                          deadline_s=deadline_s)
+                          deadline_s=deadline_s, adapter_id=adapter_id)
         return fut.result(timeout or self.scfg.request_timeout_s)
 
     def submit_resume(self, prompt_ids, prior_tokens, pages,
@@ -501,6 +543,36 @@ class Engine:
 
     def stats(self):
         return stats.serving_stats()
+
+    # ---------------- multi-tenant LoRA ----------------
+    def register_adapter(self, adapter_id, source):
+        """Validate + register an adapter on a live engine (the
+        ``ServingConfig.adapters`` registry path, but hot).  ``source``
+        is a ``save_adapter`` artifact dir or an ``adapter_spec`` dict.
+        Raises ``AdapterConfigError`` for infeasible configs."""
+        if self.adapter_pool is None:
+            from .api import AdapterConfigError
+            raise AdapterConfigError(
+                "engine has no adapter pool — construct it with "
+                "ServingConfig(max_adapters=...) > 0")
+        with self._lock:
+            return self.adapter_pool.register(adapter_id, source)
+
+    def loaded_adapters(self):
+        """Adapter ids currently hot in pool slots — the set gossip
+        advertises for router affinity."""
+        if self.adapter_pool is None:
+            return []
+        with self._lock:
+            return self.adapter_pool.loaded_ids()
+
+    def _lora_ctx(self, idx=None):
+        """Activation scope for TARGET-model calls: patched projections
+        apply the gathered low-rank update.  A no-op context when the
+        engine has no adapter pool."""
+        if self.adapter_pool is None:
+            return contextlib.nullcontext()
+        return self.adapter_pool.activate(idx)
 
     # ---------------- scheduler ----------------
     def _loop(self):
@@ -704,6 +776,15 @@ class Engine:
         # real token before rollback, so the reservation covers it
         total = min(req.prompt.size + req.max_new_tokens, self.max_len) \
             + self._spec_k
+        if req.adapter_id is not None:
+            # pin (hot-loading first if cold) the adapter's pool slot
+            # for this request's lifetime.  None = every slot is pinned
+            # by in-flight requests: the request stays queued — LRU
+            # eviction never touches a slot with live traffic.
+            pool_slot = self.adapter_pool.acquire(req.adapter_id)
+            if pool_slot is None:
+                return None
+            req.adapter_slot = pool_slot
         if req.resume is not None:
             # migrated request: adopt its transferred pages instead of
             # reserving for a prefill it will never run.  Adopted pages
@@ -731,7 +812,12 @@ class Engine:
             return slot
         nodes, pages = [], []
         if self.prefix_tree is not None:
-            nodes, pages = self.prefix_tree.match(req.prompt)
+            # tree entries are scoped by adapter id: a prompt prefilled
+            # under one adapter produces DIFFERENT K/V than under
+            # another (or under the base), so adapters never share
+            # cached prompt pages
+            nodes, pages = self.prefix_tree.match(req.prompt,
+                                                  scope=req.adapter_id)
         need = -(-total // psz) - len(pages)
         short = need - self.cache.available_pages
         if short > 0 and self.prefix_tree is not None:
@@ -742,6 +828,8 @@ class Engine:
         if slot is None:
             if nodes:
                 self.prefix_tree.release(nodes)
+            if req.adapter_id is not None:
+                self.adapter_pool.release(req.adapter_id)
             return None
         if self._spec:
             # mirror the slot in the draft cache: same free-slot stack
@@ -770,6 +858,14 @@ class Engine:
         req.slot = slot
         req.prefill_pos = req.shared_len
         req.first_tok = None
+        if self.adapter_pool is not None:
+            # the slot's row of the persistent adapter-index vector now
+            # points at this request's pool slot (0 for base requests);
+            # the compiled tick re-gathers the vector every iteration,
+            # so the update flows into the SAME compiled program
+            self.adapter_pool.set_row(slot, req.adapter_slot)
+            if req.adapter_id is not None:
+                stats.adapter_observe(req.adapter_id)
         self.cache.set_offset(slot, req.shared_len)
         if self._spec:
             req.draft_prefill_pos = 0
@@ -831,7 +927,8 @@ class Engine:
                 stats.incr("prefill_steps")
                 if self.prefix_tree is not None:
                     self.prefix_tree.insert(req.prompt, self.cache,
-                                            req.slot, req.prefix_nodes)
+                                            req.slot, req.prefix_nodes,
+                                            scope=req.adapter_id)
         if self._spec:
             # the draft model's own chunked prefill, same cadence: its
             # cache must hold the whole prompt before the request can
@@ -892,11 +989,21 @@ class Engine:
             cache.ensure_capacity(req.slot, off + new_real - 1)
             starts.append(start)
         from ..framework.capture import TRACE_LOCK
+        # chunked prefill batches by CALL ROW, not scheduler slot: the
+        # adapter index for this call is row-ordered (scratch rows ride
+        # the identity slot 0).  Draft-model calls are never adapted.
+        lora = contextlib.nullcontext()
+        if self.adapter_pool is not None and model is self.model:
+            rows = np.zeros(cache.num_slots, np.int32)
+            for row, req in enumerate(reqs):
+                rows[row] = req.adapter_slot
+            lora = self.adapter_pool.activate(
+                self.adapter_pool.row_tensor(rows))
         t0 = time.monotonic()
         with RecordEvent("serving::prefill",
                          args={"request_ids": [r.id for r in reqs]}):
             views = cache.prefill_view([r.slot for r in reqs], starts)
-            with TRACE_LOCK:    # a shared model may be mid-capture
+            with TRACE_LOCK, lora:  # a shared model may be mid-capture
                 logits = model(Tensor(tokens), caches=views)
             cache.absorb_view(views)
         dt_ms = (time.monotonic() - t0) * 1e3
@@ -912,6 +1019,11 @@ class Engine:
         finish on this very token (migrating a finished request is pure
         waste) nor has it already blown its deadline."""
         if req.handoff is None or self.migrator is None:
+            return False
+        if req.adapter_id is not None:
+            # adapter requests decode where their adapter is pinned:
+            # the resume path carries no adapter state, and the target
+            # replica may not have the adapter hot — decode locally
             return False
         if req.max_new_tokens <= 1:
             return False
@@ -1107,6 +1219,13 @@ class Engine:
         machinery (`_known_token` teacher forcing) absorbs the lag."""
         if not self._spec:
             return False
+        if self.adapter_pool is not None and any(
+                r.adapter_id is not None for r in self._active.values()):
+            # the draft model has no adapter pool: its proposals would
+            # come from the BASE distribution while the target verifies
+            # under the adapter — acceptance collapses.  Adapter
+            # iterations take the plain (or compiled-tick) step.
+            return False
         K = self._spec_k
         for req in self._active.values():
             sp = req.sampling
@@ -1264,7 +1383,7 @@ class Engine:
             for slot, req in self._active.items():
                 tok_in[slot, 0] = req.last_token
             from ..framework.capture import TRACE_LOCK
-            with TRACE_LOCK:    # a shared model may be mid-capture
+            with TRACE_LOCK, self._lora_ctx():
                 logits = self.model(Tensor(tok_in),
                                     caches=self.cache.layer_caches())
             self.cache.advance(self._active.keys())
@@ -1454,6 +1573,12 @@ class Engine:
             if req.prefix_nodes and self.prefix_tree is not None:
                 self.prefix_tree.release(req.prefix_nodes)
                 req.prefix_nodes = []
+        if self.adapter_pool is not None:
+            self.adapter_pool.clear_row(req.slot)
+            if req.adapter_id is not None:
+                self.adapter_pool.release(req.adapter_id)
+                req.adapter_id = None   # released exactly once
+                req.adapter_slot = 0
         req.slot = None
 
     def _fail_all(self, exc):

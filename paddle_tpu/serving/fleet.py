@@ -111,7 +111,8 @@ _REPLICAS: dict[str, "ReplicaServer"] = {}
 
 
 def _remote_submit(replica_name, rid, prompt, max_new_tokens, sampling,
-                   eos_token_id, deadline_s, handoff=None):
+                   eos_token_id, deadline_s, handoff=None,
+                   adapter_id=None):
     """The request plane's rpc target: runs inside the replica process
     (one rpc handler thread per router connection, so blocking on the
     engine future is fine)."""
@@ -121,7 +122,8 @@ def _remote_submit(replica_name, rid, prompt, max_new_tokens, sampling,
             f"replica {replica_name!r} is not hosted in this process "
             f"(hosted: {sorted(_REPLICAS)})")
     return rep.handle_submit(rid, prompt, max_new_tokens, sampling,
-                             eos_token_id, deadline_s, handoff=handoff)
+                             eos_token_id, deadline_s, handoff=handoff,
+                             adapter_id=adapter_id)
 
 
 def _remote_adopt(replica_name, rid, meta, header, *blobs):
@@ -239,6 +241,7 @@ class ReplicaServer:
                 "gen": self.gen, "pid": os.getpid(),
                 "tp": self.cfg.tensor_parallel_degree,
                 "role": self.engine.scfg.role,
+                "adapters": self.engine.loaded_adapters(),
                 "load": self._load(), "load_ts": time.time()}
         with self._store_lock:
             self.store.set(INFO_PREFIX + self.name, json.dumps(info))
@@ -266,7 +269,8 @@ class ReplicaServer:
 
     # ---------------- request plane ----------------
     def handle_submit(self, rid, prompt, max_new_tokens, sampling,
-                      eos_token_id, deadline_s, handoff=None):
+                      eos_token_id, deadline_s, handoff=None,
+                      adapter_id=None):
         """Idempotent submit: a rid seen before re-awaits the SAME
         engine future (a router resubmission after an ambiguous timeout
         can never make this replica decode — or deliver — twice).
@@ -287,7 +291,7 @@ class ReplicaServer:
                     prompt, max_new_tokens=max_new_tokens,
                     sampling=SamplingParams(**(sampling or {})),
                     eos_token_id=eos_token_id, deadline_s=deadline_s,
-                    handoff=handoff)
+                    handoff=handoff, adapter_id=adapter_id)
                 self._dedup[rid] = fut
                 while len(self._dedup) > self.cfg.dedup_results:
                     self._dedup.popitem(last=False)

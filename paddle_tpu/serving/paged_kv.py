@@ -445,23 +445,38 @@ class PrefixTree:
     prefix) until `evict()` reclaims them LRU under pool pressure.
     `match` never returns the whole prompt: at least the final token is
     always recomputed so the engine has last-token logits to sample
-    from."""
+    from.
+
+    Entries are keyed by ``scope`` (the request's LoRA adapter id; None
+    = base model): the SAME prompt prefilled under different adapters
+    produces different K/V, so each scope owns a private root and
+    adapters never share cached prompt pages.  Eviction and accounting
+    walk every scope's root."""
 
     def __init__(self, page_size):
         self.page_size = int(page_size)
         self.root = _PrefixNode(None, None, None)
+        # scope -> root; the base scope aliases self.root so existing
+        # single-tenant callers/tests see the historical structure
+        self._roots = {None: self.root}
         self._ticks = itertools.count(1)
+
+    def _scope_root(self, scope):
+        root = self._roots.get(scope)
+        if root is None:
+            root = self._roots[scope] = _PrefixNode(None, None, None)
+        return root
 
     def _page_key(self, prompt, i):
         p = self.page_size
         return tuple(np.asarray(prompt[i * p:(i + 1) * p]).tolist())
 
-    def match(self, prompt):
-        """Longest cached page-aligned prefix of `prompt`, capped at
-        ``(len-1)//page_size`` pages.  Acquires a reference on every
-        matched node; returns (nodes, page_ids)."""
+    def match(self, prompt, scope=None):
+        """Longest cached page-aligned prefix of `prompt` within
+        ``scope``, capped at ``(len-1)//page_size`` pages.  Acquires a
+        reference on every matched node; returns (nodes, page_ids)."""
         limit = (len(prompt) - 1) // self.page_size
-        node, nodes, pages = self.root, [], []
+        node, nodes, pages = self._scope_root(scope), [], []
         for i in range(limit):
             child = node.children.get(self._page_key(prompt, i))
             if child is None:
@@ -473,7 +488,7 @@ class PrefixTree:
             node = child
         return nodes, pages
 
-    def insert(self, prompt, cache, slot, held_nodes):
+    def insert(self, prompt, cache, slot, held_nodes, scope=None):
         """Register the prompt's fully-covered pages after its prefill
         completed, transferring ownership of the slot's corresponding
         private pages to the tree (refcount 1 for the inserting
@@ -484,7 +499,7 @@ class PrefixTree:
         many were inserted."""
         full = len(prompt) // self.page_size
         held = set(id(n) for n in held_nodes)
-        node, inserted = self.root, 0
+        node, inserted = self._scope_root(scope), 0
         for i in range(full):
             key = self._page_key(prompt, i)
             child = node.children.get(key)
@@ -514,7 +529,8 @@ class PrefixTree:
         freed = 0
         while freed < n_pages:
             victim, best = None, None
-            stack = list(self.root.children.values())
+            stack = [n for root in self._roots.values()
+                     for n in root.children.values()]
             while stack:
                 node = stack.pop()
                 if node.children:
@@ -530,7 +546,8 @@ class PrefixTree:
 
     def cached_pages(self):
         """Total pages the tree currently owns (any refcount)."""
-        count, stack = 0, list(self.root.children.values())
+        count, stack = 0, [n for root in self._roots.values()
+                           for n in root.children.values()]
         while stack:
             node = stack.pop()
             count += 1

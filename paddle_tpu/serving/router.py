@@ -193,7 +193,7 @@ class HashRing:
 
 class _ReplicaView:
     __slots__ = ("name", "ip", "port", "state", "gen", "load",
-                 "load_ts", "tp", "role")
+                 "load_ts", "tp", "role", "adapters")
 
     def __init__(self, info):
         self.name = info["name"]
@@ -205,15 +205,16 @@ class _ReplicaView:
         self.load_ts = float(info.get("load_ts", 0.0))
         self.tp = int(info.get("tp", 1))
         self.role = info.get("role", "mixed")
+        self.adapters = frozenset(info.get("adapters") or ())
 
 
 class _RoutedRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "sampling",
                  "eos_token_id", "deadline", "session_key", "future",
-                 "submit_t", "attempts", "resubmits")
+                 "submit_t", "attempts", "resubmits", "adapter_id")
 
     def __init__(self, rid, prompt, max_new_tokens, sampling,
-                 eos_token_id, deadline, session_key):
+                 eos_token_id, deadline, session_key, adapter_id=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -221,6 +222,7 @@ class _RoutedRequest:
         self.eos_token_id = eos_token_id
         self.deadline = deadline            # absolute monotonic or None
         self.session_key = session_key
+        self.adapter_id = adapter_id        # multi-tenant LoRA affinity
         self.future = Future()
         self.submit_t = time.monotonic()
         self.attempts = 0                   # dispatch rounds
@@ -372,7 +374,8 @@ class ServingRouter:
 
     # ---------------- client API ----------------
     def submit(self, prompt_ids, max_new_tokens=None, sampling=None,
-               eos_token_id=None, deadline_s=None, session_id=None):
+               eos_token_id=None, deadline_s=None, session_id=None,
+               adapter_id=None):
         """Route one request; returns a `Future[RequestOutput]`.  The
         Future resolves exactly once — with the output, or with the
         loudest-applicable error (`QueueFullError` when the fleet sheds,
@@ -390,8 +393,11 @@ class ServingRouter:
         key = str(session_id) if session_id is not None \
             else prompt[:16].tobytes()
         rid = f"{self._rid_prefix}-{next(self._ids)}"
-        req = _RoutedRequest(rid, prompt, max_new_tokens, sampling,
-                             eos_token_id, deadline, key)
+        req = _RoutedRequest(
+            rid, prompt, max_new_tokens, sampling, eos_token_id,
+            deadline, key,
+            adapter_id=str(adapter_id) if adapter_id is not None
+            else None)
         with self._lock:
             self._inflight[rid] = req
         threading.Thread(target=self._dispatch, args=(req,),
@@ -400,10 +406,11 @@ class ServingRouter:
 
     def generate(self, prompt_ids, max_new_tokens=None, sampling=None,
                  eos_token_id=None, deadline_s=None, session_id=None,
-                 timeout=None):
+                 timeout=None, adapter_id=None):
         fut = self.submit(prompt_ids, max_new_tokens=max_new_tokens,
                           sampling=sampling, eos_token_id=eos_token_id,
-                          deadline_s=deadline_s, session_id=session_id)
+                          deadline_s=deadline_s, session_id=session_id,
+                          adapter_id=adapter_id)
         return fut.result(timeout or self.cfg.request_timeout_s)
 
     def stats(self):
@@ -422,7 +429,14 @@ class ServingRouter:
         candidates by role preference (prefill > mixed > decode, ring
         order within a class) — new prompts land on prefill replicas,
         but a decode replica still serves as the last resort, so a
-        fleet mid-role-flip never strands a request."""
+        fleet mid-role-flip never strands a request.
+
+        Adapter affinity is the OUTERMOST (final, stable) sort: a
+        request carrying an `adapter_id` prefers replicas whose gossip
+        advertises that adapter as hot-loaded, so a warm pool slot is
+        reused instead of paying a hot-load; a cold replica is still a
+        valid fallback (it hot-loads on admission), so no adapter ever
+        strands a request."""
         with self._lock:
             order = list(self.ring.successors(req.session_key))
             views = dict(self._replicas)
@@ -445,6 +459,9 @@ class ServingRouter:
             rank = {"prefill": 0, "mixed": 1, "decode": 2}
             out.sort(key=lambda n: rank.get(
                 getattr(views.get(n), "role", "mixed"), 1))
+        if req.adapter_id is not None:
+            out.sort(key=lambda n: 0 if req.adapter_id in getattr(
+                views.get(n), "adapters", ()) else 1)
         return out, skipped_full
 
     def _fail(self, req, exc):
@@ -634,7 +651,7 @@ class ServingRouter:
                 name, _remote_submit,
                 args=(name, req.rid, req.prompt,
                       req.max_new_tokens, sampling, req.eos_token_id,
-                      remaining, handoff),
+                      remaining, handoff, req.adapter_id),
                 timeout=budget + 1.0)
         except Exception as e:               # noqa: BLE001
             return e

@@ -116,6 +116,41 @@ def declare_migration_stats():
                         "resume handshake (ms)")
 
 
+def declare_adapter_stats():
+    """Get-or-create the multi-tenant LoRA metric families at engine
+    start so the Prometheus exposition carries the full adapter schema
+    before the first hot-load — a dashboard must see
+    ``adapter_evictions`` at 0, not a missing series, on an engine that
+    never evicted (tools/check_telemetry.py --lora gates on this)."""
+    from ..observability import registry as _registry
+    _registry.counter(PREFIX + "adapter.adapters_loaded",
+                      "adapters hot-loaded into pool slots")
+    _registry.counter(PREFIX + "adapter.adapter_evictions",
+                      "LRU evictions of idle adapters from pool slots")
+    _registry.counter(PREFIX + "adapter.requests_routed_adapter_total",
+                      "requests admitted carrying any adapter_id")
+    _registry.counter(PREFIX + "adapter.requests_routed_adapter",
+                      "requests admitted per adapter",
+                      labelnames=("adapter",))
+    _registry.histogram(PREFIX + "adapter.adapter_load_ms",
+                        "wall time of one adapter hot-load into its "
+                        "pool slot (ms)")
+
+
+def adapter_observe(adapter_id):
+    """One admitted adapter request: the per-adapter labeled counter
+    ``serving.adapter.requests_routed_adapter{adapter=...}`` plus the
+    flat total the snapshot reads.  Cardinality is bounded by the
+    engine run, like ``request_tokens`` (``reset_serving_stats()``
+    clears the family at engine start)."""
+    from ..observability import registry as _registry
+    _registry.counter(PREFIX + "adapter.requests_routed_adapter",
+                      "requests admitted per adapter",
+                      labelnames=("adapter",)) \
+        .labels(adapter=str(adapter_id)).inc()
+    monitor.incr(PREFIX + "adapter.requests_routed_adapter_total")
+
+
 def declare_router_stats():
     """Get-or-create every ``serving.router.*`` metric family so the
     Prometheus exposition carries the full fleet schema from router
@@ -208,6 +243,14 @@ def serving_stats():
     tools/check_telemetry.py --migration, which also requires the
     router's per-role ``requests_routed_role{role=...}`` family.
 
+    Multi-tenant LoRA quantities (``max_adapters > 0``, zero
+    otherwise): ``adapters_loaded`` (hot-loads into pool slots),
+    ``adapter_evictions`` (LRU evictions of idle adapters),
+    ``adapter_load_ms_avg`` (mean hot-load wall time), and
+    ``requests_routed_adapter`` — total admitted adapter requests, with
+    the per-adapter ``requests_routed_adapter{adapter=...}`` series in
+    the Prometheus exposition (gated by check_telemetry.py --lora).
+
     Fleet/router quantities (``serving.router.*``, zero without a
     router; per-replica ``requests_routed{replica=...}`` series live in
     the Prometheus exposition): ``router_requests_routed`` total,
@@ -277,6 +320,11 @@ def serving_stats():
         "prefix_cache_evictions": g("prefix_cache_evictions"),
         "prefix_cache_hit_tokens": g("prefix_cache_hit_tokens"),
         "max_active_slots": g("max_active_slots"),
+        "adapters_loaded": g("adapter.adapters_loaded"),
+        "adapter_evictions": g("adapter.adapter_evictions"),
+        "adapter_load_ms_avg": avg("adapter.adapter_load_ms"),
+        "requests_routed_adapter": g(
+            "adapter.requests_routed_adapter_total"),
         "ttft_ms_avg": avg("ttft_ms"),
         "per_token_ms_avg": avg("decode_ms"),
         "slot_occupancy": (active_steps / slot_steps) if slot_steps

@@ -119,6 +119,13 @@ _FLAGS: dict[str, Any] = {
     # overrides, launched multi-process worlds without a global jax
     # mesh, or this flag off all run the byte-identical eager path.
     "FLAGS_compiled_train_step": True,
+    # Pallas fused multi-LoRA decode delta (serving/adapters.py,
+    # docs/SERVING.md): the per-slot adapter gather-matmul
+    # y += gather(B, idx) @ (gather(A, idx) @ x) * scale runs as one
+    # scalar-prefetch Pallas kernel on TPU instead of the XLA gather
+    # lane.  Off (default): the XLA gather path, which is the
+    # bit-equality reference.  Set before the engine starts.
+    "FLAGS_pallas_lora": False,
     # Pallas fused-optimizer kernels (pallas/fused.py): run the AdamW/
     # Adam elementwise update as a row-blocked Pallas kernel on TPU
     # (exact — same fp32 arithmetic as the XLA lane, verified bitwise in

@@ -611,6 +611,99 @@ def check_spec_bench(run):
     return 0
 
 
+_LORA_SCHEMA = {
+    # key -> accepted types; every key is required
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "speedup_vs_sequential_adapters": (int, float),
+    "sequential_adapters": dict,
+    "multiplexed": dict,
+    "num_adapters": int,
+    "adapter_rank": int,
+    "max_adapters": int,
+    "num_slots": int,
+    "requests_per_adapter": int,
+    "max_new_tokens": int,
+    "adapter_mismatches": int,
+    "dropped_requests": int,
+    "tick_fallbacks": (int, float),
+    "tick_compiled_hits": (int, float),
+    "adapters_loaded": (int, float),
+    "adapter_evictions": (int, float),
+    "adapter_load_ms_avg": (int, float),
+    "smoke": bool,
+    "platform": str,
+}
+
+# acceptance floors (ISSUE 16): multiplexing N adapters through ONE
+# batched engine must sustain >= 5x the aggregate tokens/sec of N
+# sequential single-adapter engine runs (the CI smoke lane, 4 adapters
+# on 4 slots, clears a lower 2x floor), every per-request output must
+# be bit-equal to the dedicated-engine reference, adapter hot-swap
+# must drop zero requests, and the compiled tick must serve the whole
+# mixed-adapter workload without a single fallback.
+_LORA_MIN_SPEEDUP = 5.0
+_LORA_MIN_SPEEDUP_SMOKE = 2.0
+
+
+def check_lora_bench(run):
+    """Schema + speedup/bit-equality/zero-drop gates for the
+    multi-tenant LoRA lane of benchmarks/serving_bench.py (--workload
+    multitenant, ISSUE 16)."""
+    errors = []
+    for key, types in _LORA_SCHEMA.items():
+        if key not in run:
+            errors.append(f"missing key {key!r}")
+        elif run[key] is None or not isinstance(run[key], types):
+            errors.append(f"{key!r} has type {type(run[key]).__name__}, "
+                          f"expected {types}")
+    if not errors:
+        for side in ("sequential_adapters", "multiplexed"):
+            for k in ("tokens_per_sec", "wall_s", "tokens"):
+                v = run[side].get(k)
+                if not isinstance(v, (int, float)) or v <= 0:
+                    errors.append(f"{side}.{k} must be a positive "
+                                  f"number, got {v!r}")
+        floor = _LORA_MIN_SPEEDUP_SMOKE if run["smoke"] \
+            else _LORA_MIN_SPEEDUP
+        if run["speedup_vs_sequential_adapters"] < floor:
+            errors.append(
+                f"speedup_vs_sequential_adapters "
+                f"{run['speedup_vs_sequential_adapters']:.2f} < required "
+                f"{floor}x for {run['num_adapters']} adapters")
+        if run["adapter_mismatches"] != 0:
+            errors.append(
+                f"{run['adapter_mismatches']} outputs diverged from the "
+                "single-adapter engine reference — per-slot adapter "
+                "gather must be output-invariant")
+        if run["dropped_requests"] != 0:
+            errors.append(f"{run['dropped_requests']} request(s) "
+                          "dropped during adapter hot-swap")
+        if run["tick_fallbacks"] != 0:
+            errors.append(f"{run['tick_fallbacks']} tick fallback(s) on "
+                          "a mixed-adapter workload")
+        if run["tick_compiled_hits"] <= 0:
+            errors.append("tick_compiled_hits is 0 — the compiled tick "
+                          "never actually served the multiplexed lane")
+        if run["adapters_loaded"] < run["num_adapters"]:
+            errors.append(
+                f"adapters_loaded {run['adapters_loaded']} < "
+                f"num_adapters {run['num_adapters']} — some tenant "
+                "never reached a pool slot")
+    if errors:
+        print("serving_lora schema check FAILED:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(f"serving_lora schema OK: {run['value']:.1f} tokens/sec, "
+          f"{run['speedup_vs_sequential_adapters']:.2f}x vs "
+          f"{run['num_adapters']} sequential single-adapter runs, "
+          f"{run['adapter_evictions']} eviction(s), outputs bit-equal, "
+          "zero drops/fallbacks")
+    return 0
+
+
 _FLEET_SCHEMA = {
     # key -> accepted types; every key is required
     "metric": str,
@@ -833,6 +926,8 @@ def main():
         return check_disagg_bench(run)
     if str(run.get("metric", "")).startswith("serving_fleet"):
         return check_fleet_bench(run)
+    if str(run.get("metric", "")).startswith("serving_lora"):
+        return check_lora_bench(run)
     if str(run.get("metric", "")).startswith("serving_tick"):
         return check_tick_bench(run)
     if str(run.get("metric", "")).startswith("serving_speculative"):

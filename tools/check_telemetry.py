@@ -336,6 +336,44 @@ def check_serving_tick_exposition(series, typed):
     return errors
 
 
+_LORA_COUNTERS = ("serving_adapter_adapters_loaded",
+                  "serving_adapter_adapter_evictions",
+                  "serving_adapter_requests_routed_adapter_total")
+
+
+def check_lora_exposition(series, typed):
+    """Schema gate for the multi-tenant LoRA telemetry (ISSUE 16): the
+    ``serving.adapter.*`` family — hot-loads, LRU evictions, the
+    ``adapter_load_ms`` histogram, and the per-adapter routed counter —
+    must expose, correctly typed, whenever the engine hosts an adapter
+    pool.  A dashboard that cannot see evictions cannot tell pool
+    thrash from a healthy working set."""
+    errors = []
+    for name in _LORA_COUNTERS:
+        if name not in series:
+            errors.append(f"adapter counter {name!r} absent")
+        elif typed.get(name) != "counter":
+            errors.append(f"{name!r} typed {typed.get(name)!r}, "
+                          "expected counter")
+    hname = "serving_adapter_adapter_load_ms"
+    if typed.get(hname) != "histogram":
+        errors.append(f"{hname!r} absent or not a histogram")
+    elif hname + "_bucket" not in series:
+        errors.append(f"{hname!r} exposes no buckets")
+    pname = "serving_adapter_requests_routed_adapter"
+    if typed.get(pname) != "counter":
+        errors.append(f"{pname!r} (per-adapter) absent or not a counter")
+    else:
+        labeled = [labels for labels, _ in series.get(pname, [])
+                   if "adapter" in labels]
+        total = sum(float(v) for labels, v in
+                    series.get(pname + "_total", []))
+        if total > 0 and not labeled:
+            errors.append(f"{pname!r} has no adapter-labeled samples "
+                          "despite adapter-routed requests")
+    return errors
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--prometheus", help="Prometheus text dump to check")
@@ -359,6 +397,11 @@ def main():
                          "schema (serving.migration.* counters + "
                          "migrate_ms histogram + per-role routed "
                          "counter) in the --prometheus dump")
+    ap.add_argument("--lora", action="store_true",
+                    help="also gate the multi-tenant adapter metric "
+                         "schema (serving.adapter.* counters + "
+                         "adapter_load_ms histogram + per-adapter "
+                         "routed counter) in the --prometheus dump")
     args = ap.parse_args()
     if args.router and not args.prometheus:
         ap.error("--router needs --prometheus")
@@ -366,6 +409,8 @@ def main():
         ap.error("--serving-tick needs --prometheus")
     if args.migration and not args.prometheus:
         ap.error("--migration needs --prometheus")
+    if args.lora and not args.prometheus:
+        ap.error("--lora needs --prometheus")
     if not args.prometheus and not args.snapshots \
             and not args.stall_dump and not args.sentinel_dump:
         ap.error("nothing to check: pass --prometheus, --snapshots, "
@@ -402,6 +447,12 @@ def main():
             if not mig_errors:
                 print("migration exposition OK: full serving.migration"
                       ".* schema + per-role routed counter present")
+        if args.lora:
+            lora_errors = check_lora_exposition(series, typed)
+            failures += lora_errors
+            if not lora_errors:
+                print("adapter exposition OK: full serving.adapter.* "
+                      "schema + per-adapter routed counter present")
     if args.snapshots:
         n, errors = check_snapshots(args.snapshots)
         failures += errors

@@ -98,6 +98,58 @@ python benchmarks/serving_bench.py --workload speculative --smoke \
     --out /tmp/serving_spec_ci.json
 python tools/check_bench_result.py /tmp/serving_spec_ci.json
 
+echo "== multi-tenant LoRA bench (smoke: >=2x vs sequential single-adapter engines, bit-equal, zero drops) =="
+timeout -k 10 600 python benchmarks/serving_bench.py --workload multitenant \
+    --smoke --out /tmp/serving_lora_ci.json
+python tools/check_bench_result.py /tmp/serving_lora_ci.json
+
+echo "== multi-tenant adapter telemetry exposition =="
+timeout -k 10 300 python - <<'EOF'
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.models import GPTForCausalLM, gpt_config
+from paddle_tpu.serving import Engine, ServingConfig
+
+def mk():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=2, hidden_size=64, num_heads=2,
+        vocab_size=128, max_seq_len=64))
+    m.eval()
+    return m
+
+tmp = mk()
+nn.attach_lora(tmp, rank=4)
+rng = np.random.default_rng(7)
+specs = {}
+for i in range(2):
+    for l in nn.lora_layers(tmp).values():
+        l.lora_A.set_value(rng.standard_normal(
+            l.lora_A.shape).astype(np.float32) * 0.3)
+        l.lora_B.set_value(rng.standard_normal(
+            l.lora_B.shape).astype(np.float32) * 0.3)
+    specs[f"t{i}"] = nn.adapter_spec(tmp)
+eng = Engine(mk(), ServingConfig(
+    num_slots=2, max_queue=4, max_adapters=1, adapter_rank_pool=4,
+    adapters=specs)).start()
+prompt = rng.integers(0, 128, (6,)).astype("int32")
+futs = [eng.submit(prompt, max_new_tokens=4, adapter_id=f"t{i}")
+        for i in range(2)]
+outs = [f.result(timeout=300) for f in futs]
+snap = eng.stats()
+assert snap["adapters_loaded"] >= 2, snap
+assert snap["adapter_evictions"] >= 1, snap
+assert snap["requests_routed_adapter"] == 2, snap
+eng.shutdown()
+import paddle_tpu.observability as obs
+with open("/tmp/pt_lora_ci.prom", "w") as f:
+    f.write(obs.render_prometheus())
+print(f"adapter smoke OK: {snap['adapters_loaded']} hot-loads, "
+      f"{snap['adapter_evictions']} eviction(s) through a 1-slot pool")
+EOF
+python tools/check_telemetry.py --prometheus /tmp/pt_lora_ci.prom --lora
+
 echo "== eager op-dispatch cache microbench (smoke + drift gate) =="
 python benchmarks/eager_overhead.py --smoke --out /tmp/eager_overhead_ci.json \
     --baseline benchmarks/EAGER_OVERHEAD.json
