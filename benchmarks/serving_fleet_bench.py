@@ -91,6 +91,28 @@ def _p99(xs):
     return float(np.percentile(np.asarray(xs), 99)) if xs else 0.0
 
 
+def _result_with_retry(fut, resubmit, timeout_s, max_retries=8):
+    """Resolve one future, honoring shed backpressure: a
+    `QueueFullError` carries the router's ``retry_after_s`` hint
+    (pressure-scaled, the 429 Retry-After analog), and a well-behaved
+    client sleeps that long and resubmits instead of counting the shed
+    as a lost request.  `resubmit()` must re-issue the SAME request
+    (same prompt/session) and return a fresh future."""
+    from paddle_tpu.serving import QueueFullError
+    deadline = time.perf_counter() + timeout_s
+    for _ in range(max_retries):
+        try:
+            return fut.result(
+                timeout=max(0.1, deadline - time.perf_counter()))
+        except QueueFullError as e:
+            hint = getattr(e, "retry_after_s", None) or 1.0
+            if time.perf_counter() + hint >= deadline:
+                raise
+            time.sleep(hint)
+            fut = resubmit()
+    return fut.result(timeout=max(0.1, deadline - time.perf_counter()))
+
+
 def _run_variant(variant, prompts, refs, max_new, args):
     """One chaos round: fleet up, load on, kill/drain one replica
     mid-flight, account for every request."""
@@ -125,9 +147,14 @@ def _run_variant(variant, prompts, refs, max_new, args):
         else:
             fleet.drain_replica(victim)       # SIGTERM
         done_at, outs, lost = [], [], 0
-        for fut in futs:
+        for i, fut in enumerate(futs):
+            p = prompts[i]
             try:
-                outs.append(fut.result(timeout=args.timeout_s))
+                outs.append(_result_with_retry(
+                    fut,
+                    lambda p=p, i=i: fleet.submit(
+                        p, max_new_tokens=max_new, session_id=i),
+                    args.timeout_s))
                 done_at.append(time.perf_counter())
             except Exception as e:            # noqa: BLE001
                 outs.append(e)
@@ -234,9 +261,14 @@ def _drive_load(fleet, jobs, timeout_s, gap_s=0.0):
         futs.append(fleet.submit(p, max_new_tokens=max_new,
                                  session_id=i))
     outs, errors = [], []
-    for fut in futs:
+    for i, fut in enumerate(futs):
+        kind, p, max_new = jobs[i]
         try:
-            outs.append(fut.result(timeout=timeout_s))
+            outs.append(_result_with_retry(
+                fut,
+                lambda p=p, max_new=max_new, i=i: fleet.submit(
+                    p, max_new_tokens=max_new, session_id=i),
+                timeout_s))
         except Exception as e:                # noqa: BLE001
             outs.append(None)
             errors.append(repr(e))

@@ -333,10 +333,13 @@ def _connect(to):
         return Client((info.ip, info.port), authkey=_state["authkey"])
 
     try:
+        # decorrelated jitter: a fleet of dispatch threads mass-
+        # reconnecting after a store blip spreads over the whole backoff
+        # window instead of thundering-herding this replica in waves
         return retry_call(_dial, tries=3,
                           retry_on=(ConnectionRefusedError,
                                     ConnectionResetError),
-                          base=0.05, max_delay=0.5)
+                          base=0.05, max_delay=0.5, decorrelated=True)
     except (ConnectionRefusedError, ConnectionResetError) as e:
         raise ConnectionError(
             f"rpc to worker {to!r} at {info.ip}:{info.port}: connect "
@@ -347,7 +350,13 @@ def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
     """reference: rpc.py rpc_sync — blocking remote call.  A positive
     ``timeout`` (seconds) bounds the wait for the response: a dead or
     wedged worker raises ``TimeoutError`` naming it instead of blocking
-    this process forever in ``recv()``."""
+    this process forever in ``recv()``.
+
+    The ``rpc_slow`` fault point fires here, IN-CALL: after the request
+    went out, before the response is awaited — modelling latency on an
+    already-connected worker (a stalled NIC, a wedged peer), which the
+    connect-time ``rpc_delay`` point cannot.  The injected stall counts
+    against ``timeout``, exactly as a genuinely slow response would."""
     c = _connect(to)
     try:
         plain, blobs = _extract_blobs(tuple(args or ()))
@@ -357,6 +366,13 @@ def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
                 _send_blob(c, b)
         else:
             c.send(("call", fn, plain, kwargs))
+        from ...utils import fault_injection as _fi
+        if _fi.active("rpc_slow") is not None:
+            t0 = time.monotonic()
+            _fi.check_rpc("rpc_slow", to)    # sleeps in-call when armed
+            slept = time.monotonic() - t0
+            if timeout is not None and timeout > 0:
+                timeout = max(1e-6, timeout - slept)
         if timeout is not None and timeout > 0:
             if not c.poll(timeout):
                 raise TimeoutError(

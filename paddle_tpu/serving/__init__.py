@@ -17,8 +17,9 @@ from __future__ import annotations
 from .adapters import AdapterPool  # noqa: F401
 from .api import (  # noqa: F401
     AdapterConfigError, DeadlineExceededError, EngineShutdownError,
-    NoReplicaError, PageMigrationError, QueueFullError, RequestOutput,
-    SamplingParams, SchedulerStallError, ServingConfig, ServingError,
+    NoReplicaError, PageMigrationError, QueueFullError,
+    RequestCancelledError, RequestOutput, SamplingParams,
+    SchedulerStallError, ServingConfig, ServingError,
     UnknownAdapterError,
 )
 from .compiled_tick import (  # noqa: F401
@@ -39,6 +40,7 @@ __all__ = [
     "SlotKVCache", "PagedKVCache", "PrefixTree", "ServingError",
     "QueueFullError", "DeadlineExceededError", "EngineShutdownError",
     "SchedulerStallError", "NoReplicaError", "PageMigrationError",
+    "RequestCancelledError",
     "AdapterConfigError", "UnknownAdapterError", "AdapterPool",
     "serving_stats", "reset_serving_stats", "reset_router_stats",
     "ServingRouter", "RouterConfig", "HashRing", "ServingFleet",

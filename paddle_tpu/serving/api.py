@@ -46,6 +46,15 @@ class EngineShutdownError(ServingError):
     or in flight."""
 
 
+class RequestCancelledError(ServingError):
+    """The request was cancelled via ``Engine.cancel`` before it
+    finished — the hedged-dispatch loser path: the router got its
+    answer from another replica, so this attempt's slot, KV pages and
+    adapter rows were released and its future failed with this error
+    (which the router's first-answer-wins delivery never surfaces to
+    the client)."""
+
+
 class SchedulerStallError(ServingError):
     """One scheduler iteration exceeded ``ServingConfig.step_timeout_s``;
     the engine failed every outstanding future and restarted its loop

@@ -32,9 +32,10 @@ from concurrent.futures import Future
 import numpy as np
 
 from . import stats
+from ..utils import fault_injection as _fi
 from .api import (DeadlineExceededError, EngineShutdownError,
-                  QueueFullError, RequestOutput, SamplingParams,
-                  SchedulerStallError, ServingConfig)
+                  QueueFullError, RequestCancelledError, RequestOutput,
+                  SamplingParams, SchedulerStallError, ServingConfig)
 from .kv_slots import SlotKVCache
 
 
@@ -170,6 +171,14 @@ class Engine:
         self._migration_results: deque = deque()
         self._migrate_failed: set[int] = set()
         self._drain_migrate = False
+        # cancellation (hedged-dispatch losers, chaos drills): ids whose
+        # slot-resident state the SCHEDULER must unwind inside its own
+        # iteration — prefill/decode run outside the lock, so another
+        # thread can never release a live slot directly
+        self._cancels: set[int] = set()
+        # the hosting ReplicaServer stamps its name here so the
+        # `engine_slow` gray-failure point can target one replica
+        self.fault_name = None
         # multi-tenant LoRA (serving/adapters.py): preallocated A/B
         # stacks per wrapped projection + per-slot int32 adapter index.
         # Built (and the registry validated — typed AdapterConfigError)
@@ -453,6 +462,7 @@ class Engine:
             stats.incr("requests_submitted")
             stats.set_value("queue_depth", len(self._queue))
             self._work.notify()
+        req.future.request_id = req.id       # cancel()'s handle
         return req.future
 
     def generate(self, prompt_ids, max_new_tokens=None, sampling=None,
@@ -539,7 +549,66 @@ class Engine:
             stats.incr("requests_submitted")
             stats.set_value("queue_depth", len(self._queue))
             self._work.notify()
+        req.future.request_id = req.id       # cancel()'s handle
         return req.future
+
+    def cancel(self, request_id):
+        """Best-effort cancel of one pending request (the hedged-
+        dispatch loser path; ``request_id`` is the engine id stamped on
+        the submitted future as ``future.request_id``).  A queued
+        request is failed with `RequestCancelledError` right here; a
+        slot-resident one (prefilling/decoding) is handed to the
+        scheduler, which unwinds it inside its next iteration —
+        releasing its slot, KV pages, prefix-tree refs and adapter rows
+        through the same exactly-once `_release` path every completion
+        takes.  Returns True when the request was pending and the
+        cancellation was applied or scheduled; False when it is unknown,
+        already resolved, or mid-migration (its pages are in flight to
+        another replica — it will resolve through the migration
+        protocol, and first-answer-wins delivery makes a late result
+        harmless)."""
+        with self._work:
+            req = self._pending.get(request_id)
+            if req is None or req.future.done():
+                return False
+            if req.id in self._migrating_out:
+                return False
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                # slot-resident or mid-admission: the scheduler owns
+                # slot state — let it apply the cancellation
+                self._cancels.add(req.id)
+                self._work.notify()
+                return True
+            self._fail(req, RequestCancelledError(
+                f"request {req.id} cancelled while queued"))
+            stats.incr("requests_cancelled")
+            stats.set_value("queue_depth", len(self._queue))
+            return True
+
+    def _process_cancels_locked(self):
+        if not self._cancels:
+            return
+        cancels, self._cancels = self._cancels, set()
+        for cid in cancels:
+            req = self._pending.get(cid)
+            if req is None or req.id in self._migrating_out:
+                continue
+            try:
+                self._prefilling.remove(req)
+            except ValueError:
+                pass
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                pass
+            self._fail(req, RequestCancelledError(
+                f"request {req.id} cancelled"))
+            stats.incr("requests_cancelled")
+            self._release(req)
+        stats.set_value("queue_depth", len(self._queue))
+        stats.set_value("active_slots", len(self._active))
 
     def stats(self):
         return stats.serving_stats()
@@ -631,6 +700,7 @@ class Engine:
                     if not self._running:
                         break
                     self._process_migration_results_locked()
+                    self._process_cancels_locked()
                     self._expire_queued_locked()
                     admits = []
                     while self._queue and self.cache.free_slots:
@@ -651,6 +721,11 @@ class Engine:
                 if budget > 0:
                     self._iter_deadline = time.monotonic() + budget
                 t_tick = time.monotonic()
+                if _fi.active("engine_slow") is not None:
+                    # gray-failure drill: a per-iteration stall on this
+                    # replica — heartbeats stay healthy, every request
+                    # hashed here just gets slower (docs/RESILIENCE.md)
+                    _fi.check_rpc("engine_slow", self.fault_name or "")
                 if self._paged and self._draining and \
                         self._drain_migrate and self.migrator is not None:
                     # preemption recovery: stream the still-decoding
@@ -1594,6 +1669,7 @@ class Engine:
             self._prefilling.clear()
             self._migrating_out.clear()
             self._migration_results.clear()
+            self._cancels.clear()
         for req in reqs:
             if not req.future.done():
                 self._fail(req, exc)

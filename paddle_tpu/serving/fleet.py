@@ -126,6 +126,32 @@ def _remote_submit(replica_name, rid, prompt, max_new_tokens, sampling,
                              adapter_id=adapter_id)
 
 
+def _remote_cancel(replica_name, rid):
+    """Hedged-dispatch loser cancellation rpc target: best-effort
+    cancel of the engine attempt behind ``rid`` so the losing replica's
+    slot/pages/adapter rows return to the pool instead of decoding a
+    result nobody will read.  Never raises for an unknown rid — a
+    cancel racing completion is the expected case, not an error."""
+    rep = _REPLICAS.get(replica_name)
+    if rep is None:
+        return {"cancelled": False, "replica": replica_name}
+    return rep.handle_cancel(rid)
+
+
+def _remote_canary(replica_name, max_new_tokens=1):
+    """Canary-probe rpc target (gray-failure guardian): decode a
+    minimal request through the full engine path — admission, prefill,
+    one decode step — so an `engine_slow`-class degradation shows up in
+    the probe's wall time, which a bare connect ping would never see.
+    Returns the probe latency; raises whatever the engine raises."""
+    rep = _REPLICAS.get(replica_name)
+    if rep is None:
+        raise EngineShutdownError(
+            f"replica {replica_name!r} is not hosted in this process "
+            f"(hosted: {sorted(_REPLICAS)})")
+    return rep.handle_canary(max_new_tokens=max_new_tokens)
+
+
 def _remote_adopt(replica_name, rid, meta, header, *blobs):
     """Migration phase 1 rpc target (decode side): adopt the page
     frames — which arrive as `rpc.Blob` raw frames, never pickle —
@@ -208,7 +234,11 @@ class ReplicaServer:
         self._dedup: OrderedDict[str, object] = OrderedDict()
         self._dedup_lock = threading.Lock()
         self._store_lock = threading.Lock()
-        self.engine = Engine(model, serving_config).start()
+        self.engine = Engine(model, serving_config)
+        # name the engine for the `engine_slow` gray-failure point (the
+        # `to=` filter targets one replica of a thread-mode fleet too)
+        self.engine.fault_name = name
+        self.engine.start()
         # live KV-page migration: the engine exports/adopts pages; the
         # replica supplies the transport (rpc) + target selection
         self.engine.migrator = self._migrate_request
@@ -276,15 +306,19 @@ class ReplicaServer:
         can never make this replica decode — or deliver — twice).
         ``handoff`` names the decode replica this request's KV pages
         should migrate to once its prompt is hot (disaggregation)."""
+        from .api import RequestCancelledError
         with self._dedup_lock:
             fut = self._dedup.get(rid)
             if fut is not None and fut.done() and \
-                    isinstance(fut.exception(), EngineShutdownError):
+                    isinstance(fut.exception(),
+                               (EngineShutdownError,
+                                RequestCancelledError)):
                 # the cached attempt failed without ever delivering
-                # (e.g. its migration target died after adopting): a
-                # resubmission under the same rid deserves a FRESH
-                # attempt — re-awaiting the corpse would bounce the
-                # request until its resubmit budget ran out
+                # (e.g. its migration target died after adopting, or a
+                # hedged-dispatch loser was cancelled): a resubmission
+                # under the same rid deserves a FRESH attempt —
+                # re-awaiting the corpse would bounce the request until
+                # its resubmit budget ran out
                 fut = None
             if fut is None:
                 fut = self.engine.submit(
@@ -312,6 +346,32 @@ class ReplicaServer:
                 "finish_reason": out.finish_reason,
                 "ttft_ms": out.ttft_ms, "latency_ms": out.latency_ms,
                 "decoded_by": out.decoded_by or self.name}
+
+    def handle_cancel(self, rid):
+        """Best-effort cancel of the engine attempt behind ``rid``
+        (hedged-dispatch loser, chaos drills).  The dedup cache keeps
+        its entry: a late resubmission of the rid finds a future done
+        with `RequestCancelledError` and takes a fresh attempt (see
+        `handle_submit`)."""
+        with self._dedup_lock:
+            fut = self._dedup.get(rid)
+        if fut is None or fut.done():
+            return {"cancelled": False, "replica": self.name}
+        eid = getattr(fut, "request_id", None)
+        ok = self.engine.cancel(eid) if eid is not None else False
+        return {"cancelled": bool(ok), "replica": self.name}
+
+    def handle_canary(self, max_new_tokens=1):
+        """Serve one minimal probe request through the full engine path
+        and return its wall time — the guardian's readmission signal
+        for an ejected replica.  A degraded engine (`engine_slow`, a
+        wedged host) inflates the latency; a draining/stopped one
+        raises."""
+        t0 = time.monotonic()
+        self.engine.generate(np.asarray([1], np.int32),
+                             max_new_tokens=max(1, int(max_new_tokens)))
+        return {"replica": self.name,
+                "latency_ms": (time.monotonic() - t0) * 1e3}
 
     # ---------------- migration plane ----------------
     def handle_resume_begin(self, rid, meta, header, blobs):
@@ -647,6 +707,27 @@ class ServingFleet:
 
     def generate(self, *args, **kwargs):
         return self.router.generate(*args, **kwargs)
+
+    def generate_with_retry(self, *args, shed_retries=8, timeout=None,
+                            **kwargs):
+        """Sync generate that honors shed backpressure: when the fleet
+        sheds (`QueueFullError`), sleep the router-suggested
+        ``retry_after_s`` — scaled by current shed pressure on the
+        router side — and resubmit, instead of hot-spinning the
+        admission path.  Re-raises the last `QueueFullError` after
+        ``shed_retries`` resubmissions."""
+        from .api import QueueFullError
+        attempt = 0
+        while True:
+            try:
+                return self.router.generate(*args, timeout=timeout,
+                                            **kwargs)
+            except QueueFullError as e:
+                attempt += 1
+                if attempt > shed_retries:
+                    raise
+                time.sleep(e.retry_after_s if e.retry_after_s
+                           else self.router.cfg.retry_after_s)
 
     def stats(self):
         return self.router.stats()

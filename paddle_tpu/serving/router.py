@@ -60,14 +60,17 @@ import itertools
 import json
 import threading
 import time
-from concurrent.futures import Future
+from collections import deque
+from concurrent.futures import FIRST_COMPLETED, Future
+from concurrent.futures import wait as _futures_wait
 from dataclasses import dataclass
 
 import numpy as np
 
 from . import stats
 from .api import (DeadlineExceededError, EngineShutdownError,
-                  NoReplicaError, QueueFullError, RequestOutput,
+                  NoReplicaError, QueueFullError,
+                  RequestCancelledError, RequestOutput,
                   SamplingParams, ServingError)
 
 #: membership key prefixes on the fleet store (shared with fleet.py)
@@ -109,6 +112,55 @@ class RouterConfig:
                          is cheaper to decode where it prefilled than
                          to move (requests without an explicit
                          max_new_tokens always qualify)
+
+    Gray-failure guardian (ISSUE 17, docs/RESILIENCE.md "Gray-failure
+    guardian"; every knob defaults OFF — routing is then byte-identical
+    to the guardian-less router):
+
+    health_ejection      master switch for health-scored outlier
+                         ejection: per-replica EWMA latency and error
+                         rates are fed from EVERY dispatch; a replica
+                         whose score exceeds a robust z-threshold vs
+                         the fleet median is ejected from the candidate
+                         order (reversible + generation-preserving,
+                         unlike sticky-dead), canary-probed, and
+                         readmitted on sustained recovery
+    health_alpha         EWMA coefficient of the latency/error score
+    eject_zscore         robust z (median/MAD) beyond which a replica
+                         is an outlier
+    eject_min_samples    dispatches a replica must have served before
+                         it can be ejected (no ejection on noise)
+    eject_max_fraction   never eject more than this fraction of the
+                         ready fleet (and never the last replica)
+    canary_interval_s    probe cadence for ejected replicas
+    canary_timeout_s     rpc budget of one canary probe
+    readmit_canaries     consecutive healthy canaries before
+                         readmission (sustained recovery, not one
+                         lucky probe)
+    hedge_percentile     > 0 arms hedged dispatch: a primary attempt
+                         still unanswered past this percentile of
+                         recent route latencies fires ONE hedge to the
+                         next candidate under the SAME idempotent rid
+                         (the replica dedup cache makes the pair
+                         at-most-once); first answer wins, the loser
+                         is cancelled (`Engine.cancel`).  0 = off
+    hedge_min_samples    recent-latency samples required before the
+                         percentile is trusted (no hedging cold)
+    breaker_failures     > 0 arms per-replica circuit breakers: this
+                         many transport failures within
+                         breaker_window_s opens the breaker (replica
+                         skipped without paying an rpc), one trial
+                         call after breaker_cooldown_s half-opens it,
+                         and a trial success recloses.  0 = off
+    breaker_window_s     sliding failure-count window
+    breaker_cooldown_s   open -> half-open delay
+    retry_budget_per_s   > 0 arms the fleet-wide token-bucket retry
+                         budget: resubmissions (failover, drain
+                         bounce, dead-timeout) spend a token; an empty
+                         bucket fails the request instead of letting a
+                         resubmission storm amplify an outage.  0 =
+                         unlimited (the pre-guardian behavior)
+    retry_budget_burst   bucket capacity (burst tolerance)
     """
 
     heartbeat_ttl_s: float = 3.0
@@ -121,6 +173,21 @@ class RouterConfig:
     request_timeout_s: float = 120.0
     disaggregation: bool = False
     migrate_min_new_tokens: int = 2
+    health_ejection: bool = False
+    health_alpha: float = 0.3
+    eject_zscore: float = 4.0
+    eject_min_samples: int = 8
+    eject_max_fraction: float = 0.5
+    canary_interval_s: float = 0.5
+    canary_timeout_s: float = 5.0
+    readmit_canaries: int = 3
+    hedge_percentile: float = 0.0
+    hedge_min_samples: int = 16
+    breaker_failures: int = 0
+    breaker_window_s: float = 10.0
+    breaker_cooldown_s: float = 2.0
+    retry_budget_per_s: float = 0.0
+    retry_budget_burst: int = 10
 
     def validate(self):
         if self.heartbeat_ttl_s <= 0:
@@ -135,7 +202,49 @@ class RouterConfig:
         if self.max_resubmits < 0:
             raise ValueError(f"max_resubmits must be >= 0, got "
                              f"{self.max_resubmits}")
+        if not (0.0 < self.health_alpha <= 1.0):
+            raise ValueError(f"health_alpha must be in (0, 1], got "
+                             f"{self.health_alpha}")
+        if self.eject_zscore <= 0:
+            raise ValueError(f"eject_zscore must be > 0, got "
+                             f"{self.eject_zscore}")
+        if self.eject_min_samples < 1:
+            raise ValueError(f"eject_min_samples must be >= 1, got "
+                             f"{self.eject_min_samples}")
+        if not (0.0 <= self.eject_max_fraction <= 1.0):
+            raise ValueError(f"eject_max_fraction must be in [0, 1], "
+                             f"got {self.eject_max_fraction}")
+        if self.canary_interval_s <= 0 or self.canary_timeout_s <= 0:
+            raise ValueError("canary_interval_s and canary_timeout_s "
+                             "must be > 0")
+        if self.readmit_canaries < 1:
+            raise ValueError(f"readmit_canaries must be >= 1, got "
+                             f"{self.readmit_canaries}")
+        if not (0.0 <= self.hedge_percentile < 100.0):
+            raise ValueError(f"hedge_percentile must be in [0, 100), "
+                             f"got {self.hedge_percentile}")
+        if self.hedge_min_samples < 1:
+            raise ValueError(f"hedge_min_samples must be >= 1, got "
+                             f"{self.hedge_min_samples}")
+        if self.breaker_failures < 0 or self.breaker_window_s <= 0 \
+                or self.breaker_cooldown_s <= 0:
+            raise ValueError("breaker_failures must be >= 0 and "
+                             "breaker_window_s/breaker_cooldown_s > 0")
+        if self.retry_budget_per_s < 0 or self.retry_budget_burst < 1:
+            raise ValueError("retry_budget_per_s must be >= 0 and "
+                             "retry_budget_burst >= 1")
         return self
+
+
+def _as_transport_error(exc):
+    """A candidate list is a snapshot: a dispatch thread can race a
+    concurrent `_mark_dead` + `rpc.forget_worker` and dial a replica
+    the registry no longer knows.  That 'unknown worker' ValueError IS
+    a dead-replica signal — coerce it to the ConnectionError failover
+    path instead of failing the request with an app-level error."""
+    if isinstance(exc, ValueError) and "unknown worker" in str(exc):
+        return ConnectionError(str(exc))
+    return exc
 
 
 def _hash64(data):
@@ -229,6 +338,109 @@ class _RoutedRequest:
         self.resubmits = 0                  # re-sends after the first
 
 
+class _ReplicaHealth:
+    """EWMA latency + error-rate score of one replica, fed from every
+    dispatch.  `score()` is the health scalar the guardian compares
+    across the fleet: EWMA route latency (ms) inflated by the EWMA
+    transport-error rate — a replica that is slow OR flaky scores high.
+    Backpressure (`QueueFullError`) and lifecycle bounces are neutral:
+    a full queue is load, not sickness."""
+
+    __slots__ = ("ewma_ms", "err_ewma", "samples")
+
+    def __init__(self):
+        self.ewma_ms = None
+        self.err_ewma = 0.0
+        self.samples = 0
+
+    def observe(self, alpha, latency_ms, error):
+        self.samples += 1
+        if self.ewma_ms is None:
+            self.ewma_ms = float(latency_ms)
+        else:
+            self.ewma_ms += alpha * (float(latency_ms) - self.ewma_ms)
+        self.err_ewma += alpha * ((1.0 if error else 0.0)
+                                  - self.err_ewma)
+
+    def score(self):
+        if self.ewma_ms is None:
+            return None
+        return self.ewma_ms * (1.0 + 4.0 * self.err_ewma)
+
+
+class _Breaker:
+    """Per-replica circuit breaker: closed -> open -> half-open.
+    `breaker_failures` transport failures inside `breaker_window_s`
+    open it (calls skipped without paying an rpc); after
+    `breaker_cooldown_s` ONE trial call is admitted (half-open); a
+    trial success recloses, a trial failure re-opens."""
+
+    __slots__ = ("state", "fail_times", "open_until")
+
+    def __init__(self):
+        self.state = "closed"
+        self.fail_times: list[float] = []
+        self.open_until = 0.0
+
+    def allow(self, now, cooldown_s):
+        if self.state == "closed":
+            return True
+        if self.state == "open" and now >= self.open_until:
+            self.state = "half"          # admit exactly one trial
+            return True
+        return False                     # open (cooling) or half (trial
+        #                                  already in flight)
+
+    def on_success(self):
+        self.state = "closed"
+        self.fail_times.clear()
+
+    def on_failure(self, now, threshold, window_s, cooldown_s):
+        """Record one transport failure; returns True on a transition
+        into `open` (the caller counts those)."""
+        if self.state == "half":
+            self.state = "open"
+            self.open_until = now + cooldown_s
+            return True
+        self.fail_times.append(now)
+        self.fail_times = [t for t in self.fail_times
+                           if now - t <= window_s]
+        if self.state == "closed" and len(self.fail_times) >= threshold:
+            self.state = "open"
+            self.open_until = now + cooldown_s
+            return True
+        return False
+
+
+class _RetryBudget:
+    """Fleet-wide token bucket spent by resubmissions.  A replica
+    outage that triggers mass failover drains the bucket; once empty,
+    further resubmissions fail loudly instead of amplifying the outage
+    with a retry storm (the classic metastable-failure feedback
+    loop)."""
+
+    __slots__ = ("rate", "burst", "tokens", "stamp", "_lock")
+
+    def __init__(self, rate, burst):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.stamp = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self):
+        with self._lock:
+            now = time.monotonic()
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self.stamp)
+                              * self.rate)
+            self.stamp = now
+            if self.tokens >= 1.0:
+                self.tokens -= 1.0
+                return True
+            return False
+
+
 class ServingRouter:
     """`ServingRouter(store).start()`; then `submit()` / `generate()`
     exactly like a local `Engine` — the fleet is one logical engine.
@@ -251,6 +463,19 @@ class ServingRouter:
         self._watcher = None
         self._rid_prefix = f"{name}-{_hash64(repr(time.time())) % 10**6}"
         self._ids = itertools.count()
+        # ---- gray-failure guardian state (all knobs default off) ----
+        cfg = self.cfg
+        self._guardian = bool(cfg.health_ejection
+                              or cfg.hedge_percentile > 0
+                              or cfg.breaker_failures > 0)
+        self._health: dict[str, _ReplicaHealth] = {}
+        self._ejected: dict[str, dict] = {}   # name -> canary state
+        self._breakers: dict[str, _Breaker] = {}
+        self._lat_ring: deque[float] = deque(maxlen=512)
+        self._shed_times: deque[float] = deque(maxlen=256)
+        self._retry_budget = (_RetryBudget(cfg.retry_budget_per_s,
+                                           cfg.retry_budget_burst)
+                              if cfg.retry_budget_per_s > 0 else None)
 
     # ---------------- lifecycle ----------------
     def start(self):
@@ -299,6 +524,12 @@ class ServingRouter:
             except Exception:
                 # a flaky store read must not kill routing; the next
                 # poll retries and the sticky-dead set is unchanged
+                pass
+            try:
+                self._guardian_tick()
+            except Exception:
+                # guardian bookkeeping must never kill membership
+                # polling either
                 pass
             time.sleep(self.cfg.poll_interval_s)
 
@@ -349,6 +580,11 @@ class ServingRouter:
         if name in self.ring.members:
             self.ring.rebuild(self.ring.members - {name})
             stats.incr("router.replicas_lost")
+        # a dead replica's guardian state dies with it: its eventual
+        # rejoin (bumped generation) starts with a clean slate
+        self._ejected.pop(name, None)
+        self._health.pop(name, None)
+        self._breakers.pop(name, None)
         from ..distributed import rpc
         rpc.forget_worker(name)
 
@@ -440,11 +676,29 @@ class ServingRouter:
         with self._lock:
             order = list(self.ring.successors(req.session_key))
             views = dict(self._replicas)
+            blocked = set()
+            if self._guardian:
+                if self.cfg.health_ejection and self._ejected:
+                    blocked |= set(self._ejected)
+                if self.cfg.breaker_failures > 0 and self._breakers:
+                    mono = time.monotonic()
+                    for n in order:
+                        br = self._breakers.get(n)
+                        if br is not None and n not in blocked and \
+                                not br.allow(
+                                    mono, self.cfg.breaker_cooldown_s):
+                            blocked.add(n)
         now = time.time()
         out, skipped_full = [], 0
         for name in order:
             view = views.get(name)
             if view is None:
+                continue
+            if name in blocked:
+                # ejected by the health guardian or breaker-open:
+                # reversible, generation-preserving skip — the replica
+                # stays in the ring and rejoins the order on
+                # readmission / breaker reclose
                 continue
             load = view.load
             fresh = (now - view.load_ts) <= \
@@ -528,7 +782,7 @@ class ServingRouter:
                 time.sleep(cfg.poll_interval_s)
                 continue
             all_full = True
-            for name in candidates:
+            for i, name in enumerate(candidates):
                 remaining = self._remaining(req)
                 if remaining is not None and remaining <= 0:
                     self._fail(req, DeadlineExceededError(
@@ -536,7 +790,15 @@ class ServingRouter:
                     return
                 budget = cfg.rpc_timeout_s if remaining is None \
                     else min(cfg.rpc_timeout_s, remaining)
-                err = self._try_replica(req, name, budget)
+                # hedging applies to the PRIMARY attempt only (first
+                # candidate, first round) — hedging a spill chain would
+                # amplify load exactly when the fleet is struggling
+                hedge_peer = (candidates[i + 1]
+                              if cfg.hedge_percentile > 0 and i == 0
+                              and req.attempts == 0
+                              and len(candidates) > 1 else None)
+                err = self._try_replica(req, name, budget,
+                                        hedge_peer=hedge_peer)
                 if err is None:
                     return                       # delivered
                 if isinstance(err, QueueFullError):
@@ -546,6 +808,8 @@ class ServingRouter:
                     # against the same budget as death-failovers so a
                     # replica stuck bouncing every submit can never pin
                     # a request in the dispatch loop forever
+                    if not self._retry_allowed(req, err):
+                        return
                     stats.incr("router.resubmissions")
                     req.resubmits += 1
                     req.attempts += 1
@@ -560,6 +824,8 @@ class ServingRouter:
                 if isinstance(err, (ConnectionError, OSError)):
                     self._mark_dead(name)
                     stats.incr("router.failovers")
+                    if not self._retry_allowed(req, err):
+                        return
                     stats.incr("router.resubmissions")
                     req.resubmits += 1
                     req.attempts += 1
@@ -584,6 +850,8 @@ class ServingRouter:
                         return
                     self._mark_dead(name)
                     stats.incr("router.failovers")
+                    if not self._retry_allowed(req, err):
+                        return
                     stats.incr("router.resubmissions")
                     req.resubmits += 1
                     req.attempts += 1
@@ -606,10 +874,25 @@ class ServingRouter:
 
     def _shed(self, req):
         stats.incr("router.requests_shed")
+        hint = self._retry_after_hint()
         self._fail(req, QueueFullError(
             f"request {req.rid}: every ready replica is at capacity; "
-            f"retry after {self.cfg.retry_after_s:.1f}s",
-            retry_after_s=self.cfg.retry_after_s))
+            f"retry after {hint:.1f}s",
+            retry_after_s=hint))
+
+    def _retry_after_hint(self):
+        """The Retry-After hint, scaled by current shed pressure: the
+        busier the last 5 s of sheds, the longer clients are told to
+        back off — fleet-side pushback that spreads the retry wave
+        instead of inviting it back all at once.  The FIRST shed in a
+        quiet window returns exactly `retry_after_s`."""
+        now = time.monotonic()
+        with self._lock:
+            self._shed_times.append(now)
+            recent = sum(1 for t in self._shed_times
+                         if now - t <= 5.0)
+        return self.cfg.retry_after_s * min(
+            8.0, 1.0 + 0.25 * (recent - 1))
 
     def _pick_decode_target(self, exclude):
         """The migration target for a request about to land on
@@ -629,12 +912,10 @@ class ServingRouter:
             v.name))
         return {"name": v.name, "ip": v.ip, "port": v.port}
 
-    def _try_replica(self, req, name, budget):
-        """One delivery attempt.  Returns None on success (future
-        completed) or the exception describing why this replica did not
-        serve it."""
-        from ..distributed import rpc
-        from .fleet import _remote_submit
+    def _submit_args(self, req, name):
+        """The `_remote_submit` args tuple for one attempt against
+        `name` (the handoff target is picked per target replica, so a
+        hedge recomputes it)."""
         remaining = self._remaining(req)
         sampling = {"temperature": req.sampling.temperature,
                     "top_k": req.sampling.top_k,
@@ -646,14 +927,304 @@ class ServingRouter:
             req.max_new_tokens >= self.cfg.migrate_min_new_tokens
         handoff = self._pick_decode_target(name) \
             if self.cfg.disaggregation and migratable else None
+        return (name, req.rid, req.prompt, req.max_new_tokens,
+                sampling, req.eos_token_id, remaining, handoff,
+                req.adapter_id)
+
+    def _try_replica(self, req, name, budget, hedge_peer=None):
+        """One delivery attempt.  Returns None on success (future
+        completed) or the exception describing why this replica did not
+        serve it.  With hedging armed and warmed up, the attempt runs
+        through `_try_replica_hedged` instead."""
+        from ..distributed import rpc
+        from .fleet import _remote_submit
+        if hedge_peer is not None:
+            threshold_s = self._hedge_threshold_s()
+            if threshold_s is not None and threshold_s < budget:
+                return self._try_replica_hedged(
+                    req, name, hedge_peer, budget, threshold_s)
+        t0 = time.monotonic()
         try:
             payload = rpc.rpc_sync(
                 name, _remote_submit,
-                args=(name, req.rid, req.prompt,
-                      req.max_new_tokens, sampling, req.eos_token_id,
-                      remaining, handoff, req.adapter_id),
+                args=self._submit_args(req, name),
                 timeout=budget + 1.0)
         except Exception as e:               # noqa: BLE001
+            e = _as_transport_error(e)
+            self._observe_attempt(name, time.monotonic() - t0, e)
             return e
+        self._observe_attempt(name, time.monotonic() - t0, None)
         self._complete(req, payload, name)
         return None
+
+    # ---------------- gray-failure guardian ----------------
+    def _observe_attempt(self, name, dt_s, exc):
+        """Health/breaker bookkeeping for one finished attempt.  Fed
+        from EVERY dispatch (successes included), which is what lets
+        the guardian see a replica that is slow-but-alive.  Transport
+        failures (connection loss, timeout) count as errors;
+        backpressure and lifecycle errors (`QueueFullError`,
+        `EngineShutdownError`) are neutral — a shedding replica is
+        busy, not sick.  A hedged loser's `RequestCancelledError` is a
+        LATENCY observation, not an error: the attempt was at least
+        `dt_s` slow before the hedge beat it and we gave up — without
+        this, hedging would mask exactly the slow replica that
+        health-scored ejection exists to catch (every slow primary
+        gets hedged away and cancelled, so it never reports a slow
+        success)."""
+        if not self._guardian:
+            return
+        transport = exc is not None and isinstance(
+            exc, (OSError, TimeoutError))
+        cancelled = isinstance(exc, RequestCancelledError)
+        success = exc is None
+        with self._lock:
+            if self.cfg.breaker_failures > 0:
+                br = self._breakers.setdefault(name, _Breaker())
+                if transport:
+                    if br.on_failure(time.monotonic(),
+                                     self.cfg.breaker_failures,
+                                     self.cfg.breaker_window_s,
+                                     self.cfg.breaker_cooldown_s):
+                        stats.incr("router.breaker_open")
+                elif success:
+                    br.on_success()
+            if success or transport or cancelled:
+                h = self._health.setdefault(name, _ReplicaHealth())
+                h.observe(self.cfg.health_alpha, dt_s * 1e3,
+                          error=transport)
+            if success:
+                self._lat_ring.append(dt_s * 1e3)
+
+    def _attempt_observer(self, name, t0):
+        """`add_done_callback` adapter for async (hedged) attempts."""
+        def _cb(fut):
+            try:
+                exc = fut.exception()
+            except Exception as e:           # noqa: BLE001
+                exc = e
+            self._observe_attempt(name, time.monotonic() - t0, exc)
+        return _cb
+
+    def _hedge_threshold_s(self):
+        """p{hedge_percentile} of recent route latencies, or None until
+        `hedge_min_samples` successes have been seen (no hedging on a
+        cold or idle fleet — a made-up threshold would hedge every
+        request)."""
+        if self.cfg.hedge_percentile <= 0:
+            return None
+        with self._lock:
+            if len(self._lat_ring) < self.cfg.hedge_min_samples:
+                return None
+            arr = np.fromiter(self._lat_ring, dtype=np.float64)
+        return float(np.percentile(arr,
+                                   self.cfg.hedge_percentile)) / 1e3
+
+    def _try_replica_hedged(self, req, name, peer, budget,
+                            threshold_s):
+        """Hedged primary attempt: fire `name`, wait the latency
+        percentile, and if still unanswered fire ONE hedge to `peer`
+        under the SAME rid.  The replica-side dedup cache makes the
+        pair at-most-once on any single replica, and `_complete`'s
+        done-check makes delivery exactly-once across both.  First
+        answer wins; the loser is cancelled (`Engine.cancel` via
+        `_remote_cancel`) so its slot/pages/adapter rows come back
+        instead of decoding a stream nobody will read."""
+        from ..distributed import rpc
+        from .fleet import _remote_cancel, _remote_submit
+        t0 = time.monotonic()
+        fut1 = rpc.rpc_async(name, _remote_submit,
+                             args=self._submit_args(req, name),
+                             timeout=budget + 1.0)
+        fut1.add_done_callback(self._attempt_observer(name, t0))
+        done, _ = _futures_wait([fut1], timeout=threshold_s)
+        futs = {fut1: name}
+        hedge_fut = None
+        if not done:
+            left = budget - (time.monotonic() - t0)
+            if left > 0:
+                stats.incr("router.hedges")
+                t1 = time.monotonic()
+                hedge_fut = rpc.rpc_async(
+                    peer, _remote_submit,
+                    args=self._submit_args(req, peer),
+                    timeout=left + 1.0)
+                hedge_fut.add_done_callback(
+                    self._attempt_observer(peer, t1))
+                futs[hedge_fut] = peer
+        pending = set(futs)
+        primary_err = None
+        other_err = None
+        while pending:
+            # each attempt carries its own rpc timeout, so this wait
+            # always terminates; the outer timeout is a backstop
+            done, pending = _futures_wait(
+                pending, timeout=budget + 5.0,
+                return_when=FIRST_COMPLETED)
+            if not done:
+                break
+            for fut in done:
+                who = futs[fut]
+                try:
+                    exc = fut.exception()
+                except Exception as e:       # noqa: BLE001
+                    exc = e
+                exc = _as_transport_error(exc) if exc is not None \
+                    else None
+                if exc is None:
+                    self._complete(req, fut.result(), who)
+                    if fut is hedge_fut:
+                        stats.incr("router.hedge_wins")
+                    for loser, loser_name in futs.items():
+                        if loser is not fut and not loser.done():
+                            try:             # fire-and-forget cancel
+                                rpc.rpc_async(
+                                    loser_name, _remote_cancel,
+                                    args=(loser_name, req.rid),
+                                    timeout=self.cfg.rpc_timeout_s)
+                            except Exception:
+                                pass
+                    return None
+                if fut is fut1:
+                    primary_err = exc
+                else:
+                    other_err = exc
+        # both attempts failed (or the primary failed before a hedge
+        # fired): report the primary's error so the dispatch loop's
+        # spill/failover semantics match the unhedged path
+        if primary_err is not None:
+            return primary_err
+        if other_err is not None:
+            return other_err
+        return TimeoutError(
+            f"hedged attempt pair for {req.rid} did not resolve "
+            f"within {budget:.1f}s")
+
+    def _retry_allowed(self, req, err):
+        """Spend one fleet-wide retry-budget token for a resubmission;
+        an empty bucket fails the request loudly (no retry storm).
+        Unlimited when the budget knob is off."""
+        if self._retry_budget is None or self._retry_budget.take():
+            return True
+        stats.incr("router.retry_budget_exhausted")
+        self._fail(req, ServingError(
+            f"request {req.rid}: fleet retry budget exhausted "
+            f"({self.cfg.retry_budget_per_s:.1f}/s, burst "
+            f"{self.cfg.retry_budget_burst}); not amplifying the "
+            f"outage (last error: {err})"))
+        return False
+
+    def _healthy_median_locked(self, exclude=None):
+        """Median health score of ready, non-ejected replicas (the
+        canary's yardstick), or None when nothing has a score yet."""
+        vals = []
+        for n in self.ring.members:
+            if n == exclude or n in self._ejected:
+                continue
+            h = self._health.get(n)
+            s = h.score() if h is not None else None
+            if s is not None:
+                vals.append(s)
+        return float(np.median(vals)) if vals else None
+
+    def _guardian_tick(self):
+        """One watcher-cadence pass of the health guardian: publish
+        per-replica scores, eject robust-z outliers, and canary-probe
+        ejected replicas toward readmission."""
+        cfg = self.cfg
+        if not cfg.health_ejection:
+            return
+        now = time.monotonic()
+        probes = []
+        with self._lock:
+            ready = self.ring.members
+            # scores -> gauge (ejected replicas keep publishing so the
+            # recovery is visible on the dashboard)
+            scored = {}
+            for n in ready | set(self._ejected):
+                h = self._health.get(n)
+                s = h.score() if h is not None else None
+                if s is not None:
+                    scored[n] = s
+                    stats.health_observe(n, s)
+            # robust-z outlier ejection over warmed-up, still-in
+            # candidates
+            eligible = {
+                n: s for n, s in scored.items()
+                if n in ready and n not in self._ejected
+                and self._health[n].samples >= cfg.eject_min_samples}
+            # never eject past the fraction cap, and never the last
+            # standing replica
+            allowed = min(max(0, len(ready) - 1),
+                          int(cfg.eject_max_fraction * len(ready)))
+            if len(eligible) >= 2 and len(self._ejected) < allowed:
+                vals = sorted(eligible.values())
+                med = float(np.median(vals))
+                mad = float(np.median([abs(v - med) for v in vals]))
+                # MAD floor: an all-identical fleet (MAD 0) must not
+                # turn noise into ejections
+                scale = max(1.4826 * mad, 0.05 * med, 1.0)
+                for n, s in sorted(eligible.items(),
+                                   key=lambda kv: -kv[1]):
+                    if len(self._ejected) >= allowed:
+                        break
+                    if (s - med) / scale > cfg.eject_zscore:
+                        self._ejected[n] = {
+                            "since": now, "ok": 0,
+                            "last_probe": 0.0, "probing": False}
+                        stats.incr("router.ejections")
+            # due canaries (fired outside the lock)
+            for n, st in self._ejected.items():
+                if st["probing"]:
+                    continue
+                if now - st["last_probe"] < cfg.canary_interval_s:
+                    continue
+                st["probing"] = True
+                st["last_probe"] = now
+                probes.append(n)
+        for n in probes:
+            threading.Thread(target=self._canary_probe, args=(n,),
+                             name=f"canary-{n}", daemon=True).start()
+
+    def _canary_probe(self, name):
+        """One canary against an ejected replica: a real 1-token
+        generate through the full engine path (a connect-level ping
+        would pass right through an `engine_slow` gray failure).
+        Healthy = completed within the canary budget AND at a latency
+        comparable to the healthy fleet; `readmit_canaries` consecutive
+        healthy probes readmit the replica with a fresh health slate."""
+        from ..distributed import rpc
+        from .fleet import _remote_canary
+        cfg = self.cfg
+        t0 = time.monotonic()
+        ok, lat_ms = False, None
+        try:
+            res = rpc.rpc_sync(name, _remote_canary, args=(name,),
+                               timeout=cfg.canary_timeout_s)
+            lat_ms = float(res.get(
+                "latency_ms", (time.monotonic() - t0) * 1e3))
+            ok = True
+        except Exception:                    # noqa: BLE001
+            ok = False
+        with self._lock:
+            st = self._ejected.get(name)
+            if st is None:
+                return
+            st["probing"] = False
+            if ok:
+                med = self._healthy_median_locked(exclude=name)
+                # a 1-token canary is cheaper than a typical request,
+                # so "comparable" is generous: 3x the healthy median
+                # score (floor 100 ms); with no yardstick, finishing
+                # inside the canary budget counts
+                limit = max(3.0 * med, 100.0) if med is not None \
+                    else cfg.canary_timeout_s * 1e3
+                ok = lat_ms <= limit
+            if not ok:
+                st["ok"] = 0
+                return
+            st["ok"] += 1
+            if st["ok"] >= cfg.readmit_canaries:
+                del self._ejected[name]
+                self._health[name] = _ReplicaHealth()
+                stats.incr("router.readmissions")

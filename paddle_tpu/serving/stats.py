@@ -61,6 +61,20 @@ def route_observe(replica, role="mixed"):
     monitor.incr(ROUTER_PREFIX + "requests_routed_total")
 
 
+def health_observe(replica, score):
+    """Publish one replica's current health score (EWMA-latency-based,
+    error-inflated — serving/router.py `_ReplicaHealth`) as the
+    ``serving.router.replica_health_score{replica=...}`` gauge the
+    gray-failure dashboard plots against the ejection threshold."""
+    from ..observability import registry as _registry
+    _registry.gauge(ROUTER_PREFIX + "replica_health_score",
+                    "per-replica health score (EWMA latency ms, "
+                    "error-inflated); outliers vs the fleet median "
+                    "are ejected",
+                    labelnames=("replica",)) \
+        .labels(replica=str(replica)).set(float(score))
+
+
 def reset_serving_stats():
     """Clear every ``serving.*`` counter EXCEPT the router's (engine
     start does this so each engine run's snapshot is self-contained;
@@ -172,10 +186,29 @@ def declare_router_stats():
             ("resubmissions", "re-sends under the same idempotent id"),
             ("requests_recovered", "requests completed after >= 1 "
                                    "resubmission"),
-            ("replicas_lost", "replicas marked sticky-dead")):
+            ("replicas_lost", "replicas marked sticky-dead"),
+            ("ejections", "replicas ejected by the gray-failure "
+                          "guardian (health-score outliers; reversible, "
+                          "unlike sticky-dead)"),
+            ("readmissions", "ejected replicas readmitted after "
+                             "sustained canary recovery"),
+            ("hedges", "hedge requests fired past the latency "
+                       "percentile (same idempotent rid)"),
+            ("hedge_wins", "requests whose hedge answered before the "
+                           "primary attempt"),
+            ("breaker_open", "circuit-breaker closed->open transitions "
+                             "(per-replica rpc breakers)"),
+            ("retry_budget_exhausted", "resubmissions refused by the "
+                                       "fleet-wide token-bucket retry "
+                                       "budget")):
         _registry.counter(ROUTER_PREFIX + name, doc)
     _registry.gauge(ROUTER_PREFIX + "replicas_alive",
                     "ready replicas in the routing ring")
+    _registry.gauge(ROUTER_PREFIX + "replica_health_score",
+                    "per-replica health score (EWMA latency ms, "
+                    "error-inflated); outliers vs the fleet median "
+                    "are ejected",
+                    labelnames=("replica",))
     _registry.histogram(ROUTER_PREFIX + "route_latency_ms",
                         "submit-to-completion through the fleet (ms)")
 
@@ -261,6 +294,17 @@ def serving_stats():
     resubmission), ``router_replicas_alive``/``router_replicas_lost``,
     and ``router_route_latency_ms_avg`` (submit → completion through
     the fleet).
+
+    Gray-failure guardian quantities (ISSUE 17, zero with the guardian
+    off): ``router_ejections``/``router_readmissions`` (reversible
+    health-score ejections and canary readmissions),
+    ``router_hedges``/``router_hedge_wins`` (hedged dispatch),
+    ``router_breaker_open`` (circuit-breaker trips),
+    ``router_retry_budget_exhausted`` (token-bucket refusals), and
+    ``requests_cancelled`` (engine-side hedged-loser cancellations);
+    the per-replica ``replica_health_score{replica=...}`` gauge rides
+    the Prometheus exposition (gated by check_telemetry.py
+    --gray-failure).
     """
     s = monitor.all_stats()
 
@@ -338,4 +382,12 @@ def serving_stats():
         "router_replicas_alive": g("router.replicas_alive"),
         "router_replicas_lost": g("router.replicas_lost"),
         "router_route_latency_ms_avg": avg("router.route_latency_ms"),
+        "router_ejections": g("router.ejections"),
+        "router_readmissions": g("router.readmissions"),
+        "router_hedges": g("router.hedges"),
+        "router_hedge_wins": g("router.hedge_wins"),
+        "router_breaker_open": g("router.breaker_open"),
+        "router_retry_budget_exhausted": g(
+            "router.retry_budget_exhausted"),
+        "requests_cancelled": g("requests_cancelled"),
     }

@@ -69,6 +69,21 @@ KNOWN_POINTS = {
     "rpc_drop": {"to": str, "count": int, "once_file": str},
     "rpc_delay": {"to": str, "delay_s": float, "count": int,
                   "once_file": str},
+    # gray-failure drills (serving/router.py guardian, docs/RESILIENCE.md).
+    # Unlike rpc_drop/rpc_delay these model a replica that is SLOW but
+    # alive — the failure class health-scored ejection exists for.
+    # `rpc_slow` fires IN-CALL (rpc.rpc_sync, after the request went
+    # out): the caller experiences response latency on an already-
+    # connected worker, the call is still delivered exactly once.
+    # `engine_slow` fires once per scheduler iteration inside
+    # Engine._loop_once on replicas whose name contains `to` — a wedged
+    # GC / timeslice-starved host whose heartbeats stay perfectly
+    # healthy.  Both share the rpc points' `to`/`count`/`once_file`
+    # filter semantics.
+    "rpc_slow": {"to": str, "delay_s": float, "count": int,
+                 "once_file": str},
+    "engine_slow": {"to": str, "delay_s": float, "count": int,
+                    "once_file": str},
 }
 
 _IDENT = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
@@ -182,15 +197,20 @@ _RPC_STATE = {"raw": "", "counts": {}}
 
 
 def check_rpc(point, worker_name):
-    """Consult an armed ``rpc_drop``/``rpc_delay`` point for a CONNECT to
-    ``worker_name`` (the rpc client calls this before dialing, so an
-    injected failure can never masquerade as a possibly-delivered call).
-    Returns True when an armed ``rpc_drop`` says this connect must fail
-    — the caller raises ``ConnectionError`` — and False otherwise;
-    ``rpc_delay`` sleeps ``delay_s`` here and returns False.  Filters:
-    ``to`` = substring of the target worker name, ``count`` = max fires
-    (re-armed when the spec string changes), ``once_file`` = fire once
-    per path (the file is created on first fire)."""
+    """Consult an armed rpc/gray-failure point for ``worker_name``.
+    ``rpc_drop``/``rpc_delay`` fire at CONNECT time (the rpc client
+    calls this before dialing, so an injected failure can never
+    masquerade as a possibly-delivered call); ``rpc_slow`` fires
+    IN-CALL from ``rpc_sync`` after the request went out, and
+    ``engine_slow`` once per scheduler iteration from
+    ``Engine._loop_once`` (``worker_name`` is then the hosting
+    replica's name).  Returns True when an armed ``rpc_drop`` says this
+    connect must fail — the caller raises ``ConnectionError`` — and
+    False otherwise; the delay points sleep ``delay_s`` here and return
+    False.  Filters: ``to`` = substring of the target worker name,
+    ``count`` = max fires (re-armed when the spec string changes),
+    ``once_file`` = fire once per path (the file is created on first
+    fire)."""
     params = active(point)
     if params is None:
         return False
@@ -213,7 +233,7 @@ def check_rpc(point, worker_name):
             os.close(fd)
         except FileExistsError:
             return False
-    if point == "rpc_delay":
+    if point in ("rpc_delay", "rpc_slow", "engine_slow"):
         time.sleep(float(params.get("delay_s", 0.0)))
         return False
     return True

@@ -27,15 +27,39 @@ def backoff_delays(base=0.05, factor=2.0, max_delay=2.0, jitter=0.5,
         n += 1
 
 
+def decorrelated_delays(base=0.05, max_delay=2.0, tries=None, rng=None):
+    """Yield decorrelated-jitter sleep durations: each delay is
+    ``uniform(base, 3 * previous)`` capped at ``max_delay``.  Unlike the
+    multiplicative jitter of :func:`backoff_delays` (where every client
+    still clusters around ``base * factor**n``), successive delays carry
+    no shared schedule at all — a fleet of workers mass-reconnecting
+    after a store blip spreads across the whole window instead of
+    thundering-herding one replica in loose waves.  Infinite when
+    ``tries`` is None (callers bound by deadline)."""
+    draw = (rng.uniform if rng is not None else random.uniform)
+    prev = float(base)
+    n = 0
+    while tries is None or n < tries:
+        prev = min(float(max_delay), draw(float(base), prev * 3.0))
+        yield max(prev, 0.0)
+        n += 1
+
+
 def retry_call(fn, *args, tries=5, retry_on=(OSError,), base=0.05,
                factor=2.0, max_delay=2.0, jitter=0.5, deadline=None,
-               sleep=time.sleep, on_retry=None, **kwargs):
+               sleep=time.sleep, on_retry=None, decorrelated=False,
+               **kwargs):
     """Call ``fn(*args, **kwargs)``, retrying on ``retry_on`` exceptions
     with exponential backoff.  Gives up (re-raising the last exception)
     after ``tries`` attempts or once ``deadline`` (absolute time.time())
-    passes — whichever comes first."""
-    delays = backoff_delays(base=base, factor=factor, max_delay=max_delay,
-                            jitter=jitter)
+    passes — whichever comes first.  ``decorrelated=True`` swaps the
+    schedule for :func:`decorrelated_delays` (AWS-style decorrelated
+    jitter; ``factor``/``jitter`` are then ignored)."""
+    if decorrelated:
+        delays = decorrelated_delays(base=base, max_delay=max_delay)
+    else:
+        delays = backoff_delays(base=base, factor=factor,
+                                max_delay=max_delay, jitter=jitter)
     attempt = 0
     while True:
         try:

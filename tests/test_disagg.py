@@ -552,3 +552,88 @@ def test_config_validation():
     assert RouterConfig().disaggregation is False
     assert ReplicaConfig().migrate_on_drain is True
     assert ServingConfig().role == "mixed"
+
+
+# ------------------------------------------------------------------
+# deadline propagation across the migration path (ISSUE 17)
+# ------------------------------------------------------------------
+
+def test_deadline_propagates_through_migration(model):
+    """A client deadline bounds the WHOLE migrated request — prefill,
+    transfer, and the resumed decode on the target replica.  A generous
+    deadline rides through the handoff untouched; one that expires
+    while the (slowed) decode replica holds the request must surface
+    `DeadlineExceededError` instead of a late answer."""
+    from paddle_tpu.serving import DeadlineExceededError
+    from paddle_tpu.utils.flags import set_flags
+    specs = {"rep-p": ServingConfig(num_slots=2, role="prefill"),
+             "rep-d": ServingConfig(num_slots=4, role="decode")}
+    with _RoleFleet(model, specs) as f:
+        p = _prompts([6], seed=20)[0]
+        # warm both engines so compile time can't eat the deadline
+        f.router.submit(p, max_new_tokens=4,
+                        session_id="warm").result(timeout=300)
+        out = f.router.submit(p, max_new_tokens=5, deadline_s=60.0,
+                              session_id="ok").result(timeout=300)
+        np.testing.assert_array_equal(out.output_ids,
+                                      _ref_greedy(model, p, 5))
+        assert out.decoded_by == "rep-d"        # migrated AND bounded
+        # now stall the decode replica's scheduler (gray failure: its
+        # heartbeats stay healthy) so the resumed decode blows the
+        # propagated deadline on the FAR side of the migration
+        set_flags({"FLAGS_fault_inject":
+                   "engine_slow:to=rep-d,delay_s=0.4,count=200"})
+        try:
+            with pytest.raises(DeadlineExceededError):
+                f.router.submit(
+                    p, max_new_tokens=24, deadline_s=1.5,
+                    session_id="late").result(timeout=120)
+        finally:
+            set_flags({"FLAGS_fault_inject": ""})
+        # the evicted request released every page on BOTH replicas
+        deadline = time.monotonic() + 60
+        for name in ("rep-p", "rep-d"):
+            eng = f.reps[name].engine
+            while eng.cache.pages_in_use or eng._active:
+                assert time.monotonic() < deadline, \
+                    f"{name} leaked pages after deadline evict"
+                time.sleep(0.05)
+        assert serving_stats()["requests_evicted_deadline"] >= 1
+
+
+def test_mid_transfer_deadline_leaves_no_pages_on_either_side(model):
+    """The deadline expires DURING the page transfer (the migration rpc
+    itself is stalled in-call): wherever the request dies — evicted on
+    the target, or fallback-decoded past its deadline at the source —
+    it must resolve loudly and strand zero KV pages on either replica."""
+    from paddle_tpu.serving import DeadlineExceededError
+    from paddle_tpu.utils.flags import set_flags
+    specs = {"rep-p": ServingConfig(num_slots=2, role="prefill"),
+             "rep-d": ServingConfig(num_slots=4, role="decode")}
+    with _RoleFleet(model, specs) as f:
+        p = _prompts([7], seed=21)[0]
+        f.router.submit(p, max_new_tokens=4,
+                        session_id="warm").result(timeout=300)
+        # every rpc INTO rep-d now sleeps 2s in-call: the transfer
+        # straddles the 1.2s deadline
+        set_flags({"FLAGS_fault_inject":
+                   "rpc_slow:to=rep-d,delay_s=2.0,count=8"})
+        try:
+            with pytest.raises(DeadlineExceededError):
+                f.router.submit(
+                    p, max_new_tokens=16, deadline_s=1.2,
+                    session_id="midxfer").result(timeout=120)
+        finally:
+            set_flags({"FLAGS_fault_inject": ""})
+        deadline = time.monotonic() + 60
+        for name in ("rep-p", "rep-d"):
+            eng = f.reps[name].engine
+            while eng.cache.pages_in_use or eng._active:
+                assert time.monotonic() < deadline, \
+                    f"{name} leaked pages after mid-transfer deadline"
+                time.sleep(0.05)
+        # the fleet is fully serviceable afterwards
+        out = f.router.submit(p, max_new_tokens=4,
+                              session_id="after").result(timeout=300)
+        np.testing.assert_array_equal(out.output_ids,
+                                      _ref_greedy(model, p, 4))

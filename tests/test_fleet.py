@@ -180,7 +180,9 @@ def test_router_load_shedding_fails_fast(model):
                 assert out.finish_reason in ("length", "eos")
                 done += 1
             except QueueFullError as e:
-                assert e.retry_after_s == 0.7
+                # the hint starts at the knob and scales (up to 8x)
+                # with the router's recent shed pressure
+                assert 0.7 <= e.retry_after_s <= 0.7 * 8
                 shed += 1
         assert done + shed == 10
         assert shed >= 1, "10 requests into 2x(1 slot + 1 queue) must shed"

@@ -1,0 +1,530 @@
+"""Gray-failure guardian (ISSUE 17): health scoring + robust-z outlier
+ejection + canary readmission, hedged dispatch with exactly-once
+delivery and loser cancellation, per-replica circuit breakers, the
+fleet-wide retry budget, `Engine.cancel` resource release, the in-call
+`rpc_slow` / per-iteration `engine_slow` injection points, decorrelated
+reconnect jitter, and the flag-off identity guarantee (guardian
+disabled == PR 16 behavior).  The full live-fleet scenario matrix runs
+in tools/chaos_campaign.py (CI lane); these tests pin the mechanisms."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.models import GPTForCausalLM, gpt_config
+from paddle_tpu.serving import (Engine, ReplicaConfig, ReplicaServer,
+                                RequestCancelledError, RouterConfig,
+                                ServingConfig, ServingRouter,
+                                serving_stats)
+from paddle_tpu.serving.api import QueueFullError
+from paddle_tpu.serving.router import (_Breaker, _ReplicaHealth,
+                                       _RetryBudget, _as_transport_error)
+from paddle_tpu.utils import fault_injection as fi
+from paddle_tpu.utils.flags import set_flags
+from paddle_tpu.utils.retry import decorrelated_delays
+
+
+def _np(t):
+    return np.asarray(t._data_)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=2, hidden_size=64, num_heads=4,
+        vocab_size=256, max_seq_len=64))
+    m.eval()
+    return m
+
+
+def _prompts(lens, seed=0, vocab=256):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (n,)).astype("int32") for n in lens]
+
+
+def _ref_greedy(model, prompt, max_new):
+    ids = model.generate(paddle.to_tensor(prompt[None, :]),
+                         max_new_tokens=max_new, temperature=0.0)
+    return _np(ids)[0, prompt.size:]
+
+
+# ------------------------------------------------------------------
+# health score / breaker / retry budget units
+# ------------------------------------------------------------------
+
+def test_replica_health_score_ewma_and_error_inflation():
+    h = _ReplicaHealth()
+    assert h.score() is None                # unscored until observed
+    h.observe(0.5, 100.0, error=False)
+    assert h.score() == pytest.approx(100.0)   # seeded at first value
+    h.observe(0.5, 200.0, error=False)
+    assert h.score() == pytest.approx(150.0)
+    # a transport error inflates the score without touching latency
+    flaky = _ReplicaHealth()
+    flaky.observe(0.5, 100.0, error=True)
+    assert flaky.score() > 100.0
+    assert flaky.samples == 1
+
+
+def test_breaker_state_machine():
+    br = _Breaker()
+    now = 100.0
+    assert br.allow(now, cooldown_s=1.0)
+    # failures below the threshold keep it closed
+    assert not br.on_failure(now, 3, window_s=10.0, cooldown_s=1.0)
+    assert not br.on_failure(now + 0.1, 3, 10.0, 1.0)
+    assert br.state == "closed"
+    # the threshold-th failure inside the window trips it (True = the
+    # transition the caller counts)
+    assert br.on_failure(now + 0.2, 3, 10.0, 1.0)
+    assert br.state == "open"
+    assert not br.allow(now + 0.5, 1.0)     # cooling: calls skipped
+    # cooldown elapsed: exactly one half-open trial is admitted
+    assert br.allow(now + 1.3, 1.0)
+    assert br.state == "half"
+    assert not br.allow(now + 1.4, 1.0)     # trial already in flight
+    # a trial failure re-opens immediately (no window accounting)
+    assert br.on_failure(now + 1.5, 3, 10.0, 1.0)
+    assert br.state == "open"
+    # next trial succeeds -> recloses with a clean window
+    assert br.allow(now + 3.0, 1.0)
+    br.on_success()
+    assert br.state == "closed" and not br.fail_times
+
+
+def test_breaker_window_expires_old_failures():
+    br = _Breaker()
+    assert not br.on_failure(0.0, 2, window_s=1.0, cooldown_s=1.0)
+    # the first failure aged out of the window: no trip
+    assert not br.on_failure(5.0, 2, 1.0, 1.0)
+    assert br.state == "closed"
+
+
+def test_retry_budget_burst_and_refill():
+    b = _RetryBudget(rate=1000.0, burst=3)
+    assert [b.take() for _ in range(4)] == [True, True, True, False]
+    time.sleep(0.01)                        # 1000/s refills fast
+    assert b.take()
+
+
+def test_unknown_worker_coerced_to_transport_error():
+    e = _as_transport_error(ValueError("unknown worker 'rep-0'"))
+    assert isinstance(e, ConnectionError)
+    keep = ValueError("some other error")
+    assert _as_transport_error(keep) is keep
+
+
+def test_router_config_guardian_validation():
+    RouterConfig(health_ejection=True, hedge_percentile=95.0,
+                 breaker_failures=3, retry_budget_per_s=10.0).validate()
+    for bad in (dict(health_alpha=0.0), dict(health_alpha=1.5),
+                dict(eject_zscore=0.0), dict(eject_min_samples=0),
+                dict(eject_max_fraction=1.5),
+                dict(hedge_percentile=100.0),
+                dict(hedge_min_samples=0), dict(breaker_failures=-1),
+                dict(retry_budget_per_s=-1.0),
+                dict(readmit_canaries=0)):
+        with pytest.raises(ValueError):
+            RouterConfig(**bad).validate()
+
+
+# ------------------------------------------------------------------
+# fault-injection grammar + in-call seams
+# ------------------------------------------------------------------
+
+def test_gray_failure_fault_points_parse():
+    spec = fi.parse("rpc_slow:to=rep-0,delay_s=0.25,count=3;"
+                    "engine_slow:to=rep-1,delay_s=0.5,count=8")
+    assert spec["rpc_slow"] == {"to": "rep-0", "delay_s": 0.25,
+                                "count": 3}
+    assert spec["engine_slow"]["delay_s"] == 0.5
+    for bad in ("rpc_slow:delay_s=abc", "engine_slow:nope=1"):
+        with pytest.raises(ValueError):
+            fi.parse(bad)
+
+
+def test_rpc_slow_sleeps_and_respects_count_and_target():
+    set_flags({"FLAGS_fault_inject":
+               "rpc_slow:to=rep-0,delay_s=0.05,count=2"})
+    try:
+        t0 = time.monotonic()
+        assert fi.check_rpc("rpc_slow", "rep-0") is False   # slept
+        assert time.monotonic() - t0 >= 0.05
+        # wrong target: no sleep, no budget spent
+        t0 = time.monotonic()
+        assert fi.check_rpc("rpc_slow", "rep-1") is False
+        assert time.monotonic() - t0 < 0.05
+        fi.check_rpc("rpc_slow", "rep-0")                   # 2nd fire
+        t0 = time.monotonic()
+        fi.check_rpc("rpc_slow", "rep-0")                   # exhausted
+        assert time.monotonic() - t0 < 0.05
+    finally:
+        set_flags({"FLAGS_fault_inject": ""})
+
+
+def test_decorrelated_jitter_bounds():
+    rng = np.random.default_rng(0)
+
+    class _R:
+        def uniform(self, lo, hi):
+            return float(rng.uniform(lo, hi))
+
+    delays = list(decorrelated_delays(base=0.05, max_delay=2.0,
+                                      tries=64, rng=_R()))
+    assert len(delays) == 64
+    assert all(0.05 <= d <= 2.0 for d in delays)
+    # decorrelated: not a fixed multiplicative ladder
+    assert len({round(d, 6) for d in delays}) > 10
+
+
+# ------------------------------------------------------------------
+# router guardian units (real router object, no fleet)
+# ------------------------------------------------------------------
+
+@pytest.fixture()
+def bare_router():
+    """An unstarted router on a private store: `_dispatch` never runs,
+    so guardian internals can be driven directly."""
+    def make(**kw):
+        master = TCPStore(is_master=True)
+        router = ServingRouter(
+            TCPStore("127.0.0.1", master.port),
+            RouterConfig(**kw).validate())
+        router._chaos_master = master       # keep it alive
+        return router
+    routers = []
+
+    def factory(**kw):
+        r = make(**kw)
+        routers.append(r)
+        return r
+    yield factory
+    for r in routers:
+        r.close()
+        r._chaos_master.close()
+
+
+def test_guardian_off_is_inert(bare_router):
+    """Flag-off identity: with every guardian knob at its default the
+    observation hook is a no-op — no health state, no breakers, no
+    latency ring — and the candidate filter has nothing to block."""
+    r = bare_router()
+    assert r._guardian is False
+    r._observe_attempt("rep-0", 0.5, None)
+    r._observe_attempt("rep-0", 0.5, ConnectionError("x"))
+    assert not r._health and not r._breakers and not r._lat_ring
+    assert r._hedge_threshold_s() is None
+    r._guardian_tick()                      # health_ejection off: no-op
+    assert not r._ejected
+
+
+def test_observe_attempt_classification(bare_router):
+    r = bare_router(health_ejection=True, breaker_failures=3)
+    # success: latency sample + ring entry, breaker recloses
+    r._observe_attempt("a", 0.1, None)
+    assert r._health["a"].samples == 1 and len(r._lat_ring) == 1
+    # transport error: error-weighted sample + breaker failure
+    r._observe_attempt("a", 0.2, ConnectionError("snap"))
+    assert r._health["a"].samples == 2
+    assert r._health["a"].err_ewma > 0
+    assert len(r._breakers["a"].fail_times) == 1
+    assert len(r._lat_ring) == 1            # failures never enter ring
+    # backpressure is neutral: busy, not sick
+    r._observe_attempt("a", 0.3, QueueFullError("full"))
+    assert r._health["a"].samples == 2
+    # a hedged loser's cancellation is a LATENCY observation — without
+    # it, hedging would mask exactly the slow replica ejection hunts
+    r._observe_attempt("a", 2.0, RequestCancelledError("lost race"))
+    assert r._health["a"].samples == 3
+    assert r._health["a"].ewma_ms > 100.0
+
+
+def test_breaker_blocks_candidates_until_halfopen(bare_router):
+    r = bare_router(breaker_failures=2, breaker_window_s=10.0,
+                    breaker_cooldown_s=0.2)
+    r.ring.rebuild({"a", "b"})
+    from paddle_tpu.serving.router import _ReplicaView
+    for n in ("a", "b"):
+        r._replicas[n] = _ReplicaView(
+            {"name": n, "ip": "127.0.0.1", "port": 1, "gen": 0,
+             "state": "ready"})
+    req = type("R", (), {"session_key": "s", "adapter_id": None})()
+    for _ in range(2):
+        r._observe_attempt("a", 0.1, ConnectionError("snap"))
+    assert r._breakers["a"].state == "open"
+    out, _ = r._candidates(req)
+    assert out == ["b"]                     # open breaker: skipped
+    time.sleep(0.25)                        # cooldown: one trial admits
+    out, _ = r._candidates(req)
+    assert "a" in out
+    out, _ = r._candidates(req)             # trial in flight: blocked
+    assert out == ["b"]
+    r._observe_attempt("a", 0.1, None)      # trial succeeds: recloses
+    out, _ = r._candidates(req)
+    assert "a" in out
+
+
+def test_hedge_threshold_needs_warmup(bare_router):
+    r = bare_router(hedge_percentile=95.0, hedge_min_samples=4)
+    assert r._guardian is True
+    for _ in range(3):
+        r._observe_attempt("a", 0.1, None)
+    assert r._hedge_threshold_s() is None   # cold: no hedging
+    r._observe_attempt("a", 0.1, None)
+    assert r._hedge_threshold_s() == pytest.approx(0.1, rel=0.05)
+
+
+def test_guardian_tick_ejects_robust_z_outlier(bare_router):
+    r = bare_router(health_ejection=True, eject_zscore=3.0,
+                    eject_min_samples=4)
+    r.ring.rebuild({"a", "b", "c"})
+    for _ in range(6):
+        r._observe_attempt("a", 0.10, None)
+        r._observe_attempt("b", 0.11, None)
+        r._observe_attempt("c", 2.0, None)  # 20x outlier
+    r._guardian_tick()
+    assert set(r._ejected) == {"c"}
+    assert serving_stats()["router_ejections"] >= 1
+    # ejected: out of the candidate order, ring membership untouched
+    req = type("R", (), {"session_key": "s", "adapter_id": None})()
+    from paddle_tpu.serving.router import _ReplicaView
+    for n in ("a", "b", "c"):
+        r._replicas[n] = _ReplicaView(
+            {"name": n, "ip": "127.0.0.1", "port": 1, "gen": 0,
+             "state": "ready"})
+    out, _ = r._candidates(req)
+    assert "c" not in out and set(out) == {"a", "b"}
+    assert "c" in r.ring.members
+
+
+def test_guardian_tick_never_ejects_uniform_fleet(bare_router):
+    """MAD floor: an all-identical fleet must not turn noise into
+    ejections, and the fraction cap never ejects the last replica."""
+    r = bare_router(health_ejection=True, eject_min_samples=2)
+    r.ring.rebuild({"a", "b", "c"})
+    for _ in range(4):
+        for n in ("a", "b", "c"):
+            r._observe_attempt(n, 0.1, None)
+    r._guardian_tick()
+    assert not r._ejected
+    # two replicas: eject_max_fraction=0.5 allows 1; one replica: none
+    r2 = bare_router(health_ejection=True, eject_min_samples=2)
+    r2.ring.rebuild({"solo"})
+    for _ in range(4):
+        r2._observe_attempt("solo", 5.0, None)
+    r2._guardian_tick()
+    assert not r2._ejected
+
+
+def test_canary_readmission(bare_router, monkeypatch):
+    r = bare_router(health_ejection=True, readmit_canaries=2,
+                    canary_interval_s=0.01)
+    r.ring.rebuild({"a", "b"})
+    for _ in range(6):
+        r._observe_attempt("a", 0.1, None)
+        r._observe_attempt("b", 0.1, None)
+    r._ejected["a"] = {"since": 0.0, "ok": 0, "last_probe": 0.0,
+                       "probing": False}
+    calls = []
+
+    def fake_rpc_sync(name, fn, args=(), timeout=None):
+        calls.append(name)
+        if len(calls) == 1:
+            raise TimeoutError("canary still slow")
+        return {"latency_ms": 5.0}
+
+    monkeypatch.setattr("paddle_tpu.distributed.rpc.rpc_sync",
+                        fake_rpc_sync)
+    r._canary_probe("a")                    # fails: streak resets
+    assert r._ejected["a"]["ok"] == 0
+    r._canary_probe("a")
+    assert r._ejected["a"]["ok"] == 1
+    r._canary_probe("a")                    # 2nd consecutive: readmit
+    assert "a" not in r._ejected
+    assert r._health["a"].samples == 0      # fresh slate
+    assert serving_stats()["router_readmissions"] >= 1
+
+
+def test_retry_after_hint_scales_with_shed_pressure(bare_router):
+    r = bare_router(retry_after_s=1.0)
+    first = r._retry_after_hint()
+    assert first == pytest.approx(1.0)      # first shed: exact knob
+    hints = [r._retry_after_hint() for _ in range(10)]
+    assert hints[0] > first * 1.1           # pressure scales the hint
+    assert max(hints) <= 8.0                # capped at 8x
+    assert hints == sorted(hints)
+
+
+def test_retry_budget_exhaustion_fails_loudly(bare_router):
+    r = bare_router(retry_budget_per_s=0.001, retry_budget_burst=1)
+    from paddle_tpu.serving.router import _RoutedRequest
+    from paddle_tpu.serving import SamplingParams, ServingError
+    req = _RoutedRequest("rid-1", np.array([1], np.int32), 4,
+                         SamplingParams().validate(), None, None, "s")
+    assert r._retry_allowed(req, ConnectionError("x"))   # burst token
+    req2 = _RoutedRequest("rid-2", np.array([1], np.int32), 4,
+                          SamplingParams().validate(), None, None, "s")
+    assert not r._retry_allowed(req2, ConnectionError("x"))
+    with pytest.raises(ServingError, match="retry budget exhausted"):
+        req2.future.result(timeout=1)
+    assert serving_stats()["router_retry_budget_exhausted"] >= 1
+
+
+# ------------------------------------------------------------------
+# Engine.cancel: exactly-once resource release
+# ------------------------------------------------------------------
+
+def test_engine_cancel_queued_request(model):
+    eng = Engine(model, ServingConfig(num_slots=1, max_queue=8)).start()
+    try:
+        p1, p2 = _prompts([6, 7], seed=1)
+        base = serving_stats()["requests_cancelled"]
+        f1 = eng.submit(p1, max_new_tokens=16)
+        f2 = eng.submit(p2, max_new_tokens=4)    # queued behind f1
+        assert eng.cancel(f2.request_id) is True
+        with pytest.raises(RequestCancelledError):
+            f2.result(timeout=30)
+        # the survivor is untouched, bit-equal
+        np.testing.assert_array_equal(f1.result(timeout=180).output_ids,
+                                      _ref_greedy(model, p1, 16))
+        assert serving_stats()["requests_cancelled"] == base + 1
+        assert eng.cache.pages_in_use == 0
+    finally:
+        eng.shutdown()
+
+
+def test_engine_cancel_slot_resident_releases_pages(model):
+    eng = Engine(model, ServingConfig(num_slots=2)).start()
+    try:
+        p = _prompts([8], seed=2)[0]
+        fut = eng.submit(p, max_new_tokens=48)
+        deadline = time.monotonic() + 60
+        while eng.cache.pages_in_use == 0:       # wait until admitted
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        assert eng.cancel(fut.request_id) is True
+        with pytest.raises(RequestCancelledError):
+            fut.result(timeout=60)
+        deadline = time.monotonic() + 30
+        while eng.cache.pages_in_use or eng._active:
+            assert time.monotonic() < deadline, "cancel leaked pages"
+            time.sleep(0.01)
+        # the engine is fully reusable afterwards
+        out = eng.generate(p, max_new_tokens=4, timeout=180)
+        np.testing.assert_array_equal(out.output_ids,
+                                      _ref_greedy(model, p, 4))
+    finally:
+        eng.shutdown()
+
+
+def test_engine_cancel_unknown_or_done_is_false(model):
+    eng = Engine(model, ServingConfig(num_slots=1)).start()
+    try:
+        assert eng.cancel("no-such-rid") is False
+        p = _prompts([5], seed=3)[0]
+        fut = eng.submit(p, max_new_tokens=3)
+        fut.result(timeout=180)
+        assert eng.cancel(fut.request_id) is False   # already resolved
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------------------------
+# fleet integration: hedged dispatch + flag-off identity
+# ------------------------------------------------------------------
+
+_FAST = dict(heartbeat_interval_s=0.2, heartbeat_ttl_s=2.0)
+
+
+class _Fleet:
+    def __init__(self, model, names, router_kw=None):
+        self.master = TCPStore(is_master=True)
+        rcfg = ReplicaConfig(**_FAST).validate()
+        scfg = ServingConfig(num_slots=2, max_queue=32)
+        self.reps = {n: ReplicaServer(
+            n, model, TCPStore("127.0.0.1", self.master.port),
+            scfg, rcfg) for n in names}
+        self.router = ServingRouter(
+            TCPStore("127.0.0.1", self.master.port),
+            RouterConfig(heartbeat_ttl_s=2.0, poll_interval_s=0.1,
+                         **(router_kw or {}))).start()
+        deadline = time.monotonic() + 30
+        while len(self.router.ring.members) < len(names):
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.router.close()
+        for rep in self.reps.values():
+            rep.close()
+        self.master.close()
+
+
+def test_hedged_dispatch_first_answer_wins(model):
+    """A stalled primary past the latency percentile fires ONE hedge
+    under the same rid; the hedge answer wins bit-equal, the loser is
+    cancelled, and both engines drain back to idle — no double
+    execution visible anywhere."""
+    kw = dict(hedge_percentile=80.0, hedge_min_samples=4,
+              rpc_timeout_s=60.0)
+    with _Fleet(model, ["g-0", "g-1"], router_kw=kw) as f:
+        base = serving_stats()
+        prompts = _prompts([5, 6, 7, 5, 6, 7], seed=10)
+        for i, p in enumerate(prompts):     # warm the latency ring
+            f.router.generate(p, max_new_tokens=4,
+                              session_id=f"warm-{i}", timeout=180)
+        # primary for this session stalls per scheduler iteration;
+        # heartbeats stay healthy — a gray failure, not a death
+        sid = "hedge-probe"
+        primary = next(iter(f.router.ring.successors(sid)))
+        set_flags({"FLAGS_fault_inject":
+                   f"engine_slow:to={primary},delay_s=1.5,count=40"})
+        try:
+            p = _prompts([6], seed=11)[0]
+            t0 = time.monotonic()
+            out = f.router.generate(p, max_new_tokens=4,
+                                    session_id=sid, timeout=180)
+            hedged_latency = time.monotonic() - t0
+        finally:
+            set_flags({"FLAGS_fault_inject": ""})
+        np.testing.assert_array_equal(out.output_ids,
+                                      _ref_greedy(model, p, 4))
+        snap = serving_stats()
+        assert snap["router_hedges"] > base["router_hedges"]
+        assert snap["router_hedge_wins"] > base["router_hedge_wins"]
+        # the hedge answered long before the stalled primary could
+        assert hedged_latency < 60.0
+        assert snap["router_failovers"] == base["router_failovers"]
+        deadline = time.monotonic() + 30
+        for rep in f.reps.values():
+            while rep.engine.cache.pages_in_use or rep.engine._active:
+                assert time.monotonic() < deadline, "hedge leaked"
+                time.sleep(0.05)
+
+
+def test_default_config_keeps_guardian_off_in_fleet(model):
+    """Flag-off identity: a default-config fleet routes exactly as
+    before — no guardian state accrues, no guardian counter moves."""
+    with _Fleet(model, ["p-0", "p-1"]) as f:
+        assert f.router._guardian is False
+        base = serving_stats()
+        prompts = _prompts([5, 7], seed=12)
+        for i, p in enumerate(prompts):
+            out = f.router.generate(p, max_new_tokens=4,
+                                    session_id=i, timeout=180)
+            np.testing.assert_array_equal(out.output_ids,
+                                          _ref_greedy(model, p, 4))
+        snap = serving_stats()
+        for k in ("router_ejections", "router_readmissions",
+                  "router_hedges", "router_hedge_wins",
+                  "router_breaker_open",
+                  "router_retry_budget_exhausted"):
+            assert snap[k] == base[k], k
+        assert not f.router._health and not f.router._breakers
+        assert not f.router._ejected and not f.router._lat_ring

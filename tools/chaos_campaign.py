@@ -1,0 +1,502 @@
+#!/usr/bin/env python
+"""Seeded chaos campaign against a live thread-mode serving fleet
+(ISSUE 17).
+
+Reference capability: the reference proves its fleet layer with
+scripted failover drills; this runner generalizes them into a seeded
+CAMPAIGN: a reproducible sequence of fault episodes — gray failures
+(`rpc_slow`, `engine_slow`: slow-but-alive, heartbeats healthy),
+connect-time drops (`rpc_drop`), and abrupt replica loss (`kill`, the
+thread-mode SIGKILL analog: heartbeat stops and the rpc endpoint snaps
+mid-call with NO deregistration, so the router must detect it) — driven
+against a live 3-replica fleet with the gray-failure guardian armed
+(health ejection + hedged dispatch + breakers + retry budget).
+
+After EVERY episode the invariant auditors run:
+
+  * zero lost requests — every submitted future resolves;
+  * zero duplicates — outputs bit-equal to the clean greedy reference
+    (a double-decoded or torn stream cannot be bit-equal);
+  * pool-drain audit identical to an idle engine — every replica ends
+    the episode with no pending/queued/active requests and ZERO KV
+    pages in use (a hedge loser whose cancel leaked pages fails here);
+  * the fleet converges back to full membership (killed replicas
+    respawn under a bumped join generation).
+
+The whole campaign derives from ``--seed``: same seed, same episode
+sequence, same fault parameters, same prompts.  The summary JSON is
+schema-gated by ``tools/check_telemetry.py --campaign-summary`` and the
+guardian counters it leaves in the metrics registry by
+``--gray-failure`` (tools/run_ci.sh chaos lane).
+
+Usage:
+    python tools/chaos_campaign.py --seed 0 --episodes 20 \
+        --out /tmp/chaos_summary.json --episode-log /tmp/chaos_log.jsonl \
+        --prom-out /tmp/chaos.prom --ejection-drill
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+VOCAB = 256
+FAULT_KINDS = ("rpc_slow", "rpc_drop", "engine_slow", "kill")
+
+
+def make_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=2, hidden_size=64, num_heads=4,
+        vocab_size=VOCAB, max_seq_len=64))
+    m.eval()
+    return m
+
+
+class _RefCache:
+    """Clean-run greedy references, computed once per (prompt, len)."""
+
+    def __init__(self, model):
+        self.model = model
+        self._memo = {}
+
+    def get(self, prompt, max_new):
+        import paddle_tpu as paddle
+        key = (prompt.tobytes(), int(max_new))
+        if key not in self._memo:
+            ids = self.model.generate(
+                paddle.to_tensor(prompt[None, :]),
+                max_new_tokens=int(max_new), temperature=0.0)
+            self._memo[key] = np.asarray(
+                ids._data_)[0, prompt.size:]
+        return self._memo[key]
+
+
+class ChaosFleet:
+    """Thread-mode fleet under test: one TCPStore master, N mixed
+    replicas, and a guardian-armed router.  `kill()` emulates SIGKILL
+    (no drain, no deregister — the lease must expire and the socket
+    must snap); `respawn()` brings the victim back under a bumped join
+    generation, exactly like a relaunched process."""
+
+    def __init__(self, model, num_replicas=3):
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.serving import (ReplicaConfig, RouterConfig,
+                                        ReplicaServer, ServingConfig,
+                                        ServingRouter)
+        self.model = model
+        self.master = TCPStore(is_master=True)
+        self._scfg = ServingConfig(num_slots=2, max_queue=64)
+        # a generous lease: thread-mode replicas share one CPU with the
+        # router, the canaries, and XLA compiles — a 1.2s TTL turns a
+        # compile stall into a spurious (and sticky, by the anti-flap
+        # rejoin protocol) death of the whole fleet
+        self._rcfg = ReplicaConfig(heartbeat_interval_s=0.25,
+                                   heartbeat_ttl_s=3.0).validate()
+        self.reps = {}
+        for i in range(num_replicas):
+            self._spawn(f"rep-{i}")
+        self.router = ServingRouter(
+            TCPStore("127.0.0.1", self.master.port),
+            RouterConfig(
+                heartbeat_ttl_s=3.0, poll_interval_s=0.1,
+                rpc_timeout_s=60.0, retry_after_s=0.2,
+                health_ejection=True, health_alpha=0.3,
+                eject_zscore=3.0, eject_min_samples=4,
+                canary_interval_s=0.3, canary_timeout_s=10.0,
+                readmit_canaries=2,
+                hedge_percentile=95.0, hedge_min_samples=8,
+                breaker_failures=4, breaker_window_s=5.0,
+                breaker_cooldown_s=0.8,
+                retry_budget_per_s=20.0,
+                retry_budget_burst=40)).start()
+        self.wait_members(num_replicas)
+
+    def _spawn(self, name):
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.serving import ReplicaServer
+        self.reps[name] = ReplicaServer(
+            name, self.model, TCPStore("127.0.0.1", self.master.port),
+            self._scfg, self._rcfg)
+
+    def wait_members(self, n, timeout_s=30.0):
+        deadline = time.monotonic() + timeout_s
+        while len(self.router.ring.members) < n:
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"fleet never reached {n} members: "
+                    f"{self.router.replicas()}")
+            time.sleep(0.05)
+
+    def kill(self, name):
+        rep = self.reps[name]
+        rep._closed = True                  # make close() a no-op later
+        rep._stop.set()                     # heartbeat stops beating
+        rep._beat.join(5.0)
+        rep.rpc_server.close()              # in-flight calls snap
+        rep.engine.shutdown()               # free threads; NO drain,
+        #                                     NO deregister, lease left
+        #                                     to expire (SIGKILL analog)
+        from paddle_tpu.serving import fleet as fleet_mod
+        if fleet_mod._REPLICAS.get(name) is rep:
+            del fleet_mod._REPLICAS[name]
+
+    def respawn(self, name, timeout_s=30.0):
+        self._spawn(name)
+        deadline = time.monotonic() + timeout_s
+        while name not in self.router.ring.members:
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"{name} never rejoined: {self.router.replicas()}")
+            time.sleep(0.05)
+
+    def heal(self):
+        """Supervisor analog: bounce any replica the router has marked
+        dead while its server object is actually alive (a heartbeat
+        lease blip under CPU contention — sticky by the anti-flap
+        rejoin protocol, so without an external restart the fleet
+        shrinks permanently).  A bounce re-registers under a bumped
+        join generation, exactly the rejoin path a real supervisor
+        restart takes."""
+        healed = []
+        for name, state in sorted(self.router.replicas().items()):
+            rep = self.reps.get(name)
+            if state != "dead" or rep is None or \
+                    getattr(rep, "_closed", False):
+                continue
+            self.kill(name)
+            self.respawn(name)
+            healed.append(name)
+        return healed
+
+    def close(self):
+        self.router.close()
+        for rep in self.reps.values():
+            rep.close()
+        self.master.close()
+
+
+def _collect(fleet, futs, jobs, timeout_s, episode):
+    """Resolve every future, honoring shed backpressure
+    (``QueueFullError.retry_after_s`` -> sleep and resubmit).  Returns
+    (outputs, errors, lost) with one entry per submitted request."""
+    from paddle_tpu.serving import QueueFullError
+    outs, errors, lost = [], [], 0
+    for j, fut in enumerate(futs):
+        prompt, max_new = jobs[j]
+        deadline = time.monotonic() + timeout_s
+        for _ in range(16):
+            try:
+                outs.append(fut.result(
+                    timeout=max(0.1, deadline - time.monotonic())))
+                break
+            except QueueFullError as e:
+                hint = getattr(e, "retry_after_s", None) or 0.2
+                if time.monotonic() + hint >= deadline:
+                    outs.append(None)
+                    errors.append(f"req {j}: shed past deadline: {e!r}")
+                    lost += 1
+                    break
+                time.sleep(hint)
+                fut = fleet.router.submit(
+                    prompt, max_new_tokens=max_new,
+                    session_id=f"ep{episode}-{j}")
+            except Exception as e:          # noqa: BLE001
+                outs.append(None)
+                errors.append(f"req {j}: {e!r}")
+                lost += 1
+                break
+        else:
+            outs.append(None)
+            errors.append(f"req {j}: shed retries exhausted")
+            lost += 1
+    return outs, errors, lost
+
+
+def _audit_idle(fleet, skip=(), timeout_s=20.0):
+    """The pool-drain auditor: every live replica must end the episode
+    indistinguishable from an idle engine — nothing pending, nothing
+    queued, nothing in a slot, zero KV pages in use."""
+    leaks = []
+    deadline = time.monotonic() + timeout_s
+    for name, rep in sorted(fleet.reps.items()):
+        if name in skip:
+            continue
+        eng = rep.engine
+        while time.monotonic() < deadline:
+            busy = (len(getattr(eng, "_pending", ())) or
+                    len(getattr(eng, "_queue", ())) or
+                    len(getattr(eng, "_active", ())) or
+                    getattr(eng.cache, "pages_in_use", 0))
+            if not busy:
+                break
+            time.sleep(0.05)
+        pend = len(getattr(eng, "_pending", ()))
+        queue = len(getattr(eng, "_queue", ()))
+        active = len(getattr(eng, "_active", ()))
+        pages = getattr(eng.cache, "pages_in_use", 0)
+        if pend or queue or active or pages:
+            leaks.append(f"{name}: pending={pend} queue={queue} "
+                         f"active={active} pages_in_use={pages}")
+    return leaks
+
+
+def _fault_spec(kind, victim, rng):
+    if kind == "rpc_slow":
+        return (f"rpc_slow:to={victim},"
+                f"delay_s={float(rng.uniform(0.2, 0.4)):.3f},"
+                f"count={int(rng.integers(2, 5))}")
+    if kind == "engine_slow":
+        return (f"engine_slow:to={victim},"
+                f"delay_s={float(rng.uniform(0.15, 0.3)):.3f},"
+                f"count={int(rng.integers(4, 10))}")
+    if kind == "rpc_drop":
+        return f"rpc_drop:to={victim},count={int(rng.integers(1, 3))}"
+    return ""                               # kill needs no flag
+
+
+def run_episode(i, kind, fleet, refs, rng, args):
+    from paddle_tpu.utils.flags import set_flags
+    victim = str(rng.choice(sorted(fleet.reps)))
+    spec = _fault_spec(kind, victim, rng)
+    jobs = []
+    for _ in range(args.requests):
+        n = int(rng.integers(3, 10))
+        prompt = rng.integers(0, VOCAB, (n,)).astype("int32")
+        jobs.append((prompt, int(rng.integers(3, 7))))
+    t0 = time.monotonic()
+    killed = False
+    fleet.heal()                            # enter with a full fleet
+    set_flags({"FLAGS_fault_inject": spec})
+    try:
+        futs = [fleet.router.submit(p, max_new_tokens=m,
+                                    session_id=f"ep{i}-{j}")
+                for j, (p, m) in enumerate(jobs)]
+        if kind == "kill":
+            time.sleep(0.15)                # let load land first
+            fleet.kill(victim)
+            killed = True
+        outs, errors, lost = _collect(fleet, futs, jobs,
+                                      args.timeout_s, i)
+    finally:
+        set_flags({"FLAGS_fault_inject": ""})
+    if killed:
+        fleet.respawn(victim)
+    healed = fleet.heal()
+    mismatches = 0
+    for (prompt, max_new), out in zip(jobs, outs):
+        if out is None:
+            continue
+        if not np.array_equal(out.output_ids,
+                              refs.get(prompt, max_new)):
+            mismatches += 1
+    leaks = _audit_idle(fleet, skip=())
+    rec = {
+        "episode": i, "fault": kind, "victim": victim, "spec": spec,
+        "requests": len(jobs), "lost": lost, "mismatches": mismatches,
+        "leaks": leaks, "errors": errors, "healed": healed,
+        "wall_s": round(time.monotonic() - t0, 3),
+    }
+    rec["ok"] = not (lost or mismatches or leaks)
+    return rec
+
+
+def run_ejection_drill(fleet, refs, rng, args):
+    """The headline gray-failure scenario: `engine_slow` on 1-of-3
+    replicas (10x+ per-iteration stall, heartbeats perfectly healthy)
+    must trigger health-scored ejection; clearing the fault must bring
+    the replica back through canary readmission.  Latency p99 is
+    measured clean / ejected and must recover to <=1.5x the healthy
+    baseline once the victim is out of the candidate order."""
+    from paddle_tpu.serving import serving_stats
+    from paddle_tpu.utils.flags import set_flags
+
+    def p99(xs):
+        return float(np.percentile(np.asarray(xs), 99)) if xs else 0.0
+
+    seq = iter(range(10**9))
+
+    def round_trip(tag, n):
+        lats = []
+        for _ in range(n):
+            # session ids must stay unique across calls: reusing them
+            # would pin every round to the same ring subset and could
+            # starve the victim of the load the detector feeds on
+            j = next(seq)
+            prompt = rng.integers(0, VOCAB,
+                                  (int(rng.integers(3, 10)),)) \
+                .astype("int32")
+            t0 = time.monotonic()
+            out = fleet.router.generate(prompt, max_new_tokens=4,
+                                        session_id=f"{tag}-{j}",
+                                        timeout=args.timeout_s)
+            lats.append(time.monotonic() - t0)
+            assert np.array_equal(out.output_ids, refs.get(prompt, 4)), \
+                f"{tag}-{j}: output diverged from clean reference"
+        return lats
+
+    victim = sorted(fleet.reps)[0]
+    rec = {"victim": victim}
+    clean = round_trip("drill-clean", 24)
+    rec["p99_clean_s"] = round(p99(clean), 3)
+    # settle to steady state before arming the fault: first-request JIT
+    # compiles are slow enough to look like gray failures themselves —
+    # wait out any warmup ejection (canaries readmit it) and drop the
+    # warmup-contaminated EWMAs so detection is measured from clean
+    deadline = time.monotonic() + 60.0
+    while fleet.router._ejected:
+        if time.monotonic() >= deadline:
+            raise RuntimeError("warmup ejection never readmitted: "
+                               f"{dict(fleet.router._ejected)}")
+        time.sleep(0.1)
+    with fleet.router._lock:
+        fleet.router._health.clear()
+    base = serving_stats()
+    set_flags({"FLAGS_fault_inject":
+               f"engine_slow:to={victim},delay_s=0.5,count=10000"})
+    try:
+        # drive load until the guardian ejects the victim
+        deadline = time.monotonic() + 60.0
+        while serving_stats()["router_ejections"] == \
+                base["router_ejections"]:
+            if time.monotonic() >= deadline:
+                raise RuntimeError("guardian never ejected the "
+                                   "engine_slow victim")
+            round_trip("drill-load", 6)
+        rec["ejections"] = (serving_stats()["router_ejections"]
+                           - base["router_ejections"])
+        # with the victim out of the candidate order, p99 must recover
+        post = round_trip("drill-post", 24)
+        rec["p99_ejected_s"] = round(p99(post), 3)
+        limit = max(1.5 * p99(clean), p99(clean) + 0.25)
+        if p99(post) > limit:
+            raise RuntimeError(
+                f"p99 after ejection {p99(post):.3f}s did not recover "
+                f"to <=1.5x healthy baseline {p99(clean):.3f}s")
+    finally:
+        set_flags({"FLAGS_fault_inject": ""})
+    # fault cleared: canary probes must readmit the victim
+    deadline = time.monotonic() + 60.0
+    while serving_stats()["router_readmissions"] == \
+            base["router_readmissions"]:
+        if time.monotonic() >= deadline:
+            raise RuntimeError("canaries never readmitted the "
+                               "recovered victim")
+        time.sleep(0.1)
+    rec["readmissions"] = (serving_stats()["router_readmissions"]
+                          - base["router_readmissions"])
+    leaks = _audit_idle(fleet)
+    if leaks:
+        raise RuntimeError(f"ejection drill leaked: {leaks}")
+    return rec
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="seeded chaos campaign with invariant auditors")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--episodes", type=int, default=20)
+    ap.add_argument("--requests", type=int, default=6,
+                    help="requests per episode")
+    ap.add_argument("--timeout-s", type=float, default=60.0)
+    ap.add_argument("--out", help="summary JSON path")
+    ap.add_argument("--episode-log", help="per-episode JSONL path")
+    ap.add_argument("--prom-out",
+                    help="Prometheus dump path (guardian counter gate)")
+    ap.add_argument("--ejection-drill", action="store_true",
+                    help="run the engine_slow ejection/readmission "
+                         "scenario before the episode loop")
+    args = ap.parse_args(argv)
+
+    rng = np.random.default_rng(args.seed)
+    t_start = time.monotonic()
+    model = make_model()
+    refs = _RefCache(model)
+    fleet = ChaosFleet(model)
+    records = []
+    drill = None
+    try:
+        if args.ejection_drill:
+            drill = run_ejection_drill(fleet, refs, rng, args)
+            print(f"ejection drill OK: victim {drill['victim']} "
+                  f"ejected (p99 {drill['p99_clean_s']}s clean -> "
+                  f"{drill['p99_ejected_s']}s ejected) and readmitted")
+        # shuffled round-robin: every kind covered, order seeded
+        kinds = []
+        while len(kinds) < args.episodes:
+            batch = list(FAULT_KINDS)
+            rng.shuffle(batch)
+            kinds.extend(batch)
+        kinds = kinds[:args.episodes]
+        log_f = open(args.episode_log, "w") if args.episode_log \
+            else None
+        try:
+            for i, kind in enumerate(kinds):
+                rec = run_episode(i, kind, fleet, refs, rng, args)
+                records.append(rec)
+                if log_f:
+                    log_f.write(json.dumps(rec) + "\n")
+                    log_f.flush()
+                status = "ok" if rec["ok"] else "FAILED"
+                print(f"episode {i:2d} [{kind:>11s} -> "
+                      f"{rec['victim']}] {status}: "
+                      f"{rec['requests']} reqs, lost={rec['lost']}, "
+                      f"mismatches={rec['mismatches']}, "
+                      f"leaks={len(rec['leaks'])}, "
+                      f"{rec['wall_s']:.2f}s")
+        finally:
+            if log_f:
+                log_f.close()
+        from paddle_tpu.serving import serving_stats
+        snap = serving_stats()
+    finally:
+        fleet.close()
+    if args.prom_out:
+        import paddle_tpu.observability as obs
+        with open(args.prom_out, "w") as f:
+            f.write(obs.render_prometheus())
+    faults: dict = {}
+    for rec in records:
+        faults[rec["fault"]] = faults.get(rec["fault"], 0) + 1
+    summary = {
+        "schema_version": 1,
+        "seed": args.seed,
+        "episodes": len(records),
+        "faults": faults,
+        "requests": sum(r["requests"] for r in records),
+        "lost_requests": sum(r["lost"] for r in records),
+        "duplicate_requests": sum(r["mismatches"] for r in records),
+        "mismatches": sum(r["mismatches"] for r in records),
+        "leaks": sum(len(r["leaks"]) for r in records),
+        "failed_episodes": [r["episode"] for r in records
+                            if not r["ok"]],
+        "wall_s": round(time.monotonic() - t_start, 3),
+        "guardian": {k: snap[k] for k in (
+            "router_ejections", "router_readmissions",
+            "router_hedges", "router_hedge_wins",
+            "router_breaker_open", "router_retry_budget_exhausted",
+            "requests_cancelled")},
+    }
+    if drill is not None:
+        summary["ejection_drill"] = drill
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    ok = (not summary["failed_episodes"]
+          and summary["lost_requests"] == 0
+          and summary["mismatches"] == 0
+          and summary["leaks"] == 0)
+    print(f"chaos campaign {'OK' if ok else 'FAILED'}: "
+          f"{summary['episodes']} episodes, seed {args.seed}, "
+          f"{summary['wall_s']:.1f}s")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -374,6 +374,95 @@ def check_lora_exposition(series, typed):
     return errors
 
 
+_GRAY_FAILURE_COUNTERS = ("serving_router_ejections",
+                          "serving_router_readmissions",
+                          "serving_router_hedges",
+                          "serving_router_hedge_wins",
+                          "serving_router_breaker_open",
+                          "serving_router_retry_budget_exhausted")
+
+
+def check_gray_failure_exposition(series, typed):
+    """Schema gate for the gray-failure guardian telemetry (ISSUE 17):
+    the six ``serving.router.*`` guardian counters plus the per-replica
+    ``replica_health_score`` gauge must expose, correctly typed, from
+    router start.  A dashboard without these cannot distinguish a
+    healthy fleet from one where the guardian never ran — 'zero
+    ejections' must mean 'nothing was ejected', not 'nobody was
+    counting'."""
+    errors = []
+    for name in _GRAY_FAILURE_COUNTERS:
+        if name not in series:
+            errors.append(f"guardian counter {name!r} absent")
+        elif typed.get(name) != "counter":
+            errors.append(f"{name!r} typed {typed.get(name)!r}, "
+                          "expected counter")
+    gname = "serving_router_replica_health_score"
+    if typed.get(gname) != "gauge":
+        errors.append(f"{gname!r} absent or not a gauge")
+    else:
+        samples = series.get(gname, [])
+        unlabeled = [labels for labels, _ in samples
+                     if labels and "replica" not in labels]
+        if unlabeled:
+            errors.append(f"{gname!r} has samples labeled without a "
+                          f"'replica' key: {unlabeled[:3]}")
+    ejections = sum(float(v) for labels, v in
+                    series.get("serving_router_ejections", []))
+    if ejections > 0:
+        labeled = [labels for labels, _ in series.get(gname, [])
+                   if "replica" in labels]
+        if not labeled:
+            errors.append(f"{gname!r} has no replica-labeled samples "
+                          "despite recorded ejections")
+    return errors
+
+
+_CAMPAIGN_KEYS = {"schema_version": int, "seed": int, "episodes": int,
+                  "faults": dict, "requests": int, "lost_requests": int,
+                  "duplicate_requests": int, "mismatches": int,
+                  "leaks": int, "failed_episodes": list,
+                  "wall_s": (int, float)}
+
+
+def check_campaign_summary(path):
+    """Schema gate for a chaos-campaign summary JSON
+    (tools/chaos_campaign.py --out): the invariant ledger a CI lane
+    asserts on must itself be well-formed, carry every auditor's
+    verdict, and report the clean sweep explicitly."""
+    errors = []
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable campaign summary: {e}"]
+    for key, types in _CAMPAIGN_KEYS.items():
+        if key not in data:
+            errors.append(f"{path}: missing {key!r}")
+        elif not isinstance(data[key], types):
+            errors.append(f"{path}: {key!r} has type "
+                          f"{type(data[key]).__name__}")
+    if errors:
+        return errors
+    if data["schema_version"] != 1:
+        errors.append(f"{path}: schema_version {data['schema_version']}"
+                      " != 1")
+    if data["episodes"] < 1:
+        errors.append(f"{path}: no episodes ran")
+    for kind, n in data["faults"].items():
+        if not isinstance(n, int) or n < 0:
+            errors.append(f"{path}: faults[{kind!r}] not a count: {n!r}")
+    for key in ("lost_requests", "duplicate_requests", "mismatches",
+                "leaks"):
+        if data[key] != 0:
+            errors.append(f"{path}: {key} = {data[key]} (invariant "
+                          "violated)")
+    if data["failed_episodes"]:
+        errors.append(f"{path}: failed episodes: "
+                      f"{data['failed_episodes']}")
+    return errors
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--prometheus", help="Prometheus text dump to check")
@@ -402,6 +491,16 @@ def main():
                          "schema (serving.adapter.* counters + "
                          "adapter_load_ms histogram + per-adapter "
                          "routed counter) in the --prometheus dump")
+    ap.add_argument("--gray-failure", action="store_true",
+                    help="also gate the gray-failure guardian metric "
+                         "schema (ejections/readmissions/hedges/"
+                         "hedge_wins/breaker_open/"
+                         "retry_budget_exhausted counters + per-replica"
+                         " replica_health_score gauge) in the "
+                         "--prometheus dump")
+    ap.add_argument("--campaign-summary",
+                    help="chaos-campaign summary JSON to schema-gate "
+                         "(zero lost/duplicate/mismatch/leak required)")
     args = ap.parse_args()
     if args.router and not args.prometheus:
         ap.error("--router needs --prometheus")
@@ -411,10 +510,14 @@ def main():
         ap.error("--migration needs --prometheus")
     if args.lora and not args.prometheus:
         ap.error("--lora needs --prometheus")
+    if args.gray_failure and not args.prometheus:
+        ap.error("--gray-failure needs --prometheus")
     if not args.prometheus and not args.snapshots \
-            and not args.stall_dump and not args.sentinel_dump:
+            and not args.stall_dump and not args.sentinel_dump \
+            and not args.campaign_summary:
         ap.error("nothing to check: pass --prometheus, --snapshots, "
-                 "--stall-dump and/or --sentinel-dump")
+                 "--stall-dump, --sentinel-dump and/or "
+                 "--campaign-summary")
 
     failures = []
     if args.prometheus:
@@ -453,6 +556,21 @@ def main():
             if not lora_errors:
                 print("adapter exposition OK: full serving.adapter.* "
                       "schema + per-adapter routed counter present")
+        if args.gray_failure:
+            gf_errors = check_gray_failure_exposition(series, typed)
+            failures += gf_errors
+            if not gf_errors:
+                print("gray-failure exposition OK: guardian counters "
+                      "+ replica_health_score gauge present")
+    if args.campaign_summary:
+        errors = check_campaign_summary(args.campaign_summary)
+        failures += errors
+        if not errors:
+            with open(args.campaign_summary) as f:
+                summ = json.load(f)
+            print(f"campaign summary OK: seed={summ['seed']} "
+                  f"episodes={summ['episodes']} faults={summ['faults']}"
+                  f" zero lost/duplicate/mismatch/leak")
     if args.snapshots:
         n, errors = check_snapshots(args.snapshots)
         failures += errors

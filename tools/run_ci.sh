@@ -31,9 +31,17 @@ spec = fi.parse("ckpt_write:after_bytes=128,mode=raise;step:crash_at=3")
 assert spec["ckpt_write"]["after_bytes"] == 128
 assert spec["step"]["crash_at"] == 3
 
+# gray-failure points (ISSUE 17): in-call rpc stall + scheduler stall
+spec = fi.parse("rpc_slow:to=rep-0,delay_s=0.25,count=3;"
+                "engine_slow:to=rep-1,delay_s=0.5,count=8")
+assert spec["rpc_slow"]["to"] == "rep-0"
+assert spec["rpc_slow"]["delay_s"] == 0.25
+assert spec["engine_slow"]["count"] == 8
+
 # malformed specs must be rejected loudly, never silently inject nothing
 for bad in ("bogus:after_bytes=1", "ckpt_write", "ckpt_write:after_bytes",
-            "ckpt_write:after_bytes=xyz", "step:nope=1"):
+            "ckpt_write:after_bytes=xyz", "step:nope=1",
+            "rpc_slow", "rpc_slow:delay_s=abc", "engine_slow:nope=1"):
     try:
         fi.parse(bad)
     except fi.FaultSpecError:
@@ -313,6 +321,25 @@ echo "== serving fleet chaos drill (3 replicas, SIGKILL + SIGTERM mid-load) =="
 timeout -k 10 300 python benchmarks/serving_fleet_bench.py --smoke \
     --out /tmp/serving_fleet_ci.json
 python tools/check_bench_result.py /tmp/serving_fleet_ci.json
+
+echo "== gray-failure chaos campaign (seeded episodes + guardian ejection drill) =="
+# bounded: thread-mode 3-replica fleet, fixed seed, 20 episodes drawn
+# round-robin from {rpc_slow, rpc_drop, engine_slow, kill} plus the
+# engine_slow ejection/readmission drill (a 10x-slow replica must be
+# health-ejected, p99 must recover to <=1.5x the healthy baseline, and
+# the victim must be canary-readmitted once the fault clears).  The
+# runner exits nonzero on any lost/duplicate/mismatched request or
+# leaked KV page; the gates re-check the summary schema and the
+# guardian counter exposition.  Same --seed reproduces the identical
+# fault schedule.
+timeout -k 10 300 python tools/chaos_campaign.py --seed 0 --episodes 20 \
+    --requests 4 --ejection-drill \
+    --out /tmp/chaos_campaign_ci.json \
+    --episode-log /tmp/chaos_campaign_ci.jsonl \
+    --prom-out /tmp/chaos_campaign_ci.prom
+python tools/check_telemetry.py --campaign-summary /tmp/chaos_campaign_ci.json
+python tools/check_telemetry.py --prometheus /tmp/chaos_campaign_ci.prom \
+    --router --gray-failure
 
 echo "== serving fleet router + migration telemetry (thread-mode disagg fleet -> prometheus gate) =="
 python - <<'EOF'
