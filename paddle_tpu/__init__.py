@@ -133,6 +133,7 @@ from . import utils  # noqa: F401,E402
 from .utils.flags import set_flags, get_flags  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import observability  # noqa: F401,E402
+from . import data  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import static  # noqa: F401,E402

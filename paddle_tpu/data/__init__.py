@@ -1,0 +1,46 @@
+"""paddle_tpu.data — deterministic, checkpointable, device-overlapped
+input pipeline (reference capability: the DataLoader/Dataset/fleet
+dataset feeding layer; design lineage: tf.data [Murray et al., VLDB'21]
+and Google Grain's checkpointable-iterator contract).
+
+A pipeline is a pull-based chain of explicitly-ordered stages::
+
+    source -> shard(rank, dp_degree) -> shuffle(seeded, windowed)
+           -> map -> pack([B,S] with segment ids) -> batch
+           -> device_prefetch
+
+Three properties the thread-pool ``io.DataLoader`` cannot offer:
+
+* **Checkpointable** — every stage exposes ``state_dict()`` /
+  ``load_state_dict()`` holding only seeds, counters and window
+  positions (never buffer contents), so the whole iterator rides a
+  ``CheckpointManager`` checkpoint and ``Model.fit(resume=True)``
+  restarts mid-epoch bit-exactly — including on a *resized* world,
+  because shard state is a single global sample position that
+  re-shards to any dp degree.
+* **Device-overlapped** — ``device_prefetch`` double-buffers
+  ``jax.device_put`` (with ``NamedSharding`` over the active dp mesh
+  axis) so the next batch's host->device transfer overlaps the current
+  donated-buffer step.
+* **Goodput-accounted** — ``data.fetch_ms`` / ``data.prefetch_occupancy``
+  / ``data.starved_steps`` plus the ``data.input_bound`` gauge tell you
+  whether a run is input-bound or compute-bound, and the
+  ``data_slow`` / ``data_corrupt`` fault points let CI drill both.
+
+See docs/DATA.md for the stage contract and the resize-resume protocol.
+"""
+from .pipeline import (  # noqa: F401
+    CorruptRecordError,
+    Pipeline,
+    PipelineConfigError,
+    pipeline,
+)
+from .goodput import GoodputMeter  # noqa: F401
+
+__all__ = [
+    "CorruptRecordError",
+    "GoodputMeter",
+    "Pipeline",
+    "PipelineConfigError",
+    "pipeline",
+]

@@ -30,7 +30,9 @@ production guardrail for that class (docs/RESILIENCE.md):
    (``CheckpointManager.save_anchor`` — finiteness-validated at save,
    exempt from ``max_to_keep`` retention) and a replay in which the
    offending iterations are **quarantined**: the deterministic batch
-   order lets ``Model.fit`` fast-forward the loader and skip exactly
+   order lets ``Model.fit`` fast-forward the loader (a checkpointable
+   ``paddle_tpu.data.Pipeline`` is instead rewound onto the anchor's
+   recorded position, nothing to fast-forward past) and skip exactly
    the poisoned batches; (c) after ``FLAGS_sentinel_max_rollbacks``
    failed rollbacks the sentinel declares the anomaly persistent and
    stands down loudly instead of looping.
@@ -113,8 +115,10 @@ class SentinelError(RuntimeError):
 class RollbackDirective:
     """What ``Model.fit`` must do after the sentinel restored the
     anchor: rewind the iteration counter to ``it``, redo the epoch
-    ``epoch`` fast-forwarding batches before ``next_step``, and skip
-    quarantined iterations on the way."""
+    ``epoch`` fast-forwarding batches before ``next_step`` (a
+    checkpointable data pipeline is rewound onto the anchor position
+    instead, so ``next_step`` is 0 for it), and skip quarantined
+    iterations on the way."""
 
     __slots__ = ("it", "epoch", "next_step", "reason")
 

@@ -149,6 +149,12 @@ class ModelCheckpoint(Callback):
         opt = getattr(self.model, "_optimizer", None)
         if opt is not None:
             state["optimizer"] = opt.state_dict()
+        pipe = getattr(self.model, "_data_pipeline", None)
+        if pipe is not None:
+            # a few ints (epoch, global position, carry slot) — the
+            # whole input iterator resumes from this, mid-epoch, on
+            # any dp degree (docs/DATA.md)
+            state["data_pipeline"] = pipe.state_dict()
         if self.async_save:
             # snapshot: the background thread must not race the
             # donating compiled train step, which deletes the live

@@ -61,6 +61,9 @@ class Model:
         # global iteration fed to the sentinel fault-injection seams
         # (bad_batch / loss_spike / grad_bitflip); set by fit per step
         self._fi_step = None
+        # the active data.Pipeline train loader (set by fit): its
+        # position state rides ModelCheckpoint/sentinel snapshots
+        self._data_pipeline = None
 
     # ---- configuration ----
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -288,6 +291,11 @@ class Model:
     def _as_loader(self, data, batch_size, shuffle):
         if data is None or isinstance(data, DataLoader):
             return data
+        from ..data import Pipeline as _DataPipeline
+        if isinstance(data, _DataPipeline):
+            # a paddle_tpu.data pipeline carries its own shard/shuffle/
+            # batch stages and a checkpointable position — use as-is
+            return data
         if self._nranks > 1:
             # each launched worker reads only its shard (reference:
             # hapi fit builds a DistributedBatchSampler when nranks>1)
@@ -311,8 +319,15 @@ class Model:
         exit(ELASTIC_EXIT_CODE) so the launch controller relaunches into
         auto-resume (docs/FAULT_TOLERANCE.md)."""
         from .callbacks import ModelCheckpoint
+        from ..data import Pipeline as _DataPipeline
         loader = self._as_loader(train_data, batch_size, shuffle)
         eval_loader = self._as_loader(eval_data, batch_size, False)
+        # a checkpointable pipeline rides every checkpoint this fit
+        # writes (ModelCheckpoint._state) and is rewound by resume /
+        # sentinel rollback instead of being fast-forwarded O(steps)
+        self._data_pipeline = (loader
+                               if isinstance(loader, _DataPipeline)
+                               else None)
         try:
             steps = len(loader)
         except TypeError:
@@ -354,6 +369,8 @@ class Model:
         from ..observability import StepMetrics, maybe_start_exporter
         maybe_start_exporter()
         self.step_metrics = StepMetrics(prefix="train.")
+        if self._data_pipeline is not None:
+            self.step_metrics.attach_data(self._data_pipeline.goodput)
         flops_pending = True
 
         self.stop_training = False
@@ -372,6 +389,12 @@ class Model:
             replay_epoch, replay_from = None, -1
             while epoch < epochs:
                 cbs.call("on_epoch_begin", epoch)
+                sampler = getattr(loader, "batch_sampler", None)
+                if sampler is not None and hasattr(sampler, "set_epoch"):
+                    # epoch-folded reshuffle key: multi-epoch fit must
+                    # not replay one fixed order, and a RESUMED fit must
+                    # shuffle epoch N the way the uninterrupted run did
+                    sampler.set_epoch(epoch)
                 for m in self._metrics:
                     m.reset()
                 logs = {}
@@ -409,8 +432,10 @@ class Model:
                     cbs.call("on_train_batch_end", step, logs)
                     if handler is not None and handler.preempted():
                         # save at the step boundary, then request relaunch
-                        # — the restarted process redoes this epoch from
-                        # its start with the mid-epoch weights
+                        # — with a plain loader the restarted process
+                        # redoes this epoch from its start with the
+                        # mid-epoch weights; a data.Pipeline checkpoints
+                        # its position and resumes mid-epoch exactly
                         self._sync_compiled_state()
                         ckpt_cb.save_now(next_epoch=epoch)
                         ckpt_cb.manager.wait()
@@ -429,8 +454,12 @@ class Model:
                 if rollback is not None:
                     it = rollback.it
                     epoch = rollback.epoch
-                    replay_epoch, replay_from = (rollback.epoch,
-                                                 rollback.next_step)
+                    replay_epoch = rollback.epoch
+                    # a checkpointable pipeline was rewound onto the
+                    # anchor position by _sentinel_restore — there is
+                    # nothing to fast-forward past
+                    replay_from = (0 if self._data_pipeline is not None
+                                   else rollback.next_step)
                     continue           # redo from the anchor point
                 replay_epoch, replay_from = None, -1
                 if loss_t is not None:
@@ -517,6 +546,9 @@ class Model:
             state["optimizer"] = host(self._optimizer.state_dict())
         if self._scaler is not None:
             state["scaler"] = dict(self._scaler.state_dict())
+        pipe = getattr(self, "_data_pipeline", None)
+        if pipe is not None:
+            state["data_pipeline"] = pipe.state_dict()
         return state
 
     def _sentinel_restore(self, state):
@@ -538,6 +570,9 @@ class Model:
         if "rng_counter" in state:
             from ..core import state as _cstate
             _cstate.STATE.rng_counter = int(state["rng_counter"])
+        pipe = getattr(self, "_data_pipeline", None)
+        if pipe is not None and state.get("data_pipeline"):
+            pipe.load_state_dict(state["data_pipeline"])
 
     def _measure_step_flops(self, x):
         """Analytic FLOPs of one train step via the dispatch-funnel
@@ -623,6 +658,12 @@ class Model:
         self.network.set_state_dict(state["model"])
         if self._optimizer is not None and state.get("optimizer"):
             self._optimizer.set_state_dict(state["optimizer"])
+        pipe = getattr(self, "_data_pipeline", None)
+        if pipe is not None and state.get("data_pipeline"):
+            # O(1) mid-epoch rewind: the pipeline re-derives its buffers
+            # from (epoch, global position) — and because the position
+            # is global, the same state loads on a resized dp world
+            pipe.load_state_dict(state["data_pipeline"])
         return int(state.get("next_epoch", 0))
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

@@ -1,5 +1,5 @@
 from .dataset import Dataset, IterableDataset, TensorDataset, Subset, ConcatDataset, random_split  # noqa: F401
 from .sampler import Sampler, SequenceSampler, RandomSampler, BatchSampler, DistributedBatchSampler, WeightedRandomSampler  # noqa: F401
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import DataLoader, DataLoaderTimeoutError, DataLoaderWarning, default_collate_fn  # noqa: F401
 from .dataset import ChainDataset, ComposeDataset  # noqa: F401
 from .worker_info import get_worker_info, WorkerInfo  # noqa: F401

@@ -13,12 +13,52 @@ from __future__ import annotations
 
 import queue
 import threading
+import warnings
 
 import numpy as np
 
 from ..core.tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
+
+
+class DataLoaderTimeoutError(TimeoutError):
+    """``DataLoader(timeout=T)`` expired while waiting for a batch —
+    names the batch so a hung worker is attributable."""
+
+    def __init__(self, batch_index, timeout):
+        self.batch_index = int(batch_index)
+        self.timeout = float(timeout)
+        super().__init__(
+            f"DataLoader timed out after {timeout:g}s waiting for "
+            f"batch {batch_index}")
+
+
+class DataLoaderWarning(UserWarning):
+    """Typed warning for DataLoader args this loader accepts for
+    reference-API compatibility but does not implement."""
+
+
+_WARNED_ARGS = set()
+
+
+def _warn_unsupported(name, why):
+    if name in _WARNED_ARGS:
+        return
+    _WARNED_ARGS.add(name)
+    warnings.warn(f"DataLoader({name}=...) is not supported by the "
+                  f"TPU-native loader and is ignored: {why}",
+                  DataLoaderWarning, stacklevel=3)
+
+
+class _WorkerFailure:
+    """In-queue wrapper distinguishing a worker exception from a batch
+    that happens to BE an Exception instance."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
 
 
 def default_collate_fn(batch):
@@ -54,6 +94,14 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.shm_slot_size = 16 << 20  # 16 MiB per batch slot
         self.prefetch_factor = max(prefetch_factor, 2)
+        self.timeout = float(timeout or 0)
+        if self.timeout < 0:
+            raise ValueError(f"DataLoader(timeout={timeout}): must be >= 0")
+        if persistent_workers:
+            _warn_unsupported(
+                "persistent_workers",
+                "workers are per-epoch (threads are cheap; shm worker "
+                "processes rebind the dataset each epoch)")
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -167,10 +215,15 @@ class DataLoader:
         pending = {}
         try:
             for want in range(n_batches):
+                waited = 0.0
                 while want not in pending:
+                    poll = 5.0
+                    if self.timeout:
+                        poll = max(min(poll, self.timeout - waited), 0.01)
                     try:
-                        i, batch = out_q.get(timeout=5.0)
+                        i, batch = out_q.get(timeout=poll)
                     except TimeoutError:
+                        waited += poll
                         # fail fast only when the batch we are waiting on
                         # belongs to a crashed worker (batch i is produced
                         # by worker i % nw) — a worker that died AFTER
@@ -182,6 +235,8 @@ class DataLoader:
                                 f"unexpectedly (code {owner.exitcode}) "
                                 f"before delivering batch {want}; "
                                 f"see stderr")
+                        if self.timeout and waited >= self.timeout:
+                            raise DataLoaderTimeoutError(want, self.timeout)
                         continue
                     if i == "__worker_error__":
                         raise RuntimeError(
@@ -207,38 +262,95 @@ class DataLoader:
         return [self.dataset[i] for i in indices]
 
     def _iter_threaded(self):
-        """Prefetch with a worker thread pool + bounded queue (the
+        """Worker thread pool streaming through bounded queues (the
         reference's _DataLoaderIterMultiProcess shape, reference:
-        dataloader_iter.py:358)."""
-        index_queue = queue.Queue()
-        out_queues = {}
-        n_batches = 0
-        for i, indices in enumerate(self.batch_sampler):
-            index_queue.put((i, indices))
-            out_queues[i] = queue.Queue(maxsize=1)
-            n_batches += 1
+        dataloader_iter.py:358).
+
+        The batch sampler is consumed LAZILY by a feeder thread through
+        a queue bounded at ``num_workers * prefetch_factor`` — the old
+        implementation materialized the whole epoch's index list plus
+        one Queue per batch up front, O(epoch) memory before the first
+        batch.  Delivery stays in-order via a reorder buffer; a worker
+        exception is re-raised at its batch's position; ``timeout``
+        bounds the wait for each batch."""
+        nw = self.num_workers
+        window = nw * self.prefetch_factor
+        index_q = queue.Queue(maxsize=window)
+        out_q = queue.Queue(maxsize=window)
         stop = threading.Event()
+
+        def _put(q, item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def feeder():
+            try:
+                for item in enumerate(self.batch_sampler):
+                    if not _put(index_q, item):
+                        return
+            except Exception as e:  # sampler failure → consumer
+                _put(out_q, ("sampler_error", None, _WorkerFailure(e)))
+                return
+            for _ in range(nw):     # one end-marker per worker
+                if not _put(index_q, None):
+                    return
 
         def worker():
             while not stop.is_set():
                 try:
-                    i, indices = index_queue.get_nowait()
+                    item = index_q.get(timeout=0.1)
                 except queue.Empty:
+                    continue
+                if item is None:
+                    _put(out_q, ("done", None, None))
                     return
+                i, indices = item
                 try:
-                    out_queues[i].put(self._fetch(indices))
-                except Exception as e:  # propagate to consumer
-                    out_queues[i].put(e)
+                    _put(out_q, ("batch", i, self._fetch(indices)))
+                except Exception as e:  # re-raised at position i
+                    _put(out_q, ("batch", i, _WorkerFailure(e)))
 
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(self.num_workers)]
+        threads = [threading.Thread(target=feeder, daemon=True)]
+        threads += [threading.Thread(target=worker, daemon=True)
+                    for _ in range(nw)]
         for t in threads:
             t.start()
+        pending = {}
+        want = 0
+        done_workers = 0
+        waited = 0.0
+        poll = 0.2
         try:
-            for i in range(n_batches):
-                item = out_queues[i].get()
-                if isinstance(item, Exception):
-                    raise item
-                yield item
+            while True:
+                if want in pending:
+                    item = pending.pop(want)
+                    if isinstance(item, _WorkerFailure):
+                        raise item.exc
+                    yield item
+                    want += 1
+                    waited = 0.0
+                    continue
+                if done_workers == nw:
+                    # FIFO guarantees each worker's batches precede its
+                    # end-marker, so nothing is still in flight
+                    return
+                try:
+                    kind, i, payload = out_q.get(timeout=poll)
+                except queue.Empty:
+                    waited += poll
+                    if self.timeout and waited >= self.timeout:
+                        raise DataLoaderTimeoutError(want, self.timeout)
+                    continue
+                if kind == "done":
+                    done_workers += 1
+                elif kind == "sampler_error":
+                    raise payload.exc
+                else:
+                    pending[i] = payload
         finally:
             stop.set()

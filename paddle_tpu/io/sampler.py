@@ -23,21 +23,41 @@ class SequenceSampler(Sampler):
 
 
 class RandomSampler(Sampler):
+    """``seed=None`` (default) draws from the global numpy RNG exactly
+    as before; with a seed, each epoch permutes under the epoch-folded
+    key ``(seed, epoch)`` — deterministic across runs AND different per
+    epoch (``set_epoch`` is what a resumed fit uses to land on the same
+    epoch order the uninterrupted run had)."""
+
     def __init__(self, data_source, replacement=False, num_samples=None,
-                 generator=None):
+                 generator=None, seed=None):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch):
+        self.epoch = int(epoch)
 
     @property
     def num_samples(self):
         return self._num_samples or len(self.data_source)
 
+    def _rng(self):
+        if self.seed is None:
+            return np.random  # legacy path: byte-identical to before
+        return np.random.default_rng([int(self.seed), int(self.epoch)])
+
     def __iter__(self):
         n = len(self.data_source)
+        rng = self._rng()
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[:self.num_samples].tolist())
+            idx = rng.integers(0, n, self.num_samples) \
+                if rng is not np.random \
+                else np.random.randint(0, n, self.num_samples)
+            return iter(idx.tolist())
+        return iter(rng.permutation(n)[:self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples
@@ -61,15 +81,28 @@ class WeightedRandomSampler(Sampler):
 
 class BatchSampler(Sampler):
     def __init__(self, dataset=None, sampler=None, shuffle=False,
-                 batch_size=1, drop_last=False):
+                 batch_size=1, drop_last=False, seed=None):
         self.batch_size = batch_size
         self.drop_last = drop_last
+        self.epoch = 0
         if sampler is not None:
             self.sampler = sampler
         elif shuffle:
-            self.sampler = RandomSampler(dataset)
+            self.sampler = RandomSampler(dataset, seed=seed)
         else:
             self.sampler = SequenceSampler(dataset)
+
+    def set_epoch(self, epoch):
+        """Epoch-folded reshuffle key: hapi fit calls this at each
+        epoch begin so (a) multi-epoch training does not replay one
+        fixed order and (b) a resumed fit reproduces the order the
+        uninterrupted run used for that epoch.  A plain unseeded
+        sampler is unaffected (it already draws fresh global-RNG
+        permutations)."""
+        self.epoch = int(epoch)
+        inner = getattr(self.sampler, "set_epoch", None)
+        if inner is not None:
+            inner(epoch)
 
     def __iter__(self):
         batch = []
@@ -93,7 +126,7 @@ class DistributedBatchSampler(BatchSampler):
     python/paddle/io/dataloader/batch_sampler.py DistributedBatchSampler)."""
 
     def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
-                 shuffle=False, drop_last=False):
+                 shuffle=False, drop_last=False, seed=0):
         from ..distributed import env as dist_env
         self.dataset = dataset
         self.batch_size = batch_size
@@ -102,6 +135,7 @@ class DistributedBatchSampler(BatchSampler):
         self.local_rank = rank if rank is not None else dist_env.get_rank()
         self.shuffle = shuffle
         self.drop_last = drop_last
+        self.seed = int(seed)
         self.epoch = 0
         self.num_samples = int(np.ceil(len(dataset) / self.nranks))
         self.total_size = self.num_samples * self.nranks
@@ -109,7 +143,10 @@ class DistributedBatchSampler(BatchSampler):
     def __iter__(self):
         n = len(self.dataset)
         if self.shuffle:
-            rng = np.random.RandomState(self.epoch)
+            # epoch-folded key: identical on every rank (the shard
+            # split below needs one global order), pinned per epoch by
+            # set_epoch — standalone use keeps the legacy auto-advance
+            rng = np.random.RandomState(self.seed + self.epoch)
             indices = rng.permutation(n).tolist()
             self.epoch += 1
         else:

@@ -64,6 +64,13 @@ class StepMetrics:
         self.mfu = reg.gauge(
             prefix + "mfu", "achieved / peak FLOPs of the last step")
         self.steps = reg.counter(prefix + "steps_total", "steps completed")
+        # input-pipeline goodput (paddle_tpu.data.GoodputMeter): attached
+        # by fit when the train loader is a data.Pipeline, so one
+        # snapshot carries both sides of the host/device boundary
+        self._data_goodput = None
+
+    def attach_data(self, goodput):
+        self._data_goodput = goodput
 
     # ---- configuration ----
     def set_flops_per_step(self, flops):
@@ -153,6 +160,8 @@ class StepMetrics:
             else None,
         }
         snap["memory"] = sample_memory_watermarks(self.registry)
+        if self._data_goodput is not None:
+            snap["data"] = self._data_goodput.snapshot()
         return snap
 
 

@@ -84,6 +84,18 @@ KNOWN_POINTS = {
                  "once_file": str},
     "engine_slow": {"to": str, "delay_s": float, "count": int,
                     "once_file": str},
+    # input-pipeline goodput drills (paddle_tpu/data, docs/DATA.md).
+    # `data_slow` sleeps `delay_s` inside the record fetch (every
+    # `every`-th fetch call, default every fetch) — an overloaded
+    # storage host; it is what makes the `data.starved_steps` counter
+    # and the input-bound gauge move in CI.  `data_corrupt` makes the
+    # fetch of matching records raise — `at_sample` targets one dataset
+    # index, `every` poisons each index divisible by it — driving the
+    # skip-and-count path and the CorruptRecordError threshold.  Both
+    # honor a `count` total-fire budget (re-armed when the spec
+    # changes).
+    "data_slow": {"delay_s": float, "every": int, "count": int},
+    "data_corrupt": {"at_sample": int, "every": int, "count": int},
 }
 
 _IDENT = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
@@ -330,3 +342,68 @@ def corrupt_grads(optimizer, step):
     if hasattr(g, "at"):
         p.grad._data_ = g.at[(0,) * len(g.shape)].set(val)
     return True
+
+
+#: fetch-sequence counters + remaining-fire budgets for the data points
+#: (data_slow / data_corrupt); re-armed when the spec string changes.
+_DATA_STATE = {"raw": "", "counts": {}, "fetches": 0}
+
+
+def _data_point(point):
+    """Params for an armed data fault point with budget accounting, or
+    None.  One dict lookup when the flag is unset."""
+    params = active(point)
+    if params is None:
+        return None
+    raw = flag("FLAGS_fault_inject", "") or ""
+    if _DATA_STATE["raw"] != raw:
+        _DATA_STATE["raw"] = raw
+        _DATA_STATE["counts"] = {}
+        _DATA_STATE["fetches"] = 0
+    return params
+
+
+def _data_spend(point, params):
+    if "count" not in params:
+        return True
+    left = _DATA_STATE["counts"].get(point, params["count"])
+    if left <= 0:
+        return False
+    _DATA_STATE["counts"][point] = left - 1
+    return True
+
+
+def data_fetch_delay():
+    """The ``data_slow`` seam: the pipeline source calls this once per
+    record fetch.  An armed point sleeps ``delay_s`` (default 0.05) on
+    every ``every``-th fetch — a slow storage host, the drill behind
+    the starved-step counter and the input-bound gauge."""
+    params = _data_point("data_slow")
+    if params is None:
+        return
+    seq = _DATA_STATE["fetches"]
+    _DATA_STATE["fetches"] = seq + 1
+    if seq % max(params.get("every", 1), 1) != 0:
+        return
+    if not _data_spend("data_slow", params):
+        return
+    time.sleep(params.get("delay_s", 0.05))
+
+
+def data_record_corrupt(sample_id):
+    """The ``data_corrupt`` seam: True when the record at dataset index
+    ``sample_id`` should be treated as corrupt (the source raises and
+    takes its skip-and-count path).  Matching is on the *dataset
+    index*, so a resumed run re-skips the same records — determinism
+    survives the drill."""
+    params = _data_point("data_corrupt")
+    if params is None:
+        return False
+    sid = int(sample_id)
+    if "at_sample" in params:
+        if params["at_sample"] != sid:
+            return False
+    elif "every" in params:
+        if sid % max(params["every"], 1) != 0:
+            return False
+    return _data_spend("data_corrupt", params)

@@ -29,6 +29,7 @@ MODULES = [
     "paddle_tpu.audio.functional",
     "paddle_tpu.autograd",
     "paddle_tpu.cost_model",
+    "paddle_tpu.data",
     "paddle_tpu.device",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.fleet",

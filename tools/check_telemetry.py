@@ -418,6 +418,43 @@ def check_gray_failure_exposition(series, typed):
     return errors
 
 
+_DATA_COUNTERS = ("data_batches", "data_starved_steps")
+_DATA_GAUGES = ("data_prefetch_occupancy", "data_input_bound")
+
+
+def check_data_exposition(series, typed):
+    """Schema gate for the input-pipeline goodput telemetry (ISSUE 18):
+    the ``data.*`` family — ``fetch_ms`` histogram, consumed-batch and
+    starved-step counters, prefetch-occupancy and input-bound gauges —
+    must expose, correctly typed, whenever a pipeline served a fit.  A
+    dashboard that cannot see ``data_input_bound`` cannot tell a slow
+    model from a starved one — which is the question the goodput layer
+    exists to answer."""
+    errors = []
+    hname = "data_fetch_ms"
+    if typed.get(hname) != "histogram":
+        errors.append(f"{hname!r} absent or not a histogram")
+    elif hname + "_bucket" not in series:
+        errors.append(f"{hname!r} exposes no buckets")
+    for name in _DATA_COUNTERS:
+        if name not in series:
+            errors.append(f"data counter {name!r} absent")
+        elif typed.get(name) != "counter":
+            errors.append(f"{name!r} typed {typed.get(name)!r}, "
+                          "expected counter")
+    for name in _DATA_GAUGES:
+        if name not in series:
+            errors.append(f"data gauge {name!r} absent")
+        elif typed.get(name) != "gauge":
+            errors.append(f"{name!r} typed {typed.get(name)!r}, "
+                          "expected gauge")
+    for labels, v in series.get("data_input_bound", []):
+        if not 0.0 <= float(v) <= 1.0:
+            errors.append(f"data_input_bound sample {v!r} outside "
+                          "[0, 1]")
+    return errors
+
+
 _CAMPAIGN_KEYS = {"schema_version": int, "seed": int, "episodes": int,
                   "faults": dict, "requests": int, "lost_requests": int,
                   "duplicate_requests": int, "mismatches": int,
@@ -498,6 +535,11 @@ def main():
                          "retry_budget_exhausted counters + per-replica"
                          " replica_health_score gauge) in the "
                          "--prometheus dump")
+    ap.add_argument("--data", action="store_true",
+                    help="also gate the input-pipeline goodput metric "
+                         "schema (data.fetch_ms histogram + batch/"
+                         "starved counters + occupancy/input-bound "
+                         "gauges) in the --prometheus dump")
     ap.add_argument("--campaign-summary",
                     help="chaos-campaign summary JSON to schema-gate "
                          "(zero lost/duplicate/mismatch/leak required)")
@@ -512,6 +554,8 @@ def main():
         ap.error("--lora needs --prometheus")
     if args.gray_failure and not args.prometheus:
         ap.error("--gray-failure needs --prometheus")
+    if args.data and not args.prometheus:
+        ap.error("--data needs --prometheus")
     if not args.prometheus and not args.snapshots \
             and not args.stall_dump and not args.sentinel_dump \
             and not args.campaign_summary:
@@ -562,6 +606,13 @@ def main():
             if not gf_errors:
                 print("gray-failure exposition OK: guardian counters "
                       "+ replica_health_score gauge present")
+        if args.data:
+            data_errors = check_data_exposition(series, typed)
+            failures += data_errors
+            if not data_errors:
+                print("data exposition OK: fetch_ms histogram + "
+                      "batch/starved counters + occupancy/input-bound "
+                      "gauges present")
     if args.campaign_summary:
         errors = check_campaign_summary(args.campaign_summary)
         failures += errors

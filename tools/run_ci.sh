@@ -158,6 +158,41 @@ print(f"adapter smoke OK: {snap['adapters_loaded']} hot-loads, "
 EOF
 python tools/check_telemetry.py --prometheus /tmp/pt_lora_ci.prom --lora
 
+echo "== data pipeline bench (smoke: mid-epoch bit-exact resume, 4->2 resize audit, goodput drill) =="
+# bounded: calibrated input-heavy fit + resume/resize/goodput lanes,
+# ~2 min wall on CPU.  The >=1.3x prefetch-overlap floor applies only
+# on a parallel host (>= 2 cores); the 1-core CI box records the
+# speedup observationally and still gates bitwise resume, the
+# zero-loss resize, and the starvation telemetry.
+timeout -k 10 600 python benchmarks/data_pipeline_bench.py --smoke \
+    --out /tmp/data_pipeline_ci.json
+python tools/check_bench_result.py /tmp/data_pipeline_ci.json
+
+echo "== data pipeline goodput telemetry exposition =="
+timeout -k 10 300 python - <<'EOF'
+import numpy as np
+from paddle_tpu import data as D
+from paddle_tpu import observability as obs
+
+class DS:
+    def __len__(self):
+        return 64
+    def __getitem__(self, i):
+        return np.float32(i)
+
+pipe = D.pipeline(DS()).shard(0, 1).shuffle(seed=1).batch(8) \
+    .device_prefetch(2)
+n = sum(1 for _ in pipe)
+assert n == 8, n
+snap = pipe.goodput.snapshot()
+assert snap["batches"] == 8, snap
+with open("/tmp/pt_data_ci.prom", "w") as f:
+    f.write(obs.render_prometheus())
+print(f"data goodput smoke OK: {snap['batches']} batches, "
+      f"input_bound {snap['input_bound']:.2f}")
+EOF
+python tools/check_telemetry.py --prometheus /tmp/pt_data_ci.prom --data
+
 echo "== eager op-dispatch cache microbench (smoke + drift gate) =="
 python benchmarks/eager_overhead.py --smoke --out /tmp/eager_overhead_ci.json \
     --baseline benchmarks/EAGER_OVERHEAD.json
