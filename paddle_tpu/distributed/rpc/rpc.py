@@ -26,6 +26,8 @@ import time
 from concurrent.futures import Future
 from multiprocessing.connection import Listener, Client
 
+from ...observability import tracing as _trace
+
 
 class WorkerInfo:
     def __init__(self, name, rank, ip, port):
@@ -220,17 +222,26 @@ def _handle(conn):
                 return
             kind = msg[0]
             if kind == "call":
-                _, fn, args, kwargs = msg
+                # the envelope optionally carries a 5th trace-context
+                # slot (observability/tracing.py); tolerant unpack keeps
+                # old 4-tuples from peers without tracing working
+                _, fn, args, kwargs = msg[:4]
+                wire = msg[4] if len(msg) > 4 else None
                 try:
-                    result = fn(*args, **(kwargs or {}))
+                    with _trace.bind_wire(wire):
+                        result = fn(*args, **(kwargs or {}))
                     conn.send(("ok", result))
                 except Exception as e:  # serialize the failure
                     conn.send(("err", e))
             elif kind == "callraw":
                 # raw-bytes fast path: the pickled header carries
                 # _BlobSlot placeholders; each blob follows as one raw
-                # frame and re-enters the args as a received-side Blob
-                _, fn, args, kwargs, n_blobs = msg
+                # frame and re-enters the args as a received-side Blob.
+                # The optional trace slot rides the pickled header, so
+                # context crosses the fast path without touching the
+                # raw frames.
+                _, fn, args, kwargs, n_blobs = msg[:5]
+                wire = msg[5] if len(msg) > 5 else None
                 try:
                     blobs = [Blob(conn.recv_bytes())
                              for _ in range(n_blobs)]
@@ -240,7 +251,8 @@ def _handle(conn):
                     args = tuple(blobs[a.index]
                                  if isinstance(a, _BlobSlot) else a
                                  for a in args)
-                    result = fn(*args, **(kwargs or {}))
+                    with _trace.bind_wire(wire):
+                        result = fn(*args, **(kwargs or {}))
                     conn.send(("ok", result))
                 except Exception as e:  # serialize the failure
                     conn.send(("err", e))
@@ -360,12 +372,18 @@ def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
     c = _connect(to)
     try:
         plain, blobs = _extract_blobs(tuple(args or ()))
+        # optional trace-context envelope slot: None (tracing off, the
+        # default) keeps the wire format byte-identical to the pre-
+        # tracing 4/5-tuples
+        wire = _trace.current_wire()
         if blobs:
-            c.send(("callraw", fn, plain, kwargs, len(blobs)))
+            env = ("callraw", fn, plain, kwargs, len(blobs))
+            c.send(env if wire is None else env + (wire,))
             for b in blobs:
                 _send_blob(c, b)
         else:
-            c.send(("call", fn, plain, kwargs))
+            env = ("call", fn, plain, kwargs)
+            c.send(env if wire is None else env + (wire,))
         from ...utils import fault_injection as _fi
         if _fi.active("rpc_slow") is not None:
             t0 = time.monotonic()
@@ -402,11 +420,16 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
     bounds the remote wait exactly as in :func:`rpc_sync`; the Future
     then resolves with that ``TimeoutError``."""
     fut: Future = Future()
+    # capture the CALLER's trace context now: the worker thread below
+    # would otherwise read its own (empty) thread-local and the hedged-
+    # dispatch spans would lose their trace
+    wire = _trace.current_wire()
 
     def run():
         try:
-            fut.set_result(rpc_sync(to, fn, args=args, kwargs=kwargs,
-                                    timeout=timeout))
+            with _trace.bind_wire(wire):
+                fut.set_result(rpc_sync(to, fn, args=args, kwargs=kwargs,
+                                        timeout=timeout))
         except BaseException as e:
             fut.set_exception(e)
 

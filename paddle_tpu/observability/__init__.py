@@ -15,6 +15,11 @@ consumer layer over everything the framework already measures:
   watermarks; wired into ``hapi.Model.fit``.
 - :mod:`flight_recorder` — bounded ring of recent spans/events dumped
   on unhandled exceptions and on SIGTERM preemption.
+- :mod:`tracing` — fleet-wide distributed request tracing: per-request
+  ``TraceContext`` propagated across the rpc plane, per-hop spans with
+  dual clocks, tail-based sampling decided at root completion, atomic
+  JSONL spools merged by a fleet collector, Perfetto chrome-trace
+  export.  Off (``FLAGS_trace_dir`` empty) it costs one falsy check.
 
 See docs/OBSERVABILITY.md.
 """
@@ -32,3 +37,5 @@ from . import step_metrics  # noqa: F401
 from .step_metrics import StepMetrics, sample_memory_watermarks  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from .flight_recorder import FlightRecorder  # noqa: F401
+from . import tracing  # noqa: F401
+from .tracing import TraceContext, Span  # noqa: F401

@@ -21,6 +21,12 @@ import time
 from ..utils.flags import flag as _flag
 from . import registry as _registry
 
+# stamped on every snapshot line; tools/check_telemetry.py fails LOUDLY
+# (SnapshotSchemaError, the COMM_BUDGET BudgetSchemaError precedent) on
+# a line whose version it does not understand.  Bump on any change to
+# the line layout and teach the checker the new shape in the same PR.
+SNAPSHOT_SCHEMA_VERSION = 1
+
 
 class MetricsExporter:
     """Append a registry snapshot to ``path`` every ``interval_s``
@@ -53,7 +59,8 @@ class MetricsExporter:
             self._write_snapshot()
 
     def _write_snapshot(self):
-        rec = {"ts": time.time(), "pid": os.getpid()}
+        rec = {"schema_version": SNAPSHOT_SCHEMA_VERSION,
+               "ts": time.time(), "pid": os.getpid()}
         rec.update(self.registry.dump_json())
         try:
             with open(self.path, "a") as f:

@@ -50,7 +50,11 @@ class FlightRecorder:
     def record(self, kind, name, **data):
         if self.capacity <= 0:
             return
-        ev = {"ts": time.time(), "kind": kind, "name": name}
+        # both clocks on every event: wall time for humans, monotonic
+        # for post-mortem alignment of dumps from different replicas
+        # against merged traces (which carry the same clock pair)
+        ev = {"ts": time.time(), "mono": time.monotonic(),
+              "kind": kind, "name": name}
         if data:
             ev.update(data)
         with self._lock:

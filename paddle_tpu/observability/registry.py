@@ -92,6 +92,24 @@ class _Metric:
                 self._children[values] = child
             return child
 
+    def labels_lru(self, cap, *values, **kw):
+        """``labels()`` with LRU rotation: the touched child moves to
+        the MRU end of the family and, when the family holds more than
+        ``cap`` children, the least-recently-touched ones are dropped
+        (their series vanish from the exposition).  This bounds the
+        cardinality of per-request label families — a long-lived engine
+        otherwise grows one child per request forever.  ``cap <= 0``
+        disables rotation (plain ``labels()``)."""
+        child = self.labels(*values, **kw)
+        if cap is not None and cap > 0:
+            with self._lock:
+                key = getattr(child, "labelvalues", None)
+                if key in self._children:
+                    self._children.move_to_end(key)
+                while len(self._children) > cap:
+                    self._children.popitem(last=False)
+        return child
+
     def _child_kwargs(self):
         return {}
 

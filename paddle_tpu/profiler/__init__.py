@@ -1,7 +1,7 @@
 from .profiler import (  # noqa: F401
     Profiler, ProfilerTarget, ProfilerState, make_scheduler, RecordEvent,
     export_chrome_tracing, export_protobuf, load_profiler_result,
-    merge_chrome_traces,
+    merge_chrome_traces, write_chrome_trace,
 )
 from .timer import benchmark, TimerHub, mfu  # noqa: F401
 from ..ops.flops import FlopsCounter, count_flops  # noqa: F401

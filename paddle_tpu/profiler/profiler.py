@@ -291,11 +291,13 @@ class Profiler:
         self.stop()
 
 
-def _metadata_rows(events):
+def _metadata_rows(events, proc_names=None):
     """process_name/thread_name metadata events ("ph": "M") for every
     pid/tid a span references, so Perfetto/chrome://tracing shows
     labeled rows instead of bare numbers (the same labeling
-    merge_chrome_traces applies to its per-host bands)."""
+    merge_chrome_traces applies to its per-host bands).  ``proc_names``
+    optionally maps pid -> label (the tracing exporter labels rows with
+    replica names instead of raw pids)."""
     pids, tids = set(), set()
     for e in events:
         if e.get("ph") == "M":
@@ -305,9 +307,11 @@ def _metadata_rows(events):
     rows = []
     main_tid = threading.main_thread().ident
     main_tid = main_tid % 2 ** 31 if main_tid is not None else None
+    proc_names = proc_names or {}
     for pid in sorted(pids):
+        label = proc_names.get(pid, f"paddle_tpu host (pid {pid})")
         rows.append({"name": "process_name", "ph": "M", "pid": pid,
-                     "args": {"name": f"paddle_tpu host (pid {pid})"}})
+                     "args": {"name": label}})
     for pid, tid in sorted(tids):
         label = "main thread" if tid in (0, main_tid) else f"thread {tid}"
         rows.append({"name": "thread_name", "ph": "M", "pid": pid,
@@ -315,17 +319,26 @@ def _metadata_rows(events):
     return rows
 
 
-def export_chrome_tracing_data(prof: Profiler, path):
+def write_chrome_trace(events, path, metadata=None, proc_names=None):
+    """Write a chrome://tracing / Perfetto-loadable trace file: the
+    shared writer behind both the profiler export and the distributed-
+    tracing export (observability/tracing.py).  Prepends process/thread
+    metadata rows for every pid/tid the events reference."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    events = prof.events
-    trace = {"traceEvents": _metadata_rows(events) + events,
-             "displayTimeUnit": "ms",
-             "metadata": {"xplane_dir": prof._device_dir}}
+    trace = {"traceEvents": _metadata_rows(events, proc_names) + events,
+             "displayTimeUnit": "ms"}
+    if metadata is not None:
+        trace["metadata"] = metadata
     with open(path, "w") as f:
         json.dump(trace, f)
     return path
+
+
+def export_chrome_tracing_data(prof: Profiler, path):
+    return write_chrome_trace(prof.events, path,
+                              metadata={"xplane_dir": prof._device_dir})
 
 
 def export_chrome_tracing(dir_name, worker_name=None):

@@ -32,6 +32,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from . import stats
+from ..observability import tracing
 from ..utils import fault_injection as _fi
 from .api import (DeadlineExceededError, EngineShutdownError,
                   QueueFullError, RequestCancelledError, RequestOutput,
@@ -45,7 +46,7 @@ class _Request:
                  "ttft_ms", "tokens", "seen", "last_token", "slot",
                  "prefill_pos", "shared_len", "prefix_nodes",
                  "draft_prefill_pos", "first_tok", "handoff", "resume",
-                 "adapter_id", "adapter_slot")
+                 "adapter_id", "adapter_slot", "trace")
 
     def __init__(self, rid, prompt, max_new_tokens, sampling,
                  eos_token_id, deadline):
@@ -71,6 +72,45 @@ class _Request:
         self.resume = None          # migrated-page payload + prior state
         self.adapter_id = None      # LoRA adapter this request decodes
         self.adapter_slot = 0       # its pool slot (0 = base identity)
+        self.trace = None           # _ReqTrace holder (tracing armed)
+
+
+class _ReqTrace:
+    """Per-request span holder, existing only when ``FLAGS_trace_dir``
+    is set: the engine-side request span plus the phase spans hanging
+    off it (queue wait, chunked prefill, decode, migration transfer /
+    remote wait).  ``owns_root`` marks a request whose trace the ENGINE
+    minted (no upstream context on the rpc envelope): only that owner
+    ends the trace with a tail-sampling decision — routed requests
+    leave both the winner mark and the decision to the router."""
+
+    __slots__ = ("root", "queue", "prefill", "decode", "transfer",
+                 "remote", "owns_root")
+
+    def __init__(self, root, owns_root):
+        self.root = root
+        self.owns_root = owns_root
+        self.queue = None
+        self.prefill = None
+        self.decode = None
+        self.transfer = None
+        self.remote = None
+
+    def finish(self, status, latency_ms, **attrs):
+        """Terminal close: end every still-open phase span with the
+        request's outcome (``end`` is idempotent — already-closed spans
+        keep their own status), end the request span, and make the
+        tail-sampling decision iff this engine owns the root."""
+        for sp in (self.queue, self.prefill, self.decode,
+                   self.transfer, self.remote):
+            if sp is not None:
+                sp.end(status=status)
+        self.root.end(status=status,
+                      winner=True if self.owns_root and status == "ok"
+                      else None, **attrs)
+        if self.owns_root:
+            tracing.decide(self.root.ctx.trace_id, status=status,
+                           latency_ms=latency_ms)
 
 
 class Engine:
@@ -206,6 +246,7 @@ class Engine:
             stats.declare_tick_stats()
             stats.declare_migration_stats()
             stats.declare_adapter_stats()
+            stats.declare_trace_stats()
             self.cache = self._new_cache()
             self._tick = self._make_tick()
             self._max_active = 0
@@ -304,6 +345,8 @@ class Engine:
         # the loop's finally already failed everything; this covers a
         # shutdown() racing a never-started or crashed loop
         self._fail_all(EngineShutdownError("engine shut down"))
+        if tracing.enabled():
+            tracing.spool_now()     # crash-robust handoff to the collector
 
     def drain(self, deadline_s=None, migrate=False):
         """Graceful shutdown (the preemption/SIGTERM path): stop
@@ -443,6 +486,19 @@ class Engine:
             req.adapter_id = str(adapter_id)
         if handoff is not None and self._paged:
             req.handoff = handoff
+        if tracing.enabled():
+            # a routed request arrives on an rpc handler thread with the
+            # router's attempt span bound (distributed/rpc bind_wire) —
+            # the engine span is then a CHILD and the router keeps the
+            # sampling decision; with no upstream context (local
+            # clients) the engine mints the root and owns the decision
+            parent = tracing.current()
+            root = tracing.start_span(
+                "engine.request", parent=parent, rid=req.id,
+                prompt_tokens=int(prompt.size))
+            req.trace = _ReqTrace(root, owns_root=parent is None)
+            req.trace.queue = tracing.start_span(
+                "engine.queue", parent=root)
         with self._work:
             if not self._running:
                 raise EngineShutdownError(
@@ -532,6 +588,19 @@ class Engine:
         req.tokens = prior
         req.last_token = prior[-1]
         req.ttft_ms = ttft_ms
+        if tracing.enabled():
+            # the adopting side of a migration: handle_resume_begin
+            # binds the SENDER's transfer-span context before calling
+            # here, so the resumed decode parents the transfer span and
+            # the whole hop chain stays one trace
+            parent = tracing.current()
+            root = tracing.start_span(
+                "engine.request", parent=parent, rid=req.id,
+                resumed=True, prior_tokens=len(prior),
+                prompt_tokens=int(prompt.size))
+            req.trace = _ReqTrace(root, owns_root=parent is None)
+            req.trace.queue = tracing.start_span(
+                "engine.queue", parent=root)
         with self._work:
             if not self._running:
                 raise EngineShutdownError(
@@ -813,6 +882,13 @@ class Engine:
         from ..models.generation import init_kv_caches
         from ..profiler import RecordEvent
         from ..framework.capture import TRACE_LOCK
+        tr = req.trace
+        if tr is not None:
+            if tr.queue is not None:
+                tr.queue.end(slot=slot)
+            tr.prefill = tracing.start_span(
+                "engine.prefill", parent=tr.root, slot=slot,
+                prompt_tokens=int(req.prompt.size))
         t0 = time.monotonic()
         with RecordEvent("serving::prefill",
                          args={"request_id": req.id}):
@@ -835,6 +911,12 @@ class Engine:
         stats.incr("prefill_steps")
         req.slot = slot
         self._active[slot] = req
+        if tr is not None:
+            tr.prefill.event("first_token",
+                             ttft_ms=round(req.ttft_ms, 3))
+            tr.prefill.end()
+            tr.decode = tracing.start_span(
+                "engine.decode", parent=tr.root, slot=slot)
         self._append_token(req, tok)
         stats.set_value("active_slots", len(self._active))
 
@@ -933,6 +1015,20 @@ class Engine:
         req.slot = slot
         req.prefill_pos = req.shared_len
         req.first_tok = None
+        tr = req.trace
+        if tr is not None:
+            if tr.queue is not None:
+                tr.queue.end(slot=slot)
+            tr.prefill = tracing.start_span(
+                "engine.prefill", parent=tr.root, slot=slot,
+                prompt_tokens=int(req.prompt.size),
+                shared_len=req.shared_len)
+            if req.adapter_id is not None:
+                # the pool slot was pinned during admission (a cold
+                # adapter paid its hot-load there)
+                tr.prefill.event("adapter_acquire",
+                                 adapter_id=req.adapter_id,
+                                 pool_slot=req.adapter_slot)
         if self.adapter_pool is not None:
             # the slot's row of the persistent adapter-index vector now
             # points at this request's pool slot (0 for base requests);
@@ -987,6 +1083,11 @@ class Engine:
                 start = starts[row]
                 req.prefill_pos = min(start + chunk, plen)
                 self.cache.set_offset(req.slot, req.prefill_pos)
+                if req.trace is not None and \
+                        req.trace.prefill is not None:
+                    req.trace.prefill.event(
+                        "chunk", start=int(start),
+                        pos=int(req.prefill_pos))
                 if req.prefill_pos < plen:
                     continue
                 # prompt fully cached: sample the first token from the
@@ -1000,6 +1101,10 @@ class Engine:
                 req.ttft_ms = (time.monotonic() - req.submit_t) * 1e3
                 stats.observe("ttft_ms", req.ttft_ms)
                 stats.incr("prefill_steps")
+                if req.trace is not None and \
+                        req.trace.prefill is not None:
+                    req.trace.prefill.event(
+                        "first_token", ttft_ms=round(req.ttft_ms, 3))
                 if self.prefix_tree is not None:
                     self.prefix_tree.insert(req.prompt, self.cache,
                                             req.slot, req.prefix_nodes,
@@ -1043,6 +1148,13 @@ class Engine:
                 self._begin_migration(req)
                 continue
             self._active[req.slot] = req
+            tr = req.trace
+            if tr is not None:
+                if tr.prefill is not None:
+                    tr.prefill.end()
+                tr.decode = tracing.start_span(
+                    "engine.decode", parent=tr.root, slot=req.slot,
+                    spec=self._spec)
             self._append_token(req, tok)
         stats.set_value("active_slots", len(self._active))
 
@@ -1122,6 +1234,24 @@ class Engine:
         header, blobs = migration.export_slot(self.cache, req.slot)
         self._migrating_out[req.id] = req
         self._mut += 1          # slot left the active set: tick rebuilds
+        tr = req.trace
+        if tr is not None:
+            # close whatever phase the request was in (prefill handoff
+            # or drain-time mid-decode) and open the transfer span
+            # BEFORE the migrator runs: fleet._migration_meta ships
+            # THIS span's context in the meta dict, so the remote
+            # resumed decode parents the transfer hop
+            if tr.prefill is not None:
+                tr.prefill.end()
+            if tr.decode is not None:
+                tr.decode.end(status="migrated",
+                              tokens=len(req.tokens))
+                tr.decode = None
+            tr.transfer = tracing.start_span(
+                "engine.migrate", parent=tr.root,
+                target=str((req.handoff or {}).get("name")),
+                pages=int(header["num_pages"]),
+                tokens=len(req.tokens))
         stats.incr("migration.pages_sent", header["num_pages"])
         threading.Thread(
             target=self._migrate_async,
@@ -1136,26 +1266,40 @@ class Engine:
         decode holding NOTHING locally; a failure here (target died
         mid-decode) fails the future with `EngineShutdownError`, which
         the router answers with an idempotent resubmission."""
+        tr = req.trace
         t0 = time.monotonic()
         try:
             ack = self.migrator(req, header, blobs, target)
         except Exception as e:              # noqa: BLE001
             stats.observe("migration.migrate_ms",
                           (time.monotonic() - t0) * 1e3)
+            if tr is not None and tr.transfer is not None:
+                tr.transfer.end(status=type(e).__name__)
             self._post_migration(req, "fail", e)
             return
         stats.observe("migration.migrate_ms",
                       (time.monotonic() - t0) * 1e3)
+        if tr is not None and tr.transfer is not None:
+            tr.transfer.end()
         if self.migration_awaiter is None:
             # single-phase migrator (tests): phase 1 returned the result
             self._post_migration(req, "done", ack)
             return
         self._post_migration(req, "sent", None)
+        if tr is not None:
+            # phase 2 holds nothing locally — the span makes the remote
+            # decode wait attributable in the critical path
+            tr.remote = tracing.start_span(
+                "engine.remote_wait", parent=tr.root)
         try:
             payload = self.migration_awaiter(req, ack)
         except Exception as e:              # noqa: BLE001
+            if tr is not None and tr.remote is not None:
+                tr.remote.end(status=type(e).__name__)
             self._post_migration(req, "lost", e)
             return
+        if tr is not None and tr.remote is not None:
+            tr.remote.end()
         self._post_migration(req, "done", payload)
 
     def _post_migration(self, req, kind, val):
@@ -1192,6 +1336,16 @@ class Engine:
                 self._migrate_failed.add(req.id)
                 self._active[req.slot] = req
                 self._mut += 1
+                tr = req.trace
+                if tr is not None:
+                    # mid-transfer fallback: the failed transfer span
+                    # already closed with its error; the local decode
+                    # resumes under the SAME trace, marked as such
+                    tr.root.event("migration_fallback",
+                                  error=type(val).__name__)
+                    tr.decode = tracing.start_span(
+                        "engine.decode", parent=tr.root,
+                        slot=req.slot, fallback=True)
                 continue
             if kind == "lost":
                 stats.incr("migration.remote_failures")
@@ -1222,6 +1376,11 @@ class Engine:
             return
         stats.incr("requests_completed")
         stats.incr("migration.migrations")
+        if req.trace is not None:
+            req.trace.finish(
+                "ok", out.latency_ms,
+                finish_reason=payload["finish_reason"],
+                migrated_to=payload.get("replica"))
         from ..observability import flight_recorder as _fr
         _fr.record("serving", "request_done", request_id=req.id,
                    reason=payload["finish_reason"],
@@ -1242,6 +1401,13 @@ class Engine:
         req.resume = None
         self._active[slot] = req
         self._mut += 1
+        tr = req.trace
+        if tr is not None:
+            if tr.queue is not None:
+                tr.queue.end(slot=slot)
+            tr.decode = tracing.start_span(
+                "engine.decode", parent=tr.root, slot=slot,
+                resumed=True, prior_tokens=len(req.tokens))
         stats.incr("migration.resumed_requests")
         stats.set_value("active_slots", len(self._active))
 
@@ -1607,6 +1773,12 @@ class Engine:
         except Exception:       # lost the race to a concurrent _fail
             return
         stats.incr("requests_completed")
+        if req.trace is not None:
+            if req.trace.decode is not None:
+                req.trace.decode.set(tokens=len(req.tokens))
+            req.trace.finish("ok", out.latency_ms,
+                             finish_reason=reason,
+                             tokens=len(req.tokens))
         # labeled by the same request_id the span args carry, so one
         # request's trace and metrics can be joined post-hoc
         stats.request_observe("request_tokens", req.id, len(req.tokens),
@@ -1626,6 +1798,11 @@ class Engine:
             req.future.set_exception(exc)
         except Exception:       # resolved by a concurrent completer
             return
+        if req.trace is not None:
+            req.trace.finish(
+                type(exc).__name__,
+                (time.monotonic() - req.submit_t) * 1e3,
+                error=str(exc)[:200])
         from ..observability import flight_recorder as _fr
         _fr.record("serving", "request_failed", request_id=req.id,
                    error=type(exc).__name__)

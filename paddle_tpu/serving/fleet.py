@@ -53,6 +53,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..observability import tracing
 from .api import EngineShutdownError, SamplingParams, ServingConfig
 from .router import INFO_PREFIX, RouterConfig, ServingRouter
 
@@ -177,6 +178,16 @@ def _remote_await(replica_name, rid, timeout_s):
     return rep.handle_resume_await(rid, timeout_s)
 
 
+def _remote_spool_traces(replica_name):
+    """Trace-collector rpc target: flush this process's span ring to
+    its atomic spool file under ``FLAGS_trace_dir`` so the fleet
+    collector's merge sees everything recorded so far.  The span ring
+    is process-global, so this works regardless of how many replicas
+    the process hosts; returns the spool path (None when tracing is
+    off or nothing was recorded)."""
+    return {"replica": replica_name, "spool": tracing.spool_now()}
+
+
 def _open_store(spec):
     """("tcp", host, port) | ("file", dir) → TCPStore-shaped client."""
     from ..distributed.store import FileKVStore, TCPStore
@@ -238,6 +249,8 @@ class ReplicaServer:
         # name the engine for the `engine_slow` gray-failure point (the
         # `to=` filter targets one replica of a thread-mode fleet too)
         self.engine.fault_name = name
+        # label this process's trace spans/spool with the replica name
+        tracing.set_process_name(name)
         self.engine.start()
         # live KV-page migration: the engine exports/adopts pages; the
         # replica supplies the transport (rpc) + target selection
@@ -386,13 +399,19 @@ class ReplicaServer:
             fut = self._dedup.get(rid)
             if fut is None:
                 pages = migration.unpack(header, *blobs)
-                fut = self.engine.submit_resume(
-                    meta["prompt"], meta["tokens"], pages,
-                    max_new_tokens=meta["max_new_tokens"],
-                    sampling=SamplingParams(**(meta["sampling"] or {})),
-                    eos_token_id=meta["eos_token_id"],
-                    deadline_s=meta["deadline_s"],
-                    ttft_ms=meta["ttft_ms"])
+                # the sender's transfer-span context rides the meta
+                # dict (the Blob raw frames never carry it): bind it so
+                # the resumed request's spans stay on the SAME trace,
+                # parented under the transfer hop
+                with tracing.bind_wire(meta.get("trace")):
+                    fut = self.engine.submit_resume(
+                        meta["prompt"], meta["tokens"], pages,
+                        max_new_tokens=meta["max_new_tokens"],
+                        sampling=SamplingParams(
+                            **(meta["sampling"] or {})),
+                        eos_token_id=meta["eos_token_id"],
+                        deadline_s=meta["deadline_s"],
+                        ttft_ms=meta["ttft_ms"])
                 self._dedup[rid] = fut
                 while len(self._dedup) > self.cfg.dedup_results:
                     self._dedup.popitem(last=False)
@@ -414,7 +433,10 @@ class ReplicaServer:
                 "decoded_by": out.decoded_by or self.name}
 
     def _migration_meta(self, req):
+        tr = getattr(req, "trace", None)
         return {"prompt": req.prompt, "tokens": list(req.tokens),
+                "trace": tr.transfer.ctx.wire()
+                if tr is not None and tr.transfer is not None else None,
                 "max_new_tokens": req.max_new_tokens,
                 "sampling": {"temperature": req.sampling.temperature,
                              "top_k": req.sampling.top_k,
@@ -731,6 +753,39 @@ class ServingFleet:
 
     def stats(self):
         return self.router.stats()
+
+    # ---------------- distributed tracing ----------------
+    def collect_traces(self, out_path=None, chrome_path=None,
+                       timeout_s=10.0):
+        """Fleet trace collector: ask every live replica process to
+        flush its span ring to its atomic spool file, flush this
+        (router/client) process too, then merge every spool under
+        ``FLAGS_trace_dir`` into one document (optionally written as
+        JSON and/or exported as Perfetto-loadable chrome-trace JSON).
+        Best-effort by design: a dead or unreachable replica
+        contributes whatever it last spooled — engines also spool on
+        shutdown and every 64 tail-sampling decisions, so even a
+        SIGKILLed replica usually left most of its spans behind, and a
+        trace missing its tail is itself the post-mortem signal.
+        Returns the merged document, or None with tracing off."""
+        if not tracing.enabled():
+            return None
+        from ..distributed import rpc
+        for name, p in list(self._procs.items()):
+            if not p.is_alive():
+                continue
+            try:
+                rpc.rpc_sync(name, _remote_spool_traces, args=(name,),
+                             timeout=timeout_s)
+            except Exception:
+                continue        # merge picks up its last on-disk spool
+        tracing.spool_now()
+        merged = tracing.merge_spools()
+        if out_path:
+            tracing.write_merged(merged, out_path)
+        if chrome_path:
+            tracing.export_chrome(merged, chrome_path)
+        return merged
 
     # ---------------- chaos / elasticity ----------------
     def kill_replica(self, name, sig=signal.SIGKILL):

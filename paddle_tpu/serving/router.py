@@ -68,6 +68,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from . import stats
+from ..observability import tracing
 from .api import (DeadlineExceededError, EngineShutdownError,
                   NoReplicaError, QueueFullError,
                   RequestCancelledError, RequestOutput,
@@ -320,7 +321,8 @@ class _ReplicaView:
 class _RoutedRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "sampling",
                  "eos_token_id", "deadline", "session_key", "future",
-                 "submit_t", "attempts", "resubmits", "adapter_id")
+                 "submit_t", "attempts", "resubmits", "adapter_id",
+                 "trace")
 
     def __init__(self, rid, prompt, max_new_tokens, sampling,
                  eos_token_id, deadline, session_key, adapter_id=None):
@@ -336,6 +338,7 @@ class _RoutedRequest:
         self.submit_t = time.monotonic()
         self.attempts = 0                   # dispatch rounds
         self.resubmits = 0                  # re-sends after the first
+        self.trace = None                   # root Span (tracing armed)
 
 
 class _ReplicaHealth:
@@ -483,6 +486,9 @@ class ServingRouter:
             if self._running:
                 return self
             stats.reset_router_stats()
+            stats.declare_trace_stats()
+            if tracing.enabled():
+                tracing.set_process_name(self.name, default=True)
             self._running = True
         self._poll_membership()               # synchronous first view
         self._watcher = threading.Thread(
@@ -505,6 +511,13 @@ class ServingRouter:
                         "serving router closed"))
                 except Exception:
                     pass
+            if req.trace is not None:
+                req.trace.end(status="shutdown")
+                tracing.decide(
+                    req.trace.ctx.trace_id, status="shutdown",
+                    latency_ms=(time.monotonic() - req.submit_t) * 1e3)
+        if tracing.enabled():
+            tracing.spool_now()
         w = self._watcher
         if w is not None:
             w.join(5.0)
@@ -634,6 +647,14 @@ class ServingRouter:
             deadline, key,
             adapter_id=str(adapter_id) if adapter_id is not None
             else None)
+        if tracing.enabled():
+            # the router owns the ROOT span of a routed trace: it ends
+            # it in _complete/_fail and makes the one tail-sampling
+            # decision for the whole request (engine-side spans of a
+            # routed request are always children, never roots)
+            req.trace = tracing.start_span(
+                "router.request", rid=rid,
+                prompt_tokens=int(prompt.size))
         with self._lock:
             self._inflight[rid] = req
         threading.Thread(target=self._dispatch, args=(req,),
@@ -716,7 +737,7 @@ class ServingRouter:
         if req.adapter_id is not None:
             out.sort(key=lambda n: 0 if req.adapter_id in getattr(
                 views.get(n), "adapters", ()) else 1)
-        return out, skipped_full
+        return out, skipped_full, sorted(blocked)
 
     def _fail(self, req, exc):
         with self._lock:
@@ -726,8 +747,17 @@ class ServingRouter:
                 req.future.set_exception(exc)
             except Exception:
                 pass
+        if req.trace is not None:
+            status = type(exc).__name__
+            req.trace.end(status=status, error=str(exc)[:200])
+            tracing.decide(
+                req.trace.ctx.trace_id, status=status,
+                latency_ms=(time.monotonic() - req.submit_t) * 1e3)
 
     def _complete(self, req, payload, replica):
+        """Deliver one payload to the request future.  Returns True iff
+        THIS call won the exactly-once delivery (the caller marks its
+        attempt span as the trace's single winner on True)."""
         out = RequestOutput(
             request_id=req.rid, prompt_ids=req.prompt,
             output_ids=np.asarray(payload["output_ids"], np.int32),
@@ -739,15 +769,24 @@ class ServingRouter:
             self._inflight.pop(req.rid, None)
             view = self._replicas.get(replica)
         if req.future.done():            # at-most-once delivery
-            return
+            return False
         try:
             req.future.set_result(out)
         except Exception:
-            return
+            return False
         stats.route_observe(replica, view.role if view else "mixed")
         stats.observe("router.route_latency_ms", out.latency_ms)
         if req.resubmits:
             stats.incr("router.requests_recovered")
+        if req.trace is not None:
+            req.trace.end(status="ok",
+                          finish_reason=out.finish_reason,
+                          replica=replica,
+                          decoded_by=out.decoded_by,
+                          resubmits=req.resubmits)
+            tracing.decide(req.trace.ctx.trace_id, status="ok",
+                           latency_ms=out.latency_ms)
+        return True
 
     def _dispatch(self, req):
         cfg = self.cfg
@@ -766,9 +805,16 @@ class ServingRouter:
                     f"{time.monotonic() - req.submit_t:.3f}s at the "
                     "router"))
                 return
-            candidates, skipped_full = self._candidates(req)
+            candidates, skipped_full, blocked = self._candidates(req)
+            if req.trace is not None:
+                req.trace.event("candidates", order=list(candidates),
+                                skipped_full=skipped_full,
+                                blocked=blocked)
             if not candidates:
                 if skipped_full:
+                    if req.trace is not None:
+                        req.trace.event("shed",
+                                        skipped_full=skipped_full)
                     self._shed(req)
                     return
                 # no ready replica AT ALL: wait for the fleet (warming
@@ -802,6 +848,8 @@ class ServingRouter:
                 if err is None:
                     return                       # delivered
                 if isinstance(err, QueueFullError):
+                    if req.trace is not None:
+                        req.trace.event("spill", replica=name)
                     continue                     # spill to successor
                 if isinstance(err, EngineShutdownError):
                     # draining/stopped: resubmit elsewhere — counted
@@ -813,6 +861,9 @@ class ServingRouter:
                     stats.incr("router.resubmissions")
                     req.resubmits += 1
                     req.attempts += 1
+                    if req.trace is not None:
+                        req.trace.event("resubmit", replica=name,
+                                        reason="drain_bounce")
                     all_full = False
                     if req.attempts > cfg.max_resubmits:
                         self._fail(req, ServingError(
@@ -824,6 +875,9 @@ class ServingRouter:
                 if isinstance(err, (ConnectionError, OSError)):
                     self._mark_dead(name)
                     stats.incr("router.failovers")
+                    if req.trace is not None:
+                        req.trace.event("failover", replica=name,
+                                        reason="transport")
                     if not self._retry_allowed(req, err):
                         return
                     stats.incr("router.resubmissions")
@@ -850,6 +904,9 @@ class ServingRouter:
                         return
                     self._mark_dead(name)
                     stats.incr("router.failovers")
+                    if req.trace is not None:
+                        req.trace.event("failover", replica=name,
+                                        reason="timeout_dead")
                     if not self._retry_allowed(req, err):
                         return
                     stats.incr("router.resubmissions")
@@ -866,6 +923,8 @@ class ServingRouter:
                 self._fail(req, err)             # app-level error
                 return
             if all_full:
+                if req.trace is not None:
+                    req.trace.event("shed", all_full=True)
                 self._shed(req)
                 return
             # unsuccessful round that wasn't a shed: give the watcher
@@ -943,18 +1002,31 @@ class ServingRouter:
             if threshold_s is not None and threshold_s < budget:
                 return self._try_replica_hedged(
                     req, name, hedge_peer, budget, threshold_s)
+        span = None
+        if req.trace is not None:
+            span = tracing.start_span(
+                "router.attempt", parent=req.trace,
+                replica=name, attempt=req.attempts)
         t0 = time.monotonic()
         try:
-            payload = rpc.rpc_sync(
-                name, _remote_submit,
-                args=self._submit_args(req, name),
-                timeout=budget + 1.0)
+            # bind the attempt span so rpc_sync attaches its wire form
+            # to the call envelope — the replica's engine spans parent
+            # under THIS attempt, not the root
+            with tracing.bind(span):
+                payload = rpc.rpc_sync(
+                    name, _remote_submit,
+                    args=self._submit_args(req, name),
+                    timeout=budget + 1.0)
         except Exception as e:               # noqa: BLE001
             e = _as_transport_error(e)
             self._observe_attempt(name, time.monotonic() - t0, e)
+            if span is not None:
+                span.end(status=type(e).__name__)
             return e
         self._observe_attempt(name, time.monotonic() - t0, None)
-        self._complete(req, payload, name)
+        won = self._complete(req, payload, name)
+        if span is not None:
+            span.end(status="ok", winner=won)
         return None
 
     # ---------------- gray-failure guardian ----------------
@@ -1032,11 +1104,22 @@ class ServingRouter:
         instead of decoding a stream nobody will read."""
         from ..distributed import rpc
         from .fleet import _remote_cancel, _remote_submit
+        spans = {}                           # future -> attempt Span
+        span1 = None
+        if req.trace is not None:
+            span1 = tracing.start_span(
+                "router.attempt", parent=req.trace,
+                replica=name, attempt=req.attempts, hedged="primary")
         t0 = time.monotonic()
-        fut1 = rpc.rpc_async(name, _remote_submit,
-                             args=self._submit_args(req, name),
-                             timeout=budget + 1.0)
+        # rpc_async captures the caller's thread-bound context at CALL
+        # time, so each attempt's wire context is its own span — both
+        # hedge arms stay under the SAME trace, each as its own child
+        with tracing.bind(span1):
+            fut1 = rpc.rpc_async(name, _remote_submit,
+                                 args=self._submit_args(req, name),
+                                 timeout=budget + 1.0)
         fut1.add_done_callback(self._attempt_observer(name, t0))
+        spans[fut1] = span1
         done, _ = _futures_wait([fut1], timeout=threshold_s)
         futs = {fut1: name}
         hedge_fut = None
@@ -1044,14 +1127,25 @@ class ServingRouter:
             left = budget - (time.monotonic() - t0)
             if left > 0:
                 stats.incr("router.hedges")
+                hedge_span = None
+                if req.trace is not None:
+                    req.trace.event("hedge", primary=name, peer=peer,
+                                    threshold_ms=round(
+                                        threshold_s * 1e3, 3))
+                    hedge_span = tracing.start_span(
+                        "router.attempt", parent=req.trace,
+                        replica=peer, attempt=req.attempts,
+                        hedged="hedge")
                 t1 = time.monotonic()
-                hedge_fut = rpc.rpc_async(
-                    peer, _remote_submit,
-                    args=self._submit_args(req, peer),
-                    timeout=left + 1.0)
+                with tracing.bind(hedge_span):
+                    hedge_fut = rpc.rpc_async(
+                        peer, _remote_submit,
+                        args=self._submit_args(req, peer),
+                        timeout=left + 1.0)
                 hedge_fut.add_done_callback(
                     self._attempt_observer(peer, t1))
                 futs[hedge_fut] = peer
+                spans[hedge_fut] = hedge_span
         pending = set(futs)
         primary_err = None
         other_err = None
@@ -1072,7 +1166,9 @@ class ServingRouter:
                 exc = _as_transport_error(exc) if exc is not None \
                     else None
                 if exc is None:
-                    self._complete(req, fut.result(), who)
+                    won = self._complete(req, fut.result(), who)
+                    if spans.get(fut) is not None:
+                        spans[fut].end(status="ok", winner=won)
                     if fut is hedge_fut:
                         stats.incr("router.hedge_wins")
                     for loser, loser_name in futs.items():
@@ -1084,7 +1180,21 @@ class ServingRouter:
                                     timeout=self.cfg.rpc_timeout_s)
                             except Exception:
                                 pass
+                            if spans.get(loser) is not None:
+                                # the explicitly-cancelled loser: one
+                                # winning span + this, never two wins
+                                spans[loser].end(status="cancelled",
+                                                 cancelled=True)
+                    for f2, sp2 in spans.items():
+                        # a loser that FINISHED before the winner was
+                        # processed (same done batch): not cancelled,
+                        # just beaten — end() is idempotent, so spans
+                        # already closed above keep their status
+                        if sp2 is not None and f2 is not fut:
+                            sp2.end(status="superseded")
                     return None
+                if spans.get(fut) is not None:
+                    spans[fut].end(status=type(exc).__name__)
                 if fut is fut1:
                     primary_err = exc
                 else:
@@ -1092,6 +1202,9 @@ class ServingRouter:
         # both attempts failed (or the primary failed before a hedge
         # fired): report the primary's error so the dispatch loop's
         # spill/failover semantics match the unhedged path
+        for sp in spans.values():
+            if sp is not None:               # idempotent for ended ones
+                sp.end(status="unresolved")
         if primary_err is not None:
             return primary_err
         if other_err is not None:

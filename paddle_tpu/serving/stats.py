@@ -24,13 +24,19 @@ def request_observe(name, request_id, value, help=""):  # noqa: A002
     """Per-request labeled series ``serving.<name>{request_id=...}`` —
     the same monotonically increasing id the engine puts in its
     ``serving::prefill``/``serving::decode`` span args, so one request's
-    trace spans and metrics join on it.  Cardinality is bounded by the
-    engine run (``reset_serving_stats()`` clears the families at engine
-    start)."""
+    trace spans and metrics join on it.  Cardinality is bounded TWICE:
+    ``reset_serving_stats()`` clears the families at engine start, and
+    within one engine run the family is LRU-rotated to at most
+    ``FLAGS_serving_request_label_cap`` children (the oldest request's
+    series is dropped when a new request would exceed the cap), so a
+    long-lived engine's registry converges instead of growing one child
+    per request forever."""
     from ..observability import registry as _registry
+    from ..utils.flags import flag as _flag
+    cap = int(_flag("FLAGS_serving_request_label_cap", 1024) or 0)
     _registry.counter(PREFIX + name, help,
                       labelnames=("request_id",)) \
-        .labels(request_id=str(request_id)).inc(value)
+        .labels_lru(cap, request_id=str(request_id)).inc(value)
 
 
 def set_value(name, value):
@@ -149,6 +155,31 @@ def declare_adapter_stats():
     _registry.histogram(PREFIX + "adapter.adapter_load_ms",
                         "wall time of one adapter hot-load into its "
                         "pool slot (ms)")
+
+
+def declare_trace_stats():
+    """Get-or-create the distributed-tracing metric families at router/
+    engine start so the Prometheus exposition carries the full tracing
+    schema before the first span — a dashboard must see
+    ``trace_spans_dropped`` at 0, not a missing series, on a process
+    that never overflowed its span ring (tools/check_telemetry.py
+    --trace gates on this)."""
+    from ..observability import registry as _registry
+    _registry.counter(PREFIX + "trace.spans",
+                      "completed spans recorded into the per-process "
+                      "trace ring")
+    _registry.counter(PREFIX + "trace.spans_dropped",
+                      "completed spans dropped oldest-first when the "
+                      "ring exceeded FLAGS_trace_buffer_cap")
+    _registry.counter(PREFIX + "trace.decisions",
+                      "tail-sampling decisions made at root-request "
+                      "completion (exactly one per trace)")
+    _registry.counter(PREFIX + "trace.decisions_kept",
+                      "tail-sampling decisions that KEPT the trace "
+                      "(error/evicted/deadline, latency threshold, or "
+                      "probabilistic floor)")
+    _registry.counter(PREFIX + "trace.spools",
+                      "atomic JSONL spool writes under FLAGS_trace_dir")
 
 
 def adapter_observe(adapter_id):
@@ -390,4 +421,9 @@ def serving_stats():
         "router_retry_budget_exhausted": g(
             "router.retry_budget_exhausted"),
         "requests_cancelled": g("requests_cancelled"),
+        "trace_spans": g("trace.spans"),
+        "trace_spans_dropped": g("trace.spans_dropped"),
+        "trace_decisions": g("trace.decisions"),
+        "trace_decisions_kept": g("trace.decisions_kept"),
+        "trace_spools": g("trace.spools"),
     }

@@ -180,6 +180,34 @@ _FLAGS: dict[str, Any] = {
     # sentinel_dump.<pid>.json under FLAGS_dump_dir; multi-rank jobs
     # insert .rank<R> before the extension, like stall dumps.
     "FLAGS_sentinel_dump_path": "",
+    # distributed request tracing (observability/tracing.py,
+    # docs/OBSERVABILITY.md).  A non-empty directory arms per-request
+    # TraceContext minting and span recording across router/engine/
+    # migration hops; each process spools its spans there as atomic
+    # JSONL for the fleet collector to merge.  Empty (default) = no
+    # context objects, no spans, no I/O — every hot-path seam pays one
+    # falsy flag check / None compare and the serving output is
+    # byte-identical to tracing never existing.
+    "FLAGS_trace_dir": "",
+    # tail-sampling probabilistic floor: fraction of OK-and-fast traces
+    # kept anyway (decided by a deterministic hash of the trace id, so
+    # reruns keep the same traces).  Errors, deadline evictions and
+    # traces slower than FLAGS_trace_latency_threshold_ms are ALWAYS
+    # kept regardless of this rate.
+    "FLAGS_trace_sample_rate": 0.05,
+    # root-request latency above which a trace is always kept (the tail
+    # the p99 attribution exists for).  0 keeps every trace.
+    "FLAGS_trace_latency_threshold_ms": 250.0,
+    # per-process span ring capacity: completed spans beyond this are
+    # dropped oldest-first (and counted) rather than growing without
+    # bound on a replica the collector never visits.
+    "FLAGS_trace_buffer_cap": 4096,
+    # serving/stats.py request_observe label-cardinality cap: at most
+    # this many request_id-labeled children are kept per metric family
+    # (LRU rotation — the oldest request's child is dropped when a new
+    # request would exceed the cap), so a long-lived engine's registry
+    # converges instead of growing per request.
+    "FLAGS_serving_request_label_cap": 1024,
 }
 
 

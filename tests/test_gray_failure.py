@@ -255,15 +255,16 @@ def test_breaker_blocks_candidates_until_halfopen(bare_router):
     for _ in range(2):
         r._observe_attempt("a", 0.1, ConnectionError("snap"))
     assert r._breakers["a"].state == "open"
-    out, _ = r._candidates(req)
+    out, _, blocked = r._candidates(req)
     assert out == ["b"]                     # open breaker: skipped
+    assert blocked == ["a"]
     time.sleep(0.25)                        # cooldown: one trial admits
-    out, _ = r._candidates(req)
+    out, _, _ = r._candidates(req)
     assert "a" in out
-    out, _ = r._candidates(req)             # trial in flight: blocked
+    out, _, _ = r._candidates(req)          # trial in flight: blocked
     assert out == ["b"]
     r._observe_attempt("a", 0.1, None)      # trial succeeds: recloses
-    out, _ = r._candidates(req)
+    out, _, _ = r._candidates(req)
     assert "a" in out
 
 
@@ -295,8 +296,9 @@ def test_guardian_tick_ejects_robust_z_outlier(bare_router):
         r._replicas[n] = _ReplicaView(
             {"name": n, "ip": "127.0.0.1", "port": 1, "gen": 0,
              "state": "ready"})
-    out, _ = r._candidates(req)
+    out, _, blocked = r._candidates(req)
     assert "c" not in out and set(out) == {"a", "b"}
+    assert blocked == ["c"]
     assert "c" in r.ring.members
 
 

@@ -279,6 +279,39 @@ def test_serving_request_labeled_series():
     assert "serving.request_tokens{request_id=7}" not in monitor.all_stats()
 
 
+def test_serving_request_label_cardinality_converges():
+    """A long-lived engine's per-request family is LRU-rotated to
+    ``FLAGS_serving_request_label_cap`` children (ISSUE 19): observing
+    thousands of distinct request ids converges to the cap with the
+    most-recent ids surviving, instead of growing one series per
+    request forever."""
+    from paddle_tpu.serving import stats as sstats
+    from paddle_tpu.utils.flags import set_flags
+    sstats.reset_serving_stats()
+    set_flags({"FLAGS_serving_request_label_cap": 8})
+    try:
+        for rid in range(100):
+            sstats.request_observe("request_tokens", rid, 1)
+        from paddle_tpu.observability import registry
+        fam = registry.counter("serving.request_tokens",
+                               labelnames=("request_id",))
+        kept = {vals[0] for vals, _ in fam._samples()}
+        assert len(kept) == 8
+        assert kept == {str(r) for r in range(92, 100)}  # MRU survive
+        # re-touching an old id re-creates it and evicts the LRU one
+        sstats.request_observe("request_tokens", 0, 1)
+        kept = {vals[0] for vals, _ in fam._samples()}
+        assert "0" in kept and "92" not in kept and len(kept) == 8
+        # cap <= 0 disables rotation entirely
+        set_flags({"FLAGS_serving_request_label_cap": 0})
+        for rid in range(200, 220):
+            sstats.request_observe("request_tokens", rid, 1)
+        assert len(fam._samples()) == 28
+    finally:
+        set_flags({"FLAGS_serving_request_label_cap": 1024})
+        sstats.reset_serving_stats()
+
+
 # ---------------------------------------------------------------------------
 # StepMetrics
 # ---------------------------------------------------------------------------
@@ -366,8 +399,11 @@ def test_metrics_exporter_appends_snapshots(tmp_path):
              for line in open(path).read().splitlines() if line]
     assert len(lines) >= 2             # periodic + final
     for rec in lines:
-        assert {"ts", "pid", "counters", "gauges",
+        assert {"schema_version", "ts", "pid", "counters", "gauges",
                 "histograms"} <= set(rec)
+        # every line self-describes its schema so a consumer pinned to
+        # version 1 can fail loudly instead of misparsing (ISSUE 19)
+        assert rec["schema_version"] == 1
     assert lines[-1]["counters"]["exp.ticks"] == 3
 
 
@@ -406,6 +442,11 @@ def test_flight_recorder_ring_is_bounded(tmp_path):
     assert data["reason"] == "test"
     assert [e["name"] for e in data["events"]] == ["e6", "e7", "e8", "e9"]
     assert "metrics" in data and "counters" in data["metrics"]
+    # dual clocks on every event (ISSUE 19): wall time anchors the
+    # event against other processes' dumps and trace spans, the
+    # monotonic stamp gives drift-free in-process deltas
+    for e in data["events"]:
+        assert e["ts"] > 0 and e["mono"] > 0
 
 
 def test_flight_recorder_disabled_is_noop(tmp_path):

@@ -1,0 +1,568 @@
+"""Distributed request tracing (ISSUE 19): trace-context propagation
+through router -> rpc -> engine -> migration, tail-based sampling
+decided once at root completion, per-process spools merged by the
+collector, and the hard delivery paths — hedged winner + cancelled
+loser under ONE trace, SIGKILL failover resubmission, migration
+transfer spans parenting the resumed remote decode, and the
+mid-transfer local fallback.  The zero-overhead-off identity and the
+full chaos matrix run in tools/run_ci.sh (trace lanes); these tests
+pin the mechanisms."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.models import GPTForCausalLM, gpt_config
+from paddle_tpu.observability import tracing
+from paddle_tpu.serving import (Engine, ReplicaConfig, ReplicaServer,
+                                RouterConfig, ServingConfig,
+                                ServingRouter)
+from paddle_tpu.serving import migration
+from paddle_tpu.utils.flags import set_flags
+
+
+def _np(t):
+    return np.asarray(t._data_)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=2, hidden_size=64, num_heads=4,
+        vocab_size=256, max_seq_len=64))
+    m.eval()
+    return m
+
+
+def _prompts(lens, seed=0, vocab=256):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (n,)).astype("int32") for n in lens]
+
+
+def _ref_greedy(model, prompt, max_new):
+    ids = model.generate(paddle.to_tensor(prompt[None, :]),
+                         max_new_tokens=max_new, temperature=0.0)
+    return _np(ids)[0, prompt.size:]
+
+
+@pytest.fixture()
+def trace_dir(tmp_path):
+    """Arm tracing into a per-test spool dir (threshold 0 keeps every
+    trace); restore the off-by-default flags and wipe process state."""
+    d = str(tmp_path / "traces")
+    tracing.reset()
+    set_flags({"FLAGS_trace_dir": d,
+               "FLAGS_trace_latency_threshold_ms": 0.0})
+    yield d
+    set_flags({"FLAGS_trace_dir": "",
+               "FLAGS_trace_latency_threshold_ms": 250.0,
+               "FLAGS_trace_sample_rate": 0.05,
+               "FLAGS_trace_buffer_cap": 4096})
+    tracing.reset()
+
+
+def _merged(trace_dir):
+    tracing.spool_now(trace_dir)
+    return tracing.merge_spools(trace_dir)
+
+
+def _spans_by_name(trace, name):
+    return [s for s in trace.get("spans", []) if s["name"] == name]
+
+
+def _winners(trace):
+    return [s for s in trace.get("spans", []) if s.get("winner")]
+
+
+# ------------------------------------------------------------------
+# core: context / span / sampling / spool units
+# ------------------------------------------------------------------
+
+def test_tracing_off_is_inert():
+    set_flags({"FLAGS_trace_dir": ""})
+    assert tracing.enabled() is False
+    assert tracing.start_span("x") is None
+    assert tracing.decide("t", "error", 1.0) is None
+    assert tracing.current_wire() is None
+    assert tracing.spool_now() is None
+    with tracing.bind_wire(None):       # null context, no tls write
+        assert tracing.current() is None
+
+
+def test_context_wire_roundtrip():
+    ctx = tracing.TraceContext("t-1", "s-1", "p-1", sampled=True)
+    back = tracing.TraceContext.from_wire(ctx.wire())
+    assert (back.trace_id, back.span_id, back.parent_span_id,
+            back.sampled) == ("t-1", "s-1", "p-1", True)
+    assert tracing.TraceContext.from_wire(None) is None
+    # short wire tuples (older peers) still parse
+    short = tracing.TraceContext.from_wire(("t", "s"))
+    assert short.parent_span_id is None and short.sampled is None
+
+
+def test_span_record_dual_clocks_and_idempotent_end(trace_dir):
+    span = tracing.start_span("unit.op", rid=7)
+    span.event("tick", n=1)
+    span.end(status="ok", winner=True, tokens=3)
+    span.end(status="error")            # second end ignored
+    assert span.status == "ok"
+    merged = _merged(trace_dir)
+    (tr,) = merged["traces"]
+    (rec,) = tr["spans"]
+    assert rec["name"] == "unit.op" and rec["status"] == "ok"
+    assert rec["winner"] is True
+    assert rec["attrs"] == {"rid": 7, "tokens": 3}
+    assert rec["events"][0]["name"] == "tick"
+    assert rec["events"][0]["t_ms"] >= 0
+    # both clocks: wall anchor + monotonic pair
+    assert rec["wall"] > 0 and rec["t1"] >= rec["t0"] > 0
+
+
+def test_child_spans_share_trace_and_bind_propagates(trace_dir):
+    root = tracing.start_span("root")
+    child = tracing.start_span("child", parent=root)
+    assert child.ctx.trace_id == root.ctx.trace_id
+    assert child.ctx.parent_span_id == root.ctx.span_id
+    with tracing.bind(root):
+        implicit = tracing.start_span("implicit")
+        wire = tracing.current_wire()
+    assert implicit.ctx.trace_id == root.ctx.trace_id
+    assert wire[0] == root.ctx.trace_id
+    assert tracing.current() is None    # bind restored on exit
+    # server side: bind_wire re-binds the propagated context
+    with tracing.bind_wire(wire):
+        remote = tracing.start_span("remote")
+    assert remote.ctx.trace_id == root.ctx.trace_id
+
+
+def test_ring_is_bounded_by_buffer_cap(trace_dir):
+    set_flags({"FLAGS_trace_buffer_cap": 8})
+    for i in range(20):
+        tracing.start_span(f"op{i}").end()
+    with tracing._lock:
+        assert len(tracing._buffer) == 8
+
+
+def test_tail_sampling_policy_and_first_decision_wins(trace_dir):
+    set_flags({"FLAGS_trace_latency_threshold_ms": 100.0,
+               "FLAGS_trace_sample_rate": 0.0})
+    assert tracing.decide("t-err", "EvictedError", 1.0) is True
+    assert tracing.decide("t-slow", "ok", 500.0) is True
+    assert tracing.decide("t-fast", "ok", 1.0) is False
+    # first decision wins: a later error report cannot flip it
+    assert tracing.decide("t-fast", "error", 1.0) is False
+    # deterministic hash floor: rate 1.0 keeps everything, and the
+    # same trace id always hashes to the same verdict
+    set_flags({"FLAGS_trace_sample_rate": 1.0})
+    assert tracing.decide("t-floor", "ok", 1.0) is True
+    assert tracing._hash_floor("t-x") == tracing._hash_floor("t-x")
+
+
+def test_spool_merge_elides_dropped_keeps_undecided(trace_dir):
+    set_flags({"FLAGS_trace_latency_threshold_ms": 1e9,
+               "FLAGS_trace_sample_rate": 0.0})
+    for tid in ("keep", "drop", "lost"):
+        root = tracing.start_span(f"req-{tid}")
+        root.ctx.trace_id = tid          # pin ids for the assert
+        root.end()
+    tracing.decide("keep", "error", 1.0)
+    tracing.decide("drop", "ok", 1.0)
+    # "lost" never decides: a request that vanished mid-flight
+    merged = _merged(trace_dir)
+    by_id = {t["trace_id"]: t for t in merged["traces"]}
+    assert by_id["keep"]["sampled"] is True
+    assert by_id["keep"]["decision"]["reason"] == "status:error"
+    assert len(by_id["keep"]["spans"]) == 1
+    # dropped: spans elided, span_count preserved — that IS sampling
+    assert by_id["drop"]["sampled"] is False
+    assert "spans" not in by_id["drop"]
+    assert by_id["drop"]["span_count"] == 1
+    # undecided keeps its spans for post-mortem
+    assert by_id["lost"]["sampled"] is None
+    assert by_id["lost"]["decision_count"] == 0
+    assert len(by_id["lost"]["spans"]) == 1
+    # spool file is whole-file JSONL (atomic rewrite, no torn tail)
+    for line in open(tracing.spool_path(trace_dir)):
+        json.loads(line)
+
+
+def test_chrome_export_emits_cross_process_flows(trace_dir, tmp_path):
+    rec = {"kind": "span", "trace": "t", "span": "a.1", "parent": None,
+           "name": "router.request", "proc": "router", "pid": 1,
+           "wall": 100.0, "t0": 1.0, "t1": 2.0, "status": "ok"}
+    child = dict(rec, span="b.1", parent="a.1", name="engine.request",
+                 proc="rep-0", pid=2, winner=True)
+    local = dict(rec, span="a.2", parent="a.1", name="router.attempt")
+    merged = {"schema_version": 1,
+              "traces": [{"trace_id": "t", "sampled": True,
+                          "spans": [rec, child, local]}]}
+    events, proc_names = tracing.chrome_events(merged)
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"router.request",
+                                       "engine.request",
+                                       "router.attempt"}
+    # exactly one s/f flow pair: the router->replica hop (the local
+    # child shares the parent's process, no arrow)
+    assert [e["ph"] for e in events if e["ph"] in "sf"] == ["s", "f"]
+    assert len(proc_names) == 2
+    out = tracing.export_chrome(merged, str(tmp_path / "chrome.json"))
+    doc = json.load(open(out))
+    assert any(e.get("args", {}).get("winner")
+               for e in doc["traceEvents"] if e["ph"] == "X")
+
+
+# ------------------------------------------------------------------
+# engine integration
+# ------------------------------------------------------------------
+
+def test_engine_trace_phases_single_winner_one_decision(model,
+                                                        trace_dir):
+    with Engine(model, ServingConfig(num_slots=2)) as eng:
+        prompts = _prompts([5, 8], seed=1)
+        futs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        outs = [f.result(timeout=180) for f in futs]
+    assert all(o.output_ids.size == 4 for o in outs)
+    merged = _merged(trace_dir)
+    assert len(merged["traces"]) == 2
+    for tr in merged["traces"]:
+        assert tr["decision_count"] == 1
+        assert tr["decision"]["status"] == "ok"
+        names = {s["name"] for s in tr["spans"]}
+        assert {"engine.request", "engine.queue", "engine.prefill",
+                "engine.decode"} <= names
+        (root,) = [s for s in tr["spans"] if s["parent"] is None]
+        assert root["name"] == "engine.request"
+        (winner,) = _winners(tr)
+        assert winner["span"] == root["span"]
+        # prefill span carries the chunk + first-token events
+        (pre,) = _spans_by_name(tr, "engine.prefill")
+        evs = {e["name"] for e in pre.get("events", [])}
+        assert "first_token" in evs
+        # every non-root span parents inside the trace
+        ids = {s["span"] for s in tr["spans"]}
+        assert all(s["parent"] in ids for s in tr["spans"]
+                   if s["parent"] is not None)
+
+
+def test_engine_trace_report_attributes_latency(model, trace_dir):
+    """The analyzer reconstructs complete critical paths from a live
+    engine's spools and the per-phase attribution sums to the measured
+    latency (the ISSUE 19 acceptance numbers, in miniature)."""
+    with Engine(model, ServingConfig(num_slots=2)) as eng:
+        futs = [eng.submit(p, max_new_tokens=4)
+                for p in _prompts([6, 4, 7], seed=2)]
+        [f.result(timeout=180) for f in futs]
+    import importlib
+    ta = importlib.import_module("tools.trace_analyze")
+    report = ta.build_report(_merged(trace_dir))
+    assert report["analyzed"] == 3
+    assert report["complete_fraction"] == 1.0
+    assert report["winner_violations"] == []
+    assert report["multi_decision_traces"] == 0
+    assert report["span_sum"]["checked"] == 3
+    assert report["span_sum"]["violations"] == []
+    assert {"prefill", "decode"} <= set(report["phase_ms"])
+
+
+def test_engine_failure_trace_decides_non_ok(model, trace_dir):
+    """A failed request still decides its trace exactly once, with the
+    error status — errors are always kept by tail sampling."""
+    set_flags({"FLAGS_trace_latency_threshold_ms": 1e9,
+               "FLAGS_trace_sample_rate": 0.0})
+    with Engine(model, ServingConfig(num_slots=2)) as eng:
+        # validation failures raise before a trace exists
+        with pytest.raises(ValueError):
+            eng.submit(np.zeros((0,), np.int32), max_new_tokens=4)
+        fut = eng.submit(_prompts([5], seed=3)[0], max_new_tokens=4,
+                         deadline_s=1e-4)      # admitted, then expires
+        with pytest.raises(Exception):
+            fut.result(timeout=180)
+    merged = _merged(trace_dir)
+    kept = [t for t in merged["traces"] if t["sampled"]]
+    assert kept, merged["traces"]
+    for tr in kept:
+        assert tr["decision_count"] == 1
+        assert tr["decision"]["status"] != "ok"
+        assert tr["decision"]["reason"].startswith("status:")
+
+
+def test_migration_fallback_trace_marks_transfer_error(model,
+                                                       trace_dir):
+    """Mid-transfer target death: the transfer span ends non-ok, the
+    root records the fallback event, a fresh local decode span carries
+    the request to a normal single-winner completion."""
+    with Engine(model, ServingConfig(num_slots=2,
+                                     role="prefill")) as eng:
+        def dead(req, header, blobs, target):
+            raise ConnectionError("target died mid-transfer")
+        eng.migrator = dead
+        p = _prompts([7], seed=5)[0]
+        out = eng.submit(p, max_new_tokens=6,
+                         handoff={"name": "x"}).result(timeout=180)
+    np.testing.assert_array_equal(out.output_ids,
+                                  _ref_greedy(model, p, 6))
+    merged = _merged(trace_dir)
+    (tr,) = merged["traces"]
+    assert tr["decision_count"] == 1
+    (transfer,) = _spans_by_name(tr, "engine.migrate")
+    assert transfer["status"] == "ConnectionError"
+    (root,) = [s for s in tr["spans"] if s["parent"] is None]
+    assert any(e["name"] == "migration_fallback"
+               for e in root.get("events", []))
+    decodes = _spans_by_name(tr, "engine.decode")
+    assert any(s.get("attrs", {}).get("fallback") for s in decodes)
+    assert len(_winners(tr)) == 1
+
+
+def test_resumed_request_parents_under_bound_transfer_ctx(model,
+                                                          trace_dir):
+    """submit_resume under a bound wire context (what the receiving
+    replica's handle_resume_begin does) joins the sender's trace with
+    owns_root=False — the resumed engine never double-decides."""
+    eng_p = Engine(model, ServingConfig(num_slots=2,
+                                        role="prefill")).start()
+    eng_d = Engine(model, ServingConfig(num_slots=2,
+                                        role="decode")).start()
+    transfer_ctx = {}
+
+    def migrate(req, header, blobs, target):
+        tr = req.trace
+        assert tr is not None and tr.transfer is not None
+        transfer_ctx["wire"] = tr.transfer.ctx.wire()
+        pages = migration.unpack(header, *blobs)
+        with tracing.bind_wire(transfer_ctx["wire"]):
+            fut = eng_d.submit_resume(
+                req.prompt, list(req.tokens), pages,
+                max_new_tokens=req.max_new_tokens,
+                sampling=req.sampling, eos_token_id=req.eos_token_id,
+                ttft_ms=req.ttft_ms)
+        out = fut.result(timeout=120)
+        return {"request_id": req.id, "replica": "peer",
+                "output_ids": out.output_ids,
+                "finish_reason": out.finish_reason}
+
+    try:
+        eng_p.migrator = migrate
+        p = _prompts([9], seed=4)[0]
+        out = eng_p.submit(p, max_new_tokens=6,
+                           handoff={"name": "peer"}).result(timeout=180)
+    finally:
+        eng_p.shutdown()
+        eng_d.shutdown()
+    np.testing.assert_array_equal(out.output_ids,
+                                  _ref_greedy(model, p, 6))
+    assert out.decoded_by == "peer"
+    merged = _merged(trace_dir)
+    (tr,) = merged["traces"]             # ONE trace across both engines
+    assert tr["decision_count"] == 1
+    (transfer,) = _spans_by_name(tr, "engine.migrate")
+    assert transfer["span"] == transfer_ctx["wire"][1]
+    # the resumed engine.request hangs off the transfer span
+    roots = _spans_by_name(tr, "engine.request")
+    resumed = [s for s in roots if s["parent"] == transfer["span"]]
+    assert len(resumed) == 1
+    assert resumed[0].get("attrs", {}).get("resumed") is True
+    # single-phase migrator: no phase-2 remote_wait span (the fleet
+    # test below covers the two-phase awaiter path)
+    assert not _spans_by_name(tr, "engine.remote_wait")
+    assert len(_winners(tr)) == 1        # the migrating root, not the
+    #                                      resumed remote request
+
+
+# ------------------------------------------------------------------
+# fleet integration: rpc propagation + the hard delivery paths
+# ------------------------------------------------------------------
+
+_FAST = dict(heartbeat_interval_s=0.2, heartbeat_ttl_s=2.0)
+
+
+class _Fleet:
+    def __init__(self, model, names, router_kw=None, scfgs=None):
+        self.master = TCPStore(is_master=True)
+        rcfg = ReplicaConfig(**_FAST).validate()
+        self.reps = {}
+        for n in names:
+            scfg = (scfgs or {}).get(
+                n, ServingConfig(num_slots=2, max_queue=32))
+            self.reps[n] = ReplicaServer(
+                n, model, TCPStore("127.0.0.1", self.master.port),
+                scfg, rcfg)
+        self.router = ServingRouter(
+            TCPStore("127.0.0.1", self.master.port),
+            RouterConfig(heartbeat_ttl_s=2.0, poll_interval_s=0.1,
+                         **(router_kw or {}))).start()
+        deadline = time.monotonic() + 30
+        while len(self.router.ring.members) < len(names):
+            assert time.monotonic() < deadline, \
+                f"ring never filled: {self.router.replicas()}"
+            time.sleep(0.05)
+
+    def kill(self, name):
+        """SIGKILL analog for a threaded replica: rpc listener gone,
+        heartbeats stop, engine dead — NO deregistration."""
+        rep = self.reps[name]
+        rep._stop.set()
+        rep._beat.join(5.0)
+        rep.rpc_server.close()
+        rep.engine.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.router.close()
+        for rep in self.reps.values():
+            rep.close()
+        self.master.close()
+
+
+def test_fleet_trace_propagates_over_rpc_single_winner(model,
+                                                       trace_dir):
+    """A routed request is ONE trace: router.request root, winning
+    router.attempt, and the replica's engine spans all share the id,
+    with the engine.request parented under the attempt span that
+    carried it (the rpc envelope slot end-to-end)."""
+    with _Fleet(model, ["rep-0", "rep-1"]) as f:
+        prompts = _prompts([5, 7], seed=6)
+        futs = [f.router.submit(p, max_new_tokens=4, session_id=i)
+                for i, p in enumerate(prompts)]
+        outs = [fut.result(timeout=300) for fut in futs]
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(o.output_ids,
+                                          _ref_greedy(model, p, 4))
+    merged = _merged(trace_dir)
+    assert len(merged["traces"]) == 2
+    for tr in merged["traces"]:
+        assert tr["decision_count"] == 1
+        (root,) = [s for s in tr["spans"] if s["parent"] is None]
+        assert root["name"] == "router.request"
+        assert any(e["name"] == "candidates"
+                   for e in root.get("events", []))
+        (attempt,) = _spans_by_name(tr, "router.attempt")
+        assert attempt["parent"] == root["span"]
+        (engine_root,) = _spans_by_name(tr, "engine.request")
+        assert engine_root["parent"] == attempt["span"]
+        assert _spans_by_name(tr, "engine.decode")
+        # exactly one winner, and it is the router's attempt — the
+        # engine knows it does not own this root
+        (winner,) = _winners(tr)
+        assert winner["span"] == attempt["span"]
+
+
+def test_sigkill_failover_resubmits_under_same_trace(model,
+                                                     trace_dir):
+    """A request whose owner replica is dead is resubmitted to a
+    survivor under the SAME trace: the failed attempt span, the
+    failover event, and the winning retry are all one story with one
+    decision."""
+    with _Fleet(model, ["rep-0", "rep-1"]) as f:
+        owner = f.router.ring.lookup("victim-session")
+        f.kill(owner)
+        p = _prompts([6], seed=5)[0]
+        out = f.router.submit(
+            p, max_new_tokens=5,
+            session_id="victim-session").result(timeout=120)
+        np.testing.assert_array_equal(out.output_ids,
+                                      _ref_greedy(model, p, 5))
+    merged = _merged(trace_dir)
+    kept = [t for t in merged["traces"]
+            if _spans_by_name(t, "router.request")]
+    assert len(kept) == 1
+    tr = kept[0]
+    assert tr["decision_count"] == 1
+    (root,) = _spans_by_name(tr, "router.request")
+    assert any(e["name"] == "failover"
+               for e in root.get("events", []))
+    attempts = _spans_by_name(tr, "router.attempt")
+    assert len(attempts) >= 2
+    failed = [s for s in attempts if s["status"] != "ok"]
+    assert failed and all(s["attrs"]["replica"] == owner
+                          for s in failed)
+    (winner,) = _winners(tr)
+    assert winner["name"] == "router.attempt"
+    assert winner["attrs"]["replica"] != owner
+
+
+def test_hedged_dispatch_traces_winner_and_cancelled_loser(model,
+                                                           trace_dir):
+    """The hedged pair stays under ONE trace: the root records the
+    hedge event, the answering arm is the single winner, and the
+    beaten arm ends explicitly cancelled/superseded — never a second
+    winner, never a second decision."""
+    kw = dict(hedge_percentile=80.0, hedge_min_samples=4,
+              rpc_timeout_s=60.0)
+    with _Fleet(model, ["g-0", "g-1"], router_kw=kw) as f:
+        for i, p in enumerate(_prompts([5, 6, 7, 5, 6, 7], seed=10)):
+            f.router.generate(p, max_new_tokens=4,
+                              session_id=f"warm-{i}", timeout=180)
+        sid = "hedge-probe"
+        primary = next(iter(f.router.ring.successors(sid)))
+        set_flags({"FLAGS_fault_inject":
+                   f"engine_slow:to={primary},delay_s=1.5,count=40"})
+        try:
+            p = _prompts([6], seed=11)[0]
+            out = f.router.generate(p, max_new_tokens=4,
+                                    session_id=sid, timeout=180)
+        finally:
+            set_flags({"FLAGS_fault_inject": ""})
+        np.testing.assert_array_equal(out.output_ids,
+                                      _ref_greedy(model, p, 4))
+    merged = _merged(trace_dir)
+    hedged = [t for t in merged["traces"]
+              if any(e["name"] == "hedge"
+                     for s in _spans_by_name(t, "router.request")
+                     for e in s.get("events", []))]
+    assert len(hedged) == 1
+    tr = hedged[0]
+    assert tr["decision_count"] == 1
+    attempts = _spans_by_name(tr, "router.attempt")
+    assert len(attempts) == 2
+    winners = [s for s in attempts if s.get("winner")]
+    assert len(winners) == 1
+    assert winners[0]["attrs"]["hedged"] == "hedge"
+    (loser,) = [s for s in attempts if not s.get("winner")]
+    assert loser["status"] in ("cancelled", "superseded")
+    assert loser["attrs"]["hedged"] == "primary"
+    # every span of the pair shares the one trace id
+    assert {s["trace"] for s in tr["spans"]} == {tr["trace_id"]}
+
+
+def test_fleet_disagg_migration_trace_spans_three_hops(model,
+                                                      trace_dir):
+    """Prefill-replica -> page transfer -> decode-replica is ONE
+    trace over the real rpc plane: the transfer span rides the
+    migration meta dict across the Blob fast path and parents the
+    resumed request on the decode replica."""
+    scfgs = {"rep-p": ServingConfig(num_slots=2, role="prefill"),
+             "rep-d": ServingConfig(num_slots=4, role="decode")}
+    with _Fleet(model, ["rep-p", "rep-d"],
+                router_kw=dict(disaggregation=True),
+                scfgs=scfgs) as f:
+        p = _prompts([9], seed=7)[0]
+        out = f.router.submit(p, max_new_tokens=5,
+                              session_id="mig").result(timeout=300)
+        np.testing.assert_array_equal(out.output_ids,
+                                      _ref_greedy(model, p, 5))
+        assert out.decoded_by == "rep-d"
+    merged = _merged(trace_dir)
+    (tr,) = [t for t in merged["traces"]
+             if _spans_by_name(t, "engine.migrate")]
+    assert tr["decision_count"] == 1
+    (transfer,) = _spans_by_name(tr, "engine.migrate")
+    assert transfer["status"] == "ok"
+    roots = _spans_by_name(tr, "engine.request")
+    resumed = [s for s in roots if s["parent"] == transfer["span"]]
+    assert len(resumed) == 1
+    assert resumed[0].get("attrs", {}).get("resumed") is True
+    assert _spans_by_name(tr, "engine.remote_wait")
+    # resumed decode happened on the decode replica's engine
+    decodes = [s for s in _spans_by_name(tr, "engine.decode")
+               if s["parent"] == resumed[0]["span"]]
+    assert decodes
+    (winner,) = _winners(tr)
+    assert winner["name"] == "router.attempt"

@@ -411,7 +411,22 @@ def main(argv=None):
     ap.add_argument("--ejection-drill", action="store_true",
                     help="run the engine_slow ejection/readmission "
                          "scenario before the episode loop")
+    ap.add_argument("--trace-dir",
+                    help="arm distributed tracing (ISSUE 19): spool "
+                         "spans here, write <dir>/merged.json, and "
+                         "audit exactly-one tail-sampling decision "
+                         "per request — under chaos, a hedged/killed/"
+                         "resubmitted request must still decide once")
     args = ap.parse_args(argv)
+
+    if args.trace_dir:
+        from paddle_tpu.observability import tracing
+        from paddle_tpu.utils.flags import set_flags
+        tracing.reset()
+        # threshold 0 keeps every trace's spans: the campaign artifact
+        # is also the analyzer's input, so sample nothing out
+        set_flags({"FLAGS_trace_dir": args.trace_dir,
+                   "FLAGS_trace_latency_threshold_ms": 0.0})
 
     rng = np.random.default_rng(args.seed)
     t_start = time.monotonic()
@@ -484,6 +499,29 @@ def main(argv=None):
     }
     if drill is not None:
         summary["ejection_drill"] = drill
+    if args.trace_dir:
+        # trace audit: every request that resolved (none were lost if
+        # we got here) must have decided its trace exactly once — a
+        # hedged winner + cancelled loser, a SIGKILL resubmission, or
+        # a drain bounce shows up as EXTRA spans, never extra
+        # decisions, and a request that finished without deciding is
+        # an untraced p99 outlier waiting to happen
+        from paddle_tpu.observability import tracing
+        tracing.spool_now(args.trace_dir)
+        merged = tracing.merge_spools(args.trace_dir)
+        import os as _os
+        tracing.write_merged(
+            merged, _os.path.join(args.trace_dir, "merged.json"))
+        counts = [t.get("decision_count", 0)
+                  for t in merged.get("traces", [])]
+        summary["trace"] = {
+            "requests": len(counts),
+            "decided": sum(1 for c in counts if c == 1),
+            "multi_decision": sum(1 for c in counts if c > 1),
+            "undecided": sum(1 for c in counts if c == 0),
+            "kept": sum(1 for t in merged.get("traces", [])
+                        if t.get("sampled")),
+        }
     if args.out:
         with open(args.out, "w") as f:
             json.dump(summary, f, indent=2, sort_keys=True)
@@ -491,7 +529,9 @@ def main(argv=None):
     ok = (not summary["failed_episodes"]
           and summary["lost_requests"] == 0
           and summary["mismatches"] == 0
-          and summary["leaks"] == 0)
+          and summary["leaks"] == 0
+          and summary.get("trace", {}).get("multi_decision", 0) == 0
+          and summary.get("trace", {}).get("undecided", 0) == 0)
     print(f"chaos campaign {'OK' if ok else 'FAILED'}: "
           f"{summary['episodes']} episodes, seed {args.seed}, "
           f"{summary['wall_s']:.1f}s")

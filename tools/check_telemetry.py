@@ -87,9 +87,11 @@ def parse_prometheus(text):
     return series, typed, errors
 
 
-_SNAPSHOT_KEYS = {"ts": (int, float), "pid": int, "counters": dict,
+_SNAPSHOT_KEYS = {"schema_version": int, "ts": (int, float),
+                  "pid": int, "counters": dict,
                   "gauges": dict, "histograms": dict}
 _HIST_KEYS = ("count", "sum", "min", "max", "avg", "p50", "p90", "p99")
+SNAPSHOT_SCHEMA_VERSION = 1
 
 
 def check_snapshots(path):
@@ -105,6 +107,12 @@ def check_snapshots(path):
             except json.JSONDecodeError as e:
                 errors.append(f"{path}:{lineno}: invalid JSON: {e}")
                 continue
+            sv = rec.get("schema_version")
+            if sv is not None and sv != SNAPSHOT_SCHEMA_VERSION:
+                errors.append(
+                    f"{path}:{lineno}: schema_version {sv!r} != "
+                    f"{SNAPSHOT_SCHEMA_VERSION} (a consumer pinned to "
+                    "this schema must fail loudly, not misparse)")
             for key, types in _SNAPSHOT_KEYS.items():
                 if key not in rec:
                     errors.append(f"{path}:{lineno}: missing {key!r}")
@@ -497,6 +505,131 @@ def check_campaign_summary(path):
     if data["failed_episodes"]:
         errors.append(f"{path}: failed episodes: "
                       f"{data['failed_episodes']}")
+    trace = data.get("trace")
+    if trace is not None:
+        if not isinstance(trace, dict):
+            errors.append(f"{path}: 'trace' section is not a dict")
+        else:
+            for key in ("requests", "decided", "multi_decision",
+                        "undecided"):
+                if not isinstance(trace.get(key), int):
+                    errors.append(f"{path}: trace.{key} missing or "
+                                  "not int")
+            if trace.get("multi_decision"):
+                errors.append(f"{path}: trace.multi_decision = "
+                              f"{trace['multi_decision']} (a request "
+                              "was tail-sampled twice — exactly-once "
+                              "decision violated)")
+            if trace.get("undecided"):
+                errors.append(f"{path}: trace.undecided = "
+                              f"{trace['undecided']} (a surviving "
+                              "request finished without a sampling "
+                              "decision)")
+    return errors
+
+
+_SPAN_KEYS = {"trace": str, "span": str, "name": str, "proc": str,
+              "pid": int, "wall": (int, float), "t0": (int, float),
+              "t1": (int, float), "status": str}
+_TRACE_ENTRY_KEYS = {"trace_id": str, "decision_count": int,
+                     "span_count": int}
+
+
+def check_trace_merged(path):
+    """Schema gate for a merged trace document (ISSUE 19 CI satellite:
+    ``ServingFleet.collect_traces`` / ``tracing.merge_spools`` output).
+    Every sampled trace must carry well-formed dual-clock spans, every
+    decided trace exactly ONE tail-sampling decision, and dropped
+    traces must actually have their spans elided — sampling that
+    silently keeps everything is a disk bill, sampling that drops the
+    errors is a blind post-mortem."""
+    errors = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable merged trace doc: {e}"]
+    if doc.get("schema_version") != 1:
+        errors.append(f"{path}: schema_version "
+                      f"{doc.get('schema_version')!r} != 1")
+    traces = doc.get("traces")
+    if not isinstance(traces, list):
+        return errors + [f"{path}: missing 'traces' list"]
+    for i, tr in enumerate(traces):
+        where = f"{path}: traces[{i}]"
+        for key, types in _TRACE_ENTRY_KEYS.items():
+            if not isinstance(tr.get(key), types):
+                errors.append(f"{where}: {key!r} missing or not "
+                              f"{types}")
+        if tr.get("decision_count", 0) > 1:
+            errors.append(f"{where} ({tr.get('trace_id')!r}): "
+                          f"{tr['decision_count']} sampling decisions "
+                          "(exactly-once violated)")
+        sampled = tr.get("sampled")
+        spans = tr.get("spans")
+        if sampled is False and spans:
+            errors.append(f"{where}: dropped trace still carries "
+                          f"{len(spans)} span(s)")
+        if sampled and not spans:
+            errors.append(f"{where}: kept trace has no spans")
+        for j, rec in enumerate(spans or []):
+            for key, types in _SPAN_KEYS.items():
+                if not isinstance(rec.get(key), types):
+                    errors.append(f"{where}.spans[{j}]: {key!r} "
+                                  f"missing or not {types}")
+                    break
+            else:
+                if rec["t1"] < rec["t0"]:
+                    errors.append(f"{where}.spans[{j}]: t1 < t0")
+                if rec["trace"] != tr.get("trace_id"):
+                    errors.append(f"{where}.spans[{j}]: trace id "
+                                  f"{rec['trace']!r} != entry's "
+                                  f"{tr.get('trace_id')!r}")
+    return errors
+
+
+_TRACE_REPORT_KEYS = {"schema_version": int, "traces": int,
+                      "analyzed": int, "complete": int,
+                      "latency_ms": dict, "phase_ms": dict,
+                      "winner_violations": list, "span_sum": dict}
+
+
+def check_trace_report(path):
+    """Schema gate for a tools/trace_analyze.py report: the p99-
+    attribution artifact a CI lane asserts on must itself be well-
+    formed and must report the invariants it checked — zero winner
+    violations, zero multi-decisions, and the span-sum agreement."""
+    errors = []
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable trace report: {e}"]
+    for key, types in _TRACE_REPORT_KEYS.items():
+        if key not in data:
+            errors.append(f"{path}: missing {key!r}")
+        elif not isinstance(data[key], types):
+            errors.append(f"{path}: {key!r} has type "
+                          f"{type(data[key]).__name__}")
+    if errors:
+        return errors
+    if data["schema_version"] != 1:
+        errors.append(f"{path}: schema_version "
+                      f"{data['schema_version']} != 1")
+    if data["winner_violations"]:
+        errors.append(f"{path}: {len(data['winner_violations'])} "
+                      "trace(s) without exactly one winning span")
+    if data.get("multi_decision_traces"):
+        errors.append(f"{path}: {data['multi_decision_traces']} "
+                      "trace(s) decided more than once")
+    ss = data["span_sum"]
+    if ss.get("violations"):
+        errors.append(f"{path}: {len(ss['violations'])} trace(s) with "
+                      "span-sum drift beyond tolerance")
+    for p, row in data["phase_ms"].items():
+        for key in ("count", "p50", "p99"):
+            if not isinstance((row or {}).get(key), (int, float)):
+                errors.append(f"{path}: phase_ms.{p}.{key} missing")
     return errors
 
 
@@ -543,6 +676,14 @@ def main():
     ap.add_argument("--campaign-summary",
                     help="chaos-campaign summary JSON to schema-gate "
                          "(zero lost/duplicate/mismatch/leak required)")
+    ap.add_argument("--trace",
+                    help="merged trace document JSON to schema-gate "
+                         "(exactly-one decision, dual-clock spans, "
+                         "dropped traces elided)")
+    ap.add_argument("--trace-report",
+                    help="tools/trace_analyze.py report JSON to "
+                         "schema-gate (zero winner violations, "
+                         "span-sum agreement)")
     args = ap.parse_args()
     if args.router and not args.prometheus:
         ap.error("--router needs --prometheus")
@@ -558,10 +699,11 @@ def main():
         ap.error("--data needs --prometheus")
     if not args.prometheus and not args.snapshots \
             and not args.stall_dump and not args.sentinel_dump \
-            and not args.campaign_summary:
+            and not args.campaign_summary and not args.trace \
+            and not args.trace_report:
         ap.error("nothing to check: pass --prometheus, --snapshots, "
-                 "--stall-dump, --sentinel-dump and/or "
-                 "--campaign-summary")
+                 "--stall-dump, --sentinel-dump, --campaign-summary, "
+                 "--trace and/or --trace-report")
 
     failures = []
     if args.prometheus:
@@ -622,6 +764,26 @@ def main():
             print(f"campaign summary OK: seed={summ['seed']} "
                   f"episodes={summ['episodes']} faults={summ['faults']}"
                   f" zero lost/duplicate/mismatch/leak")
+    if args.trace:
+        errors = check_trace_merged(args.trace)
+        failures += errors
+        if not errors:
+            with open(args.trace) as f:
+                doc = json.load(f)
+            trs = doc.get("traces", [])
+            kept = sum(1 for t in trs if t.get("sampled"))
+            print(f"merged traces OK: {len(trs)} trace(s), {kept} "
+                  f"kept, exactly-one decision per decided trace")
+    if args.trace_report:
+        errors = check_trace_report(args.trace_report)
+        failures += errors
+        if not errors:
+            with open(args.trace_report) as f:
+                rep = json.load(f)
+            print(f"trace report OK: {rep['analyzed']} analyzed, "
+                  f"complete_fraction="
+                  f"{rep.get('complete_fraction')}, zero winner "
+                  f"violations")
     if args.snapshots:
         n, errors = check_snapshots(args.snapshots)
         failures += errors

@@ -367,14 +367,65 @@ echo "== gray-failure chaos campaign (seeded episodes + guardian ejection drill)
 # leaked KV page; the gates re-check the summary schema and the
 # guardian counter exposition.  Same --seed reproduces the identical
 # fault schedule.
-timeout -k 10 300 python tools/chaos_campaign.py --seed 0 --episodes 20 \
+rm -rf /tmp/chaos_campaign_ci_traces
+timeout -k 10 420 python tools/chaos_campaign.py --seed 0 --episodes 20 \
     --requests 4 --ejection-drill \
+    --trace-dir /tmp/chaos_campaign_ci_traces \
     --out /tmp/chaos_campaign_ci.json \
     --episode-log /tmp/chaos_campaign_ci.jsonl \
     --prom-out /tmp/chaos_campaign_ci.prom
 python tools/check_telemetry.py --campaign-summary /tmp/chaos_campaign_ci.json
 python tools/check_telemetry.py --prometheus /tmp/chaos_campaign_ci.prom \
     --router --gray-failure
+
+echo "== distributed tracing gate (chaos traces -> critical-path p99 attribution) =="
+# the traced campaign above left per-process spools + the collector's
+# merged.json; the analyzer must reconstruct >=95% complete critical
+# paths, find exactly one winning span per kept trace, exactly one
+# tail-sampling decision per request, and the span-sum must agree with
+# the measured latency within 10% (ISSUE 19 acceptance).
+python tools/trace_analyze.py \
+    --trace /tmp/chaos_campaign_ci_traces/merged.json \
+    --out /tmp/chaos_campaign_ci_trace_report.json --strict
+python tools/check_telemetry.py \
+    --trace /tmp/chaos_campaign_ci_traces/merged.json \
+    --trace-report /tmp/chaos_campaign_ci_trace_report.json
+
+echo "== tracing zero-overhead-off check (outputs byte-identical either way) =="
+python - <<'EOF'
+import os
+import numpy as np
+
+def run(trace_dir):
+    from paddle_tpu.utils.flags import set_flags
+    set_flags({"FLAGS_trace_dir": trace_dir,
+               "FLAGS_trace_latency_threshold_ms": 0.0})
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+    from paddle_tpu.serving import Engine, ServingConfig
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt_config(
+        "gpt2-124m", num_layers=2, hidden_size=64, num_heads=2,
+        vocab_size=128, max_seq_len=64))
+    rng = np.random.default_rng(0)
+    with Engine(model, ServingConfig(num_slots=2)) as eng:
+        futs = [eng.submit(
+            rng.integers(0, 128, (int(rng.integers(3, 9)),))
+            .astype("int32"), max_new_tokens=5) for _ in range(4)]
+        return [f.result(timeout=300).output_ids.tobytes()
+                for f in futs]
+
+os.makedirs("/tmp/pt_trace_ci_overhead", exist_ok=True)
+off = run("")
+on = run("/tmp/pt_trace_ci_overhead")
+assert off == on, "tracing changed the served bytes"
+from paddle_tpu.observability import tracing
+tracing.spool_now("/tmp/pt_trace_ci_overhead")
+merged = tracing.merge_spools("/tmp/pt_trace_ci_overhead")
+assert len(merged["traces"]) == 4, len(merged["traces"])
+print("tracing overhead check OK: 4 requests byte-identical with "
+      "tracing on/off, 4 traces collected when armed")
+EOF
 
 echo "== serving fleet router + migration telemetry (thread-mode disagg fleet -> prometheus gate) =="
 python - <<'EOF'

@@ -1,0 +1,371 @@
+#!/usr/bin/env python
+"""Critical-path p99 attribution over merged request traces (ISSUE 19).
+
+Reads the collector's merged trace document (``ServingFleet
+.collect_traces`` / ``paddle_tpu.observability.tracing.merge_spools``)
+or a raw ``--trace-dir`` of per-process spool JSONLs, reconstructs each
+sampled request's critical path, and attributes its end-to-end latency
+to phases — queue / prefill / transfer / remote_wait / decode /
+hedge_wait / other — so "why is p99 slow?" gets a machine-checkable
+answer instead of a histogram shrug.
+
+The attribution rule is the deepest-covering-span sweep: each span's
+interval is anchored to absolute time as ``[wall, wall + (t1 - t0)]``
+(per-span wall anchor aligns processes; the monotonic pair gives the
+drift-free duration), the root interval is cut at every span boundary,
+and each segment is charged to the DEEPEST span covering its midpoint.
+Time under ``engine.queue`` is queue time even while ``engine.request``
+is also open; time covered only by the root is "other" (router
+dispatch, rpc, python).  When the winning attempt is the hedge arm,
+the root's ``hedge`` event offset is surfaced as ``hedge_wait`` — the
+latency the primary burned before the hedge fired.
+
+Invariants gated under ``--strict`` (the CI lane):
+- every analyzed trace has exactly one root and fully-resolving
+  parents (``--min-complete`` fraction, default 0.95);
+- every kept trace has EXACTLY one winning terminal span (exactly-once
+  delivery, visible in the trace itself);
+- the root span's duration agrees with the tail-sampling decision's
+  measured latency within 10% (span clocks are not lying).
+
+Stdlib-only on a merged document, like the rest of tools/.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+SCHEMA_VERSION = 1
+
+# span name -> latency phase; anything unmapped (router dispatch, rpc
+# time, python overhead) lands in "other"
+PHASE_MAP = {
+    "engine.queue": "queue",
+    "engine.prefill": "prefill",
+    "engine.migrate": "transfer",
+    "engine.remote_wait": "remote_wait",
+    "engine.decode": "decode",
+}
+PHASES = ("queue", "prefill", "transfer", "remote_wait", "decode",
+          "hedge_wait", "other")
+
+
+def load_merged_doc(trace_path=None, trace_dir=None):
+    """Load the merged trace document from a file, or merge raw spool
+    JSONLs from a directory (the collector's grouping re-implemented
+    stdlib-only so this tool runs anywhere CI does)."""
+    if trace_path:
+        with open(trace_path) as f:
+            return json.load(f)
+    spans: dict = {}
+    decisions: dict = {}
+    for fn in sorted(os.listdir(trace_dir)):
+        if not (fn.startswith("spool-") and fn.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(trace_dir, fn)) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            tid = rec.get("trace")
+            if not tid:
+                continue
+            if rec.get("kind") == "span" and rec.get("span"):
+                spans.setdefault(tid, {})[rec["span"]] = rec
+            elif rec.get("kind") == "decision":
+                decisions.setdefault(tid, []).append(rec)
+    traces = []
+    for tid in sorted(set(spans) | set(decisions)):
+        ds = decisions.get(tid, [])
+        decision = ds[0] if ds else None
+        sampled = bool(decision["keep"]) if decision else None
+        entry = {"trace_id": tid, "sampled": sampled,
+                 "decision": decision, "decision_count": len(ds),
+                 "span_count": len(spans.get(tid, {}))}
+        if sampled is not False:
+            entry["spans"] = sorted(
+                spans.get(tid, {}).values(),
+                key=lambda r: (r.get("wall", 0.0), r.get("span", "")))
+        traces.append(entry)
+    return {"schema_version": SCHEMA_VERSION, "generator": "spool-dir",
+            "traces": traces}
+
+
+def _abs_interval(rec):
+    """Absolute [start, end) seconds for one span record: wall anchor
+    plus monotonic duration."""
+    wall = float(rec.get("wall", 0.0))
+    dur = max(float(rec.get("t1", 0.0)) - float(rec.get("t0", 0.0)),
+              0.0)
+    return wall, wall + dur
+
+
+def _depth(rec, by_id, _cache):
+    """Distance from the root via the parent chain (cycle-safe)."""
+    sid = rec.get("span")
+    if sid in _cache:
+        return _cache[sid]
+    _cache[sid] = 0            # breaks cycles: treat as root depth
+    parent = by_id.get(rec.get("parent"))
+    d = 0 if parent is None else _depth(parent, by_id, _cache) + 1
+    _cache[sid] = d
+    return d
+
+
+def analyze_trace(entry):
+    """One trace -> per-phase milliseconds + structural verdicts.
+
+    Returns None for traces with no spans (dropped by sampling or
+    decision-only)."""
+    spans = entry.get("spans") or []
+    if not spans:
+        return None
+    by_id = {rec["span"]: rec for rec in spans}
+    roots = [rec for rec in spans
+             if rec.get("parent") not in by_id]
+    true_roots = [rec for rec in roots if not rec.get("parent")]
+    # complete = exactly one parentless root and every non-root
+    # parent pointer resolves inside the trace (no span lost to a
+    # crashed spool / ring eviction)
+    complete = len(roots) == 1 and len(true_roots) == 1
+    root = None
+    if roots:
+        root = max(roots, key=lambda r: (_abs_interval(r)[1]
+                                         - _abs_interval(r)[0]))
+    r0, r1 = _abs_interval(root)
+    if r1 <= r0:
+        return {"trace_id": entry["trace_id"], "complete": False,
+                "root": root.get("name"), "phase_ms": {},
+                "root_ms": 0.0, "winners": _winners(spans),
+                "statuses": sorted({s.get("status", "ok")
+                                    for s in spans})}
+    depth_cache: dict = {}
+    clipped = []
+    for rec in spans:
+        s, e = _abs_interval(rec)
+        s, e = max(s, r0), min(e, r1)
+        if e > s:
+            clipped.append((s, e, _depth(rec, by_id, depth_cache),
+                            rec))
+    cuts = sorted({p for s, e, _, _ in clipped for p in (s, e)})
+    phase_s = dict.fromkeys(PHASES, 0.0)
+    for i in range(len(cuts) - 1):
+        a, b = cuts[i], cuts[i + 1]
+        mid = (a + b) / 2.0
+        best = None
+        for s, e, d, rec in clipped:
+            if s <= mid < e and (best is None or d > best[0]):
+                best = (d, rec)
+        if best is None:
+            continue
+        phase = PHASE_MAP.get(best[1].get("name"), "other")
+        phase_s[phase] += b - a
+    # hedge_wait: when the hedge arm won, the root's "hedge" event
+    # offset is the latency the primary burned before backup fired
+    winner = next((s for s in spans if s.get("winner")), None)
+    if winner is not None and \
+            (winner.get("attrs") or {}).get("hedged") == "hedge":
+        for ev in root.get("events") or []:
+            if ev.get("name") == "hedge":
+                phase_s["hedge_wait"] = float(ev.get("t_ms", 0.0)) / 1e3
+                break
+    return {"trace_id": entry["trace_id"], "complete": complete,
+            "root": root.get("name"),
+            "phase_ms": {k: round(v * 1e3, 3)
+                         for k, v in phase_s.items() if v > 0},
+            "root_ms": round((r1 - r0) * 1e3, 3),
+            "winners": _winners(spans),
+            "statuses": sorted({s.get("status", "ok")
+                                for s in spans})}
+
+
+def _winners(spans):
+    return [s["span"] for s in spans if s.get("winner")]
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(round(q * (len(sorted_vals) - 1))),
+              len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def build_report(doc, span_sum_tolerance=0.10):
+    traces = doc.get("traces", [])
+    analyses = []
+    winner_violations = []
+    span_sum = {"checked": 0, "within_tolerance": 0, "violations": []}
+    for entry in traces:
+        a = analyze_trace(entry)
+        if a is None:
+            continue
+        analyses.append(a)
+        if entry.get("sampled") and len(a["winners"]) != 1:
+            winner_violations.append(
+                {"trace_id": a["trace_id"],
+                 "winner_count": len(a["winners"]),
+                 "winners": a["winners"]})
+        decision = entry.get("decision") or {}
+        lat = decision.get("latency_ms")
+        if decision.get("status") == "ok" and lat and lat > 0 \
+                and a["complete"]:
+            span_sum["checked"] += 1
+            rel = abs(a["root_ms"] - lat) / float(lat)
+            if rel <= span_sum_tolerance:
+                span_sum["within_tolerance"] += 1
+            else:
+                span_sum["violations"].append(
+                    {"trace_id": a["trace_id"],
+                     "root_ms": a["root_ms"],
+                     "decision_latency_ms": lat,
+                     "relative_error": round(rel, 4)})
+    phase_samples = {p: [] for p in PHASES}
+    latencies = []
+    for a in analyses:
+        latencies.append(a["root_ms"])
+        for p, ms in a["phase_ms"].items():
+            phase_samples[p].append(ms)
+    phase_ms = {}
+    for p, vals in phase_samples.items():
+        if not vals:
+            continue
+        vals.sort()
+        phase_ms[p] = {"count": len(vals),
+                       "mean": round(sum(vals) / len(vals), 3),
+                       "p50": round(_pct(vals, 0.50), 3),
+                       "p99": round(_pct(vals, 0.99), 3)}
+    latencies.sort()
+    n = len(analyses)
+    n_complete = sum(1 for a in analyses if a["complete"])
+    decision_counts = [t.get("decision_count", 0) for t in traces]
+    report = {
+        "schema_version": SCHEMA_VERSION,
+        "generator": "tools/trace_analyze.py",
+        "traces": len(traces),
+        "analyzed": n,
+        "complete": n_complete,
+        "complete_fraction": round(n_complete / n, 4) if n else None,
+        "multi_decision_traces": sum(1 for c in decision_counts
+                                     if c > 1),
+        "undecided_traces": sum(1 for c in decision_counts if c == 0),
+        "latency_ms": {"count": n,
+                       "p50": round(_pct(latencies, 0.50), 3),
+                       "p99": round(_pct(latencies, 0.99), 3)},
+        "phase_ms": phase_ms,
+        "winner_violations": winner_violations,
+        "span_sum": {**span_sum,
+                     "tolerance": span_sum_tolerance,
+                     "fraction": round(
+                         span_sum["within_tolerance"]
+                         / span_sum["checked"], 4)
+                     if span_sum["checked"] else None},
+        "per_trace": analyses,
+    }
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace",
+                    help="merged trace document JSON "
+                         "(ServingFleet.collect_traces output)")
+    ap.add_argument("--trace-dir",
+                    help="directory of spool-*.jsonl files to merge "
+                         "in-tool (no fleet needed)")
+    ap.add_argument("--out", help="write the report JSON here "
+                                  "(atomic tmp+replace)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on incomplete critical paths, "
+                         "winner violations, or span-sum drift — the "
+                         "CI gate")
+    ap.add_argument("--min-complete", type=float, default=0.95,
+                    help="--strict floor on the fraction of analyzed "
+                         "traces with a complete critical path")
+    ap.add_argument("--span-sum-tolerance", type=float, default=0.10,
+                    help="allowed relative error between the root "
+                         "span's duration and the decision's measured "
+                         "latency")
+    args = ap.parse_args()
+    if not args.trace and not args.trace_dir:
+        ap.error("pass --trace (merged JSON) or --trace-dir (spools)")
+    if args.trace_dir and not os.path.isdir(args.trace_dir):
+        print(f"trace dir {args.trace_dir!r} does not exist")
+        return 1
+
+    doc = load_merged_doc(args.trace, args.trace_dir)
+    if doc.get("schema_version") != SCHEMA_VERSION:
+        print(f"merged doc schema_version "
+              f"{doc.get('schema_version')!r} != {SCHEMA_VERSION}")
+        return 1
+    report = build_report(doc, args.span_sum_tolerance)
+
+    if args.out:
+        d = os.path.dirname(args.out)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{args.out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(tmp, args.out)
+
+    print(f"traces: {report['traces']} total, {report['analyzed']} "
+          f"with spans, {report['complete']} complete "
+          f"(fraction={report['complete_fraction']})")
+    lat = report["latency_ms"]
+    print(f"latency: p50={lat['p50']}ms p99={lat['p99']}ms over "
+          f"{lat['count']} trace(s)")
+    for p in PHASES:
+        row = report["phase_ms"].get(p)
+        if row:
+            print(f"  {p:<12} p50={row['p50']:>10.3f}ms "
+                  f"p99={row['p99']:>10.3f}ms n={row['count']}")
+    ss = report["span_sum"]
+    print(f"span-sum check: {ss['within_tolerance']}/{ss['checked']} "
+          f"within {int(ss['tolerance'] * 100)}% of measured latency")
+    if report["winner_violations"]:
+        print(f"winner violations ({len(report['winner_violations'])}):")
+        for v in report["winner_violations"][:10]:
+            print(f"  - {v['trace_id']}: {v['winner_count']} winner(s)")
+    if report["multi_decision_traces"]:
+        print(f"multi-decision traces: "
+              f"{report['multi_decision_traces']}")
+
+    if args.strict:
+        failures = []
+        frac = report["complete_fraction"]
+        if report["analyzed"] == 0:
+            failures.append("no traces with spans to analyze")
+        elif frac is not None and frac < args.min_complete:
+            failures.append(f"complete_fraction {frac} < "
+                            f"{args.min_complete}")
+        if report["winner_violations"]:
+            failures.append(f"{len(report['winner_violations'])} "
+                            "trace(s) without exactly one winner")
+        if ss["violations"]:
+            failures.append(f"{len(ss['violations'])} trace(s) with "
+                            "span-sum drift beyond tolerance")
+        if report["multi_decision_traces"]:
+            failures.append(f"{report['multi_decision_traces']} "
+                            "trace(s) decided more than once")
+        if failures:
+            print("trace analysis FAILED:")
+            for e in failures:
+                print(f"  - {e}")
+            return 1
+        print("trace analysis OK (strict)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
