@@ -48,6 +48,23 @@ def plan_topology(world_size, model_desc=None):
     return {"dp": int(plan.dp), "mp": int(plan.mp)}
 
 
+def resized_worlds():
+    """``(old_world, new_world)`` when this incarnation was relaunched
+    after an elastic resize (the controller exports
+    ``PADDLE_ELASTIC_RESIZED="old:new"``), else None.  The hot-spare
+    layer uses this to announce that its buddy ring was re-derived for
+    the new world — parked snapshots from the old ring stay fetchable
+    by owner rank, but live replication follows the new mesh order."""
+    raw = os.environ.get("PADDLE_ELASTIC_RESIZED", "")
+    if not raw or ":" not in raw:
+        return None
+    old, _, new = raw.partition(":")
+    try:
+        return int(old), int(new)
+    except ValueError:
+        return None
+
+
 def reshard_mesh_for(world_size, model_desc=None):
     """The target MeshSpec a resumed job reshards onto: the
     ``PADDLE_RESHARD_MESH`` env override (JSON ``{"axes":..,"shape":..}``

@@ -80,6 +80,31 @@ class CollectiveController:
         sys.stderr.flush()
         return errs
 
+    def _hot_spare_store(self):
+        """KV store the hot-spare buddy map is advertised through (the
+        same guardian store workers dial)."""
+        return self._trap.store if self._trap is not None else None
+
+    def _advertise_hot_spare(self, world):
+        """Publish the hot-spare buddy ring for this incarnation's
+        world (framework/hot_spare.py): a relaunched worker reads it to
+        learn which rank holds its RAM replica BEFORE its own mesh
+        exists.  Advertised unconditionally — the flag lives in the
+        workers; a stale map is just ignored bytes.  Never fatal."""
+        try:
+            from ...framework.hot_spare import advertise_buddy_map
+            store = self._hot_spare_store()
+            if store is None:
+                return
+            resized = getattr(self, "_extra_env", {}) \
+                .get("PADDLE_ELASTIC_RESIZED")
+            old = int(resized.split(":")[0]) if resized else None
+            advertise_buddy_map(store, self.ctx.args.job_id, world,
+                                resized_from=old)
+        except Exception as e:
+            sys.stderr.write(
+                f"[launch] hot-spare buddy-map advertise failed: {e}\n")
+
     def _spawn_one(self, local_rank, rank=None, world=None):
         args = self.ctx.args
         env = self.ctx.proc_env(local_rank, self.master,
@@ -108,6 +133,7 @@ class CollectiveController:
                 # fresh incarnation's watchdogs
                 self._trap.clear()
             world = getattr(self, "_world", None)
+            self._advertise_hot_spare(world or args.nproc_per_node)
             if world is None:
                 self.procs = [self._spawn_one(i)
                               for i in range(args.nproc_per_node)]
@@ -260,6 +286,16 @@ class ElasticCollectiveController(CollectiveController):
         # TCPStore (the same KV the KVMaster heartbeat loop polls)
         return {"PADDLE_GUARDIAN_STORE": self.master}
 
+    def _hot_spare_store(self):
+        # same TCPStore the workers' guardian_store() dials — parked
+        # snapshots advertised/held there live in the master's RAM
+        from ..store import TCPStore
+        host, _, port = str(self.master).partition(":")
+        try:
+            return TCPStore(host, int(port), timeout=5.0)
+        except Exception:
+            return None
+
     def _guardian_blame(self):
         errs = self.kv.peer_errors()
         for e in errs:
@@ -299,6 +335,7 @@ class ElasticCollectiveController(CollectiveController):
                     self._extra_env["PADDLE_ELASTIC_RESIZED"] = \
                         f"{prev_world}:{world}"
                 prev_world = world
+                self._advertise_hot_spare(world)
                 self.procs = [
                     self._spawn_one(i, rank=offset + i, world=world)
                     for i in range(args.nproc_per_node)]

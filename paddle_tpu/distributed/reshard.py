@@ -260,6 +260,22 @@ def _rebuild(tree, arrays):
     return tree
 
 
+def flatten_state(state):
+    """Public assembly seam: ``state`` tree → ``(objects_tree, arrays)``
+    with array leaves replaced by :class:`_ArrayRef` placeholders and
+    hoisted into a flat ``{key: ndarray}`` dict.  The hot-spare layer
+    (framework/hot_spare.py) serializes snapshots in exactly this shape
+    so a peer restore feeds the same rebuild path checkpoints use."""
+    arrays = {}
+    tree = _flatten(state, "", arrays)
+    return tree, arrays
+
+
+def rebuild_state(tree, arrays):
+    """Inverse of :func:`flatten_state`."""
+    return _rebuild(tree, arrays)
+
+
 # ---------------------------------------------------------------------------
 # save
 # ---------------------------------------------------------------------------

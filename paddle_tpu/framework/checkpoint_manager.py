@@ -28,6 +28,7 @@ import os
 import re
 import shutil
 import threading
+import time
 import zlib
 
 from ..utils.log import get_logger
@@ -225,6 +226,16 @@ class CheckpointManager:
         self._thread = None
         self._error = None
         os.makedirs(self.root, exist_ok=True)
+        if async_save:
+            # pre-declare at zero: an async save() landing while the
+            # prior one is still writing BLOCKS the step loop in wait()
+            # — on slow storage that stall must show up as its own
+            # series, not masquerade as step-time jitter.
+            from ..observability import registry as _registry
+            _registry.histogram(
+                "ckpt.save_blocked_ms",
+                "step-loop stall waiting for the prior async "
+                "checkpoint save")
 
     # ---- save ----
     def save(self, state, step=None, meta=None, layout=None,
@@ -248,7 +259,13 @@ class CheckpointManager:
             step = (steps[0][0] + 1) if steps else 0
         step = int(step)
         if self.async_save:
+            blocked = self._thread is not None and self._thread.is_alive()
+            t0 = time.perf_counter()
             self.wait()       # one in-flight save at a time
+            if blocked:
+                from ..observability import registry as _registry
+                _registry.histogram("ckpt.save_blocked_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
             self._reraise()
             self._thread = threading.Thread(
                 target=self._save_guarded, args=(state, step, meta,

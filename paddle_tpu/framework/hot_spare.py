@@ -1,0 +1,735 @@
+"""Hot-spare recovery: buddy-replicated in-memory shard snapshots.
+
+Every recovery path the runtime already has — guardian peer-abort,
+elastic reshard, sentinel rollback — bottoms out in a DISK checkpoint,
+so one flaky host costs all steps since the last persisted ``ckpt-N``
+plus a storage round-trip.  Gemini (SOSP '23) and CheckFreq (FAST '21)
+show that replicating shard state into *peer host RAM* at near-every-
+step cadence makes recovery seconds-fast while disk stays the
+durability backstop.  This module is that layer, built from primitives
+the repo already ships:
+
+- each rank, every ``FLAGS_hot_spare_every`` update steps, snapshots
+  its shard state (params, optimizer moments, GradScaler vec, RNG
+  counter, data-pipeline position — the exact
+  ``Model._sentinel_snapshot()`` shape) into host RAM and streams it to
+  its **ring buddy**'s RAM over the rpc ``Blob`` raw-byte fast path —
+  chunked, crc32-per-chunk, and double-buffered on the receiver: a
+  sender crash mid-transfer can never clobber the buddy's last valid
+  copy, because staged chunks only replace it at a fully-verified
+  commit;
+- buddy assignment derives from the active ``ProcessMesh`` process
+  order (ring: rank ``i``'s replica lives on the next process in mesh
+  order) and re-derives on elastic resize;
+- on a *cooperative* exit (preemption SIGTERM, clean end) the agent
+  **parks** every snapshot it holds — its own and its buddies'
+  replicas — into the guardian store, so a full-pod relaunch (the
+  controller restarts all ranks when one dies) still finds the dead
+  rank's RAM-resident state: live-RPC pull from the holder first,
+  parked copy second.  With a TCPStore guardian the parked bytes are
+  genuinely memory-resident on the controller host; the FileKVStore
+  substrate (single-host tests) stands in for it transport-wise.
+
+Recovery is a ladder tried loudest-first (docs/FAULT_TOLERANCE.md
+"Recovery ladder"):
+
+1. **peer restore** — the relaunched incarnation reads the buddy map
+   the controller advertised through the guardian store, pulls the
+   dead rank's shard from its buddy (live endpoint, then parked copy),
+   crc- and finiteness-validates it, and resumes.  Target: seconds.
+2. **sentinel rollback** prefers the newest finiteness-validated local
+   snapshot over the disk anchor when fresher (framework/sentinel.py).
+3. **disk ``restore_latest``** as today — and byte-identical to it
+   when ``FLAGS_hot_spare`` is off.
+
+Every fall-through is loud: a dead buddy, torn transfer, or corrupt
+snapshot emits a typed :class:`PeerRestoreWarning` naming the rung that
+failed before the next rung runs.  Telemetry (``ckpt.peer.*``) is
+declared at arm time so "zero peer restores" on a dashboard means
+"nothing failed", never "nobody was counting".
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import pickle
+import sys
+import threading
+import time
+import warnings
+import zlib
+
+from ..utils.flags import flag as _flag
+
+SCHEMA_VERSION = 1
+
+#: guardian-store key layout (all under ``{job}/hot_spare/``)
+_K_BUDDIES = "{job}/hot_spare/buddies"
+_K_ENDPOINT = "{job}/hot_spare/endpoints/r{rank}"
+_K_PARKED = "{job}/hot_spare/parked/r{rank}"
+
+
+class PeerSnapshotError(RuntimeError):
+    """Base class for hot-spare snapshot/restore failures."""
+
+
+class BuddyUnavailableError(PeerSnapshotError):
+    """The buddy holding this rank's replica cannot serve it (dead
+    endpoint, no parked copy, or the ``buddy_crash`` injection)."""
+
+
+class SnapshotIntegrityError(PeerSnapshotError):
+    """A peer snapshot failed crc or finiteness validation — bitrot or
+    a torn transfer that somehow reached a reader."""
+
+
+class PeerRestoreWarning(UserWarning):
+    """Typed warning emitted whenever the recovery ladder falls through
+    a rung — peer restore failing over to disk must be loud."""
+
+
+# ----------------------------------------------------------------------
+# telemetry — declared at arm time so every series exposes from zero
+# ----------------------------------------------------------------------
+def declare_metrics():
+    """Pre-register the full ``ckpt.peer.*`` family (counters at 0,
+    histograms with 0 samples) in the process registry."""
+    from ..observability import registry as _registry
+    _registry.counter("ckpt.peer.snapshots",
+                      "peer snapshots committed to a buddy's RAM")
+    _registry.counter("ckpt.peer.bytes_sent",
+                      "snapshot payload bytes streamed to buddies")
+    _registry.counter("ckpt.peer.restores",
+                      "recoveries served from a peer snapshot")
+    _registry.counter("ckpt.peer.stale_skipped",
+                      "peer snapshots consulted but older than the "
+                      "competing disk state")
+    _registry.counter("ckpt.peer.crc_failures",
+                      "snapshot chunks/payloads failing crc or "
+                      "finiteness validation")
+    _registry.histogram("ckpt.peer.transfer_ms",
+                        "wall time of one snapshot stream to the buddy")
+    _registry.histogram("ckpt.peer.restore_ms",
+                        "wall time of a peer-snapshot restore")
+    return _registry
+
+
+def _counter(name):
+    from ..observability import registry as _registry
+    return _registry.counter(name)
+
+
+def _observe(name, value):
+    from ..observability import registry as _registry
+    _registry.histogram(name).observe(value)
+
+
+# ----------------------------------------------------------------------
+# buddy ring
+# ----------------------------------------------------------------------
+def derive_buddies(world, mesh=None):
+    """``{rank: holder_rank}`` — rank ``r``'s snapshot replica lives on
+    ``buddies[r]``, the next process in ring order.  Ring order is the
+    active ``ProcessMesh``'s process order when one is installed for
+    this world size (so a hybrid mesh keeps replicas off the same
+    model-parallel group where possible), else plain rank order.  A
+    world of one has no buddy (local snapshots only)."""
+    world = int(world)
+    order = None
+    if mesh is None:
+        try:
+            from ..distributed.mesh import get_mesh
+            mesh = get_mesh()
+        except Exception:
+            mesh = None
+    if mesh is not None:
+        try:
+            pids = list(mesh.process_ids)
+            if len(pids) == world:
+                order = pids
+        except Exception:
+            order = None
+    if order is None:
+        order = list(range(world))
+    if len(order) < 2:
+        return {}
+    n = len(order)
+    return {int(order[i]): int(order[(i + 1) % n]) for i in range(n)}
+
+
+def advertise_buddy_map(store, job, world, mesh=None, resized_from=None):
+    """Write the buddy map into the guardian store (the launch
+    controller calls this each incarnation; relaunched workers read it
+    before their own mesh exists).  Returns the map."""
+    buddies = derive_buddies(world, mesh=mesh)
+    doc = {"schema": SCHEMA_VERSION, "world": int(world),
+           "buddies": {str(k): v for k, v in buddies.items()}}
+    if resized_from is not None:
+        doc["resized_from"] = int(resized_from)
+    store.set(_K_BUDDIES.format(job=job), json.dumps(doc).encode())
+    return buddies
+
+
+def read_buddy_map(store, job):
+    """The advertised ``{rank: holder}`` map, or None."""
+    raw = store.get(_K_BUDDIES.format(job=job))
+    if not raw:
+        return None
+    try:
+        doc = json.loads(bytes(raw).decode())
+        return {int(k): int(v) for k, v in doc["buddies"].items()}
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+# ----------------------------------------------------------------------
+# snapshot records + the receiver-side double-buffered store
+# ----------------------------------------------------------------------
+def pack_state(state):
+    """Host-side state tree → payload bytes (pickle of the flattened
+    reshard tree: the object skeleton plus the flat numpy arrays dict,
+    so a peer restore feeds the SAME assembly ``_resume_from`` uses)."""
+    from ..distributed.reshard import flatten_state
+    tree, arrays = flatten_state(state)
+    buf = io.BytesIO()
+    pickle.dump({"tree": tree, "arrays": arrays}, buf,
+                protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def unpack_state(payload):
+    """Payload bytes → the original state tree."""
+    from ..distributed.reshard import rebuild_state
+    doc = pickle.loads(payload)
+    return rebuild_state(doc["tree"], doc["arrays"])
+
+
+def make_record(owner, step, book, state):
+    payload = pack_state(state)
+    return {"schema": SCHEMA_VERSION, "owner": int(owner),
+            "step": int(step), "book": dict(book or {}),
+            "nbytes": len(payload), "crc": zlib.crc32(payload),
+            "payload": payload, "parked_by": None}
+
+
+def verify_record(record):
+    """crc-check a record's payload; raises SnapshotIntegrityError (and
+    counts ``ckpt.peer.crc_failures``) on mismatch."""
+    crc = zlib.crc32(record["payload"])
+    if crc != record["crc"] or len(record["payload"]) != record["nbytes"]:
+        _counter("ckpt.peer.crc_failures").inc()
+        raise SnapshotIntegrityError(
+            f"peer snapshot for rank {record.get('owner')} step "
+            f"{record.get('step')} failed crc (got {crc:#x}, recorded "
+            f"{record['crc']:#x}, {len(record['payload'])} of "
+            f"{record['nbytes']} bytes)")
+    return record
+
+
+def validated_state(record):
+    """Record → (state, book) after crc + finiteness validation.  A
+    non-finite snapshot is as dead as a torn one — counting it under
+    ``crc_failures`` keeps the single 'snapshot unusable' series."""
+    verify_record(record)
+    state = unpack_state(record["payload"])
+    from .checkpoint_manager import validate_finite_state
+    try:
+        validate_finite_state(state)
+    except Exception as e:
+        _counter("ckpt.peer.crc_failures").inc()
+        raise SnapshotIntegrityError(
+            f"peer snapshot for rank {record.get('owner')} step "
+            f"{record.get('step')} failed finiteness validation: {e}"
+        ) from e
+    return state, record["book"]
+
+
+class HotSpareStore:
+    """Receiver-side replica store: one *valid* record per owner rank
+    plus per-transfer staging buffers.  Double-buffered by protocol —
+    chunks accumulate in staging keyed by transfer id, and only a
+    commit whose every chunk arrived and whose whole-payload crc checks
+    out flips the owner's valid pointer.  A sender dying mid-transfer
+    leaves staging garbage and the previous valid copy untouched."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._valid = {}      # owner -> committed record
+        self._staging = {}    # (owner, xfer_id) -> staging dict
+
+    def begin(self, owner, xfer_id, step, book, total_chunks,
+              total_bytes, payload_crc):
+        with self._lock:
+            self._staging[(int(owner), str(xfer_id))] = {
+                "step": int(step), "book": dict(book or {}),
+                "total_chunks": int(total_chunks),
+                "total_bytes": int(total_bytes),
+                "crc": int(payload_crc), "chunks": {}, "poisoned": False}
+
+    def chunk(self, owner, xfer_id, idx, chunk_crc, data):
+        data = bytes(data)
+        key = (int(owner), str(xfer_id))
+        if zlib.crc32(data) != int(chunk_crc):
+            _counter("ckpt.peer.crc_failures").inc()
+            with self._lock:
+                st = self._staging.get(key)
+                if st is not None:
+                    st["poisoned"] = True
+            raise SnapshotIntegrityError(
+                f"chunk {idx} of transfer {xfer_id} (owner {owner}) "
+                "failed crc32 — rejected before staging")
+        with self._lock:
+            st = self._staging.get(key)
+            if st is None:
+                raise PeerSnapshotError(
+                    f"chunk for unknown transfer {xfer_id} "
+                    f"(owner {owner}) — no begin seen")
+            st["chunks"][int(idx)] = data
+
+    def commit(self, owner, xfer_id):
+        """Atomically flip the owner's valid record — or refuse.  The
+        previous valid copy survives every refusal path."""
+        key = (int(owner), str(xfer_id))
+        with self._lock:
+            st = self._staging.pop(key, None)
+        if st is None:
+            raise PeerSnapshotError(
+                f"commit for unknown transfer {xfer_id} (owner {owner})")
+        if st["poisoned"] or len(st["chunks"]) != st["total_chunks"]:
+            raise PeerSnapshotError(
+                f"transfer {xfer_id} (owner {owner}) incomplete at "
+                f"commit: {len(st['chunks'])}/{st['total_chunks']} "
+                f"chunks{' (poisoned)' if st['poisoned'] else ''}")
+        payload = b"".join(st["chunks"][i]
+                           for i in range(st["total_chunks"]))
+        if len(payload) != st["total_bytes"] or \
+                zlib.crc32(payload) != st["crc"]:
+            _counter("ckpt.peer.crc_failures").inc()
+            raise SnapshotIntegrityError(
+                f"transfer {xfer_id} (owner {owner}) payload failed "
+                "whole-payload crc at commit — last valid copy kept")
+        record = {"schema": SCHEMA_VERSION, "owner": int(owner),
+                  "step": st["step"], "book": st["book"],
+                  "nbytes": st["total_bytes"], "crc": st["crc"],
+                  "payload": payload, "parked_by": None}
+        with self._lock:
+            self._valid[int(owner)] = record
+        return record["step"]
+
+    def latest(self, owner):
+        with self._lock:
+            return self._valid.get(int(owner))
+
+    def install(self, record):
+        """Directly install a committed record (local-agent use)."""
+        with self._lock:
+            self._valid[int(record["owner"])] = record
+
+    def owners(self):
+        with self._lock:
+            return sorted(self._valid)
+
+
+#: per-job receiver stores; module-level so the rpc-served functions
+#: (pickled by reference) reach the same objects in the server process.
+_STORES: dict = {}
+_STORES_LOCK = threading.Lock()
+
+
+def store_for(job):
+    with _STORES_LOCK:
+        st = _STORES.get(str(job))
+        if st is None:
+            st = _STORES[str(job)] = HotSpareStore()
+        return st
+
+
+# ------ rpc-served endpoints (module-level: pickled by reference) -----
+def _rpc_begin(job, owner, xfer_id, step, book_json, total_chunks,
+               total_bytes, payload_crc):
+    store_for(job).begin(owner, xfer_id, step, json.loads(book_json),
+                         total_chunks, total_bytes, payload_crc)
+    return "ok"
+
+
+def _rpc_chunk(job, owner, xfer_id, idx, chunk_crc, blob):
+    data = blob.data if hasattr(blob, "data") else blob
+    store_for(job).chunk(owner, xfer_id, idx, chunk_crc, data)
+    return "ok"
+
+
+def _rpc_commit(job, owner, xfer_id):
+    return store_for(job).commit(owner, xfer_id)
+
+
+def _rpc_fetch(job, owner):
+    """Serve the newest valid replica held for ``owner`` (live peer
+    restore).  Returns pickled record bytes, or None."""
+    rec = store_for(job).latest(owner)
+    if rec is None:
+        return None
+    return pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+# ----------------------------------------------------------------------
+# the per-rank agent
+# ----------------------------------------------------------------------
+_XFER_SEQ = [0]
+
+
+def _next_xfer_id(rank):
+    _XFER_SEQ[0] += 1
+    return f"{os.getpid()}-{rank}-{_XFER_SEQ[0]}"
+
+
+class HotSpareAgent:
+    """One per training process.  Owns (a) the rank's own latest
+    snapshot record, (b) an rpc endpoint receiving buddies' streams
+    into the process-global :class:`HotSpareStore`, and (c) the
+    park-on-exit protocol."""
+
+    def __init__(self, job, rank, world, store=None, every=None,
+                 chunk_bytes=None, timeout_s=None, serve=None):
+        self.job = str(job)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.every = max(int(every if every is not None
+                             else _flag("FLAGS_hot_spare_every", 8)), 1)
+        self.chunk_bytes = max(int(
+            chunk_bytes if chunk_bytes is not None
+            else _flag("FLAGS_hot_spare_chunk_kb", 1024) * 1024), 1)
+        self.timeout_s = float(timeout_s if timeout_s is not None
+                               else _flag("FLAGS_hot_spare_timeout_s",
+                                          10.0))
+        if store is None:
+            from ..distributed.host_collectives import guardian_store
+            store = guardian_store()
+        self.store = store
+        self.buddies = derive_buddies(self.world)
+        resized = _resized_worlds()
+        if resized is not None:
+            old, new = resized
+            print(f"hot-spare: buddy ring re-derived after elastic "
+                  f"resize {old}->{new}: {self.buddies}",
+                  file=sys.stderr, flush=True)
+        self._latest = None          # own newest committed record
+        self._lock = threading.Lock()
+        self._thread = None
+        self._parked = False
+        self._server = None
+        if serve is None:
+            serve = self.world > 1
+        if serve:
+            from ..distributed.rpc.rpc import RpcServer
+            self._server = RpcServer(worker_name(self.job, self.rank))
+            if self.store is not None:
+                self.store.set(
+                    _K_ENDPOINT.format(job=self.job, rank=self.rank),
+                    json.dumps({"name": self._server.info.name,
+                                "ip": self._server.info.ip,
+                                "port": self._server.info.port,
+                                "pid": os.getpid()}).encode())
+
+    # -- snapshot side -------------------------------------------------
+    def maybe_snapshot(self, it, state_fn, book):
+        """Every ``every``-th update step, capture ``state_fn()`` and
+        stream it to the buddy on a background thread.  One transfer in
+        flight at a time — a slow buddy skips cadences instead of
+        stacking threads behind the step loop."""
+        if int(it) % self.every != 0:
+            return False
+        if self._thread is not None and self._thread.is_alive():
+            return False
+        state = state_fn()
+        self._thread = threading.Thread(
+            target=self._snapshot, args=(int(it), state, dict(book)),
+            daemon=True, name=f"hot-spare-snap-{it}")
+        self._thread.start()
+        return True
+
+    def snapshot_now(self, it, state, book):
+        """Synchronous snapshot + stream (tests, benchmarks)."""
+        self.wait()
+        self._snapshot(int(it), state, dict(book))
+
+    def _snapshot(self, it, state, book):
+        try:
+            record = make_record(self.rank, it, book, state)
+        except Exception as e:
+            print(f"hot-spare: snapshot serialization failed at it "
+                  f"{it}: {e}", file=sys.stderr, flush=True)
+            return
+        with self._lock:
+            self._latest = record
+        holder = self.buddies.get(self.rank)
+        if holder is None or self._server is None:
+            return
+        try:
+            self._stream(record, holder)
+        except Exception as e:
+            # a dead/slow buddy must never take the training loop down;
+            # the local copy + the disk ladder below still stand
+            print(f"hot-spare: stream to buddy rank {holder} failed: "
+                  f"{e}", file=sys.stderr, flush=True)
+
+    def _stream(self, record, holder):
+        from ..distributed.rpc.rpc import Blob, rpc_sync
+        from ..utils import fault_injection as _fi
+        to = self._resolve(holder)
+        if to is None:
+            return False
+        payload = record["payload"]
+        chunks = [payload[i:i + self.chunk_bytes]
+                  for i in range(0, len(payload), self.chunk_bytes)] \
+            or [b""]
+        xfer = _next_xfer_id(self.rank)
+        t0 = time.perf_counter()
+        rpc_sync(to, _rpc_begin,
+                 (self.job, self.rank, xfer, record["step"],
+                  json.dumps(record["book"]), len(chunks),
+                  record["nbytes"], record["crc"]),
+                 timeout=self.timeout_s)
+        drop = _fi.check_peer_snap_drop(record["step"])
+        stop_after = drop.get("after_chunks", 1) if drop is not None \
+            else None
+        for i, chunk in enumerate(chunks):
+            if stop_after is not None and i >= stop_after:
+                # injected sender death mid-transfer: staging is left
+                # torn, no commit — the buddy's last valid copy stands
+                return False
+            rpc_sync(to, _rpc_chunk,
+                     (self.job, self.rank, xfer, i, zlib.crc32(chunk),
+                      Blob(chunk)), timeout=self.timeout_s)
+        rpc_sync(to, _rpc_commit, (self.job, self.rank, xfer),
+                 timeout=self.timeout_s)
+        ms = (time.perf_counter() - t0) * 1e3
+        _counter("ckpt.peer.snapshots").inc()
+        _counter("ckpt.peer.bytes_sent").inc(record["nbytes"])
+        _observe("ckpt.peer.transfer_ms", ms)
+        return True
+
+    def _resolve(self, holder):
+        """Worker name for ``holder``'s hot-spare endpoint, registering
+        it from the guardian store when not already known locally."""
+        name = worker_name(self.job, holder)
+        if self.store is not None:
+            raw = self.store.get(
+                _K_ENDPOINT.format(job=self.job, rank=holder))
+            if raw:
+                try:
+                    ep = json.loads(bytes(raw).decode())
+                    from ..distributed.rpc.rpc import connect_worker
+                    connect_worker(ep["name"], ep["ip"], ep["port"])
+                    return ep["name"]
+                except (ValueError, KeyError):
+                    pass
+        return name
+
+    # -- local/latest accessors ----------------------------------------
+    def latest_record(self):
+        with self._lock:
+            return self._latest
+
+    def wait(self, timeout=None):
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout if timeout is not None else self.timeout_s)
+
+    # -- park-on-exit --------------------------------------------------
+    def park(self):
+        """Persist every RAM-resident snapshot — own latest + all held
+        buddy replicas — into the guardian store, so the state survives
+        the full-pod relaunch a cooperative exit precedes.  Idempotent;
+        called from the preemption path and from close()."""
+        if self._parked:
+            return 0
+        self.wait()
+        if self.store is None:
+            return 0
+        parked = 0
+        records = []
+        own = self.latest_record()
+        if own is not None:
+            records.append(own)
+        held = store_for(self.job)
+        for owner in held.owners():
+            rec = held.latest(owner)
+            if rec is not None and rec["owner"] != self.rank:
+                records.append(rec)
+        for rec in records:
+            rec = dict(rec)
+            rec["parked_by"] = self.rank
+            try:
+                self.store.set(
+                    _K_PARKED.format(job=self.job, rank=rec["owner"]),
+                    pickle.dumps(rec,
+                                 protocol=pickle.HIGHEST_PROTOCOL))
+                parked += 1
+            except Exception as e:
+                print(f"hot-spare: parking snapshot for rank "
+                      f"{rec['owner']} failed: {e}", file=sys.stderr,
+                      flush=True)
+        self._parked = True
+        return parked
+
+    def close(self, park=True):
+        if park:
+            self.park()
+        else:
+            self.wait()
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        global _AGENT
+        if _AGENT is self:
+            _AGENT = None
+
+
+def worker_name(job, rank):
+    return f"hotspare:{job}:r{int(rank)}"
+
+
+def _resized_worlds():
+    try:
+        from ..distributed.fleet.elastic import resized_worlds
+        return resized_worlds()
+    except Exception:
+        return None
+
+
+# ----------------------------------------------------------------------
+# module-level agent registry (one armed agent per process)
+# ----------------------------------------------------------------------
+_AGENT = None
+
+
+def arm(rank, world, job=None, store=None, **kw):
+    """Declare the telemetry family and install the process agent.
+    Re-arming replaces (and closes) a previous agent."""
+    global _AGENT
+    declare_metrics()
+    if _AGENT is not None:
+        _AGENT.close(park=False)
+    job = job if job is not None else os.environ.get("PADDLE_JOB_ID",
+                                                     "default")
+    _AGENT = HotSpareAgent(job, rank, world, store=store, **kw)
+    return _AGENT
+
+
+def disarm(park=False):
+    global _AGENT
+    if _AGENT is not None:
+        _AGENT.close(park=park)
+        _AGENT = None
+
+
+def current_agent():
+    return _AGENT
+
+
+def sentinel_candidate():
+    """The armed agent's newest finiteness-validated local snapshot as
+    ``(state, book)``, or None.  The sentinel consults this at rollback
+    escalation: a validated peer snapshot fresher than the disk anchor
+    loses fewer steps (rung 2 of the ladder)."""
+    agent = _AGENT
+    if agent is None:
+        return None
+    rec = agent.latest_record()
+    if rec is None:
+        return None
+    try:
+        return validated_state(rec)
+    except PeerSnapshotError as e:
+        warnings.warn(f"hot-spare: local snapshot unusable for "
+                      f"sentinel rollback ({e}); falling back to the "
+                      "disk anchor", PeerRestoreWarning, stacklevel=2)
+        return None
+
+
+# ----------------------------------------------------------------------
+# the recovery ladder (restore side)
+# ----------------------------------------------------------------------
+def peer_restore(job, rank, store=None, timeout_s=None):
+    """Rung 1: pull ``rank``'s shard from its buddy's RAM.  Tries the
+    holder's live rpc endpoint first, then the parked guardian-store
+    copy.  Returns ``(state, book, source)`` with source ``"peer"`` (a
+    buddy's replica) or ``"self"`` (this rank's own parked copy), or
+    None when no snapshot exists.  Raises
+    :class:`BuddyUnavailableError` when the ``buddy_crash`` injection
+    is armed for this rank, and :class:`SnapshotIntegrityError` when
+    the only available snapshot fails validation."""
+    if store is None:
+        from ..distributed.host_collectives import guardian_store
+        store = guardian_store()
+    if store is None:
+        return None
+    rank = int(rank)
+    timeout_s = float(timeout_s if timeout_s is not None
+                      else _flag("FLAGS_hot_spare_timeout_s", 10.0))
+    buddies = read_buddy_map(store, job) or {}
+    holder = buddies.get(rank)
+    from ..utils import fault_injection as _fi
+    t0 = time.perf_counter()
+    raw = None
+    # 1a: the holder may still be alive and serving
+    if holder is not None:
+        if _fi.check_buddy_crash() is not None:
+            raise BuddyUnavailableError(
+                f"buddy rank {holder} holding rank {rank}'s replica is "
+                "down (injected buddy_crash)")
+        ep_raw = store.get(_K_ENDPOINT.format(job=job, rank=holder))
+        if ep_raw:
+            try:
+                ep = json.loads(bytes(ep_raw).decode())
+                from ..distributed.rpc.rpc import (connect_worker,
+                                                   rpc_sync)
+                connect_worker(ep["name"], ep["ip"], ep["port"])
+                raw = rpc_sync(ep["name"], _rpc_fetch, (job, rank),
+                               timeout=timeout_s)
+            except (ConnectionError, TimeoutError, OSError, ValueError,
+                    KeyError):
+                raw = None
+    # 1b: the holder parked its replicas before exiting
+    if raw is None:
+        raw = store.get(_K_PARKED.format(job=job, rank=rank))
+    if raw is None:
+        if holder is not None and _fi.active("buddy_crash") is not None:
+            raise BuddyUnavailableError(
+                f"no live endpoint and no parked snapshot for rank "
+                f"{rank} (holder rank {holder})")
+        return None
+    record = pickle.loads(bytes(raw))
+    state, book = validated_state(record)
+    parked_by = record.get("parked_by")
+    source = "self" if parked_by == rank else "peer"
+    ms = (time.perf_counter() - t0) * 1e3
+    _counter("ckpt.peer.restores").inc()
+    _observe("ckpt.peer.restore_ms", ms)
+    print(f"hot-spare: rank {rank} restored from {source} snapshot "
+          f"(step {record['step']}, {record['nbytes']} bytes, "
+          f"{ms:.0f}ms)", file=sys.stderr, flush=True)
+    return state, book, source
+
+
+def restore_with_ladder(job, rank, disk_fn, store=None, timeout_s=None):
+    """Run the recovery ladder loudest-first.  Rung 1 is
+    :func:`peer_restore`; every failure there emits a typed
+    :class:`PeerRestoreWarning` and falls through to ``disk_fn`` (rung
+    3 — the caller's existing ``restore_latest`` path, which must
+    return ``(state, book, "disk")`` or None)."""
+    declare_metrics()
+    got = None
+    try:
+        got = peer_restore(job, rank, store=store, timeout_s=timeout_s)
+    except PeerSnapshotError as e:
+        msg = (f"hot-spare: peer restore failed for rank {rank} "
+               f"({type(e).__name__}: {e}); falling back to disk")
+        warnings.warn(msg, PeerRestoreWarning, stacklevel=2)
+        print(f"PeerRestoreWarning: {msg}", file=sys.stderr, flush=True)
+    if got is not None:
+        return got
+    if disk_fn is None:
+        return None
+    return disk_fn()

@@ -558,6 +558,23 @@ class TrainingSentinel:
                     "relaunch without it")
             return None
         anchor = self._load_anchor()
+        # rung 2 of the recovery ladder (docs/FAULT_TOLERANCE.md): the
+        # hot-spare agent's newest finiteness-validated snapshot beats
+        # the disk anchor when it is FRESHER — fewer iterations redone.
+        # A staler snapshot is skipped (and counted) so a long-parked
+        # replica can never rewind past a newer disk anchor.
+        restored_from = "anchor"
+        candidate = self._peer_candidate()
+        if candidate is not None:
+            from ..observability import registry as _registry
+            cand_it = int(candidate[1].get("it", 0))
+            anchor_it = int(anchor[1].get("it", -1)) if anchor else -1
+            if cand_it > anchor_it:
+                anchor = candidate
+                restored_from = "peer-snapshot"
+                _registry.counter("ckpt.peer.restores").inc()
+            else:
+                _registry.counter("ckpt.peer.stale_skipped").inc()
         if anchor is None:
             self.dump(action="no-anchor", step=it)
             self._log.warning("sentinel: rollback wanted (%s) but no "
@@ -577,10 +594,21 @@ class TrainingSentinel:
         self.dump(action="rollback", step=it,
                   anchor_step=directive.it)
         self._log.warning(
-            "sentinel: %s at iteration %d — rolled back to anchor "
+            "sentinel: %s at iteration %d — rolled back to %s "
             "(it=%d, epoch=%d), %d iteration(s) quarantined", reason,
-            it, directive.it, directive.epoch, len(self._quarantine))
+            it, restored_from, directive.it, directive.epoch,
+            len(self._quarantine))
         return directive
+
+    def _peer_candidate(self):
+        """The hot-spare agent's newest validated local snapshot as
+        ``(state, book)``, or None (flag off / no agent / no snapshot /
+        validation failure — the last already warned loudly)."""
+        from ..utils.flags import flag as _flag
+        if not _flag("FLAGS_hot_spare", False):
+            return None
+        from . import hot_spare
+        return hot_spare.sentinel_candidate()
 
     # ---- dump ----------------------------------------------------------
     def dump(self, action, step, anchor_step=None, per_rank=None,

@@ -361,6 +361,14 @@ class Model:
         # seam below is a single attr read.
         sentinel = self._install_sentinel(ckpt_cb)
 
+        # hot-spare recovery (framework/hot_spare.py,
+        # docs/FAULT_TOLERANCE.md "Recovery ladder"): periodic host-RAM
+        # snapshots streamed to the ring-buddy rank, parked into the
+        # guardian store on cooperative exits, so a relaunch restores
+        # from peer memory before touching disk.  Off (default): None,
+        # and every seam below is a single attr read.
+        hot_spare_agent = self._install_hot_spare(ckpt_cb)
+
         # unified telemetry (docs/OBSERVABILITY.md): step-time histogram,
         # examples/tokens-per-sec, MFU, memory watermarks — published into
         # the metrics registry; exporter thread only if the flag names a
@@ -439,6 +447,10 @@ class Model:
                         self._sync_compiled_state()
                         ckpt_cb.save_now(next_epoch=epoch)
                         ckpt_cb.manager.wait()
+                        if hot_spare_agent is not None:
+                            # RAM dies with the relaunch: park every
+                            # held snapshot into the guardian store
+                            hot_spare_agent.park()
                         handler.uninstall()
                         handler.exit_for_relaunch()
                     if sentinel is not None:
@@ -447,6 +459,14 @@ class Model:
                     it += 1
                     if rollback is not None:
                         break
+                    if hot_spare_agent is not None:
+                        # book says "resume at iteration `it`": the
+                        # step just completed is already inside the
+                        # snapshot, so a peer restore loses nothing
+                        hot_spare_agent.maybe_snapshot(
+                            it, self._sentinel_snapshot,
+                            {"it": it, "epoch": epoch,
+                             "next_step": step + 1, "next_epoch": epoch})
                     if num_iters and it >= num_iters:
                         break
                 if rollback is None and sentinel is not None:
@@ -478,6 +498,8 @@ class Model:
         finally:
             if handler is not None:
                 handler.uninstall()
+            if hot_spare_agent is not None:
+                hot_spare_agent.close(park=True)
             self._sentinel = None
             self._fi_step = None
         cbs.call("on_train_end", logs)
@@ -527,6 +549,18 @@ class Model:
         self._sentinel = TrainingSentinel(
             self, manager=manager, nranks=self._nranks, rank=self._rank)
         return self._sentinel
+
+    def _install_hot_spare(self, ckpt_cb):
+        """Arm the fit-scoped hot-spare agent when FLAGS_hot_spare is
+        on (returns None otherwise).  Snapshot capture reuses
+        :meth:`_sentinel_snapshot` — the peer replica carries exactly
+        the state a sentinel anchor does (params, optimizer moments,
+        GradScaler vec, RNG counter, data-pipeline position)."""
+        from ..utils.flags import flag
+        if not flag("FLAGS_hot_spare", False):
+            return None
+        from ..framework import hot_spare
+        return hot_spare.arm(rank=self._rank, world=self._nranks)
 
     def _sentinel_snapshot(self):
         """Host-copied model/optimizer/scaler state for the sentinel's
@@ -643,6 +677,20 @@ class Model:
         if not resume_dir:
             raise ValueError(
                 "fit(resume=True) needs save_dir (or resume=<dir>)")
+        # rung 1 of the recovery ladder: a relaunched incarnation pulls
+        # its shard from the buddy's RAM (or the parked guardian-store
+        # copy) before touching disk.  Any rung-1 failure warned loudly
+        # inside restore_with_ladder and we fall through to rung 3.
+        from ..utils.flags import flag as _flag
+        if _flag("FLAGS_hot_spare", False):
+            from ..framework import hot_spare
+            got = hot_spare.restore_with_ladder(
+                os.environ.get("PADDLE_JOB_ID", "default"), self._rank,
+                disk_fn=None)
+            if got is not None:
+                state, book, _source = got
+                self._sentinel_restore(state)
+                return int(book.get("next_epoch", book.get("epoch", 0)))
         from ..distributed.reshard import restore_latest_resharded
         restored = restore_latest_resharded(
             str(resume_dir), self._resume_target_mesh(), self._rank)

@@ -34,7 +34,11 @@ from .flags import flag
 KNOWN_POINTS = {
     "ckpt_write": {"after_bytes": int, "mode": str, "file": str,
                    "exit": int},
-    "step": {"crash_at": int, "sigterm_at": int, "exit": int},
+    # `rank` filters on the global rank and `once_file` fires once per
+    # path — a relaunched incarnation that resumes AT the crash step
+    # (peer restore loses no completed steps) must not re-die there.
+    "step": {"crash_at": int, "sigterm_at": int, "exit": int,
+             "rank": int, "once_file": str},
     # hang-guardian drills (distributed/watchdog.py, docs/RESILIENCE.md).
     # Both filter on op name / per-group collective sequence / global
     # rank; `once_file` makes the injection fire once per path (the file
@@ -96,6 +100,18 @@ KNOWN_POINTS = {
     # changes).
     "data_slow": {"delay_s": float, "every": int, "count": int},
     "data_corrupt": {"at_sample": int, "every": int, "count": int},
+    # hot-spare recovery drills (framework/hot_spare.py,
+    # docs/FAULT_TOLERANCE.md "Recovery ladder").  `peer_snap_drop`
+    # kills a snapshot stream mid-transfer — the sender stops after
+    # `after_chunks` chunks (default 1) without committing, proving the
+    # buddy's double buffer keeps its last valid copy.  `buddy_crash`
+    # makes the peer-restore rung see a dead buddy (live endpoint and
+    # parked copy both refused), forcing the loud fall-through to disk.
+    # Both filter on the fit loop's `at_step` / the global `rank` and
+    # honor a `count` total-fire budget, like the sentinel points.
+    "peer_snap_drop": {"at_step": int, "rank": int, "count": int,
+                       "after_chunks": int},
+    "buddy_crash": {"at_step": int, "rank": int, "count": int},
 }
 
 _IDENT = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
@@ -255,13 +271,27 @@ def check_step(step):
     """Training loops call this once per step.  ``crash_at=N`` hard-exits
     at step N (simulated hard fault); ``sigterm_at=N`` delivers SIGTERM to
     the current process (simulated preemption notice) so the installed
-    PreemptionHandler path is exercised end to end."""
+    PreemptionHandler path is exercised end to end.  ``rank=R`` filters
+    on the global rank and ``once_file=PATH`` fires once per path (the
+    file is created on first fire) — hot-spare peer restore resumes AT
+    the crash step, so without it the relaunched incarnation would
+    re-die at the same step forever."""
     params = active("step")
     if params is None:
         return
-    if params.get("crash_at") == step:
-        _crash(params)
-    if params.get("sigterm_at") == step:
+    if "rank" in params:
+        if params["rank"] != int(os.environ.get("PADDLE_TRAINER_ID", "0")):
+            return
+    if params.get("crash_at") == step or params.get("sigterm_at") == step:
+        once = params.get("once_file")
+        if once:
+            try:
+                fd = os.open(once, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+            except FileExistsError:
+                return
+        if params.get("crash_at") == step:
+            _crash(params)
         os.kill(os.getpid(), signal.SIGTERM)
 
 
@@ -407,3 +437,50 @@ def data_record_corrupt(sample_id):
         if sid % max(params["every"], 1) != 0:
             return False
     return _data_spend("data_corrupt", params)
+
+
+#: remaining-fire budgets for the hot-spare ladder points
+#: (peer_snap_drop / buddy_crash); re-armed when the spec changes.
+_LADDER_STATE = {"raw": "", "counts": {}}
+
+
+def _ladder_point(point, step):
+    """Params for an armed hot-spare ladder point, else None.  Same
+    ``at_step``/``rank``/``count`` semantics as the sentinel points,
+    except ``step=None`` (a restore-time consult, where no step exists
+    yet) matches any point WITHOUT an ``at_step`` filter instead of
+    never matching."""
+    params = active(point)
+    if params is None:
+        return None
+    if "at_step" in params:
+        if step is None or params["at_step"] != int(step):
+            return None
+    if "rank" in params:
+        if params["rank"] != int(os.environ.get("PADDLE_TRAINER_ID", "0")):
+            return None
+    raw = flag("FLAGS_fault_inject", "") or ""
+    if _LADDER_STATE["raw"] != raw:
+        _LADDER_STATE["raw"] = raw
+        _LADDER_STATE["counts"] = {}
+    if "count" in params:
+        left = _LADDER_STATE["counts"].get(point, params["count"])
+        if left <= 0:
+            return None
+        _LADDER_STATE["counts"][point] = left - 1
+    return params
+
+
+def check_peer_snap_drop(step):
+    """The ``peer_snap_drop`` seam (hot_spare snapshot stream): a
+    non-None return makes the sender die after ``after_chunks`` chunks
+    (default 1) without committing — a mid-transfer crash the buddy's
+    double buffer must survive."""
+    return _ladder_point("peer_snap_drop", step)
+
+
+def check_buddy_crash(step=None):
+    """The ``buddy_crash`` seam (hot_spare peer-restore rung): a
+    non-None return means the buddy holding this rank's replica must be
+    treated as dead, forcing the ladder's loud fall-through to disk."""
+    return _ladder_point("buddy_crash", step)

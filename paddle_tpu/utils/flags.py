@@ -208,6 +208,26 @@ _FLAGS: dict[str, Any] = {
     # request would exceed the cap), so a long-lived engine's registry
     # converges instead of growing per request.
     "FLAGS_serving_request_label_cap": 1024,
+    # hot-spare recovery (framework/hot_spare.py, docs/FAULT_TOLERANCE.md
+    # "Recovery ladder"): each rank periodically snapshots its shard
+    # state into host RAM and streams it — chunked, crc32-per-chunk,
+    # double-buffered — to its ring-buddy rank's RAM over the rpc Blob
+    # fast path, so a relaunched incarnation restores from a peer's
+    # memory in seconds instead of re-reading disk.  Off (default):
+    # training and resume are byte-identical to the module never
+    # existing (disk restore_latest stays the only rung).
+    "FLAGS_hot_spare": False,
+    # update steps between peer snapshots.  Lower = fewer steps lost on
+    # a crash, more host-RAM churn and rpc bytes.
+    "FLAGS_hot_spare_every": 8,
+    # snapshot stream chunk size (KiB): each chunk carries its own
+    # crc32 and rides the rpc Blob raw path; the buddy only flips its
+    # valid copy at a fully-verified commit.
+    "FLAGS_hot_spare_chunk_kb": 1024,
+    # per-rpc timeout for snapshot streaming and peer-restore pulls; a
+    # buddy slower than this skips the cadence (stream) or fails the
+    # ladder rung loudly (restore) rather than wedging the step loop.
+    "FLAGS_hot_spare_timeout_s": 10.0,
 }
 
 

@@ -1,0 +1,118 @@
+"""Hot-spare recovery drill worker (docs/FAULT_TOLERANCE.md "Recovery
+ladder").
+
+Replicated training whose loss trajectory is rank- and world-invariant:
+every rank computes the FULL deterministic global batch (no collectives,
+so a hard-killed peer can never wedge the survivor) and keeps its running
+loss list INSIDE the snapshot state, so whatever rung restores the state
+also restores the trajectory.  Each rank additionally writes its own
+per-step disk checkpoint under ``ckpts/r{rank}`` — rung 3 of the ladder,
+and what the ``buddy_crash`` variant must loudly fall through to.
+
+Drill flow (tests/test_hot_spare.py, tools/run_ci.sh hot-spare lane):
+``FLAGS_fault_inject=step:crash_at=3,rank=1,once_file=...`` hard-kills
+rank 1 at the top of step 3 (exit 23 — a hard fault, not a cooperative
+relaunch).  The surviving rank parks its RAM-held snapshots — its own
+and the dead rank's replica — into the guardian store on the SIGTERM
+the controller follows up with (or at clean completion); the relaunched
+incarnation then climbs the ladder.  Each incarnation appends
+``rank:world:start_step:restored_from`` to ``incarnations.log`` —
+``restored_from=peer`` with start_step=3 is the acceptance line: the
+dead rank resumed from its buddy's memory, zero ckpt payload reads.
+Rank 0 of the completing incarnation writes ``losses.json``.
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.distributed.fleet.elastic import PreemptionHandler  # noqa: E402
+from paddle_tpu.framework import hot_spare  # noqa: E402
+from paddle_tpu.framework.checkpoint_manager import CheckpointManager  # noqa: E402
+from paddle_tpu.utils import fault_injection  # noqa: E402
+
+TOTAL_STEPS = 6
+GLOBAL_BATCH = 8
+IN_DIM, HID_DIM, OUT_DIM = 6, 16, 4
+
+
+def global_batch(step):
+    rng = np.random.default_rng(1000 + step)   # data keyed by step only
+    x = rng.standard_normal((GLOBAL_BATCH, IN_DIM)).astype("float32")
+    y = rng.standard_normal((GLOBAL_BATCH, OUT_DIM)).astype("float32")
+    return x, y
+
+
+def main():
+    outdir = sys.argv[1]
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+
+    paddle.seed(7)
+    model = nn.Sequential(nn.Linear(IN_DIM, HID_DIM), nn.Tanh(),
+                          nn.Linear(HID_DIM, OUT_DIM))
+    opt = paddle.optimizer.AdamW(5e-3, parameters=model.parameters())
+
+    ckpt = CheckpointManager(os.path.join(outdir, "ckpts", f"r{rank}"),
+                             max_to_keep=3)
+    handler = PreemptionHandler().install()
+    # every=1: a snapshot after every step, streamed synchronously below
+    # so the replica is committed before the next step can crash us
+    agent = hot_spare.arm(rank, world, job=job, every=1)
+
+    def disk_restore():
+        restored = ckpt.restore_latest()
+        if restored is None:
+            return None
+        state, _step = restored
+        return state, {"step": int(state["step"])}, "disk"
+
+    start_step, losses, source = 0, [], "none"
+    got = hot_spare.restore_with_ladder(job, rank, disk_fn=disk_restore)
+    if got is not None:
+        state, book, source = got
+        model.set_state_dict(state["model"])
+        opt.set_state_dict(state["optimizer"])
+        start_step = int(book["step"]) + 1
+        losses = [float(v) for v in state["losses"]]
+    with open(os.path.join(outdir, "incarnations.log"), "a") as f:
+        f.write(f"{rank}:{world}:{start_step}:{source}\n")
+
+    for step in range(start_step, TOTAL_STEPS):
+        fault_injection.check_step(step)
+        x, y = global_batch(step)
+        xb, yb = paddle.to_tensor(x), paddle.to_tensor(y)
+        loss = ((model(xb) - yb) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(round(float(loss.numpy()), 6))
+
+        state = {"model": {k: np.asarray(v._data_) for k, v in
+                           model.state_dict().items()},
+                 "optimizer": opt.state_dict(),
+                 "step": step, "losses": list(losses)}
+        ckpt.save(state, step=step)
+        agent.snapshot_now(step, state, {"step": step})
+
+        if handler.preempted():
+            agent.park()
+            handler.uninstall()
+            handler.exit_for_relaunch()
+
+    if rank == 0:
+        with open(os.path.join(outdir, "losses.json"), "w") as f:
+            json.dump(losses, f)
+    agent.close(park=True)
+
+
+if __name__ == "__main__":
+    main()

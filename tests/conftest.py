@@ -123,6 +123,11 @@ _SLOW_TESTS = {
     "test_fault_tolerance.py::test_drill_sigterm_preemption_relaunch_resumes",  # 5
     "test_train_step.py::test_dp_psum_matches_two_proc_sync_grads_drill",       # 5
     "test_launch_elastic.py::test_scale_in_dead_pod_triggers_rebuild",          # 5
+    # r20 hot-spare recovery drills (2-proc controller relaunch each;
+    # run_ci.sh runs the peer-restore drill in its own bounded lane and
+    # the fast in-process ladder tests stay tier-1)
+    "test_hot_spare.py::test_hot_spare_drill_peer_restore",
+    "test_hot_spare.py::test_hot_spare_drill_buddy_crash_falls_to_disk",
 }
 
 

@@ -1,0 +1,447 @@
+"""Hot-spare recovery: buddy-replicated in-memory snapshots and the
+peer-restore-first recovery ladder (framework/hot_spare.py,
+docs/FAULT_TOLERANCE.md "Recovery ladder").
+
+Fast tests cover each rung's mechanics in-process — double-buffer
+integrity under a mid-transfer kill, crc bitrot falling to disk loudly,
+buddy remap on resize, sentinel-prefers-fresher-peer-snapshot, flag-off
+bitwise identity, the save_blocked_ms satellite.  The 2-proc subprocess
+drills (slow-marked per the conftest convention) kill a rank mid-epoch
+and assert the relaunch restores from the surviving buddy's memory.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.store import FileKVStore
+from paddle_tpu.framework import hot_spare
+from paddle_tpu.framework.checkpoint_manager import CheckpointManager
+from paddle_tpu.framework.hot_spare import (
+    BuddyUnavailableError, HotSpareStore, PeerRestoreWarning,
+    PeerSnapshotError, SnapshotIntegrityError)
+from paddle_tpu.observability import registry
+from paddle_tpu.utils import fault_injection
+
+WORKER = os.path.join(os.path.dirname(__file__), "_hot_spare_worker.py")
+
+
+@pytest.fixture
+def flags():
+    keys = ("FLAGS_hot_spare", "FLAGS_hot_spare_every",
+            "FLAGS_hot_spare_chunk_kb", "FLAGS_hot_spare_timeout_s",
+            "FLAGS_fault_inject", "FLAGS_sentinel")
+    old = {k: paddle.get_flags([k])[k] for k in keys}
+    yield paddle.set_flags
+    paddle.set_flags(old)
+    hot_spare.disarm()
+
+
+def _record(owner, step, nbytes=20000):
+    rng = np.random.default_rng(step)
+    state = {"w": rng.standard_normal(nbytes // 8).astype(np.float64),
+             "step": step}
+    return hot_spare.make_record(owner, step,
+                                 {"it": step, "epoch": 0,
+                                  "next_step": step}, state)
+
+
+def _send(store, rec, chunk=4096, upto=None, xfer="x", commit=True,
+          corrupt_chunk=None):
+    """Drive the receiver protocol by hand (what Agent._stream does)."""
+    payload = rec["payload"]
+    chunks = [payload[i:i + chunk] for i in range(0, len(payload), chunk)]
+    store.begin(rec["owner"], xfer, rec["step"], rec["book"],
+                len(chunks), rec["nbytes"], rec["crc"])
+    import zlib
+    for i, c in enumerate(chunks):
+        if upto is not None and i >= upto:
+            return None                      # sender died mid-transfer
+        if i == corrupt_chunk:
+            store.chunk(rec["owner"], xfer, i, zlib.crc32(c),
+                        c[:-1] + bytes([c[-1] ^ 0xFF]))
+        else:
+            store.chunk(rec["owner"], xfer, i, zlib.crc32(c), c)
+    if commit:
+        return store.commit(rec["owner"], xfer)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# receiver double buffer + crc
+# ---------------------------------------------------------------------------
+
+def test_double_buffer_keeps_last_valid_on_mid_transfer_kill():
+    store = HotSpareStore()
+    assert _send(store, _record(0, step=1), xfer="g1") == 1
+    # generation 2 dies mid-transfer: staged chunks never committed
+    _send(store, _record(0, step=2), xfer="g2", upto=2, commit=False)
+    assert store.latest(0)["step"] == 1      # last valid copy untouched
+    # a commit for the torn transfer is refused, valid copy still 1
+    with pytest.raises(PeerSnapshotError):
+        store.commit(0, "g2")
+    assert store.latest(0)["step"] == 1
+    # generation 3 lands whole and flips the buffer
+    assert _send(store, _record(0, step=3), xfer="g3") == 3
+    rec = store.latest(0)
+    assert rec["step"] == 3
+    hot_spare.verify_record(rec)             # committed copy is intact
+
+
+def test_chunk_crc_bitrot_rejected_and_counted():
+    store = HotSpareStore()
+    _send(store, _record(0, step=1), xfer="ok")
+    before = registry.counter("ckpt.peer.crc_failures").value
+    with pytest.raises(SnapshotIntegrityError):
+        _send(store, _record(0, step=2), xfer="rot", corrupt_chunk=1)
+    assert registry.counter("ckpt.peer.crc_failures").value > before
+    # the poisoned transfer can never commit; last valid copy stands
+    with pytest.raises(PeerSnapshotError):
+        store.commit(0, "rot")
+    assert store.latest(0)["step"] == 1
+
+
+def test_ladder_falls_to_disk_loudly_on_bitrot(tmp_path, flags):
+    """A bit-rotted parked snapshot fails validation → typed warning →
+    rung 3 (the caller's disk restore) serves the state."""
+    store = FileKVStore(str(tmp_path))
+    hot_spare.advertise_buddy_map(store, "rot", 2)
+    rec = dict(_record(1, step=4))
+    rec["parked_by"] = 0
+    rec["payload"] = rec["payload"][:-1] + \
+        bytes([rec["payload"][-1] ^ 0xFF])   # flip one bit
+    import pickle
+    store.set("rot/hot_spare/parked/r1", pickle.dumps(rec))
+    disk = {"model": "from-disk"}
+    before = registry.counter("ckpt.peer.crc_failures").value
+    os.environ["PADDLE_TRAINER_ID"] = "1"
+    try:
+        with pytest.warns(PeerRestoreWarning, match="falling back"):
+            got = hot_spare.restore_with_ladder(
+                "rot", 1, disk_fn=lambda: (disk, {"step": 0}, "disk"),
+                store=store)
+    finally:
+        os.environ.pop("PADDLE_TRAINER_ID", None)
+    assert got[2] == "disk" and got[0] is disk
+    assert registry.counter("ckpt.peer.crc_failures").value > before
+
+
+def test_buddy_crash_injection_forces_disk(tmp_path, flags):
+    store = FileKVStore(str(tmp_path))
+    hot_spare.advertise_buddy_map(store, "bc", 2)
+    import pickle
+    store.set("bc/hot_spare/parked/r1",
+              pickle.dumps(dict(_record(1, step=4), parked_by=0)))
+    flags({"FLAGS_fault_inject": "buddy_crash:count=1"})
+    with pytest.raises(BuddyUnavailableError):
+        hot_spare.peer_restore("bc", 1, store=store)
+    # budget spent: the next consult sees a healthy buddy again
+    got = hot_spare.peer_restore("bc", 1, store=store)
+    assert got is not None and got[2] == "peer"
+
+
+# ---------------------------------------------------------------------------
+# buddy ring derivation
+# ---------------------------------------------------------------------------
+
+def test_buddy_remap_on_resize():
+    four = hot_spare.derive_buddies(4)
+    assert four == {0: 1, 1: 2, 2: 3, 3: 0}
+    two = hot_spare.derive_buddies(2)         # 4 -> 2 elastic resize
+    assert two == {0: 1, 1: 0}
+    assert hot_spare.derive_buddies(1) == {}  # no buddy, local only
+
+
+def test_buddy_ring_follows_mesh_process_order():
+    from types import SimpleNamespace
+    mesh = SimpleNamespace(process_ids=[2, 0, 3, 1])
+    got = hot_spare.derive_buddies(4, mesh=mesh)
+    assert got == {2: 0, 0: 3, 3: 1, 1: 2}
+    # a mesh for a DIFFERENT world is ignored, not half-applied
+    assert hot_spare.derive_buddies(2, mesh=mesh) == {0: 1, 1: 0}
+
+
+def test_advertised_map_round_trips(tmp_path):
+    store = FileKVStore(str(tmp_path))
+    sent = hot_spare.advertise_buddy_map(store, "adv", 4,
+                                         resized_from=8)
+    assert hot_spare.read_buddy_map(store, "adv") == sent
+
+
+# ---------------------------------------------------------------------------
+# agent stream + park + restore (in-process, real rpc sockets)
+# ---------------------------------------------------------------------------
+
+def test_agent_stream_park_and_peer_restore(tmp_path, flags):
+    store = FileKVStore(str(tmp_path))
+    hot_spare.advertise_buddy_map(store, "agents", 2)
+    a0 = hot_spare.HotSpareAgent("agents", 0, 2, store=store, every=1,
+                                 chunk_bytes=4096)
+    a1 = hot_spare.HotSpareAgent("agents", 1, 2, store=store, every=1,
+                                 chunk_bytes=4096)
+    try:
+        state = {"w": np.arange(6000, dtype=np.float32), "step": 2}
+        sent_before = registry.counter("ckpt.peer.snapshots").value
+        a1.snapshot_now(2, state, {"it": 3, "epoch": 0, "next_step": 3})
+        assert registry.counter("ckpt.peer.snapshots").value > sent_before
+        # live pull: rank 1's replica served from rank 0's RAM
+        got = hot_spare.peer_restore("agents", 1, store=store)
+        assert got is not None and got[2] == "peer"
+        np.testing.assert_array_equal(got[0]["w"], state["w"])
+
+        # peer_snap_drop: the NEXT stream dies mid-transfer and must
+        # not clobber the committed copy
+        flags({"FLAGS_fault_inject": "peer_snap_drop:at_step=4"})
+        a1.snapshot_now(4, {"w": np.zeros(6000, np.float32),
+                            "step": 4}, {"it": 5})
+        flags({"FLAGS_fault_inject": ""})
+        held = hot_spare.store_for("agents").latest(1)
+        assert held["step"] == 2              # torn transfer discarded
+
+        # park on exit: rank 0 (the survivor) parks the replicas it
+        # holds — rank 1 "died" and never parked, as in the drill
+        a0.park()
+    finally:
+        a0.close(park=False)
+        a1.close(park=False)
+    hot_spare._STORES.pop("agents", None)     # both "processes" gone
+    got = hot_spare.peer_restore("agents", 1, store=store)
+    assert got is not None
+    # rank 0 parked rank 1's replica → provenance is a peer's memory
+    assert got[2] == "peer" and got[1]["it"] == 3
+
+
+# ---------------------------------------------------------------------------
+# sentinel rung: prefer the fresher validated peer snapshot
+# ---------------------------------------------------------------------------
+
+class _FakeModel:
+    def __init__(self):
+        self.restored = None
+
+    def _sentinel_restore(self, state):
+        self.restored = state
+
+
+def _armed_agent_with_snapshot(it, flags):
+    flags({"FLAGS_hot_spare": True})
+    agent = hot_spare.arm(rank=0, world=1, job="sent")
+    agent.snapshot_now(it, {"w": np.full(8, float(it), np.float32)},
+                       {"it": it, "epoch": 0, "next_step": it})
+    return agent
+
+
+def test_sentinel_prefers_fresher_peer_snapshot(flags):
+    from paddle_tpu.framework.sentinel import TrainingSentinel
+    model = _FakeModel()
+    sen = TrainingSentinel(model=model)
+    sen._anchor = ({"w": np.full(8, 5.0, np.float32)},
+                   {"it": 5, "epoch": 0, "next_step": 5})
+    _armed_agent_with_snapshot(9, flags)      # fresher than the anchor
+    before = registry.counter("ckpt.peer.restores").value
+    directive = sen._escalate("drill", {"it": 12})
+    assert directive is not None and directive.it == 9
+    assert model.restored["w"][0] == 9.0
+    assert registry.counter("ckpt.peer.restores").value > before
+
+
+def test_sentinel_skips_stale_peer_snapshot(flags):
+    from paddle_tpu.framework.sentinel import TrainingSentinel
+    model = _FakeModel()
+    sen = TrainingSentinel(model=model)
+    sen._anchor = ({"w": np.full(8, 5.0, np.float32)},
+                   {"it": 5, "epoch": 0, "next_step": 5})
+    _armed_agent_with_snapshot(3, flags)      # staler than the anchor
+    before = registry.counter("ckpt.peer.stale_skipped").value
+    directive = sen._escalate("drill", {"it": 12})
+    assert directive is not None and directive.it == 5
+    assert model.restored["w"][0] == 5.0      # anchor won
+    assert registry.counter("ckpt.peer.stale_skipped").value > before
+
+
+# ---------------------------------------------------------------------------
+# flag-off identity + save_blocked_ms satellite
+# ---------------------------------------------------------------------------
+
+class _ToyData:
+    def __len__(self):
+        return 24
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(i)
+        x = rng.normal(size=(8,)).astype(np.float32)
+        return x, np.tanh(np.sum(x, keepdims=True)).astype(np.float32)
+
+
+def _fit_weights():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.AdamW(0.01,
+                                         parameters=net.parameters()),
+        loss=nn.MSELoss())
+    model.fit(_ToyData(), batch_size=4, epochs=1, verbose=0,
+              shuffle=False)
+    return {k: np.asarray(v._data_) for k, v in net.state_dict().items()}
+
+
+def test_flag_off_and_world1_bitwise_identity(flags):
+    flags({"FLAGS_hot_spare": False})
+    off = _fit_weights()
+    # world-of-one agent armed: snapshots captured, nothing streamed —
+    # the training trajectory must be BITWISE identical either way
+    flags({"FLAGS_hot_spare": True, "FLAGS_hot_spare_every": 2})
+    on = _fit_weights()
+    assert off.keys() == on.keys()
+    for k in off:
+        np.testing.assert_array_equal(off[k], on[k], err_msg=k)
+    # the fit armed (and closed) a real agent and declared the family
+    text = registry.render_prometheus()
+    assert "ckpt_peer_snapshots" in text
+
+
+def test_save_blocked_ms_histogram(tmp_path):
+    h = registry.histogram("ckpt.save_blocked_ms")
+    before = h.count
+
+    def slow_save(state, dirpath):
+        time.sleep(0.15)
+        with open(os.path.join(dirpath, "payload.bin"), "wb") as f:
+            f.write(b"x" * 64)
+
+    mgr = CheckpointManager(str(tmp_path), async_save=True,
+                            save_fn=slow_save)
+    # declared at construction, before any save blocks
+    assert "ckpt_save_blocked_ms_count" in registry.render_prometheus()
+    mgr.save({"w": 1}, step=0)
+    mgr.save({"w": 2}, step=1)   # prior save still writing → blocks
+    mgr.wait()
+    assert h.count > before
+    assert h.snapshot()["max"] >= 100.0      # ~150ms stall recorded
+
+
+# ---------------------------------------------------------------------------
+# fault-point grammar
+# ---------------------------------------------------------------------------
+
+def test_new_fault_point_specs_validate():
+    spec = ("peer_snap_drop:at_step=3,rank=1,after_chunks=2;"
+            "buddy_crash:rank=0,count=1;"
+            "step:crash_at=3,rank=1,once_file=/tmp/x.once")
+    parsed = fault_injection.parse(spec)
+    assert parsed["peer_snap_drop"] == {"at_step": 3, "rank": 1,
+                                        "after_chunks": 2}
+    assert parsed["buddy_crash"] == {"rank": 0, "count": 1}
+    assert parsed["step"]["once_file"] == "/tmp/x.once"
+    for bad in ("peer_snap_drop", "buddy_crash:nope=1",
+                "peer_snap_drop:at_step=x"):
+        with pytest.raises(fault_injection.FaultSpecError):
+            fault_injection.parse(bad)
+
+
+def test_step_point_rank_filter_and_once_file(tmp_path, flags):
+    once = tmp_path / "fired.once"
+    flags({"FLAGS_fault_inject":
+           f"step:sigterm_at=2,rank=3,once_file={once}"})
+    os.environ["PADDLE_TRAINER_ID"] = "0"
+    try:
+        fault_injection.check_step(2)     # filtered: wrong rank
+        assert not once.exists()
+    finally:
+        os.environ.pop("PADDLE_TRAINER_ID", None)
+
+
+# ---------------------------------------------------------------------------
+# 2-proc subprocess drills (slow-marked in conftest)
+# ---------------------------------------------------------------------------
+
+def _launch(nproc, outdir, fault=None, max_restart=0, level=0):
+    from paddle_tpu.distributed.launch.context import Context, parse_args
+    from paddle_tpu.distributed.launch.controller import \
+        CollectiveController
+    args = parse_args(["--nproc_per_node", str(nproc),
+                       "--max_restart", str(max_restart),
+                       "--log_dir", str(os.path.join(outdir, "logs")),
+                       WORKER, str(outdir)])
+    old = {k: os.environ.get(k) for k in
+           ("FLAGS_fault_inject", "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL")}
+    if fault is not None:
+        os.environ["FLAGS_fault_inject"] = fault
+    else:
+        os.environ.pop("FLAGS_fault_inject", None)
+    os.environ["PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL"] = str(level)
+    try:
+        return CollectiveController(Context(args=args)).run()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _incarnations(outdir):
+    with open(os.path.join(outdir, "incarnations.log")) as f:
+        return [ln.split(":") for ln in f.read().splitlines()]
+
+
+def _reference_losses(tmp_path):
+    d = tmp_path / "ref"
+    d.mkdir()
+    assert _launch(1, d) == 0
+    with open(d / "losses.json") as f:
+        return json.load(f)
+
+
+def test_hot_spare_drill_peer_restore(tmp_path):
+    """SIGKILL-grade crash of rank 1 at step 3 → relaunch → rank 1
+    resumes from the surviving buddy's parked RAM snapshot
+    (restored_from=peer, zero ckpt payload reads) and the loss
+    trajectory matches the uninterrupted run."""
+    ref = _reference_losses(tmp_path)
+    assert len(ref) == 6
+    d = tmp_path / "drill"
+    d.mkdir()
+    code = _launch(2, d,
+                   fault=f"step:crash_at=3,rank=1,"
+                         f"once_file={d / 'crash.once'}",
+                   max_restart=1, level=1)
+    assert code == 0
+    with open(d / "losses.json") as f:
+        got = json.load(f)
+    np.testing.assert_allclose(got, ref, rtol=0, atol=5e-4)
+    lines = _incarnations(d)
+    second = [ln for ln in lines[2:]]
+    assert len(lines) == 4, lines
+    r1 = next(ln for ln in second if ln[0] == "1")
+    # THE acceptance line: resumed at the crash step from peer memory
+    assert r1[2] == "3" and r1[3] == "peer", lines
+    r0 = next(ln for ln in second if ln[0] == "0")
+    assert r0[3] == "self", lines             # own parked copy
+
+
+def test_hot_spare_drill_buddy_crash_falls_to_disk(tmp_path):
+    """Same crash with buddy_crash injected for the relaunched rank:
+    the ladder must fall through to disk LOUDLY (typed warning in the
+    worker log), never silently diverge."""
+    ref = _reference_losses(tmp_path)
+    d = tmp_path / "drill_bc"
+    d.mkdir()
+    code = _launch(2, d,
+                   fault=f"step:crash_at=3,rank=1,"
+                         f"once_file={d / 'crash.once'};"
+                         f"buddy_crash:rank=1",
+                   max_restart=1, level=1)
+    assert code == 0
+    with open(d / "losses.json") as f:
+        got = json.load(f)
+    np.testing.assert_allclose(got, ref, rtol=0, atol=5e-4)
+    r1 = next(ln for ln in _incarnations(d)[2:] if ln[0] == "1")
+    assert r1[2] == "3" and r1[3] == "disk", _incarnations(d)
+    log = (d / "logs" / "worker.1.log").read_text()
+    assert "PeerRestoreWarning" in log

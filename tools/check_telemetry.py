@@ -463,6 +463,37 @@ def check_data_exposition(series, typed):
     return errors
 
 
+_HOT_SPARE_COUNTERS = ("ckpt_peer_snapshots", "ckpt_peer_bytes_sent",
+                       "ckpt_peer_restores", "ckpt_peer_stale_skipped",
+                       "ckpt_peer_crc_failures")
+_HOT_SPARE_HISTOGRAMS = ("ckpt_peer_transfer_ms", "ckpt_peer_restore_ms",
+                         "ckpt_save_blocked_ms")
+
+
+def check_hot_spare_exposition(series, typed):
+    """Schema gate for the hot-spare telemetry (ISSUE 20): the
+    ``ckpt.peer.*`` family — snapshot/byte/restore/stale/crc counters
+    plus the transfer and restore latency histograms — and the
+    ``ckpt.save_blocked_ms`` back-pressure histogram must expose,
+    correctly typed, from the moment the agent arms.  'Zero crc
+    failures' must mean 'every replica verified', not 'nobody was
+    counting'; a save_blocked_ms that never exposes hides the async
+    checkpoint writer stalling the train loop."""
+    errors = []
+    for name in _HOT_SPARE_COUNTERS:
+        if name not in series:
+            errors.append(f"hot-spare counter {name!r} absent")
+        elif typed.get(name) != "counter":
+            errors.append(f"{name!r} typed {typed.get(name)!r}, "
+                          "expected counter")
+    for name in _HOT_SPARE_HISTOGRAMS:
+        if typed.get(name) != "histogram":
+            errors.append(f"{name!r} absent or not a histogram")
+        elif name + "_bucket" not in series:
+            errors.append(f"{name!r} exposes no buckets")
+    return errors
+
+
 _CAMPAIGN_KEYS = {"schema_version": int, "seed": int, "episodes": int,
                   "faults": dict, "requests": int, "lost_requests": int,
                   "duplicate_requests": int, "mismatches": int,
@@ -673,6 +704,11 @@ def main():
                          "schema (data.fetch_ms histogram + batch/"
                          "starved counters + occupancy/input-bound "
                          "gauges) in the --prometheus dump")
+    ap.add_argument("--hot-spare", action="store_true",
+                    help="also gate the hot-spare recovery metric "
+                         "schema (ckpt.peer.* counters + transfer/"
+                         "restore histograms + ckpt.save_blocked_ms) "
+                         "in the --prometheus dump")
     ap.add_argument("--campaign-summary",
                     help="chaos-campaign summary JSON to schema-gate "
                          "(zero lost/duplicate/mismatch/leak required)")
@@ -697,6 +733,8 @@ def main():
         ap.error("--gray-failure needs --prometheus")
     if args.data and not args.prometheus:
         ap.error("--data needs --prometheus")
+    if args.hot_spare and not args.prometheus:
+        ap.error("--hot-spare needs --prometheus")
     if not args.prometheus and not args.snapshots \
             and not args.stall_dump and not args.sentinel_dump \
             and not args.campaign_summary and not args.trace \
@@ -755,6 +793,13 @@ def main():
                 print("data exposition OK: fetch_ms histogram + "
                       "batch/starved counters + occupancy/input-bound "
                       "gauges present")
+        if args.hot_spare:
+            hs_errors = check_hot_spare_exposition(series, typed)
+            failures += hs_errors
+            if not hs_errors:
+                print("hot-spare exposition OK: ckpt.peer.* counters "
+                      "+ transfer/restore + save_blocked_ms "
+                      "histograms present")
     if args.campaign_summary:
         errors = check_campaign_summary(args.campaign_summary)
         failures += errors
